@@ -1,0 +1,52 @@
+"""Generic BLS interface — the plugin boundary of the framework.
+
+Mirrors the reference's backend-generic BLS facade
+(/root/reference/crypto/bls/src/lib.rs:84-139, where `define_mod!` selects
+blst / fake_crypto at compile time). Here the backend is selected at runtime
+via `set_backend` / the LIGHTHOUSE_TPU_BLS_BACKEND env var:
+
+  "python" — pure-Python ground truth (this package's bls381 module)
+  "fake"   — always-valid stub proving the batch plumbing, like
+             /root/reference/crypto/bls/src/impls/fake_crypto.rs
+  "jax"    — the TPU-native batched backend (lighthouse_tpu.crypto.jaxbls)
+
+The core interchange record is SignatureSet — signature + signing keys +
+32-byte message — matching GenericSignatureSet
+(/root/reference/crypto/bls/src/generic_signature_set.rs:61).
+"""
+
+from .keys import SecretKey, PublicKey, Keypair, interop_keypairs, interop_keypair
+from .signature import Signature, AggregateSignature, INFINITY_SIGNATURE_BYTES
+from .signature_set import SignatureSet
+from .api import (
+    get_backend,
+    set_backend,
+    available_backends,
+    sign,
+    verify,
+    aggregate_verify,
+    fast_aggregate_verify,
+    eth_fast_aggregate_verify,
+    verify_signature_sets,
+)
+
+__all__ = [
+    "SecretKey",
+    "PublicKey",
+    "Keypair",
+    "Signature",
+    "AggregateSignature",
+    "SignatureSet",
+    "INFINITY_SIGNATURE_BYTES",
+    "interop_keypairs",
+    "interop_keypair",
+    "get_backend",
+    "set_backend",
+    "available_backends",
+    "sign",
+    "verify",
+    "aggregate_verify",
+    "fast_aggregate_verify",
+    "eth_fast_aggregate_verify",
+    "verify_signature_sets",
+]

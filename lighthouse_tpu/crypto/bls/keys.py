@@ -1,0 +1,113 @@
+"""Secret/public key types for the generic BLS layer.
+
+Parity surface: GenericSecretKey / GenericPublicKey in
+/root/reference/crypto/bls/src/generic_secret_key.rs and
+generic_public_key.rs, and the deterministic interop keypairs of
+/root/reference/common/eth2_interop_keypairs/src/lib.rs (sk =
+le_int(sha256(index_le_pad32)) mod r).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from ..bls381 import curve as cv
+from ..bls381 import serde
+from ..bls381.constants import R
+
+SECRET_KEY_BYTES = 32
+PUBLIC_KEY_BYTES = 48
+
+
+class SecretKey:
+    __slots__ = ("_scalar",)
+
+    def __init__(self, scalar: int):
+        if not 0 < scalar < R:
+            raise ValueError("secret key scalar out of range")
+        self._scalar = scalar
+
+    @classmethod
+    def deserialize(cls, data: bytes) -> "SecretKey":
+        if len(data) != SECRET_KEY_BYTES:
+            raise ValueError("secret key must be 32 bytes")
+        return cls(int.from_bytes(data, "big"))
+
+    def serialize(self) -> bytes:
+        return self._scalar.to_bytes(SECRET_KEY_BYTES, "big")
+
+    @property
+    def scalar(self) -> int:
+        return self._scalar
+
+    def public_key(self) -> "PublicKey":
+        return PublicKey(cv.g1_mul(cv.G1_GEN, self._scalar))
+
+    def __repr__(self):
+        return "SecretKey(<redacted>)"
+
+
+class PublicKey:
+    """A decompressed, subgroup-checked G1 public key."""
+
+    __slots__ = ("_point", "_compressed")
+
+    def __init__(self, point):
+        if point is None:
+            raise ValueError("public key may not be the point at infinity")
+        self._point = point
+        self._compressed = None
+
+    @classmethod
+    def deserialize(cls, data: bytes) -> "PublicKey":
+        pt = serde.g1_decompress(data, subgroup_check=True)
+        if pt is None:
+            raise ValueError("public key may not be the point at infinity")
+        pk = cls(pt)
+        pk._compressed = bytes(data)
+        return pk
+
+    def serialize(self) -> bytes:
+        if self._compressed is None:
+            self._compressed = serde.g1_compress(self._point)
+        return self._compressed
+
+    @property
+    def point(self):
+        return self._point
+
+    def __eq__(self, other):
+        return isinstance(other, PublicKey) and self._point == other._point
+
+    def __hash__(self):
+        return hash(self.serialize())
+
+    def __repr__(self):
+        return f"PublicKey(0x{self.serialize().hex()})"
+
+
+class Keypair:
+    __slots__ = ("sk", "pk")
+
+    def __init__(self, sk: SecretKey, pk: PublicKey):
+        self.sk = sk
+        self.pk = pk
+
+    @classmethod
+    def from_secret(cls, sk: SecretKey) -> "Keypair":
+        return cls(sk, sk.public_key())
+
+
+def interop_secret_key(validator_index: int) -> SecretKey:
+    """Deterministic interop secret key: le_int(sha256(index_le32)) mod r."""
+    preimage = validator_index.to_bytes(8, "little") + b"\x00" * 24
+    scalar = int.from_bytes(hashlib.sha256(preimage).digest(), "little") % R
+    return SecretKey(scalar)
+
+
+def interop_keypair(validator_index: int) -> Keypair:
+    return Keypair.from_secret(interop_secret_key(validator_index))
+
+
+def interop_keypairs(count: int) -> list[Keypair]:
+    return [interop_keypair(i) for i in range(count)]

@@ -1,0 +1,76 @@
+"""Signature / AggregateSignature types (G2 points).
+
+Parity surface: GenericSignature / GenericAggregateSignature in
+/root/reference/crypto/bls/src/generic_signature.rs and
+generic_aggregate_signature.rs — including the explicit representation of the
+point at infinity (used by the spec for empty sync aggregates).
+"""
+
+from __future__ import annotations
+
+from ..bls381 import curve as cv
+from ..bls381 import serde
+
+SIGNATURE_BYTES = 96
+INFINITY_SIGNATURE_BYTES = bytes([0xC0] + [0] * 95)
+
+
+class Signature:
+    """A (possibly infinity) G2 signature, decompressed and subgroup-checked."""
+
+    __slots__ = ("_point", "_compressed")
+
+    def __init__(self, point):
+        self._point = point  # None == infinity
+        self._compressed = None
+
+    @classmethod
+    def infinity(cls) -> "Signature":
+        return cls(None)
+
+    @classmethod
+    def deserialize(cls, data: bytes, subgroup_check: bool = True) -> "Signature":
+        pt = serde.g2_decompress(data, subgroup_check=subgroup_check)
+        sig = cls(pt)
+        sig._compressed = bytes(data)
+        return sig
+
+    def serialize(self) -> bytes:
+        if self._compressed is None:
+            self._compressed = serde.g2_compress(self._point)
+        return self._compressed
+
+    @property
+    def point(self):
+        return self._point
+
+    def is_infinity(self) -> bool:
+        return self._point is None
+
+    def __eq__(self, other):
+        return isinstance(other, Signature) and self._point == other._point
+
+    def __hash__(self):
+        return hash(self.serialize())
+
+    def __repr__(self):
+        return f"Signature(0x{self.serialize().hex()})"
+
+
+class AggregateSignature(Signature):
+    """A running aggregate of G2 signatures (starts at infinity)."""
+
+    @classmethod
+    def empty(cls) -> "AggregateSignature":
+        return cls(None)
+
+    def add_assign(self, other: Signature) -> None:
+        self._point = cv.g2_add(self._point, other.point)
+        self._compressed = None
+
+    @classmethod
+    def aggregate(cls, signatures) -> "AggregateSignature":
+        agg = cls.empty()
+        for s in signatures:
+            agg.add_assign(s)
+        return agg

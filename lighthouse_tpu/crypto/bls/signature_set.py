@@ -1,0 +1,40 @@
+"""SignatureSet — the pure-data interchange record for batch verification.
+
+Matches GenericSignatureSet
+(/root/reference/crypto/bls/src/generic_signature_set.rs:61): one (aggregate)
+signature, one or more signing public keys, and a single 32-byte message.
+Sets are what the chain layers accumulate and hand to the crypto backend —
+on TPU, batches of these are what the vmapped pairing kernel consumes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence
+
+from .keys import PublicKey
+from .signature import Signature
+
+
+@dataclass(frozen=True)
+class SignatureSet:
+    signature: Signature
+    signing_keys: tuple[PublicKey, ...]
+    message: bytes  # 32-byte signing root
+
+    def __init__(self, signature: Signature, signing_keys: Sequence[PublicKey], message: bytes):
+        if len(message) != 32:
+            raise ValueError("SignatureSet message must be a 32-byte root")
+        if len(signing_keys) == 0:
+            raise ValueError("SignatureSet requires at least one signing key")
+        object.__setattr__(self, "signature", signature)
+        object.__setattr__(self, "signing_keys", tuple(signing_keys))
+        object.__setattr__(self, "message", bytes(message))
+
+    @classmethod
+    def single_pubkey(cls, signature: Signature, signing_key: PublicKey, message: bytes):
+        return cls(signature, (signing_key,), message)
+
+    @classmethod
+    def multiple_pubkeys(cls, signature: Signature, signing_keys: Sequence[PublicKey], message: bytes):
+        return cls(signature, signing_keys, message)

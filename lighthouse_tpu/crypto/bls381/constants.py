@@ -1,0 +1,54 @@
+"""BLS12-381 curve constants.
+
+These are the public, standardized parameters of the BLS12-381 pairing-friendly
+curve (draft-irtf-cfrg-pairing-friendly-curves; used by the Ethereum consensus
+spec). Reference parity: the same constants underlie blst as wrapped by
+/root/reference/crypto/bls/src/impls/blst.rs.
+
+All values are self-validated in tests/test_bls381_core.py:
+  - p, r primality witnesses
+  - generator curve membership and subgroup order
+  - r == x^4 - x^2 + 1, p == (x-1)^2 * r / 3 + x
+"""
+
+# Base field prime.
+P = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+
+# Subgroup order (scalar field).
+R = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
+
+# BLS parameter x (negative: x = -X_ABS). Drives the Miller loop and final exp.
+X_ABS = 0xD201000000010000
+X_IS_NEGATIVE = True
+
+# Curve equations: G1: y^2 = x^3 + 4 over Fq; G2: y^2 = x^3 + 4(u+1) over Fq2.
+B_G1 = 4
+B_G2 = (4, 4)
+
+# Generators.
+G1_X = 0x17F1D3A73197D7942695638C4FA9AC0FC3688C4F9774B905A14E3A3F171BAC586C55E83FF97A1AEFFB3AF00ADB22C6BB
+G1_Y = 0x08B3F481E3AAA0F1A09E30ED741D8AE4FCF5E095D5D00AF600DB18CB2C04B3EDD03CC744A2888AE40CAA232946C5E7E1
+
+G2_X = (
+    0x024AA2B2F08F0A91260805272DC51051C6E47AD4FA403B02B4510B647AE3D1770BAC0326A805BBEFD48056C8C121BDB8,
+    0x13E02B6052719F607DACD3A088274F65596BD0D09920B61AB5DA61BBDC7F5049334CF11213945D57E5AC7D055D042B7E,
+)
+G2_Y = (
+    0x0CE5D527727D6E118CC9CDC6DA2E351AADFD9BAA8CBDD3A76D429A695160D12C923AC9CC3BACA289E193548608B82801,
+    0x0606C4A02EA734CC32ACD2B02BC28B99CB3E287E85A763AF267492AB572E99AB3F370D275CEC1DA1AAA9075FF05F79BE,
+)
+
+# Cofactors.
+H_G1 = 0x396C8C005555E1568C00AAAB0000AAAB
+# G2 cofactor h2 = (x^8 - 4x^7 + 5x^6 - 4x^4 + 6x^3 - 4x^2 - 4x + 13) / 9
+_x = -X_ABS
+H_G2 = (_x**8 - 4 * _x**7 + 5 * _x**6 - 4 * _x**4 + 6 * _x**3 - 4 * _x**2 - 4 * _x + 13) // 9
+
+# Effective cofactor for G2 cofactor clearing (RFC 9380 §8.8.2) == 3 * H_G2,
+# verified numerically in tests (test_h_eff_is_3h2).
+H_EFF_G2 = 3 * H_G2
+
+# Ethereum BLS signature scheme domain separation tag (proof-of-possession
+# ciphersuite BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_), matching
+# /root/reference/crypto/bls/src/impls/blst.rs:13.
+DST_POP = b"BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"

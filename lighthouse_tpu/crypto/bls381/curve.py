@@ -1,0 +1,143 @@
+"""Pure-Python G1/G2 group operations for BLS12-381.
+
+Points are affine tuples (x, y) with the identity represented as None.
+G1 coordinates live in Fq (ints), G2 coordinates in Fq2 (int pairs).
+
+This is the host-side ground truth the batched JAX backend
+(lighthouse_tpu/crypto/jaxbls/curve_ops.py) is differentially tested against,
+playing the role blst's scalar paths play in /root/reference/crypto/bls.
+"""
+
+from . import fields as f
+from .constants import B_G1, B_G2, G1_X, G1_Y, G2_X, G2_Y, H_EFF_G2, P, R
+
+
+class _FieldOps:
+    __slots__ = ("add", "sub", "mul", "sqr", "neg", "inv", "zero", "one", "scalar", "b")
+
+    def __init__(self, add, sub, mul, sqr, neg, inv, zero, one, scalar, b):
+        self.add = add
+        self.sub = sub
+        self.mul = mul
+        self.sqr = sqr
+        self.neg = neg
+        self.inv = inv
+        self.zero = zero
+        self.one = one
+        self.scalar = scalar  # multiply field element by small int
+        self.b = b            # curve constant
+
+
+FQ_OPS = _FieldOps(
+    add=f.fq_add, sub=f.fq_sub, mul=f.fq_mul, sqr=lambda a: a * a % P,
+    neg=f.fq_neg, inv=f.fq_inv, zero=0, one=1,
+    scalar=lambda a, k: a * k % P, b=B_G1,
+)
+
+FQ2_OPS = _FieldOps(
+    add=f.fq2_add, sub=f.fq2_sub, mul=f.fq2_mul, sqr=f.fq2_sqr,
+    neg=f.fq2_neg, inv=f.fq2_inv, zero=f.FQ2_ZERO, one=f.FQ2_ONE,
+    scalar=f.fq2_mul_scalar, b=B_G2,
+)
+
+
+def is_on_curve(pt, ops):
+    if pt is None:
+        return True
+    x, y = pt
+    return ops.sqr(y) == ops.add(ops.mul(ops.sqr(x), x), ops.b)
+
+
+def add(p1, p2, ops):
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2:
+        if y1 == y2:
+            return double(p1, ops)
+        return None  # P + (-P)
+    lam = ops.mul(ops.sub(y2, y1), ops.inv(ops.sub(x2, x1)))
+    x3 = ops.sub(ops.sub(ops.sqr(lam), x1), x2)
+    y3 = ops.sub(ops.mul(lam, ops.sub(x1, x3)), y1)
+    return (x3, y3)
+
+
+def double(pt, ops):
+    if pt is None:
+        return None
+    x, y = pt
+    if y == ops.zero:
+        return None
+    lam = ops.mul(ops.scalar(ops.sqr(x), 3), ops.inv(ops.scalar(y, 2)))
+    x3 = ops.sub(ops.sqr(lam), ops.scalar(x, 2))
+    y3 = ops.sub(ops.mul(lam, ops.sub(x, x3)), y)
+    return (x3, y3)
+
+
+def neg(pt, ops):
+    if pt is None:
+        return None
+    return (pt[0], ops.neg(pt[1]))
+
+
+def mul_raw(pt, k, ops):
+    """Scalar multiplication by an arbitrary non-negative integer."""
+    if k < 0:
+        return mul_raw(neg(pt, ops), -k, ops)
+    result = None
+    addend = pt
+    while k:
+        if k & 1:
+            result = add(result, addend, ops)
+        addend = double(addend, ops)
+        k >>= 1
+    return result
+
+
+def eq(p1, p2):
+    return p1 == p2
+
+
+# Convenience wrappers ---------------------------------------------------
+
+G1_GEN = (G1_X, G1_Y)
+G2_GEN = (G2_X, G2_Y)
+
+
+def g1_add(p1, p2):
+    return add(p1, p2, FQ_OPS)
+
+
+def g1_mul(pt, k):
+    return mul_raw(pt, k % R, FQ_OPS)
+
+
+def g1_neg(pt):
+    return neg(pt, FQ_OPS)
+
+
+def g2_add(p1, p2):
+    return add(p1, p2, FQ2_OPS)
+
+
+def g2_mul(pt, k):
+    return mul_raw(pt, k % R, FQ2_OPS)
+
+
+def g2_neg(pt):
+    return neg(pt, FQ2_OPS)
+
+
+def g1_in_subgroup(pt):
+    return is_on_curve(pt, FQ_OPS) and mul_raw(pt, R, FQ_OPS) is None
+
+
+def g2_in_subgroup(pt):
+    return is_on_curve(pt, FQ2_OPS) and mul_raw(pt, R, FQ2_OPS) is None
+
+
+def g2_clear_cofactor(pt):
+    return mul_raw(pt, H_EFF_G2, FQ2_OPS)

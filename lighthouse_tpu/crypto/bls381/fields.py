@@ -1,0 +1,312 @@
+"""Pure-Python BLS12-381 field tower: Fq, Fq2, Fq6, Fq12.
+
+Ground-truth implementation used for (a) host-side single operations
+(decompression, key handling), (b) differential testing of the batched JAX
+backend (lighthouse_tpu/crypto/jaxbls), mirroring the role blst's reference
+paths play under /root/reference/crypto/bls.
+
+Representation (kept deliberately plain so the JAX backend can match it
+bit-for-bit):
+  Fq   : int in [0, P)
+  Fq2  : (c0, c1)            = c0 + c1*u,        u^2 = -1
+  Fq6  : (a0, a1, a2) of Fq2 = a0 + a1*v + a2*v^2, v^3 = xi = u + 1
+  Fq12 : (b0, b1) of Fq6     = b0 + b1*w,        w^2 = v
+"""
+
+from .constants import P
+
+# ---------------------------------------------------------------- Fq
+
+def fq_add(a, b):
+    return (a + b) % P
+
+
+def fq_sub(a, b):
+    return (a - b) % P
+
+
+def fq_mul(a, b):
+    return (a * b) % P
+
+
+def fq_neg(a):
+    return (-a) % P
+
+
+def fq_inv(a):
+    if a == 0:
+        raise ZeroDivisionError("inverse of 0 in Fq")
+    return pow(a, P - 2, P)
+
+
+def fq_is_square(a):
+    return a == 0 or pow(a, (P - 1) // 2, P) == 1
+
+
+def fq_sqrt(a):
+    """Square root in Fq (P ≡ 3 mod 4), or None if a is not a QR."""
+    if a == 0:
+        return 0
+    root = pow(a, (P + 1) // 4, P)
+    return root if root * root % P == a else None
+
+
+def fq_sgn0(a):
+    return a & 1
+
+
+# ---------------------------------------------------------------- Fq2
+
+FQ2_ZERO = (0, 0)
+FQ2_ONE = (1, 0)
+
+
+def fq2_add(a, b):
+    return ((a[0] + b[0]) % P, (a[1] + b[1]) % P)
+
+
+def fq2_sub(a, b):
+    return ((a[0] - b[0]) % P, (a[1] - b[1]) % P)
+
+
+def fq2_neg(a):
+    return ((-a[0]) % P, (-a[1]) % P)
+
+
+def fq2_conj(a):
+    return (a[0], (-a[1]) % P)
+
+
+def fq2_mul(a, b):
+    a0, a1 = a
+    b0, b1 = b
+    return ((a0 * b0 - a1 * b1) % P, (a0 * b1 + a1 * b0) % P)
+
+
+def fq2_sqr(a):
+    a0, a1 = a
+    # (a0 + a1 u)^2 = (a0+a1)(a0-a1) + 2 a0 a1 u
+    return ((a0 + a1) * (a0 - a1) % P, 2 * a0 * a1 % P)
+
+
+def fq2_mul_scalar(a, k):
+    return (a[0] * k % P, a[1] * k % P)
+
+
+def fq2_mul_by_xi(a):
+    """Multiply by xi = u + 1: (c0 + c1 u)(1 + u) = (c0 - c1) + (c0 + c1) u."""
+    a0, a1 = a
+    return ((a0 - a1) % P, (a0 + a1) % P)
+
+
+def fq2_inv(a):
+    a0, a1 = a
+    norm = (a0 * a0 + a1 * a1) % P
+    ninv = fq_inv(norm)
+    return (a0 * ninv % P, (-a1) * ninv % P)
+
+
+def fq2_pow(a, e):
+    result = FQ2_ONE
+    base = a
+    while e > 0:
+        if e & 1:
+            result = fq2_mul(result, base)
+        base = fq2_sqr(base)
+        e >>= 1
+    return result
+
+
+def fq2_is_zero(a):
+    return a[0] == 0 and a[1] == 0
+
+
+def fq2_legendre_is_square(a):
+    """QR test in Fq2 via the norm map: a is a square iff N(a) is a QR in Fq."""
+    if fq2_is_zero(a):
+        return True
+    norm = (a[0] * a[0] + a[1] * a[1]) % P
+    return fq_is_square(norm)
+
+
+def fq2_sqrt(a):
+    """Square root of a = a0 + a1*u in Fq2, or None if not a QR.
+
+    Uses the classical complex-style formula via the norm: with
+    s = sqrt(a0^2 + a1^2), the roots are x + y*u where x^2 = (a0 + s)/2
+    (or (a0 - s)/2) and y = a1 / (2x). Verified by re-squaring.
+    """
+    a0, a1 = a
+    if a1 == 0:
+        r = fq_sqrt(a0)
+        if r is not None:
+            return (r, 0)
+        # a0 is a non-residue; since -1 is a non-residue (P ≡ 3 mod 4),
+        # -a0 is a QR and sqrt(a0) = sqrt(-a0) * u.
+        r = fq_sqrt((-a0) % P)
+        assert r is not None
+        return (0, r)
+    s = fq_sqrt((a0 * a0 + a1 * a1) % P)
+    if s is None:
+        return None
+    inv2 = fq_inv(2)
+    for sign in (s, (-s) % P):
+        x2 = (a0 + sign) * inv2 % P
+        x = fq_sqrt(x2)
+        if x is not None and x != 0:
+            y = a1 * fq_inv(2 * x % P) % P
+            cand = (x, y)
+            if fq2_sqr(cand) == (a0 % P, a1 % P):
+                return cand
+    return None
+
+
+def fq2_sgn0(a):
+    """RFC 9380 sgn0 for Fq2 (m=2, lexicographic-in-limbs)."""
+    s0 = a[0] & 1
+    z0 = a[0] == 0
+    s1 = a[1] & 1
+    return s0 | (z0 & s1)
+
+
+# ---------------------------------------------------------------- Fq6
+
+FQ6_ZERO = (FQ2_ZERO, FQ2_ZERO, FQ2_ZERO)
+FQ6_ONE = (FQ2_ONE, FQ2_ZERO, FQ2_ZERO)
+
+
+def fq6_add(a, b):
+    return (fq2_add(a[0], b[0]), fq2_add(a[1], b[1]), fq2_add(a[2], b[2]))
+
+
+def fq6_sub(a, b):
+    return (fq2_sub(a[0], b[0]), fq2_sub(a[1], b[1]), fq2_sub(a[2], b[2]))
+
+
+def fq6_neg(a):
+    return (fq2_neg(a[0]), fq2_neg(a[1]), fq2_neg(a[2]))
+
+
+def fq6_mul(a, b):
+    a0, a1, a2 = a
+    b0, b1, b2 = b
+    t0 = fq2_mul(a0, b0)
+    t1 = fq2_mul(a1, b1)
+    t2 = fq2_mul(a2, b2)
+    # Karatsuba-style interpolation (Devegili et al.)
+    c0 = fq2_add(t0, fq2_mul_by_xi(fq2_sub(fq2_mul(fq2_add(a1, a2), fq2_add(b1, b2)), fq2_add(t1, t2))))
+    c1 = fq2_add(fq2_sub(fq2_mul(fq2_add(a0, a1), fq2_add(b0, b1)), fq2_add(t0, t1)), fq2_mul_by_xi(t2))
+    c2 = fq2_add(fq2_sub(fq2_mul(fq2_add(a0, a2), fq2_add(b0, b2)), fq2_add(t0, t2)), t1)
+    return (c0, c1, c2)
+
+
+def fq6_sqr(a):
+    return fq6_mul(a, a)
+
+
+def fq6_mul_by_v(a):
+    """Multiply by v: (a0, a1, a2) -> (xi*a2, a0, a1)."""
+    return (fq2_mul_by_xi(a[2]), a[0], a[1])
+
+
+def fq6_inv(a):
+    a0, a1, a2 = a
+    c0 = fq2_sub(fq2_sqr(a0), fq2_mul_by_xi(fq2_mul(a1, a2)))
+    c1 = fq2_sub(fq2_mul_by_xi(fq2_sqr(a2)), fq2_mul(a0, a1))
+    c2 = fq2_sub(fq2_sqr(a1), fq2_mul(a0, a2))
+    t = fq2_add(
+        fq2_mul_by_xi(fq2_add(fq2_mul(a1, c2), fq2_mul(a2, c1))),
+        fq2_mul(a0, c0),
+    )
+    tinv = fq2_inv(t)
+    return (fq2_mul(c0, tinv), fq2_mul(c1, tinv), fq2_mul(c2, tinv))
+
+
+# ---------------------------------------------------------------- Fq12
+
+FQ12_ZERO = (FQ6_ZERO, FQ6_ZERO)
+FQ12_ONE = (FQ6_ONE, FQ6_ZERO)
+
+
+def fq12_add(a, b):
+    return (fq6_add(a[0], b[0]), fq6_add(a[1], b[1]))
+
+
+def fq12_mul(a, b):
+    a0, a1 = a
+    b0, b1 = b
+    t0 = fq6_mul(a0, b0)
+    t1 = fq6_mul(a1, b1)
+    c0 = fq6_add(t0, fq6_mul_by_v(t1))
+    c1 = fq6_sub(fq6_mul(fq6_add(a0, a1), fq6_add(b0, b1)), fq6_add(t0, t1))
+    return (c0, c1)
+
+
+def fq12_sqr(a):
+    return fq12_mul(a, a)
+
+
+def fq12_conj(a):
+    """Conjugation over Fq6 (the p^6 Frobenius): (b0, b1) -> (b0, -b1)."""
+    return (a[0], fq6_neg(a[1]))
+
+
+def fq12_inv(a):
+    a0, a1 = a
+    t = fq6_sub(fq6_sqr(a0), fq6_mul_by_v(fq6_sqr(a1)))
+    tinv = fq6_inv(t)
+    return (fq6_mul(a0, tinv), fq6_neg(fq6_mul(a1, tinv)))
+
+
+def fq12_pow(a, e):
+    if e < 0:
+        return fq12_pow(fq12_inv(a), -e)
+    result = FQ12_ONE
+    base = a
+    while e > 0:
+        if e & 1:
+            result = fq12_mul(result, base)
+        base = fq12_sqr(base)
+        e >>= 1
+    return result
+
+
+def fq12_eq_one(a):
+    return a == FQ12_ONE
+
+
+# ------------------------------------------------ Frobenius endomorphism
+# gamma constants computed once at import (cheap): powers of xi.
+
+# Fq2 frobenius is conjugation. For Fq6/Fq12 we need xi^((p-1)/3), xi^((p-1)/6)
+# and their powers, all elements of Fq2.
+
+_XI = (1, 1)
+
+# xi^((p^i - 1) / 6) for i = 1..11 — coefficients for Fq12 frobenius.
+FROB_FQ12_C1 = [fq2_pow(_XI, (P**i - 1) // 6) for i in range(12)]
+# For Fq6 frobenius: xi^((p^i - 1)/3) and xi^(2(p^i - 1)/3)
+FROB_FQ6_C1 = [fq2_pow(_XI, (P**i - 1) // 3) for i in range(6)]
+FROB_FQ6_C2 = [fq2_pow(_XI, 2 * (P**i - 1) // 3) for i in range(6)]
+
+
+def fq2_frobenius(a, power=1):
+    return a if power % 2 == 0 else fq2_conj(a)
+
+
+def fq6_frobenius(a, power=1):
+    a0, a1, a2 = a
+    return (
+        fq2_frobenius(a0, power),
+        fq2_mul(fq2_frobenius(a1, power), FROB_FQ6_C1[power % 6]),
+        fq2_mul(fq2_frobenius(a2, power), FROB_FQ6_C2[power % 6]),
+    )
+
+
+def fq12_frobenius(a, power=1):
+    a0, a1 = a
+    c0 = fq6_frobenius(a0, power)
+    c1 = fq6_frobenius(a1, power)
+    g = FROB_FQ12_C1[power % 12]
+    c1 = tuple(fq2_mul(x, g) for x in c1)
+    return (c0, c1)

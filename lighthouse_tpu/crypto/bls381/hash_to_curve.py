@@ -1,0 +1,185 @@
+"""RFC 9380 hash-to-curve for BLS12-381 G2 (BLS12381G2_XMD:SHA-256_SSWU_RO_).
+
+This is the message-hashing half of the Ethereum BLS signature scheme
+(signatures live in G2, public keys in G1 — the "minimal-pubkey-size"
+POP ciphersuite used via blst in
+/root/reference/crypto/bls/src/impls/blst.rs:13).
+
+Pipeline: expand_message_xmd(SHA-256) -> hash_to_field (Fq2, count=2, L=64)
+-> simplified SWU on the 3-isogenous curve E' -> 3-isogeny to E2
+-> cofactor clearing by h_eff.
+
+The isogeny map constants are validated structurally in tests: outputs of the
+SSWU map are verified on E', isogeny outputs verified on E2, and the isogeny
+verified to be a group homomorphism on random samples — any wrong constant
+fails those with overwhelming probability.
+"""
+
+import hashlib
+
+from . import fields as f
+from .constants import P
+from . import curve as cv
+
+# --- E2' (3-isogenous curve): y^2 = x^3 + A'x + B', over Fq2 ---
+ISO_A = (0, 240)
+ISO_B = (1012, 1012)
+# SSWU Z parameter: -(2 + u)
+ISO_Z = ((-2) % P, (-1) % P)
+
+# --- 3-isogeny map E2' -> E2 constants (RFC 9380 Appendix E.3) ---
+_K = 0x171D6541FA38CCFAED6DEA691F5FB614CB14B4E7F4E810AA22D6108F142B85757098E38D0F671C7188E2AAAAAAAA5ED1
+
+X_NUM = [
+    (
+        0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97D6,
+        0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97D6,
+    ),
+    (
+        0,
+        0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71A,
+    ),
+    (
+        0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71E,
+        0x8AB05F8BDD54CDE190937E76BC3E447CC27C3D6FBD7063FCD104635A790520C0A395554E5C6AAAA9354FFFFFFFFE38D,
+    ),
+    (
+        0x171D6541FA38CCFAED6DEA691F5FB614CB14B4E7F4E810AA22D6108F142B85757098E38D0F671C7188E2AAAAAAAA5ED1,
+        0,
+    ),
+]
+
+X_DEN = [
+    (
+        0,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA63,
+    ),
+    (
+        0xC,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA9F,
+    ),
+    ((1, 0)),  # leading coefficient (monic x^2 term)
+]
+
+Y_NUM = [
+    (
+        0x1530477C7AB4113B59A4C18B076D11930F7DA5D4A07F649BF54439D87D27E500FC8C25EBF8C92F6812CFC71C71C6D706,
+        0x1530477C7AB4113B59A4C18B076D11930F7DA5D4A07F649BF54439D87D27E500FC8C25EBF8C92F6812CFC71C71C6D706,
+    ),
+    (
+        0,
+        0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97BE,
+    ),
+    (
+        0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71C,
+        0x8AB05F8BDD54CDE190937E76BC3E447CC27C3D6FBD7063FCD104635A790520C0A395554E5C6AAAA9354FFFFFFFFE38F,
+    ),
+    (
+        0x124C9AD43B6CF79BFBF7043DE3811AD0761B0F37A1E26286B0E977C69AA274524E79097A56DC4BD9E1B371C71C718B10,
+        0,
+    ),
+]
+
+Y_DEN = [
+    (
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFA8FB,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFA8FB,
+    ),
+    (
+        0,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFA9D3,
+    ),
+    (
+        0x12,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA99,
+    ),
+    ((1, 0)),  # monic x^3 term
+]
+
+
+def expand_message_xmd(msg: bytes, dst: bytes, len_in_bytes: int) -> bytes:
+    """RFC 9380 §5.3.1 expand_message_xmd with SHA-256."""
+    h = hashlib.sha256
+    b_in_bytes = 32
+    s_in_bytes = 64
+    ell = (len_in_bytes + b_in_bytes - 1) // b_in_bytes
+    if ell > 255 or len(dst) > 255:
+        raise ValueError("expand_message_xmd parameter overflow")
+    dst_prime = dst + len(dst).to_bytes(1, "big")
+    z_pad = b"\x00" * s_in_bytes
+    l_i_b_str = len_in_bytes.to_bytes(2, "big")
+    b_0 = h(z_pad + msg + l_i_b_str + b"\x00" + dst_prime).digest()
+    b_vals = [h(b_0 + b"\x01" + dst_prime).digest()]
+    for i in range(2, ell + 1):
+        tmp = bytes(x ^ y for x, y in zip(b_0, b_vals[-1]))
+        b_vals.append(h(tmp + i.to_bytes(1, "big") + dst_prime).digest())
+    return b"".join(b_vals)[:len_in_bytes]
+
+
+def hash_to_field_fq2(msg: bytes, count: int, dst: bytes):
+    """RFC 9380 §5.2 hash_to_field with m=2, L=64."""
+    m, L = 2, 64
+    uniform = expand_message_xmd(msg, dst, count * m * L)
+    out = []
+    for i in range(count):
+        coords = []
+        for j in range(m):
+            off = L * (j + i * m)
+            coords.append(int.from_bytes(uniform[off : off + L], "big") % P)
+        out.append(tuple(coords))
+    return out
+
+
+def sswu(u):
+    """Simplified SWU map to E2' (RFC 9380 §6.6.2), returns affine point on E2'."""
+    A, B, Z = ISO_A, ISO_B, ISO_Z
+    u2 = f.fq2_sqr(u)
+    tv1 = f.fq2_mul(Z, u2)                    # Z u^2
+    tv2 = f.fq2_add(f.fq2_sqr(tv1), tv1)      # Z^2 u^4 + Z u^2
+    neg_b = f.fq2_neg(B)
+    inv_a = f.fq2_inv(A)
+    if f.fq2_is_zero(tv2):
+        # x1 = B / (Z A)
+        x1 = f.fq2_mul(neg_b, f.fq2_inv(f.fq2_mul(Z, A)))
+        x1 = f.fq2_neg(x1)
+    else:
+        # x1 = (-B/A) * (1 + 1/tv2)
+        x1 = f.fq2_mul(f.fq2_mul(neg_b, inv_a), f.fq2_add(f.FQ2_ONE, f.fq2_inv(tv2)))
+    gx1 = f.fq2_add(f.fq2_mul(f.fq2_add(f.fq2_sqr(x1), A), x1), B)  # x1^3 + A x1 + B
+    if f.fq2_legendre_is_square(gx1):
+        x, y = x1, f.fq2_sqrt(gx1)
+    else:
+        x2 = f.fq2_mul(tv1, x1)               # Z u^2 x1
+        gx2 = f.fq2_add(f.fq2_mul(f.fq2_add(f.fq2_sqr(x2), A), x2), B)
+        x, y = x2, f.fq2_sqrt(gx2)
+    assert y is not None, "SSWU: neither gx1 nor gx2 square (impossible)"
+    if f.fq2_sgn0(u) != f.fq2_sgn0(y):
+        y = f.fq2_neg(y)
+    return (x, y)
+
+
+def _horner(coeffs, x):
+    acc = coeffs[-1]
+    for c in reversed(coeffs[:-1]):
+        acc = f.fq2_add(f.fq2_mul(acc, x), c)
+    return acc
+
+
+def iso_map(pt):
+    """Apply the 3-isogeny E2' -> E2."""
+    x, y = pt
+    x_num = _horner(X_NUM, x)
+    x_den = _horner(X_DEN, x)
+    y_num = _horner(Y_NUM, x)
+    y_den = _horner(Y_DEN, x)
+    xo = f.fq2_mul(x_num, f.fq2_inv(x_den))
+    yo = f.fq2_mul(y, f.fq2_mul(y_num, f.fq2_inv(y_den)))
+    return (xo, yo)
+
+
+def hash_to_g2(msg: bytes, dst: bytes):
+    """Full hash_to_curve for G2: returns an affine point in the r-order subgroup."""
+    u0, u1 = hash_to_field_fq2(msg, 2, dst)
+    q0 = iso_map(sswu(u0))
+    q1 = iso_map(sswu(u1))
+    return cv.g2_clear_cofactor(cv.g2_add(q0, q1))

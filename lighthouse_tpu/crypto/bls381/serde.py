@@ -1,0 +1,109 @@
+"""ZCash-format point serialization for BLS12-381 (the Ethereum wire format).
+
+Compressed G1 = 48 bytes, compressed G2 = 96 bytes. Three flag bits live in
+the most significant bits of the first byte:
+  bit 7 (0x80): compression flag (always 1 for compressed)
+  bit 6 (0x40): infinity flag
+  bit 5 (0x20): sign flag — set iff y is lexicographically the larger root
+G2 serializes x as c1 || c0 (imaginary limb first).
+
+Parity surface: PublicKeyBytes/SignatureBytes in
+/root/reference/crypto/bls/src/generic_public_key_bytes.rs and blst's
+deserialize, including the subgroup / on-curve validation split.
+"""
+
+from . import fields as f
+from .constants import B_G1, B_G2, P
+from . import curve as cv
+
+
+class DecodeError(ValueError):
+    pass
+
+
+def _y_is_larger_fq(y):
+    return y > (P - 1) // 2
+
+
+def _y_is_larger_fq2(y):
+    # Lexicographic: compare imaginary limb first, then real.
+    c0, c1 = y
+    if c1 != 0:
+        return c1 > (P - 1) // 2
+    return c0 > (P - 1) // 2
+
+
+def g1_compress(pt):
+    if pt is None:
+        return bytes([0xC0] + [0] * 47)
+    x, y = pt
+    flags = 0x80 | (0x20 if _y_is_larger_fq(y) else 0)
+    b = bytearray(x.to_bytes(48, "big"))
+    b[0] |= flags
+    return bytes(b)
+
+
+def g1_decompress(data, subgroup_check=True):
+    if len(data) != 48:
+        raise DecodeError(f"G1 compressed must be 48 bytes, got {len(data)}")
+    flags = data[0]
+    if not flags & 0x80:
+        raise DecodeError("uncompressed flag in compressed context")
+    infinity = bool(flags & 0x40)
+    sign = bool(flags & 0x20)
+    x = int.from_bytes(bytes([data[0] & 0x1F]) + data[1:], "big")
+    if infinity:
+        if x != 0 or sign:
+            raise DecodeError("malformed infinity encoding")
+        return None
+    if x >= P:
+        raise DecodeError("x >= p")
+    y2 = (x * x % P * x + B_G1) % P
+    y = f.fq_sqrt(y2)
+    if y is None:
+        raise DecodeError("x not on curve")
+    if _y_is_larger_fq(y) != sign:
+        y = (-y) % P
+    pt = (x, y)
+    if subgroup_check and not cv.g1_in_subgroup(pt):
+        raise DecodeError("point not in G1 subgroup")
+    return pt
+
+
+def g2_compress(pt):
+    if pt is None:
+        return bytes([0xC0] + [0] * 95)
+    (x0, x1), y = pt
+    flags = 0x80 | (0x20 if _y_is_larger_fq2(y) else 0)
+    b = bytearray(x1.to_bytes(48, "big") + x0.to_bytes(48, "big"))
+    b[0] |= flags
+    return bytes(b)
+
+
+def g2_decompress(data, subgroup_check=True):
+    if len(data) != 96:
+        raise DecodeError(f"G2 compressed must be 96 bytes, got {len(data)}")
+    flags = data[0]
+    if not flags & 0x80:
+        raise DecodeError("uncompressed flag in compressed context")
+    infinity = bool(flags & 0x40)
+    sign = bool(flags & 0x20)
+    x1 = int.from_bytes(bytes([data[0] & 0x1F]) + data[1:48], "big")
+    x0 = int.from_bytes(data[48:96], "big")
+    if infinity:
+        if x0 != 0 or x1 != 0 or sign:
+            raise DecodeError("malformed infinity encoding")
+        return None
+    if x0 >= P or x1 >= P:
+        raise DecodeError("x >= p")
+    x = (x0, x1)
+    y2 = f.fq2_add(f.fq2_mul(f.fq2_sqr(x), x), B_G2)
+    y = f.fq2_sqrt(y2)
+    if y is None:
+        raise DecodeError("x not on curve")
+    if _y_is_larger_fq2(y) != sign:
+        y = f.fq2_neg(y)
+    pt = (x, y)
+    if subgroup_check and not cv.g2_in_subgroup(pt):
+        raise DecodeError("point not in G2 subgroup")
+    return pt

@@ -1,0 +1,13 @@
+"""Test configuration.
+
+Forces JAX onto a virtual 8-device CPU platform so sharding/multi-chip tests
+run anywhere (the driver separately dry-runs the multichip path; real-TPU
+benchmarking happens via bench.py). Must run before jax is imported.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,242 @@
+"""Ground-truth BLS12-381 validation: constants, fields, curves, pairing,
+hash-to-curve, serialization.
+
+These tests are the trust anchor for the whole crypto stack (the JAX backend
+is differentially tested against this implementation), standing in for the EF
+BLS vectors consumed by /root/reference/testing/ef_tests/src/cases/bls_*.rs
+(the vector tarballs are not vendored; algebraic invariants + RFC 9380
+published test vectors are used instead).
+"""
+
+import os
+import random
+
+import pytest
+
+from lighthouse_tpu.crypto.bls381 import curve as cv
+from lighthouse_tpu.crypto.bls381 import fields as f
+from lighthouse_tpu.crypto.bls381 import hash_to_curve as h2c
+from lighthouse_tpu.crypto.bls381 import pairing as pr
+from lighthouse_tpu.crypto.bls381 import serde
+from lighthouse_tpu.crypto.bls381.constants import (
+    DST_POP,
+    H_EFF_G2,
+    H_G2,
+    P,
+    R,
+    X_ABS,
+)
+
+rng = random.Random(1234)
+
+
+# ------------------------------------------------------------ constants
+
+
+def test_p_r_prime_witness():
+    for a in (2, 3, 5, 7):
+        assert pow(a, P - 1, P) == 1
+        assert pow(a, R - 1, R) == 1
+
+
+def test_parameter_relations():
+    x = -X_ABS
+    assert X_ABS**4 - X_ABS**2 + 1 == R
+    assert (x - 1) ** 2 * R // 3 + x == P
+
+
+def test_generators_in_subgroup():
+    assert cv.g1_in_subgroup(cv.G1_GEN)
+    assert cv.g2_in_subgroup(cv.G2_GEN)
+
+
+def test_h_eff_is_3h2():
+    assert H_EFF_G2 == 3 * H_G2
+
+
+# ------------------------------------------------------------ fields
+
+
+def _rand_fq2():
+    return (rng.randrange(P), rng.randrange(P))
+
+
+def test_fq2_inv_roundtrip():
+    for _ in range(20):
+        a = _rand_fq2()
+        if f.fq2_is_zero(a):
+            continue
+        assert f.fq2_mul(a, f.fq2_inv(a)) == f.FQ2_ONE
+
+
+def test_fq2_sqrt():
+    for _ in range(20):
+        a = _rand_fq2()
+        sq = f.fq2_sqr(a)
+        root = f.fq2_sqrt(sq)
+        assert root is not None
+        assert f.fq2_sqr(root) == sq
+
+
+def test_fq6_fq12_inv_roundtrip():
+    for _ in range(5):
+        a6 = (_rand_fq2(), _rand_fq2(), _rand_fq2())
+        assert f.fq6_mul(a6, f.fq6_inv(a6)) == f.FQ6_ONE
+        a12 = ((_rand_fq2(), _rand_fq2(), _rand_fq2()), (_rand_fq2(), _rand_fq2(), _rand_fq2()))
+        assert f.fq12_mul(a12, f.fq12_inv(a12)) == f.FQ12_ONE
+
+
+def test_frobenius_is_pth_power():
+    a12 = ((_rand_fq2(), _rand_fq2(), _rand_fq2()), (_rand_fq2(), _rand_fq2(), _rand_fq2()))
+    assert f.fq12_frobenius(a12, 1) == f.fq12_pow(a12, P)
+    assert f.fq12_frobenius(a12, 2) == f.fq12_pow(f.fq12_pow(a12, P), P)
+
+
+def test_frobenius_power_6_is_conj():
+    a12 = ((_rand_fq2(), _rand_fq2(), _rand_fq2()), (_rand_fq2(), _rand_fq2(), _rand_fq2()))
+    assert f.fq12_frobenius(a12, 6) == f.fq12_conj(a12)
+
+
+# ------------------------------------------------------------ curve
+
+
+def test_group_laws():
+    a, b = rng.randrange(1, R), rng.randrange(1, R)
+    for (gen, add, mul, ops) in (
+        (cv.G1_GEN, cv.g1_add, cv.g1_mul, cv.FQ_OPS),
+        (cv.G2_GEN, cv.g2_add, cv.g2_mul, cv.FQ2_OPS),
+    ):
+        pa, pb = mul(gen, a), mul(gen, b)
+        assert add(pa, pb) == mul(gen, (a + b) % R)
+        assert add(pa, cv.neg(pa, ops)) is None
+        assert add(pa, None) == pa
+        assert mul(gen, R) is None
+
+
+# ------------------------------------------------------------ pairing
+
+
+def test_pairing_nondegenerate_and_order_r():
+    e1 = pr.pairing(cv.G1_GEN, cv.G2_GEN)
+    assert e1 != f.FQ12_ONE
+    assert f.fq12_pow(e1, R) == f.FQ12_ONE
+
+
+def test_pairing_bilinearity():
+    a, b = 987654321, 123456789
+    e1 = pr.pairing(cv.G1_GEN, cv.G2_GEN)
+    assert pr.pairing(cv.g1_mul(cv.G1_GEN, a), cv.g2_mul(cv.G2_GEN, b)) == f.fq12_pow(e1, a * b % R)
+    assert pr.pairing(cv.g1_mul(cv.G1_GEN, a), cv.G2_GEN) == f.fq12_pow(e1, a)
+
+
+def test_multi_pairing_identity():
+    a, b = rng.randrange(1, R), rng.randrange(1, R)
+    pa = cv.g1_mul(cv.G1_GEN, a)
+    qb = cv.g2_mul(cv.G2_GEN, b)
+    neg = cv.g1_neg(cv.g1_mul(cv.G1_GEN, a * b % R))
+    assert pr.multi_pairing_is_one([(pa, qb), (neg, cv.G2_GEN)])
+    assert not pr.multi_pairing_is_one([(pa, qb), (cv.g1_neg(pa), cv.G2_GEN)])
+
+
+def test_final_exp_chain_matches_integer_pow():
+    """The HHT hard-part chain must equal m^(3(p^4-p^2+1)/r) after easy part."""
+    m = ((_rand_fq2(), _rand_fq2(), _rand_fq2()), (_rand_fq2(), _rand_fq2(), _rand_fq2()))
+    full = pr.final_exponentiation(m)
+    exponent = 3 * (P**12 - 1) // R
+    assert full == f.fq12_pow(m, exponent)
+
+
+# ------------------------------------------------------------ hash-to-curve
+
+
+def test_expand_message_xmd_rfc9380_vectors():
+    """Published RFC 9380 appendix K.1 vectors (SHA-256 expander)."""
+    dst = b"QUUX-V01-CS02-with-expander-SHA256-128"
+    assert (
+        h2c.expand_message_xmd(b"", dst, 0x20).hex()
+        == "68a985b87eb6b46952128911f2a4412bbc302a9d759667f87f7a21d803f07235"
+    )
+    assert (
+        h2c.expand_message_xmd(b"abc", dst, 0x20).hex()
+        == "d8ccab23b5985ccea865c6c97b6e5b8350e794e603b4b97902f53a8a0d605615"
+    )
+
+
+def test_sswu_output_on_iso_curve():
+    for i in range(4):
+        u = h2c.hash_to_field_fq2(os.urandom(32), 2, DST_POP)[0]
+        x, y = h2c.sswu(u)
+        rhs = f.fq2_add(f.fq2_add(f.fq2_mul(f.fq2_sqr(x), x), f.fq2_mul(h2c.ISO_A, x)), h2c.ISO_B)
+        assert f.fq2_sqr(y) == rhs
+
+
+def test_isogeny_homomorphism():
+    u1 = h2c.hash_to_field_fq2(b"hom1", 2, DST_POP)[0]
+    u2 = h2c.hash_to_field_fq2(b"hom2", 2, DST_POP)[0]
+    p1, p2 = h2c.sswu(u1), h2c.sswu(u2)
+    (x1, y1), (x2, y2) = p1, p2
+    lam = f.fq2_mul(f.fq2_sub(y2, y1), f.fq2_inv(f.fq2_sub(x2, x1)))
+    x3 = f.fq2_sub(f.fq2_sub(f.fq2_sqr(lam), x1), x2)
+    y3 = f.fq2_sub(f.fq2_mul(lam, f.fq2_sub(x1, x3)), y1)
+    assert h2c.iso_map((x3, y3)) == cv.g2_add(h2c.iso_map(p1), h2c.iso_map(p2))
+
+
+def test_hash_to_g2_subgroup_and_deterministic():
+    q = h2c.hash_to_g2(b"lighthouse-tpu", DST_POP)
+    assert cv.g2_in_subgroup(q)
+    assert h2c.hash_to_g2(b"lighthouse-tpu", DST_POP) == q
+    assert h2c.hash_to_g2(b"lighthouse-tpu!", DST_POP) != q
+
+
+# ------------------------------------------------------------ serialization
+
+
+def test_g1_compress_roundtrip():
+    for k in (1, 2, rng.randrange(R)):
+        pt = cv.g1_mul(cv.G1_GEN, k)
+        data = serde.g1_compress(pt)
+        assert len(data) == 48
+        assert serde.g1_decompress(data) == pt
+
+
+def test_g2_compress_roundtrip():
+    for k in (1, 2, rng.randrange(R)):
+        pt = cv.g2_mul(cv.G2_GEN, k)
+        data = serde.g2_compress(pt)
+        assert len(data) == 96
+        assert serde.g2_decompress(data) == pt
+
+
+def test_g1_generator_known_encoding():
+    """The compressed G1 generator encoding is a well-known constant."""
+    assert serde.g1_compress(cv.G1_GEN).hex() == (
+        "97f1d3a73197d7942695638c4fa9ac0fc3688c4f9774b905a14e3a3f171bac58"
+        "6c55e83ff97a1aeffb3af00adb22c6bb"
+    )
+
+
+def test_infinity_encodings():
+    assert serde.g1_compress(None) == bytes([0xC0] + [0] * 47)
+    assert serde.g1_decompress(bytes([0xC0] + [0] * 47)) is None
+    assert serde.g2_decompress(bytes([0xC0] + [0] * 95)) is None
+
+
+def test_decompress_rejects_invalid():
+    with pytest.raises(serde.DecodeError):
+        serde.g1_decompress(b"\x00" * 48)  # no compression flag
+    with pytest.raises(serde.DecodeError):
+        serde.g1_decompress(bytes([0x80 | 0x1F] + [0xFF] * 47))  # x >= p
+    # a point on the curve but not in the subgroup:
+    # pick x until curve eq solvable, check subgroup rejection handled inside
+    x = 5
+    while True:
+        y = f.fq_sqrt((x * x * x + 4) % P)
+        if y is not None:
+            pt = (x, y)
+            if not cv.g1_in_subgroup(pt):
+                data = serde.g1_compress(pt)
+                with pytest.raises(serde.DecodeError):
+                    serde.g1_decompress(data, subgroup_check=True)
+                assert serde.g1_decompress(data, subgroup_check=False) == pt
+                break
+        x += 1

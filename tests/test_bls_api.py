@@ -1,0 +1,141 @@
+"""BLS signature-scheme API tests, run against both the python and fake
+backends — mirroring the macro-driven dual-backend suite in
+/root/reference/crypto/bls/tests/tests.rs:10."""
+
+import pytest
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.crypto.bls import api as bls_api
+
+
+@pytest.fixture(params=["python", "fake"])
+def backend(request):
+    prev = bls.get_backend()
+    bls.set_backend(request.param)
+    yield request.param
+    bls_api._active_backend = prev
+
+
+KEYS = bls.interop_keypairs(8)
+MSG_A = b"\x11" * 32
+MSG_B = b"\x22" * 32
+
+
+def test_sign_verify_roundtrip(backend):
+    kp = KEYS[0]
+    sig = bls.sign(kp.sk, MSG_A)
+    assert bls.verify(kp.pk, MSG_A, sig)
+    if backend == "python":
+        assert not bls.verify(kp.pk, MSG_B, sig)
+        assert not bls.verify(KEYS[1].pk, MSG_A, sig)
+
+
+def test_serialization_roundtrip(backend):
+    kp = KEYS[2]
+    sig = bls.sign(kp.sk, MSG_A)
+    sig2 = bls.Signature.deserialize(sig.serialize())
+    assert sig2 == sig
+    pk2 = bls.PublicKey.deserialize(kp.pk.serialize())
+    assert pk2 == kp.pk
+    sk2 = bls.SecretKey.deserialize(kp.sk.serialize())
+    assert sk2.scalar == kp.sk.scalar
+
+
+def test_fast_aggregate_verify(backend):
+    sigs = [bls.sign(kp.sk, MSG_A) for kp in KEYS]
+    agg = bls.AggregateSignature.aggregate(sigs)
+    pks = [kp.pk for kp in KEYS]
+    assert bls.fast_aggregate_verify(pks, MSG_A, agg)
+    if backend == "python":
+        assert not bls.fast_aggregate_verify(pks, MSG_B, agg)
+        assert not bls.fast_aggregate_verify(pks[:-1], MSG_A, agg)
+
+
+def test_eth_fast_aggregate_verify_empty(backend):
+    inf = bls.Signature.deserialize(bls.INFINITY_SIGNATURE_BYTES)
+    assert inf.is_infinity()
+    assert bls.eth_fast_aggregate_verify([], MSG_A, inf)
+    assert not bls.fast_aggregate_verify([], MSG_A, inf)
+
+
+def test_aggregate_verify_distinct_messages(backend):
+    msgs = [bytes([i]) * 32 for i in range(4)]
+    sigs = [bls.sign(KEYS[i].sk, msgs[i]) for i in range(4)]
+    agg = bls.AggregateSignature.aggregate(sigs)
+    pks = [KEYS[i].pk for i in range(4)]
+    assert bls.aggregate_verify(pks, msgs, agg)
+    if backend == "python":
+        assert not bls.aggregate_verify(pks, list(reversed(msgs)), agg)
+
+
+def test_verify_signature_sets_batch(backend):
+    sets = []
+    # single-pubkey sets
+    for i, kp in enumerate(KEYS[:3]):
+        msg = bytes([i + 1]) * 32
+        sets.append(bls.SignatureSet.single_pubkey(bls.sign(kp.sk, msg), kp.pk, msg))
+    # one aggregate set
+    sigs = [bls.sign(kp.sk, MSG_A) for kp in KEYS]
+    agg = bls.AggregateSignature.aggregate(sigs)
+    sets.append(bls.SignatureSet.multiple_pubkeys(agg, [kp.pk for kp in KEYS], MSG_A))
+    assert bls.verify_signature_sets(sets)
+
+    if backend == "python":
+        # corrupt one set -> whole batch fails
+        bad = bls.SignatureSet.single_pubkey(sets[0].signature, KEYS[5].pk, sets[0].message)
+        assert not bls.verify_signature_sets(sets[:-1] + [bad])
+
+
+def test_verify_signature_sets_deterministic_rands(backend):
+    kp = KEYS[0]
+    s = bls.SignatureSet.single_pubkey(bls.sign(kp.sk, MSG_A), kp.pk, MSG_A)
+    fixed = lambda n: [1] * n
+    assert bls.verify_signature_sets([s, s], rand_fn=fixed)
+
+
+def test_empty_set_list_fails(backend):
+    # blst semantics: an empty batch is a deterministic failure
+    # (/root/reference/crypto/bls/src/impls/blst.rs:40).
+    assert not bls.verify_signature_sets([])
+
+
+def test_infinity_signature_in_set_fails(backend):
+    kp = KEYS[0]
+    s = bls.SignatureSet.single_pubkey(bls.Signature.infinity(), kp.pk, MSG_A)
+    assert not bls.verify_signature_sets([s])
+
+
+def test_zero_coefficient_rejected(backend):
+    kp = KEYS[0]
+    s = bls.SignatureSet.single_pubkey(bls.sign(kp.sk, MSG_A), kp.pk, MSG_A)
+    with pytest.raises(ValueError):
+        bls.verify_signature_sets([s], rand_fn=lambda n: [0] * n)
+
+
+def test_interop_pubkeys_match_published_vectors():
+    """The first two interop validator pubkeys are published constants
+    (ethereum/eth2.0-pm mocked_start keygen_test_vector.yaml), validating key
+    derivation + G1 scalar mul + compression against external ground truth."""
+    assert bls.interop_keypair(0).pk.serialize().hex() == (
+        "a99a76ed7796f7be22d5b7e85deeb7c5677e88e511e0b337618f8c4eb61349b4"
+        "bf2d153f649f7b53359fe8b94a38e44c"
+    )
+    assert bls.interop_keypair(1).pk.serialize().hex() == (
+        "b89bebc699769726a318c8e9971bd3171297c61aea4a6578a7a4f94b547dcba5"
+        "bac16a89108b6b6a1fe3695d1a874a0b"
+    )
+
+
+def test_interop_keys_deterministic():
+    k0 = bls.interop_keypair(0)
+    k0b = bls.interop_keypair(0)
+    assert k0.sk.scalar == k0b.sk.scalar
+    assert k0.pk == k0b.pk
+    assert bls.interop_keypair(1).sk.scalar != k0.sk.scalar
+
+
+def test_signature_set_validation():
+    with pytest.raises(ValueError):
+        bls.SignatureSet(bls.Signature.infinity(), [], b"\x00" * 32)
+    with pytest.raises(ValueError):
+        bls.SignatureSet(bls.Signature.infinity(), [KEYS[0].pk], b"short")
