@@ -44,9 +44,13 @@ H_G1 = 0x396C8C005555E1568C00AAAB0000AAAB
 _x = -X_ABS
 H_G2 = (_x**8 - 4 * _x**7 + 5 * _x**6 - 4 * _x**4 + 6 * _x**3 - 4 * _x**2 - 4 * _x + 13) // 9
 
-# Effective cofactor for G2 cofactor clearing (RFC 9380 §8.8.2) == 3 * H_G2,
-# verified numerically in tests (test_h_eff_is_3h2).
-H_EFF_G2 = 3 * H_G2
+# Effective cofactor for G2 cofactor clearing — the RFC 9380 §8.8.2 constant
+# (Budroni-Pintore method; NOT a small multiple of H_G2). Using any other
+# cofactor multiple still lands in the subgroup but yields points that differ
+# from the standard ciphersuite by a scalar — i.e. non-interoperable
+# signatures. Pinned by the RFC 9380 Appendix J.10.1 point vector in
+# tests/test_bls381_core.py::test_hash_to_g2_rfc9380_point_vector.
+H_EFF_G2 = 0xBC69F08F2EE75B3584C6A0EA91B352888E2A8E9145AD7689986FF031508FFE1329C2F178731DB956D82BF015D1212B02EC0EC69D7477C1AE954CBC06689F6A359894C0ADEBBF6B4E8020005AAA95551
 
 # Ethereum BLS signature scheme domain separation tag (proof-of-possession
 # ciphersuite BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_), matching

@@ -28,8 +28,6 @@ ISO_B = (1012, 1012)
 ISO_Z = ((-2) % P, (-1) % P)
 
 # --- 3-isogeny map E2' -> E2 constants (RFC 9380 Appendix E.3) ---
-_K = 0x171D6541FA38CCFAED6DEA691F5FB614CB14B4E7F4E810AA22D6108F142B85757098E38D0F671C7188E2AAAAAAAA5ED1
-
 X_NUM = [
     (
         0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97D6,

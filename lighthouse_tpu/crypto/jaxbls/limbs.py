@@ -1,0 +1,243 @@
+"""Batched multi-precision Montgomery arithmetic for Fq (BLS12-381 base field)
+on TPU.
+
+Representation: radix 2^16, 24 limbs, least-significant first, stored as
+uint32 with values < 2^16 (canonical form). All ops broadcast over arbitrary
+leading batch dimensions; the limb axis is last.
+
+Why 16-bit limbs in uint32: TPU has native 32-bit integer multiply (low half).
+16x16 products fit exactly; column sums of 48 such halves stay < 2^22, so a
+full 24x24 schoolbook product plus interleaved Montgomery reduction (radix-
+2^16 REDC) runs with NO per-step carry chains — one lax.scan carry
+normalization per multiplication. This avoids uint64 emulation entirely
+(SURVEY.md §7 "hard parts" (a): limbed modular multiplication throughput is
+the whole game).
+
+Montgomery domain: R_mont = 2^384. mont_mul(a, b) = a * b * R_mont^-1 mod P.
+Differentially tested against Python bigints in tests/test_jaxbls_limbs.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..bls381.constants import P
+
+NL = 24            # number of limbs
+LB = 16            # bits per limb
+MASK = (1 << LB) - 1
+U32 = jnp.uint32
+
+
+def pack(x: int) -> np.ndarray:
+    """Host: int -> (NL,) uint32 limb array (little-endian 16-bit limbs)."""
+    if not 0 <= x < (1 << (NL * LB)):
+        raise ValueError("value out of limb range")
+    return np.array([(x >> (LB * i)) & MASK for i in range(NL)], dtype=np.uint32)
+
+
+def unpack(arr) -> int:
+    """Host: limb array (last axis NL) -> int (single element only)."""
+    a = np.asarray(arr, dtype=np.uint64).reshape(-1)
+    return sum(int(v) << (LB * i) for i, v in enumerate(a))
+
+
+def pack_batch(xs) -> np.ndarray:
+    """Host: list of ints -> (len, NL) uint32."""
+    return np.stack([pack(x) for x in xs])
+
+
+def unpack_batch(arr) -> list[int]:
+    a = np.asarray(arr)
+    flat = a.reshape(-1, a.shape[-1])
+    return [sum(int(v) << (LB * i) for i, v in enumerate(row)) for row in flat]
+
+
+# ----------------------------------------------------------------- constants
+
+R_MONT = pow(2, NL * LB, P)
+R2_INT = R_MONT * R_MONT % P
+N0P = (-pow(P, -1, 1 << LB)) % (1 << LB)   # -P^-1 mod 2^16
+
+N_HOST = pack(P)
+N_EXT_HOST = np.concatenate([N_HOST, np.zeros(1, np.uint32)])
+R2 = jnp.asarray(pack(R2_INT))
+ZERO = jnp.zeros((NL,), U32)
+ONE_STD = jnp.asarray(pack(1))
+ONE_MONT = jnp.asarray(pack(R_MONT))
+
+
+def _scan_last(f, init, xs):
+    """lax.scan over the LAST axis of xs (any leading batch dims)."""
+    moved = jnp.moveaxis(xs, -1, 0)
+    carry, ys = lax.scan(f, init, moved)
+    return carry, jnp.moveaxis(ys, 0, -1)
+
+
+def carry_normalize(t):
+    """Propagate carries: redundant u32 limbs -> canonical 16-bit limbs.
+
+    Returns (normalized array same shape, final carry)."""
+    def body(c, limb):
+        v = limb + c
+        return v >> LB, v & MASK
+    zero_c = jnp.zeros(t.shape[:-1], U32)
+    carry, limbs = _scan_last(body, zero_c, t)
+    return limbs, carry
+
+
+def _sub_with_borrow(a, b):
+    """a - b limbwise (canonical 16-bit limbs). Returns (diff, borrow in {0,1})."""
+    def body(borrow, ab):
+        ai, bi = ab
+        v = ai + (MASK + 1) - bi - borrow
+        return 1 - (v >> LB), v & MASK
+    zero_b = jnp.zeros(a.shape[:-1], U32)
+    moved = (jnp.moveaxis(a, -1, 0), jnp.moveaxis(b, -1, 0))
+    borrow, diff = lax.scan(lambda c, ab: body(c, ab), zero_b, moved)
+    return jnp.moveaxis(diff, 0, -1), borrow
+
+
+def _cond_sub_n(t):
+    """Reduce t (NL+1 canonical limbs, value < 2N) to t mod N (NL limbs)."""
+    n_ext = jnp.asarray(N_EXT_HOST)
+    n_b = jnp.broadcast_to(n_ext, t.shape)
+    diff, borrow = _sub_with_borrow(t, n_b)
+    keep = (borrow == 1)
+    out = jnp.where(keep[..., None], t, diff)
+    return out[..., :NL]
+
+
+def mont_mul(a, b):
+    """Montgomery product a*b*R^-1 mod P. a, b: (..., NL) canonical limbs."""
+    batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    a = jnp.broadcast_to(a, batch + (NL,))
+    b = jnp.broadcast_to(b, batch + (NL,))
+    acc = jnp.zeros(batch + (2 * NL + 1,), U32)
+    # Schoolbook product with lo/hi split; columns stay < 2^22.
+    for i in range(NL):
+        p = a[..., i : i + 1] * b                     # (..., NL) u32 exact
+        acc = acc.at[..., i : i + NL].add(p & MASK)
+        acc = acc.at[..., i + 1 : i + NL + 1].add(p >> LB)
+    acc, _ = carry_normalize(acc)
+    # Interleaved REDC: after each step acc[i] ≡ 0 mod 2^16; push its carry.
+    n_arr = jnp.asarray(N_HOST)
+    for i in range(NL):
+        m = (acc[..., i] * N0P) & MASK                # (...,)
+        p = m[..., None] * n_arr                      # (..., NL)
+        acc = acc.at[..., i : i + NL].add(p & MASK)
+        acc = acc.at[..., i + 1 : i + NL + 1].add(p >> LB)
+        acc = acc.at[..., i + 1].add(acc[..., i] >> LB)
+    res = acc[..., NL:]                               # (..., NL+1), < 2N redundant
+    res, _ = carry_normalize(res)
+    return _cond_sub_n(res)
+
+
+def mont_sqr(a):
+    return mont_mul(a, a)
+
+
+def add_mod(a, b):
+    s = a + b                                          # ≤ 2^17 per limb
+    s = jnp.concatenate([s, jnp.zeros(s.shape[:-1] + (1,), U32)], axis=-1)
+    s, _ = carry_normalize(s)
+    return _cond_sub_n(s)
+
+
+def sub_mod(a, b):
+    diff, borrow = _sub_with_borrow(a, b)
+    n_arr = jnp.broadcast_to(jnp.asarray(N_HOST), diff.shape)
+    fixed = diff + n_arr                               # ≤ 2^17 per limb
+    fixed = jnp.concatenate([fixed, jnp.zeros(fixed.shape[:-1] + (1,), U32)], axis=-1)
+    fixed, _ = carry_normalize(fixed)
+    fixed = fixed[..., :NL]
+    return jnp.where((borrow == 1)[..., None], fixed, diff)
+
+
+def neg_mod(a):
+    """-a mod P (0 maps to 0)."""
+    n_arr = jnp.broadcast_to(jnp.asarray(N_HOST), a.shape)
+    diff, _ = _sub_with_borrow(n_arr, a)
+    nonzero = jnp.any(a != 0, axis=-1, keepdims=True)
+    return jnp.where(nonzero, diff, a)
+
+
+def is_zero(a):
+    return jnp.all(a == 0, axis=-1)
+
+
+def eq(a, b):
+    return jnp.all(a == b, axis=-1)
+
+
+def _cond_sub_n_ext(t):
+    """One conditional subtract of N on an (NL+1)-limb value; keeps NL+1 limbs."""
+    n_ext = jnp.broadcast_to(jnp.asarray(N_EXT_HOST), t.shape)
+    diff, borrow = _sub_with_borrow(t, n_ext)
+    return jnp.where((borrow == 1)[..., None], t, diff)
+
+
+def mul_small(a, k: int):
+    """a * k mod P for small static int k (callers use k in {2, 3, 8, 12})."""
+    assert 0 < k < (1 << 15)
+    p = a * np.uint32(k)                               # ≤ 2^31
+    lo = p & MASK
+    hi = p >> LB
+    acc = jnp.concatenate([lo, jnp.zeros(lo.shape[:-1] + (1,), U32)], axis=-1)
+    acc = acc.at[..., 1 : NL + 1].add(hi)
+    acc, _ = carry_normalize(acc)                      # value < k*P, NL+1 limbs
+    for _ in range(k - 1):
+        acc = _cond_sub_n_ext(acc)
+    return acc[..., :NL]
+
+
+def to_mont(a_std):
+    return mont_mul(a_std, jnp.broadcast_to(R2, a_std.shape))
+
+
+def from_mont(a_mont):
+    return mont_mul(a_mont, jnp.broadcast_to(ONE_STD, a_mont.shape))
+
+
+def mont_pow_static(a, exponent: int):
+    """a^exponent in Montgomery domain, exponent a static Python int.
+
+    Unrolled square-and-multiply is too large a graph for 381-bit exponents;
+    we scan over the bit array (MSB first) with a select-multiply.
+    """
+    bits = [int(b) for b in bin(exponent)[2:]]
+    bits_arr = jnp.asarray(np.array(bits, np.uint32))
+
+    def body(acc, bit):
+        acc = mont_sqr(acc)
+        with_mul = mont_mul(acc, a)
+        acc = jnp.where((bit == 1)[..., None] if bit.ndim else (bit == 1), with_mul, acc)
+        return acc, None
+
+    one = jnp.broadcast_to(ONE_MONT, a.shape)
+    # start from 1, scan all bits
+    acc, _ = lax.scan(lambda c, b: body(c, b), one, bits_arr)
+    return acc
+
+
+def mont_inv(a):
+    """a^-1 in Montgomery domain (Fermat: a^(P-2))."""
+    return mont_pow_static(a, P - 2)
+
+
+# Jitted entry points for eager/test use. Inside larger jitted programs the
+# un-jitted Python functions compose and fuse; these wrappers make standalone
+# calls cache their compilation per input shape instead of re-tracing scans.
+mont_mul_jit = jax.jit(mont_mul)
+mont_sqr_jit = jax.jit(mont_sqr)
+add_mod_jit = jax.jit(add_mod)
+sub_mod_jit = jax.jit(sub_mod)
+neg_mod_jit = jax.jit(neg_mod)
+mul_small_jit = jax.jit(mul_small, static_argnums=1)
+to_mont_jit = jax.jit(to_mont)
+from_mont_jit = jax.jit(from_mont)
+mont_pow_static_jit = jax.jit(mont_pow_static, static_argnums=1)
+mont_inv_jit = jax.jit(mont_inv)

@@ -1,13 +1,21 @@
-"""Test configuration.
+"""Test configuration: force JAX onto a virtual 8-device CPU platform.
 
-Forces JAX onto a virtual 8-device CPU platform so sharding/multi-chip tests
-run anywhere (the driver separately dry-runs the multichip path; real-TPU
-benchmarking happens via bench.py). Must run before jax is imported.
+The environment preloads JAX with a remote-TPU ("axon") platform via
+sitecustomize and forces jax.config.jax_platforms = "axon,cpu" — env vars
+alone cannot override that, so we update jax.config directly before any
+backend is initialized. Sharding/multi-chip tests then run on 8 virtual CPU
+devices anywhere; real-TPU benchmarking happens via bench.py.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before the CPU backend initializes (jax itself is already
+# imported by sitecustomize; backends are not yet initialized at conftest
+# import time).
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

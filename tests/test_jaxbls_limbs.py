@@ -1,0 +1,87 @@
+"""Differential tests: JAX limbed Montgomery arithmetic vs Python bigints."""
+
+import random
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.crypto.bls381.constants import P
+from lighthouse_tpu.crypto.jaxbls import limbs as L
+
+rng = random.Random(99)
+
+
+def rand_elems(n):
+    return [rng.randrange(P) for _ in range(n)]
+
+
+def to_m(xs):
+    return L.to_mont_jit(np.asarray(L.pack_batch(xs)))
+
+
+def from_m(arr):
+    return L.unpack_batch(L.from_mont_jit(arr))
+
+
+def test_pack_unpack_roundtrip():
+    xs = rand_elems(8) + [0, 1, P - 1]
+    arr = L.pack_batch(xs)
+    assert L.unpack_batch(arr) == xs
+
+
+def test_mont_roundtrip():
+    xs = rand_elems(8) + [0, 1, P - 1]
+    assert from_m(to_m(xs)) == xs
+
+
+def test_mont_mul_matches_bigint():
+    xs = rand_elems(16)
+    ys = rand_elems(16)
+    out = from_m(L.mont_mul_jit(to_m(xs), to_m(ys)))
+    assert out == [x * y % P for x, y in zip(xs, ys)]
+
+
+def test_mont_sqr():
+    xs = rand_elems(8)
+    out = from_m(L.mont_sqr_jit(to_m(xs)))
+    assert out == [x * x % P for x in xs]
+
+
+def test_add_sub_neg():
+    xs = rand_elems(12) + [0, P - 1]
+    ys = rand_elems(12) + [P - 1, 0]
+    ax, ay = to_m(xs), to_m(ys)
+    assert from_m(L.add_mod_jit(ax, ay)) == [(x + y) % P for x, y in zip(xs, ys)]
+    assert from_m(L.sub_mod_jit(ax, ay)) == [(x - y) % P for x, y in zip(xs, ys)]
+    assert from_m(L.neg_mod_jit(ax)) == [(-x) % P for x in xs]
+
+
+def test_mul_small():
+    xs = rand_elems(8) + [P - 1, 0]
+    ax = to_m(xs)
+    for k in (2, 3, 8, 12):
+        assert from_m(L.mul_small_jit(ax, k)) == [x * k % P for x in xs]
+
+
+def test_pow_and_inv():
+    xs = rand_elems(4)
+    ax = to_m(xs)
+    out = from_m(L.mont_pow_static_jit(ax, 5))
+    assert out == [pow(x, 5, P) for x in xs]
+    inv = from_m(L.mont_inv_jit(ax))
+    assert inv == [pow(x, P - 2, P) for x in xs]
+
+
+def test_edge_values():
+    # worst-case operands for carry logic
+    xs = [P - 1, P - 1, 1, 0, (1 << 380) % P]
+    ys = [P - 1, 1, P - 1, P - 1, (1 << 383) % P]
+    out = from_m(L.mont_mul_jit(to_m(xs), to_m(ys)))
+    assert out == [x * y % P for x, y in zip(xs, ys)]
+
+
+def test_is_zero_eq():
+    xs = [0, 5, P - 1]
+    arr = np.asarray(L.pack_batch(xs))
+    assert list(np.asarray(L.is_zero(arr))) == [True, False, False]
+    assert bool(np.all(np.asarray(L.eq(arr, arr))))
