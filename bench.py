@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Headline benchmark: BLS signature-sets verified per second on one chip.
+
+Workload (BASELINE.md config 5, "mainnet gossip firehose" shape): a batch of
+64 attestation-style signature sets, each an aggregate over 128 pubkeys with
+a distinct 32-byte message, verified by the TPU backend's single fused kernel
+(aggregate pubkeys -> random-coefficient scaling -> hash-to-G2 -> one
+multi-pairing).  Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "sets/s", "vs_baseline": N}
+
+vs_baseline compares against an estimated single-host blst throughput for the
+same workload (~700 sets/s: per set one 128-point aggregation + hash-to-curve
++ its share of a multi-pairing on a modern core; the reference publishes no
+absolute numbers — SURVEY.md §6). Replace with a measured blst number when a
+CPU baseline harness is available.
+"""
+
+import json
+import sys
+import time
+
+N_SETS = 64
+N_PKS = 128
+EST_BLST_SETS_PER_SEC = 700.0
+ITERS = 3
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main():
+    from lighthouse_tpu.utils.jaxcfg import setup_compilation_cache
+
+    setup_compilation_cache()
+    import jax
+    import random
+
+    log(f"devices: {jax.devices()}")
+
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.crypto.bls import api as bls_api
+    from lighthouse_tpu.crypto.bls381 import curve as cv
+    from lighthouse_tpu.crypto.bls381.constants import R
+
+    backend = bls_api.set_backend("jax")
+
+    rng = random.Random(0xBE7C)
+    log(f"building {N_SETS} sets x {N_PKS} pubkeys ...")
+    t0 = time.time()
+    sets = []
+    for i in range(N_SETS):
+        sks = [bls.SecretKey(rng.randrange(1, R)) for _ in range(N_PKS)]
+        pks = [sk.public_key() for sk in sks]
+        msg = i.to_bytes(32, "big")
+        # aggregate signature: sum_k sk_k * H(msg) == (sum sk_k) * H(msg)
+        agg_sk = sum(sk.scalar for sk in sks) % R
+        h = bls_api.hash_to_g2_point(msg)
+        sig = bls.Signature(cv.g2_mul(h, agg_sk))
+        sets.append(bls.SignatureSet(sig, pks, msg))
+    log(f"fixture build: {time.time()-t0:.1f}s")
+
+    rands = [1] + [rng.getrandbits(64) | 1 for _ in range(N_SETS - 1)]
+
+    # warmup (compile)
+    t0 = time.time()
+    ok = backend.verify_signature_sets(sets, rands)
+    log(f"warmup/compile: {time.time()-t0:.1f}s ok={ok}")
+    assert ok, "benchmark batch failed to verify"
+
+    times = []
+    for _ in range(ITERS):
+        t0 = time.time()
+        ok = backend.verify_signature_sets(sets, rands)
+        times.append(time.time() - t0)
+        assert ok
+    best = min(times)
+    sets_per_sec = N_SETS / best
+    log(f"times: {[round(t,4) for t in times]}")
+
+    print(
+        json.dumps(
+            {
+                "metric": f"BLS signature-sets verified/sec ({N_SETS} sets x {N_PKS} pubkeys, TPU backend)",
+                "value": round(sets_per_sec, 2),
+                "unit": "sets/s",
+                "vs_baseline": round(sets_per_sec / EST_BLST_SETS_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

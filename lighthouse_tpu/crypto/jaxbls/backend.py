@@ -1,0 +1,259 @@
+"""The TPU BLS backend: batched multi-set signature verification on device.
+
+This is the north-star component (BASELINE.json): the plugin that slots into
+the generic backend registry (crypto/bls/api.py) exactly where blst slots
+into /root/reference/crypto/bls/src/impls/ — but instead of per-core
+assembly, `verify_signature_sets` marshals whole batches of SignatureSets to
+one jitted XLA program:
+
+    1. masked tree-sum of each set's pubkeys (G1, Jacobian, batched)
+    2. z_i * aggpk_i with the 64-bit random coefficients (batched scan)
+    3. hash-to-G2 of each message (host sha256 -> device SSWU/isogeny/cofactor)
+    4. sum_i z_i * sig_i (batched scan + tree reduce)
+    5. one multi-pairing product check with a single final exponentiation
+
+Shapes are padded to power-of-two buckets (pad lanes masked out) so XLA
+compiles one program per bucket, cached persistently (utils/jaxcfg.py) —
+the bucketing policy answers SURVEY.md §7 hard part (c).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..bls381.constants import P, DST_POP
+from ..bls381 import curve as pc
+from . import limbs as lb
+from . import tower as tw
+from . import curve_ops as co
+from . import h2c_ops as h2
+from . import pairing_ops as po
+
+MIN_SETS = 4          # smallest bucket (pairs axis = sets + 1 rounded up)
+MIN_PKS = 1
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def _verify_kernel(pk_x, pk_y, pk_mask, sig_x, sig_y, us, z_bits, set_mask):
+    """The jitted device program. Shapes:
+      pk_x/pk_y: (n, m, NL)  padded pubkey affine coords
+      pk_mask:   (n, m)      1 = real pubkey
+      sig_x/sig_y: (n, 2, NL) signature affine G2 coords (never infinity:
+                   rejected host-side per blst semantics)
+      us:        (n, 2, 2, NL) hash_to_field outputs per message
+      z_bits:    (n, 64)     random coefficient bits, MSB first
+      set_mask:  (n,)        1 = real set
+    Returns (ok, any_bad_aggpk)."""
+    import jax.numpy as jnp
+
+    n = pk_x.shape[0]
+
+    # 1. aggregate pubkeys per set: (n, m) -> (n,)
+    pk_jac = co.affine_to_jac(co.FQ_OPS, (pk_x, pk_y), inf_mask=jnp.logical_not(pk_mask))
+    # masked_tree_sum reduces axis 0; move the pk axis first
+    pk_jac_t = tuple(jnp.moveaxis(c, 1, 0) for c in pk_jac)
+    m = pk_x.shape[1]
+    agg = pk_jac_t
+    while m > 1:
+        half = m // 2
+        a = tuple(c[:half] for c in agg)
+        b = tuple(c[half:m] for c in agg)
+        agg = co.jac_add(a, b, co.FQ_OPS)
+        m = half
+    aggpk = tuple(c[0] for c in agg)                       # (n,) jacobian G1
+    aggpk_inf = co.FQ_OPS.is_zero(aggpk[2])
+    bad_aggpk = jnp.any(jnp.logical_and(aggpk_inf, set_mask))
+
+    # 2. z_i * aggpk_i
+    z_pk = co.scalar_mul_bits(aggpk, z_bits, co.FQ_OPS)
+
+    # 3. hash messages to G2
+    h_jac = h2.hash_to_g2_jacobian(us)
+
+    # 4. sum_i z_i * sig_i  (mask padded sets to identity first)
+    sig_jac = co.affine_to_jac(co.FQ2_OPS, (sig_x, sig_y), inf_mask=jnp.logical_not(set_mask))
+    z_sig = co.scalar_mul_bits(sig_jac, z_bits, co.FQ2_OPS)
+    z_sig = co.pt_select(
+        co.FQ2_OPS,
+        jnp.asarray(set_mask, bool),
+        z_sig,
+        tuple(jnp.broadcast_to(c, x.shape) for c, x in zip(co.identity(co.FQ2_OPS), z_sig)),
+    )
+    sig_acc = co.tree_sum(z_sig, co.FQ2_OPS)               # single jacobian G2
+
+    # 5. affine conversions + multi-pairing
+    p1x, p1y, p1inf = co.jac_to_affine(z_pk, co.FQ_OPS)
+    qx, qy, qinf = co.jac_to_affine(h_jac, co.FQ2_OPS)
+    sx, sy, sinf = co.jac_to_affine(sig_acc, co.FQ2_OPS)
+
+    # pairs: n set-pairs + 1 signature pair, padded to pow2
+    npairs = _next_pow2(n + 1)
+    neg_g1x = jnp.broadcast_to(_NEG_G1_GEN[0], (1,) + _NEG_G1_GEN[0].shape)
+    neg_g1y = jnp.broadcast_to(_NEG_G1_GEN[1], (1,) + _NEG_G1_GEN[1].shape)
+    pad = npairs - n - 1
+    px = jnp.concatenate([p1x, neg_g1x, jnp.zeros((pad,) + p1x.shape[1:], p1x.dtype)])
+    py = jnp.concatenate([p1y, neg_g1y, jnp.zeros((pad,) + p1y.shape[1:], p1y.dtype)])
+    qxx = jnp.concatenate([qx, sx[None], jnp.zeros((pad,) + qx.shape[1:], qx.dtype)])
+    qyy = jnp.concatenate([qy, sy[None], jnp.zeros((pad,) + qy.shape[1:], qy.dtype)])
+    pair_mask = jnp.concatenate(
+        [jnp.asarray(set_mask, bool), jnp.asarray([True]), jnp.zeros((pad,), bool)]
+    )
+    # a set-pair with an identity side contributes 1 (mask it out); the
+    # signature accumulator can legitimately be identity (all-zero z*sig)
+    side_inf = jnp.concatenate([jnp.logical_or(p1inf, qinf), sinf[None], jnp.zeros((pad,), bool)])
+    pair_mask = jnp.logical_and(pair_mask, jnp.logical_not(side_inf))
+
+    ok = po.pairing_product_is_one((px, py), (qxx, qyy), pair_mask)
+    return ok, bad_aggpk
+
+
+_NEG_G1_GEN = None
+_kernel_cache: dict = {}
+
+
+def _get_kernel():
+    global _NEG_G1_GEN
+    import jax
+
+    if _NEG_G1_GEN is None:
+        gx, gy = pc.g1_neg(pc.G1_GEN)
+        _NEG_G1_GEN = (tw.fq_to_device(gx), tw.fq_to_device(gy))
+    if "k" not in _kernel_cache:
+        from ..utils.jaxcfg import setup_compilation_cache
+
+        setup_compilation_cache()
+        _kernel_cache["k"] = jax.jit(_verify_kernel)
+    return _kernel_cache["k"]
+
+
+class JaxBackend:
+    """Batched TPU verification backend (registered as "jax" in bls.api)."""
+
+    name = "jax"
+
+    def __init__(self, dst: bytes = DST_POP):
+        self.dst = dst
+
+    # -- the multi-set hot path ------------------------------------------
+
+    def verify_signature_sets(self, sets, rands) -> bool:
+        kernel = _get_kernel()
+        n_real = len(sets)
+        n = max(MIN_SETS, _next_pow2(n_real))
+        m = max(MIN_PKS, _next_pow2(max(len(s.signing_keys) for s in sets)))
+
+        pk_x = np.zeros((n, m, lb.NL), np.uint32)
+        pk_y = np.zeros((n, m, lb.NL), np.uint32)
+        pk_mask = np.zeros((n, m), np.uint32)
+        sig_x = np.zeros((n, 2, lb.NL), np.uint32)
+        sig_y = np.zeros((n, 2, lb.NL), np.uint32)
+        z_bits = np.zeros((n, 64), np.uint32)
+        set_mask = np.zeros((n,), np.uint32)
+
+        def mont(v: int) -> np.ndarray:
+            return lb.pack(v * lb.R_MONT % P)
+
+        for i, (s, z) in enumerate(zip(sets, rands)):
+            for j, pk in enumerate(s.signing_keys):
+                x, y = pk.point
+                pk_x[i, j] = mont(x)
+                pk_y[i, j] = mont(y)
+                pk_mask[i, j] = 1
+            sp = s.signature.point
+            if sp is None:
+                return False  # blst semantics: infinity signature fails
+            sig_x[i, 0] = mont(sp[0][0])
+            sig_x[i, 1] = mont(sp[0][1])
+            sig_y[i, 0] = mont(sp[1][0])
+            sig_y[i, 1] = mont(sp[1][1])
+            z64 = z & ((1 << 64) - 1)
+            for b in range(64):
+                z_bits[i, 63 - b] = (z64 >> b) & 1
+            set_mask[i] = 1
+
+        us = np.zeros((n, 2, 2, lb.NL), np.uint32)
+        us[:n_real] = h2.hash_to_field_batch([s.message for s in sets], self.dst)
+
+        ok, bad = kernel(pk_x, pk_y, pk_mask, sig_x, sig_y, us, z_bits, set_mask)
+        return bool(np.asarray(ok)) and not bool(np.asarray(bad))
+
+    # -- single-set paths reuse the same kernel ---------------------------
+
+    def verify_single(self, pk, message: bytes, sig) -> bool:
+        if sig.is_infinity():
+            return False
+        from .. import bls
+
+        s = bls.SignatureSet(sig, (pk,), message)
+        return self.verify_signature_sets([s], [1])
+
+    def aggregate_verify(self, pks, messages, sig) -> bool:
+        """Distinct-message AggregateVerify:
+        prod_i e(pk_i, H(m_i)) * e(-g1, sig) == 1 — a plain pairing product
+        (no random coefficients), so it gets its own small kernel."""
+        if len(pks) == 0 or sig.point is None:
+            return False
+        kernel = _get_aggregate_kernel()
+        n_real = len(pks)
+        n = max(MIN_SETS, _next_pow2(n_real))
+
+        pk_x = np.zeros((n, lb.NL), np.uint32)
+        pk_y = np.zeros((n, lb.NL), np.uint32)
+        mask = np.zeros((n,), np.uint32)
+
+        def mont(v: int) -> np.ndarray:
+            return lb.pack(v * lb.R_MONT % P)
+
+        for i, pk in enumerate(pks):
+            x, y = pk.point
+            pk_x[i] = mont(x)
+            pk_y[i] = mont(y)
+            mask[i] = 1
+        sp = sig.point
+        sig_xy = np.zeros((2, 2, lb.NL), np.uint32)
+        sig_xy[0, 0] = mont(sp[0][0])
+        sig_xy[0, 1] = mont(sp[0][1])
+        sig_xy[1, 0] = mont(sp[1][0])
+        sig_xy[1, 1] = mont(sp[1][1])
+
+        us = np.zeros((n, 2, 2, lb.NL), np.uint32)
+        us[:n_real] = h2.hash_to_field_batch(list(messages), self.dst)
+        ok = kernel(pk_x, pk_y, mask, sig_xy, us)
+        return bool(np.asarray(ok))
+
+
+def _aggregate_kernel(pk_x, pk_y, mask, sig_xy, us):
+    import jax.numpy as jnp
+
+    n = pk_x.shape[0]
+    h_jac = h2.hash_to_g2_jacobian(us)
+    qx, qy, qinf = co.jac_to_affine(h_jac, co.FQ2_OPS)
+
+    npairs = _next_pow2(n + 1)
+    pad = npairs - n - 1
+    neg_g1x = _NEG_G1_GEN[0][None]
+    neg_g1y = _NEG_G1_GEN[1][None]
+    px = jnp.concatenate([pk_x, neg_g1x, jnp.zeros((pad,) + pk_x.shape[1:], pk_x.dtype)])
+    py = jnp.concatenate([pk_y, neg_g1y, jnp.zeros((pad,) + pk_y.shape[1:], pk_y.dtype)])
+    qxx = jnp.concatenate([qx, sig_xy[None, 0], jnp.zeros((pad,) + qx.shape[1:], qx.dtype)])
+    qyy = jnp.concatenate([qy, sig_xy[None, 1], jnp.zeros((pad,) + qy.shape[1:], qy.dtype)])
+    pair_mask = jnp.concatenate(
+        [jnp.logical_and(jnp.asarray(mask, bool), jnp.logical_not(qinf)),
+         jnp.asarray([True]), jnp.zeros((pad,), bool)]
+    )
+    return po.pairing_product_is_one((px, py), (qxx, qyy), pair_mask)
+
+
+def _get_aggregate_kernel():
+    import jax
+
+    _get_kernel()  # ensures constants + cache initialized
+    if "agg" not in _kernel_cache:
+        _kernel_cache["agg"] = jax.jit(_aggregate_kernel)
+    return _kernel_cache["agg"]

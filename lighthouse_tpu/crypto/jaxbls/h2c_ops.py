@@ -1,0 +1,234 @@
+"""Batched hash-to-G2 on TPU (RFC 9380 BLS12381G2_XMD:SHA-256_SSWU_RO_).
+
+Split host/device at the hashing boundary (SURVEY.md §7 step 1):
+  host   — expand_message_xmd with SHA-256 (hashlib; sequential, tiny) and
+           hash_to_field reduction to Fq2 elements (Python bigints).
+  device — everything algebraic and batch-parallel: simplified SWU with a
+           single-exponentiation sqrt_ratio (branch-free candidate selects),
+           3-isogeny in projective form (no inversions), Jacobian point add
+           and cofactor clearing by h_eff.
+
+Ground truth: lighthouse_tpu/crypto/bls381/hash_to_curve.py (itself pinned by
+the RFC 9380 J.10.1 vector). The device path is differentially tested against
+it in tests/test_jaxbls_h2c.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..bls381 import fields as pyf
+from ..bls381 import hash_to_curve as ph2c
+from ..bls381.constants import P, H_EFF_G2
+from . import limbs as lb
+from . import tower as tw
+from . import curve_ops as co
+
+Q = P * P  # order of Fq2
+
+# ------------------------------------------------------------ constants
+
+ISO_A = tw.fq2_to_device(ph2c.ISO_A)
+ISO_B = tw.fq2_to_device(ph2c.ISO_B)
+ISO_Z = tw.fq2_to_device(ph2c.ISO_Z)
+_NEG_A = tw.fq2_to_device(pyf.fq2_neg(ph2c.ISO_A))
+_ZA = tw.fq2_to_device(pyf.fq2_mul(ph2c.ISO_Z, ph2c.ISO_A))
+
+# sqrt_ratio exponent: s = u * v^7 * (u * v^15)^E with E = (q-9)/16 gives
+# s^2 = omega * u/v for an 8th root of unity omega.
+_E = (Q - 9) // 16
+_E_BITS = np.array([int(b) for b in bin(_E)[2:]], np.uint32)
+
+# Candidate correction constants: y = s*c with c^2 = 1/omega (QR cases,
+# omega in the 4th roots of unity) or c^2 = Z/omega (non-QR cases, omega a
+# primitive 8th root). All computed with the verified pure-Python tower.
+_I = (0, 1)                      # sqrt(-1) in Fq2 = Fq[u]/(u^2+1)
+_RHO = pyf.fq2_sqrt(_I)          # primitive 8th root of unity
+
+
+def _py_inv(a):
+    return pyf.fq2_inv(a)
+
+
+_QR_OMEGAS = [(1, 0), ((-1) % P, 0), _I, (0, (-1) % P)]
+_NQR_OMEGAS = [_RHO, pyf.fq2_mul(_RHO, _I), pyf.fq2_neg(_RHO), pyf.fq2_mul(_RHO, (0, (-1) % P))]
+
+_CANDS = []
+for w in _QR_OMEGAS:
+    c = pyf.fq2_sqrt(_py_inv(w))
+    assert c is not None
+    _CANDS.append(c)
+for w in _NQR_OMEGAS:
+    c = pyf.fq2_sqrt(pyf.fq2_mul(ph2c.ISO_Z, _py_inv(w)))
+    assert c is not None, "Z/omega must be square for primitive 8th roots"
+    _CANDS.append(c)
+CAND_CONSTS = jnp.asarray(np.stack([np.asarray(tw.fq2_to_device(c)) for c in _CANDS]))
+
+# Isogeny coefficient matrix: 4 polynomials x 4 coefficients (padded), in the
+# shared monomial basis [xd^3, xn*xd^2, xn^2*xd, xn^3].
+def _poly4(coeffs):
+    cs = list(coeffs) + [(0, 0)] * (4 - len(coeffs))
+    return np.stack([np.asarray(tw.fq2_to_device(c)) for c in cs])
+
+
+ISO_K = jnp.asarray(
+    np.stack(
+        [
+            _poly4(ph2c.X_NUM),
+            _poly4(ph2c.X_DEN),
+            _poly4(ph2c.Y_NUM),
+            _poly4(ph2c.Y_DEN),
+        ]
+    )
+)  # (4 polys, 4 coeffs, 2, NL)
+
+
+# ------------------------------------------------------------ device pieces
+
+
+def fq2_pow_static(a, bits: np.ndarray):
+    """a^e for a static exponent given as an MSB-first bit array."""
+    one = jnp.broadcast_to(tw.FQ2_ONE, a.shape)
+
+    def body(acc, bit):
+        acc = tw.fq2_sqr(acc)
+        withm = tw.fq2_mul(acc, a)
+        return tw.fq2_select(jnp.broadcast_to(bit == 1, acc.shape[:-2]), withm, acc), None
+
+    acc, _ = lax.scan(body, one, jnp.asarray(bits))
+    return acc
+
+
+def fq2_sgn0(a):
+    """RFC 9380 sgn0 for Fq2 on device (needs standard form for parity)."""
+    std = lb.from_mont(a)
+    s0 = std[..., 0, 0] & 1
+    z0 = jnp.all(std[..., 0, :] == 0, axis=-1)
+    s1 = std[..., 1, 0] & 1
+    return s0 | (jnp.asarray(z0, jnp.uint32) & s1)
+
+
+def fq2_sqrt_ratio(u, v):
+    """RFC 9380-style sqrt_ratio for Fq2 (q = p^2 ≡ 9 mod 16).
+
+    Returns (is_qr, y): y^2 * v == u if is_qr else y^2 * v == Z * u.
+    Single static exponentiation + 8 constant-multiple candidates."""
+    v2 = tw.fq2_sqr(v)
+    v4 = tw.fq2_sqr(v2)
+    v8 = tw.fq2_sqr(v4)
+    v7 = tw.fq2_mul(v4, tw.fq2_mul(v2, v))
+    v15 = tw.fq2_mul(v8, v7)
+    uv15 = tw.fq2_mul(u, v15)
+    s = tw.fq2_mul(tw.fq2_mul(u, v7), fq2_pow_static(uv15, _E_BITS))
+
+    ys = tw.fq2_mul(s[..., None, :, :], CAND_CONSTS)          # (..., 8, 2, NL)
+    checks = tw.fq2_mul(tw.fq2_sqr(ys), v[..., None, :, :])   # y^2 * v
+    zu = tw.fq2_mul(jnp.broadcast_to(ISO_Z, u.shape), u)
+    ok_qr = tw.fq2_eq(checks[..., :4, :, :], u[..., None, :, :])
+    ok_nqr = tw.fq2_eq(checks[..., 4:, :, :], zu[..., None, :, :])
+    ok = jnp.concatenate([ok_qr, ok_nqr], axis=-1)            # (..., 8)
+    is_qr = jnp.any(ok_qr, axis=-1)
+    idx = jnp.argmax(ok, axis=-1)                             # first matching
+    y = jnp.take_along_axis(ys, idx[..., None, None, None], axis=-3)[..., 0, :, :]
+    return is_qr, y
+
+
+def sswu_projective(u):
+    """Simplified SWU map to E2' (branch-free). u: (..., 2, NL) Montgomery.
+
+    Returns (xn, xd, y): affine x = xn/xd on E2', y affine."""
+    shape = u.shape
+    Z = jnp.broadcast_to(ISO_Z, shape)
+    A = jnp.broadcast_to(ISO_A, shape)
+    B = jnp.broadcast_to(ISO_B, shape)
+
+    u2 = tw.fq2_sqr(u)
+    tv1 = tw.fq2_mul(Z, u2)
+    tv2 = tw.fq2_add(tw.fq2_sqr(tv1), tv1)
+    x1n = tw.fq2_mul(B, tw.fq2_add(tv2, jnp.broadcast_to(tw.FQ2_ONE, shape)))
+    xd = tw.fq2_mul(jnp.broadcast_to(_NEG_A, shape), tv2)
+    xd = tw.fq2_select(tw.fq2_is_zero(xd), jnp.broadcast_to(_ZA, shape), xd)
+
+    xd2 = tw.fq2_sqr(xd)
+    xd3 = tw.fq2_mul(xd2, xd)
+    gx1 = tw.fq2_mul(tw.fq2_add(tw.fq2_sqr(x1n), tw.fq2_mul(A, xd2)), x1n)
+    gx1 = tw.fq2_add(gx1, tw.fq2_mul(B, xd3))                 # gx1 numerator
+    is_qr, y1 = fq2_sqrt_ratio(gx1, xd3)
+
+    x2n = tw.fq2_mul(tv1, x1n)
+    u3 = tw.fq2_mul(u2, u)
+    y2 = tw.fq2_mul(tw.fq2_mul(Z, u3), y1)
+    xn = tw.fq2_select(is_qr, x1n, x2n)
+    y = tw.fq2_select(is_qr, y1, y2)
+
+    # sign: sgn0(y) == sgn0(u)
+    flip = fq2_sgn0(y) != fq2_sgn0(u)
+    y = tw.fq2_select(flip, tw.fq2_neg(y), y)
+    return xn, xd, y
+
+
+def iso_map_jacobian(xn, xd, y):
+    """3-isogeny E2' -> E2 evaluated on x = xn/xd, output Jacobian (X, Y, Z).
+
+    All four isogeny polynomials are evaluated in one batched fq2_mul against
+    the shared monomial vector [xd^3, xn*xd^2, xn^2*xd, xn^3]."""
+    xd2 = tw.fq2_sqr(xd)
+    xn2 = tw.fq2_sqr(xn)
+    m = jnp.stack(
+        [
+            tw.fq2_mul(xd2, xd),
+            tw.fq2_mul(xn, xd2),
+            tw.fq2_mul(xn2, xd),
+            tw.fq2_mul(xn2, xn),
+        ],
+        axis=-3,
+    )  # (..., 4, 2, NL)
+    terms = tw.fq2_mul(ISO_K, m[..., None, :, :, :])          # (..., 4, 4, 2, NL)
+    sums = lb.add_mod(
+        lb.add_mod(terms[..., 0, :, :], terms[..., 1, :, :]),
+        lb.add_mod(terms[..., 2, :, :], terms[..., 3, :, :]),
+    )  # (..., 4, 2, NL): x_num, x_den, y_num, y_den (all * xd^3)
+    xo_n = sums[..., 0, :, :]
+    xo_d = sums[..., 1, :, :]
+    yo_n = tw.fq2_mul(y, sums[..., 2, :, :])
+    yo_d = sums[..., 3, :, :]
+
+    # Jacobian with Zj = xo_d * yo_d:
+    Zj = tw.fq2_mul(xo_d, yo_d)
+    X = tw.fq2_mul(tw.fq2_mul(xo_n, xo_d), tw.fq2_sqr(yo_d))
+    Y = tw.fq2_mul(tw.fq2_mul(yo_n, tw.fq2_sqr(xo_d)), tw.fq2_mul(xo_d, tw.fq2_sqr(yo_d)))
+    return (X, Y, Zj)
+
+
+def map_to_g2(u0, u1):
+    """Device: two field elements per message -> Jacobian point in G2
+    (SSWU + isogeny on both, add, clear cofactor). u0/u1: (..., 2, NL)."""
+    us = jnp.stack([u0, u1], axis=0)          # map both in one batched pass
+    xn, xd, y = sswu_projective(us)
+    q = iso_map_jacobian(xn, xd, y)
+    q0 = jax.tree_util.tree_map(lambda c: c[0], q)
+    q1 = jax.tree_util.tree_map(lambda c: c[1], q)
+    r = co.jac_add(q0, q1, co.FQ2_OPS)
+    return co.scalar_mul_static(r, H_EFF_G2, co.FQ2_OPS)
+
+
+# ------------------------------------------------------------ host pipeline
+
+
+def hash_to_field_batch(messages, dst: bytes) -> np.ndarray:
+    """Host: messages -> (n, 2, 2, NL) Montgomery limb array of u-values."""
+    out = np.zeros((len(messages), 2, 2, lb.NL), np.uint32)
+    for i, msg in enumerate(messages):
+        u0, u1 = ph2c.hash_to_field_fq2(msg, 2, dst)
+        for j, u in enumerate((u0, u1)):
+            out[i, j, 0] = lb.pack(u[0] * lb.R_MONT % P)
+            out[i, j, 1] = lb.pack(u[1] * lb.R_MONT % P)
+    return out
+
+
+def hash_to_g2_jacobian(us):
+    """Device: (n, 2, 2, NL) u-values -> batched Jacobian G2 points."""
+    return map_to_g2(us[:, 0], us[:, 1])

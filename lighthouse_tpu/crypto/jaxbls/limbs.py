@@ -111,28 +111,59 @@ def _cond_sub_n(t):
     return out[..., :NL]
 
 
+def _banded(b, na: int, ncols: int):
+    """Build the banded convolution matrix B[..., j, k] = b[k - j]
+    (0 <= k-j < nb), so that polynomial multiplication a*b becomes the
+    batched matvec einsum('...j,...jk->...k', a, B). This maps limb
+    multiplication onto XLA dot_general (MXU-friendly) instead of
+    scatter-add loops — compile time and runtime both improve by orders
+    of magnitude over the schoolbook form."""
+    nb = b.shape[-1]
+    j = np.arange(na)[:, None]
+    k = np.arange(ncols)[None, :]
+    idx = k - j                                        # (na, ncols) static
+    valid = jnp.asarray((idx >= 0) & (idx < nb))
+    idx_c = np.clip(idx, 0, nb - 1)
+    return jnp.where(valid, b[..., idx_c], 0)
+
+
+def _poly_mul(a, b, ncols: int):
+    """Carry-free limb product: a (..., na) * b (..., nb) -> (..., ncols)
+    column sums. Inputs are 16-bit-valued u32; the 8-bit split of `a` keeps
+    every dot-product partial sum < 2^30 (no u32 overflow)."""
+    na = a.shape[-1]
+    B = _banded(b, na, ncols)
+    a_lo = a & 0xFF
+    a_hi = a >> 8
+    c_lo = jnp.einsum("...j,...jk->...k", a_lo, B)
+    c_hi = jnp.einsum("...j,...jk->...k", a_hi, B)
+    col = c_lo + ((c_hi & 0xFF) << 8)
+    col = col.at[..., 1:].add(c_hi[..., :-1] >> 8)
+    return col                                          # each < 2^31
+
+
+# -P^-1 mod 2^384, full-width Montgomery constant for non-interleaved REDC.
+NPRIME_HOST = pack((-pow(P, -1, 1 << (NL * LB))) % (1 << (NL * LB)))
+
+
 def mont_mul(a, b):
-    """Montgomery product a*b*R^-1 mod P. a, b: (..., NL) canonical limbs."""
+    """Montgomery product a*b*R^-1 mod P. a, b: (..., NL) canonical limbs.
+
+    Non-interleaved REDC with all three limb products as banded matmuls:
+      T = a*b ; m = (T mod R) * N' mod R ; res = (T + m*N) / R ; cond-sub.
+    """
     batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
     a = jnp.broadcast_to(a, batch + (NL,))
     b = jnp.broadcast_to(b, batch + (NL,))
-    acc = jnp.zeros(batch + (2 * NL + 1,), U32)
-    # Schoolbook product with lo/hi split; columns stay < 2^22.
-    for i in range(NL):
-        p = a[..., i : i + 1] * b                     # (..., NL) u32 exact
-        acc = acc.at[..., i : i + NL].add(p & MASK)
-        acc = acc.at[..., i + 1 : i + NL + 1].add(p >> LB)
-    acc, _ = carry_normalize(acc)
-    # Interleaved REDC: after each step acc[i] ≡ 0 mod 2^16; push its carry.
-    n_arr = jnp.asarray(N_HOST)
-    for i in range(NL):
-        m = (acc[..., i] * N0P) & MASK                # (...,)
-        p = m[..., None] * n_arr                      # (..., NL)
-        acc = acc.at[..., i : i + NL].add(p & MASK)
-        acc = acc.at[..., i + 1 : i + NL + 1].add(p >> LB)
-        acc = acc.at[..., i + 1].add(acc[..., i] >> LB)
-    res = acc[..., NL:]                               # (..., NL+1), < 2N redundant
-    res, _ = carry_normalize(res)
+
+    t = _poly_mul(a, b, 2 * NL + 1)
+    t, _ = carry_normalize(t)                          # canonical T, 2NL+1 limbs
+    m = _poly_mul(t[..., :NL], jnp.asarray(NPRIME_HOST), NL)
+    m, _ = carry_normalize(m)                          # mod 2^384 via truncation
+    mn = _poly_mul(m, jnp.asarray(N_HOST), 2 * NL + 1)
+    s = t + mn                                         # < 2^31 + 2^16 per column
+    s, _ = carry_normalize(s)
+    res = s[..., NL:]                                  # (..., NL+1), value < 2N
     return _cond_sub_n(res)
 
 

@@ -1,0 +1,32 @@
+"""JAX configuration helpers.
+
+The jaxbls kernels are large graphs (Miller loop + final exponentiation);
+first-compile latency is tens of seconds. A persistent compilation cache
+turns that into a one-time cost per (shape, platform) across processes —
+essential for the node's startup latency and for the test suite.
+"""
+
+from __future__ import annotations
+
+import os
+
+_DEFAULT_CACHE_DIR = os.environ.get(
+    "LIGHTHOUSE_TPU_JAX_CACHE", os.path.expanduser("~/.cache/lighthouse_tpu_jax")
+)
+
+_initialized = False
+
+
+def setup_compilation_cache(cache_dir: str | None = None) -> None:
+    global _initialized
+    if _initialized:
+        return
+    import jax
+
+    path = cache_dir or _DEFAULT_CACHE_DIR
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    # Cache everything, including small/fast compiles.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    _initialized = True

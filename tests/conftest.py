@@ -19,3 +19,7 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+from lighthouse_tpu.utils.jaxcfg import setup_compilation_cache
+
+setup_compilation_cache()

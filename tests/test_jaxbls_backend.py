@@ -1,0 +1,81 @@
+"""End-to-end parity: the jax TPU backend vs the pure-Python backend on the
+generic BLS API — the same dual-backend strategy the reference uses for
+blst vs fake_crypto (/root/reference/crypto/bls/tests/tests.rs)."""
+
+import random
+
+import pytest
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.crypto.bls import api as bls_api
+from lighthouse_tpu.crypto.bls381 import curve as cv
+from lighthouse_tpu.crypto.bls381.constants import R
+
+
+rng = random.Random(0xBAC)
+
+
+def _mk_set(n_pks: int, msg: bytes, valid=True):
+    sks = [bls.SecretKey(rng.randrange(1, R)) for _ in range(n_pks)]
+    pks = [sk.public_key() for sk in sks]
+    agg = sum(sk.scalar for sk in sks) % R
+    h = bls_api.hash_to_g2_point(msg)
+    if not valid:
+        agg = (agg + 1) % R
+    sig = bls.Signature(cv.g2_mul(h, agg))
+    return bls.SignatureSet(sig, pks, msg)
+
+
+@pytest.fixture(autouse=True)
+def _restore_backend():
+    yield
+    bls_api.set_backend("python")
+
+
+def test_verify_signature_sets_parity():
+    backend = bls_api.set_backend("jax")
+    sets = [_mk_set(3, b"\x11" * 32), _mk_set(1, b"\x22" * 32), _mk_set(5, b"\x33" * 32)]
+    rands = [1, 0xDEADBEEF12345677, 0x42]
+    assert backend.verify_signature_sets(sets, rands)
+
+    # one invalid set poisons the batch
+    bad_sets = sets[:2] + [_mk_set(2, b"\x44" * 32, valid=False)]
+    assert not backend.verify_signature_sets(bad_sets, rands)
+
+    # wrong message fails
+    tampered = [bls.SignatureSet(sets[0].signature, sets[0].signing_keys, b"\x55" * 32)] + sets[1:]
+    assert not backend.verify_signature_sets(tampered, rands)
+
+
+def test_single_verify_parity():
+    bls_api.set_backend("jax")
+    sk = bls.SecretKey(rng.randrange(1, R))
+    msg = b"\x66" * 32
+    sig = bls_api.sign(sk, msg)
+    assert bls_api.verify(sk.public_key(), msg, sig)
+    assert not bls_api.verify(sk.public_key(), b"\x67" * 32, sig)
+
+
+def test_fast_aggregate_verify_parity():
+    bls_api.set_backend("jax")
+    msg = b"\x77" * 32
+    sks = [bls.SecretKey(rng.randrange(1, R)) for _ in range(4)]
+    pks = [sk.public_key() for sk in sks]
+    h = bls_api.hash_to_g2_point(msg)
+    agg_sig = bls.Signature(cv.g2_mul(h, sum(sk.scalar for sk in sks) % R))
+    assert bls_api.fast_aggregate_verify(pks, msg, agg_sig)
+    assert not bls_api.fast_aggregate_verify(pks[:3], msg, agg_sig)
+
+
+def test_aggregate_verify_distinct_messages_parity():
+    bls_api.set_backend("jax")
+    sks = [bls.SecretKey(rng.randrange(1, R)) for _ in range(3)]
+    msgs = [bytes([i]) * 32 for i in range(3)]
+    sig_pt = None
+    for sk, m in zip(sks, msgs):
+        s = cv.g2_mul(bls_api.hash_to_g2_point(m), sk.scalar)
+        sig_pt = cv.g2_add(sig_pt, s)
+    agg = bls.Signature(sig_pt)
+    pks = [sk.public_key() for sk in sks]
+    assert bls_api.aggregate_verify(pks, msgs, agg)
+    assert not bls_api.aggregate_verify(pks, list(reversed(msgs)), agg)

@@ -1,0 +1,94 @@
+"""Differential tests: jaxbls pairing vs pure-Python bls381.pairing.
+
+The device pairing uses unit-scaled lines, so raw Miller values differ from
+the ground truth by Fq2 units — equality is checked after final
+exponentiation (the only form consensus code ever uses)."""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lighthouse_tpu.crypto.bls381 import curve as pc
+from lighthouse_tpu.crypto.bls381 import fields as pyf
+from lighthouse_tpu.crypto.bls381 import pairing as pp
+from lighthouse_tpu.crypto.bls381.constants import R
+from lighthouse_tpu.crypto.jaxbls import curve_ops as co
+from lighthouse_tpu.crypto.jaxbls import pairing_ops as po
+from lighthouse_tpu.crypto.jaxbls import tower as tw
+
+rng = random.Random(0xE7)
+
+
+def _device_pairs(pairs, pad_to):
+    """Host affine pairs [(g1, g2), ...] -> batched device arrays + mask."""
+    n = len(pairs)
+    mask = np.zeros(pad_to, bool)
+    mask[:n] = True
+    g1s = [p for p, _ in pairs] + [None] * (pad_to - n)
+    g2s = [q for _, q in pairs] + [None] * (pad_to - n)
+    xp = tw.fq_batch_to_device([p[0] if p else 0 for p in g1s])
+    yp = tw.fq_batch_to_device([p[1] if p else 0 for p in g1s])
+    xq = tw.fq2_batch_to_device([q[0] if q else (0, 0) for q in g2s])
+    yq = tw.fq2_batch_to_device([q[1] if q else (0, 0) for q in g2s])
+    return (xp, yp), (xq, yq), jnp.asarray(mask)
+
+
+_full_pairing = jax.jit(
+    lambda p, q, m: po.final_exponentiation(po.fq12_product(po.miller_loop_batch(p, q, m)))
+)
+_product_check = jax.jit(po.pairing_product_is_one)
+
+
+def test_single_pairing_matches_python():
+    a = rng.randrange(1, R)
+    b = rng.randrange(1, R)
+    p = pc.g1_mul(pc.G1_GEN, a)
+    q = pc.g2_mul(pc.G2_GEN, b)
+    dp, dq, mask = _device_pairs([(p, q)], 1)
+    got = tw.fq12_from_device(_full_pairing(dp, dq, mask))
+    assert got == pp.pairing(p, q)
+
+
+def test_bilinearity_product_check():
+    # e(aG1, bG2) * e(-abG1, G2) == 1
+    a = rng.randrange(1, R)
+    b = rng.randrange(1, R)
+    p1 = pc.g1_mul(pc.G1_GEN, a)
+    q1 = pc.g2_mul(pc.G2_GEN, b)
+    p2 = pc.g1_neg(pc.g1_mul(pc.G1_GEN, a * b % R))
+    q2 = pc.G2_GEN
+    dp, dq, mask = _device_pairs([(p1, q1), (p2, q2)], 2)
+    assert bool(_product_check(dp, dq, mask))
+
+
+def test_product_check_rejects_wrong():
+    a = rng.randrange(1, R)
+    p1 = pc.g1_mul(pc.G1_GEN, a)
+    q1 = pc.g2_mul(pc.G2_GEN, 7)
+    p2 = pc.g1_neg(pc.g1_mul(pc.G1_GEN, a * 8 % R))  # wrong scalar
+    dp, dq, mask = _device_pairs([(p1, q1), (p2, pc.G2_GEN)], 2)
+    assert not bool(_product_check(dp, dq, mask))
+
+
+def test_padded_lanes_contribute_one():
+    # Same bilinearity check but padded to 4 lanes with garbage-identity pads.
+    a = rng.randrange(1, R)
+    b = rng.randrange(1, R)
+    p1 = pc.g1_mul(pc.G1_GEN, a)
+    q1 = pc.g2_mul(pc.G2_GEN, b)
+    p2 = pc.g1_neg(pc.g1_mul(pc.G1_GEN, a * b % R))
+    dp, dq, mask = _device_pairs([(p1, q1), (p2, pc.G2_GEN)], 4)
+    assert bool(_product_check(dp, dq, mask))
+
+
+def test_final_exp_matches_python_on_random_miller_output():
+    # Feed the same Miller value through both final exps.
+    p = pc.g1_mul(pc.G1_GEN, rng.randrange(1, R))
+    q = pc.g2_mul(pc.G2_GEN, rng.randrange(1, R))
+    m = pp.miller_loop([(p, q)])
+    dm = tw.fq12_to_device(m)
+    got = tw.fq12_from_device(jax.jit(po.final_exponentiation)(dm))
+    assert got == pp.final_exponentiation(m)

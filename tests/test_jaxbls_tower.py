@@ -1,0 +1,112 @@
+"""Differential tests: jaxbls tower vs pure-Python bls381.fields ground truth."""
+
+import random
+
+import jax
+import pytest
+
+from lighthouse_tpu.crypto.bls381 import fields as pyf
+from lighthouse_tpu.crypto.bls381.constants import P
+from lighthouse_tpu.crypto.jaxbls import tower as tw
+
+rng = random.Random(0xB15)
+
+
+def rfq():
+    return rng.randrange(P)
+
+
+def rfq2():
+    return (rfq(), rfq())
+
+
+def rfq6():
+    return (rfq2(), rfq2(), rfq2())
+
+
+def rfq12():
+    return (rfq6(), rfq6())
+
+
+def test_fq2_ops():
+    a, b = rfq2(), rfq2()
+    da, db = tw.fq2_to_device(a), tw.fq2_to_device(b)
+    assert tw.fq2_from_device(tw.fq2_mul(da, db)) == pyf.fq2_mul(a, b)
+    assert tw.fq2_from_device(tw.fq2_sqr(da)) == pyf.fq2_sqr(a)
+    assert tw.fq2_from_device(tw.fq2_add(da, db)) == pyf.fq2_add(a, b)
+    assert tw.fq2_from_device(tw.fq2_sub(da, db)) == pyf.fq2_sub(a, b)
+    assert tw.fq2_from_device(tw.fq2_neg(da)) == pyf.fq2_neg(a)
+    assert tw.fq2_from_device(tw.fq2_conj(da)) == pyf.fq2_conj(a)
+    assert tw.fq2_from_device(tw.fq2_mul_by_xi(da)) == pyf.fq2_mul_by_xi(a)
+    assert tw.fq2_from_device(tw.fq2_mul_small(da, 3)) == pyf.fq2_mul_scalar(a, 3)
+
+
+def test_fq2_inv():
+    a = rfq2()
+    da = tw.fq2_to_device(a)
+    assert tw.fq2_from_device(jax.jit(tw.fq2_inv)(da)) == pyf.fq2_inv(a)
+
+
+def test_fq6_ops():
+    a, b = rfq6(), rfq6()
+    da, db = tw.fq6_to_device(a), tw.fq6_to_device(b)
+    assert tw.fq6_from_device(tw.fq6_mul(da, db)) == pyf.fq6_mul(a, b)
+    assert tw.fq6_from_device(tw.fq6_mul_by_v(da)) == pyf.fq6_mul_by_v(a)
+    assert tw.fq6_from_device(tw.fq6_sub(da, db)) == pyf.fq6_sub(a, b)
+
+
+def test_fq6_inv():
+    a = rfq6()
+    da = tw.fq6_to_device(a)
+    assert tw.fq6_from_device(jax.jit(tw.fq6_inv)(da)) == pyf.fq6_inv(a)
+
+
+def test_fq12_mul_sqr():
+    a, b = rfq12(), rfq12()
+    da, db = tw.fq12_to_device(a), tw.fq12_to_device(b)
+    assert tw.fq12_from_device(jax.jit(tw.fq12_mul)(da, db)) == pyf.fq12_mul(a, b)
+    assert tw.fq12_from_device(jax.jit(tw.fq12_sqr)(da)) == pyf.fq12_sqr(a)
+    assert tw.fq12_from_device(tw.fq12_conj(da)) == pyf.fq12_conj(a)
+
+
+def test_fq12_inv():
+    a = rfq12()
+    da = tw.fq12_to_device(a)
+    assert tw.fq12_from_device(jax.jit(tw.fq12_inv)(da)) == pyf.fq12_inv(a)
+
+
+def test_fq12_frobenius():
+    a = rfq12()
+    da = tw.fq12_to_device(a)
+    fro = jax.jit(tw.fq12_frobenius, static_argnums=1)
+    for power in (1, 2, 3, 6):
+        assert tw.fq12_from_device(fro(da, power)) == pyf.fq12_frobenius(a, power)
+
+
+def test_cyclotomic_sqr_matches_generic_sqr():
+    # Build a cyclotomic element: m^((p^6-1)(p^2+1)) for random m.
+    m = rfq12()
+    t = pyf.fq12_mul(pyf.fq12_conj(m), pyf.fq12_inv(m))
+    t = pyf.fq12_mul(pyf.fq12_frobenius(t, 2), t)
+    dt = tw.fq12_to_device(t)
+    got = tw.fq12_from_device(jax.jit(tw.fq12_cyclotomic_sqr)(dt))
+    assert got == pyf.fq12_sqr(t)
+
+
+def test_fq12_eq_one():
+    one = tw.fq12_to_device(pyf.FQ12_ONE)
+    assert bool(tw.fq12_eq_one(one))
+    a = tw.fq12_to_device(rfq12())
+    assert not bool(tw.fq12_eq_one(a))
+
+
+def test_batched_fq2_mul():
+    a_list = [rfq2() for _ in range(8)]
+    b_list = [rfq2() for _ in range(8)]
+    da = tw.fq2_batch_to_device(a_list)
+    db = tw.fq2_batch_to_device(b_list)
+    out = jax.jit(tw.fq2_mul)(da, db)
+    got0 = tw.fq_batch_from_device(out[..., 0, :])
+    got1 = tw.fq_batch_from_device(out[..., 1, :])
+    for i, (a, b) in enumerate(zip(a_list, b_list)):
+        assert (got0[i], got1[i]) == pyf.fq2_mul(a, b)
