@@ -125,7 +125,7 @@ def _get_kernel():
         gx, gy = pc.g1_neg(pc.G1_GEN)
         _NEG_G1_GEN = (tw.fq_to_device(gx), tw.fq_to_device(gy))
     if "k" not in _kernel_cache:
-        from ..utils.jaxcfg import setup_compilation_cache
+        from ...utils.jaxcfg import setup_compilation_cache
 
         setup_compilation_cache()
         _kernel_cache["k"] = jax.jit(_verify_kernel)
