@@ -1,0 +1,82 @@
+"""Consensus types: container construction/roundtrip, spec helpers, shuffle."""
+
+import pytest
+
+from lighthouse_tpu.types import helpers as h
+from lighthouse_tpu.types.containers import spec_types
+from lighthouse_tpu.types.spec import (
+    ForkName,
+    MAINNET_PRESET,
+    MINIMAL_PRESET,
+    minimal_spec,
+    mainnet_spec,
+    DOMAIN_BEACON_PROPOSER,
+)
+
+
+@pytest.mark.parametrize("fork", list(ForkName))
+def test_state_default_roundtrip(fork):
+    t = spec_types(MINIMAL_PRESET, fork)
+    state = t.BeaconState.default()
+    enc = t.BeaconState.serialize(state)
+    assert t.BeaconState.deserialize(enc) == state
+    assert isinstance(t.BeaconState.hash_tree_root(state), bytes)
+
+
+@pytest.mark.parametrize("fork", list(ForkName))
+def test_block_default_roundtrip(fork):
+    t = spec_types(MINIMAL_PRESET, fork)
+    blk = t.SignedBeaconBlock.default()
+    enc = t.SignedBeaconBlock.serialize(blk)
+    assert t.SignedBeaconBlock.deserialize(enc) == blk
+
+
+def test_fork_fields_progression():
+    t0 = spec_types(MINIMAL_PRESET, ForkName.phase0)
+    ta = spec_types(MINIMAL_PRESET, ForkName.altair)
+    td = spec_types(MINIMAL_PRESET, ForkName.deneb)
+    names0 = [f.name for f in t0.BeaconState.fields]
+    namesa = [f.name for f in ta.BeaconState.fields]
+    namesd = [f.name for f in td.BeaconBlockBody.fields]
+    assert "previous_epoch_attestations" in names0
+    assert "previous_epoch_participation" in namesa
+    assert "current_sync_committee" in namesa
+    assert "blob_kzg_commitments" in namesd
+
+
+def test_fork_schedule():
+    spec = mainnet_spec()
+    assert spec.fork_name_at_epoch(0) == ForkName.phase0
+    assert spec.fork_name_at_epoch(74240) == ForkName.altair
+    assert spec.fork_name_at_epoch(269568) == ForkName.deneb
+    mini = minimal_spec()
+    assert mini.fork_name_at_epoch(0) == ForkName.deneb  # all forks at genesis
+
+
+def test_compute_domain_shape():
+    d = h.compute_domain(DOMAIN_BEACON_PROPOSER, bytes(4), bytes(32))
+    assert len(d) == 32 and d[:4] == DOMAIN_BEACON_PROPOSER
+
+
+def test_shuffled_index_is_permutation():
+    seed = b"\x01" * 32
+    n = 33
+    out = [h.compute_shuffled_index(i, n, seed, 10) for i in range(n)]
+    assert sorted(out) == list(range(n))
+
+
+def test_shuffle_list_matches_per_index():
+    seed = b"\x02" * 32
+    n = 57
+    rounds = 10
+    indices = list(range(100, 100 + n))
+    full = h.shuffle_list(indices, seed, rounds)
+    expected = [indices[h.compute_shuffled_index(i, n, seed, rounds)] for i in range(n)]
+    assert full == expected
+
+
+def test_committees_partition():
+    ids = list(range(20))
+    parts = [h.compute_committee(ids, i, 3) for i in range(3)]
+    flat = [x for p in parts for x in p]
+    assert flat == ids
