@@ -1,0 +1,90 @@
+"""Genesis state construction (interop + from-deposits).
+
+Parity surface: /root/reference/beacon_node/genesis/ plus the interop
+genesis the testing harness uses (deterministic keypairs, pre-activated
+validators — common/eth2_interop_keypairs + BeaconChainHarness defaults).
+"""
+
+from __future__ import annotations
+
+from ..crypto import bls
+from ..types import helpers as h
+from ..types.spec import ChainSpec, ForkName, FAR_FUTURE_EPOCH
+from ..types.containers import spec_types
+from . import accessors as acc
+from .epoch import get_next_sync_committee
+from .slot import upgrade_state
+
+
+def bls_withdrawal_credentials(pubkey_bytes: bytes) -> bytes:
+    return b"\x00" + h.sha256(pubkey_bytes)[1:]
+
+
+def interop_genesis_state(
+    keypairs: list[bls.Keypair],
+    genesis_time: int,
+    spec: ChainSpec,
+    eth1_block_hash: bytes = b"\x42" * 32,
+):
+    """Deterministic pre-activated genesis state at the spec's genesis fork."""
+    fork = spec.fork_name_at_epoch(0)
+    types = spec_types(spec.preset, ForkName.phase0)
+
+    state = types.BeaconState.default()
+    state.genesis_time = genesis_time
+    state.fork = types.Fork.make(
+        previous_version=spec.genesis_fork_version,
+        current_version=spec.genesis_fork_version,
+        epoch=0,
+    )
+    state.eth1_data = types.Eth1Data.make(
+        deposit_root=b"\x00" * 32,
+        deposit_count=len(keypairs),
+        block_hash=eth1_block_hash,
+    )
+    state.eth1_deposit_index = len(keypairs)
+    body = types.BeaconBlockBody.default()
+    state.latest_block_header = types.BeaconBlockHeader.make(
+        slot=0,
+        proposer_index=0,
+        parent_root=b"\x00" * 32,
+        state_root=b"\x00" * 32,
+        body_root=types.BeaconBlockBody.hash_tree_root(body),
+    )
+    state.randao_mixes = [eth1_block_hash] * spec.preset.EPOCHS_PER_HISTORICAL_VECTOR
+
+    for kp in keypairs:
+        pk_bytes = kp.pk.serialize()
+        state.validators.append(
+            types.Validator.make(
+                pubkey=pk_bytes,
+                withdrawal_credentials=bls_withdrawal_credentials(pk_bytes),
+                effective_balance=spec.max_effective_balance,
+                slashed=False,
+                activation_eligibility_epoch=0,
+                activation_epoch=0,
+                exit_epoch=FAR_FUTURE_EPOCH,
+                withdrawable_epoch=FAR_FUTURE_EPOCH,
+            )
+        )
+        state.balances.append(spec.max_effective_balance)
+
+    state.genesis_validators_root = _validators_root(state, types, spec)
+
+    if fork != ForkName.phase0:
+        upgrade_state(state, spec, ForkName.phase0, fork)
+        # genesis fork versions: previous == current at genesis
+        ftypes = spec_types(spec.preset, fork)
+        state.fork = ftypes.Fork.make(
+            previous_version=spec.fork_version(fork),
+            current_version=spec.fork_version(fork),
+            epoch=0,
+        )
+    return state
+
+
+def _validators_root(state, types, spec: ChainSpec) -> bytes:
+    from ..ssz.core import List as SSZList
+
+    reg = SSZList(types.Validator, spec.preset.VALIDATOR_REGISTRY_LIMIT)
+    return reg.hash_tree_root(state.validators)

@@ -198,6 +198,10 @@ class SpecTypes:
                 ("aggregation_bits", Bitvector(p.SYNC_COMMITTEE_SIZE // 4)),
                 ("signature", BLSSignature),
             ])
+            self.SyncAggregatorSelectionData = C("SyncAggregatorSelectionData", [
+                ("slot", Slot),
+                ("subcommittee_index", uint64),
+            ])
             self.ContributionAndProof = C("ContributionAndProof", [
                 ("aggregator_index", ValidatorIndex),
                 ("contribution", self.SyncCommitteeContribution),

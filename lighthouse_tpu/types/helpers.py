@@ -61,6 +61,15 @@ def compute_domain(
     return domain_type + fork_data_root[:28]
 
 
+def compute_signing_root_from_root(object_root: bytes, domain: bytes) -> bytes:
+    from .containers import spec_types
+    from .spec import MAINNET_PRESET
+
+    t = spec_types(MAINNET_PRESET, ForkName.phase0)
+    sd = t.SigningData.make(object_root=object_root, domain=domain)
+    return t.SigningData.hash_tree_root(sd)
+
+
 def compute_signing_root(ssz_type, obj, domain: bytes) -> bytes:
     from .containers import spec_types
     from .spec import MAINNET_PRESET

@@ -1,0 +1,117 @@
+"""Proto-array fork choice unit tests (vote accounting, LMD-GHOST head
+selection, proposer boost, pruning, execution invalidation) — modeled on
+the reference's proto_array vote tests."""
+
+import pytest
+
+from lighthouse_tpu.fork_choice.proto_array import (
+    ExecutionStatus,
+    ProtoArrayForkChoice,
+)
+
+
+def root(i: int) -> bytes:
+    return i.to_bytes(32, "big")
+
+
+JC = (0, root(0))
+FC = (0, root(0))
+
+
+def mk_fc():
+    fc = ProtoArrayForkChoice(root(0), 0, JC, FC)
+    return fc
+
+
+def test_single_chain_head():
+    fc = mk_fc()
+    for i in range(1, 4):
+        fc.on_block(i, root(i), root(i - 1), JC, FC)
+    assert fc.find_head(root(0)) == root(3)
+
+
+def test_votes_pick_heavier_fork():
+    fc = mk_fc()
+    # two children of genesis
+    fc.on_block(1, root(1), root(0), JC, FC)
+    fc.on_block(1, root(2), root(0), JC, FC)
+    balances = [10, 10, 10]
+    # two votes for fork 2, one for fork 1
+    fc.process_attestation(0, root(2), 1)
+    fc.process_attestation(1, root(2), 1)
+    fc.process_attestation(2, root(1), 1)
+    assert fc.find_head(root(0), balances) == root(2)
+    # votes move to fork 1
+    fc.process_attestation(0, root(1), 2)
+    fc.process_attestation(1, root(1), 2)
+    assert fc.find_head(root(0), balances) == root(1)
+
+
+def test_tie_breaks_by_root():
+    fc = mk_fc()
+    fc.on_block(1, root(1), root(0), JC, FC)
+    fc.on_block(1, root(2), root(0), JC, FC)
+    # no votes: higher root wins
+    assert fc.find_head(root(0), []) == root(2)
+
+
+def test_deeper_subtree_weight_propagates():
+    fc = mk_fc()
+    fc.on_block(1, root(1), root(0), JC, FC)
+    fc.on_block(1, root(2), root(0), JC, FC)
+    fc.on_block(2, root(3), root(1), JC, FC)
+    balances = [10, 10]
+    fc.process_attestation(0, root(3), 1)  # vote deep in fork 1
+    assert fc.find_head(root(0), balances) == root(3)
+    fc.process_attestation(0, root(2), 2)
+    fc.process_attestation(1, root(2), 2)
+    assert fc.find_head(root(0), balances) == root(2)
+
+
+def test_proposer_boost():
+    fc = mk_fc()
+    fc.on_block(1, root(1), root(0), JC, FC)
+    fc.on_block(1, root(2), root(0), JC, FC)
+    balances = [10]
+    fc.process_attestation(0, root(1), 1)
+    assert fc.find_head(root(0), balances) == root(1)
+    # boost block 2 with weight > 10
+    fc.set_proposer_boost(root(2))
+    assert fc.find_head(root(0), balances, proposer_boost_amount=15) == root(2)
+    # boost cleared -> back to votes
+    fc.set_proposer_boost(b"\x00" * 32)
+    assert fc.find_head(root(0), balances) == root(1)
+
+
+def test_invalid_execution_excluded():
+    fc = mk_fc()
+    fc.on_block(1, root(1), root(0), JC, FC, execution_status=ExecutionStatus.optimistic)
+    fc.on_block(2, root(2), root(1), JC, FC, execution_status=ExecutionStatus.optimistic)
+    fc.on_block(1, root(3), root(0), JC, FC)
+    balances = [10]
+    fc.process_attestation(0, root(2), 1)
+    assert fc.find_head(root(0), balances) == root(2)
+    fc.on_invalid_execution_payload(root(1))  # invalidates 1 and 2
+    assert fc.find_head(root(0), balances) == root(3)
+
+
+def test_is_descendant_and_ancestor():
+    fc = mk_fc()
+    fc.on_block(1, root(1), root(0), JC, FC)
+    fc.on_block(2, root(2), root(1), JC, FC)
+    fc.on_block(1, root(9), root(0), JC, FC)
+    assert fc.is_descendant(root(0), root(2))
+    assert fc.is_descendant(root(1), root(2))
+    assert not fc.is_descendant(root(9), root(2))
+    assert fc.ancestor_at_slot(root(2), 1) == root(1)
+
+
+def test_prune():
+    fc = mk_fc()
+    for i in range(1, 6):
+        fc.on_block(i, root(i), root(i - 1), JC, FC)
+    fc.on_block(1, root(7), root(0), JC, FC)  # stale fork
+    fc.prune(root(2))
+    assert root(7) not in fc.index_by_root
+    assert root(2) in fc.index_by_root and root(5) in fc.index_by_root
+    assert fc.find_head(root(2)) == root(5)
