@@ -169,8 +169,9 @@ def scalar_mul_static(p_jac, k: int, ops):
 
     def body(acc, bit):
         acc = jac_double(acc, ops)
-        added = jac_add(acc, p_jac, ops)
-        return pt_select(ops, bit == 1, added, acc), None
+        # static scalar -> scalar predicate: only the taken branch runs
+        acc = jax.lax.cond(bit == 1, lambda a: jac_add(a, p_jac, ops), lambda a: a, acc)
+        return acc, None
 
     init = jax.tree_util.tree_map(lambda c, x: jnp.broadcast_to(c, x.shape), identity(ops), p_jac)
     acc, _ = jax.lax.scan(body, init, bits)

@@ -90,13 +90,14 @@ ISO_K = jnp.asarray(
 
 
 def fq2_pow_static(a, bits: np.ndarray):
-    """a^e for a static exponent given as an MSB-first bit array."""
+    """a^e for a static exponent given as an MSB-first bit array. One scan
+    with the conditional multiply behind lax.cond (scalar predicate)."""
     one = jnp.broadcast_to(tw.FQ2_ONE, a.shape)
 
     def body(acc, bit):
         acc = tw.fq2_sqr(acc)
-        withm = tw.fq2_mul(acc, a)
-        return tw.fq2_select(jnp.broadcast_to(bit == 1, acc.shape[:-2]), withm, acc), None
+        acc = lax.cond(bit == 1, lambda x: tw.fq2_mul(x, a), lambda x: x, acc)
+        return acc, None
 
     acc, _ = lax.scan(body, one, jnp.asarray(bits))
     return acc

@@ -117,32 +117,27 @@ def miller_loop_batch(p_aff, q_aff, valid_mask):
     f = jnp.broadcast_to(tw.FQ12_ONE, (n,) + tw.FQ12_ONE.shape)
     r = co.affine_to_jac(co.FQ2_OPS, (xq, yq))
 
-    def uniform_step(carry, _):
+    # ONE scan instance over the static bit pattern; the (rare) add step
+    # hides behind lax.cond with a scalar predicate, so only the taken
+    # branch runs at runtime and only one loop body is compiled — compile
+    # time stays flat in the bit length.
+    bits_arr = jnp.asarray(np.array([int(b) for b in _X_BITS], np.uint32))
+
+    def step(carry, bit):
         f, r = carry
         f = tw.fq12_sqr(f)
         r, line = _dbl_step(r, xp, yp)
         f = _mul_by_line(f, line)
+
+        def with_add(op):
+            f_, r_ = op
+            r2, line2 = _add_step(r_, (xq, yq), xp, yp)
+            return (_mul_by_line(f_, line2), r2)
+
+        f, r = lax.cond(bit == 1, with_add, lambda op: op, (f, r))
         return (f, r), None
 
-    carry = (f, r)
-    i = 0
-    while i < len(_X_BITS):
-        if _X_BITS[i] == "0":
-            j = i
-            while j < len(_X_BITS) and _X_BITS[j] == "0":
-                j += 1
-            run = j - i
-            carry, _ = lax.scan(uniform_step, carry, None, length=run)
-            i = j
-        else:
-            carry, _ = uniform_step(carry, None)
-            f, r = carry
-            r, line = _add_step(r, (xq, yq), xp, yp)
-            f = _mul_by_line(f, line)
-            carry = (f, r)
-            i += 1
-
-    f, r = carry
+    (f, r), _ = lax.scan(step, (f, r), bits_arr)
     # x < 0: conjugate the Miller value.
     f = tw.fq12_conj(f)
     one = jnp.broadcast_to(tw.FQ12_ONE, (n,) + tw.FQ12_ONE.shape)
@@ -161,26 +156,17 @@ def fq12_product(fs):
 
 
 def _cyc_exp_abs_x(a):
-    """a^|x| for cyclotomic a, via Granger-Scott squarings over the static
-    bit pattern of X_ABS (zero-runs scanned, the 5 one-bits unrolled)."""
-    bits = bin(X_ABS)[3:]
+    """a^|x| for cyclotomic a: one scan of Granger-Scott squarings with the
+    multiply for one-bits behind lax.cond (scalar predicate -> single
+    compiled body, no wasted multiplies at runtime)."""
+    bits_arr = jnp.asarray(np.array([int(b) for b in bin(X_ABS)[3:]], np.uint32))
 
-    def sqr_step(acc, _):
-        return tw.fq12_cyclotomic_sqr(acc), None
+    def step(acc, bit):
+        acc = tw.fq12_cyclotomic_sqr(acc)
+        acc = lax.cond(bit == 1, lambda x: tw.fq12_mul(x, a), lambda x: x, acc)
+        return acc, None
 
-    acc = a
-    i = 0
-    while i < len(bits):
-        if bits[i] == "0":
-            j = i
-            while j < len(bits) and bits[j] == "0":
-                j += 1
-            acc, _ = lax.scan(sqr_step, acc, None, length=j - i)
-            i = j
-        else:
-            acc = tw.fq12_cyclotomic_sqr(acc)
-            acc = tw.fq12_mul(acc, a)
-            i += 1
+    acc, _ = lax.scan(step, a, bits_arr)
     return acc
 
 

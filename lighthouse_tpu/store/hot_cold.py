@@ -1,0 +1,159 @@
+"""HotColdDB — split hot/freezer storage for blocks, states and blobs.
+
+Parity surface: /root/reference/beacon_node/store/src/hot_cold_store.rs:50 —
+hot DB holds recent blocks + per-slot state summaries with full states at
+epoch boundaries; the freezer holds finalized block/state roots as chunked
+vectors plus periodic full "restore point" states; blobs live in their own
+column. `migrate_to_freezer` moves finalized data across the split like the
+background migrator (store/src/hot_cold_store.rs migration +
+beacon_chain/src/migrate.rs).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..types.spec import ChainSpec
+from ..types.containers import spec_types
+from .kv import Column, KeyValueOp, KeyValueStore, MemoryStore
+
+CHUNK_SIZE = 128  # roots per freezer chunk (chunked_vector.rs analog)
+
+
+@dataclass
+class StoreConfig:
+    slots_per_restore_point: int = 2048
+    compact_on_migration: bool = True
+
+
+class HotColdDB:
+    def __init__(
+        self,
+        spec: ChainSpec,
+        hot: KeyValueStore | None = None,
+        cold: KeyValueStore | None = None,
+        blobs: KeyValueStore | None = None,
+        config: StoreConfig | None = None,
+    ):
+        self.spec = spec
+        self.hot = hot or MemoryStore()
+        self.cold = cold or MemoryStore()
+        self.blobs_db = blobs or self.hot
+        self.config = config or StoreConfig()
+        self.split_slot = 0  # boundary: slots < split are in the freezer
+
+    # ------------------------------------------------------------- blocks
+
+    def put_block(self, block_root: bytes, signed_block, types) -> None:
+        self.hot.put(Column.block, block_root, types.SignedBeaconBlock.serialize(signed_block))
+
+    def get_block(self, block_root: bytes, types):
+        data = self.hot.get(Column.block, block_root)
+        if data is None:
+            return None
+        return types.SignedBeaconBlock.deserialize(data)
+
+    def block_exists(self, block_root: bytes) -> bool:
+        return self.hot.exists(Column.block, block_root)
+
+    def delete_block(self, block_root: bytes) -> None:
+        self.hot.delete(Column.block, block_root)
+
+    # ------------------------------------------------------------- states
+
+    def put_state(self, state_root: bytes, state, types) -> None:
+        self.hot.put(Column.state, state_root, types.BeaconState.serialize(state))
+        self.hot.put(
+            Column.state_summary,
+            state_root,
+            int(state.slot).to_bytes(8, "little"),
+        )
+
+    def get_state(self, state_root: bytes, types):
+        data = self.hot.get(Column.state, state_root)
+        if data is None:
+            return None
+        return types.BeaconState.deserialize(data)
+
+    def state_exists(self, state_root: bytes) -> bool:
+        return self.hot.exists(Column.state, state_root)
+
+    # ------------------------------------------------------------- blobs
+
+    def put_blobs(self, block_root: bytes, blobs_bytes: bytes) -> None:
+        self.blobs_db.put(Column.blob, block_root, blobs_bytes)
+
+    def get_blobs(self, block_root: bytes) -> bytes | None:
+        return self.blobs_db.get(Column.blob, block_root)
+
+    # ------------------------------------------------------------- chain data
+
+    def put_chain_item(self, key: bytes, value: bytes) -> None:
+        self.hot.put(Column.beacon_chain, key, value)
+
+    def get_chain_item(self, key: bytes) -> bytes | None:
+        return self.hot.get(Column.beacon_chain, key)
+
+    # ------------------------------------------------------------- freezer
+
+    @staticmethod
+    def _chunk_key(kind_index: int) -> bytes:
+        return kind_index.to_bytes(8, "little")
+
+    def _append_root(self, column: Column, slot: int, root: bytes) -> None:
+        chunk_idx = slot // CHUNK_SIZE
+        key = self._chunk_key(chunk_idx)
+        chunk = bytearray(self.cold.get(column, key) or b"")
+        offset = (slot % CHUNK_SIZE) * 32
+        if len(chunk) < offset + 32:
+            chunk.extend(b"\x00" * (offset + 32 - len(chunk)))
+        chunk[offset : offset + 32] = root
+        self.cold.put(column, key, bytes(chunk))
+
+    def _get_root(self, column: Column, slot: int) -> bytes | None:
+        chunk = self.cold.get(column, self._chunk_key(slot // CHUNK_SIZE))
+        if chunk is None:
+            return None
+        off = (slot % CHUNK_SIZE) * 32
+        if len(chunk) < off + 32:
+            return None
+        root = chunk[off : off + 32]
+        return root if root != b"\x00" * 32 else None
+
+    def freezer_block_root_at_slot(self, slot: int) -> bytes | None:
+        return self._get_root(Column.freezer_block_roots, slot)
+
+    def freezer_state_root_at_slot(self, slot: int) -> bytes | None:
+        return self._get_root(Column.freezer_state_roots, slot)
+
+    def migrate_to_freezer(self, finalized_slot: int, chain_iter, types) -> None:
+        """Move blocks/states below `finalized_slot` into the freezer.
+
+        chain_iter: iterable of (slot, block_root, state_root) ascending for
+        the finalized chain segment being migrated."""
+        for slot, block_root, state_root in chain_iter:
+            if slot >= finalized_slot:
+                continue
+            self._append_root(Column.freezer_block_roots, slot, block_root)
+            self._append_root(Column.freezer_state_roots, slot, state_root)
+            # restore points keep the full state
+            if slot % self.config.slots_per_restore_point == 0:
+                data = self.hot.get(Column.state, state_root)
+                if data is not None:
+                    self.cold.put(Column.freezer_chunks, state_root, data)
+            # drop hot state (blocks stay hot for by-root queries until pruned)
+            self.hot.do_atomically(
+                [
+                    KeyValueOp.delete(Column.state, state_root),
+                    KeyValueOp.delete(Column.state_summary, state_root),
+                ]
+            )
+        self.split_slot = max(self.split_slot, finalized_slot)
+        if self.config.compact_on_migration:
+            self.hot.compact()
+
+    def get_restore_point_state(self, state_root: bytes, types):
+        data = self.cold.get(Column.freezer_chunks, state_root)
+        if data is None:
+            return None
+        return types.BeaconState.deserialize(data)

@@ -1,0 +1,142 @@
+"""ctypes binding for the native C++ log-structured KV store.
+
+Builds lib on first use with g++ (cached beside the source); exposes the
+KeyValueStore interface so HotColdDB can run on either MemoryStore (tests)
+or NativeKVStore (production), mirroring how the reference picks
+LevelDB vs MemoryStore behind its KeyValueStore trait."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+from .kv import Column, KeyValueOp, KeyValueStore
+
+_SRC = Path(__file__).parent / "native" / "kv_store.cc"
+_LIB = Path(__file__).parent / "native" / "libltkv.so"
+_build_lock = threading.Lock()
+
+
+def _ensure_built() -> Path:
+    with _build_lock:
+        if _LIB.exists() and _LIB.stat().st_mtime >= _SRC.stat().st_mtime:
+            return _LIB
+        cmd = [
+            "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+            str(_SRC), "-o", str(_LIB),
+        ]
+        subprocess.run(cmd, check=True, capture_output=True)
+        return _LIB
+
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    path = _ensure_built()
+    lib = ctypes.CDLL(str(path))
+    lib.kvs_open.restype = ctypes.c_void_p
+    lib.kvs_open.argtypes = [ctypes.c_char_p]
+    lib.kvs_close.argtypes = [ctypes.c_void_p]
+    lib.kvs_put.restype = ctypes.c_int
+    lib.kvs_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+                            ctypes.c_char_p, ctypes.c_uint32]
+    lib.kvs_delete.restype = ctypes.c_int
+    lib.kvs_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
+    lib.kvs_batch.restype = ctypes.c_int
+    lib.kvs_batch.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
+    lib.kvs_get.restype = ctypes.c_int
+    lib.kvs_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+                            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+                            ctypes.POINTER(ctypes.c_uint32)]
+    lib.kvs_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+    lib.kvs_count.restype = ctypes.c_uint64
+    lib.kvs_count.argtypes = [ctypes.c_void_p]
+    lib.kvs_compact.restype = ctypes.c_int
+    lib.kvs_compact.argtypes = [ctypes.c_void_p]
+    _ITER_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p,
+                                ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint32,
+                                ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint32)
+    lib._ITER_CB = _ITER_CB
+    lib.kvs_iter_prefix.restype = ctypes.c_int
+    lib.kvs_iter_prefix.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+                                    _ITER_CB, ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+def _ckey(column: Column, key: bytes) -> bytes:
+    return column.value.encode() + b":" + key
+
+
+class NativeKVStore(KeyValueStore):
+    """Production store on the C++ backend."""
+
+    def __init__(self, path: str | os.PathLike):
+        lib = _load()
+        os.makedirs(os.path.dirname(os.fspath(path)) or ".", exist_ok=True)
+        self._lib = lib
+        self._h = lib.kvs_open(os.fspath(path).encode())
+        if not self._h:
+            raise OSError(f"cannot open native kv store at {path}")
+
+    def get(self, column: Column, key: bytes) -> bytes | None:
+        k = _ckey(column, key)
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_uint32()
+        rc = self._lib.kvs_get(self._h, k, len(k), ctypes.byref(out), ctypes.byref(out_len))
+        if rc == -1:
+            return None
+        if rc != 0:
+            raise OSError(f"kvs_get failed: {rc}")
+        try:
+            return ctypes.string_at(out, out_len.value)
+        finally:
+            self._lib.kvs_free(out)
+
+    def do_atomically(self, ops: list[KeyValueOp]) -> None:
+        payload = bytearray()
+        for op in ops:
+            k = _ckey(op.column, op.key)
+            v = op.value or b""
+            payload.append(1 if op.kind == "put" else 2)
+            payload += len(k).to_bytes(4, "little")
+            payload += (len(v) if op.kind == "put" else 0).to_bytes(4, "little")
+            payload += k
+            if op.kind == "put":
+                payload += v
+        rc = self._lib.kvs_batch(self._h, bytes(payload), len(payload))
+        if rc != 0:
+            raise OSError(f"kvs_batch failed: {rc}")
+
+    def iter_column(self, column: Column):
+        results: list[tuple[bytes, bytes]] = []
+        prefix = column.value.encode() + b":"
+
+        @self._lib._ITER_CB
+        def cb(_ctx, kptr, klen, vptr, vlen):
+            k = ctypes.string_at(kptr, klen)
+            v = ctypes.string_at(vptr, vlen)
+            results.append((k[len(prefix):], v))
+
+        self._lib.kvs_iter_prefix(self._h, prefix, len(prefix), cb, None)
+        return iter(results)
+
+    def compact(self) -> None:
+        rc = self._lib.kvs_compact(self._h)
+        if rc != 0:
+            raise OSError(f"kvs_compact failed: {rc}")
+
+    def __len__(self):
+        return self._lib.kvs_count(self._h)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.kvs_close(self._h)
+            self._h = None
