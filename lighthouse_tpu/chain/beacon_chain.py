@@ -1,0 +1,396 @@
+"""BeaconChain — chain orchestration: verification pipelines, import, head.
+
+Parity surface (trimmed to the load-bearing paths of
+/root/reference/beacon_node/beacon_chain/src/):
+  - gossip block verification (block_verification.rs GossipVerifiedBlock
+    :639 -> SignatureVerifiedBlock :648): slot/parent/dedup checks, cheap
+    proposer-signature check, then full batch verification on import
+  - process_block / import_block (beacon_chain.rs:3035,:3362): state
+    transition with VERIFY_BULK (one TPU batch per block), store writes,
+    fork-choice on_block, head recompute (canonical_head.rs:473)
+  - attestation verification, single and batched
+    (attestation_verification.rs + batch.rs): committee resolution via the
+    shuffling cache, observed-dedup, batched BLS verify, fork-choice votes
+  - caches: ValidatorPubkeyCache (device feed), ShufflingCache,
+    BeaconProposerCache, observed_* gossip dedup sets
+  - chain-segment processing with ONE signature batch for the whole
+    segment (block_verification.rs:568 signature_verify_chain_segment)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..crypto import bls
+from ..fork_choice.fork_choice import ForkChoice
+from ..state_transition import accessors as acc
+from ..state_transition import signature_sets as sigs
+from ..state_transition.block import (
+    BlockProcessingError,
+    SignatureBatch,
+    SignatureStrategy,
+    per_block_processing,
+)
+from ..state_transition.slot import process_slots, types_for_slot
+from ..store.hot_cold import HotColdDB
+from ..testing.harness import clone_state
+from ..types import helpers as h
+from ..types.spec import ChainSpec, DOMAIN_BEACON_ATTESTER
+from ..utils.slot_clock import SlotClock
+from .pubkey_cache import ValidatorPubkeyCache
+
+
+class BlockError(Exception):
+    """Block rejected (block_verification.rs BlockError analog)."""
+
+
+class AttestationError(Exception):
+    """Attestation rejected (attestation_verification.rs Error analog)."""
+
+
+@dataclass
+class ChainConfig:
+    reorg_threshold_percent: int = 20
+    import_max_skip_slots: int | None = None
+
+
+class ShufflingCache:
+    """(epoch, decision_root) -> CommitteeCache (shuffling_cache.rs)."""
+
+    def __init__(self, capacity: int = 16):
+        self.capacity = capacity
+        self._map: dict[tuple[int, bytes], object] = {}
+
+    def get_or_build(self, state, spec, epoch: int, decision_root: bytes):
+        key = (epoch, decision_root)
+        got = self._map.get(key)
+        if got is None:
+            got = acc.build_committee_cache(state, spec, epoch)
+            if len(self._map) >= self.capacity:
+                self._map.pop(next(iter(self._map)))
+            self._map[key] = got
+        return got
+
+
+class BeaconChain:
+    def __init__(
+        self,
+        spec: ChainSpec,
+        genesis_state,
+        store: HotColdDB | None = None,
+        slot_clock: SlotClock | None = None,
+        config: ChainConfig | None = None,
+    ):
+        from ..utils.slot_clock import ManualSlotClock
+
+        self.spec = spec
+        self.config = config or ChainConfig()
+        self.store = store or HotColdDB(spec)
+        self.slot_clock = slot_clock or ManualSlotClock(
+            genesis_state.genesis_time, spec.seconds_per_slot
+        )
+
+        types = types_for_slot(spec, genesis_state.slot)
+        state_root = types.BeaconState.hash_tree_root(genesis_state)
+        # The anchor block root must match what descendants reference:
+        # hash of the state's latest_block_header with its state_root filled
+        # (the header's body_root may predate fork upgrades, so we must not
+        # rebuild the body ourselves).
+        header = genesis_state.latest_block_header
+        if bytes(header.state_root) == b"\x00" * 32:
+            header = header.copy_with(state_root=state_root)
+        self.genesis_block_root = types.BeaconBlockHeader.hash_tree_root(header)
+        genesis_block = types.BeaconBlock.make(
+            slot=genesis_state.slot,
+            proposer_index=header.proposer_index,
+            parent_root=header.parent_root,
+            state_root=header.state_root,
+            body=types.BeaconBlockBody.default(),
+        )
+        signed_genesis = types.SignedBeaconBlock.make(
+            message=genesis_block, signature=b"\x00" * 96
+        )
+        self.store.put_block(self.genesis_block_root, signed_genesis, types)
+        self.store.put_state(state_root, genesis_state, types)
+
+        self.fork_choice = ForkChoice(
+            spec, self.genesis_block_root, genesis_state.slot, genesis_state
+        )
+        # head state kept in memory (state_cache analog: root -> state)
+        self.state_cache: dict[bytes, object] = {state_root: genesis_state}
+        self.block_slots: dict[bytes, int] = {self.genesis_block_root: genesis_state.slot}
+        self.state_root_by_block: dict[bytes, bytes] = {
+            self.genesis_block_root: state_root
+        }
+        self.head_root = self.genesis_block_root
+
+        self.pubkey_cache = ValidatorPubkeyCache(self.store)
+        self.pubkey_cache.import_new_pubkeys(genesis_state)
+        self.shuffling_cache = ShufflingCache()
+        self.proposer_cache: dict[tuple[int, bytes], list[int]] = {}
+
+        # observed-* gossip dedup (observed_attesters.rs etc.)
+        self.observed_block_producers: set[tuple[int, int]] = set()
+        self.observed_attesters: set[tuple[int, int]] = set()          # (epoch, validator)
+        self.observed_aggregators: set[tuple[int, int]] = set()
+        self.observed_blocks: set[bytes] = set()
+
+    # ---------------------------------------------------------------- time
+
+    @property
+    def current_slot(self) -> int:
+        s = self.slot_clock.now()
+        return s if s is not None else 0
+
+    def per_slot_task(self) -> None:
+        self.fork_choice.on_tick(self.current_slot)
+
+    # ---------------------------------------------------------------- head
+
+    def head_state(self):
+        return self.state_cache[self.state_root_by_block[self.head_root]]
+
+    def head_block(self):
+        types = types_for_slot(self.spec, self.block_slots[self.head_root])
+        return self.store.get_block(self.head_root, types)
+
+    def recompute_head(self) -> bytes:
+        self.fork_choice.on_tick(self.current_slot)
+        head = self.fork_choice.get_head()
+        self.head_root = head
+        return head
+
+    # ------------------------------------------------------------ gossip block
+
+    def verify_block_for_gossip(self, signed_block, block_root=None):
+        """Cheap structural + proposer-signature verification
+        (GossipVerifiedBlock::new analog)."""
+        spec = self.spec
+        block = signed_block.message
+        types = types_for_slot(spec, block.slot)
+        if block_root is None:
+            block_root = types.BeaconBlock.hash_tree_root(block)
+
+        if block.slot > self.current_slot:
+            raise BlockError(f"future block: {block.slot} > {self.current_slot}")
+        if block_root in self.observed_blocks or self.store.block_exists(block_root):
+            raise BlockError("block already known")
+        parent_root = bytes(block.parent_root)
+        if not self.store.block_exists(parent_root):
+            raise BlockError("parent unknown")
+        fin_epoch = self.fork_choice.store.finalized_checkpoint[0]
+        fin_slot = h.compute_start_slot_at_epoch(fin_epoch, spec)
+        if block.slot <= fin_slot:
+            raise BlockError("block older than finalization")
+        key = (block.slot, block.proposer_index)
+        if key in self.observed_block_producers:
+            raise BlockError("proposer equivocation for slot")
+
+        # proposer signature over a cheaply-advanced parent state
+        state = self._state_for_block(parent_root, block.slot)
+        batch = SignatureBatch()
+        batch.add(
+            sigs.block_proposal_set(
+                state, spec, types, signed_block,
+                self.pubkey_cache.pubkey_getter(), block_root=block_root,
+            )
+        )
+        if not batch.verify():
+            raise BlockError("invalid proposer signature")
+
+        self.observed_block_producers.add(key)
+        self.observed_blocks.add(block_root)
+        return block_root
+
+    def _state_for_block(self, parent_root: bytes, slot: int):
+        """Parent post-state advanced to `slot` (cheap_state_advance)."""
+        state_root = self.state_root_by_block.get(parent_root)
+        if state_root is None or state_root not in self.state_cache:
+            raise BlockError("parent state unavailable")
+        state = clone_state(self.state_cache[state_root], self.spec)
+        if state.slot < slot:
+            process_slots(state, self.spec, slot)
+        return state
+
+    # ------------------------------------------------------------ import
+
+    def process_block(
+        self,
+        signed_block,
+        block_root=None,
+        proposal_already_verified: bool = False,
+    ) -> bytes:
+        """Full verification + import (process_block/import_block analog)."""
+        spec = self.spec
+        block = signed_block.message
+        types = types_for_slot(spec, block.slot)
+        if block_root is None:
+            block_root = types.BeaconBlock.hash_tree_root(block)
+        parent_root = bytes(block.parent_root)
+        if not self.store.block_exists(parent_root):
+            raise BlockError("parent unknown")
+
+        state = self._state_for_block(parent_root, block.slot)
+        get_pubkey = self.pubkey_cache.pubkey_getter()
+
+        batch = SignatureBatch()
+        if not proposal_already_verified:
+            batch.add(
+                sigs.block_proposal_set(
+                    state, spec, types, signed_block, get_pubkey, block_root=block_root
+                )
+            )
+
+        # run per-block processing, accumulating the remaining signature sets
+        # into the same batch, then verify EVERYTHING in one device call
+        def handle(s):
+            batch.add(s)
+
+        from ..state_transition import block as blk
+
+        blk.process_block_header(state, spec, types, block)
+        fork = spec.fork_name_at_slot(block.slot)
+        from ..types.spec import ForkName
+
+        if fork >= ForkName.bellatrix:
+            blk.process_withdrawals_and_payload(state, spec, types, block, fork)
+        blk.process_randao(
+            state, spec, types, block, SignatureStrategy.VERIFY_BULK, handle, get_pubkey
+        )
+        blk.process_eth1_data(state, spec, types, block.body)
+        blk.process_operations(state, spec, types, block, fork, handle, get_pubkey)
+        if fork >= ForkName.altair:
+            blk.process_sync_aggregate(state, spec, types, block, handle, get_pubkey)
+
+        if not batch.verify():
+            raise BlockError("block signature batch invalid")
+
+        state_root = types.BeaconState.hash_tree_root(state)
+        if bytes(block.state_root) != state_root:
+            raise BlockError("state root mismatch")
+
+        # import: store + caches + fork choice
+        self.store.put_block(block_root, signed_block, types)
+        self.store.put_state(state_root, state, types)
+        self.state_cache[state_root] = state
+        self.block_slots[block_root] = block.slot
+        self.state_root_by_block[block_root] = state_root
+        self.pubkey_cache.import_new_pubkeys(state)
+
+        timely = self.current_slot == block.slot
+        self.fork_choice.on_block(signed_block, block_root, state, is_timely=timely)
+        self.recompute_head()
+        self._prune_state_cache()
+        return block_root
+
+    def process_chain_segment(self, blocks) -> list[bytes]:
+        """Import a batch of contiguous blocks with ONE signature batch for
+        the whole segment (signature_verify_chain_segment analog)."""
+        if not blocks:
+            return []
+        spec = self.spec
+        get_pubkey = self.pubkey_cache.pubkey_getter()
+        # 1. one pass building proposal sets against cheaply-advanced states
+        batch = SignatureBatch()
+        state = self._state_for_block(bytes(blocks[0].message.parent_root), blocks[0].message.slot)
+        trial = clone_state(state, spec)
+        for sb in blocks:
+            types = types_for_slot(spec, sb.message.slot)
+            if trial.slot < sb.message.slot:
+                process_slots(trial, spec, sb.message.slot)
+            batch.add(sigs.block_proposal_set(trial, spec, types, sb, get_pubkey))
+            batch.add(sigs.randao_set(trial, spec, types, sb.message, get_pubkey))
+        if not batch.verify():
+            raise BlockError("chain segment signature batch invalid")
+        # 2. sequential import without re-verifying proposal signatures
+        roots = []
+        for sb in blocks:
+            roots.append(self.process_block(sb, proposal_already_verified=True))
+        return roots
+
+    def _prune_state_cache(self, keep: int = 8):
+        if len(self.state_cache) <= keep:
+            return
+        # keep the most recent states by slot
+        by_slot = sorted(
+            self.state_cache.items(), key=lambda kv: kv[1].slot, reverse=True
+        )
+        self.state_cache = dict(by_slot[:keep])
+
+    # ------------------------------------------------------------ attestations
+
+    def _committee_for(self, data):
+        spec = self.spec
+        epoch = data.target.epoch
+        head_state = self.head_state()
+        cache = self.shuffling_cache.get_or_build(
+            self._attestation_state(data), spec, epoch, bytes(data.target.root)
+        )
+        if data.index >= cache.committees_per_slot:
+            raise AttestationError("bad committee index")
+        return cache.committee(data.slot, data.index)
+
+    def _attestation_state(self, data):
+        """A state usable to compute the committee for `data`."""
+        target_root = bytes(data.target.root)
+        state_root = self.state_root_by_block.get(target_root)
+        if state_root and state_root in self.state_cache:
+            return self.state_cache[state_root]
+        return self.head_state()
+
+    def verify_unaggregated_attestations(self, attestations) -> list:
+        """Batch gossip verification (batch_verify_unaggregated_attestations,
+        attestation_verification/batch.rs:140). Returns list of
+        (attestation, attesting_indices) that verified; raises only on
+        per-batch failures of structure, not on individual invalid sigs —
+        on batch failure falls back to per-set verification, exactly like
+        the reference (:213-221)."""
+        spec = self.spec
+        get_pubkey = self.pubkey_cache.pubkey_getter()
+        prepared = []
+        sets = []
+        for att in attestations:
+            data = att.data
+            epoch = data.target.epoch
+            if data.target.epoch not in (
+                h.compute_epoch_at_slot(data.slot, spec),
+            ):
+                continue
+            committee = self._committee_for(data)
+            if len(att.aggregation_bits) != len(committee):
+                continue
+            attesting = [i for i, b in zip(committee, att.aggregation_bits) if b]
+            if len(attesting) != 1:
+                continue  # unaggregated = exactly one bit
+            if (epoch, attesting[0]) in self.observed_attesters:
+                continue
+            state = self._attestation_state(data)
+            types = types_for_slot(spec, data.slot)
+            indexed = types.IndexedAttestation.make(
+                attesting_indices=attesting, data=data, signature=att.signature
+            )
+            try:
+                s = sigs.indexed_attestation_set(state, spec, types, indexed, get_pubkey)
+            except sigs.SignatureSetError:
+                continue
+            prepared.append((att, attesting, s))
+            sets.append(s)
+
+        if not sets:
+            return []
+        ok = bls.verify_signature_sets(sets)
+        results = []
+        for att, attesting, s in prepared:
+            valid = ok or bls.verify_signature_sets([s])
+            if valid:
+                self.observed_attesters.add((att.data.target.epoch, attesting[0]))
+                results.append((att, attesting))
+        return results
+
+    def apply_attestation_to_fork_choice(self, att, attesting_indices):
+        self.fork_choice.on_attestation(
+            att.data.slot,
+            attesting_indices,
+            bytes(att.data.beacon_block_root),
+            att.data.target.epoch,
+        )

@@ -1,0 +1,64 @@
+"""BeaconProcessor scheduler tests: priority order, batch coalescing,
+bounded queues, threaded pump."""
+
+import threading
+import time
+
+from lighthouse_tpu.chain.beacon_processor import (
+    BeaconProcessor,
+    BeaconProcessorConfig,
+    WorkItem,
+    WorkKind,
+)
+
+
+def test_priority_order():
+    bp = BeaconProcessor()
+    order = []
+    bp.submit(WorkItem(WorkKind.gossip_attestation, payload=1, run_batch=lambda xs: order.append(("att", xs))))
+    bp.submit(WorkItem(WorkKind.gossip_block, run=lambda: order.append(("block", None))))
+    bp.submit(WorkItem(WorkKind.chain_segment, run=lambda: order.append(("segment", None))))
+    bp.run_until_idle()
+    assert [x[0] for x in order] == ["block", "att", "segment"]
+
+
+def test_attestation_batch_coalescing():
+    bp = BeaconProcessor(BeaconProcessorConfig(max_attestation_batch=10))
+    got = []
+    for i in range(25):
+        bp.submit(WorkItem(WorkKind.gossip_attestation, payload=i, run_batch=lambda xs: got.append(list(xs))))
+    bp.run_until_idle()
+    assert [len(b) for b in got] == [10, 10, 5]
+    assert sorted(x for b in got for x in b) == list(range(25))
+    assert bp.batches_formed >= 2
+
+
+def test_bounded_queue_drops():
+    bp = BeaconProcessor()
+    bp.max_lengths[WorkKind.gossip_block] = 2
+    assert bp.submit(WorkItem(WorkKind.gossip_block, run=lambda: None))
+    assert bp.submit(WorkItem(WorkKind.gossip_block, run=lambda: None))
+    assert not bp.submit(WorkItem(WorkKind.gossip_block, run=lambda: None))
+    assert bp.dropped[WorkKind.gossip_block] == 1
+
+
+def test_threaded_pump():
+    bp = BeaconProcessor(BeaconProcessorConfig(num_workers=2, max_attestation_batch=8))
+    done = threading.Event()
+    count = [0]
+    lock = threading.Lock()
+
+    def on_batch(xs):
+        with lock:
+            count[0] += len(xs)
+            if count[0] >= 100:
+                done.set()
+
+    bp.start()
+    try:
+        for i in range(100):
+            bp.submit(WorkItem(WorkKind.gossip_attestation, payload=i, run_batch=on_batch))
+        assert done.wait(timeout=5)
+    finally:
+        bp.stop()
+    assert count[0] == 100
