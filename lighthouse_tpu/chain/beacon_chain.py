@@ -189,12 +189,15 @@ class BeaconChain:
         # proposer signature over a cheaply-advanced parent state
         state = self._state_for_block(parent_root, block.slot)
         batch = SignatureBatch()
-        batch.add(
-            sigs.block_proposal_set(
-                state, spec, types, signed_block,
-                self.pubkey_cache.pubkey_getter(), block_root=block_root,
+        try:
+            batch.add(
+                sigs.block_proposal_set(
+                    state, spec, types, signed_block,
+                    self.pubkey_cache.pubkey_getter(), block_root=block_root,
+                )
             )
-        )
+        except sigs.SignatureSetError as e:
+            raise BlockError(f"undecodable signature: {e}") from e
         if not batch.verify():
             raise BlockError("invalid proposer signature")
 
@@ -248,19 +251,24 @@ class BeaconChain:
 
         from ..state_transition import block as blk
 
-        blk.process_block_header(state, spec, types, block)
-        fork = spec.fork_name_at_slot(block.slot)
-        from ..types.spec import ForkName
+        try:
+            blk.process_block_header(state, spec, types, block)
+            fork = spec.fork_name_at_slot(block.slot)
+            from ..types.spec import ForkName
 
-        if fork >= ForkName.bellatrix:
-            blk.process_withdrawals_and_payload(state, spec, types, block, fork)
-        blk.process_randao(
-            state, spec, types, block, SignatureStrategy.VERIFY_BULK, handle, get_pubkey
-        )
-        blk.process_eth1_data(state, spec, types, block.body)
-        blk.process_operations(state, spec, types, block, fork, handle, get_pubkey)
-        if fork >= ForkName.altair:
-            blk.process_sync_aggregate(state, spec, types, block, handle, get_pubkey)
+            if fork >= ForkName.bellatrix:
+                blk.process_withdrawals_and_payload(state, spec, types, block, fork)
+            blk.process_randao(
+                state, spec, types, block, SignatureStrategy.VERIFY_BULK, handle, get_pubkey
+            )
+            blk.process_eth1_data(state, spec, types, block.body)
+            blk.process_operations(state, spec, types, block, fork, handle, get_pubkey)
+            if fork >= ForkName.altair:
+                blk.process_sync_aggregate(state, spec, types, block, handle, get_pubkey)
+        except sigs.SignatureSetError as e:
+            raise BlockError(f"undecodable signature: {e}") from e
+        except BlockProcessingError as e:
+            raise BlockError(str(e)) from e
 
         if not batch.verify():
             raise BlockError("block signature batch invalid")

@@ -1,0 +1,191 @@
+"""OperationPool — pending operations + greedy max-cover attestation packing.
+
+Parity surface: /root/reference/beacon_node/operation_pool/src/lib.rs:50
+(pools for attestations, slashings, exits, BLS changes, sync contributions),
+attestation_storage.rs (attestations stored split by data with compact
+participation sets) and max_cover.rs (greedy weighted maximum-coverage
+packing of aggregates into the block's MAX_ATTESTATIONS slots).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+from ..state_transition import accessors as acc
+from ..types import helpers as h
+from ..types.spec import ChainSpec
+
+
+@dataclass
+class PooledAttestation:
+    data_key: bytes              # hash_tree_root(AttestationData)
+    data: object
+    aggregation_bits: tuple[bool, ...]
+    signature: bytes
+    attesting_indices: frozenset[int]
+
+
+def max_cover(items: list[tuple[frozenset, float, object]], limit: int) -> list[object]:
+    """Greedy weighted max-cover (max_cover.rs MaximumCover analog):
+    items are (element_set, weight_per_element..., payload). Picks up to
+    `limit` payloads maximizing newly-covered elements; re-scores each round."""
+    chosen = []
+    covered: set = set()
+    remaining = list(items)
+    for _ in range(limit):
+        best = None
+        best_gain = 0
+        for entry in remaining:
+            elems, weight, _payload = entry
+            gain = sum(weight for e in elems if e not in covered)
+            if gain > best_gain:
+                best_gain = gain
+                best = entry
+        if best is None:
+            break
+        covered |= set(best[0])
+        chosen.append(best[2])
+        remaining.remove(best)
+    return chosen
+
+
+class OperationPool:
+    def __init__(self, spec: ChainSpec):
+        self.spec = spec
+        # data_key -> list[PooledAttestation] (attestation_storage analog)
+        self.attestations: dict[bytes, list[PooledAttestation]] = defaultdict(list)
+        self.attestation_data: dict[bytes, object] = {}
+        self.proposer_slashings: dict[int, object] = {}
+        self.attester_slashings: list[object] = []
+        self.voluntary_exits: dict[int, object] = {}
+        self.bls_changes: dict[int, object] = {}
+        self.sync_contributions: dict[tuple[int, bytes, int], object] = {}
+
+    # ------------------------------------------------------------- inserts
+
+    def insert_attestation(self, att, attesting_indices, types) -> None:
+        key = types.AttestationData.hash_tree_root(att.data)
+        entry = PooledAttestation(
+            data_key=key,
+            data=att.data,
+            aggregation_bits=tuple(att.aggregation_bits),
+            signature=bytes(att.signature),
+            attesting_indices=frozenset(attesting_indices),
+        )
+        bucket = self.attestations[key]
+        # drop if strictly covered by an existing aggregate
+        for existing in bucket:
+            if entry.attesting_indices <= existing.attesting_indices:
+                return
+        bucket[:] = [
+            e for e in bucket if not (e.attesting_indices < entry.attesting_indices)
+        ]
+        bucket.append(entry)
+        self.attestation_data[key] = att.data
+
+    def insert_proposer_slashing(self, slashing) -> None:
+        self.proposer_slashings[slashing.signed_header_1.message.proposer_index] = slashing
+
+    def insert_attester_slashing(self, slashing) -> None:
+        self.attester_slashings.append(slashing)
+
+    def insert_voluntary_exit(self, signed_exit) -> None:
+        self.voluntary_exits[signed_exit.message.validator_index] = signed_exit
+
+    def insert_bls_change(self, signed_change) -> None:
+        self.bls_changes[signed_change.message.validator_index] = signed_change
+
+    # ------------------------------------------------------------- packing
+
+    def get_attestations_for_block(self, state, types) -> list:
+        """Greedy max-cover packing into MAX_ATTESTATIONS
+        (lib.rs:252-343 analog). Weight = effective-balance-weighted new
+        coverage of (epoch, validator) pairs not yet on chain (approximated
+        by participation flags)."""
+        spec = self.spec
+        current_epoch = acc.get_current_epoch(state, spec)
+        previous_epoch = acc.get_previous_epoch(state, spec)
+        items = []
+        for key, bucket in self.attestations.items():
+            data = self.attestation_data[key]
+            if data.target.epoch not in (previous_epoch, current_epoch):
+                continue
+            if not (
+                data.slot + spec.min_attestation_inclusion_delay
+                <= state.slot
+                <= data.slot + spec.preset.SLOTS_PER_EPOCH
+            ):
+                continue
+            participation = (
+                state.current_epoch_participation
+                if data.target.epoch == current_epoch
+                else state.previous_epoch_participation
+            )
+            for entry in bucket:
+                fresh = frozenset(
+                    i
+                    for i in entry.attesting_indices
+                    if not acc.has_flag(participation[i], acc.TIMELY_TARGET_FLAG_INDEX)
+                )
+                if not fresh:
+                    continue
+                items.append((fresh, 1.0, entry))
+        chosen = max_cover(items, spec.preset.MAX_ATTESTATIONS)
+        out = []
+        for entry in chosen:
+            out.append(
+                types.Attestation.make(
+                    aggregation_bits=list(entry.aggregation_bits),
+                    data=entry.data,
+                    signature=entry.signature,
+                )
+            )
+        return out
+
+    def get_slashings_and_exits(self, state, types):
+        spec = self.spec
+        epoch = acc.get_current_epoch(state, spec)
+        proposer_slashings = [
+            s
+            for s in self.proposer_slashings.values()
+            if h.is_slashable_validator(
+                state.validators[s.signed_header_1.message.proposer_index], epoch
+            )
+        ][: spec.preset.MAX_PROPOSER_SLASHINGS]
+        attester_slashings = self.attester_slashings[: spec.preset.MAX_ATTESTER_SLASHINGS]
+        exits = [
+            e
+            for e in self.voluntary_exits.values()
+            if state.validators[e.message.validator_index].exit_epoch == 2**64 - 1
+        ][: spec.preset.MAX_VOLUNTARY_EXITS]
+        changes = list(self.bls_changes.values())[
+            : spec.preset.MAX_BLS_TO_EXECUTION_CHANGES
+        ]
+        return proposer_slashings, attester_slashings, exits, changes
+
+    # ------------------------------------------------------------- pruning
+
+    def prune(self, state) -> None:
+        """Drop operations no longer includable (persistence.rs prune path)."""
+        spec = self.spec
+        current_epoch = acc.get_current_epoch(state, spec)
+        keep = {}
+        for key, bucket in self.attestations.items():
+            data = self.attestation_data[key]
+            if data.target.epoch + 1 >= current_epoch:
+                keep[key] = bucket
+        self.attestations = defaultdict(list, keep)
+        self.attestation_data = {
+            k: v for k, v in self.attestation_data.items() if k in keep
+        }
+        self.voluntary_exits = {
+            i: e
+            for i, e in self.voluntary_exits.items()
+            if state.validators[i].exit_epoch == 2**64 - 1
+        }
+        self.proposer_slashings = {
+            i: s
+            for i, s in self.proposer_slashings.items()
+            if not state.validators[i].slashed
+        }

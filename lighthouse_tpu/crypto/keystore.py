@@ -1,0 +1,224 @@
+"""EIP-2335 encrypted BLS keystores (scrypt / pbkdf2 + AES-128-CTR).
+
+Parity surface: /root/reference/crypto/eth2_keystore — JSON keystore
+create/decrypt with checksum verification. AES-128-CTR is implemented
+locally over hashlib/hmac primitives (CTR mode needs only the forward AES
+block function; a compact pure-Python AES core is embedded — keystore
+encryption is not a hot path).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import secrets
+import unicodedata
+import uuid
+
+# ------------------------------------------------------------ AES-128 core
+
+_SBOX = None
+
+
+def _build_sbox():
+    global _SBOX
+    if _SBOX is not None:
+        return _SBOX
+    # standard AES S-box generation
+    p = q = 1
+    sbox = [0] * 256
+    while True:
+        # multiply p by 3 in GF(2^8)
+        p = p ^ ((p << 1) & 0xFF) ^ (0x1B if p & 0x80 else 0)
+        # divide q by 3
+        q ^= (q << 1) & 0xFF
+        q ^= (q << 2) & 0xFF
+        q ^= (q << 4) & 0xFF
+        if q & 0x80:
+            q ^= 0x09
+        x = q ^ ((q << 1) | (q >> 7)) & 0xFF ^ ((q << 2) | (q >> 6)) & 0xFF ^ (
+            (q << 3) | (q >> 5)
+        ) & 0xFF ^ ((q << 4) | (q >> 4)) & 0xFF
+        sbox[p] = (x ^ 0x63) & 0xFF
+        if p == 1:
+            break
+    sbox[0] = 0x63
+    _SBOX = sbox
+    return sbox
+
+
+_RCON = [0x01, 0x02, 0x04, 0x08, 0x10, 0x20, 0x40, 0x80, 0x1B, 0x36]
+
+
+def _xtime(a):
+    a <<= 1
+    if a & 0x100:
+        a ^= 0x11B
+    return a & 0xFF
+
+
+def _expand_key(key: bytes):
+    sbox = _build_sbox()
+    w = [list(key[i : i + 4]) for i in range(0, 16, 4)]
+    for i in range(4, 44):
+        t = list(w[i - 1])
+        if i % 4 == 0:
+            t = t[1:] + t[:1]
+            t = [sbox[b] for b in t]
+            t[0] ^= _RCON[i // 4 - 1]
+        w.append([a ^ b for a, b in zip(w[i - 4], t)])
+    return w
+
+
+def _aes128_block(key_sched, block: bytes) -> bytes:
+    sbox = _build_sbox()
+    s = [list(block[i::4]) for i in range(4)]  # column-major state
+
+    def add_round_key(rnd):
+        for c in range(4):
+            for r in range(4):
+                s[r][c] ^= key_sched[rnd * 4 + c][r]
+
+    def sub_shift():
+        for r in range(4):
+            row = [sbox[b] for b in s[r]]
+            s[r] = row[r:] + row[:r]
+
+    def mix():
+        for c in range(4):
+            a = [s[r][c] for r in range(4)]
+            s[0][c] = _xtime(a[0]) ^ _xtime(a[1]) ^ a[1] ^ a[2] ^ a[3]
+            s[1][c] = a[0] ^ _xtime(a[1]) ^ _xtime(a[2]) ^ a[2] ^ a[3]
+            s[2][c] = a[0] ^ a[1] ^ _xtime(a[2]) ^ _xtime(a[3]) ^ a[3]
+            s[3][c] = _xtime(a[0]) ^ a[0] ^ a[1] ^ a[2] ^ _xtime(a[3])
+
+    add_round_key(0)
+    for rnd in range(1, 10):
+        sub_shift()
+        mix()
+        add_round_key(rnd)
+    sub_shift()
+    add_round_key(10)
+    out = bytearray(16)
+    for c in range(4):
+        for r in range(4):
+            out[c * 4 + r] = s[r][c]
+    return bytes(out)
+
+
+def aes128_ctr(key: bytes, iv: bytes, data: bytes) -> bytes:
+    sched = _expand_key(key)
+    out = bytearray()
+    counter = int.from_bytes(iv, "big")
+    for i in range(0, len(data), 16):
+        ks = _aes128_block(sched, counter.to_bytes(16, "big"))
+        chunk = data[i : i + 16]
+        out += bytes(a ^ b for a, b in zip(chunk, ks))
+        counter = (counter + 1) % (1 << 128)
+    return bytes(out)
+
+
+# ------------------------------------------------------------ keystore
+
+
+def _normalize_password(password: str) -> bytes:
+    norm = unicodedata.normalize("NFKD", password)
+    stripped = "".join(c for c in norm if not (ord(c) < 0x20 or 0x7F <= ord(c) <= 0x9F))
+    return stripped.encode("utf-8")
+
+
+def _derive_key(password: bytes, kdf: dict) -> bytes:
+    params = kdf["params"]
+    if kdf["function"] == "scrypt":
+        return hashlib.scrypt(
+            password,
+            salt=bytes.fromhex(params["salt"]),
+            n=params["n"],
+            r=params["r"],
+            p=params["p"],
+            dklen=params["dklen"],
+            maxmem=2**31 - 1,
+        )
+    if kdf["function"] == "pbkdf2":
+        return hashlib.pbkdf2_hmac(
+            "sha256",
+            password,
+            bytes.fromhex(params["salt"]),
+            params["c"],
+            dklen=params["dklen"],
+        )
+    raise ValueError(f"unsupported kdf {kdf['function']}")
+
+
+def encrypt_keystore(
+    secret: bytes,
+    password: str,
+    pubkey_hex: str = "",
+    path: str = "",
+    kdf_function: str = "scrypt",
+    kdf_params: dict | None = None,
+) -> dict:
+    pw = _normalize_password(password)
+    salt = secrets.token_bytes(32)
+    if kdf_function == "scrypt":
+        params = kdf_params or {"n": 262144, "r": 8, "p": 1}
+        kdf = {
+            "function": "scrypt",
+            "params": {**params, "dklen": 32, "salt": salt.hex()},
+            "message": "",
+        }
+    else:
+        params = kdf_params or {"c": 262144, "prf": "hmac-sha256"}
+        kdf = {
+            "function": "pbkdf2",
+            "params": {**params, "dklen": 32, "salt": salt.hex()},
+            "message": "",
+        }
+    dk = _derive_key(pw, kdf)
+    iv = secrets.token_bytes(16)
+    cipher_text = aes128_ctr(dk[:16], iv, secret)
+    checksum = hashlib.sha256(dk[16:32] + cipher_text).digest()
+    return {
+        "crypto": {
+            "kdf": kdf,
+            "checksum": {"function": "sha256", "params": {}, "message": checksum.hex()},
+            "cipher": {
+                "function": "aes-128-ctr",
+                "params": {"iv": iv.hex()},
+                "message": cipher_text.hex(),
+            },
+        },
+        "description": "",
+        "pubkey": pubkey_hex,
+        "path": path,
+        "uuid": str(uuid.uuid4()),
+        "version": 4,
+    }
+
+
+class KeystoreError(Exception):
+    pass
+
+
+def decrypt_keystore(keystore: dict, password: str) -> bytes:
+    crypto = keystore["crypto"]
+    pw = _normalize_password(password)
+    dk = _derive_key(pw, crypto["kdf"])
+    cipher_text = bytes.fromhex(crypto["cipher"]["message"])
+    checksum = hashlib.sha256(dk[16:32] + cipher_text).digest()
+    if checksum.hex() != crypto["checksum"]["message"]:
+        raise KeystoreError("invalid password (checksum mismatch)")
+    iv = bytes.fromhex(crypto["cipher"]["params"]["iv"])
+    return aes128_ctr(dk[:16], iv, cipher_text)
+
+
+def save_keystore(keystore: dict, path) -> None:
+    with open(path, "w") as f:
+        json.dump(keystore, f, indent=2)
+    os.chmod(path, 0o600)
+
+
+def load_keystore(path) -> dict:
+    with open(path) as f:
+        return json.load(f)

@@ -1,0 +1,68 @@
+"""EIP-2333 key derivation + EIP-2335 keystore tests.
+
+Known-answer vectors: EIP-2333 test case 0 (from the EIP), NIST SP 800-38A
+CTR-AES128 block 1 for the embedded AES core.
+"""
+
+import pytest
+
+from lighthouse_tpu.crypto import key_derivation as kd
+from lighthouse_tpu.crypto.keystore import (
+    KeystoreError,
+    aes128_ctr,
+    decrypt_keystore,
+    encrypt_keystore,
+)
+
+
+def test_eip2333_case0():
+    seed = bytes.fromhex(
+        "c55257c360c07c72029aebc1b53c05ed0362ada38ead3e3e9efa3708e53495531f09a6"
+        "987599d18264c1e1c92f2cf141630c7a3c4ab7c81b2f001698e7463b04"
+    )
+    master = kd.derive_master_sk(seed)
+    assert master == 6083874454709270928345386274498605044986640685124978867557563392430687146096
+    child = kd.derive_child_sk(master, 0)
+    assert child == 20397789859736650942317412262472558107875392172444076792671091975210932703118
+
+
+def test_derive_path_matches_manual():
+    seed = b"\x42" * 32
+    sk = kd.derive_path(seed, "m/12381/3600/0/0/0")
+    manual = kd.derive_master_sk(seed)
+    for idx in (12381, 3600, 0, 0, 0):
+        manual = kd.derive_child_sk(manual, idx)
+    assert sk == manual
+    assert kd.validator_signing_key_path(7) == "m/12381/3600/7/0/0"
+
+
+def test_nist_aes128_ctr_vector():
+    key = bytes.fromhex("2b7e151628aed2a6abf7158809cf4f3c")
+    iv = bytes.fromhex("f0f1f2f3f4f5f6f7f8f9fafbfcfdfeff")
+    pt = bytes.fromhex("6bc1bee22e409f96e93d7e117393172a")
+    ct = aes128_ctr(key, iv, pt)
+    assert ct.hex() == "874d6191b620e3261bef6864990db6ce"
+    # roundtrip
+    assert aes128_ctr(key, iv, ct) == pt
+
+
+@pytest.mark.parametrize("kdf", ["pbkdf2", "scrypt"])
+def test_keystore_roundtrip(kdf):
+    secret = bytes.fromhex(
+        "000000000019d6689c085ae165831e934ff763ae46a2a6c172b3f1b60a8ce26f"
+    )
+    params = {"c": 16, "prf": "hmac-sha256"} if kdf == "pbkdf2" else {"n": 16, "r": 8, "p": 1}
+    ks = encrypt_keystore(secret, "testpassword", kdf_function=kdf, kdf_params=params)
+    assert ks["version"] == 4
+    assert decrypt_keystore(ks, "testpassword") == secret
+    with pytest.raises(KeystoreError):
+        decrypt_keystore(ks, "wrong")
+
+
+def test_password_nfkd_control_strip():
+    secret = b"\x11" * 32
+    ks = encrypt_keystore(
+        secret, "pass\x00word", kdf_function="pbkdf2", kdf_params={"c": 16, "prf": "hmac-sha256"}
+    )
+    # control chars are stripped per EIP-2335
+    assert decrypt_keystore(ks, "password") == secret

@@ -1,0 +1,88 @@
+"""Operation pool: max-cover packing, aggregate dedup/supersede, pruning.
+Modeled on the reference's op-pool unit tests (operation_pool/src/lib.rs
+test module, incl. the max-cover cases of max_cover.rs)."""
+
+import pytest
+
+from lighthouse_tpu.chain.op_pool import OperationPool, max_cover
+from lighthouse_tpu.types.containers import spec_types
+from lighthouse_tpu.types.spec import ForkName, MINIMAL_PRESET, minimal_spec
+
+
+def test_max_cover_prefers_new_coverage():
+    items = [
+        (frozenset({1, 2, 3}), 1.0, "a"),
+        (frozenset({3, 4}), 1.0, "b"),
+        (frozenset({4, 5, 6, 7}), 1.0, "c"),
+    ]
+    # first pick c (4 new), then a (3 new), then b (0 new -> dropped)
+    assert max_cover(items, 3) == ["c", "a"]
+
+
+def test_max_cover_respects_limit():
+    items = [(frozenset({i}), 1.0, i) for i in range(10)]
+    assert len(max_cover(items, 4)) == 4
+
+
+def _mk_att(types, committee_bits, slot=9, index=0):
+    data = types.AttestationData.make(
+        slot=slot,
+        index=index,
+        beacon_block_root=b"\x01" * 32,
+        source=types.Checkpoint.make(epoch=0, root=b"\x02" * 32),
+        target=types.Checkpoint.make(epoch=1, root=b"\x03" * 32),
+    )
+    return types.Attestation.make(
+        aggregation_bits=committee_bits, data=data, signature=b"\x0c" * 96
+    )
+
+
+def test_aggregate_supersede():
+    spec = minimal_spec()
+    types = spec_types(MINIMAL_PRESET, ForkName.deneb)
+    pool = OperationPool(spec)
+    small = _mk_att(types, [True, False, False, False])
+    big = _mk_att(types, [True, True, True, False])
+    pool.insert_attestation(small, [10], types)
+    pool.insert_attestation(big, [10, 11, 12], types)
+    bucket = next(iter(pool.attestations.values()))
+    assert len(bucket) == 1 and bucket[0].attesting_indices == frozenset({10, 11, 12})
+    # subset insert is a no-op
+    pool.insert_attestation(small, [10], types)
+    assert len(bucket) == 1
+
+
+def test_packing_skips_already_covered():
+    spec = minimal_spec()
+    types = spec_types(MINIMAL_PRESET, ForkName.deneb)
+    pool = OperationPool(spec)
+    st = types.BeaconState.default()
+    st.slot = 10
+    st.validators = [types.Validator.default() for _ in range(8)]
+    st.current_epoch_participation = [0] * 8
+    st.previous_epoch_participation = [0] * 8
+    # validator 3 already has target participation
+    from lighthouse_tpu.state_transition import accessors as acc
+
+    st.previous_epoch_participation[3] = acc.add_flag(0, acc.TIMELY_TARGET_FLAG_INDEX)
+
+    a1 = _mk_att(types, [True, True, False, False])  # validators {2,3}
+    pool.insert_attestation(a1, [2, 3], types)
+    a2 = _mk_att(types, [False, False, True, True], index=1)  # validators {4,5}
+    pool.insert_attestation(a2, [4, 5], types)
+    packed = pool.get_attestations_for_block(st, types)
+    # both still packed (a1 has one fresh validator), a2 first (2 fresh)
+    assert len(packed) == 2
+
+
+def test_prune_drops_stale():
+    spec = minimal_spec()
+    types = spec_types(MINIMAL_PRESET, ForkName.deneb)
+    pool = OperationPool(spec)
+    old = _mk_att(types, [True, False, False, False], slot=1)
+    # target epoch 1; prune at epoch 40
+    pool.insert_attestation(old, [1], types)
+    st = types.BeaconState.default()
+    st.slot = 40 * spec.preset.SLOTS_PER_EPOCH
+    pool.prune(st)
+    assert not pool.attestations
