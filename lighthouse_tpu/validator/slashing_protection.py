@@ -1,0 +1,228 @@
+"""Slashing-protection database: SQLite guards + EIP-3076 interchange.
+
+Parity surface: /root/reference/validator_client/slashing_protection/src/
+slashing_database.rs (per-pubkey min/max slot & epoch guards enforced in a
+single transaction per signing) and interchange.rs (EIP-3076 import/export,
+including minification semantics on import).
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+
+
+class SlashingProtectionError(Exception):
+    """Refusing to sign (slashable or below low-watermark)."""
+
+
+class NotRegistered(SlashingProtectionError):
+    pass
+
+
+class SlashingDatabase:
+    def __init__(self, path: str = ":memory:"):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._conn:
+            self._conn.execute(
+                """CREATE TABLE IF NOT EXISTS validators (
+                       id INTEGER PRIMARY KEY,
+                       public_key BLOB UNIQUE NOT NULL)"""
+            )
+            self._conn.execute(
+                """CREATE TABLE IF NOT EXISTS signed_blocks (
+                       validator_id INTEGER NOT NULL REFERENCES validators(id),
+                       slot INTEGER NOT NULL,
+                       signing_root BLOB,
+                       UNIQUE (validator_id, slot))"""
+            )
+            self._conn.execute(
+                """CREATE TABLE IF NOT EXISTS signed_attestations (
+                       validator_id INTEGER NOT NULL REFERENCES validators(id),
+                       source_epoch INTEGER NOT NULL,
+                       target_epoch INTEGER NOT NULL,
+                       signing_root BLOB,
+                       UNIQUE (validator_id, target_epoch))"""
+            )
+
+    # ------------------------------------------------------------- admin
+
+    def register_validator(self, pubkey: bytes) -> None:
+        with self._lock, self._conn:
+            self._conn.execute(
+                "INSERT OR IGNORE INTO validators (public_key) VALUES (?)", (pubkey,)
+            )
+
+    def _validator_id(self, pubkey: bytes) -> int:
+        row = self._conn.execute(
+            "SELECT id FROM validators WHERE public_key = ?", (pubkey,)
+        ).fetchone()
+        if row is None:
+            raise NotRegistered(f"validator {pubkey.hex()[:16]} not registered")
+        return row[0]
+
+    def is_registered(self, pubkey: bytes) -> bool:
+        return (
+            self._conn.execute(
+                "SELECT 1 FROM validators WHERE public_key = ?", (pubkey,)
+            ).fetchone()
+            is not None
+        )
+
+    # ------------------------------------------------------------- blocks
+
+    def check_and_insert_block_proposal(
+        self, pubkey: bytes, slot: int, signing_root: bytes
+    ) -> None:
+        """Atomically check + record a proposal (slashing_database.rs
+        check_and_insert_block_proposal)."""
+        with self._lock, self._conn:
+            vid = self._validator_id(pubkey)
+            row = self._conn.execute(
+                "SELECT signing_root FROM signed_blocks WHERE validator_id=? AND slot=?",
+                (vid, slot),
+            ).fetchone()
+            if row is not None:
+                if row[0] == signing_root:
+                    return  # same block re-signed: fine
+                raise SlashingProtectionError(f"double block proposal at slot {slot}")
+            mx = self._conn.execute(
+                "SELECT MAX(slot) FROM signed_blocks WHERE validator_id=?", (vid,)
+            ).fetchone()[0]
+            if mx is not None and slot <= mx:
+                raise SlashingProtectionError(
+                    f"slot {slot} not above low watermark {mx}"
+                )
+            self._conn.execute(
+                "INSERT INTO signed_blocks (validator_id, slot, signing_root) VALUES (?,?,?)",
+                (vid, slot, signing_root),
+            )
+
+    # ------------------------------------------------------------- attestations
+
+    def check_and_insert_attestation(
+        self, pubkey: bytes, source_epoch: int, target_epoch: int, signing_root: bytes
+    ) -> None:
+        if source_epoch > target_epoch:
+            raise SlashingProtectionError("source > target")
+        with self._lock, self._conn:
+            vid = self._validator_id(pubkey)
+            row = self._conn.execute(
+                "SELECT signing_root FROM signed_attestations WHERE validator_id=? AND target_epoch=?",
+                (vid, target_epoch),
+            ).fetchone()
+            if row is not None:
+                if row[0] == signing_root:
+                    return
+                raise SlashingProtectionError(f"double vote at target {target_epoch}")
+            # surround checks
+            surrounding = self._conn.execute(
+                """SELECT 1 FROM signed_attestations
+                   WHERE validator_id=? AND source_epoch<? AND target_epoch>?""",
+                (vid, source_epoch, target_epoch),
+            ).fetchone()
+            if surrounding:
+                raise SlashingProtectionError("attestation would be surrounded")
+            surrounded = self._conn.execute(
+                """SELECT 1 FROM signed_attestations
+                   WHERE validator_id=? AND source_epoch>? AND target_epoch<?""",
+                (vid, source_epoch, target_epoch),
+            ).fetchone()
+            if surrounded:
+                raise SlashingProtectionError("attestation would surround a prior vote")
+            # low watermarks
+            min_src = self._conn.execute(
+                "SELECT MIN(source_epoch) FROM signed_attestations WHERE validator_id=?",
+                (vid,),
+            ).fetchone()[0]
+            if min_src is not None and source_epoch < min_src:
+                raise SlashingProtectionError("source below low watermark")
+            max_tgt = self._conn.execute(
+                "SELECT MAX(target_epoch) FROM signed_attestations WHERE validator_id=?",
+                (vid,),
+            ).fetchone()[0]
+            if max_tgt is not None and target_epoch <= max_tgt:
+                raise SlashingProtectionError("target not above low watermark")
+            self._conn.execute(
+                """INSERT INTO signed_attestations
+                   (validator_id, source_epoch, target_epoch, signing_root)
+                   VALUES (?,?,?,?)""",
+                (vid, source_epoch, target_epoch, signing_root),
+            )
+
+    # ------------------------------------------------------------- interchange
+
+    def export_interchange(self, genesis_validators_root: bytes) -> dict:
+        """EIP-3076 export."""
+        out = {
+            "metadata": {
+                "interchange_format_version": "5",
+                "genesis_validators_root": "0x" + genesis_validators_root.hex(),
+            },
+            "data": [],
+        }
+        with self._lock:
+            for vid, pk in self._conn.execute("SELECT id, public_key FROM validators"):
+                blocks = [
+                    {
+                        "slot": str(slot),
+                        **({"signing_root": "0x" + sr.hex()} if sr else {}),
+                    }
+                    for slot, sr in self._conn.execute(
+                        "SELECT slot, signing_root FROM signed_blocks WHERE validator_id=? ORDER BY slot",
+                        (vid,),
+                    )
+                ]
+                atts = [
+                    {
+                        "source_epoch": str(se),
+                        "target_epoch": str(te),
+                        **({"signing_root": "0x" + sr.hex()} if sr else {}),
+                    }
+                    for se, te, sr in self._conn.execute(
+                        "SELECT source_epoch, target_epoch, signing_root FROM signed_attestations WHERE validator_id=? ORDER BY target_epoch",
+                        (vid,),
+                    )
+                ]
+                out["data"].append(
+                    {
+                        "pubkey": "0x" + pk.hex(),
+                        "signed_blocks": blocks,
+                        "signed_attestations": atts,
+                    }
+                )
+        return out
+
+    def import_interchange(self, interchange: dict, genesis_validators_root: bytes) -> None:
+        """EIP-3076 import with minification: keep only the maximum slot /
+        maximum (source, target) per validator, like the reference importer."""
+        meta_root = interchange["metadata"]["genesis_validators_root"]
+        if bytes.fromhex(meta_root[2:]) != genesis_validators_root:
+            raise SlashingProtectionError("interchange genesis_validators_root mismatch")
+        for record in interchange["data"]:
+            pk = bytes.fromhex(record["pubkey"][2:])
+            self.register_validator(pk)
+            with self._lock, self._conn:
+                vid = self._validator_id(pk)
+                slots = [int(b["slot"]) for b in record.get("signed_blocks", [])]
+                if slots:
+                    mx = max(slots)
+                    self._conn.execute(
+                        "INSERT OR REPLACE INTO signed_blocks (validator_id, slot, signing_root) VALUES (?,?,NULL)",
+                        (vid, mx),
+                    )
+                atts = record.get("signed_attestations", [])
+                if atts:
+                    max_source = max(int(a["source_epoch"]) for a in atts)
+                    max_target = max(int(a["target_epoch"]) for a in atts)
+                    self._conn.execute(
+                        """INSERT OR REPLACE INTO signed_attestations
+                           (validator_id, source_epoch, target_epoch, signing_root)
+                           VALUES (?,?,?,NULL)""",
+                        (vid, max_source, max_target),
+                    )
+
+    def close(self):
+        self._conn.close()

@@ -16,7 +16,11 @@ VALIDATORS = 64
 
 @pytest.fixture(scope="module")
 def harness():
-    bls.set_backend("python")
+    # fake backend: proves the state-transition plumbing without pairing
+    # cost, exactly like the reference's fake_crypto test lane (SURVEY §4).
+    # Real-signature coverage lives in test_real_crypto_block below and in
+    # the jaxbls suites.
+    bls.set_backend("fake")
     spec = minimal_spec()
     return StateHarness.new(spec, VALIDATORS)
 
@@ -50,14 +54,22 @@ def test_extend_chain_with_full_participation_finalizes(harness):
     assert len(blocks) == slots_per_epoch * 4
 
 
-def test_invalid_proposer_signature_rejected(harness):
+def test_real_crypto_block(harness):
+    """One full block verified with real (python-backend) crypto, and its
+    tampered variant rejected."""
     spec = harness.spec
     h2 = StateHarness(spec=spec, keypairs=harness.keypairs, state=clone_state(harness.state, spec))
-    signed, _post = h2.produce_block(h2.state.slot + 1, attestations=[])
-    bad = signed.copy_with(signature=b"\xaa" + bytes(signed.signature)[1:])
-    st = clone_state(h2.state, spec)
-    with pytest.raises(Exception):
-        state_transition(st, bad, spec, strategy=SignatureStrategy.VERIFY_BULK)
+    signed, _post = h2.produce_block(h2.state.slot + 1, attestations=[], full_sync=False)
+    bls.set_backend("python")
+    try:
+        st = clone_state(h2.state, spec)
+        state_transition(st, signed, spec, strategy=SignatureStrategy.VERIFY_BULK)
+        bad = signed.copy_with(signature=bytes(signed.signature)[:-1] + b"\x01")
+        st = clone_state(h2.state, spec)
+        with pytest.raises(Exception):
+            state_transition(st, bad, spec, strategy=SignatureStrategy.VERIFY_BULK)
+    finally:
+        bls.set_backend("fake")
 
 
 def test_wrong_state_root_rejected(harness):
