@@ -395,6 +395,68 @@ class BeaconChain:
                 results.append((att, attesting))
         return results
 
+    # ------------------------------------------------------------ production
+
+    def produce_block(self, slot: int, randao_reveal: bytes, op_pool=None, graffiti: bytes = b"\x00" * 32):
+        """Produce an unsigned block on the head state
+        (produce_block_on_state, beacon_chain.rs:4720 analog)."""
+        from ..state_transition.block import SignatureStrategy
+        from ..types.spec import ForkName
+
+        spec = self.spec
+        types = types_for_slot(spec, slot)
+        fork = spec.fork_name_at_slot(slot)
+        state = self._state_for_block(self.head_root, slot)
+        proposer = acc.get_beacon_proposer_index(state, spec)
+
+        attestations = []
+        if op_pool is not None:
+            attestations = op_pool.get_attestations_for_block(state, types)
+
+        body_kwargs = dict(
+            randao_reveal=randao_reveal,
+            eth1_data=state.eth1_data,
+            graffiti=graffiti,
+            proposer_slashings=[],
+            attester_slashings=[],
+            attestations=attestations,
+            deposits=[],
+            voluntary_exits=[],
+        )
+        if op_pool is not None:
+            ps, asl, exits, changes = op_pool.get_slashings_and_exits(state, types)
+            body_kwargs.update(
+                proposer_slashings=ps, attester_slashings=asl, voluntary_exits=exits
+            )
+            if fork >= ForkName.capella:
+                body_kwargs["bls_to_execution_changes"] = changes
+        if fork >= ForkName.altair:
+            body_kwargs["sync_aggregate"] = types.SyncAggregate.make(
+                sync_committee_bits=[False] * spec.preset.SYNC_COMMITTEE_SIZE,
+                sync_committee_signature=bls.INFINITY_SIGNATURE_BYTES,
+            )
+        if fork >= ForkName.bellatrix:
+            body_kwargs["execution_payload"] = types.ExecutionPayload.default()
+        if fork >= ForkName.capella and "bls_to_execution_changes" not in body_kwargs:
+            body_kwargs["bls_to_execution_changes"] = []
+        if fork >= ForkName.deneb:
+            body_kwargs["blob_kzg_commitments"] = []
+
+        block = types.BeaconBlock.make(
+            slot=slot,
+            proposer_index=proposer,
+            parent_root=self.head_root,
+            state_root=b"\x00" * 32,
+            body=types.BeaconBlockBody.make(**body_kwargs),
+        )
+        trial = types.SignedBeaconBlock.make(message=block, signature=b"\x00" * 96)
+        post = self._state_for_block(self.head_root, slot)
+        per_block_processing(
+            post, trial, spec, types,
+            strategy=SignatureStrategy.NO_VERIFICATION, verify_block_root=True,
+        )
+        return block.copy_with(state_root=types.BeaconState.hash_tree_root(post))
+
     def apply_attestation_to_fork_choice(self, att, attesting_indices):
         self.fork_choice.on_attestation(
             att.data.slot,

@@ -1,0 +1,179 @@
+"""Beacon-node interface for the validator client + multi-node fallback.
+
+Parity surface: the typed client boundary of /root/reference/common/eth2
+(BeaconNodeHttpClient, src/lib.rs:156) and the VC's
+BeaconNodeFallback health-ranked redundancy
+(validator_client/src/beacon_node_fallback.rs). The VC talks to a small
+duck-typed interface; `InProcessBeaconNode` implements it directly over a
+BeaconChain (the simulator path — testing/simulator analog), and an HTTP
+client implementing the same surface slots in for production (api/client).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..state_transition import accessors as acc
+from ..state_transition.slot import types_for_slot
+from ..types import helpers as h
+
+
+@dataclass
+class AttesterDuty:
+    pubkey: bytes
+    validator_index: int
+    slot: int
+    committee_index: int
+    committee_length: int
+    committee_position: int
+    committees_at_slot: int
+
+
+@dataclass
+class ProposerDuty:
+    pubkey: bytes
+    validator_index: int
+    slot: int
+
+
+class BeaconNodeError(Exception):
+    pass
+
+
+class InProcessBeaconNode:
+    """The VC-visible API implemented straight over a BeaconChain."""
+
+    def __init__(self, chain):
+        self.chain = chain
+        self.healthy = True
+
+    # -- node status -----------------------------------------------------
+
+    def is_healthy(self) -> bool:
+        return self.healthy
+
+    def genesis_validators_root(self) -> bytes:
+        return bytes(self.chain.head_state().genesis_validators_root)
+
+    # -- duties ----------------------------------------------------------
+
+    def attester_duties(self, epoch: int, indices: list[int]) -> list[AttesterDuty]:
+        if not self.healthy:
+            raise BeaconNodeError("node down")
+        chain = self.chain
+        state = chain.head_state()
+        spec = chain.spec
+        cache = acc.build_committee_cache(state, spec, epoch)
+        wanted = set(indices)
+        duties = []
+        for slot in range(
+            h.compute_start_slot_at_epoch(epoch, spec),
+            h.compute_start_slot_at_epoch(epoch + 1, spec),
+        ):
+            for cidx in range(cache.committees_per_slot):
+                committee = cache.committee(slot, cidx)
+                for pos, vi in enumerate(committee):
+                    if vi in wanted:
+                        duties.append(
+                            AttesterDuty(
+                                pubkey=bytes(state.validators[vi].pubkey),
+                                validator_index=vi,
+                                slot=slot,
+                                committee_index=cidx,
+                                committee_length=len(committee),
+                                committee_position=pos,
+                                committees_at_slot=cache.committees_per_slot,
+                            )
+                        )
+        return duties
+
+    def proposer_duties(self, epoch: int) -> list[ProposerDuty]:
+        if not self.healthy:
+            raise BeaconNodeError("node down")
+        chain = self.chain
+        spec = chain.spec
+        from ..testing.harness import clone_state
+        from ..state_transition.slot import process_slots
+
+        state = clone_state(chain.head_state(), spec)
+        start = h.compute_start_slot_at_epoch(epoch, spec)
+        if state.slot < start:
+            process_slots(state, spec, start)
+        duties = []
+        for slot in range(start, start + spec.preset.SLOTS_PER_EPOCH):
+            proposer = acc.get_beacon_proposer_index(state, spec, slot)
+            duties.append(
+                ProposerDuty(
+                    pubkey=bytes(state.validators[proposer].pubkey),
+                    validator_index=proposer,
+                    slot=slot,
+                )
+            )
+        return duties
+
+    # -- attestation flow ------------------------------------------------
+
+    def attestation_data(self, slot: int, committee_index: int):
+        if not self.healthy:
+            raise BeaconNodeError("node down")
+        chain = self.chain
+        spec = chain.spec
+        state = chain.head_state()
+        types = types_for_slot(spec, slot)
+        epoch = h.compute_epoch_at_slot(slot, spec)
+        head_root = chain.head_root
+        start_slot = h.compute_start_slot_at_epoch(epoch, spec)
+        if state.slot <= start_slot:
+            target_root = head_root
+        else:
+            target_root = state.block_roots[
+                start_slot % spec.preset.SLOTS_PER_HISTORICAL_ROOT
+            ]
+        source = (
+            state.current_justified_checkpoint
+            if epoch == acc.get_current_epoch(state, spec)
+            else state.previous_justified_checkpoint
+        )
+        return types.AttestationData.make(
+            slot=slot,
+            index=committee_index,
+            beacon_block_root=head_root,
+            source=source,
+            target=types.Checkpoint.make(epoch=epoch, root=target_root),
+        )
+
+    def publish_attestations(self, attestations) -> int:
+        """BN re-verifies and gossips; returns count accepted."""
+        if not self.healthy:
+            raise BeaconNodeError("node down")
+        verified = self.chain.verify_unaggregated_attestations(attestations)
+        for att, indices in verified:
+            self.chain.apply_attestation_to_fork_choice(att, indices)
+        return len(verified)
+
+    # -- blocks ----------------------------------------------------------
+
+    def publish_block(self, signed_block) -> bytes:
+        if not self.healthy:
+            raise BeaconNodeError("node down")
+        root = self.chain.verify_block_for_gossip(signed_block)
+        return self.chain.process_block(
+            signed_block, block_root=root, proposal_already_verified=True
+        )
+
+
+class BeaconNodeFallback:
+    """Health-ranked multi-node redundancy (beacon_node_fallback.rs)."""
+
+    def __init__(self, nodes: list):
+        self.nodes = list(nodes)
+
+    def first_success(self, method: str, *args, **kwargs):
+        errors = []
+        ranked = sorted(self.nodes, key=lambda n: not n.is_healthy())
+        for node in ranked:
+            try:
+                return getattr(node, method)(*args, **kwargs)
+            except Exception as e:  # noqa: BLE001 — try next node
+                errors.append((node, e))
+        raise BeaconNodeError(f"all beacon nodes failed: {errors}")

@@ -1,0 +1,166 @@
+"""Validator-client services: duties polling, attestations, proposals,
+doppelganger quarantine.
+
+Parity surface: /root/reference/validator_client/src/ — DutiesService
+(duties_service.rs:208: per-epoch attester/proposer duty maps keyed by
+dependent root, selection-proof precompute), AttestationService
+(attestation_service.rs:176-493: slot+1/3 produce/sign/publish, slot+2/3
+aggregate), BlockService (block_service.rs), DoppelgangerService
+(doppelganger_service.rs: 2-epoch liveness quarantine before signing).
+
+Scheduling is tick-driven and synchronous (`on_slot(slot, phase)`) so the
+same code runs under the deterministic in-process simulator (manual clock)
+or a wall-clock loop — logical time is the testing idiom the reference gets
+from TestingSlotClock (SURVEY §4).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+from ..types import helpers as h
+from ..types.spec import ChainSpec
+from ..state_transition.slot import types_for_slot
+from .beacon_node import BeaconNodeFallback
+from .slashing_protection import SlashingProtectionError
+from .validator_store import DoppelgangerProtected, ValidatorStore
+
+
+@dataclass
+class DutiesService:
+    spec: ChainSpec
+    store: ValidatorStore
+    nodes: BeaconNodeFallback
+    attester_duties: dict = field(default_factory=dict)   # epoch -> [AttesterDuty]
+    proposer_duties: dict = field(default_factory=dict)   # epoch -> [ProposerDuty]
+
+    def poll(self, current_epoch: int) -> None:
+        """Refresh duty maps for current and next epoch (duties_service.rs
+        poll loop)."""
+        my_pubkeys = set(self.store.voting_pubkeys())
+        # resolve indices
+        indices = [
+            v.index for v in self.store.validators.values() if v.index is not None
+        ]
+        for epoch in (current_epoch, current_epoch + 1):
+            duties = self.nodes.first_success("attester_duties", epoch, indices)
+            self.attester_duties[epoch] = [
+                d for d in duties if d.pubkey in my_pubkeys
+            ]
+            proposals = self.nodes.first_success("proposer_duties", epoch)
+            self.proposer_duties[epoch] = [
+                d for d in proposals if d.pubkey in my_pubkeys
+            ]
+        # prune old epochs
+        for e in list(self.attester_duties):
+            if e < current_epoch:
+                del self.attester_duties[e]
+        for e in list(self.proposer_duties):
+            if e < current_epoch:
+                del self.proposer_duties[e]
+
+    def attesters_at_slot(self, slot: int):
+        epoch = slot // self.spec.preset.SLOTS_PER_EPOCH
+        return [d for d in self.attester_duties.get(epoch, []) if d.slot == slot]
+
+    def proposers_at_slot(self, slot: int):
+        epoch = slot // self.spec.preset.SLOTS_PER_EPOCH
+        return [d for d in self.proposer_duties.get(epoch, []) if d.slot == slot]
+
+
+@dataclass
+class AttestationService:
+    spec: ChainSpec
+    store: ValidatorStore
+    duties: DutiesService
+    nodes: BeaconNodeFallback
+    published: int = 0
+    failed: int = 0
+
+    def attest(self, slot: int) -> int:
+        """Produce+sign+publish attestations for all duties at `slot`
+        (the slot+1/3 phase of attestation_service.rs)."""
+        duties = self.duties.attesters_at_slot(slot)
+        if not duties:
+            return 0
+        types = types_for_slot(self.spec, slot)
+        by_committee: dict[int, list] = defaultdict(list)
+        for d in duties:
+            by_committee[d.committee_index].append(d)
+        produced = 0
+        for cidx, ds in by_committee.items():
+            data = self.nodes.first_success("attestation_data", slot, cidx)
+            atts = []
+            for d in ds:
+                bits = [False] * d.committee_length
+                bits[d.committee_position] = True
+                try:
+                    sig = self.store.sign_attestation(d.pubkey, data, types)
+                except (SlashingProtectionError, DoppelgangerProtected):
+                    self.failed += 1
+                    continue
+                atts.append(
+                    types.Attestation.make(
+                        aggregation_bits=bits, data=data, signature=sig
+                    )
+                )
+            if atts:
+                produced += self.nodes.first_success("publish_attestations", atts)
+        self.published += produced
+        return produced
+
+
+@dataclass
+class BlockService:
+    spec: ChainSpec
+    store: ValidatorStore
+    duties: DutiesService
+    nodes: BeaconNodeFallback
+    produce_block_fn: object = None   # (slot, randao_reveal) -> unsigned block
+    published: int = 0
+
+    def propose(self, slot: int) -> int:
+        duties = self.duties.proposers_at_slot(slot)
+        count = 0
+        for d in duties:
+            types = types_for_slot(self.spec, slot)
+            epoch = slot // self.spec.preset.SLOTS_PER_EPOCH
+            randao = self.store.sign_randao(d.pubkey, epoch)
+            block = self.produce_block_fn(slot, randao)
+            try:
+                sig = self.store.sign_block(d.pubkey, block, types)
+            except (SlashingProtectionError, DoppelgangerProtected):
+                continue
+            signed = types.SignedBeaconBlock.make(message=block, signature=sig)
+            self.nodes.first_success("publish_block", signed)
+            count += 1
+        self.published += count
+        return count
+
+
+@dataclass
+class DoppelgangerService:
+    """Quarantine new validators for N epochs while watching for their
+    signatures on the network (doppelganger_service.rs)."""
+
+    spec: ChainSpec
+    store: ValidatorStore
+    epochs_to_watch: int = 2
+    _watch_until: dict = field(default_factory=dict)   # pubkey -> epoch
+
+    def register(self, pubkey: bytes, current_epoch: int) -> None:
+        self._watch_until[pubkey] = current_epoch + self.epochs_to_watch
+        self.store.set_doppelganger_safe(pubkey, False)
+
+    def observe_liveness(self, pubkey: bytes) -> None:
+        """Another instance signed with this key: NEVER enable it."""
+        if pubkey in self._watch_until:
+            self._watch_until[pubkey] = 2**63  # poisoned
+        self.store.set_doppelganger_safe(pubkey, False)
+
+    def on_epoch(self, current_epoch: int) -> None:
+        for pk, until in list(self._watch_until.items()):
+            if current_epoch >= until:
+                self.store.set_doppelganger_safe(pk, True)
+                del self._watch_until[pk]

@@ -1,0 +1,143 @@
+"""ValidatorStore — the signing façade every VC service goes through.
+
+Parity surface: /root/reference/validator_client/src/validator_store.rs —
+every signature is produced here and GATED by slashing protection and
+doppelganger status; signing methods are pluggable (local keystore vs
+remote signer, signing_method.rs:80).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..crypto import bls
+from ..types import helpers as h
+from ..types.spec import (
+    ChainSpec,
+    DOMAIN_AGGREGATE_AND_PROOF,
+    DOMAIN_BEACON_ATTESTER,
+    DOMAIN_BEACON_PROPOSER,
+    DOMAIN_RANDAO,
+    DOMAIN_SELECTION_PROOF,
+    DOMAIN_SYNC_COMMITTEE,
+    DOMAIN_VOLUNTARY_EXIT,
+)
+from .slashing_protection import SlashingDatabase, SlashingProtectionError
+
+
+class DoppelgangerProtected(Exception):
+    """Signing refused: validator still in doppelganger quarantine."""
+
+
+class LocalSigner:
+    """SigningMethod::LocalKeystore analog."""
+
+    def __init__(self, sk: bls.SecretKey):
+        self._sk = sk
+
+    def sign(self, signing_root: bytes) -> bls.Signature:
+        return bls.sign(self._sk, signing_root)
+
+
+@dataclass
+class InitializedValidator:
+    pubkey: bytes
+    signer: object
+    index: int | None = None
+    doppelganger_safe: bool = True
+
+
+class ValidatorStore:
+    def __init__(
+        self,
+        spec: ChainSpec,
+        genesis_validators_root: bytes,
+        slashing_db: SlashingDatabase | None = None,
+    ):
+        self.spec = spec
+        self.genesis_validators_root = genesis_validators_root
+        self.slashing_db = slashing_db or SlashingDatabase()
+        self.validators: dict[bytes, InitializedValidator] = {}
+        self.fork_version: bytes = spec.fork_version(spec.fork_name_at_epoch(0))
+
+    # ------------------------------------------------------------- admin
+
+    def add_validator(self, sk: bls.SecretKey, index: int | None = None) -> bytes:
+        pk = sk.public_key().serialize()
+        self.slashing_db.register_validator(pk)
+        self.validators[pk] = InitializedValidator(pubkey=pk, signer=LocalSigner(sk), index=index)
+        return pk
+
+    def voting_pubkeys(self) -> list[bytes]:
+        return list(self.validators)
+
+    def set_index(self, pubkey: bytes, index: int) -> None:
+        self.validators[pubkey].index = index
+
+    def set_doppelganger_safe(self, pubkey: bytes, safe: bool) -> None:
+        self.validators[pubkey].doppelganger_safe = safe
+
+    def update_fork(self, fork_version: bytes) -> None:
+        self.fork_version = fork_version
+
+    def _validator(self, pubkey: bytes) -> InitializedValidator:
+        v = self.validators[pubkey]
+        if not v.doppelganger_safe:
+            raise DoppelgangerProtected(pubkey.hex()[:16])
+        return v
+
+    def _domain(self, domain_type: bytes) -> bytes:
+        return h.compute_domain(domain_type, self.fork_version, self.genesis_validators_root)
+
+    # ------------------------------------------------------------- signing
+
+    def sign_block(self, pubkey: bytes, block, types) -> bytes:
+        v = self._validator(pubkey)
+        domain = self._domain(DOMAIN_BEACON_PROPOSER)
+        root = h.compute_signing_root(types.BeaconBlock, block, domain)
+        self.slashing_db.check_and_insert_block_proposal(pubkey, block.slot, root)
+        return v.signer.sign(root).serialize()
+
+    def sign_attestation(self, pubkey: bytes, data, types) -> bytes:
+        v = self._validator(pubkey)
+        domain = self._domain(DOMAIN_BEACON_ATTESTER)
+        root = h.compute_signing_root(types.AttestationData, data, domain)
+        self.slashing_db.check_and_insert_attestation(
+            pubkey, data.source.epoch, data.target.epoch, root
+        )
+        return v.signer.sign(root).serialize()
+
+    def sign_randao(self, pubkey: bytes, epoch: int) -> bytes:
+        from ..ssz.core import uint64
+
+        v = self._validator(pubkey)
+        domain = self._domain(DOMAIN_RANDAO)
+        root = h.compute_signing_root(uint64, epoch, domain)
+        return v.signer.sign(root).serialize()
+
+    def sign_selection_proof(self, pubkey: bytes, slot: int) -> bytes:
+        from ..ssz.core import uint64
+
+        v = self._validator(pubkey)
+        domain = self._domain(DOMAIN_SELECTION_PROOF)
+        root = h.compute_signing_root(uint64, slot, domain)
+        return v.signer.sign(root).serialize()
+
+    def sign_aggregate_and_proof(self, pubkey: bytes, agg_and_proof, types) -> bytes:
+        v = self._validator(pubkey)
+        domain = self._domain(DOMAIN_AGGREGATE_AND_PROOF)
+        root = h.compute_signing_root(types.AggregateAndProof, agg_and_proof, domain)
+        return v.signer.sign(root).serialize()
+
+    def sign_sync_committee_message(self, pubkey: bytes, block_root: bytes) -> bytes:
+        v = self._validator(pubkey)
+        domain = self._domain(DOMAIN_SYNC_COMMITTEE)
+        root = h.compute_signing_root_from_root(block_root, domain)
+        return v.signer.sign(root).serialize()
+
+    def sign_voluntary_exit(self, pubkey: bytes, exit_msg, types) -> bytes:
+        # exits are NOT slashable; no protection needed
+        v = self.validators[pubkey]  # doppelganger does not block exits
+        domain = self._domain(DOMAIN_VOLUNTARY_EXIT)
+        root = h.compute_signing_root(types.VoluntaryExit, exit_msg, domain)
+        return v.signer.sign(root).serialize()

@@ -1,0 +1,81 @@
+"""KZG blob proof tests on a small dev trusted setup (n=8): commitment/
+proof roundtrip, single + batch verification, tamper rejection."""
+
+import random
+
+import pytest
+
+from lighthouse_tpu.crypto import kzg
+from lighthouse_tpu.crypto.bls381 import curve as cv, serde
+from lighthouse_tpu.crypto.bls381.constants import R
+
+N = 8
+rng = random.Random(0x4B5A)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    from lighthouse_tpu.crypto import bls
+
+    bls.set_backend("python")
+    return kzg.TrustedSetup.insecure_dev_setup(N)
+
+
+def mk_blob():
+    return b"".join(
+        (rng.randrange(R)).to_bytes(32, "big") for _ in range(N)
+    )
+
+
+def test_lagrange_setup_consistency(setup):
+    # committing to the constant polynomial 1 must give G1 (sum of lagrange
+    # basis at tau = [1]*G1)
+    blob = b"".join((1).to_bytes(32, "big") for _ in range(N))
+    c = kzg.blob_to_kzg_commitment(blob, setup)
+    assert c == cv.G1_GEN
+
+
+def test_proof_roundtrip(setup):
+    blob = mk_blob()
+    commitment = kzg.blob_to_kzg_commitment(blob, setup)
+    cb = serde.g1_compress(commitment)
+    proof = kzg.compute_blob_kzg_proof(blob, cb, setup)
+    pb = serde.g1_compress(proof)
+    assert kzg.verify_blob_kzg_proof(blob, cb, pb, setup)
+
+
+def test_eval_on_domain_point(setup):
+    blob = mk_blob()
+    poly = kzg.blob_to_polynomial(blob, setup)
+    z = setup.roots[3]
+    proof, y = kzg.compute_kzg_proof(blob, z, setup)
+    assert y == poly[3]
+    commitment = kzg.blob_to_kzg_commitment(blob, setup)
+    assert kzg.verify_kzg_proof(commitment, z, y, proof, setup)
+
+
+def test_tampered_blob_rejected(setup):
+    blob = mk_blob()
+    commitment = kzg.blob_to_kzg_commitment(blob, setup)
+    cb = serde.g1_compress(commitment)
+    proof = kzg.compute_blob_kzg_proof(blob, cb, setup)
+    pb = serde.g1_compress(proof)
+    bad = bytearray(blob)
+    bad[5] ^= 1
+    assert not kzg.verify_blob_kzg_proof(bytes(bad), cb, pb, setup)
+
+
+def test_batch_verify(setup):
+    blobs, cbs, pbs = [], [], []
+    for _ in range(3):
+        blob = mk_blob()
+        c = kzg.blob_to_kzg_commitment(blob, setup)
+        cb = serde.g1_compress(c)
+        p = kzg.compute_blob_kzg_proof(blob, cb, setup)
+        blobs.append(blob)
+        cbs.append(cb)
+        pbs.append(serde.g1_compress(p))
+    assert kzg.verify_blob_kzg_proof_batch(blobs, cbs, pbs, setup)
+    # swap two proofs -> batch fails
+    assert not kzg.verify_blob_kzg_proof_batch(blobs, cbs, [pbs[1], pbs[0], pbs[2]], setup)
+    assert kzg.verify_blob_kzg_proof_batch([], [], [], setup)
