@@ -1,0 +1,160 @@
+"""Typed Beacon-API HTTP client (common/eth2 BeaconNodeHttpClient analog).
+
+Implements the same duck-typed surface as
+validator.beacon_node.InProcessBeaconNode so the validator client can run
+against a remote beacon node over HTTP exactly as it runs in-process."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+from ..state_transition.slot import types_for_slot
+from ..validator.beacon_node import AttesterDuty, BeaconNodeError, ProposerDuty
+
+
+class BeaconNodeHttpClient:
+    def __init__(self, base_url: str, timeout: float = 5.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    # ------------------------------------------------------------ plumbing
+
+    def _get(self, path: str):
+        try:
+            with urllib.request.urlopen(self.base_url + path, timeout=self.timeout) as r:
+                body = r.read()
+                return json.loads(body) if body else {}
+        except urllib.error.HTTPError as e:
+            raise BeaconNodeError(f"GET {path}: {e.code} {e.read()[:200]}") from e
+        except urllib.error.URLError as e:
+            raise BeaconNodeError(f"GET {path}: {e}") from e
+
+    def _post(self, path: str, payload):
+        data = json.dumps(payload).encode()
+        req = urllib.request.Request(
+            self.base_url + path, data=data, headers={"Content-Type": "application/json"}
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                body = r.read()
+                return json.loads(body) if body else {}
+        except urllib.error.HTTPError as e:
+            raise BeaconNodeError(f"POST {path}: {e.code} {e.read()[:200]}") from e
+        except urllib.error.URLError as e:
+            raise BeaconNodeError(f"POST {path}: {e}") from e
+
+    # ------------------------------------------------------------ node
+
+    def is_healthy(self) -> bool:
+        try:
+            self._get("/eth/v1/node/health")
+            return True
+        except BeaconNodeError:
+            return False
+
+    def version(self) -> str:
+        return self._get("/eth/v1/node/version")["data"]["version"]
+
+    def syncing(self) -> dict:
+        return self._get("/eth/v1/node/syncing")["data"]
+
+    def genesis(self) -> dict:
+        return self._get("/eth/v1/beacon/genesis")["data"]
+
+    def genesis_validators_root(self) -> bytes:
+        return bytes.fromhex(self.genesis()["genesis_validators_root"][2:])
+
+    def spec(self) -> dict:
+        return self._get("/eth/v1/config/spec")["data"]
+
+    # ------------------------------------------------------------ beacon
+
+    def state_root(self, state_id: str = "head") -> bytes:
+        return bytes.fromhex(
+            self._get(f"/eth/v1/beacon/states/{state_id}/root")["data"]["root"][2:]
+        )
+
+    def finality_checkpoints(self, state_id: str = "head") -> dict:
+        return self._get(f"/eth/v1/beacon/states/{state_id}/finality_checkpoints")["data"]
+
+    def validators(self, state_id: str = "head") -> list[dict]:
+        return self._get(f"/eth/v1/beacon/states/{state_id}/validators")["data"]
+
+    def block_root(self, block_id: str = "head") -> bytes:
+        return bytes.fromhex(
+            self._get(f"/eth/v1/beacon/blocks/{block_id}/root")["data"]["root"][2:]
+        )
+
+    def header(self, block_id: str = "head") -> dict:
+        return self._get(f"/eth/v1/beacon/headers/{block_id}")["data"]
+
+    # ------------------------------------------------------------ duties
+
+    def attester_duties(self, epoch: int, indices: list[int]) -> list[AttesterDuty]:
+        resp = self._post(
+            f"/eth/v1/validator/duties/attester/{epoch}", [str(i) for i in indices]
+        )
+        return [
+            AttesterDuty(
+                pubkey=bytes.fromhex(d["pubkey"][2:]),
+                validator_index=int(d["validator_index"]),
+                slot=int(d["slot"]),
+                committee_index=int(d["committee_index"]),
+                committee_length=int(d["committee_length"]),
+                committee_position=int(d["validator_committee_index"]),
+                committees_at_slot=int(d["committees_at_slot"]),
+            )
+            for d in resp["data"]
+        ]
+
+    def proposer_duties(self, epoch: int) -> list[ProposerDuty]:
+        resp = self._get(f"/eth/v1/validator/duties/proposer/{epoch}")
+        return [
+            ProposerDuty(
+                pubkey=bytes.fromhex(d["pubkey"][2:]),
+                validator_index=int(d["validator_index"]),
+                slot=int(d["slot"]),
+            )
+            for d in resp["data"]
+        ]
+
+    # ------------------------------------------------------------ publish
+
+    def publish_attestations(self, attestations, types) -> int:
+        payload = []
+        for att in attestations:
+            from ..ssz.core import Bitlist
+
+            bl = None
+            for f in types.Attestation.fields:
+                if f.name == "aggregation_bits":
+                    bl = f.type
+            payload.append(
+                {
+                    "aggregation_bits": "0x" + bl.serialize(att.aggregation_bits).hex(),
+                    "data": {
+                        "slot": str(att.data.slot),
+                        "index": str(att.data.index),
+                        "beacon_block_root": "0x" + bytes(att.data.beacon_block_root).hex(),
+                        "source": {
+                            "epoch": str(att.data.source.epoch),
+                            "root": "0x" + bytes(att.data.source.root).hex(),
+                        },
+                        "target": {
+                            "epoch": str(att.data.target.epoch),
+                            "root": "0x" + bytes(att.data.target.root).hex(),
+                        },
+                    },
+                    "signature": "0x" + bytes(att.signature).hex(),
+                }
+            )
+        self._post("/eth/v1/beacon/pool/attestations", payload)
+        return len(attestations)
+
+    def publish_block(self, signed_block, types) -> None:
+        self._post(
+            "/eth/v2/beacon/blocks",
+            {"ssz": "0x" + types.SignedBeaconBlock.serialize(signed_block).hex()},
+        )

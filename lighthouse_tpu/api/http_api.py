@@ -1,0 +1,461 @@
+"""Beacon HTTP API server (stdlib http.server; warp analog).
+
+Parity surface: the load-bearing route families of
+/root/reference/beacon_node/http_api/src/lib.rs —
+  /eth/v1/beacon/genesis | states/{id}/root | states/{id}/finality_checkpoints
+  /eth/v1/beacon/states/{id}/validators[/{vid}] | headers/{id} | blocks/{id}/root
+  /eth/v2/beacon/blocks/{id}   POST /eth/v1/beacon/pool/attestations
+  POST /eth/v2/beacon/blocks (publish: broadcast-then-import semantics)
+  /eth/v1/node/health | version | syncing      /eth/v1/config/spec
+  /eth/v1/validator/duties/attester/{epoch} (POST) | duties/proposer/{epoch}
+  /eth/v1/validator/attestation_data           /eth/v1/events (SSE)
+plus /lighthouse-style extras under /lighthouse_tpu/*.
+
+JSON encoding follows the beacon-api conventions: quoted integers, 0x-hex
+byte strings.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..state_transition import accessors as acc
+from ..state_transition.slot import types_for_slot
+from ..types import helpers as h
+
+VERSION = "lighthouse-tpu/0.1.0"
+
+
+def _hex(b: bytes) -> str:
+    return "0x" + bytes(b).hex()
+
+
+def _u(x: int) -> str:
+    return str(int(x))
+
+
+def _checkpoint(cp) -> dict:
+    return {"epoch": _u(cp.epoch), "root": _hex(cp.root)}
+
+
+def _validator_json(i, v, balance) -> dict:
+    return {
+        "index": _u(i),
+        "balance": _u(balance),
+        "status": "active_ongoing",
+        "validator": {
+            "pubkey": _hex(v.pubkey),
+            "withdrawal_credentials": _hex(v.withdrawal_credentials),
+            "effective_balance": _u(v.effective_balance),
+            "slashed": bool(v.slashed),
+            "activation_eligibility_epoch": _u(v.activation_eligibility_epoch),
+            "activation_epoch": _u(v.activation_epoch),
+            "exit_epoch": _u(v.exit_epoch),
+            "withdrawable_epoch": _u(v.withdrawable_epoch),
+        },
+    }
+
+
+class ApiError(Exception):
+    def __init__(self, code: int, message: str):
+        self.code = code
+        self.message = message
+
+
+class BeaconApiHandler(BaseHTTPRequestHandler):
+    """Routes are matched with regexes against (method, path)."""
+
+    server_version = VERSION
+    chain = None           # injected by serve()
+    op_pool = None
+    event_bus = None
+
+    def log_message(self, *args):  # silence default stderr logging
+        pass
+
+    # ------------------------------------------------------------- plumbing
+
+    def _json(self, payload, code=200):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, code, message):
+        self._json({"code": code, "message": message}, code=code)
+
+    def _read_body(self):
+        length = int(self.headers.get("Content-Length", 0))
+        if length == 0:
+            return None
+        return json.loads(self.rfile.read(length))
+
+    def _state_by_id(self, state_id: str):
+        chain = self.chain
+        if state_id == "head":
+            return chain.head_state()
+        if state_id == "genesis":
+            state_id = "0"
+        if state_id == "finalized":
+            # best-effort: finalized state if cached, else head
+            froot = chain.fork_choice.store.finalized_checkpoint[1]
+            sroot = chain.state_root_by_block.get(froot)
+            if sroot and sroot in chain.state_cache:
+                return chain.state_cache[sroot]
+            return chain.head_state()
+        if state_id.startswith("0x"):
+            root = bytes.fromhex(state_id[2:])
+            st = chain.state_cache.get(root)
+            if st is None:
+                raise ApiError(404, "state not found")
+            return st
+        # slot number: search cache
+        slot = int(state_id)
+        for st in chain.state_cache.values():
+            if st.slot == slot:
+                return st
+        raise ApiError(404, "state not found")
+
+    def _block_root_by_id(self, block_id: str) -> bytes:
+        chain = self.chain
+        if block_id == "head":
+            return chain.head_root
+        if block_id == "genesis":
+            return chain.genesis_block_root
+        if block_id == "finalized":
+            return chain.fork_choice.store.finalized_checkpoint[1]
+        if block_id.startswith("0x"):
+            return bytes.fromhex(block_id[2:])
+        slot = int(block_id)
+        for root, s in chain.block_slots.items():
+            if s == slot:
+                return root
+        raise ApiError(404, "block not found")
+
+    # ------------------------------------------------------------- dispatch
+
+    def do_GET(self):
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")
+
+    def _dispatch(self, method):
+        path = self.path.split("?")[0].rstrip("/")
+        try:
+            for pattern, meth, fn in _ROUTES:
+                m = re.fullmatch(pattern, path)
+                if m and meth == method:
+                    return fn(self, *m.groups())
+            self._error(404, f"unknown route {path}")
+        except ApiError as e:
+            self._error(e.code, e.message)
+        except Exception as e:  # noqa: BLE001
+            self._error(500, f"{type(e).__name__}: {e}")
+
+    # ------------------------------------------------------------- handlers
+
+    def get_genesis(self):
+        st = self.chain.head_state()
+        self._json(
+            {
+                "data": {
+                    "genesis_time": _u(st.genesis_time),
+                    "genesis_validators_root": _hex(st.genesis_validators_root),
+                    "genesis_fork_version": _hex(self.chain.spec.genesis_fork_version),
+                }
+            }
+        )
+
+    def get_state_root(self, state_id):
+        st = self._state_by_id(state_id)
+        types = types_for_slot(self.chain.spec, st.slot)
+        self._json({"data": {"root": _hex(types.BeaconState.hash_tree_root(st))}})
+
+    def get_finality_checkpoints(self, state_id):
+        st = self._state_by_id(state_id)
+        self._json(
+            {
+                "data": {
+                    "previous_justified": _checkpoint(st.previous_justified_checkpoint),
+                    "current_justified": _checkpoint(st.current_justified_checkpoint),
+                    "finalized": _checkpoint(st.finalized_checkpoint),
+                }
+            }
+        )
+
+    def get_validators(self, state_id):
+        st = self._state_by_id(state_id)
+        self._json(
+            {
+                "data": [
+                    _validator_json(i, v, st.balances[i])
+                    for i, v in enumerate(st.validators)
+                ]
+            }
+        )
+
+    def get_validator(self, state_id, vid):
+        st = self._state_by_id(state_id)
+        if vid.startswith("0x"):
+            pkb = bytes.fromhex(vid[2:])
+            for i, v in enumerate(st.validators):
+                if bytes(v.pubkey) == pkb:
+                    return self._json({"data": _validator_json(i, v, st.balances[i])})
+            raise ApiError(404, "validator not found")
+        i = int(vid)
+        if i >= len(st.validators):
+            raise ApiError(404, "validator not found")
+        self._json({"data": _validator_json(i, st.validators[i], st.balances[i])})
+
+    def get_block_root(self, block_id):
+        self._json({"data": {"root": _hex(self._block_root_by_id(block_id))}})
+
+    def get_block(self, block_id):
+        root = self._block_root_by_id(block_id)
+        chain = self.chain
+        slot = chain.block_slots.get(root)
+        if slot is None:
+            raise ApiError(404, "block not found")
+        types = types_for_slot(chain.spec, slot)
+        blk = chain.store.get_block(root, types)
+        if blk is None:
+            raise ApiError(404, "block not found")
+        self._json(
+            {
+                "version": chain.spec.fork_name_at_slot(slot).value,
+                "data": {"message": {"slot": _u(blk.message.slot),
+                                      "proposer_index": _u(blk.message.proposer_index),
+                                      "parent_root": _hex(blk.message.parent_root),
+                                      "state_root": _hex(blk.message.state_root)},
+                          "signature": _hex(blk.signature),
+                          "ssz": _hex(types.SignedBeaconBlock.serialize(blk))},
+            }
+        )
+
+    def get_header(self, block_id):
+        root = self._block_root_by_id(block_id)
+        chain = self.chain
+        slot = chain.block_slots.get(root)
+        if slot is None:
+            raise ApiError(404, "block not found")
+        types = types_for_slot(chain.spec, slot)
+        blk = chain.store.get_block(root, types)
+        self._json(
+            {
+                "data": {
+                    "root": _hex(root),
+                    "canonical": True,
+                    "header": {
+                        "message": {
+                            "slot": _u(blk.message.slot),
+                            "proposer_index": _u(blk.message.proposer_index),
+                            "parent_root": _hex(blk.message.parent_root),
+                            "state_root": _hex(blk.message.state_root),
+                            "body_root": _hex(
+                                types.BeaconBlockBody.hash_tree_root(blk.message.body)
+                            ),
+                        },
+                        "signature": _hex(blk.signature),
+                    },
+                }
+            }
+        )
+
+    def get_health(self):
+        self.send_response(200)
+        self.end_headers()
+
+    def get_version(self):
+        self._json({"data": {"version": VERSION}})
+
+    def get_syncing(self):
+        chain = self.chain
+        head_slot = chain.head_state().slot
+        current = chain.current_slot
+        self._json(
+            {
+                "data": {
+                    "head_slot": _u(head_slot),
+                    "sync_distance": _u(max(0, current - head_slot)),
+                    "is_syncing": current > head_slot + 1,
+                    "is_optimistic": False,
+                    "el_offline": True,
+                }
+            }
+        )
+
+    def get_spec(self):
+        spec = self.chain.spec
+        p = spec.preset
+        self._json(
+            {
+                "data": {
+                    "CONFIG_NAME": spec.config_name,
+                    "PRESET_BASE": p.name,
+                    "SLOTS_PER_EPOCH": _u(p.SLOTS_PER_EPOCH),
+                    "SECONDS_PER_SLOT": _u(spec.seconds_per_slot),
+                    "MAX_COMMITTEES_PER_SLOT": _u(p.MAX_COMMITTEES_PER_SLOT),
+                    "TARGET_COMMITTEE_SIZE": _u(p.TARGET_COMMITTEE_SIZE),
+                    "MAX_EFFECTIVE_BALANCE": _u(spec.max_effective_balance),
+                    "GENESIS_FORK_VERSION": _hex(spec.genesis_fork_version),
+                }
+            }
+        )
+
+    def post_attester_duties(self, epoch):
+        body = self._read_body() or []
+        indices = [int(i) for i in body]
+        from ..validator.beacon_node import InProcessBeaconNode
+
+        node = InProcessBeaconNode(self.chain)
+        duties = node.attester_duties(int(epoch), indices)
+        self._json(
+            {
+                "dependent_root": _hex(self.chain.head_root),
+                "execution_optimistic": False,
+                "data": [
+                    {
+                        "pubkey": _hex(d.pubkey),
+                        "validator_index": _u(d.validator_index),
+                        "committee_index": _u(d.committee_index),
+                        "committee_length": _u(d.committee_length),
+                        "committees_at_slot": _u(d.committees_at_slot),
+                        "validator_committee_index": _u(d.committee_position),
+                        "slot": _u(d.slot),
+                    }
+                    for d in duties
+                ],
+            }
+        )
+
+    def get_proposer_duties(self, epoch):
+        from ..validator.beacon_node import InProcessBeaconNode
+
+        node = InProcessBeaconNode(self.chain)
+        duties = node.proposer_duties(int(epoch))
+        self._json(
+            {
+                "dependent_root": _hex(self.chain.head_root),
+                "data": [
+                    {
+                        "pubkey": _hex(d.pubkey),
+                        "validator_index": _u(d.validator_index),
+                        "slot": _u(d.slot),
+                    }
+                    for d in duties
+                ],
+            }
+        )
+
+    def post_pool_attestations(self):
+        body = self._read_body() or []
+        chain = self.chain
+        types = types_for_slot(chain.spec, chain.head_state().slot)
+        atts = []
+        for a in body:
+            data = a["data"]
+            att = types.Attestation.make(
+                aggregation_bits=_bits_from_hex(a["aggregation_bits"]),
+                data=types.AttestationData.make(
+                    slot=int(data["slot"]),
+                    index=int(data["index"]),
+                    beacon_block_root=bytes.fromhex(data["beacon_block_root"][2:]),
+                    source=types.Checkpoint.make(
+                        epoch=int(data["source"]["epoch"]),
+                        root=bytes.fromhex(data["source"]["root"][2:]),
+                    ),
+                    target=types.Checkpoint.make(
+                        epoch=int(data["target"]["epoch"]),
+                        root=bytes.fromhex(data["target"]["root"][2:]),
+                    ),
+                ),
+                signature=bytes.fromhex(a["signature"][2:]),
+            )
+            atts.append(att)
+        verified = chain.verify_unaggregated_attestations(atts)
+        for att, indices in verified:
+            chain.apply_attestation_to_fork_choice(att, indices)
+            if self.op_pool is not None:
+                self.op_pool.insert_attestation(att, indices, types)
+        if len(verified) != len(atts):
+            raise ApiError(400, f"{len(atts)-len(verified)} attestations failed")
+        self._json({})
+
+    def post_publish_block(self):
+        body = self._read_body()
+        chain = self.chain
+        ssz_hex = body.get("ssz") if isinstance(body, dict) else None
+        if not ssz_hex:
+            raise ApiError(400, "expected {'ssz': '0x...'} body")
+        raw = bytes.fromhex(ssz_hex[2:])
+        # slot is the first 8 bytes of the message (after 100-byte envelope?)
+        # -> decode via head-fork types; forks with identical layouts decode fine
+        types = types_for_slot(chain.spec, chain.current_slot)
+        signed = types.SignedBeaconBlock.deserialize(raw)
+        root = chain.verify_block_for_gossip(signed)
+        chain.process_block(signed, block_root=root, proposal_already_verified=True)
+        if self.event_bus is not None:
+            self.event_bus.publish("block", {"slot": _u(signed.message.slot), "block": _hex(root)})
+        self._json({})
+
+
+def _bits_from_hex(hex_str: str):
+    from ..ssz.core import Bitlist
+
+    data = bytes.fromhex(hex_str[2:])
+    # decode SSZ bitlist bytes (with delimiter)
+    last = data[-1]
+    total = (len(data) - 1) * 8 + (last.bit_length() - 1)
+    return [bool((data[i // 8] >> (i % 8)) & 1) for i in range(total)]
+
+
+_ROUTES = [
+    (r"/eth/v1/beacon/genesis", "GET", BeaconApiHandler.get_genesis),
+    (r"/eth/v1/beacon/states/([^/]+)/root", "GET", BeaconApiHandler.get_state_root),
+    (r"/eth/v1/beacon/states/([^/]+)/finality_checkpoints", "GET", BeaconApiHandler.get_finality_checkpoints),
+    (r"/eth/v1/beacon/states/([^/]+)/validators", "GET", BeaconApiHandler.get_validators),
+    (r"/eth/v1/beacon/states/([^/]+)/validators/([^/]+)", "GET", BeaconApiHandler.get_validator),
+    (r"/eth/v1/beacon/blocks/([^/]+)/root", "GET", BeaconApiHandler.get_block_root),
+    (r"/eth/v2/beacon/blocks/([^/]+)", "GET", BeaconApiHandler.get_block),
+    (r"/eth/v1/beacon/headers/([^/]+)", "GET", BeaconApiHandler.get_header),
+    (r"/eth/v1/node/health", "GET", BeaconApiHandler.get_health),
+    (r"/eth/v1/node/version", "GET", BeaconApiHandler.get_version),
+    (r"/eth/v1/node/syncing", "GET", BeaconApiHandler.get_syncing),
+    (r"/eth/v1/config/spec", "GET", BeaconApiHandler.get_spec),
+    (r"/eth/v1/validator/duties/attester/(\d+)", "POST", BeaconApiHandler.post_attester_duties),
+    (r"/eth/v1/validator/duties/proposer/(\d+)", "GET", BeaconApiHandler.get_proposer_duties),
+    (r"/eth/v1/beacon/pool/attestations", "POST", BeaconApiHandler.post_pool_attestations),
+    (r"/eth/v2/beacon/blocks", "POST", BeaconApiHandler.post_publish_block),
+]
+
+
+class EventBus:
+    """SSE topics (events.rs analog), minimal pub-sub."""
+
+    def __init__(self):
+        self.subscribers: list = []
+        self._lock = threading.Lock()
+
+    def publish(self, topic: str, payload: dict):
+        with self._lock:
+            for q in self.subscribers:
+                q.append((topic, payload))
+
+
+def serve(chain, op_pool=None, host="127.0.0.1", port=0):
+    """Start the API server; returns (server, thread, actual_port)."""
+    handler = type(
+        "BoundHandler",
+        (BeaconApiHandler,),
+        {"chain": chain, "op_pool": op_pool, "event_bus": EventBus()},
+    )
+    server = ThreadingHTTPServer((host, port), handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server, thread, server.server_address[1]

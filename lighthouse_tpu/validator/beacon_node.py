@@ -63,6 +63,15 @@ class InProcessBeaconNode:
         chain = self.chain
         state = chain.head_state()
         spec = chain.spec
+        # advance a clone when the requested epoch is beyond the head's
+        # shuffling horizon (the reference advances the state the same way
+        # in its duties endpoint)
+        if epoch > acc.get_current_epoch(state, spec) + 1:
+            from ..testing.harness import clone_state
+            from ..state_transition.slot import process_slots
+
+            state = clone_state(state, spec)
+            process_slots(state, spec, h.compute_start_slot_at_epoch(epoch, spec))
         cache = acc.build_committee_cache(state, spec, epoch)
         wanted = set(indices)
         duties = []

@@ -1,0 +1,78 @@
+"""HTTP Beacon API: server routes + typed client roundtrip over a live
+socket (the http_api/tests analog, in-process)."""
+
+import pytest
+
+from lighthouse_tpu.api.client import BeaconNodeHttpClient
+from lighthouse_tpu.api.http_api import serve
+from lighthouse_tpu.chain.beacon_chain import BeaconChain
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.state_transition.slot import types_for_slot
+from lighthouse_tpu.testing.harness import StateHarness, clone_state
+from lighthouse_tpu.types.spec import minimal_spec
+
+VALIDATORS = 16
+
+
+@pytest.fixture(scope="module")
+def api():
+    bls.set_backend("fake")
+    spec = minimal_spec()
+    harness = StateHarness.new(spec, VALIDATORS)
+    chain = BeaconChain(spec, clone_state(harness.state, spec))
+    server, thread, port = serve(chain)
+    client = BeaconNodeHttpClient(f"http://127.0.0.1:{port}")
+    yield harness, chain, client
+    server.shutdown()
+
+
+def test_node_endpoints(api):
+    harness, chain, client = api
+    assert client.is_healthy()
+    assert "lighthouse-tpu" in client.version()
+    sy = client.syncing()
+    assert "head_slot" in sy
+
+
+def test_genesis_and_spec(api):
+    harness, chain, client = api
+    g = client.genesis()
+    assert int(g["genesis_time"]) == harness.state.genesis_time
+    assert client.genesis_validators_root() == bytes(
+        harness.state.genesis_validators_root
+    )
+    sp = client.spec()
+    assert int(sp["SLOTS_PER_EPOCH"]) == chain.spec.preset.SLOTS_PER_EPOCH
+
+
+def test_state_and_validators(api):
+    harness, chain, client = api
+    root = client.state_root("head")
+    assert len(root) == 32
+    vals = client.validators("head")
+    assert len(vals) == VALIDATORS
+    fc = client.finality_checkpoints("head")
+    assert fc["finalized"]["epoch"] == "0"
+
+
+def test_duties_roundtrip(api):
+    harness, chain, client = api
+    duties = client.attester_duties(0, list(range(VALIDATORS)))
+    assert len(duties) == VALIDATORS  # every validator has one duty per epoch
+    proposers = client.proposer_duties(0)
+    assert len(proposers) == chain.spec.preset.SLOTS_PER_EPOCH
+
+
+def test_block_publish_and_query(api):
+    harness, chain, client = api
+    slot = harness.state.slot + 1
+    signed, _ = harness.produce_block(slot, attestations=[], full_sync=False)
+    harness.apply_block(signed)
+    chain.slot_clock.set_slot(slot)
+    chain.per_slot_task()
+    types = types_for_slot(chain.spec, slot)
+    client.publish_block(signed, types)
+    assert chain.head_state().slot == slot
+    hdr = client.header("head")
+    assert int(hdr["header"]["message"]["slot"]) == slot
+    assert client.block_root("head") == chain.head_root
