@@ -1,0 +1,167 @@
+"""Slasher — offline slashing detection over min/max target arrays.
+
+Parity surface: /root/reference/slasher/src/ — attestation queues
+(attestation_queue.rs), per-epoch batch processing (slasher.rs), and the
+min-max chunked arrays (array.rs) that answer "does any prior attestation
+surround / get surrounded by this one" in O(1) per validator via running
+minima/maxima of target epochs indexed by source epoch; block proposal
+double-signing detection (block_queue.rs). Backing storage is the same
+KeyValueStore abstraction the beacon store uses (LMDB/MDBX role).
+
+Detection invariants (array.rs):
+  min_targets[v][e] = min target of attestations by v with source > e
+  max_targets[v][e] = max target of attestations by v with source < e
+  new att (s, t) is SURROUNDED by an existing one iff max_targets[v][s] > t
+  new att (s, t) SURROUNDS an existing one     iff min_targets[v][s] < t
+Arrays are stored in fixed-size chunks per validator (chunked columns), so
+the working set for an epoch batch stays small.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+from ..store.kv import Column, KeyValueStore, MemoryStore
+
+CHUNK = 16  # epochs per chunk (C, array.rs chunk size analog)
+MAX_HISTORY = 4096  # epochs of history kept (slasher config default)
+
+
+@dataclass
+class AttestationRecord:
+    validator_index: int
+    source: int
+    target: int
+    data_root: bytes
+    indexed: object = None     # full IndexedAttestation for evidence
+
+
+@dataclass
+class ProposalRecord:
+    proposer_index: int
+    slot: int
+    block_root: bytes
+    signed_header: object = None
+
+
+@dataclass
+class SlashingEvidence:
+    kind: str                  # "double_vote" | "surround" | "double_proposal"
+    validator_index: int
+    prior: object
+    new: object
+
+
+class Slasher:
+    def __init__(self, store: KeyValueStore | None = None):
+        self.store = store or MemoryStore()
+        self.attestation_queue: list[AttestationRecord] = []
+        self.proposal_queue: list[ProposalRecord] = []
+        self.found: list[SlashingEvidence] = []
+
+    # ------------------------------------------------------------- queues
+
+    def accept_attestation(self, rec: AttestationRecord) -> None:
+        self.attestation_queue.append(rec)
+
+    def accept_proposal(self, rec: ProposalRecord) -> None:
+        self.proposal_queue.append(rec)
+
+    # ------------------------------------------------------------- storage
+
+    @staticmethod
+    def _chunk_key(validator: int, kind: str, chunk_idx: int) -> bytes:
+        return kind.encode() + validator.to_bytes(8, "little") + chunk_idx.to_bytes(8, "little")
+
+    def _get_chunk(self, validator: int, kind: str, chunk_idx: int) -> list[int]:
+        raw = self.store.get(Column.metadata, self._chunk_key(validator, kind, chunk_idx))
+        default = 2**63 if kind.startswith("min") else 0
+        if raw is None:
+            return [default] * CHUNK
+        return [int.from_bytes(raw[i * 8 : (i + 1) * 8], "little") for i in range(CHUNK)]
+
+    def _put_chunk(self, validator: int, kind: str, chunk_idx: int, vals: list[int]) -> None:
+        raw = b"".join(v.to_bytes(8, "little") for v in vals)
+        self.store.put(Column.metadata, self._chunk_key(validator, kind, chunk_idx), raw)
+
+    def _att_key(self, validator: int, target: int) -> bytes:
+        return b"att" + validator.to_bytes(8, "little") + target.to_bytes(8, "little")
+
+    # ------------------------------------------------------------- detection
+
+    def _check_double_vote(self, rec: AttestationRecord) -> SlashingEvidence | None:
+        raw = self.store.get(Column.metadata, self._att_key(rec.validator_index, rec.target))
+        if raw is not None:
+            prior_root = raw[16:48]
+            if prior_root != rec.data_root:
+                return SlashingEvidence("double_vote", rec.validator_index, raw, rec)
+        return None
+
+    def _min_target_with_source_gt(self, v: int, source: int) -> int:
+        """min target over attestations with source > `source`."""
+        best = 2**63
+        for e in range(source + 1, source + 1 + MAX_HISTORY):
+            chunk = self._get_chunk(v, "minbysrc", e // CHUNK)
+            val = chunk[e % CHUNK]
+            if val != 2**63:
+                best = min(best, val)
+            if e % CHUNK == CHUNK - 1 and best != 2**63:
+                break
+        return best
+
+    def _max_target_with_source_lt(self, v: int, source: int) -> int:
+        best = 0
+        for e in range(max(0, source - MAX_HISTORY), source):
+            chunk = self._get_chunk(v, "maxbysrc", e // CHUNK)
+            best = max(best, chunk[e % CHUNK])
+        return best
+
+    def process_queued(self) -> list[SlashingEvidence]:
+        """Epoch-batch processing (slasher.rs process_batch)."""
+        new_evidence: list[SlashingEvidence] = []
+        for rec in self.attestation_queue:
+            v = rec.validator_index
+            ev = self._check_double_vote(rec)
+            if ev is None:
+                # surround checks against recorded extrema
+                max_t = self._max_target_with_source_lt(v, rec.source)
+                if max_t > rec.target:
+                    ev = SlashingEvidence("surround", v, ("surrounded_by_prior", max_t), rec)
+                else:
+                    min_t = self._min_target_with_source_gt(v, rec.source)
+                    if min_t < rec.target and min_t != 2**63:
+                        ev = SlashingEvidence("surround", v, ("surrounds_prior", min_t), rec)
+            if ev is not None:
+                new_evidence.append(ev)
+                continue
+            # record
+            self.store.put(
+                Column.metadata,
+                self._att_key(v, rec.target),
+                rec.source.to_bytes(8, "little")
+                + rec.target.to_bytes(8, "little")
+                + rec.data_root,
+            )
+            ci = rec.source // CHUNK
+            mn = self._get_chunk(v, "minbysrc", ci)
+            mn[rec.source % CHUNK] = min(mn[rec.source % CHUNK], rec.target)
+            self._put_chunk(v, "minbysrc", ci, mn)
+            mx = self._get_chunk(v, "maxbysrc", ci)
+            mx[rec.source % CHUNK] = max(mx[rec.source % CHUNK], rec.target)
+            self._put_chunk(v, "maxbysrc", ci, mx)
+        self.attestation_queue.clear()
+
+        for rec in self.proposal_queue:
+            key = b"blk" + rec.proposer_index.to_bytes(8, "little") + rec.slot.to_bytes(8, "little")
+            raw = self.store.get(Column.metadata, key)
+            if raw is not None and raw != rec.block_root:
+                new_evidence.append(
+                    SlashingEvidence("double_proposal", rec.proposer_index, raw, rec)
+                )
+            else:
+                self.store.put(Column.metadata, key, rec.block_root)
+        self.proposal_queue.clear()
+
+        self.found.extend(new_evidence)
+        return new_evidence

@@ -1,0 +1,82 @@
+"""Slasher detection tests: double votes, both surround directions,
+double proposals, chunked persistence across instances."""
+
+import pytest
+
+from lighthouse_tpu.slasher.slasher import (
+    AttestationRecord,
+    ProposalRecord,
+    Slasher,
+)
+from lighthouse_tpu.store.kv import MemoryStore
+
+
+def att(v, s, t, root=b"\x01" * 32):
+    return AttestationRecord(validator_index=v, source=s, target=t, data_root=root)
+
+
+def test_benign_attestations_no_evidence():
+    sl = Slasher()
+    for e in range(5):
+        sl.accept_attestation(att(0, e, e + 1))
+    assert sl.process_queued() == []
+
+
+def test_double_vote_detected():
+    sl = Slasher()
+    sl.accept_attestation(att(1, 0, 5, root=b"\x0a" * 32))
+    sl.process_queued()
+    sl.accept_attestation(att(1, 1, 5, root=b"\x0b" * 32))
+    ev = sl.process_queued()
+    assert len(ev) == 1 and ev[0].kind == "double_vote" and ev[0].validator_index == 1
+
+
+def test_surrounded_by_prior_detected():
+    sl = Slasher()
+    sl.accept_attestation(att(2, 1, 10))
+    sl.process_queued()
+    # (3, 8) is surrounded by (1, 10)
+    sl.accept_attestation(att(2, 3, 8))
+    ev = sl.process_queued()
+    assert len(ev) == 1 and ev[0].kind == "surround"
+
+
+def test_surrounds_prior_detected():
+    sl = Slasher()
+    sl.accept_attestation(att(3, 4, 6))
+    sl.process_queued()
+    # (2, 9) surrounds (4, 6)
+    sl.accept_attestation(att(3, 2, 9))
+    ev = sl.process_queued()
+    assert len(ev) == 1 and ev[0].kind == "surround"
+
+
+def test_same_attestation_idempotent():
+    sl = Slasher()
+    sl.accept_attestation(att(4, 1, 2))
+    sl.process_queued()
+    sl.accept_attestation(att(4, 1, 2))
+    assert sl.process_queued() == []
+
+
+def test_double_proposal():
+    sl = Slasher()
+    sl.accept_proposal(ProposalRecord(7, 100, b"\x01" * 32))
+    sl.process_queued()
+    sl.accept_proposal(ProposalRecord(7, 100, b"\x01" * 32))  # same: fine
+    assert sl.process_queued() == []
+    sl.accept_proposal(ProposalRecord(7, 100, b"\x02" * 32))
+    ev = sl.process_queued()
+    assert len(ev) == 1 and ev[0].kind == "double_proposal"
+
+
+def test_persistence_across_instances():
+    store = MemoryStore()
+    sl = Slasher(store)
+    sl.accept_attestation(att(5, 1, 10))
+    sl.process_queued()
+    # new slasher over the same store still sees history
+    sl2 = Slasher(store)
+    sl2.accept_attestation(att(5, 3, 8))
+    ev = sl2.process_queued()
+    assert len(ev) == 1 and ev[0].kind == "surround"
