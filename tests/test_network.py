@@ -1,0 +1,207 @@
+"""Network layer: snappy codec, gossip topics/router, RPC codec + handler,
+peer scoring."""
+
+import pytest
+
+from lighthouse_tpu.network import snappy
+from lighthouse_tpu.network.gossip import (
+    InProcessGossipRouter,
+    attestation_subnet_topic,
+    compute_subnet_for_attestation,
+    message_id,
+    topic_name,
+)
+from lighthouse_tpu.network.peer_manager import (
+    BAN_THRESHOLD,
+    ConnectionState,
+    PeerAction,
+    PeerManager,
+)
+from lighthouse_tpu.network.rpc import (
+    BlocksByRangeRequest,
+    Protocol,
+    RESP_SUCCESS,
+    RpcHandler,
+    StatusMessage,
+    decode_chunk,
+    decode_response_chunk,
+    encode_chunk,
+)
+from lighthouse_tpu.types.spec import minimal_spec
+
+
+# ------------------------------------------------------------------ snappy
+
+
+@pytest.mark.parametrize(
+    "data",
+    [
+        b"",
+        b"a",
+        b"hello world",
+        b"ab" * 5000,                      # highly compressible
+        bytes(range(256)) * 10,
+        b"\x00" * 100000,
+    ],
+)
+def test_snappy_roundtrip(data):
+    comp = snappy.compress(data)
+    assert snappy.decompress(comp) == data
+    if len(data) > 1000 and len(set(data)) < 10:
+        assert len(comp) < len(data) // 2  # actually compresses
+
+
+def test_snappy_rejects_garbage():
+    with pytest.raises(snappy.SnappyError):
+        snappy.decompress(b"\xff\xff\xff\xff\xff\xff")
+
+
+def test_snappy_overlapping_copy():
+    # run-length via overlapping copy: literal 'ab' + copy(offset=2, len=8)
+    payload = bytes([10]) + bytes([(2 - 1) << 2]) + b"ab" + bytes([((8 - 1) << 2) | 2]) + (2).to_bytes(2, "little")
+    assert snappy.decompress(payload) == b"ab" * 5
+
+
+# ------------------------------------------------------------------ gossip
+
+
+def test_topic_names():
+    fd = bytes.fromhex("01020304")
+    assert topic_name(fd, "beacon_block") == "/eth2/01020304/beacon_block/ssz_snappy"
+    assert attestation_subnet_topic(fd, 5).endswith("beacon_attestation_5/ssz_snappy")
+
+
+def test_subnet_computation():
+    spec = minimal_spec()
+    s0 = compute_subnet_for_attestation(2, 0, 0, spec)
+    s1 = compute_subnet_for_attestation(2, 0, 1, spec)
+    s2 = compute_subnet_for_attestation(2, 1, 0, spec)
+    assert s1 == (s0 + 1) % spec.attestation_subnet_count
+    assert s2 == (s0 + 2) % spec.attestation_subnet_count
+
+
+def test_gossip_router_dedup_and_delivery():
+    router = InProcessGossipRouter()
+    got_a, got_b = [], []
+    router.subscribe("a", "t", lambda m: (got_a.append(m), True)[1])
+    router.subscribe("b", "t", lambda m: (got_b.append(m), True)[1])
+    n = router.publish("a", "t", b"payload")
+    assert n == 1                      # not delivered back to the source
+    assert len(got_b) == 1 and not got_a
+    # duplicate publish is suppressed by message id
+    assert router.publish("b", "t", b"payload") == 0
+
+
+def test_message_id_stable():
+    mid1 = message_id("t", snappy.compress(b"x"))
+    mid2 = message_id("t", snappy.compress(b"x"))
+    assert mid1 == mid2 and len(mid1) == 20
+
+
+# ------------------------------------------------------------------ rpc
+
+
+def test_rpc_chunk_roundtrip():
+    msg = StatusMessage.make(
+        fork_digest=b"\x01\x02\x03\x04",
+        finalized_root=b"\x11" * 32,
+        finalized_epoch=7,
+        head_root=b"\x22" * 32,
+        head_slot=99,
+    )
+    chunk = encode_chunk(StatusMessage.serialize(msg))
+    payload, _ = decode_chunk(chunk)
+    assert StatusMessage.deserialize(payload) == msg
+
+
+@pytest.fixture(scope="module")
+def chain_env():
+    from lighthouse_tpu.chain.beacon_chain import BeaconChain
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.testing.harness import StateHarness, clone_state
+
+    bls.set_backend("fake")
+    spec = minimal_spec()
+    harness = StateHarness.new(spec, 16)
+    chain = BeaconChain(spec, clone_state(harness.state, spec))
+    for _ in range(3):
+        slot = harness.state.slot + 1
+        signed, _post = harness.produce_block(slot, attestations=[], full_sync=False)
+        harness.apply_block(signed)
+        chain.slot_clock.set_slot(slot)
+        chain.per_slot_task()
+        chain.process_block(signed)
+    return harness, chain
+
+
+def test_rpc_status_and_blocks_by_range(chain_env):
+    harness, chain = chain_env
+    handler = RpcHandler(chain)
+    # status
+    chunks = handler.handle("peer1", Protocol.status, encode_chunk(b""))
+    code, payload = decode_response_chunk(chunks[0])
+    assert code == RESP_SUCCESS
+    status = StatusMessage.deserialize(payload)
+    assert status.head_slot == 3
+
+    # blocks by range
+    req = BlocksByRangeRequest.make(start_slot=1, count=10, step=1)
+    chunks = handler.handle(
+        "peer1", Protocol.blocks_by_range, encode_chunk(BlocksByRangeRequest.serialize(req))
+    )
+    assert len(chunks) == 3
+    for c in chunks:
+        code, payload = decode_response_chunk(c)
+        assert code == RESP_SUCCESS
+
+
+def test_rpc_rate_limit(chain_env):
+    harness, chain = chain_env
+    handler = RpcHandler(chain)
+    ok = 0
+    for _ in range(10):
+        chunks = handler.handle("peer2", Protocol.ping, encode_chunk((1).to_bytes(8, "little")))
+        code, _ = decode_response_chunk(chunks[0])
+        if code == RESP_SUCCESS:
+            ok += 1
+    assert ok < 10  # bucket exhausted
+
+
+# ------------------------------------------------------------------ peers
+
+
+def test_peer_scoring_and_ban():
+    t = [0.0]
+    pm = PeerManager(now_fn=lambda: t[0])
+    pm.connect("p1")
+    pm.report("p1", PeerAction.mid_tolerance)
+    assert pm.score("p1") == -5.0
+    assert "p1" in pm.connected_peers()
+    for _ in range(10):
+        pm.report("p1", PeerAction.low_tolerance)
+    assert pm.is_banned("p1")
+    assert not pm.connect("p1")
+    # ban expires
+    t[0] += 3600
+    assert not pm.is_banned("p1")
+    assert pm.connect("p1")
+
+
+def test_peer_score_decay_and_trusted():
+    t = [0.0]
+    pm = PeerManager(now_fn=lambda: t[0])
+    pm.connect("p2")
+    pm.report("p2", PeerAction.low_tolerance)
+    t[0] += 600  # one half-life
+    assert abs(pm.score("p2") + 5.0) < 0.1
+    pm._peer("p3").trusted = True
+    pm.connect("p3")
+    pm.report("p3", PeerAction.fatal)
+    assert pm.score("p3") == 0.0
+
+
+def test_fatal_is_instant_ban():
+    pm = PeerManager(now_fn=lambda: 0.0)
+    pm.connect("p4")
+    pm.report("p4", PeerAction.fatal)
+    assert pm.peers["p4"].state == ConnectionState.banned
