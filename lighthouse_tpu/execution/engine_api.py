@@ -1,0 +1,202 @@
+"""Execution-layer interface: engine API client, JWT auth, engine state
+machine, and a mock EL for tests.
+
+Parity surface: /root/reference/beacon_node/execution_layer/src/ —
+engine_api/http.rs (JSON-RPC engine_newPayloadV*, engine_forkchoiceUpdatedV*,
+engine_getPayloadV* with JWT bearer auth, auth.rs), engines.rs (upcheck/
+offline state machine with retry), and test_utils/ (the mock EL +
+ExecutionBlockGenerator the whole beacon test-suite leans on, SURVEY §4).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+import urllib.request
+from dataclasses import dataclass, field
+from enum import Enum
+
+
+class PayloadStatus(str, Enum):
+    valid = "VALID"
+    invalid = "INVALID"
+    syncing = "SYNCING"
+    accepted = "ACCEPTED"
+
+
+# ------------------------------------------------------------ JWT (auth.rs)
+
+
+def _b64url(data: bytes) -> bytes:
+    return base64.urlsafe_b64encode(data).rstrip(b"=")
+
+
+def make_jwt(secret: bytes, issued_at: int | None = None) -> str:
+    header = _b64url(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+    claims = _b64url(
+        json.dumps({"iat": issued_at or int(time.time())}).encode()
+    )
+    signing_input = header + b"." + claims
+    sig = hmac.new(secret, signing_input, hashlib.sha256).digest()
+    return (signing_input + b"." + _b64url(sig)).decode()
+
+
+def verify_jwt(secret: bytes, token: str, max_age: int = 60) -> bool:
+    try:
+        header, claims, sig = token.split(".")
+        signing_input = (header + "." + claims).encode()
+        expected = _b64url(hmac.new(secret, signing_input, hashlib.sha256).digest())
+        if not hmac.compare_digest(expected.decode(), sig):
+            return False
+        pad = "=" * (-len(claims) % 4)
+        iat = json.loads(base64.urlsafe_b64decode(claims + pad))["iat"]
+        return abs(time.time() - iat) <= max_age
+    except Exception:
+        return False
+
+
+# ------------------------------------------------------------ engine states
+
+
+class EngineHealth(Enum):
+    synced = "synced"
+    syncing = "syncing"
+    offline = "offline"
+    auth_failed = "auth_failed"
+
+
+@dataclass
+class EngineState:
+    """engines.rs upcheck/fallback state machine."""
+
+    health: EngineHealth = EngineHealth.offline
+    consecutive_failures: int = 0
+    last_upcheck: float = 0.0
+
+    def on_success(self):
+        self.health = EngineHealth.synced
+        self.consecutive_failures = 0
+
+    def on_failure(self):
+        self.consecutive_failures += 1
+        if self.consecutive_failures >= 3:
+            self.health = EngineHealth.offline
+
+
+class EngineApiClient:
+    """JSON-RPC over HTTP with JWT (engine_api/http.rs analog)."""
+
+    def __init__(self, url: str, jwt_secret: bytes, timeout: float = 8.0):
+        self.url = url
+        self.jwt_secret = jwt_secret
+        self.timeout = timeout
+        self.state = EngineState()
+        self._id = 0
+
+    def _call(self, method: str, params: list):
+        self._id += 1
+        body = json.dumps(
+            {"jsonrpc": "2.0", "method": method, "params": params, "id": self._id}
+        ).encode()
+        req = urllib.request.Request(
+            self.url,
+            data=body,
+            headers={
+                "Content-Type": "application/json",
+                "Authorization": f"Bearer {make_jwt(self.jwt_secret)}",
+            },
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                resp = json.loads(r.read())
+            self.state.on_success()
+        except Exception:
+            self.state.on_failure()
+            raise
+        if "error" in resp and resp["error"]:
+            raise RuntimeError(f"engine error: {resp['error']}")
+        return resp.get("result")
+
+    def new_payload(self, payload_json: dict) -> dict:
+        return self._call("engine_newPayloadV3", [payload_json])
+
+    def forkchoice_updated(self, head: bytes, safe: bytes, finalized: bytes, attrs=None) -> dict:
+        state = {
+            "headBlockHash": "0x" + head.hex(),
+            "safeBlockHash": "0x" + safe.hex(),
+            "finalizedBlockHash": "0x" + finalized.hex(),
+        }
+        return self._call("engine_forkchoiceUpdatedV3", [state, attrs])
+
+    def get_payload(self, payload_id: str) -> dict:
+        return self._call("engine_getPayloadV3", [payload_id])
+
+
+# ------------------------------------------------------------ mock EL
+
+
+@dataclass
+class MockExecutionLayer:
+    """In-process EL double (execution_layer/src/test_utils analog):
+    maintains a toy block tree, validates payload parent linkage, supports
+    forced INVALID verdicts for invalidation tests."""
+
+    blocks: dict[bytes, dict] = field(default_factory=dict)
+    head: bytes = b"\x00" * 32
+    invalid_hashes: set = field(default_factory=set)
+    payload_counter: int = 0
+    pending_payloads: dict = field(default_factory=dict)
+
+    def __post_init__(self):
+        self.blocks[self.head] = {"number": 0, "parent": None}
+
+    # engine API surface (duck-typed like EngineApiClient)
+
+    def new_payload(self, payload_json: dict) -> dict:
+        block_hash = bytes.fromhex(payload_json["blockHash"][2:])
+        parent = bytes.fromhex(payload_json["parentHash"][2:])
+        if block_hash in self.invalid_hashes:
+            return {"status": PayloadStatus.invalid.value, "latestValidHash": None}
+        if parent not in self.blocks:
+            return {"status": PayloadStatus.syncing.value}
+        self.blocks[block_hash] = {
+            "number": self.blocks[parent]["number"] + 1,
+            "parent": parent,
+        }
+        return {"status": PayloadStatus.valid.value, "latestValidHash": payload_json["blockHash"]}
+
+    def forkchoice_updated(self, head: bytes, safe: bytes, finalized: bytes, attrs=None) -> dict:
+        if head not in self.blocks:
+            return {"payloadStatus": {"status": PayloadStatus.syncing.value}, "payloadId": None}
+        self.head = head
+        payload_id = None
+        if attrs is not None:
+            self.payload_counter += 1
+            payload_id = f"0x{self.payload_counter:016x}"
+            self.pending_payloads[payload_id] = {
+                "parent": head,
+                "timestamp": attrs.get("timestamp"),
+                "prevRandao": attrs.get("prevRandao"),
+            }
+        return {
+            "payloadStatus": {"status": PayloadStatus.valid.value},
+            "payloadId": payload_id,
+        }
+
+    def get_payload(self, payload_id: str) -> dict:
+        info = self.pending_payloads.pop(payload_id)
+        parent = info["parent"]
+        number = self.blocks[parent]["number"] + 1
+        block_hash = hashlib.sha256(b"mock-el" + parent + number.to_bytes(8, "big")).digest()
+        return {
+            "executionPayload": {
+                "parentHash": "0x" + parent.hex(),
+                "blockHash": "0x" + block_hash.hex(),
+                "blockNumber": hex(number),
+                "timestamp": info["timestamp"],
+                "prevRandao": info["prevRandao"],
+            }
+        }

@@ -1,0 +1,54 @@
+"""Execution layer: JWT auth, engine state machine, mock EL block tree."""
+
+import time
+
+from lighthouse_tpu.execution.engine_api import (
+    EngineHealth,
+    EngineState,
+    MockExecutionLayer,
+    PayloadStatus,
+    make_jwt,
+    verify_jwt,
+)
+
+
+def test_jwt_roundtrip():
+    secret = b"\x42" * 32
+    token = make_jwt(secret)
+    assert verify_jwt(secret, token)
+    assert not verify_jwt(b"\x43" * 32, token)
+    stale = make_jwt(secret, issued_at=int(time.time()) - 3600)
+    assert not verify_jwt(secret, stale)
+
+
+def test_engine_state_machine():
+    st = EngineState()
+    assert st.health == EngineHealth.offline
+    st.on_success()
+    assert st.health == EngineHealth.synced
+    st.on_failure()
+    st.on_failure()
+    assert st.health == EngineHealth.synced  # tolerate 2
+    st.on_failure()
+    assert st.health == EngineHealth.offline
+
+
+def test_mock_el_payload_flow():
+    el = MockExecutionLayer()
+    genesis = b"\x00" * 32
+    # build a payload on genesis
+    r = el.forkchoice_updated(genesis, genesis, genesis, attrs={"timestamp": "0x1", "prevRandao": "0x" + "00" * 32})
+    pid = r["payloadId"]
+    assert pid is not None
+    payload = el.get_payload(pid)["executionPayload"]
+    # import it
+    res = el.new_payload(payload)
+    assert res["status"] == PayloadStatus.valid.value
+    # unknown parent -> syncing
+    orphan = dict(payload)
+    orphan["parentHash"] = "0x" + (b"\x99" * 32).hex()
+    orphan["blockHash"] = "0x" + (b"\x98" * 32).hex()
+    assert el.new_payload(orphan)["status"] == PayloadStatus.syncing.value
+    # forced invalid
+    el.invalid_hashes.add(bytes.fromhex(payload["blockHash"][2:]))
+    assert el.new_payload(payload)["status"] == PayloadStatus.invalid.value
