@@ -1,0 +1,187 @@
+"""Metrics: process-global Prometheus-style registry.
+
+Parity surface: /root/reference/common/lighthouse_metrics/src/lib.rs (global
+registry, int/float gauges, counters, histograms with explicit buckets and
+start_timer guards) and beacon_node/http_metrics (the /metrics text
+exposition). Pure stdlib; the exposition format is Prometheus 0.0.4 text.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+        self._lock = threading.Lock()
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name, help_):
+        super().__init__(name, help_)
+        self.value = 0.0
+
+    def inc(self, amount: float = 1.0):
+        with self._lock:
+            self.value += amount
+
+    def expose(self) -> list[str]:
+        return [f"{self.name} {self.value:g}"]
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name, help_):
+        super().__init__(name, help_)
+        self.value = 0.0
+
+    def set(self, v: float):
+        with self._lock:
+            self.value = v
+
+    def inc(self, amount: float = 1.0):
+        with self._lock:
+            self.value += amount
+
+    def dec(self, amount: float = 1.0):
+        with self._lock:
+            self.value -= amount
+
+    def expose(self) -> list[str]:
+        return [f"{self.name} {self.value:g}"]
+
+
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help_, buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help_)
+        self.buckets = tuple(sorted(buckets))
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.total = 0.0
+        self.n = 0
+
+    def observe(self, v: float):
+        with self._lock:
+            self.total += v
+            self.n += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self.counts[i] += 1
+                    return
+            self.counts[-1] += 1
+
+    class _Timer:
+        def __init__(self, hist):
+            self.hist = hist
+
+        def __enter__(self):
+            self.t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            self.hist.observe(time.perf_counter() - self.t0)
+
+    def start_timer(self) -> "_Timer":
+        return self._Timer(self)
+
+    def expose(self) -> list[str]:
+        out = []
+        cum = 0
+        for b, c in zip(self.buckets, self.counts):
+            cum += c
+            out.append(f'{self.name}_bucket{{le="{b:g}"}} {cum}')
+        cum += self.counts[-1]
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
+        out.append(f"{self.name}_sum {self.total:g}")
+        out.append(f"{self.name}_count {self.n}")
+        return out
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def _register(self, metric):
+        with self._lock:
+            if metric.name in self._metrics:
+                return self._metrics[metric.name]
+            self._metrics[metric.name] = metric
+            return metric
+
+    def counter(self, name, help_="") -> Counter:
+        return self._register(Counter(name, help_))
+
+    def gauge(self, name, help_="") -> Gauge:
+        return self._register(Gauge(name, help_))
+
+    def histogram(self, name, help_="", buckets=DEFAULT_BUCKETS) -> Histogram:
+        return self._register(Histogram(name, help_, buckets))
+
+    def expose_text(self) -> str:
+        lines = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = Registry()
+
+# core metrics (metric name parity with beacon_chain/src/metrics.rs themes)
+BLOCK_PROCESSING_TIME = REGISTRY.histogram(
+    "beacon_block_processing_seconds", "Full block import latency"
+)
+SIGNATURE_BATCH_SIZE = REGISTRY.histogram(
+    "bls_batch_verify_sets", "Signature sets per device batch",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
+)
+SIGNATURE_VERIFY_TIME = REGISTRY.histogram(
+    "bls_batch_verify_seconds", "Device batch verification latency"
+)
+ATTESTATION_BATCHES = REGISTRY.counter(
+    "gossip_attestation_batches_total", "Coalesced attestation batches"
+)
+HEAD_SLOT = REGISTRY.gauge("beacon_head_slot", "Canonical head slot")
+
+
+def metrics_http_server(host="127.0.0.1", port=0, registry=REGISTRY):
+    """/metrics scrape endpoint (http_metrics analog)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    import threading as _t
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            if self.path != "/metrics":
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = registry.expose_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    thread = _t.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server, server.server_address[1]

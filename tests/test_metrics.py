@@ -1,0 +1,52 @@
+"""Metrics registry + /metrics endpoint."""
+
+import urllib.request
+
+from lighthouse_tpu.utils.metrics import Registry, metrics_http_server
+
+
+def test_counter_gauge_histogram_exposition():
+    reg = Registry()
+    c = reg.counter("requests_total", "Total requests")
+    g = reg.gauge("head_slot")
+    h = reg.histogram("latency_seconds", buckets=(0.1, 1.0))
+    c.inc()
+    c.inc(2)
+    g.set(42)
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = reg.expose_text()
+    assert "requests_total 3" in text
+    assert "head_slot 42" in text
+    assert 'latency_seconds_bucket{le="0.1"} 1' in text
+    assert 'latency_seconds_bucket{le="1"} 2' in text
+    assert 'latency_seconds_bucket{le="+Inf"} 3' in text
+    assert "latency_seconds_count 3" in text
+
+
+def test_timer_context():
+    reg = Registry()
+    h = reg.histogram("t_seconds")
+    with h.start_timer():
+        pass
+    assert h.n == 1
+
+
+def test_metrics_endpoint():
+    reg = Registry()
+    reg.counter("x_total").inc()
+    server, port = metrics_http_server(registry=reg)
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics") as r:
+            body = r.read().decode()
+        assert "x_total 1" in body
+    finally:
+        server.shutdown()
+
+
+def test_same_name_returns_same_metric():
+    reg = Registry()
+    a = reg.counter("dup_total")
+    b = reg.counter("dup_total")
+    assert a is b
