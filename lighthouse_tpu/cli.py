@@ -1,0 +1,337 @@
+"""lighthouse-tpu CLI — node, validator client, and operator tooling.
+
+Parity surface: /root/reference/lighthouse/src/main.rs:79 (clap root with
+beacon_node / validator_client / account_manager / database_manager /
+validator_manager subcommands) plus the lcli developer tools
+(/root/reference/lcli/src/main.rs:61-486: skip-slots, transition-blocks,
+pretty-ssz, block-root, state-root, mnemonic/interop validators).
+
+Run as `python -m lighthouse_tpu <subcommand>`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _add_spec_arg(p):
+    p.add_argument("--spec", choices=["mainnet", "minimal"], default="mainnet")
+
+
+def _load_spec(args):
+    from .types.spec import mainnet_spec, minimal_spec
+
+    return minimal_spec() if args.spec == "minimal" else mainnet_spec()
+
+
+# ------------------------------------------------------------------ bn
+
+
+def cmd_bn(args):
+    """Run a beacon node: chain + HTTP API + metrics (client/builder.rs)."""
+    from .chain.beacon_chain import BeaconChain
+    from .api.http_api import serve
+    from .crypto import bls
+    from .state_transition.genesis import interop_genesis_state
+    from .store.hot_cold import HotColdDB
+    from .store.native_kv import NativeKVStore
+    from .utils.metrics import metrics_http_server, HEAD_SLOT
+    from .utils.slot_clock import SystemTimeSlotClock
+
+    spec = _load_spec(args)
+    bls.set_backend(args.bls_backend)
+
+    if args.interop_validators:
+        keypairs = bls.interop_keypairs(args.interop_validators)
+        genesis_time = args.genesis_time or int(time.time())
+        state = interop_genesis_state(keypairs, genesis_time, spec)
+    else:
+        print("error: provide --interop-validators N (checkpoint sync: use --checkpoint-state)", file=sys.stderr)
+        return 1
+
+    store = None
+    if args.datadir:
+        store = HotColdDB(
+            spec,
+            hot=NativeKVStore(f"{args.datadir}/hot.db"),
+            cold=NativeKVStore(f"{args.datadir}/cold.db"),
+        )
+    clock = SystemTimeSlotClock(state.genesis_time, spec.seconds_per_slot)
+    chain = BeaconChain(spec, state, store=store, slot_clock=clock)
+
+    server, _t, port = serve(chain, port=args.http_port)
+    print(f"HTTP API on :{port}")
+    mserver, mport = metrics_http_server(port=args.metrics_port)
+    print(f"metrics on :{mport}/metrics")
+
+    try:
+        while True:
+            time.sleep(clock.duration_to_next_slot())
+            chain.per_slot_task()
+            HEAD_SLOT.set(chain.head_state().slot)
+            print(f"slot {clock.now()} head {chain.head_root.hex()[:8]}")
+    except KeyboardInterrupt:
+        server.shutdown()
+        mserver.shutdown()
+    return 0
+
+
+# ------------------------------------------------------------------ vc
+
+
+def cmd_vc(args):
+    """Run a validator client against beacon node(s)."""
+    from .api.client import BeaconNodeHttpClient
+    from .crypto import bls
+    from .validator.beacon_node import BeaconNodeFallback
+    from .validator.services import AttestationService, BlockService, DutiesService
+    from .validator.slashing_protection import SlashingDatabase
+    from .validator.validator_store import ValidatorStore
+
+    spec = _load_spec(args)
+    clients = [BeaconNodeHttpClient(u) for u in args.beacon_nodes.split(",")]
+    nodes = BeaconNodeFallback(clients)
+    gvr = clients[0].genesis_validators_root()
+    sdb = SlashingDatabase(args.slashing_db or ":memory:")
+    store = ValidatorStore(spec, gvr, sdb)
+
+    if args.interop_validators:
+        for i, kp in enumerate(bls.interop_keypairs(args.interop_validators)):
+            store.add_validator(kp.sk, index=i)
+    duties = DutiesService(spec, store, nodes)
+    atts = AttestationService(spec, store, duties, nodes)
+    genesis = clients[0].genesis()
+    genesis_time = int(genesis["genesis_time"])
+    from .utils.slot_clock import SystemTimeSlotClock
+
+    clock = SystemTimeSlotClock(genesis_time, spec.seconds_per_slot)
+    print(f"VC started with {len(store.validators)} validators")
+    try:
+        while True:
+            time.sleep(clock.duration_to_next_slot() + spec.seconds_per_slot / 3)
+            slot = clock.now()
+            if slot is None:
+                continue
+            epoch = slot // spec.preset.SLOTS_PER_EPOCH
+            duties.poll(epoch)
+            n = atts.attest(slot)
+            print(f"slot {slot}: attested {n}")
+    except KeyboardInterrupt:
+        return 0
+
+
+# ------------------------------------------------------------------ lcli tools
+
+
+def cmd_skip_slots(args):
+    from .state_transition.slot import process_slots, types_for_slot
+    from .types.containers import spec_types
+
+    spec = _load_spec(args)
+    types = spec_types(spec.preset, spec.fork_name_at_epoch(0))
+    with open(args.pre_state, "rb") as f:
+        state = types.BeaconState.deserialize(f.read())
+    types2 = types_for_slot(spec, args.slots + state.slot)
+    process_slots(state, spec, state.slot + args.slots)
+    out = types2.BeaconState.serialize(state)
+    with open(args.output, "wb") as f:
+        f.write(out)
+    print(f"advanced to slot {state.slot}; root {types2.BeaconState.hash_tree_root(state).hex()}")
+    return 0
+
+
+def cmd_transition_blocks(args):
+    from .state_transition.block import SignatureStrategy
+    from .state_transition.slot import state_transition, types_for_slot
+    from .types.containers import spec_types
+
+    spec = _load_spec(args)
+    types = spec_types(spec.preset, spec.fork_name_at_epoch(0))
+    with open(args.pre_state, "rb") as f:
+        state = types.BeaconState.deserialize(f.read())
+    with open(args.block, "rb") as f:
+        raw = f.read()
+    btypes = types_for_slot(spec, state.slot + 1)
+    block = btypes.SignedBeaconBlock.deserialize(raw)
+    strategy = (
+        SignatureStrategy.NO_VERIFICATION if args.no_signature_verification
+        else SignatureStrategy.VERIFY_BULK
+    )
+    state_transition(state, block, spec, strategy=strategy)
+    out_types = types_for_slot(spec, state.slot)
+    with open(args.output, "wb") as f:
+        f.write(out_types.BeaconState.serialize(state))
+    print(f"post-state root {out_types.BeaconState.hash_tree_root(state).hex()}")
+    return 0
+
+
+def cmd_block_root(args):
+    from .state_transition.slot import types_for_slot
+
+    spec = _load_spec(args)
+    with open(args.block, "rb") as f:
+        raw = f.read()
+    types = types_for_slot(spec, 0)
+    blk = types.SignedBeaconBlock.deserialize(raw)
+    print(types.BeaconBlock.hash_tree_root(blk.message).hex())
+    return 0
+
+
+def cmd_state_root(args):
+    from .types.containers import spec_types
+
+    spec = _load_spec(args)
+    types = spec_types(spec.preset, spec.fork_name_at_epoch(0))
+    with open(args.state, "rb") as f:
+        state = types.BeaconState.deserialize(f.read())
+    print(types.BeaconState.hash_tree_root(state).hex())
+    return 0
+
+
+def cmd_interop_genesis(args):
+    from .crypto import bls
+    from .state_transition.genesis import interop_genesis_state
+    from .state_transition.slot import types_for_slot
+
+    spec = _load_spec(args)
+    keypairs = bls.interop_keypairs(args.count)
+    state = interop_genesis_state(keypairs, args.genesis_time or int(time.time()), spec)
+    types = types_for_slot(spec, 0)
+    with open(args.output, "wb") as f:
+        f.write(types.BeaconState.serialize(state))
+    print(f"wrote genesis state with {args.count} validators to {args.output}")
+    return 0
+
+
+# ------------------------------------------------------------------ accounts
+
+
+def cmd_validator_create(args):
+    import os
+    import secrets as _secrets
+
+    from .crypto import key_derivation as kd
+    from .crypto import keystore as ks
+    from .crypto import bls
+
+    os.makedirs(args.output_dir, exist_ok=True)
+    seed = _secrets.token_bytes(32) if not args.seed else bytes.fromhex(args.seed)
+    created = []
+    for i in range(args.count):
+        sk_int = kd.derive_path(seed, kd.validator_signing_key_path(i))
+        sk = bls.SecretKey(sk_int)
+        pk_hex = sk.public_key().serialize().hex()
+        keystore = ks.encrypt_keystore(
+            sk_int.to_bytes(32, "big"),
+            args.password,
+            pubkey_hex=pk_hex,
+            path=kd.validator_signing_key_path(i),
+            kdf_function="pbkdf2",
+            kdf_params={"c": args.kdf_rounds, "prf": "hmac-sha256"},
+        )
+        path = os.path.join(args.output_dir, f"keystore-{i}.json")
+        ks.save_keystore(keystore, path)
+        created.append(pk_hex)
+        print(f"validator {i}: 0x{pk_hex}")
+    return 0
+
+
+def cmd_db_inspect(args):
+    from .store.native_kv import NativeKVStore
+    from .store.kv import Column
+
+    store = NativeKVStore(args.db)
+    print(f"total entries: {len(store)}")
+    for col in Column:
+        n = sum(1 for _ in store.iter_column(col))
+        if n:
+            print(f"  {col.name}: {n}")
+    if args.compact:
+        store.compact()
+        print("compacted")
+    store.close()
+    return 0
+
+
+# ------------------------------------------------------------------ parser
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="lighthouse-tpu", description=__doc__)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    bn = sub.add_parser("bn", help="run a beacon node")
+    _add_spec_arg(bn)
+    bn.add_argument("--http-port", type=int, default=5052)
+    bn.add_argument("--metrics-port", type=int, default=5054)
+    bn.add_argument("--datadir", default=None)
+    bn.add_argument("--interop-validators", type=int, default=None)
+    bn.add_argument("--genesis-time", type=int, default=None)
+    bn.add_argument("--bls-backend", default="python", choices=["python", "jax", "fake"])
+    bn.set_defaults(fn=cmd_bn)
+
+    vc = sub.add_parser("vc", help="run a validator client")
+    _add_spec_arg(vc)
+    vc.add_argument("--beacon-nodes", default="http://127.0.0.1:5052")
+    vc.add_argument("--slashing-db", default=None)
+    vc.add_argument("--interop-validators", type=int, default=None)
+    vc.set_defaults(fn=cmd_vc)
+
+    ss = sub.add_parser("skip-slots", help="advance a state N slots")
+    _add_spec_arg(ss)
+    ss.add_argument("--pre-state", required=True)
+    ss.add_argument("--slots", type=int, required=True)
+    ss.add_argument("--output", required=True)
+    ss.set_defaults(fn=cmd_skip_slots)
+
+    tb = sub.add_parser("transition-blocks", help="apply a block to a state")
+    _add_spec_arg(tb)
+    tb.add_argument("--pre-state", required=True)
+    tb.add_argument("--block", required=True)
+    tb.add_argument("--output", required=True)
+    tb.add_argument("--no-signature-verification", action="store_true")
+    tb.set_defaults(fn=cmd_transition_blocks)
+
+    br = sub.add_parser("block-root", help="hash tree root of a block")
+    _add_spec_arg(br)
+    br.add_argument("--block", required=True)
+    br.set_defaults(fn=cmd_block_root)
+
+    sr = sub.add_parser("state-root", help="hash tree root of a state")
+    _add_spec_arg(sr)
+    sr.add_argument("--state", required=True)
+    sr.set_defaults(fn=cmd_state_root)
+
+    ig = sub.add_parser("interop-genesis", help="write an interop genesis state")
+    _add_spec_arg(ig)
+    ig.add_argument("--count", type=int, required=True)
+    ig.add_argument("--genesis-time", type=int, default=None)
+    ig.add_argument("--output", required=True)
+    ig.set_defaults(fn=cmd_interop_genesis)
+
+    vcv = sub.add_parser("validator-create", help="create validator keystores")
+    vcv.add_argument("--count", type=int, default=1)
+    vcv.add_argument("--output-dir", required=True)
+    vcv.add_argument("--password", required=True)
+    vcv.add_argument("--seed", default=None, help="hex seed (EIP-2333)")
+    vcv.add_argument("--kdf-rounds", type=int, default=262144)
+    vcv.set_defaults(fn=cmd_validator_create)
+
+    db = sub.add_parser("db", help="inspect/compact a native store")
+    db.add_argument("--db", required=True)
+    db.add_argument("--compact", action="store_true")
+    db.set_defaults(fn=cmd_db_inspect)
+
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args) or 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
