@@ -1,0 +1,92 @@
+"""SSZ proofs + light-client bootstrap/update production and verification."""
+
+import pytest
+
+from lighthouse_tpu.chain.light_client import (
+    LightClientServerCache,
+    verify_bootstrap,
+    verify_finality_branch,
+)
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.ssz.core import Container, List, uint64, Bytes32
+from lighthouse_tpu.ssz.proof import (
+    build_tree,
+    branch_for,
+    container_field_proof,
+    verify_branch,
+)
+from lighthouse_tpu.state_transition.slot import types_for_slot
+from lighthouse_tpu.testing.harness import StateHarness
+from lighthouse_tpu.types.spec import minimal_spec
+
+
+def test_tree_branch_verify():
+    chunks = [bytes([i]) * 32 for i in range(5)]
+    layers = build_tree(chunks, 8)
+    root = layers[-1][0]
+    for i in range(5):
+        branch = branch_for(layers, i)
+        assert verify_branch(chunks[i], branch, i, root)
+    assert not verify_branch(chunks[0], branch_for(layers, 0), 1, root)
+
+
+def test_container_field_proof_simple():
+    C = Container("P", [("a", uint64), ("b", Bytes32), ("c", uint64)])
+    v = C.make(a=5, b=b"\x22" * 32, c=9)
+    root = C.hash_tree_root(v)
+    leaf, branch, pos, depth = container_field_proof(C, v, ["b"])
+    assert leaf == b"\x22" * 32
+    assert pos == 1 and depth == 2
+    assert verify_branch(leaf, branch, pos, root)
+
+
+def test_container_field_proof_nested():
+    Inner = Container("I", [("x", uint64), ("r", Bytes32)])
+    Outer = Container("O", [("p", uint64), ("inner", Inner), ("q", uint64)])
+    v = Outer.make(p=1, inner=Inner.make(x=2, r=b"\x33" * 32), q=3)
+    root = Outer.hash_tree_root(v)
+    leaf, branch, pos, depth = container_field_proof(Outer, v, ["inner", "r"])
+    assert leaf == b"\x33" * 32
+    assert verify_branch(leaf, branch, pos, root)
+
+
+@pytest.fixture(scope="module")
+def state_env():
+    bls.set_backend("fake")
+    spec = minimal_spec()
+    harness = StateHarness.new(spec, 16)
+    return spec, harness
+
+
+def test_bootstrap_roundtrip(state_env):
+    spec, harness = state_env
+    state = harness.state
+    types = types_for_slot(spec, state.slot)
+    state_root = types.BeaconState.hash_tree_root(state)
+    header = state.latest_block_header.copy_with(state_root=state_root)
+    cache = LightClientServerCache(spec)
+    bootstrap = cache.produce_bootstrap(state, header)
+    assert verify_bootstrap(spec, bootstrap, types)
+    # tampered committee fails
+    bad = bootstrap
+    bad.current_sync_committee = state.next_sync_committee
+    if state.next_sync_committee != state.current_sync_committee:
+        assert not verify_bootstrap(spec, bad, types)
+
+
+def test_finality_branch(state_env):
+    spec, harness = state_env
+    state = harness.state
+    types = types_for_slot(spec, state.slot)
+    state_root = types.BeaconState.hash_tree_root(state)
+    header = state.latest_block_header.copy_with(state_root=state_root)
+    cache = LightClientServerCache(spec)
+    sync_agg = types.SyncAggregate.default()
+    update = cache.produce_update(state, header, None, sync_agg, state.slot + 1)
+    assert verify_finality_branch(
+        spec, update, types, bytes(state.finalized_checkpoint.root)
+    )
+    assert not verify_finality_branch(spec, update, types, b"\x09" * 32)
+    # best-update tracking by participation
+    period = 0
+    assert cache.best_updates[period] is update
