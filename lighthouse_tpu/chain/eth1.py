@@ -1,0 +1,147 @@
+"""Eth1 layer: deposit-contract cache (incremental merkle tree + proofs)
+and eth1-data voting.
+
+Parity surface: /root/reference/beacon_node/eth1/src/ (deposit log cache,
+block cache feeding eth1-data votes) and beacon_chain/src/eth1_chain.rs
+(vote selection). The deposit tree is the standard depth-32 incremental
+merkle tree of the deposit contract, with length mixed in for the SSZ
+List[DepositData] root — proofs from it feed process_deposit's
+is_valid_merkle_branch (state_transition/block.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+DEPOSIT_TREE_DEPTH = 32
+
+
+def _hash(a: bytes, b: bytes) -> bytes:
+    return hashlib.sha256(a + b).digest()
+
+
+class DepositTree:
+    """Incremental merkle tree over deposit-data roots with proof support.
+
+    Keeps all leaves (the cache stores every log anyway, eth1/src/
+    deposit_cache.rs) so historical proofs at any deposit_count work —
+    that is what blocks need: a proof against eth1_data.deposit_root which
+    commits to deposit_count leaves."""
+
+    def __init__(self):
+        self.leaves: list[bytes] = []
+        self._zeros = [b"\x00" * 32]
+        for _ in range(DEPOSIT_TREE_DEPTH):
+            self._zeros.append(_hash(self._zeros[-1], self._zeros[-1]))
+
+    def push(self, deposit_data_root: bytes) -> None:
+        self.leaves.append(deposit_data_root)
+
+    def __len__(self):
+        return len(self.leaves)
+
+    def _root_at(self, count: int) -> bytes:
+        """Root of the depth-32 tree over the first `count` leaves, with
+        the deposit count mixed in (deposit contract get_deposit_root)."""
+        node_layer = list(self.leaves[:count])
+        for d in range(DEPOSIT_TREE_DEPTH):
+            nxt = []
+            for i in range(0, len(node_layer), 2):
+                left = node_layer[i]
+                right = node_layer[i + 1] if i + 1 < len(node_layer) else self._zeros[d]
+                nxt.append(_hash(left, right))
+            node_layer = nxt or [self._zeros[d + 1]]
+        return _hash(node_layer[0], count.to_bytes(32, "little"))
+
+    def root(self, count: int | None = None) -> bytes:
+        return self._root_at(len(self.leaves) if count is None else count)
+
+    def proof(self, index: int, count: int | None = None) -> list[bytes]:
+        """Branch for leaf `index` in the tree of the first `count` leaves,
+        plus the mixed-in length leaf (DEPOSIT_TREE_DEPTH + 1 elements,
+        matching Deposit.proof)."""
+        count = len(self.leaves) if count is None else count
+        assert index < count
+        layer = list(self.leaves[:count])
+        idx = index
+        branch = []
+        for d in range(DEPOSIT_TREE_DEPTH):
+            sib = idx ^ 1
+            if sib < len(layer):
+                branch.append(layer[sib])
+            else:
+                branch.append(self._zeros[d])
+            nxt = []
+            for i in range(0, len(layer), 2):
+                left = layer[i]
+                right = layer[i + 1] if i + 1 < len(layer) else self._zeros[d]
+                nxt.append(_hash(left, right))
+            layer = nxt or [self._zeros[d + 1]]
+            idx //= 2
+        branch.append(count.to_bytes(32, "little"))
+        return branch
+
+
+@dataclass
+class Eth1Block:
+    number: int
+    hash: bytes
+    timestamp: int
+    deposit_root: bytes
+    deposit_count: int
+
+
+@dataclass
+class Eth1Cache:
+    """Block + deposit caches driving eth1-data votes (service.rs)."""
+
+    tree: DepositTree = field(default_factory=DepositTree)
+    blocks: list[Eth1Block] = field(default_factory=list)
+    deposits: list[object] = field(default_factory=list)   # DepositData values
+
+    def add_deposit(self, deposit_data, types) -> None:
+        self.deposits.append(deposit_data)
+        self.tree.push(types.DepositData.hash_tree_root(deposit_data))
+
+    def add_block(self, block: Eth1Block) -> None:
+        self.blocks.append(block)
+
+    def deposits_for_block_inclusion(self, state, spec, types):
+        """Deposits the next block must include (eth1_deposit_index ..
+        eth1_data.deposit_count), with proofs against the state's
+        eth1_data.deposit_root."""
+        start = state.eth1_deposit_index
+        count = min(
+            state.eth1_data.deposit_count - start, spec.preset.MAX_DEPOSITS
+        )
+        out = []
+        for i in range(start, start + count):
+            proof = self.tree.proof(i, count=state.eth1_data.deposit_count)
+            out.append(types.Deposit.make(proof=proof, data=self.deposits[i]))
+        return out
+
+    def eth1_vote(self, state, spec, types):
+        """Pick an eth1-data vote (eth1_chain.rs voting: follow-distance
+        block in the voting period; falls back to the current vote)."""
+        period_start = _voting_period_start_time(state, spec)
+        follow_secs = 2048 * 14  # ETH1_FOLLOW_DISTANCE * seconds per eth1 block
+        candidates = [
+            b for b in self.blocks if b.timestamp + follow_secs <= period_start
+        ]
+        if not candidates:
+            return state.eth1_data
+        best = max(candidates, key=lambda b: b.number)
+        if best.deposit_count < state.eth1_data.deposit_count:
+            return state.eth1_data  # never roll back deposits
+        return types.Eth1Data.make(
+            deposit_root=best.deposit_root,
+            deposit_count=best.deposit_count,
+            block_hash=best.hash,
+        )
+
+
+def _voting_period_start_time(state, spec) -> int:
+    period_slots = spec.preset.EPOCHS_PER_ETH1_VOTING_PERIOD * spec.preset.SLOTS_PER_EPOCH
+    start_slot = state.slot - state.slot % period_slots
+    return state.genesis_time + start_slot * spec.seconds_per_slot

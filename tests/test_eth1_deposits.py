@@ -1,0 +1,87 @@
+"""Eth1 deposit tree proofs feeding process_deposit, and eth1 voting."""
+
+import pytest
+
+from lighthouse_tpu.chain.eth1 import DepositTree, Eth1Block, Eth1Cache
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.state_transition.block import is_valid_merkle_branch
+from lighthouse_tpu.state_transition import block as blk
+from lighthouse_tpu.testing.harness import StateHarness, clone_state
+from lighthouse_tpu.state_transition.slot import types_for_slot
+from lighthouse_tpu.types.spec import minimal_spec, DOMAIN_DEPOSIT
+from lighthouse_tpu.types import helpers as hlp
+
+
+def test_deposit_tree_proofs():
+    tree = DepositTree()
+    leaves = [bytes([i + 1]) * 32 for i in range(5)]
+    for l in leaves:
+        tree.push(l)
+    root = tree.root()
+    for i in range(5):
+        proof = tree.proof(i)
+        assert is_valid_merkle_branch(leaves[i], proof, 33, i, root)
+    # proofs against a historical count
+    root3 = tree.root(3)
+    p = tree.proof(1, count=3)
+    assert is_valid_merkle_branch(leaves[1], p, 33, 1, root3)
+
+
+def test_full_deposit_processing():
+    """A real deposit (signed, proven) flows through process_deposit and
+    creates a validator."""
+    bls.set_backend("python")
+    spec = minimal_spec()
+    harness = StateHarness.new(spec, 16)
+    state = clone_state(harness.state, spec)
+    types = types_for_slot(spec, state.slot)
+
+    cache = Eth1Cache()
+    # a new depositor
+    sk = bls.SecretKey(12345)
+    pk = sk.public_key().serialize()
+    wc = b"\x00" + hlp.sha256(pk)[1:]
+    msg = types.DepositMessage.make(
+        pubkey=pk, withdrawal_credentials=wc, amount=spec.max_effective_balance
+    )
+    domain = hlp.compute_domain(DOMAIN_DEPOSIT, spec.genesis_fork_version, b"\x00" * 32)
+    root = hlp.compute_signing_root(types.DepositMessage, msg, domain)
+    sig = bls.sign(sk, root).serialize()
+    data = types.DepositData.make(
+        pubkey=pk, withdrawal_credentials=wc,
+        amount=spec.max_effective_balance, signature=sig,
+    )
+    cache.add_deposit(data, types)
+
+    # point the state at the deposit tree
+    state.eth1_data = types.Eth1Data.make(
+        deposit_root=cache.tree.root(),
+        deposit_count=1,
+        block_hash=b"\x01" * 32,
+    )
+    state.eth1_deposit_index = 0
+    deposits = cache.deposits_for_block_inclusion(state, spec, types)
+    assert len(deposits) == 1
+    n_before = len(state.validators)
+    blk.process_deposit(state, spec, types, deposits[0], spec.fork_name_at_slot(state.slot))
+    assert len(state.validators) == n_before + 1
+    assert bytes(state.validators[-1].pubkey) == pk
+    bls.set_backend("fake")
+
+
+def test_eth1_vote_follow_distance():
+    spec = minimal_spec()
+    bls.set_backend("fake")
+    harness = StateHarness.new(spec, 16)
+    state = harness.state
+    types = types_for_slot(spec, state.slot)
+    cache = Eth1Cache()
+    # an old enough block
+    old = Eth1Block(number=100, hash=b"\xaa" * 32, timestamp=state.genesis_time - 2048 * 14 - 100,
+                    deposit_root=b"\xbb" * 32, deposit_count=16)
+    recent = Eth1Block(number=200, hash=b"\xcc" * 32, timestamp=state.genesis_time,
+                       deposit_root=b"\xdd" * 32, deposit_count=16)
+    cache.add_block(old)
+    cache.add_block(recent)
+    vote = cache.eth1_vote(state, spec, types)
+    assert bytes(vote.block_hash) == old.hash
