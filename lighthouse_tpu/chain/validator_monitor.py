@@ -1,0 +1,97 @@
+"""ValidatorMonitor — per-validator performance tracking.
+
+Parity surface: /root/reference/beacon_node/beacon_chain/src/
+validator_monitor.rs (2.1k LoC): registered validators get per-epoch
+hit/miss accounting for attestations (source/target/head timeliness),
+block proposals, sync-committee duty, plus inclusion-delay tracking;
+summaries are logged/exposed at epoch boundaries.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+from ..state_transition import accessors as acc
+from ..types.spec import ChainSpec
+
+
+@dataclass
+class EpochSummary:
+    attestations: int = 0
+    attestation_min_delay: int | None = None
+    attestation_source_hits: int = 0
+    attestation_target_hits: int = 0
+    attestation_head_hits: int = 0
+    blocks_proposed: int = 0
+    sync_signatures: int = 0
+    slashed: bool = False
+
+
+class ValidatorMonitor:
+    def __init__(self, spec: ChainSpec, auto_register: bool = False):
+        self.spec = spec
+        self.auto_register = auto_register
+        self.watched: set[int] = set()
+        # (validator_index, epoch) -> EpochSummary
+        self.summaries: dict[tuple[int, int], EpochSummary] = defaultdict(EpochSummary)
+
+    def register(self, validator_index: int) -> None:
+        self.watched.add(validator_index)
+
+    def _tracked(self, idx: int) -> bool:
+        return self.auto_register or idx in self.watched
+
+    # ------------------------------------------------------------- events
+
+    def on_block_imported(self, block, attesting_index_sets) -> None:
+        """Called on import with the block and, per included attestation,
+        its attesting indices + inclusion info."""
+        epoch = block.slot // self.spec.preset.SLOTS_PER_EPOCH
+        if self._tracked(block.proposer_index):
+            self.summaries[(block.proposer_index, epoch)].blocks_proposed += 1
+        for att, indices in attesting_index_sets:
+            delay = block.slot - att.data.slot
+            att_epoch = att.data.target.epoch
+            for vi in indices:
+                if not self._tracked(vi):
+                    continue
+                s = self.summaries[(vi, att_epoch)]
+                s.attestations += 1
+                if s.attestation_min_delay is None or delay < s.attestation_min_delay:
+                    s.attestation_min_delay = delay
+
+    def on_attestation_participation(self, state, epoch: int) -> None:
+        """Read participation flags after epoch processing (altair+)."""
+        if not hasattr(state, "previous_epoch_participation"):
+            return
+        for vi, flags in enumerate(state.previous_epoch_participation):
+            if not self._tracked(vi):
+                continue
+            s = self.summaries[(vi, epoch)]
+            if acc.has_flag(flags, acc.TIMELY_SOURCE_FLAG_INDEX):
+                s.attestation_source_hits += 1
+            if acc.has_flag(flags, acc.TIMELY_TARGET_FLAG_INDEX):
+                s.attestation_target_hits += 1
+            if acc.has_flag(flags, acc.TIMELY_HEAD_FLAG_INDEX):
+                s.attestation_head_hits += 1
+
+    def on_slashing(self, validator_index: int, epoch: int) -> None:
+        if self._tracked(validator_index):
+            self.summaries[(validator_index, epoch)].slashed = True
+
+    # ------------------------------------------------------------- queries
+
+    def summary(self, validator_index: int, epoch: int) -> EpochSummary:
+        return self.summaries[(validator_index, epoch)]
+
+    def epoch_report(self, epoch: int) -> dict[int, EpochSummary]:
+        return {
+            vi: s for (vi, e), s in self.summaries.items() if e == epoch
+        }
+
+    def prune(self, before_epoch: int) -> None:
+        self.summaries = defaultdict(
+            EpochSummary,
+            {k: v for k, v in self.summaries.items() if k[1] >= before_epoch},
+        )

@@ -1,0 +1,37 @@
+"""Validator monitor accounting."""
+
+from types import SimpleNamespace
+
+from lighthouse_tpu.chain.validator_monitor import ValidatorMonitor
+from lighthouse_tpu.state_transition import accessors as acc
+from lighthouse_tpu.types.spec import minimal_spec
+
+
+def test_block_and_attestation_tracking():
+    spec = minimal_spec()
+    vm = ValidatorMonitor(spec)
+    vm.register(3)
+    vm.register(7)
+    att = SimpleNamespace(data=SimpleNamespace(slot=9, target=SimpleNamespace(epoch=1)))
+    block = SimpleNamespace(slot=10, proposer_index=3)
+    vm.on_block_imported(block, [(att, [3, 7, 9])])
+    assert vm.summary(3, 1).attestations == 1
+    assert vm.summary(3, 1).attestation_min_delay == 1
+    assert vm.summary(7, 1).attestations == 1
+    assert (9, 1) not in vm.summaries  # unwatched
+    assert vm.summary(3, 10 // spec.preset.SLOTS_PER_EPOCH).blocks_proposed == 1
+
+
+def test_participation_flags_readout():
+    spec = minimal_spec()
+    vm = ValidatorMonitor(spec, auto_register=True)
+    flags = acc.add_flag(acc.add_flag(0, acc.TIMELY_SOURCE_FLAG_INDEX), acc.TIMELY_TARGET_FLAG_INDEX)
+    state = SimpleNamespace(previous_epoch_participation=[flags, 0])
+    vm.on_attestation_participation(state, 5)
+    assert vm.summary(0, 5).attestation_source_hits == 1
+    assert vm.summary(0, 5).attestation_target_hits == 1
+    assert vm.summary(0, 5).attestation_head_hits == 0
+    report = vm.epoch_report(5)
+    assert set(report) == {0, 1}
+    vm.prune(6)
+    assert not vm.summaries
