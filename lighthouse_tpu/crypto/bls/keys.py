@@ -47,6 +47,19 @@ class SecretKey:
         return "SecretKey(<redacted>)"
 
 
+# Decompression + subgroup check is the most expensive pure-Python operation
+# on the block path (a full scalar-mul per key), and the same validator keys
+# recur every slot. One process-wide cache of interned PublicKey objects —
+# the crypto-layer face of the reference's decompressed ValidatorPubkeyCache
+# (beacon_node/beacon_chain/src/validator_pubkey_cache.rs:17). PublicKey is
+# immutable, so sharing instances is safe. Bounded: deposit pubkeys are
+# attacker-controlled (invalid-signature deposits are skipped, not
+# rejected), so unbounded interning would be a memory-growth vector; on
+# overflow the cache resets and the registry re-fills on demand.
+_PUBKEY_CACHE: dict[bytes, "PublicKey"] = {}
+_PUBKEY_CACHE_MAX = 1 << 21
+
+
 class PublicKey:
     """A decompressed, subgroup-checked G1 public key."""
 
@@ -60,16 +73,25 @@ class PublicKey:
 
     @classmethod
     def deserialize(cls, data: bytes) -> "PublicKey":
+        data = bytes(data)
+        hit = _PUBKEY_CACHE.get(data)
+        if hit is not None:
+            return hit
         pt = serde.g1_decompress(data, subgroup_check=True)
         if pt is None:
             raise ValueError("public key may not be the point at infinity")
         pk = cls(pt)
-        pk._compressed = bytes(data)
+        pk._compressed = data
+        if len(_PUBKEY_CACHE) >= _PUBKEY_CACHE_MAX:
+            _PUBKEY_CACHE.clear()
+        _PUBKEY_CACHE[data] = pk
         return pk
 
     def serialize(self) -> bytes:
         if self._compressed is None:
             self._compressed = serde.g1_compress(self._point)
+            if len(_PUBKEY_CACHE) < _PUBKEY_CACHE_MAX:
+                _PUBKEY_CACHE.setdefault(self._compressed, self)
         return self._compressed
 
     @property
@@ -105,8 +127,65 @@ def interop_secret_key(validator_index: int) -> SecretKey:
     return SecretKey(scalar)
 
 
+# The keypairs are pure functions of the index, and the g1_mul per pubkey is
+# the single biggest fixed cost of every test harness (interop genesis used
+# to dominate the suite runtime). Cache them in-process AND on disk.
+_interop_cache: dict[int, Keypair] = {}
+_interop_disk_loaded = False
+
+
+def _interop_disk_path():
+    import os
+
+    d = os.environ.get(
+        "LIGHTHOUSE_TPU_CACHE", os.path.expanduser("~/.cache/lighthouse_tpu")
+    )
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, "interop_keys.bin")
+
+
+def _load_interop_disk():
+    global _interop_disk_loaded
+    _interop_disk_loaded = True
+    try:
+        with open(_interop_disk_path(), "rb") as f:
+            raw = f.read()
+    except OSError:
+        return
+    # records: index(4) || sk(32) || x(48) || y(48)
+    rec = 4 + 32 + 48 + 48
+    for off in range(0, len(raw) - rec + 1, rec):
+        i = int.from_bytes(raw[off : off + 4], "little")
+        sk = int.from_bytes(raw[off + 4 : off + 36], "big")
+        x = int.from_bytes(raw[off + 36 : off + 84], "big")
+        y = int.from_bytes(raw[off + 84 : off + 132], "big")
+        _interop_cache[i] = Keypair(SecretKey(sk), PublicKey((x, y)))
+
+
+def _append_interop_disk(new_items):
+    try:
+        with open(_interop_disk_path(), "ab") as f:
+            for i, kp in new_items:
+                x, y = kp.pk.point
+                f.write(
+                    i.to_bytes(4, "little")
+                    + kp.sk.scalar.to_bytes(32, "big")
+                    + x.to_bytes(48, "big")
+                    + y.to_bytes(48, "big")
+                )
+    except OSError:
+        pass
+
+
 def interop_keypair(validator_index: int) -> Keypair:
-    return Keypair.from_secret(interop_secret_key(validator_index))
+    if not _interop_disk_loaded:
+        _load_interop_disk()
+    kp = _interop_cache.get(validator_index)
+    if kp is None:
+        kp = Keypair.from_secret(interop_secret_key(validator_index))
+        _interop_cache[validator_index] = kp
+        _append_interop_disk([(validator_index, kp)])
+    return kp
 
 
 def interop_keypairs(count: int) -> list[Keypair]:

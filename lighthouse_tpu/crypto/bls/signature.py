@@ -28,11 +28,24 @@ class Signature:
     def infinity(cls) -> "Signature":
         return cls(None)
 
+    # bounded decompression cache: production signatures are unique (cache
+    # misses, no harm), but repeated bytes — aggregates re-verified across
+    # gossip/import, test fixtures — skip the G2 sqrt + subgroup scalar-mul
+    _CACHE: dict = {}
+    _CACHE_MAX = 4096
+
     @classmethod
     def deserialize(cls, data: bytes, subgroup_check: bool = True) -> "Signature":
-        pt = serde.g2_decompress(data, subgroup_check=subgroup_check)
+        data = bytes(data)
+        key = (data, subgroup_check)
+        pt = cls._CACHE.get(key, cls._CACHE)  # sentinel: cache dict itself
+        if pt is cls._CACHE:
+            pt = serde.g2_decompress(data, subgroup_check=subgroup_check)
+            if len(cls._CACHE) >= cls._CACHE_MAX:
+                cls._CACHE.clear()
+            cls._CACHE[key] = pt
         sig = cls(pt)
-        sig._compressed = bytes(data)
+        sig._compressed = data
         return sig
 
     def serialize(self) -> bytes:

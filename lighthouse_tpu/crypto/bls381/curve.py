@@ -141,3 +141,42 @@ def g2_in_subgroup(pt):
 
 def g2_clear_cofactor(pt):
     return mul_raw(pt, H_EFF_G2, FQ2_OPS)
+
+
+# psi endomorphism (untwist-Frobenius-twist) -----------------------------
+#
+# psi(x, y) = (c_x * conj(x), c_y * conj(y)) on the G2 twist, with
+# c_x = 1/(1+u)^((p-1)/3) and c_y = 1/(1+u)^((p-1)/2) (RFC 9380 App. G.3).
+# Used for the fast cofactor clearing: for the BLS12381G2 suites h_eff is
+# chosen so that [x^2-x-1]P + [x-1]psi(P) + psi^2(2P) == h_eff * P exactly,
+# turning a 636-bit scalar multiplication into two |x|-multiplications
+# (64-bit, Hamming weight 6) plus a handful of adds — the same trick blst's
+# clear_cofactor uses.
+
+_XI_1P1 = (1, 1)  # 1 + u
+PSI_CX = f.fq2_inv(f.fq2_pow(_XI_1P1, (P - 1) // 3))
+PSI_CY = f.fq2_inv(f.fq2_pow(_XI_1P1, (P - 1) // 2))
+
+
+def g2_psi(pt):
+    if pt is None:
+        return None
+    x, y = pt
+    return (f.fq2_mul(PSI_CX, f.fq2_conj(x)), f.fq2_mul(PSI_CY, f.fq2_conj(y)))
+
+
+def g2_clear_cofactor_fast(pt):
+    """psi-based cofactor clearing; equals g2_clear_cofactor bit-for-bit."""
+    from .constants import X_ABS
+
+    def xmul(p):  # multiply by the (negative) BLS parameter x
+        return neg(mul_raw(p, X_ABS, FQ2_OPS), FQ2_OPS)
+
+    t1 = xmul(pt)                                    # x P
+    t2 = g2_psi(pt)
+    t3 = g2_psi(g2_psi(double(pt, FQ2_OPS)))         # psi^2(2P)
+    t3 = add(t3, neg(t2, FQ2_OPS), FQ2_OPS)          # psi^2(2P) - psi(P)
+    t2 = xmul(add(t1, t2, FQ2_OPS))                  # x^2 P + x psi(P)
+    t3 = add(t3, t2, FQ2_OPS)
+    t3 = add(t3, neg(t1, FQ2_OPS), FQ2_OPS)
+    return add(t3, neg(pt, FQ2_OPS), FQ2_OPS)

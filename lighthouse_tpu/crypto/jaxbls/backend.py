@@ -7,14 +7,26 @@ assembly, `verify_signature_sets` marshals whole batches of SignatureSets to
 one jitted XLA program:
 
     1. masked tree-sum of each set's pubkeys (G1, Jacobian, batched)
-    2. z_i * aggpk_i with the 64-bit random coefficients (batched scan)
-    3. hash-to-G2 of each message (host sha256 -> device SSWU/isogeny/cofactor)
-    4. sum_i z_i * sig_i (batched scan + tree reduce)
-    5. one multi-pairing product check with a single final exponentiation
+    2. z_i * aggpk_i with the 64-bit random coefficients (windowed, w=4)
+    3. hash-to-G2 of each message (host sha256 -> device SSWU/isogeny and
+       psi-endomorphism cofactor clearing)
+    4. sum_i z_i * sig_i (windowed scalar mul + tree reduce)
+    5. ONE batched Montgomery-domain inversion for every Jacobian->affine
+       conversion (all Z coordinates inverted in a single Fermat chain)
+    6. one multi-pairing product check with a single final exponentiation
 
 Shapes are padded to power-of-two buckets (pad lanes masked out) so XLA
 compiles one program per bucket, cached persistently (utils/jaxcfg.py) —
 the bucketing policy answers SURVEY.md §7 hard part (c).
+
+Throughput design (r2): the device round trip through the remote-TPU tunnel
+costs tens of milliseconds of pure latency, so the backend exposes an async
+submission API (`verify_signature_sets_async`) that keeps several batches in
+flight — the beacon processor's double-buffered dispatch and bench.py both
+use it. Host marshalling is vectorized numpy (no per-element Python bigint
+work) and pubkey limb arrays are cached on device keyed by the identity of
+the key objects, mirroring the reference's decompressed ValidatorPubkeyCache
+(validator_pubkey_cache.rs:17) feeding blst.
 """
 
 from __future__ import annotations
@@ -31,6 +43,8 @@ from . import pairing_ops as po
 
 MIN_SETS = 4          # smallest bucket (pairs axis = sets + 1 rounded up)
 MIN_PKS = 1
+Z_WINDOW = 4
+Z_DIGITS = 64 // Z_WINDOW
 
 
 def _next_pow2(n: int) -> int:
@@ -40,23 +54,86 @@ def _next_pow2(n: int) -> int:
     return p
 
 
-def _verify_kernel(pk_x, pk_y, pk_mask, sig_x, sig_y, us, z_bits, set_mask):
+# ------------------------------------------------------------ host marshalling
+
+
+def pack_ints_vec(xs) -> np.ndarray:
+    """Vectorized host packing: list of ints < 2^384 -> (n, NL) u32 standard-
+    form limbs. int.to_bytes + one frombuffer instead of per-limb Python."""
+    buf = b"".join(x.to_bytes(48, "little") for x in xs)
+    b8 = np.frombuffer(buf, np.uint8).reshape(len(xs), 48)
+    return b8[:, 0::2].astype(np.uint32) | (b8[:, 1::2].astype(np.uint32) << 8)
+
+
+def _to_mont_dev(arr):
+    """Device: standard-form limbs (..., NL) -> Montgomery form."""
+    import jax.numpy as jnp
+
+    return lb.mont_mul(arr, jnp.broadcast_to(lb.R2, arr.shape))
+
+
+# ------------------------------------------------------------ device kernel
+
+
+def _batched_affine(z_pk, h_jac, sig_acc):
+    """Jacobian->affine for all three pairing inputs with ONE inversion.
+
+    Z coordinates (n Fq + n Fq2 + 1 Fq2) are stacked into a single Fq2 batch
+    (Fq embedded with zero imaginary part) and inverted in one Fermat chain;
+    identity lanes (Z == 0) invert to 0 and stay flagged."""
+    import jax.numpy as jnp
+
+    Xp, Yp, Zp = z_pk          # G1: (n, NL)
+    Xh, Yh, Zh = h_jac         # G2: (n, 2, NL)
+    Xs, Ys, Zs = sig_acc       # G2: (2, NL)
+    n = Zp.shape[0]
+
+    def embed(fq):             # (n, NL) -> (n, 2, NL)
+        return jnp.stack([fq, jnp.zeros_like(fq)], axis=-2)
+
+    zs = jnp.concatenate([embed(Zp), Zh, Zs[None]], axis=0)     # (2n+1, 2, NL)
+    zinv = tw.fq2_inv(zs)
+    zinv2 = tw.fq2_sqr(zinv)
+    zinv3 = tw.fq2_mul(zinv2, zinv)
+
+    pk_i2, pk_i3 = zinv2[:n, 0, :], zinv3[:n, 0, :]             # Fq lanes
+    h_i2, h_i3 = zinv2[n : 2 * n], zinv3[n : 2 * n]
+    s_i2, s_i3 = zinv2[2 * n], zinv3[2 * n]
+
+    px = lb.mont_mul(Xp, pk_i2)
+    py = lb.mont_mul(Yp, pk_i3)
+    p_inf = lb.is_zero(Zp)
+    qx = tw.fq2_mul(Xh, h_i2)
+    qy = tw.fq2_mul(Yh, h_i3)
+    q_inf = tw.fq2_is_zero(Zh)
+    sx = tw.fq2_mul(Xs, s_i2)
+    sy = tw.fq2_mul(Ys, s_i3)
+    s_inf = tw.fq2_is_zero(Zs)
+    return (px, py, p_inf), (qx, qy, q_inf), (sx, sy, s_inf)
+
+
+def _verify_kernel(pk_x, pk_y, pk_mask, sig_x, sig_y, us, z_digits, set_mask):
     """The jitted device program. Shapes:
-      pk_x/pk_y: (n, m, NL)  padded pubkey affine coords
+      pk_x/pk_y: (n, m, NL)  padded pubkey affine coords, STANDARD form
       pk_mask:   (n, m)      1 = real pubkey
-      sig_x/sig_y: (n, 2, NL) signature affine G2 coords (never infinity:
-                   rejected host-side per blst semantics)
-      us:        (n, 2, 2, NL) hash_to_field outputs per message
-      z_bits:    (n, 64)     random coefficient bits, MSB first
+      sig_x/sig_y: (n, 2, NL) signature affine G2 coords, standard form
+                   (infinity rejected host-side per blst semantics)
+      us:        (n, 2, 2, NL) hash_to_field outputs per message (standard)
+      z_digits:  (n, 16)     base-16 digits of the coefficients, MSB first
       set_mask:  (n,)        1 = real set
     Returns (ok, any_bad_aggpk)."""
     import jax.numpy as jnp
 
     n = pk_x.shape[0]
 
+    # 0. Montgomery-domain conversion on device (host sends standard limbs)
+    pk_x = _to_mont_dev(pk_x)
+    pk_y = _to_mont_dev(pk_y)
+    sig_x = _to_mont_dev(sig_x)
+    sig_y = _to_mont_dev(sig_y)
+
     # 1. aggregate pubkeys per set: (n, m) -> (n,)
     pk_jac = co.affine_to_jac(co.FQ_OPS, (pk_x, pk_y), inf_mask=jnp.logical_not(pk_mask))
-    # masked_tree_sum reduces axis 0; move the pk axis first
     pk_jac_t = tuple(jnp.moveaxis(c, 1, 0) for c in pk_jac)
     m = pk_x.shape[1]
     agg = pk_jac_t
@@ -70,15 +147,15 @@ def _verify_kernel(pk_x, pk_y, pk_mask, sig_x, sig_y, us, z_bits, set_mask):
     aggpk_inf = co.FQ_OPS.is_zero(aggpk[2])
     bad_aggpk = jnp.any(jnp.logical_and(aggpk_inf, set_mask))
 
-    # 2. z_i * aggpk_i
-    z_pk = co.scalar_mul_bits(aggpk, z_bits, co.FQ_OPS)
+    # 2. z_i * aggpk_i (windowed)
+    z_pk = co.scalar_mul_windowed(aggpk, z_digits, co.FQ_OPS, window=Z_WINDOW)
 
-    # 3. hash messages to G2
+    # 3. hash messages to G2 (SSWU + isogeny + psi cofactor clearing)
     h_jac = h2.hash_to_g2_jacobian(us)
 
     # 4. sum_i z_i * sig_i  (mask padded sets to identity first)
     sig_jac = co.affine_to_jac(co.FQ2_OPS, (sig_x, sig_y), inf_mask=jnp.logical_not(set_mask))
-    z_sig = co.scalar_mul_bits(sig_jac, z_bits, co.FQ2_OPS)
+    z_sig = co.scalar_mul_windowed(sig_jac, z_digits, co.FQ2_OPS, window=Z_WINDOW)
     z_sig = co.pt_select(
         co.FQ2_OPS,
         jnp.asarray(set_mask, bool),
@@ -87,10 +164,10 @@ def _verify_kernel(pk_x, pk_y, pk_mask, sig_x, sig_y, us, z_bits, set_mask):
     )
     sig_acc = co.tree_sum(z_sig, co.FQ2_OPS)               # single jacobian G2
 
-    # 5. affine conversions + multi-pairing
-    p1x, p1y, p1inf = co.jac_to_affine(z_pk, co.FQ_OPS)
-    qx, qy, qinf = co.jac_to_affine(h_jac, co.FQ2_OPS)
-    sx, sy, sinf = co.jac_to_affine(sig_acc, co.FQ2_OPS)
+    # 5. affine conversions (single batched inversion) + multi-pairing
+    (p1x, p1y, p1inf), (qx, qy, qinf), (sx, sy, sinf) = _batched_affine(
+        z_pk, h_jac, sig_acc
+    )
 
     # pairs: n set-pairs + 1 signature pair, padded to pow2
     npairs = _next_pow2(n + 1)
@@ -132,6 +209,26 @@ def _get_kernel():
     return _kernel_cache["k"]
 
 
+class VerifyHandle:
+    """In-flight verification: resolves to bool on .result().
+
+    Keeps references to the dispatched device values so the work proceeds
+    asynchronously; result() blocks on the device and applies the host-side
+    semantic (bad aggregate pubkey => False)."""
+
+    __slots__ = ("_ok", "_bad", "_hostfail")
+
+    def __init__(self, ok=None, bad=None, hostfail=False):
+        self._ok = ok
+        self._bad = bad
+        self._hostfail = hostfail
+
+    def result(self) -> bool:
+        if self._hostfail:
+            return False
+        return bool(np.asarray(self._ok)) and not bool(np.asarray(self._bad))
+
+
 class JaxBackend:
     """Batched TPU verification backend (registered as "jax" in bls.api)."""
 
@@ -139,49 +236,92 @@ class JaxBackend:
 
     def __init__(self, dst: bytes = DST_POP):
         self.dst = dst
+        # device-resident pubkey marshalling cache:
+        #   fingerprint(tuple of id(pk)) -> (pk_x_dev, pk_y_dev, mask, keepalive)
+        self._pk_cache: dict = {}
+        self._pk_cache_order: list = []
 
     # -- the multi-set hot path ------------------------------------------
 
-    def verify_signature_sets(self, sets, rands) -> bool:
+    def _marshal_pubkeys(self, sets, n: int, m: int):
+        """(n, m, NL) standard-form limb arrays for all signing keys.
+
+        Cached on device keyed by the identity of the pubkey objects — the
+        steady-state path (gossip firehose over a known validator registry)
+        re-verifies the same PublicKey objects every slot, so after the
+        first batch the pubkey upload cost disappears (the analog of the
+        reference keeping decompressed keys in ValidatorPubkeyCache)."""
+        import jax
+
+        # fingerprint covers the set grouping, not just the flat key sequence:
+        # the same keys split differently must not reuse another layout's
+        # aggregation mask
+        fp = (
+            tuple(len(s.signing_keys) for s in sets),
+            tuple(id(pk) for s in sets for pk in s.signing_keys),
+        )
+        hit = self._pk_cache.get(fp)
+        if hit is not None:
+            return hit[0], hit[1], hit[2]
+
+        pk_x = np.zeros((n, m, lb.NL), np.uint32)
+        pk_y = np.zeros((n, m, lb.NL), np.uint32)
+        pk_mask = np.zeros((n, m), np.uint32)
+        for i, s in enumerate(sets):
+            keys = s.signing_keys
+            xs = pack_ints_vec([pk.point[0] for pk in keys])
+            ys = pack_ints_vec([pk.point[1] for pk in keys])
+            pk_x[i, : len(keys)] = xs
+            pk_y[i, : len(keys)] = ys
+            pk_mask[i, : len(keys)] = 1
+        dx, dy, dm = jax.device_put(pk_x), jax.device_put(pk_y), jax.device_put(pk_mask)
+        # keep strong refs to the key objects so ids stay valid while cached
+        keepalive = (fp, [pk for s in sets for pk in s.signing_keys])
+        self._pk_cache[fp] = (dx, dy, dm, keepalive)
+        self._pk_cache_order.append(fp)
+        if len(self._pk_cache_order) > 8:
+            old = self._pk_cache_order.pop(0)
+            self._pk_cache.pop(old, None)
+        return dx, dy, dm
+
+    def verify_signature_sets_async(self, sets, rands) -> VerifyHandle:
         kernel = _get_kernel()
         n_real = len(sets)
         n = max(MIN_SETS, _next_pow2(n_real))
         m = max(MIN_PKS, _next_pow2(max(len(s.signing_keys) for s in sets)))
 
-        pk_x = np.zeros((n, m, lb.NL), np.uint32)
-        pk_y = np.zeros((n, m, lb.NL), np.uint32)
-        pk_mask = np.zeros((n, m), np.uint32)
+        pk_x, pk_y, pk_mask = self._marshal_pubkeys(sets, n, m)
+
         sig_x = np.zeros((n, 2, lb.NL), np.uint32)
         sig_y = np.zeros((n, 2, lb.NL), np.uint32)
-        z_bits = np.zeros((n, 64), np.uint32)
+        z_digits = np.zeros((n, Z_DIGITS), np.uint32)
         set_mask = np.zeros((n,), np.uint32)
 
-        def mont(v: int) -> np.ndarray:
-            return lb.pack(v * lb.R_MONT % P)
-
-        for i, (s, z) in enumerate(zip(sets, rands)):
-            for j, pk in enumerate(s.signing_keys):
-                x, y = pk.point
-                pk_x[i, j] = mont(x)
-                pk_y[i, j] = mont(y)
-                pk_mask[i, j] = 1
+        sig_ints = []
+        for s in sets:
             sp = s.signature.point
             if sp is None:
-                return False  # blst semantics: infinity signature fails
-            sig_x[i, 0] = mont(sp[0][0])
-            sig_x[i, 1] = mont(sp[0][1])
-            sig_y[i, 0] = mont(sp[1][0])
-            sig_y[i, 1] = mont(sp[1][1])
-            z64 = z & ((1 << 64) - 1)
-            for b in range(64):
-                z_bits[i, 63 - b] = (z64 >> b) & 1
-            set_mask[i] = 1
+                return VerifyHandle(hostfail=True)  # infinity signature fails
+            sig_ints.append(sp)
+        sig_x[:n_real, 0] = pack_ints_vec([sp[0][0] for sp in sig_ints])
+        sig_x[:n_real, 1] = pack_ints_vec([sp[0][1] for sp in sig_ints])
+        sig_y[:n_real, 0] = pack_ints_vec([sp[1][0] for sp in sig_ints])
+        sig_y[:n_real, 1] = pack_ints_vec([sp[1][1] for sp in sig_ints])
+
+        zmask = (1 << 64) - 1
+        z_digits[:n_real] = co.scalars_to_digits(
+            [z & zmask for z in rands], 64, Z_WINDOW
+        )[:, :Z_DIGITS]
+        set_mask[:n_real] = 1
 
         us = np.zeros((n, 2, 2, lb.NL), np.uint32)
         us[:n_real] = h2.hash_to_field_batch([s.message for s in sets], self.dst)
 
-        ok, bad = kernel(pk_x, pk_y, pk_mask, sig_x, sig_y, us, z_bits, set_mask)
-        return bool(np.asarray(ok)) and not bool(np.asarray(bad))
+        ok, bad = kernel(pk_x, pk_y, pk_mask, sig_x, sig_y, us, z_digits, set_mask)
+        return VerifyHandle(ok, bad)
+
+    def verify_signature_sets(self, sets, rands) -> bool:
+        return self.verify_signature_sets_async(sets, rands).result()
 
     # -- single-set paths reuse the same kernel ---------------------------
 
@@ -206,21 +346,16 @@ class JaxBackend:
         pk_x = np.zeros((n, lb.NL), np.uint32)
         pk_y = np.zeros((n, lb.NL), np.uint32)
         mask = np.zeros((n,), np.uint32)
+        pk_x[:n_real] = pack_ints_vec([pk.point[0] for pk in pks])
+        pk_y[:n_real] = pack_ints_vec([pk.point[1] for pk in pks])
+        mask[:n_real] = 1
 
-        def mont(v: int) -> np.ndarray:
-            return lb.pack(v * lb.R_MONT % P)
-
-        for i, pk in enumerate(pks):
-            x, y = pk.point
-            pk_x[i] = mont(x)
-            pk_y[i] = mont(y)
-            mask[i] = 1
         sp = sig.point
         sig_xy = np.zeros((2, 2, lb.NL), np.uint32)
-        sig_xy[0, 0] = mont(sp[0][0])
-        sig_xy[0, 1] = mont(sp[0][1])
-        sig_xy[1, 0] = mont(sp[1][0])
-        sig_xy[1, 1] = mont(sp[1][1])
+        sig_xy[0, 0] = pack_ints_vec([sp[0][0]])[0]
+        sig_xy[0, 1] = pack_ints_vec([sp[0][1]])[0]
+        sig_xy[1, 0] = pack_ints_vec([sp[1][0]])[0]
+        sig_xy[1, 1] = pack_ints_vec([sp[1][1]])[0]
 
         us = np.zeros((n, 2, 2, lb.NL), np.uint32)
         us[:n_real] = h2.hash_to_field_batch(list(messages), self.dst)
@@ -232,6 +367,9 @@ def _aggregate_kernel(pk_x, pk_y, mask, sig_xy, us):
     import jax.numpy as jnp
 
     n = pk_x.shape[0]
+    pk_x = _to_mont_dev(pk_x)
+    pk_y = _to_mont_dev(pk_y)
+    sig_xy = _to_mont_dev(sig_xy)
     h_jac = h2.hash_to_g2_jacobian(us)
     qx, qy, qinf = co.jac_to_affine(h_jac, co.FQ2_OPS)
 

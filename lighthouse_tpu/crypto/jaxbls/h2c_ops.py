@@ -89,17 +89,38 @@ ISO_K = jnp.asarray(
 # ------------------------------------------------------------ device pieces
 
 
-def fq2_pow_static(a, bits: np.ndarray):
-    """a^e for a static exponent given as an MSB-first bit array. One scan
-    with the conditional multiply behind lax.cond (scalar predicate)."""
-    one = jnp.broadcast_to(tw.FQ2_ONE, a.shape)
+def fq2_pow_static(a, bits: np.ndarray, window: int = 4):
+    """a^e for a static exponent given as an MSB-first bit array.
 
-    def body(acc, bit):
-        acc = tw.fq2_sqr(acc)
-        acc = lax.cond(bit == 1, lambda x: tw.fq2_mul(x, a), lambda x: x, acc)
+    Fixed-window form: a runtime table of a^0..a^(2^w-1), then one scan over
+    base-2^w digits (w squarings + one table multiply per step) — ~5 field
+    muls per 4 bits instead of 1.5 per bit, and 4x fewer scan iterations."""
+    e = int("".join(str(int(b)) for b in np.asarray(bits)), 2)
+    if e == 0:
+        return jnp.broadcast_to(tw.FQ2_ONE, a.shape)
+    digits = []
+    while e:
+        digits.append(e & ((1 << window) - 1))
+        e >>= window
+    digits.reverse()
+
+    table = [jnp.broadcast_to(tw.FQ2_ONE, a.shape), a]
+    for _ in range(2, 1 << window):
+        table.append(tw.fq2_mul(table[-1], a))
+    table_arr = jnp.stack(table)
+
+    acc = table_arr[digits[0]]
+    rest = jnp.asarray(np.array(digits[1:], np.uint32))
+    if rest.size == 0:
+        return acc
+
+    def body(acc, digit):
+        for _ in range(window):
+            acc = tw.fq2_sqr(acc)
+        acc = tw.fq2_mul(acc, lax.dynamic_index_in_dim(table_arr, digit, 0, keepdims=False))
         return acc, None
 
-    acc, _ = lax.scan(body, one, jnp.asarray(bits))
+    acc, _ = lax.scan(body, acc, rest)
     return acc
 
 
@@ -213,23 +234,29 @@ def map_to_g2(u0, u1):
     q0 = jax.tree_util.tree_map(lambda c: c[0], q)
     q1 = jax.tree_util.tree_map(lambda c: c[1], q)
     r = co.jac_add(q0, q1, co.FQ2_OPS)
-    return co.scalar_mul_static(r, H_EFF_G2, co.FQ2_OPS)
+    # psi-based clearing: 2 |x|-multiplications instead of the 636-bit h_eff
+    # double-and-add (bls381.curve.g2_clear_cofactor_fast is the ground truth)
+    return co.clear_cofactor_g2(r)
 
 
 # ------------------------------------------------------------ host pipeline
 
 
 def hash_to_field_batch(messages, dst: bytes) -> np.ndarray:
-    """Host: messages -> (n, 2, 2, NL) Montgomery limb array of u-values."""
+    """Host: messages -> (n, 2, 2, NL) STANDARD-form limb array of u-values
+    (the kernel converts to Montgomery on device — one batched mont_mul,
+    keeping all per-element bigint work off the host)."""
     out = np.zeros((len(messages), 2, 2, lb.NL), np.uint32)
     for i, msg in enumerate(messages):
         u0, u1 = ph2c.hash_to_field_fq2(msg, 2, dst)
         for j, u in enumerate((u0, u1)):
-            out[i, j, 0] = lb.pack(u[0] * lb.R_MONT % P)
-            out[i, j, 1] = lb.pack(u[1] * lb.R_MONT % P)
+            out[i, j, 0] = lb.pack(u[0])
+            out[i, j, 1] = lb.pack(u[1])
     return out
 
 
 def hash_to_g2_jacobian(us):
-    """Device: (n, 2, 2, NL) u-values -> batched Jacobian G2 points."""
+    """Device: (n, 2, 2, NL) STANDARD-form u-values -> batched Jacobian G2
+    points (converts to Montgomery on device first)."""
+    us = lb.mont_mul(us, jnp.broadcast_to(lb.R2, us.shape))
     return map_to_g2(us[:, 0], us[:, 1])

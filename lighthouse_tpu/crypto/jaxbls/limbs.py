@@ -70,6 +70,53 @@ ONE_STD = jnp.asarray(pack(1))
 ONE_MONT = jnp.asarray(pack(R_MONT))
 
 
+# --------------------------------------------------------------------------
+# Two interchangeable sets of carry/borrow internals:
+#
+#   * FAST (prefix form, DEFAULT) — Kogge-Stone carry-lookahead, all
+#     straight-line value code: ~log2(NL) wide vector steps, no lax.scan.
+#     A mont_mul then lowers to a handful of fusible elementwise/einsum HLO
+#     ops instead of three nested while-loops — the large kernels (pairing,
+#     hash-to-curve, windowed scalar mults) contain thousands of mont_muls,
+#     and nested scans made XLA compile times explode (>10 min for the
+#     verify kernel) and added per-iteration dispatch overhead at runtime.
+#     The same straight-line form is what Pallas kernel bodies need (Mosaic
+#     cannot lower while-loops efficiently).
+#   * SCAN (legacy form) — lax.scan per limb; kept as a differential-testing
+#     reference (scan_mode context manager).
+# --------------------------------------------------------------------------
+
+_FAST = True
+
+
+class fast_mode:
+    """Context manager: route mont_mul/add/sub internals through the
+    prefix-carry straight-line forms (now the default; kept for API compat)."""
+
+    def __enter__(self):
+        global _FAST
+        self._prev = _FAST
+        _FAST = True
+
+    def __exit__(self, *exc):
+        global _FAST
+        _FAST = self._prev
+
+
+class scan_mode:
+    """Context manager: route carry/borrow internals through the legacy
+    lax.scan forms (differential-testing reference)."""
+
+    def __enter__(self):
+        global _FAST
+        self._prev = _FAST
+        _FAST = False
+
+    def __exit__(self, *exc):
+        global _FAST
+        _FAST = self._prev
+
+
 def _scan_last(f, init, xs):
     """lax.scan over the LAST axis of xs (any leading batch dims)."""
     moved = jnp.moveaxis(xs, -1, 0)
@@ -77,24 +124,88 @@ def _scan_last(f, init, xs):
     return carry, jnp.moveaxis(ys, 0, -1)
 
 
-def carry_normalize(t):
-    """Propagate carries: redundant u32 limbs -> canonical 16-bit limbs.
+def _shiftd(x, d: int, fill=0):
+    """Shift limbs toward higher indices by d positions along the last axis."""
+    pad = jnp.full(x.shape[:-1] + (d,), fill, x.dtype)
+    return jnp.concatenate([pad, x[..., :-d]], axis=-1)
 
-    Returns (normalized array same shape, final carry)."""
+
+def _prefix_carry(g, p):
+    """Kogge-Stone parallel prefix over generate/propagate bit arrays.
+
+    g[k] = limb k generates a carry (borrow) on its own; p[k] = limb k
+    propagates an incoming one. Returns G[k] = carry out of window [0..k]
+    with zero carry-in, in log2(NL) elementwise steps."""
+    nl = g.shape[-1]
+    d = 1
+    while d < nl:
+        g = jnp.logical_or(g, jnp.logical_and(p, _shiftd(g, d, False)))
+        p = jnp.logical_and(p, _shiftd(p, d, False))
+        d *= 2
+    return g
+
+
+def carry_normalize_fast(t):
+    """Prefix-carry normalization: redundant u32 limbs (each < 2^31) ->
+    canonical 16-bit limbs. Returns (normalized, final carry).
+
+    One folding pass bounds every limb by 2^16 + 2^15 - 1, so at most one
+    carry unit remains per limb; the residual ripple is a carry-lookahead
+    prefix (generate/propagate can never both be set at that bound)."""
+    lo = t & MASK
+    hi = t >> LB                                     # < 2^15
+    s = lo + _shiftd(hi, 1)                          # < 2^16 + 2^15 - 1
+    g = s >> LB                                      # in {0, 1}
+    p = (s & MASK) == MASK                           # g and p never both set
+    G = _prefix_carry(g != 0, p)
+    carry_in = _shiftd(G, 1, False)
+    out = (s + jnp.asarray(carry_in, U32)) & MASK
+    final = jnp.asarray(G[..., -1], U32) + hi[..., -1]
+    return out, final
+
+
+def _carry_normalize_scan(t):
     def body(c, limb):
         v = limb + c
         return v >> LB, v & MASK
+
     zero_c = jnp.zeros(t.shape[:-1], U32)
     carry, limbs = _scan_last(body, zero_c, t)
     return limbs, carry
 
 
+def carry_normalize(t):
+    """Propagate carries: redundant u32 limbs -> canonical 16-bit limbs.
+
+    Returns (normalized array same shape, final carry)."""
+    if _FAST:
+        return carry_normalize_fast(t)
+    return _carry_normalize_scan(t)
+
+
+def _sub_with_borrow_fast(a, b):
+    g = a < b
+    p = a == b
+    B = _prefix_carry(g, p)
+    borrow_in = jnp.asarray(_shiftd(B, 1, False), U32)
+    diff = (a - b - borrow_in) & MASK                # u32 wraparound is mod 2^16
+    return diff, jnp.asarray(B[..., -1], U32)
+
+
 def _sub_with_borrow(a, b):
     """a - b limbwise (canonical 16-bit limbs). Returns (diff, borrow in {0,1})."""
+    if _FAST:
+        return _sub_with_borrow_fast(a, b)
+    return _sub_with_borrow_scan(a, b)
+
+
+def _sub_with_borrow_scan(a, b):
+
     def body(borrow, ab):
         ai, bi = ab
         v = ai + (MASK + 1) - bi - borrow
         return 1 - (v >> LB), v & MASK
+
     zero_b = jnp.zeros(a.shape[:-1], U32)
     moved = (jnp.moveaxis(a, -1, 0), jnp.moveaxis(b, -1, 0))
     borrow, diff = lax.scan(lambda c, ab: body(c, ab), zero_b, moved)
@@ -111,13 +222,34 @@ def _cond_sub_n(t):
     return out[..., :NL]
 
 
+def _poly_mul_shift(a, b, ncols: int):
+    """Shift-accumulate schoolbook limb product (FAST form, Pallas bodies):
+    na statically-shifted scaled copies of b, summed as straight-line value
+    code — no banded-matrix materialization, no gather, lowers cleanly in
+    Mosaic. 8-bit split of `a` keeps every partial sum < 2^31."""
+    na = a.shape[-1]
+    nb = b.shape[-1]
+    a_lo = a & 0xFF
+    a_hi = a >> 8
+    zero = jnp.zeros(jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1]) + (ncols,), U32)
+    c_lo = zero
+    c_hi = zero
+    pad_cfg = [(0, 0)] * (b.ndim - 1)
+    for j in range(min(na, ncols)):
+        w = min(nb, ncols - j)
+        bj = jnp.pad(b[..., :w], pad_cfg + [(j, ncols - j - w)])
+        c_lo = c_lo + a_lo[..., j : j + 1] * bj
+        c_hi = c_hi + a_hi[..., j : j + 1] * bj
+    col = c_lo + ((c_hi & 0xFF) << 8)
+    col = col.at[..., 1:].add(c_hi[..., :-1] >> 8)
+    return col                                          # each < 2^31
+
+
 def _banded(b, na: int, ncols: int):
-    """Build the banded convolution matrix B[..., j, k] = b[k - j]
-    (0 <= k-j < nb), so that polynomial multiplication a*b becomes the
-    batched matvec einsum('...j,...jk->...k', a, B). This maps limb
-    multiplication onto XLA dot_general (MXU-friendly) instead of
-    scatter-add loops — compile time and runtime both improve by orders
-    of magnitude over the schoolbook form."""
+    """Banded convolution matrix B[..., j, k] = b[k - j] (0 <= k-j < nb):
+    polynomial multiplication as the batched matvec
+    einsum('...j,...jk->...k', a, B). Compact HLO, keeps XLA compile times
+    linear — the DEFAULT form for the plain XLA path."""
     nb = b.shape[-1]
     j = np.arange(na)[:, None]
     k = np.arange(ncols)[None, :]
@@ -127,10 +259,16 @@ def _banded(b, na: int, ncols: int):
     return jnp.where(valid, b[..., idx_c], 0)
 
 
+_POLY_SHIFT = False  # flipped only while tracing Pallas bodies (Mosaic
+                     # lowers shift-accumulate; gathers/einsum poorly)
+
+
 def _poly_mul(a, b, ncols: int):
     """Carry-free limb product: a (..., na) * b (..., nb) -> (..., ncols)
     column sums. Inputs are 16-bit-valued u32; the 8-bit split of `a` keeps
-    every dot-product partial sum < 2^30 (no u32 overflow)."""
+    every dot-product partial sum < 2^31 (no u32 overflow)."""
+    if _POLY_SHIFT:
+        return _poly_mul_shift(a, b, ncols)
     na = a.shape[-1]
     B = _banded(b, na, ncols)
     a_lo = a & 0xFF
@@ -149,7 +287,8 @@ NPRIME_HOST = pack((-pow(P, -1, 1 << (NL * LB))) % (1 << (NL * LB)))
 def mont_mul(a, b):
     """Montgomery product a*b*R^-1 mod P. a, b: (..., NL) canonical limbs.
 
-    Non-interleaved REDC with all three limb products as banded matmuls:
+    Non-interleaved REDC with all three limb products as shift-accumulate
+    schoolbook convolutions:
       T = a*b ; m = (T mod R) * N' mod R ; res = (T + m*N) / R ; cond-sub.
     """
     batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
@@ -233,24 +372,40 @@ def from_mont(a_mont):
     return mont_mul(a_mont, jnp.broadcast_to(ONE_STD, a_mont.shape))
 
 
-def mont_pow_static(a, exponent: int):
+def mont_pow_static(a, exponent: int, window: int = 4):
     """a^exponent in Montgomery domain, exponent a static Python int.
 
-    Unrolled square-and-multiply is too large a graph for 381-bit exponents;
-    we scan over the bit array (MSB first) with a select-multiply.
-    """
-    bits = [int(b) for b in bin(exponent)[2:]]
-    bits_arr = jnp.asarray(np.array(bits, np.uint32))
+    Fixed-window exponentiation: a runtime table of a^0..a^(2^w - 1) then one
+    scan over the exponent's base-2^w digits (MSB first), each step = w
+    squarings + one table multiply. For 381-bit exponents this does ~490
+    Montgomery products instead of 762 for bit-at-a-time square-and-select."""
+    if exponent == 0:
+        return jnp.broadcast_to(ONE_MONT, a.shape)
+    digits = []
+    e = exponent
+    while e:
+        digits.append(e & ((1 << window) - 1))
+        e >>= window
+    digits.reverse()
 
-    def body(acc, bit):
-        acc = mont_sqr(acc)
-        with_mul = mont_mul(acc, a)
-        acc = jnp.where((bit == 1)[..., None] if bit.ndim else (bit == 1), with_mul, acc)
+    # table[i] = a^i, built with 2^w - 2 sequential multiplies
+    table = [jnp.broadcast_to(ONE_MONT, a.shape), a]
+    for _ in range(2, 1 << window):
+        table.append(mont_mul(table[-1], a))
+    table_arr = jnp.stack(table)                     # (2^w, ..., NL)
+
+    acc = table_arr[digits[0]]
+    rest = jnp.asarray(np.array(digits[1:], np.uint32))
+    if rest.size == 0:
+        return acc
+
+    def body(acc, digit):
+        for _ in range(window):
+            acc = mont_sqr(acc)
+        acc = mont_mul(acc, lax.dynamic_index_in_dim(table_arr, digit, 0, keepdims=False))
         return acc, None
 
-    one = jnp.broadcast_to(ONE_MONT, a.shape)
-    # start from 1, scan all bits
-    acc, _ = lax.scan(lambda c, b: body(c, b), one, bits_arr)
+    acc, _ = lax.scan(body, acc, rest)
     return acc
 
 

@@ -177,9 +177,11 @@ def _hash_to_bls_field(data: bytes) -> int:
 
 
 def compute_challenge(blob: bytes, commitment_bytes: bytes, setup: TrustedSetup) -> int:
-    degree = setup.n.to_bytes(8, "little")
-    inp = FIAT_SHAMIR_PROTOCOL_DOMAIN + degree + (16).to_bytes(8, "little")[:8] + blob + commitment_bytes
-    return _hash_to_bls_field(inp)
+    """Deneb compute_challenge: domain || degree_poly (16-byte big-endian
+    FIELD_ELEMENTS_PER_BLOB) || blob || commitment. With a production 4096-
+    element setup this transcript is byte-identical to c-kzg's."""
+    degree = setup.n.to_bytes(16, "big")
+    return _hash_to_bls_field(FIAT_SHAMIR_PROTOCOL_DOMAIN + degree + blob + commitment_bytes)
 
 
 def compute_kzg_proof(blob: bytes, z: int, setup: TrustedSetup):
@@ -233,10 +235,11 @@ def verify_blob_kzg_proof_batch(blobs, commitments_bytes, proofs_bytes, setup: T
         zs.append(z)
         ys.append(_evaluate_polynomial_in_evaluation_form(poly, z, setup))
 
-    # r powers from a transcript hash
-    transcript = RANDOM_CHALLENGE_DOMAIN + n.to_bytes(8, "little")
-    for cb, pb in zip(commitments_bytes, proofs_bytes):
-        transcript += cb + pb
+    # r powers per deneb compute_r_powers: domain || degree_poly (8-byte BE)
+    # || num_blobs (8-byte BE) || per-blob (commitment || z || y || proof)
+    transcript = RANDOM_CHALLENGE_DOMAIN + setup.n.to_bytes(8, "big") + n.to_bytes(8, "big")
+    for cb, z, y, pb in zip(commitments_bytes, zs, ys, proofs_bytes):
+        transcript += cb + z.to_bytes(32, "big") + y.to_bytes(32, "big") + pb
     r = _hash_to_bls_field(transcript)
     r_pows = [pow(r, i, R) for i in range(n)]
 

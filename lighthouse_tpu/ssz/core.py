@@ -535,6 +535,10 @@ class Container(SSZType):
         offsets.append(len(data))
         if var_fields and offsets[0] != pos:
             raise ValueError(f"{self.name}: bad first offset")
+        if not var_fields and pos != len(data):
+            # SSZ strictness: an all-fixed-size container must consume every
+            # byte; trailing garbage is a non-canonical encoding
+            raise ValueError(f"{self.name}: {len(data) - pos} trailing bytes")
         for i, f in enumerate(var_fields):
             if offsets[i] > offsets[i + 1]:
                 raise ValueError("offsets not monotonic")

@@ -20,7 +20,34 @@ def bls_withdrawal_credentials(pubkey_bytes: bytes) -> bytes:
     return b"\x00" + h.sha256(pubkey_bytes)[1:]
 
 
+# The interop genesis state is a pure function of (spec, keypairs,
+# genesis_time, eth1 hash) and hashing the validator registry is expensive —
+# memoize and hand out deep copies (tests build the same 64-validator
+# minimal-preset genesis dozens of times).
+_genesis_cache: dict = {}
+
+
 def interop_genesis_state(
+    keypairs: list[bls.Keypair],
+    genesis_time: int,
+    spec: ChainSpec,
+    eth1_block_hash: bytes = b"\x42" * 32,
+):
+    import copy
+
+    key = (repr(spec), len(keypairs), genesis_time, eth1_block_hash)
+    hit = _genesis_cache.get(key)
+    if hit is not None and hit[1] == [kp.pk.serialize() for kp in keypairs]:
+        return copy.deepcopy(hit[0])
+    state = _interop_genesis_state(keypairs, genesis_time, spec, eth1_block_hash)
+    _genesis_cache[key] = (
+        copy.deepcopy(state),
+        [kp.pk.serialize() for kp in keypairs],
+    )
+    return state
+
+
+def _interop_genesis_state(
     keypairs: list[bls.Keypair],
     genesis_time: int,
     spec: ChainSpec,

@@ -112,6 +112,14 @@ def test_container_fixed():
     )
 
 
+def test_container_fixed_rejects_trailing_bytes():
+    # SSZ strictness: non-canonical encodings from the wire must not decode
+    C = Container("Foo", [("a", uint64), ("b", uint32)])
+    enc = C.serialize(C.make(a=1, b=2))
+    with pytest.raises(ValueError):
+        C.deserialize(enc + b"\x00")
+
+
 def test_container_variable_offsets():
     C = Container("Bar", [("a", uint16), ("items", List(uint16, 32)), ("b", uint16)])
     v = C.make(a=0xAAAA, items=[1, 2, 3], b=0xBBBB)

@@ -59,9 +59,11 @@ def test_real_crypto_block(harness):
     tampered variant rejected."""
     spec = harness.spec
     h2 = StateHarness(spec=spec, keypairs=harness.keypairs, state=clone_state(harness.state, spec))
-    signed, _post = h2.produce_block(h2.state.slot + 1, attestations=[], full_sync=False)
+    # produce under the REAL backend: the fake backend's dummy signatures
+    # would (correctly) fail real verification
     bls.set_backend("python")
     try:
+        signed, _post = h2.produce_block(h2.state.slot + 1, attestations=[], full_sync=False)
         st = clone_state(h2.state, spec)
         state_transition(st, signed, spec, strategy=SignatureStrategy.VERIFY_BULK)
         bad = signed.copy_with(signature=bytes(signed.signature)[:-1] + b"\x01")
