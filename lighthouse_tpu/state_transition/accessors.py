@@ -118,13 +118,16 @@ def get_beacon_committee(state, spec: ChainSpec, slot: int, index: int, cache=No
 
 
 def get_beacon_proposer_index(state, spec: ChainSpec, slot: int | None = None) -> int:
+    from ..types.spec import ForkName
+
     slot = state.slot if slot is None else slot
     epoch = h.compute_epoch_at_slot(slot, spec)
     seed = h.sha256(
         h.get_seed(state, spec, epoch, DOMAIN_BEACON_PROPOSER) + h.int_to_bytes(slot, 8)
     )
     indices = h.get_active_validator_indices(state, epoch)
-    return h.compute_proposer_index(state, spec, indices, seed)
+    electra = spec.fork_name_at_slot(slot) >= ForkName.electra
+    return h.compute_proposer_index(state, spec, indices, seed, electra=electra)
 
 
 def get_attesting_indices(state, spec: ChainSpec, data, aggregation_bits, cache=None):
@@ -132,6 +135,44 @@ def get_attesting_indices(state, spec: ChainSpec, data, aggregation_bits, cache=
     if len(aggregation_bits) != len(committee):
         raise ValueError("aggregation bits length != committee size")
     return [i for i, bit in zip(committee, aggregation_bits) if bit]
+
+
+def get_committee_indices(committee_bits) -> list[int]:
+    """EIP-7549: the committee indices flagged in an electra attestation."""
+    return [i for i, bit in enumerate(committee_bits) if bit]
+
+
+def get_attesting_indices_electra(state, spec: ChainSpec, attestation, cache=None):
+    """EIP-7549 get_attesting_indices: aggregation bits span the committees
+    named by committee_bits, concatenated in index order. Strict: raises
+    ValueError on bad committee indices, length mismatches, empty
+    committee-bits, or a named committee with no attesters (the spec's
+    process_attestation assertions)."""
+    data = attestation.data
+    if cache is None or cache.epoch != h.compute_epoch_at_slot(data.slot, spec):
+        cache = build_committee_cache(state, spec, h.compute_epoch_at_slot(data.slot, spec))
+    committee_indices = get_committee_indices(attestation.committee_bits)
+    if not committee_indices:
+        raise ValueError("no committee bits set")
+    out: list[int] = []
+    offset = 0
+    bits = attestation.aggregation_bits
+    for committee_index in committee_indices:
+        if committee_index >= cache.committees_per_slot:
+            raise ValueError("committee index out of range")
+        committee = cache.committee(data.slot, committee_index)
+        if offset + len(committee) > len(bits):
+            raise ValueError("aggregation bits length != total committee size")
+        committee_attesters = [
+            vi for i, vi in enumerate(committee) if bits[offset + i]
+        ]
+        if not committee_attesters:
+            raise ValueError("committee with no attesters")
+        out.extend(committee_attesters)
+        offset += len(committee)
+    if len(bits) != offset:
+        raise ValueError("aggregation bits length != total committee size")
+    return sorted(set(out))
 
 
 # ------------------------------------------------------------ altair helpers
@@ -192,8 +233,10 @@ def is_in_inactivity_leak(state, spec: ChainSpec) -> bool:
 
 
 def get_next_sync_committee_indices(state, spec: ChainSpec) -> list[int]:
+    from ..types.spec import ForkName
+
     epoch = get_current_epoch(state, spec) + 1
-    max_random_byte = 255
+    electra = spec.fork_name_at_epoch(epoch) >= ForkName.electra
     active = h.get_active_validator_indices(state, epoch)
     count = len(active)
     seed = h.get_seed(state, spec, epoch, DOMAIN_SYNC_COMMITTEE)
@@ -202,9 +245,16 @@ def get_next_sync_committee_indices(state, spec: ChainSpec) -> list[int]:
     while len(out) < spec.preset.SYNC_COMMITTEE_SIZE:
         shuffled = h.compute_shuffled_index(i % count, count, seed, spec.preset.SHUFFLE_ROUND_COUNT)
         candidate = active[shuffled]
-        random_byte = h.sha256(seed + h.int_to_bytes(i // 32, 8))[i % 32]
         eff = state.validators[candidate].effective_balance
-        if eff * max_random_byte >= spec.max_effective_balance * random_byte:
-            out.append(candidate)
+        if electra:
+            rnd = h.sha256(seed + h.int_to_bytes(i // 16, 8))
+            off = (i % 16) * 2
+            random_value = int.from_bytes(rnd[off : off + 2], "little")
+            if eff * 0xFFFF >= spec.max_effective_balance_electra * random_value:
+                out.append(candidate)
+        else:
+            random_byte = h.sha256(seed + h.int_to_bytes(i // 32, 8))[i % 32]
+            if eff * 255 >= spec.max_effective_balance * random_byte:
+                out.append(candidate)
         i += 1
     return out

@@ -178,11 +178,24 @@ def process_eth1_data(state, spec, types, body):
 
 def process_operations(state, spec, types, block, fork, handle, get_pubkey):
     body = block.body
-    # expected deposit count
-    expected_deposits = min(
-        spec.preset.MAX_DEPOSITS,
-        state.eth1_data.deposit_count - state.eth1_deposit_index,
-    )
+    # expected deposit count; electra (EIP-6110) caps the eth1 bridge queue
+    # at deposit_requests_start_index
+    if fork >= ForkName.electra:
+        eth1_deposit_index_limit = min(
+            state.eth1_data.deposit_count, state.deposit_requests_start_index
+        )
+        if state.eth1_deposit_index < eth1_deposit_index_limit:
+            expected_deposits = min(
+                spec.preset.MAX_DEPOSITS,
+                eth1_deposit_index_limit - state.eth1_deposit_index,
+            )
+        else:
+            expected_deposits = 0
+    else:
+        expected_deposits = min(
+            spec.preset.MAX_DEPOSITS,
+            state.eth1_data.deposit_count - state.eth1_deposit_index,
+        )
     if len(body.deposits) != expected_deposits:
         raise BlockProcessingError(
             f"expected {expected_deposits} deposits, block has {len(body.deposits)}"
@@ -203,8 +216,18 @@ def process_operations(state, spec, types, block, fork, handle, get_pubkey):
         for change in body.bls_to_execution_changes:
             process_bls_to_execution_change(state, spec, types, change, handle)
     if fork >= ForkName.deneb:
-        if len(body.blob_kzg_commitments) > spec.max_blobs_per_block:
+        if len(body.blob_kzg_commitments) > spec.max_blobs(fork):
             raise BlockProcessingError("too many blob commitments")
+    if fork >= ForkName.electra:
+        from . import electra as el
+
+        reqs = body.execution_requests
+        for dr in reqs.deposits:
+            el.process_deposit_request(state, spec, types, dr)
+        for wr in reqs.withdrawals:
+            el.process_withdrawal_request(state, spec, types, wr)
+        for cr in reqs.consolidations:
+            el.process_consolidation_request(state, spec, types, cr)
 
 
 def _is_slashable_attestation_data(d1, d2) -> bool:
@@ -231,6 +254,8 @@ def process_proposer_slashing(state, spec, types, slashing, fork, handle, get_pu
         raise BlockProcessingError("proposer slashing: different proposers")
     if h1 == h2:
         raise BlockProcessingError("proposer slashing: identical headers")
+    if h1.proposer_index >= len(state.validators):
+        raise BlockProcessingError("proposer slashing: unknown validator")
     proposer = state.validators[h1.proposer_index]
     if not h.is_slashable_validator(proposer, acc.get_current_epoch(state, spec)):
         raise BlockProcessingError("proposer not slashable")
@@ -265,22 +290,34 @@ def process_attestation(state, spec, types, att, fork, handle, get_pubkey, cache
         raise BlockProcessingError("attestation target epoch out of range")
     if data.target.epoch != h.compute_epoch_at_slot(data.slot, spec):
         raise BlockProcessingError("target epoch != slot epoch")
-    if not (
-        data.slot + spec.min_attestation_inclusion_delay
-        <= state.slot
-        <= data.slot + p.SLOTS_PER_EPOCH
-    ):
+    if state.slot < data.slot + spec.min_attestation_inclusion_delay:
+        raise BlockProcessingError("attestation inclusion window")
+    # EIP-7045 (deneb) removed the one-epoch upper inclusion bound; older
+    # forks still enforce it (reference drops it for deneb+ likewise).
+    if fork < ForkName.deneb and state.slot > data.slot + p.SLOTS_PER_EPOCH:
         raise BlockProcessingError("attestation inclusion window")
     epoch_cache = cache.get(data.target.epoch)
     if epoch_cache is None:
         epoch_cache = acc.build_committee_cache(state, spec, data.target.epoch)
         cache[data.target.epoch] = epoch_cache
-    if data.index >= epoch_cache.committees_per_slot:
-        raise BlockProcessingError("bad committee index")
-    committee = epoch_cache.committee(data.slot, data.index)
-    if len(att.aggregation_bits) != len(committee):
-        raise BlockProcessingError("aggregation bits != committee size")
-    attesting = [i for i, bit in zip(committee, att.aggregation_bits) if bit]
+    if fork >= ForkName.electra:
+        # EIP-7549: committee index lives in committee_bits; aggregation bits
+        # span the named committees concatenated in index order
+        if data.index != 0:
+            raise BlockProcessingError("electra attestation data.index != 0")
+        try:
+            attesting = acc.get_attesting_indices_electra(
+                state, spec, att, epoch_cache
+            )
+        except ValueError as e:
+            raise BlockProcessingError(f"electra attestation: {e}") from e
+    else:
+        if data.index >= epoch_cache.committees_per_slot:
+            raise BlockProcessingError("bad committee index")
+        committee = epoch_cache.committee(data.slot, data.index)
+        if len(att.aggregation_bits) != len(committee):
+            raise BlockProcessingError("aggregation bits != committee size")
+        attesting = [i for i, bit in zip(committee, att.aggregation_bits) if bit]
 
     indexed = types.IndexedAttestation.make(
         attesting_indices=sorted(attesting),
@@ -390,39 +427,99 @@ def process_deposit(state, spec, types, deposit, fork):
     apply_deposit(state, spec, types, deposit.data, fork)
 
 
-def apply_deposit(state, spec, types, data, fork):
-    pubkeys = [bytes(v.pubkey) for v in state.validators]
-    pk = bytes(data.pubkey)
-    if pk not in pubkeys:
-        # new validator: verify deposit signature individually (invalid
-        # signatures are skipped, not block-invalidating — spec behavior)
-        try:
-            s = sigs.deposit_set(spec, types, data)
-        except Exception:
-            return
-        b = SignatureBatch()
-        b.add(s)
-        if not b.verify():
-            return
-        v = types.Validator.make(
-            pubkey=data.pubkey,
-            withdrawal_credentials=data.withdrawal_credentials,
-            effective_balance=min(
-                data.amount - data.amount % spec.effective_balance_increment,
-                spec.max_effective_balance,
-            ),
+def is_valid_deposit_signature(spec, types, pubkey, withdrawal_credentials, amount, signature) -> bool:
+    """Proof-of-possession check; invalid deposits are skipped, not
+    block-invalidating (spec behavior)."""
+    data = types.DepositData.make(
+        pubkey=pubkey,
+        withdrawal_credentials=withdrawal_credentials,
+        amount=amount,
+        signature=signature,
+    )
+    try:
+        s = sigs.deposit_set(spec, types, data)
+    except Exception:
+        return False
+    b = SignatureBatch()
+    b.add(s)
+    return b.verify()
+
+
+def add_validator_to_registry(state, spec, types, pubkey, withdrawal_credentials, amount) -> None:
+    electra = hasattr(state, "pending_deposits")
+    if electra:
+        v_probe = types.Validator.make(
+            pubkey=pubkey,
+            withdrawal_credentials=withdrawal_credentials,
+            effective_balance=0,
             slashed=False,
             activation_eligibility_epoch=FAR_FUTURE_EPOCH,
             activation_epoch=FAR_FUTURE_EPOCH,
             exit_epoch=FAR_FUTURE_EPOCH,
             withdrawable_epoch=FAR_FUTURE_EPOCH,
         )
-        state.validators.append(v)
-        state.balances.append(data.amount)
-        if fork >= ForkName.altair:
-            state.previous_epoch_participation.append(0)
-            state.current_epoch_participation.append(0)
-            state.inactivity_scores.append(0)
+        max_eff = h.get_max_effective_balance(v_probe, spec)
+    else:
+        max_eff = spec.max_effective_balance
+    v = types.Validator.make(
+        pubkey=pubkey,
+        withdrawal_credentials=withdrawal_credentials,
+        effective_balance=min(
+            amount - amount % spec.effective_balance_increment, max_eff
+        ),
+        slashed=False,
+        activation_eligibility_epoch=FAR_FUTURE_EPOCH,
+        activation_epoch=FAR_FUTURE_EPOCH,
+        exit_epoch=FAR_FUTURE_EPOCH,
+        withdrawable_epoch=FAR_FUTURE_EPOCH,
+    )
+    state.validators.append(v)
+    state.balances.append(amount)
+    if hasattr(state, "previous_epoch_participation"):
+        state.previous_epoch_participation.append(0)
+        state.current_epoch_participation.append(0)
+        state.inactivity_scores.append(0)
+
+
+def apply_deposit(state, spec, types, data, fork):
+    pubkeys = [bytes(v.pubkey) for v in state.validators]
+    pk = bytes(data.pubkey)
+
+    if fork >= ForkName.electra:
+        # EIP-6110: deposits flow through the pending queue; new validators
+        # are registered with zero balance, the amount follows via
+        # process_pending_deposits' churn
+        if pk not in pubkeys:
+            if not is_valid_deposit_signature(
+                spec, types, data.pubkey, data.withdrawal_credentials,
+                data.amount, data.signature,
+            ):
+                return
+            add_validator_to_registry(
+                state, spec, types, data.pubkey, data.withdrawal_credentials, 0
+            )
+        from ..types.spec import GENESIS_SLOT
+
+        state.pending_deposits.append(
+            types.PendingDeposit.make(
+                pubkey=data.pubkey,
+                withdrawal_credentials=data.withdrawal_credentials,
+                amount=data.amount,
+                signature=data.signature,
+                slot=GENESIS_SLOT,
+            )
+        )
+        return
+
+    if pk not in pubkeys:
+        if not is_valid_deposit_signature(
+            spec, types, data.pubkey, data.withdrawal_credentials,
+            data.amount, data.signature,
+        ):
+            return
+        add_validator_to_registry(
+            state, spec, types, data.pubkey, data.withdrawal_credentials, data.amount
+        )
     else:
         index = pubkeys.index(pk)
         mut.increase_balance(state, index, data.amount)
@@ -433,6 +530,8 @@ def apply_deposit(state, spec, types, data, fork):
 
 def process_voluntary_exit(state, spec, types, signed_exit, handle, get_pubkey):
     exit_ = signed_exit.message
+    if exit_.validator_index >= len(state.validators):
+        raise BlockProcessingError("exit: unknown validator")
     v = state.validators[exit_.validator_index]
     epoch = acc.get_current_epoch(state, spec)
     if not h.is_active_validator(v, epoch):
@@ -443,6 +542,12 @@ def process_voluntary_exit(state, spec, types, signed_exit, handle, get_pubkey):
         raise BlockProcessingError("exit epoch in future")
     if epoch < v.activation_epoch + spec.shard_committee_period:
         raise BlockProcessingError("validator too young to exit")
+    if hasattr(state, "pending_partial_withdrawals"):
+        # electra: only exit a validator with no pending partial withdrawals
+        from .electra import get_pending_balance_to_withdraw
+
+        if get_pending_balance_to_withdraw(state, exit_.validator_index) != 0:
+            raise BlockProcessingError("exit with pending partial withdrawals")
     handle(sigs.voluntary_exit_set(state, spec, types, signed_exit, get_pubkey))
     mut.initiate_validator_exit(state, spec, exit_.validator_index)
 
@@ -515,25 +620,63 @@ def compute_timestamp_at_slot(state, spec, slot) -> int:
 
 
 def get_expected_withdrawals(state, spec, types):
-    """Capella withdrawal sweep."""
+    """Capella withdrawal sweep; electra prepends the pending-partial queue
+    (EIP-7002) and uses compounding-aware balance ceilings (EIP-7251).
+
+    Returns (withdrawals, processed_partial_withdrawals_count)."""
     epoch = acc.get_current_epoch(state, spec)
     withdrawal_index = state.next_withdrawal_index
     validator_index = state.next_withdrawal_validator_index
     withdrawals = []
+    processed_partials = 0
+    electra = hasattr(state, "pending_partial_withdrawals")
+
+    if electra:
+        for w in state.pending_partial_withdrawals:
+            if (
+                w.withdrawable_epoch > epoch
+                or len(withdrawals)
+                == spec.preset.MAX_PENDING_PARTIALS_PER_WITHDRAWALS_SWEEP
+            ):
+                break
+            v = state.validators[w.validator_index]
+            has_sufficient = v.effective_balance >= spec.min_activation_balance
+            has_excess = state.balances[w.validator_index] > spec.min_activation_balance
+            if v.exit_epoch == FAR_FUTURE_EPOCH and has_sufficient and has_excess:
+                withdrawable = min(
+                    state.balances[w.validator_index] - spec.min_activation_balance,
+                    w.amount,
+                )
+                withdrawals.append(
+                    types.Withdrawal.make(
+                        index=withdrawal_index,
+                        validator_index=w.validator_index,
+                        address=bytes(v.withdrawal_credentials)[12:],
+                        amount=withdrawable,
+                    )
+                )
+                withdrawal_index += 1
+            processed_partials += 1
+
     n = len(state.validators)
     bound = min(n, spec.preset.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP)
     for _ in range(bound):
         v = state.validators[validator_index]
-        balance = state.balances[validator_index]
         wc = bytes(v.withdrawal_credentials)
-        has_eth1 = wc[:1] == b"\x01"
-        fully = (
-            has_eth1 and v.withdrawable_epoch <= epoch and balance > 0
-        )
+        if electra:
+            partially_withdrawn = sum(
+                w.amount for w in withdrawals if w.validator_index == validator_index
+            )
+            balance = state.balances[validator_index] - partially_withdrawn
+            has_cred = h.has_execution_withdrawal_credential(v)
+            max_eff = h.get_max_effective_balance(v, spec)
+        else:
+            balance = state.balances[validator_index]
+            has_cred = wc[:1] == b"\x01"
+            max_eff = spec.max_effective_balance
+        fully = has_cred and v.withdrawable_epoch <= epoch and balance > 0
         partially = (
-            has_eth1
-            and v.effective_balance == spec.max_effective_balance
-            and balance > spec.max_effective_balance
+            has_cred and v.effective_balance == max_eff and balance > max_eff
         )
         if fully:
             withdrawals.append(
@@ -551,14 +694,14 @@ def get_expected_withdrawals(state, spec, types):
                     index=withdrawal_index,
                     validator_index=validator_index,
                     address=wc[12:],
-                    amount=balance - spec.max_effective_balance,
+                    amount=balance - max_eff,
                 )
             )
             withdrawal_index += 1
         if len(withdrawals) == spec.preset.MAX_WITHDRAWALS_PER_PAYLOAD:
             break
         validator_index = (validator_index + 1) % n
-    return withdrawals
+    return withdrawals, processed_partials
 
 
 def is_execution_enabled(state, types, body) -> bool:
@@ -573,11 +716,15 @@ def process_withdrawals_and_payload(state, spec, types, block, fork):
     if not is_execution_enabled(state, types, block.body):
         return
     if fork >= ForkName.capella:
-        expected = get_expected_withdrawals(state, spec, types)
+        expected, processed_partials = get_expected_withdrawals(state, spec, types)
         if list(payload.withdrawals) != expected:
             raise BlockProcessingError("unexpected withdrawals")
         for w in expected:
             mut.decrease_balance(state, w.validator_index, w.amount)
+        if fork >= ForkName.electra:
+            state.pending_partial_withdrawals = list(
+                state.pending_partial_withdrawals[processed_partials:]
+            )
         if expected:
             state.next_withdrawal_index = expected[-1].index + 1
         if len(expected) == spec.preset.MAX_WITHDRAWALS_PER_PAYLOAD:

@@ -29,10 +29,20 @@ def _process_epoch_altair(state, spec, types, fork):
     process_justification_and_finalization(state, spec, types, fork)
     process_inactivity_updates(state, spec)
     process_rewards_and_penalties_altair(state, spec, fork)
-    process_registry_updates(state, spec)
-    process_slashings(state, spec, fork)
-    process_eth1_data_reset(state, spec)
-    process_effective_balance_updates(state, spec)
+    if fork >= ForkName.electra:
+        from . import electra as el
+
+        el.process_registry_updates_electra(state, spec)
+        el.process_slashings_electra(state, spec)
+        process_eth1_data_reset(state, spec)
+        el.process_pending_deposits(state, spec, types)
+        el.process_pending_consolidations(state, spec)
+        el.process_effective_balance_updates_electra(state, spec)
+    else:
+        process_registry_updates(state, spec)
+        process_slashings(state, spec, fork)
+        process_eth1_data_reset(state, spec)
+        process_effective_balance_updates(state, spec)
     process_slashings_reset(state, spec)
     process_randao_mixes_reset(state, spec)
     if fork >= ForkName.capella:
@@ -454,7 +464,9 @@ def _attestation_deltas_phase0(state, spec):
 
     if leaking:
         for i in eligible:
-            penalties[i] += base_reward(i) * 4  # BASE_REWARDS_PER_EPOCH
+            # spec get_inactivity_penalty_deltas: BASE_REWARDS_PER_EPOCH *
+            # base_reward - proposer_reward (the proposer share is not burned)
+            penalties[i] += base_reward(i) * 4 - proposer_reward(i)
             if i not in tgt_idx:
                 eff = state.validators[i].effective_balance
                 penalties[i] += (

@@ -24,6 +24,19 @@ def initiate_validator_exit(state, spec: ChainSpec, index: int) -> None:
     v = state.validators[index]
     if v.exit_epoch != FAR_FUTURE_EPOCH:
         return
+    if hasattr(state, "earliest_exit_epoch"):
+        # electra: balance-denominated churn (EIP-7251)
+        from .electra import compute_exit_epoch_and_update_churn
+
+        exit_queue_epoch = compute_exit_epoch_and_update_churn(
+            state, spec, v.effective_balance
+        )
+        state.validators[index] = v.copy_with(
+            exit_epoch=exit_queue_epoch,
+            withdrawable_epoch=exit_queue_epoch
+            + spec.min_validator_withdrawability_delay,
+        )
+        return
     exit_epochs = [
         w.exit_epoch for w in state.validators if w.exit_epoch != FAR_FUTURE_EPOCH
     ]
@@ -62,6 +75,8 @@ def slash_validator(
         min_quotient = spec.min_slashing_penalty_quotient
     elif fork == ForkName.altair:
         min_quotient = spec.min_slashing_penalty_quotient_altair
+    elif fork >= ForkName.electra:
+        min_quotient = spec.min_slashing_penalty_quotient_electra
     else:
         min_quotient = spec.min_slashing_penalty_quotient_bellatrix
     decrease_balance(state, slashed_index, v.effective_balance // min_quotient)
@@ -69,7 +84,12 @@ def slash_validator(
     proposer_index = acc.get_beacon_proposer_index(state, spec)
     if whistleblower_index is None:
         whistleblower_index = proposer_index
-    whistleblower_reward = v.effective_balance // spec.whistleblower_reward_quotient
+    if fork >= ForkName.electra:
+        whistleblower_reward = (
+            v.effective_balance // spec.whistleblower_reward_quotient_electra
+        )
+    else:
+        whistleblower_reward = v.effective_balance // spec.whistleblower_reward_quotient
     if fork == ForkName.phase0:
         proposer_reward = whistleblower_reward // spec.proposer_reward_quotient
     else:

@@ -198,9 +198,94 @@ def _upgrade_to_deneb(state, spec):
 
 
 def _upgrade_to_electra(state, spec):
-    # electra containers are deneb-shaped in this round; bump the version
+    """Real electra upgrade (upgrade/electra.rs analog): balance-churn fields
+    seeded from the current registry, pre-activation validators re-queued
+    through pending_deposits, compounding early-adopters' excess queued."""
+    from ..types.spec import (
+        FAR_FUTURE_EPOCH,
+        GENESIS_SLOT,
+        G2_POINT_AT_INFINITY,
+        UNSET_DEPOSIT_REQUESTS_START_INDEX,
+    )
+    from ..types import helpers as h
+    from . import electra as el
+
     types = spec_types(spec.preset, ForkName.electra)
-    new_state = _carry_fields(state, types, spec.electra_fork_version, spec, {})
+    current_epoch = acc.get_current_epoch(state, spec)
+
+    # spec: max over exit epochs (default current_epoch), +1 unconditionally
+    earliest_exit_epoch = (
+        max(
+            (v.exit_epoch for v in state.validators if v.exit_epoch != FAR_FUTURE_EPOCH),
+            default=current_epoch,
+        )
+        + 1
+    )
+
+    old_header = state.latest_execution_payload_header
+    hdr_fields = {
+        f.name: getattr(old_header, f.name, f.type.default())
+        for f in types.ExecutionPayloadHeader.fields
+    }
+    new_state = _carry_fields(
+        state,
+        types,
+        spec.electra_fork_version,
+        spec,
+        {
+            "latest_execution_payload_header": types.ExecutionPayloadHeader.make(**hdr_fields),
+            "deposit_requests_start_index": UNSET_DEPOSIT_REQUESTS_START_INDEX,
+            "deposit_balance_to_consume": 0,
+            "exit_balance_to_consume": 0,
+            "earliest_exit_epoch": earliest_exit_epoch,
+            "consolidation_balance_to_consume": 0,
+            "earliest_consolidation_epoch": h.compute_activation_exit_epoch(
+                current_epoch, spec
+            ),
+            "pending_deposits": [],
+            "pending_partial_withdrawals": [],
+            "pending_consolidations": [],
+        },
+    )
+    new_state.exit_balance_to_consume = el.get_activation_exit_churn_limit(
+        new_state, spec
+    )
+    new_state.consolidation_balance_to_consume = el.get_consolidation_churn_limit(
+        new_state, spec
+    )
+
+    # re-queue validators that never became eligible through the new
+    # pending-deposit churn, FIFO by (eligibility epoch, index)
+    pre_activation = sorted(
+        (
+            i
+            for i, v in enumerate(new_state.validators)
+            if v.activation_epoch == FAR_FUTURE_EPOCH
+        ),
+        key=lambda i: (new_state.validators[i].activation_eligibility_epoch, i),
+    )
+    for index in pre_activation:
+        balance = new_state.balances[index]
+        new_state.balances[index] = 0
+        v = new_state.validators[index]
+        new_state.validators[index] = v.copy_with(
+            effective_balance=0, activation_eligibility_epoch=FAR_FUTURE_EPOCH
+        )
+        new_state.pending_deposits.append(
+            types.PendingDeposit.make(
+                pubkey=v.pubkey,
+                withdrawal_credentials=v.withdrawal_credentials,
+                amount=balance,
+                signature=G2_POINT_AT_INFINITY,
+                slot=GENESIS_SLOT,
+            )
+        )
+
+    # compounding early adopters go through the queue for their excess
+    for index, v in enumerate(new_state.validators):
+        if h.has_compounding_withdrawal_credential(v):
+            el.queue_excess_active_balance(new_state, spec, index)
+
     _replace_in_place(state, new_state)
 
 

@@ -122,11 +122,12 @@ def _build_attestations(self, state, slot, head_root):
     atts = []
     from ..crypto.bls381 import curve as cv
 
+    electra = spec.fork_name_at_slot(slot) >= ForkName.electra
     for index in range(cache.committees_per_slot):
         committee = cache.committee(slot, index)
         data = types.AttestationData.make(
             slot=slot,
-            index=index,
+            index=0 if electra else index,
             beacon_block_root=head_root,
             source=source,
             target=types.Checkpoint.make(epoch=epoch, root=target_root),
@@ -140,13 +141,26 @@ def _build_attestations(self, state, slot, head_root):
                 s = bls.sign(self.sk(vi), root)
                 agg_point = cv.g2_add(agg_point, s.point)
             sig_bytes = bls.Signature(agg_point).serialize()
-        atts.append(
-            types.Attestation.make(
-                aggregation_bits=[True] * len(committee),
-                data=data,
-                signature=sig_bytes,
+        if electra:
+            # EIP-7549: one attestation per committee, committee_bits set
+            committee_bits = [False] * spec.preset.MAX_COMMITTEES_PER_SLOT
+            committee_bits[index] = True
+            atts.append(
+                types.Attestation.make(
+                    aggregation_bits=[True] * len(committee),
+                    data=data,
+                    signature=sig_bytes,
+                    committee_bits=committee_bits,
+                )
             )
-        )
+        else:
+            atts.append(
+                types.Attestation.make(
+                    aggregation_bits=[True] * len(committee),
+                    data=data,
+                    signature=sig_bytes,
+                )
+            )
     return atts
 
 
@@ -201,6 +215,13 @@ def _produce_block(self, slot: int, attestations=(), full_sync: bool = True):
     # process_slots filled latest_block_header.state_root at the parent slot
     parent_root = types.BeaconBlockHeader.hash_tree_root(state.latest_block_header)
 
+    # drop attestations whose container shape doesn't match the block's fork
+    # (at the electra boundary, pre-fork attestations can't be included —
+    # EIP-7549 changed the Attestation container)
+    electra_block = fork >= ForkName.electra
+    attestations = [
+        a for a in attestations if hasattr(a, "committee_bits") == electra_block
+    ]
     body_kwargs = dict(
         randao_reveal=self.randao_reveal(state, proposer, epoch),
         eth1_data=state.eth1_data,
@@ -225,6 +246,8 @@ def _produce_block(self, slot: int, attestations=(), full_sync: bool = True):
         body_kwargs["bls_to_execution_changes"] = []
     if fork >= ForkName.deneb:
         body_kwargs["blob_kzg_commitments"] = []
+    if fork >= ForkName.electra:
+        body_kwargs["execution_requests"] = types.ExecutionRequests.default()
 
     block = types.BeaconBlock.make(
         slot=slot,

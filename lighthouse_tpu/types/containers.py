@@ -279,14 +279,94 @@ class SpecTypes:
                 ("index", uint64),
             ])
 
+        # ---- electra (EIP-6110/7002/7251/7549)
+        if fork >= ForkName.electra:
+            self.PendingDeposit = C("PendingDeposit", [
+                ("pubkey", BLSPubkey),
+                ("withdrawal_credentials", Bytes32),
+                ("amount", Gwei),
+                ("signature", BLSSignature),
+                ("slot", Slot),
+            ])
+            self.PendingPartialWithdrawal = C("PendingPartialWithdrawal", [
+                ("validator_index", ValidatorIndex),
+                ("amount", Gwei),
+                ("withdrawable_epoch", Epoch),
+            ])
+            self.PendingConsolidation = C("PendingConsolidation", [
+                ("source_index", ValidatorIndex),
+                ("target_index", ValidatorIndex),
+            ])
+            self.DepositRequest = C("DepositRequest", [
+                ("pubkey", BLSPubkey),
+                ("withdrawal_credentials", Bytes32),
+                ("amount", Gwei),
+                ("signature", BLSSignature),
+                ("index", uint64),
+            ])
+            self.WithdrawalRequest = C("WithdrawalRequest", [
+                ("source_address", ExecutionAddress),
+                ("validator_pubkey", BLSPubkey),
+                ("amount", Gwei),
+            ])
+            self.ConsolidationRequest = C("ConsolidationRequest", [
+                ("source_address", ExecutionAddress),
+                ("source_pubkey", BLSPubkey),
+                ("target_pubkey", BLSPubkey),
+            ])
+            self.ExecutionRequests = C("ExecutionRequests", [
+                ("deposits", List(self.DepositRequest, p.MAX_DEPOSIT_REQUESTS_PER_PAYLOAD)),
+                ("withdrawals", List(self.WithdrawalRequest, p.MAX_WITHDRAWAL_REQUESTS_PER_PAYLOAD)),
+                ("consolidations", List(self.ConsolidationRequest, p.MAX_CONSOLIDATION_REQUESTS_PER_PAYLOAD)),
+            ])
+            # EIP-7549: attestations span all committees of a slot; the
+            # committee index moves out of AttestationData into committee_bits
+            max_agg_bits = p.MAX_VALIDATORS_PER_COMMITTEE * p.MAX_COMMITTEES_PER_SLOT
+            self.Attestation = C("Attestation", [
+                ("aggregation_bits", Bitlist(max_agg_bits)),
+                ("data", self.AttestationData),
+                ("signature", BLSSignature),
+                ("committee_bits", Bitvector(p.MAX_COMMITTEES_PER_SLOT)),
+            ])
+            self.IndexedAttestation = C("IndexedAttestation", [
+                ("attesting_indices", List(ValidatorIndex, max_agg_bits)),
+                ("data", self.AttestationData),
+                ("signature", BLSSignature),
+            ])
+            self.AttesterSlashing = C("AttesterSlashing", [
+                ("attestation_1", self.IndexedAttestation),
+                ("attestation_2", self.IndexedAttestation),
+            ])
+            self.AggregateAndProof = C("AggregateAndProof", [
+                ("aggregator_index", ValidatorIndex),
+                ("aggregate", self.Attestation),
+                ("selection_proof", BLSSignature),
+            ])
+            self.SignedAggregateAndProof = C("SignedAggregateAndProof", [
+                ("message", self.AggregateAndProof),
+                ("signature", BLSSignature),
+            ])
+            self.SingleAttestation = C("SingleAttestation", [
+                ("committee_index", CommitteeIndex),
+                ("attester_index", ValidatorIndex),
+                ("data", self.AttestationData),
+                ("signature", BLSSignature),
+            ])
+
         # ---- block body (per fork)
+        if fork >= ForkName.electra:
+            max_att_slashings = p.MAX_ATTESTER_SLASHINGS_ELECTRA
+            max_atts = p.MAX_ATTESTATIONS_ELECTRA
+        else:
+            max_att_slashings = p.MAX_ATTESTER_SLASHINGS
+            max_atts = p.MAX_ATTESTATIONS
         body_fields = [
             ("randao_reveal", BLSSignature),
             ("eth1_data", self.Eth1Data),
             ("graffiti", Bytes32),
             ("proposer_slashings", List(self.ProposerSlashing, p.MAX_PROPOSER_SLASHINGS)),
-            ("attester_slashings", List(self.AttesterSlashing, p.MAX_ATTESTER_SLASHINGS)),
-            ("attestations", List(self.Attestation, p.MAX_ATTESTATIONS)),
+            ("attester_slashings", List(self.AttesterSlashing, max_att_slashings)),
+            ("attestations", List(self.Attestation, max_atts)),
             ("deposits", List(self.Deposit, p.MAX_DEPOSITS)),
             ("voluntary_exits", List(self.SignedVoluntaryExit, p.MAX_VOLUNTARY_EXITS)),
         ]
@@ -304,6 +384,8 @@ class SpecTypes:
                 ("blob_kzg_commitments",
                  List(KZGCommitment, p.MAX_BLOB_COMMITMENTS_PER_BLOCK))
             )
+        if fork >= ForkName.electra:
+            body_fields.append(("execution_requests", self.ExecutionRequests))
         self.BeaconBlockBody = C("BeaconBlockBody", body_fields)
 
         self.BeaconBlock = C("BeaconBlock", [
@@ -383,6 +465,21 @@ class SpecTypes:
                 ("next_withdrawal_validator_index", ValidatorIndex),
                 ("historical_summaries",
                  List(self.HistoricalSummary, p.HISTORICAL_ROOTS_LIMIT)),
+            ]
+        if fork >= ForkName.electra:
+            state_fields += [
+                ("deposit_requests_start_index", uint64),
+                ("deposit_balance_to_consume", Gwei),
+                ("exit_balance_to_consume", Gwei),
+                ("earliest_exit_epoch", Epoch),
+                ("consolidation_balance_to_consume", Gwei),
+                ("earliest_consolidation_epoch", Epoch),
+                ("pending_deposits",
+                 List(self.PendingDeposit, p.PENDING_DEPOSITS_LIMIT)),
+                ("pending_partial_withdrawals",
+                 List(self.PendingPartialWithdrawal, p.PENDING_PARTIAL_WITHDRAWALS_LIMIT)),
+                ("pending_consolidations",
+                 List(self.PendingConsolidation, p.PENDING_CONSOLIDATIONS_LIMIT)),
             ]
         self.BeaconState = C("BeaconState", state_fields)
 

@@ -110,11 +110,39 @@ def is_active_validator(v, epoch: int) -> bool:
     return v.activation_epoch <= epoch < v.exit_epoch
 
 
-def is_eligible_for_activation_queue(v, spec: ChainSpec) -> bool:
+def is_eligible_for_activation_queue(v, spec: ChainSpec, electra: bool = False) -> bool:
+    if electra:
+        # EIP-7251: any balance >= MIN_ACTIVATION_BALANCE is queue-eligible
+        return (
+            v.activation_eligibility_epoch == FAR_FUTURE_EPOCH
+            and v.effective_balance >= spec.min_activation_balance
+        )
     return (
         v.activation_eligibility_epoch == FAR_FUTURE_EPOCH
         and v.effective_balance == spec.max_effective_balance
     )
+
+
+# ------------------------------------------------------------ withdrawal credentials
+
+
+def has_eth1_withdrawal_credential(v) -> bool:
+    return bytes(v.withdrawal_credentials)[:1] == b"\x01"
+
+
+def has_compounding_withdrawal_credential(v) -> bool:
+    return bytes(v.withdrawal_credentials)[:1] == b"\x02"
+
+
+def has_execution_withdrawal_credential(v) -> bool:
+    return has_eth1_withdrawal_credential(v) or has_compounding_withdrawal_credential(v)
+
+
+def get_max_effective_balance(v, spec: ChainSpec) -> int:
+    """EIP-7251: compounding validators may hold up to 2048 ETH effective."""
+    if has_compounding_withdrawal_credential(v):
+        return spec.max_effective_balance_electra
+    return spec.min_activation_balance
 
 
 def is_slashable_validator(v, epoch: int) -> bool:
@@ -208,10 +236,15 @@ def compute_committee(
     return shuffled_indices[start:end]
 
 
-def compute_proposer_index(state, spec: ChainSpec, indices: list[int], seed: bytes) -> int:
-    """Spec compute_proposer_index (effective-balance weighted sampling)."""
+def compute_proposer_index(
+    state, spec: ChainSpec, indices: list[int], seed: bytes, electra: bool = False
+) -> int:
+    """Spec compute_proposer_index (effective-balance weighted sampling).
+
+    Electra (EIP-7251) widens the acceptance sample from 1 random byte
+    against MAX_EFFECTIVE_BALANCE to 2 bytes against
+    MAX_EFFECTIVE_BALANCE_ELECTRA, so 2048-ETH validators sample evenly."""
     assert indices
-    max_random_byte = 255
     i = 0
     total = len(indices)
     while True:
@@ -219,8 +252,15 @@ def compute_proposer_index(state, spec: ChainSpec, indices: list[int], seed: byt
             i % total, total, seed, spec.preset.SHUFFLE_ROUND_COUNT
         )
         candidate = indices[shuffled]
-        random_byte = sha256(seed + int_to_bytes(i // 32, 8))[i % 32]
         eff = state.validators[candidate].effective_balance
-        if eff * max_random_byte >= spec.max_effective_balance * random_byte:
-            return candidate
+        if electra:
+            rnd = sha256(seed + int_to_bytes(i // 16, 8))
+            off = (i % 16) * 2
+            random_value = int.from_bytes(rnd[off : off + 2], "little")
+            if eff * 0xFFFF >= spec.max_effective_balance_electra * random_value:
+                return candidate
+        else:
+            random_byte = sha256(seed + int_to_bytes(i // 32, 8))[i % 32]
+            if eff * 255 >= spec.max_effective_balance * random_byte:
+                return candidate
         i += 1

@@ -104,6 +104,8 @@ class Preset:
     PENDING_CONSOLIDATIONS_LIMIT: int
     # misc deposit tree
     DEPOSIT_CONTRACT_TREE_DEPTH: int = 32
+    MAX_PENDING_PARTIALS_PER_WITHDRAWALS_SWEEP: int = 8
+    MAX_PENDING_DEPOSITS_PER_EPOCH: int = 16
 
 
 MAINNET_PRESET = Preset(
@@ -163,7 +165,23 @@ MINIMAL_PRESET = replace(
     MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP=16,
     FIELD_ELEMENTS_PER_BLOB=4096,
     MAX_BLOB_COMMITMENTS_PER_BLOCK=32,
+    MAX_DEPOSIT_REQUESTS_PER_PAYLOAD=4,
+    MAX_WITHDRAWAL_REQUESTS_PER_PAYLOAD=2,
+    PENDING_PARTIAL_WITHDRAWALS_LIMIT=64,
+    PENDING_CONSOLIDATIONS_LIMIT=64,
+    MAX_PENDING_PARTIALS_PER_WITHDRAWALS_SWEEP=2,
 )
+
+
+# electra misc constants
+UNSET_DEPOSIT_REQUESTS_START_INDEX = 2**64 - 1
+FULL_EXIT_REQUEST_AMOUNT = 0
+GENESIS_SLOT = 0
+BLS_WITHDRAWAL_PREFIX = b"\x00"
+ETH1_ADDRESS_WITHDRAWAL_PREFIX = b"\x01"
+COMPOUNDING_WITHDRAWAL_PREFIX = b"\x02"
+# compressed G2 point at infinity (pending-deposit signature placeholder)
+G2_POINT_AT_INFINITY = b"\xc0" + b"\x00" * 95
 
 
 # domains (spec DomainType values, 4 bytes little-endian of the given ints)
@@ -270,6 +288,7 @@ class ChainSpec:
 
     # deneb
     max_blobs_per_block: int = 6
+    max_blobs_per_block_electra: int = 9
     min_epochs_for_blob_sidecars_requests: int = 4096
 
     # terminal merge params
@@ -333,6 +352,13 @@ class ChainSpec:
             self.churn_limit(active_validator_count),
         )
 
+    def max_blobs(self, fork: ForkName) -> int:
+        return (
+            self.max_blobs_per_block_electra
+            if fork >= ForkName.electra
+            else self.max_blobs_per_block
+        )
+
 
 def mainnet_spec() -> ChainSpec:
     return ChainSpec()
@@ -359,6 +385,7 @@ def minimal_spec(**overrides) -> ChainSpec:
         min_genesis_active_validator_count=64,
         churn_limit_quotient=32,
         seconds_per_slot=6,
+        min_per_epoch_churn_limit_electra=64 * 10**9,
     )
     defaults.update(overrides)
     return ChainSpec(**defaults)

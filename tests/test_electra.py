@@ -1,0 +1,418 @@
+"""Electra state-transition tests: EIP-6110/7002/7251/7549 ops, the upgrade,
+and an electra-genesis finalizing chain (spec-pinned unit behavior, matching
+the electra arms of the reference's per_block_processing / single_pass)."""
+
+import pytest
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.types.spec import (
+    FAR_FUTURE_EPOCH,
+    GENESIS_SLOT,
+    UNSET_DEPOSIT_REQUESTS_START_INDEX,
+    ForkName,
+    minimal_spec,
+)
+from lighthouse_tpu.types.containers import spec_types
+from lighthouse_tpu.state_transition import electra as el
+from lighthouse_tpu.state_transition import accessors as acc
+from lighthouse_tpu.state_transition import mutators as mut
+from lighthouse_tpu.state_transition.block import BlockProcessingError
+from lighthouse_tpu.state_transition.slot import upgrade_state
+from lighthouse_tpu.testing.harness import StateHarness, clone_state
+
+VALIDATORS = 64
+
+
+def electra_spec(**kw):
+    return minimal_spec(electra_fork_epoch=0, **kw)
+
+
+@pytest.fixture(scope="module")
+def harness():
+    bls.set_backend("fake")
+    return StateHarness.new(electra_spec(), VALIDATORS)
+
+
+@pytest.fixture()
+def st(harness):
+    return clone_state(harness.state, harness.spec)
+
+
+@pytest.fixture(scope="module")
+def types(harness):
+    return spec_types(harness.spec.preset, ForkName.electra)
+
+
+# ---------------------------------------------------------------- containers
+
+
+def test_electra_state_has_spec_fields(st):
+    for f in (
+        "deposit_requests_start_index",
+        "deposit_balance_to_consume",
+        "exit_balance_to_consume",
+        "earliest_exit_epoch",
+        "consolidation_balance_to_consume",
+        "earliest_consolidation_epoch",
+        "pending_deposits",
+        "pending_partial_withdrawals",
+        "pending_consolidations",
+    ):
+        assert hasattr(st, f), f
+    assert st.deposit_requests_start_index == UNSET_DEPOSIT_REQUESTS_START_INDEX
+
+
+def test_electra_attestation_container_shape(types):
+    att = types.Attestation.default()
+    assert hasattr(att, "committee_bits")
+    body = types.BeaconBlockBody.default()
+    assert hasattr(body, "execution_requests")
+    reqs = body.execution_requests
+    assert hasattr(reqs, "deposits")
+    assert hasattr(reqs, "withdrawals")
+    assert hasattr(reqs, "consolidations")
+
+
+# ---------------------------------------------------------------- upgrade
+
+
+def test_upgrade_to_electra_requeues_preactivation(harness):
+    spec = minimal_spec()  # deneb genesis
+    h = StateHarness(spec=spec, keypairs=harness.keypairs)
+    st = clone_state(h.state, spec)
+    # one validator deposited but never activated
+    types_d = spec_types(spec.preset, ForkName.deneb)
+    v = st.validators[0]
+    st.validators[0] = v.copy_with(
+        activation_epoch=FAR_FUTURE_EPOCH,
+        activation_eligibility_epoch=3,
+    )
+    pre_balance = st.balances[0]
+
+    el_spec = electra_spec()
+    upgrade_state(st, el_spec, ForkName.deneb, ForkName.electra)
+
+    assert bytes(st.fork.current_version) == el_spec.electra_fork_version
+    assert st.deposit_requests_start_index == UNSET_DEPOSIT_REQUESTS_START_INDEX
+    assert st.exit_balance_to_consume == el.get_activation_exit_churn_limit(st, el_spec)
+    # pre-activation validator re-queued through pending_deposits
+    assert st.balances[0] == 0
+    assert st.validators[0].effective_balance == 0
+    assert st.validators[0].activation_eligibility_epoch == FAR_FUTURE_EPOCH
+    assert len(st.pending_deposits) == 1
+    pd = st.pending_deposits[0]
+    assert pd.amount == pre_balance
+    assert pd.slot == GENESIS_SLOT
+    assert bytes(pd.pubkey) == bytes(st.validators[0].pubkey)
+
+
+def test_upgrade_seeds_earliest_exit_epoch_past_exits(harness):
+    spec = minimal_spec()
+    h = StateHarness(spec=spec, keypairs=harness.keypairs)
+    st = clone_state(h.state, spec)
+    st.validators[5] = st.validators[5].copy_with(exit_epoch=42)
+    el_spec = electra_spec()
+    upgrade_state(st, el_spec, ForkName.deneb, ForkName.electra)
+    assert st.earliest_exit_epoch == 43
+
+
+# ---------------------------------------------------------------- EIP-6110
+
+
+def test_deposit_request_sets_start_index_and_queues(st, harness, types):
+    spec = harness.spec
+    req = types.DepositRequest.make(
+        pubkey=b"\xaa" * 48,
+        withdrawal_credentials=b"\x01" + b"\x00" * 31,
+        amount=32 * 10**9,
+        signature=b"\xbb" * 96,
+        index=7,
+    )
+    el.process_deposit_request(st, spec, types, req)
+    assert st.deposit_requests_start_index == 7
+    assert len(st.pending_deposits) == 1
+    assert st.pending_deposits[0].slot == st.slot
+    # second request does not move the start index
+    el.process_deposit_request(st, spec, types, req.copy_with(index=9))
+    assert st.deposit_requests_start_index == 7
+
+
+def test_pending_deposit_topup_applied_with_churn(st, harness, types):
+    spec = harness.spec
+    v0 = st.validators[0]
+    st.pending_deposits.append(
+        types.PendingDeposit.make(
+            pubkey=v0.pubkey,
+            withdrawal_credentials=v0.withdrawal_credentials,
+            amount=5 * 10**9,
+            signature=b"\x00" * 96,
+            slot=GENESIS_SLOT,
+        )
+    )
+    pre = st.balances[0]
+    el.process_pending_deposits(st, spec, types)
+    assert st.balances[0] == pre + 5 * 10**9
+    assert len(st.pending_deposits) == 0
+    assert st.deposit_balance_to_consume == 0
+
+
+def test_pending_deposits_respect_churn_limit(st, harness, types):
+    spec = harness.spec
+    churn = el.get_activation_exit_churn_limit(st, spec)
+    v0 = st.validators[0]
+    # queue 3 deposits of a full churn each: only the first fits this epoch
+    for _ in range(3):
+        st.pending_deposits.append(
+            types.PendingDeposit.make(
+                pubkey=v0.pubkey,
+                withdrawal_credentials=v0.withdrawal_credentials,
+                amount=churn,
+                signature=b"\x00" * 96,
+                slot=GENESIS_SLOT,
+            )
+        )
+    el.process_pending_deposits(st, spec, types)
+    assert len(st.pending_deposits) == 2  # churn hit after the first
+
+
+# ---------------------------------------------------------------- EIP-7002
+
+
+def _make_executable(st, index, prefix=b"\x01", address=b"\x11" * 20):
+    v = st.validators[index]
+    st.validators[index] = v.copy_with(
+        withdrawal_credentials=prefix + b"\x00" * 11 + address
+    )
+    return address
+
+
+def _age_past_shard_committee_period(st, spec):
+    """EL-triggered exits require the validator be active for
+    SHARD_COMMITTEE_PERIOD epochs; jump logical time forward."""
+    st.slot = (spec.shard_committee_period + 1) * spec.preset.SLOTS_PER_EPOCH
+
+
+def test_withdrawal_request_full_exit(st, harness, types):
+    spec = harness.spec
+    _age_past_shard_committee_period(st, spec)
+    addr = _make_executable(st, 3)
+    req = types.WithdrawalRequest.make(
+        source_address=addr,
+        validator_pubkey=st.validators[3].pubkey,
+        amount=0,  # FULL_EXIT_REQUEST_AMOUNT
+    )
+    el.process_withdrawal_request(st, spec, types, req)
+    assert st.validators[3].exit_epoch != FAR_FUTURE_EPOCH
+
+
+def test_withdrawal_request_wrong_source_ignored(st, harness, types):
+    spec = harness.spec
+    _age_past_shard_committee_period(st, spec)
+    _make_executable(st, 3)
+    req = types.WithdrawalRequest.make(
+        source_address=b"\x99" * 20,  # not the credentialed address
+        validator_pubkey=st.validators[3].pubkey,
+        amount=0,
+    )
+    el.process_withdrawal_request(st, spec, types, req)
+    assert st.validators[3].exit_epoch == FAR_FUTURE_EPOCH
+
+
+def test_withdrawal_request_partial_compounding(st, harness, types):
+    spec = harness.spec
+    _age_past_shard_committee_period(st, spec)
+    addr = _make_executable(st, 4, prefix=b"\x02")
+    st.balances[4] = 40 * 10**9  # 8 ETH excess over MIN_ACTIVATION_BALANCE
+    req = types.WithdrawalRequest.make(
+        source_address=addr,
+        validator_pubkey=st.validators[4].pubkey,
+        amount=6 * 10**9,
+    )
+    el.process_withdrawal_request(st, spec, types, req)
+    assert len(st.pending_partial_withdrawals) == 1
+    w = st.pending_partial_withdrawals[0]
+    assert w.validator_index == 4
+    assert w.amount == 6 * 10**9
+    # validator is NOT exiting
+    assert st.validators[4].exit_epoch == FAR_FUTURE_EPOCH
+
+
+def test_partial_withdrawal_requires_compounding(st, harness, types):
+    spec = harness.spec
+    _age_past_shard_committee_period(st, spec)
+    addr = _make_executable(st, 4, prefix=b"\x01")  # eth1, not compounding
+    st.balances[4] = 40 * 10**9
+    req = types.WithdrawalRequest.make(
+        source_address=addr,
+        validator_pubkey=st.validators[4].pubkey,
+        amount=6 * 10**9,
+    )
+    el.process_withdrawal_request(st, spec, types, req)
+    assert len(st.pending_partial_withdrawals) == 0
+
+
+def test_voluntary_exit_blocked_by_pending_partials(st, harness, types):
+    spec = harness.spec
+    from lighthouse_tpu.state_transition.block import process_voluntary_exit
+
+    st.pending_partial_withdrawals.append(
+        types.PendingPartialWithdrawal.make(
+            validator_index=6, amount=10**9, withdrawable_epoch=99
+        )
+    )
+    # age the validator past shard_committee_period
+    from lighthouse_tpu.state_transition.slot import process_slots
+
+    exit_msg = types.VoluntaryExit.make(epoch=0, validator_index=6)
+    signed = types.SignedVoluntaryExit.make(message=exit_msg, signature=b"\x00" * 96)
+    st.slot = (spec.shard_committee_period + 1) * spec.preset.SLOTS_PER_EPOCH
+    with pytest.raises(BlockProcessingError, match="pending partial"):
+        process_voluntary_exit(st, spec, types, signed, lambda s: None, lambda i: None)
+
+
+# ---------------------------------------------------------------- EIP-7251
+
+
+def test_consolidation_request_queues(st, harness, types):
+    # at 64 validators the balance churn equals the activation-exit cap, so
+    # consolidation churn is zero; lower the cap to open consolidation budget
+    import dataclasses
+    spec = dataclasses.replace(
+        harness.spec, max_per_epoch_activation_exit_churn_limit=16 * 10**9
+    )
+    _age_past_shard_committee_period(st, spec)
+    # source: eth1 credential; target: compounding
+    saddr = _make_executable(st, 1, prefix=b"\x01", address=b"\x21" * 20)
+    _make_executable(st, 2, prefix=b"\x02")
+    req = types.ConsolidationRequest.make(
+        source_address=saddr,
+        source_pubkey=st.validators[1].pubkey,
+        target_pubkey=st.validators[2].pubkey,
+    )
+    el.process_consolidation_request(st, spec, types, req)
+    assert len(st.pending_consolidations) == 1
+    pc = st.pending_consolidations[0]
+    assert (pc.source_index, pc.target_index) == (1, 2)
+    assert st.validators[1].exit_epoch != FAR_FUTURE_EPOCH
+
+
+def test_switch_to_compounding_request(st, harness, types):
+    spec = harness.spec
+    saddr = _make_executable(st, 7, prefix=b"\x01", address=b"\x31" * 20)
+    req = types.ConsolidationRequest.make(
+        source_address=saddr,
+        source_pubkey=st.validators[7].pubkey,
+        target_pubkey=st.validators[7].pubkey,  # self => switch request
+    )
+    st.balances[7] = 33 * 10**9
+    el.process_consolidation_request(st, spec, types, req)
+    v = st.validators[7]
+    assert bytes(v.withdrawal_credentials)[:1] == b"\x02"
+    assert v.exit_epoch == FAR_FUTURE_EPOCH
+    # excess balance above MIN_ACTIVATION queued as pending deposit
+    assert st.balances[7] == 32 * 10**9
+    assert len(st.pending_deposits) == 1
+    assert st.pending_deposits[0].amount == 1 * 10**9
+
+
+def test_pending_consolidation_moves_balance(st, harness, types):
+    spec = harness.spec
+    next_epoch = acc.get_current_epoch(st, spec) + 1
+    st.validators[1] = st.validators[1].copy_with(
+        exit_epoch=1, withdrawable_epoch=next_epoch
+    )
+    st.pending_consolidations.append(
+        types.PendingConsolidation.make(source_index=1, target_index=2)
+    )
+    b1, b2 = st.balances[1], st.balances[2]
+    eff = st.validators[1].effective_balance
+    el.process_pending_consolidations(st, spec)
+    assert st.balances[1] == b1 - eff
+    assert st.balances[2] == b2 + eff
+    assert len(st.pending_consolidations) == 0
+
+
+def test_slashed_source_consolidation_skipped(st, harness, types):
+    spec = harness.spec
+    st.validators[1] = st.validators[1].copy_with(slashed=True)
+    st.pending_consolidations.append(
+        types.PendingConsolidation.make(source_index=1, target_index=2)
+    )
+    b2 = st.balances[2]
+    el.process_pending_consolidations(st, spec)
+    assert st.balances[2] == b2
+    assert len(st.pending_consolidations) == 0
+
+
+# ---------------------------------------------------------------- churn
+
+
+def test_exit_churn_accumulates_across_exits(st, harness):
+    spec = harness.spec
+    churn = el.get_activation_exit_churn_limit(st, spec)
+    # exit validators until the per-epoch churn is exceeded
+    n_exits = churn // (32 * 10**9) + 1
+    epochs = set()
+    for i in range(n_exits):
+        mut.initiate_validator_exit(st, spec, i)
+        epochs.add(st.validators[i].exit_epoch)
+    assert len(epochs) >= 2, "overflow exit must land in a later epoch"
+
+
+def test_effective_balance_ceiling_compounding(st, harness):
+    spec = harness.spec
+    _make_executable(st, 9, prefix=b"\x02")
+    st.balances[9] = 100 * 10**9
+    el.process_effective_balance_updates_electra(st, spec)
+    assert st.validators[9].effective_balance == 100 * 10**9  # above 32 ETH
+
+    _make_executable(st, 10, prefix=b"\x01")
+    st.balances[10] = 100 * 10**9
+    el.process_effective_balance_updates_electra(st, spec)
+    assert st.validators[10].effective_balance == spec.min_activation_balance
+
+
+# ---------------------------------------------------------------- withdrawals
+
+
+def test_expected_withdrawals_include_pending_partials(st, harness, types):
+    spec = harness.spec
+    from lighthouse_tpu.state_transition.block import get_expected_withdrawals
+
+    _make_executable(st, 11, prefix=b"\x02")
+    st.balances[11] = 40 * 10**9
+    st.pending_partial_withdrawals.append(
+        types.PendingPartialWithdrawal.make(
+            validator_index=11, amount=3 * 10**9, withdrawable_epoch=0
+        )
+    )
+    ws, processed = get_expected_withdrawals(st, spec, types)
+    assert processed == 1
+    assert any(w.validator_index == 11 and w.amount == 3 * 10**9 for w in ws)
+
+
+# ---------------------------------------------------------------- end to end
+
+
+def test_electra_chain_finalizes(harness):
+    spec = harness.spec
+    h2 = StateHarness(
+        spec=spec, keypairs=harness.keypairs, state=clone_state(harness.state, spec)
+    )
+    h2.extend_chain(spec.preset.SLOTS_PER_EPOCH * 5)
+    st = h2.state
+    assert st.current_justified_checkpoint.epoch >= 3
+    assert st.finalized_checkpoint.epoch >= 2
+
+
+def test_deneb_to_electra_transition_chain(harness):
+    """Chain starts at deneb, crosses the electra fork boundary mid-chain,
+    keeps finalizing."""
+    spec = minimal_spec(electra_fork_epoch=2)
+    h2 = StateHarness(spec=spec, keypairs=harness.keypairs)
+    assert spec.fork_name_at_epoch(0) == ForkName.deneb
+    h2.extend_chain(spec.preset.SLOTS_PER_EPOCH * 6)
+    st = h2.state
+    assert bytes(st.fork.current_version) == spec.electra_fork_version
+    assert hasattr(st, "pending_deposits")
+    assert st.finalized_checkpoint.epoch >= 2
