@@ -28,6 +28,7 @@ from .api import (
     fast_aggregate_verify,
     eth_fast_aggregate_verify,
     verify_signature_sets,
+    verify_signature_sets_async,
 )
 
 __all__ = [
@@ -49,4 +50,5 @@ __all__ = [
     "fast_aggregate_verify",
     "eth_fast_aggregate_verify",
     "verify_signature_sets",
+    "verify_signature_sets_async",
 ]

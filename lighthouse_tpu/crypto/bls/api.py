@@ -192,3 +192,40 @@ def verify_signature_sets(
     if any(z % _R == 0 for z in rands):
         raise ValueError("batch verification coefficients must be nonzero")
     return get_backend().verify_signature_sets(sets, rands)
+
+
+class _ReadyHandle:
+    """Immediate-resolution handle for backends without async submission."""
+
+    __slots__ = ("_value",)
+
+    def __init__(self, value: bool):
+        self._value = value
+
+    def result(self) -> bool:
+        return self._value
+
+
+def verify_signature_sets_async(
+    sets: Sequence[SignatureSet],
+    rand_fn: Callable[[int], Sequence[int]] | None = None,
+):
+    """Submit a batch for verification; returns a handle whose .result()
+    blocks. On the TPU backend this keeps the device busy while the host
+    marshals the next batch (the double-buffered dispatch of SURVEY §7
+    step 2); other backends resolve immediately."""
+    sets = list(sets)
+    if not sets or any(s.signature.is_infinity() for s in sets):
+        return _ReadyHandle(False)
+    rands = (rand_fn or _default_rands)(len(sets))
+    if len(rands) != len(sets):
+        raise ValueError("rand_fn returned wrong number of coefficients")
+    from ..bls381.constants import R as _R
+
+    if any(z % _R == 0 for z in rands):
+        raise ValueError("batch verification coefficients must be nonzero")
+    backend = get_backend()
+    submit = getattr(backend, "verify_signature_sets_async", None)
+    if submit is None:
+        return _ReadyHandle(backend.verify_signature_sets(sets, rands))
+    return submit(sets, rands)

@@ -169,21 +169,18 @@ def _verify_kernel(pk_x, pk_y, pk_mask, sig_x, sig_y, us, z_digits, set_mask):
         z_pk, h_jac, sig_acc
     )
 
-    # pairs: n set-pairs + 1 signature pair, padded to pow2
-    npairs = _next_pow2(n + 1)
+    # pairs: n set-pairs + 1 signature pair (exact count — the shared-f
+    # Miller loop takes any pair count, no pow2 padding needed)
     neg_g1x = jnp.broadcast_to(_NEG_G1_GEN[0], (1,) + _NEG_G1_GEN[0].shape)
     neg_g1y = jnp.broadcast_to(_NEG_G1_GEN[1], (1,) + _NEG_G1_GEN[1].shape)
-    pad = npairs - n - 1
-    px = jnp.concatenate([p1x, neg_g1x, jnp.zeros((pad,) + p1x.shape[1:], p1x.dtype)])
-    py = jnp.concatenate([p1y, neg_g1y, jnp.zeros((pad,) + p1y.shape[1:], p1y.dtype)])
-    qxx = jnp.concatenate([qx, sx[None], jnp.zeros((pad,) + qx.shape[1:], qx.dtype)])
-    qyy = jnp.concatenate([qy, sy[None], jnp.zeros((pad,) + qy.shape[1:], qy.dtype)])
-    pair_mask = jnp.concatenate(
-        [jnp.asarray(set_mask, bool), jnp.asarray([True]), jnp.zeros((pad,), bool)]
-    )
+    px = jnp.concatenate([p1x, neg_g1x])
+    py = jnp.concatenate([p1y, neg_g1y])
+    qxx = jnp.concatenate([qx, sx[None]])
+    qyy = jnp.concatenate([qy, sy[None]])
+    pair_mask = jnp.concatenate([jnp.asarray(set_mask, bool), jnp.asarray([True])])
     # a set-pair with an identity side contributes 1 (mask it out); the
     # signature accumulator can legitimately be identity (all-zero z*sig)
-    side_inf = jnp.concatenate([jnp.logical_or(p1inf, qinf), sinf[None], jnp.zeros((pad,), bool)])
+    side_inf = jnp.concatenate([jnp.logical_or(p1inf, qinf), sinf[None]])
     pair_mask = jnp.logical_and(pair_mask, jnp.logical_not(side_inf))
 
     ok = po.pairing_product_is_one((px, py), (qxx, qyy), pair_mask)
@@ -373,17 +370,15 @@ def _aggregate_kernel(pk_x, pk_y, mask, sig_xy, us):
     h_jac = h2.hash_to_g2_jacobian(us)
     qx, qy, qinf = co.jac_to_affine(h_jac, co.FQ2_OPS)
 
-    npairs = _next_pow2(n + 1)
-    pad = npairs - n - 1
     neg_g1x = _NEG_G1_GEN[0][None]
     neg_g1y = _NEG_G1_GEN[1][None]
-    px = jnp.concatenate([pk_x, neg_g1x, jnp.zeros((pad,) + pk_x.shape[1:], pk_x.dtype)])
-    py = jnp.concatenate([pk_y, neg_g1y, jnp.zeros((pad,) + pk_y.shape[1:], pk_y.dtype)])
-    qxx = jnp.concatenate([qx, sig_xy[None, 0], jnp.zeros((pad,) + qx.shape[1:], qx.dtype)])
-    qyy = jnp.concatenate([qy, sig_xy[None, 1], jnp.zeros((pad,) + qy.shape[1:], qy.dtype)])
+    px = jnp.concatenate([pk_x, neg_g1x])
+    py = jnp.concatenate([pk_y, neg_g1y])
+    qxx = jnp.concatenate([qx, sig_xy[None, 0]])
+    qyy = jnp.concatenate([qy, sig_xy[None, 1]])
     pair_mask = jnp.concatenate(
         [jnp.logical_and(jnp.asarray(mask, bool), jnp.logical_not(qinf)),
-         jnp.asarray([True]), jnp.zeros((pad,), bool)]
+         jnp.asarray([True])]
     )
     return po.pairing_product_is_one((px, py), (qxx, qyy), pair_mask)
 

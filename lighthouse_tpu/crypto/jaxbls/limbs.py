@@ -131,18 +131,31 @@ def _shiftd(x, d: int, fill=0):
 
 
 def _prefix_carry(g, p):
-    """Kogge-Stone parallel prefix over generate/propagate bit arrays.
+    """Carry-lookahead over generate/propagate bit arrays, closed form.
 
     g[k] = limb k generates a carry (borrow) on its own; p[k] = limb k
     propagates an incoming one. Returns G[k] = carry out of window [0..k]
-    with zero carry-in, in log2(NL) elementwise steps."""
-    nl = g.shape[-1]
-    d = 1
-    while d < nl:
-        g = jnp.logical_or(g, jnp.logical_and(p, _shiftd(g, d, False)))
-        p = jnp.logical_and(p, _shiftd(p, d, False))
-        d *= 2
-    return g
+    with zero carry-in.
+
+    G[k] = OR_{j<=k} (g[j] AND p[j+1..k] all set). Expressed arithmetically
+    in f32 (exact: all quantities are sums of powers of two below 2^31):
+      S[k]   = cumsum over log-p, log-p = 0 if p else -2^20
+      best[k]= cummax of (0 if g else -2^30) - S
+      G[k]   = S[k] + best[k] == 0
+    TWO scan primitives + elementwise — replaces the Kogge-Stone form whose
+    log2(NL) shift rounds emitted ~10x the HLO (slices/concats dominated
+    kernel compile time on both CPU and TPU)."""
+    import jax
+
+    PBIG = jnp.float32(1 << 20)
+    GBIG = jnp.float32(1 << 30)
+    logp = jnp.where(p, jnp.float32(0), -PBIG)
+    logg = jnp.where(g, jnp.float32(0), -GBIG)
+    axis = logp.ndim - 1
+    S = jnp.cumsum(logp, axis=axis)               # S[k] = sum_{i<=k} logp[i]
+    best = jax.lax.cummax(logg - S, axis=axis)    # max_{j<=k} logg[j] - S[j]
+    # term(j,k) = logg[j] + (S[k] - S[j]) == 0 iff g[j] and p[(j,k]] all set
+    return (S + best) == 0
 
 
 def carry_normalize_fast(t):

@@ -99,9 +99,116 @@ def _line_to_fq12(line):
 
 
 def _mul_by_line(f, line):
-    """f * line. v1 uses the generic fq12 mul; a dedicated sparse mul_by_014
-    is a later optimization."""
-    return tw.fq12_mul(f, _line_to_fq12(line))
+    """f * line via the sparse mul_by_014 (13 Fq2 products vs 18 dense)."""
+    l0, l1, l2 = line
+    return tw.fq12_mul_by_014(f, l0, l1, l2)
+
+
+def _line_mul_line(la, lb_):
+    """Product of two sparse 014 lines -> dense Fq12 (c1[0] stays zero).
+
+    6 Fq2 products (one batched fq2_mul) via Karatsuba cross terms."""
+    l0, l1, l2 = la
+    m0, m1, m2 = lb_
+    A = jnp.stack(
+        [l0, l1, l2, tw.fq2_add(l0, l1), tw.fq2_add(l0, l2), tw.fq2_add(l1, l2)],
+        axis=-3,
+    )
+    B = jnp.stack(
+        [m0, m1, m2, tw.fq2_add(m0, m1), tw.fq2_add(m0, m2), tw.fq2_add(m1, m2)],
+        axis=-3,
+    )
+    t = tw.fq2_mul(A, B)
+    p00, p11, p22 = t[..., 0, :, :], t[..., 1, :, :], t[..., 2, :, :]
+    s01, s02, s12 = t[..., 3, :, :], t[..., 4, :, :], t[..., 5, :, :]
+    c00 = tw.fq2_add(p00, tw.fq2_mul_by_xi(p22))
+    c01 = tw.fq2_sub(tw.fq2_sub(s01, p00), p11)
+    c02 = p11
+    c10 = jnp.zeros_like(p00)
+    c11 = tw.fq2_sub(tw.fq2_sub(s02, p00), p22)
+    c12 = tw.fq2_sub(tw.fq2_sub(s12, p11), p22)
+    lo = jnp.stack([c00, c01, c02], axis=-3)
+    hi = jnp.stack([c10, c11, c12], axis=-3)
+    return jnp.stack([lo, hi], axis=-4)
+
+
+def fq12_product_any(fs):
+    """Tree product over the first axis, any length >= 1 (odd leftovers are
+    carried to the next level)."""
+    n = fs.shape[0]
+    while n > 1:
+        half = n // 2
+        prod = tw.fq12_mul(fs[:half], fs[half : 2 * half])
+        if n % 2:
+            fs = jnp.concatenate([prod, fs[2 * half : n]], axis=0)
+        else:
+            fs = prod
+        n = (n + 1) // 2
+    return fs[0]
+
+
+def _mask_lines(line, valid_mask):
+    """Replace invalid lanes with the identity line (1, 0, 0)."""
+    l0, l1, l2 = line
+    m = jnp.asarray(valid_mask, bool)
+    one = jnp.broadcast_to(tw.FQ2_ONE, l0.shape)
+    zero = jnp.zeros_like(l0)
+    return (
+        tw.fq2_select(m, l0, one),
+        tw.fq2_select(m, l1, zero),
+        tw.fq2_select(m, l2, zero),
+    )
+
+
+def _combine_lines(line, valid_mask):
+    """All n masked lines -> ONE dense Fq12: pair the lines sparsely
+    (6 Fq2 muls per pair) then tree-reduce the halved batch."""
+    l0, l1, l2 = _mask_lines(line, valid_mask)
+    n = l0.shape[0]
+    if n == 1:
+        return _line_to_fq12((l0, l1, l2))[0]
+    if n % 2:
+        one = jnp.broadcast_to(tw.FQ2_ONE, (1,) + l0.shape[1:])
+        zero = jnp.zeros((1,) + l0.shape[1:], l0.dtype)
+        l0 = jnp.concatenate([l0, one])
+        l1 = jnp.concatenate([l1, zero])
+        l2 = jnp.concatenate([l2, zero])
+        n += 1
+    half = n // 2
+    fs = _line_mul_line(
+        (l0[:half], l1[:half], l2[:half]), (l0[half:], l1[half:], l2[half:])
+    )
+    return fq12_product_any(fs)
+
+
+def miller_loop_product(p_aff, q_aff, valid_mask):
+    """Multi-pairing Miller loop with ONE shared accumulator f.
+
+    Per bit: a single fq12_sqr (instead of one per pair), each pair's line
+    folded in through a sparse line-pair product tree. Returns the Miller
+    value prod_i f_i as one Fq12 (conjugated for x < 0)."""
+    xp, yp = p_aff
+    xq, yq = q_aff
+    r = co.affine_to_jac(co.FQ2_OPS, (xq, yq))
+    f = tw.FQ12_ONE
+    bits_arr = jnp.asarray(np.array([int(b) for b in _X_BITS], np.uint32))
+
+    def step(carry, bit):
+        f, r = carry
+        f = tw.fq12_sqr(f)
+        r, line = _dbl_step(r, xp, yp)
+        f = tw.fq12_mul(f, _combine_lines(line, valid_mask))
+
+        def with_add(op):
+            f_, r_ = op
+            r2, line2 = _add_step(r_, (xq, yq), xp, yp)
+            return (tw.fq12_mul(f_, _combine_lines(line2, valid_mask)), r2)
+
+        f, r = lax.cond(bit == 1, with_add, lambda op: op, (f, r))
+        return (f, r), None
+
+    (f, r), _ = lax.scan(step, (f, r), bits_arr)
+    return tw.fq12_conj(f)          # x < 0: conjugate the Miller value
 
 
 def miller_loop_batch(p_aff, q_aff, valid_mask):
@@ -191,10 +298,8 @@ def final_exponentiation(m):
 
 
 def pairing_product_is_one(p_aff, q_aff, valid_mask):
-    """prod_{i valid} e(P_i, Q_i) == 1 (batched pairs, one final exp).
-
-    Pair count (first axis) must be a power of two (pad + mask)."""
-    fs = miller_loop_batch(p_aff, q_aff, valid_mask)
-    f = fq12_product(fs)
+    """prod_{i valid} e(P_i, Q_i) == 1: shared-accumulator Miller loop
+    (any pair count) + one final exponentiation."""
+    f = miller_loop_product(p_aff, q_aff, valid_mask)
     f = final_exponentiation(f)
     return tw.fq12_eq_one(f)

@@ -110,3 +110,25 @@ def test_batched_fq2_mul():
     got1 = tw.fq_batch_from_device(out[..., 1, :])
     for i, (a, b) in enumerate(zip(a_list, b_list)):
         assert (got0[i], got1[i]) == pyf.fq2_mul(a, b)
+
+
+def test_fq12_mul_by_014_matches_dense():
+    """Sparse line multiplication == dense fq12_mul with the embedded line."""
+    import jax.numpy as jnp
+
+    a = rfq12()
+    l0, l1, l2 = rfq2(), rfq2(), rfq2()
+    da = tw.fq12_to_device(a)
+    dl0, dl1, dl2 = (tw.fq2_to_device(x) for x in (l0, l1, l2))
+
+    line12 = ((l0, l1, (0, 0)), (((0, 0)), l2, (0, 0)))
+    expect = pyf.fq12_mul(a, line12)
+    got = tw.fq12_from_device(tw.fq12_mul_by_014(da, dl0, dl1, dl2))
+    assert got == expect
+
+    # batched: leading axis broadcasts
+    ba = jnp.stack([da, da])
+    bl = [jnp.stack([x, x]) for x in (dl0, dl1, dl2)]
+    bres = tw.fq12_mul_by_014(ba, *bl)
+    assert tw.fq12_from_device(bres[0]) == expect
+    assert tw.fq12_from_device(bres[1]) == expect

@@ -89,7 +89,7 @@ def _marshal(backend, sets, rands):
 
 @pytest.fixture(scope="module")
 def jax_backend():
-    return bls_api.get_backend("jax")
+    return bls_api.set_backend("jax")
 
 
 def _run_sharded(mesh, args):
@@ -110,7 +110,7 @@ def test_sharded_valid_batch_verifies(mesh, jax_backend):
     args = _marshal(jax_backend, sets, rands)
     assert _run_sharded(mesh, args) is True
     # python ground truth agrees
-    py = bls_api.get_backend("python")
+    py = bls_api._BACKENDS["python"]
     assert py.verify_signature_sets(sets, rands) is True
 
 
@@ -118,7 +118,7 @@ def test_sharded_invalid_batch_rejects(mesh, jax_backend):
     sets, rands = _build_sets(8, 2, seed=0x52, tamper=5)
     args = _marshal(jax_backend, sets, rands)
     assert _run_sharded(mesh, args) is False
-    py = bls_api.get_backend("python")
+    py = bls_api._BACKENDS["python"]
     assert py.verify_signature_sets(sets, rands) is False
 
 
