@@ -80,7 +80,13 @@ class BeaconChain:
         store: HotColdDB | None = None,
         slot_clock: SlotClock | None = None,
         config: ChainConfig | None = None,
+        kzg_setup=None,
+        anchor_block=None,
     ):
+        """genesis_state doubles as the ANCHOR state: pass a finalized
+        checkpoint state (+ its anchor_block) to start from a weak-
+        subjectivity checkpoint instead of genesis
+        (client/src/builder.rs:366-528 weak_subjectivity_state analog)."""
         from ..utils.slot_clock import ManualSlotClock
 
         self.spec = spec
@@ -89,28 +95,41 @@ class BeaconChain:
         self.slot_clock = slot_clock or ManualSlotClock(
             genesis_state.genesis_time, spec.seconds_per_slot
         )
+        self.genesis_validators_root = bytes(genesis_state.genesis_validators_root)
 
         types = types_for_slot(spec, genesis_state.slot)
         state_root = types.BeaconState.hash_tree_root(genesis_state)
-        # The anchor block root must match what descendants reference:
-        # hash of the state's latest_block_header with its state_root filled
-        # (the header's body_root may predate fork upgrades, so we must not
-        # rebuild the body ourselves).
-        header = genesis_state.latest_block_header
-        if bytes(header.state_root) == b"\x00" * 32:
-            header = header.copy_with(state_root=state_root)
-        self.genesis_block_root = types.BeaconBlockHeader.hash_tree_root(header)
-        genesis_block = types.BeaconBlock.make(
-            slot=genesis_state.slot,
-            proposer_index=header.proposer_index,
-            parent_root=header.parent_root,
-            state_root=header.state_root,
-            body=types.BeaconBlockBody.default(),
-        )
-        signed_genesis = types.SignedBeaconBlock.make(
-            message=genesis_block, signature=b"\x00" * 96
-        )
-        self.store.put_block(self.genesis_block_root, signed_genesis, types)
+        if anchor_block is not None:
+            # checkpoint start: the supplied block must commit to the state
+            if bytes(anchor_block.message.state_root) != state_root:
+                raise BlockError("anchor block/state mismatch")
+            self.genesis_block_root = types.BeaconBlock.hash_tree_root(
+                anchor_block.message
+            )
+            self.store.put_block(self.genesis_block_root, anchor_block, types)
+        else:
+            # The anchor block root must match what descendants reference:
+            # hash of the state's latest_block_header with its state_root
+            # filled (the header's body_root may predate fork upgrades, so
+            # we must not rebuild the body ourselves).
+            header = genesis_state.latest_block_header
+            if bytes(header.state_root) == b"\x00" * 32:
+                header = header.copy_with(state_root=state_root)
+            self.genesis_block_root = types.BeaconBlockHeader.hash_tree_root(header)
+            genesis_block = types.BeaconBlock.make(
+                slot=genesis_state.slot,
+                proposer_index=header.proposer_index,
+                parent_root=header.parent_root,
+                state_root=header.state_root,
+                body=types.BeaconBlockBody.default(),
+            )
+            signed_genesis = types.SignedBeaconBlock.make(
+                message=genesis_block, signature=b"\x00" * 96
+            )
+            self.store.put_block(self.genesis_block_root, signed_genesis, types)
+        self.anchor_slot = int(genesis_state.slot)
+        self.oldest_block_slot = self.anchor_slot  # backfill progress marker
+        self._oldest_block_root = self.genesis_block_root
         self.store.put_state(state_root, genesis_state, types)
 
         self.fork_choice = ForkChoice(
@@ -134,6 +153,140 @@ class BeaconChain:
         self.observed_attesters: set[tuple[int, int]] = set()          # (epoch, validator)
         self.observed_aggregators: set[tuple[int, int]] = set()
         self.observed_blocks: set[bytes] = set()
+        self.observed_blob_sidecars: set[tuple[bytes, int]] = set()    # (root, index)
+
+        from .data_availability import DataAvailabilityChecker
+        from .naive_aggregation import NaiveAttestationPool, NaiveSyncContributionPool
+
+        self.data_availability = DataAvailabilityChecker(spec, kzg_setup)
+        self.naive_attestation_pool = NaiveAttestationPool(spec)
+        self.naive_sync_pool = NaiveSyncContributionPool(spec)
+        # validator_index -> fee recipient, fed by prepare_beacon_proposer
+        self.proposer_preparations: dict[int, bytes] = {}
+
+    # ------------------------------------------------- checkpoint / resume
+
+    @classmethod
+    def from_checkpoint(cls, spec, anchor_state, anchor_block, **kw):
+        """Start from a trusted finalized state/block pair (checkpoint sync;
+        required-by-default startup mode in the reference since v4.6.0)."""
+        return cls(spec, anchor_state, anchor_block=anchor_block, **kw)
+
+    def import_historical_blocks(self, blocks) -> int:
+        """Backfill: import a contiguous ascending run of blocks ENDING at
+        the current oldest block's parent, with hash-linkage checks and ONE
+        batched proposer-signature verification for the whole run
+        (historical_blocks.rs:189 ParallelSignatureSets analog — a flagship
+        TPU batch workload). Returns blocks accepted."""
+        if not blocks:
+            return 0
+        spec = self.spec
+        oldest = self.store.get_block(
+            self._oldest_block_root, types_for_slot(spec, self.oldest_block_slot)
+        )
+        expected_root = bytes(oldest.message.parent_root)
+        get_pubkey = self.pubkey_cache.pubkey_getter()
+        batch = SignatureBatch()
+        roots = []
+        for sb in reversed(blocks):          # newest -> oldest linkage walk
+            types = types_for_slot(spec, sb.message.slot)
+            root = types.BeaconBlock.hash_tree_root(sb.message)
+            if root != expected_root:
+                raise BlockError("backfill chain discontinuity")
+            roots.append((root, sb, types))
+            expected_root = bytes(sb.message.parent_root)
+            if sb.message.slot > 0:
+                batch.add(
+                    sigs.historical_block_proposal_set(
+                        spec, types, sb, self.genesis_validators_root, get_pubkey
+                    )
+                )
+        if not batch.verify():
+            raise BlockError("backfill signature batch invalid")
+        for root, sb, types in roots:
+            self.store.put_block(root, sb, types)
+            self.block_slots[root] = int(sb.message.slot)
+        # roots[-1] is blocks[0] (the oldest) — the linkage walk went newest
+        # to oldest, so its root is already computed
+        self.oldest_block_slot = int(blocks[0].message.slot)
+        self._oldest_block_root = roots[-1][0]
+        return len(blocks)
+
+    PERSIST_HEAD_KEY = b"persisted-head"
+
+    def persist(self) -> None:
+        """Persist the minimal resume set: head root + anchor info + op-pool-
+        independent indices. States/blocks are already durably in the store;
+        resume() rebuilds fork choice by replaying stored blocks from the
+        finalized anchor (builder.rs resume path)."""
+        import pickle
+
+        fin_epoch, fin_root = self.fork_choice.store.finalized_checkpoint
+        payload = {
+            "head_root": self.head_root,
+            "finalized_root": fin_root,
+            "finalized_epoch": fin_epoch,
+            "anchor_root": self.genesis_block_root,
+            "oldest_block_slot": self.oldest_block_slot,
+            "oldest_block_root": self._oldest_block_root,
+            "block_slots": self.block_slots,
+            "state_root_by_block": self.state_root_by_block,
+        }
+        self.store.put_chain_item(self.PERSIST_HEAD_KEY, pickle.dumps(payload))
+
+    @classmethod
+    def resume(cls, spec, store, **kw):
+        """Rebuild a chain from a persisted store: load the finalized anchor
+        state, replay stored descendant blocks into fork choice, restore the
+        head (beacon_chain/src/builder.rs resume analog)."""
+        import pickle
+
+        raw = store.get_chain_item(cls.PERSIST_HEAD_KEY)
+        if raw is None:
+            raise BlockError("no persisted chain in store")
+        meta = pickle.loads(raw)
+        # anchor: highest stored block at/below finalization whose state we
+        # still have — walk back from head via parents
+        block_slots = meta["block_slots"]
+        state_by_block = meta["state_root_by_block"]
+        head_root = meta["head_root"]
+
+        # find the finalized anchor block+state
+        fin_root = meta["finalized_root"]
+        if fin_root == b"\x00" * 32 or fin_root not in block_slots:
+            fin_root = meta["anchor_root"]
+        fin_slot = block_slots[fin_root]
+        types = types_for_slot(spec, fin_slot)
+        anchor_block = store.get_block(fin_root, types)
+        anchor_state = store.get_state(state_by_block[fin_root], types)
+        if anchor_state is None or anchor_block is None:
+            raise BlockError("persisted anchor incomplete")
+
+        chain = cls(spec, anchor_state, store=store, anchor_block=anchor_block, **kw)
+        chain.oldest_block_slot = meta["oldest_block_slot"]
+        chain._oldest_block_root = meta["oldest_block_root"]
+        chain.block_slots.update(block_slots)
+
+        # replay the post-anchor chain into fork choice (ascending slots)
+        replay = [
+            (slot, root)
+            for root, slot in block_slots.items()
+            if slot > fin_slot and root in state_by_block
+        ]
+        for slot, root in sorted(replay):
+            t = types_for_slot(spec, slot)
+            sb = store.get_block(root, t)
+            st = store.get_state(state_by_block[root], t)
+            if sb is None or st is None:
+                continue
+            chain.slot_clock.set_slot(max(chain.current_slot, slot))
+            chain.fork_choice.on_tick(chain.current_slot)
+            chain.fork_choice.on_block(sb, root, st)
+            chain.state_cache[state_by_block[root]] = st
+            chain.state_root_by_block[root] = state_by_block[root]
+            chain.pubkey_cache.import_new_pubkeys(st)
+        chain.recompute_head()
+        return chain
 
     # ---------------------------------------------------------------- time
 
@@ -144,6 +297,8 @@ class BeaconChain:
 
     def per_slot_task(self) -> None:
         self.fork_choice.on_tick(self.current_slot)
+        self.naive_attestation_pool.prune(self.current_slot)
+        self.naive_sync_pool.prune(self.current_slot)
 
     # ---------------------------------------------------------------- head
 
@@ -222,8 +377,19 @@ class BeaconChain:
         signed_block,
         block_root=None,
         proposal_already_verified: bool = False,
+        blobs=None,
+        blobs_verified: bool = False,
     ) -> bytes:
-        """Full verification + import (process_block/import_block analog)."""
+        """Full verification + import (process_block/import_block analog).
+
+        Deneb+ blocks carrying commitments are gated on data availability:
+        sidecars either arrive via `blobs` (RPC/publish paths) or must have
+        been collected by the DA checker from gossip; otherwise the block is
+        held and AvailabilityPendingError raised
+        (data_availability_checker.rs:40)."""
+        from .data_availability import AvailabilityPendingError
+        from ..types.spec import ForkName
+
         spec = self.spec
         block = signed_block.message
         types = types_for_slot(spec, block.slot)
@@ -232,6 +398,32 @@ class BeaconChain:
         parent_root = bytes(block.parent_root)
         if not self.store.block_exists(parent_root):
             raise BlockError("parent unknown")
+
+        fork = spec.fork_name_at_slot(block.slot)
+        commitments = (
+            list(block.body.blob_kzg_commitments) if fork >= ForkName.deneb else []
+        )
+        sidecars = []
+        if commitments:
+            if blobs is not None:
+                sidecars = list(blobs)
+                if len(sidecars) != len(commitments) or any(
+                    bytes(sc.kzg_commitment) != bytes(c)
+                    for sc, c in zip(sidecars, commitments)
+                ):
+                    raise BlockError("sidecars do not match block commitments")
+            else:
+                got = self.data_availability.put_block(block_root, signed_block, types)
+                if got is None:
+                    raise AvailabilityPendingError(
+                        block_root, self.data_availability.missing_indices(block_root)
+                    )
+                _, sidecars = got
+                blobs_verified = True  # gossip-verified on arrival
+            if not blobs_verified and not self.data_availability.verify_kzg_proofs(
+                sidecars
+            ):
+                raise BlockError("blob KZG batch invalid")
 
         state = self._state_for_block(parent_root, block.slot)
         get_pubkey = self.pubkey_cache.pubkey_getter()
@@ -279,6 +471,15 @@ class BeaconChain:
 
         # import: store + caches + fork choice
         self.store.put_block(block_root, signed_block, types)
+        if sidecars:
+            import struct
+
+            parts = [types.BlobSidecar.serialize(sc) for sc in sidecars]
+            self.store.put_blobs(
+                block_root,
+                struct.pack("<I", len(parts))
+                + b"".join(struct.pack("<I", len(p)) + p for p in parts),
+            )
         self.store.put_state(state_root, state, types)
         self.state_cache[state_root] = state
         self.block_slots[block_root] = block.slot
@@ -286,14 +487,52 @@ class BeaconChain:
         self.pubkey_cache.import_new_pubkeys(state)
 
         timely = self.current_slot == block.slot
+        self.fork_choice.on_tick(self.current_slot)
         self.fork_choice.on_block(signed_block, block_root, state, is_timely=timely)
         self.recompute_head()
         self._prune_state_cache()
         return block_root
 
-    def process_chain_segment(self, blocks) -> list[bytes]:
+    def process_gossip_blob(self, sidecar):
+        """Gossip blob-sidecar entry: verify, feed the DA checker, and import
+        the joined block if it just became available. Returns the imported
+        block root or None (network_beacon_processor process_gossip_blob
+        analog)."""
+        from .data_availability import verify_blob_sidecar_for_gossip
+
+        block_root = verify_blob_sidecar_for_gossip(self, sidecar)
+        got = self.data_availability.put_blob(block_root, sidecar)
+        if got is not None:
+            block, sidecars = got
+            return self.process_block(block, blobs=sidecars, blobs_verified=True)
+        return None
+
+    def get_blobs(self, block_root: bytes):
+        """Stored sidecars for an imported block (by-root RPC / API serve)."""
+        raw = self.store.get_blobs(block_root)
+        if raw is None:
+            return []
+        import struct
+
+        slot = self.block_slots.get(block_root)
+        types = types_for_slot(self.spec, slot if slot is not None else 0)
+        n = struct.unpack_from("<I", raw, 0)[0]
+        off = 4
+        out = []
+        for _ in range(n):
+            ln = struct.unpack_from("<I", raw, off)[0]
+            off += 4
+            out.append(types.BlobSidecar.deserialize(raw[off : off + ln]))
+            off += ln
+        return out
+
+    def process_chain_segment(self, blocks, blobs_by_root=None) -> list[bytes]:
         """Import a batch of contiguous blocks with ONE signature batch for
-        the whole segment (signature_verify_chain_segment analog)."""
+        the whole segment (signature_verify_chain_segment analog).
+
+        blobs_by_root: {block_root: [sidecar]} fetched over RPC alongside
+        the range (block_sidecar_coupling) — verified as a KZG batch inside
+        process_block."""
         if not blocks:
             return []
         spec = self.spec
@@ -313,7 +552,16 @@ class BeaconChain:
         # 2. sequential import without re-verifying proposal signatures
         roots = []
         for sb in blocks:
-            roots.append(self.process_block(sb, proposal_already_verified=True))
+            blobs = None
+            if blobs_by_root is not None:
+                types = types_for_slot(spec, sb.message.slot)
+                root = types.BeaconBlock.hash_tree_root(sb.message)
+                blobs = blobs_by_root.get(root)
+            roots.append(
+                self.process_block(
+                    sb, proposal_already_verified=True, blobs=blobs
+                )
+            )
         return roots
 
     def _prune_state_cache(self, keep: int = 8):
@@ -346,17 +594,13 @@ class BeaconChain:
             return self.state_cache[state_root]
         return self.head_state()
 
-    def verify_unaggregated_attestations(self, attestations) -> list:
-        """Batch gossip verification (batch_verify_unaggregated_attestations,
-        attestation_verification/batch.rs:140). Returns list of
-        (attestation, attesting_indices) that verified; raises only on
-        per-batch failures of structure, not on individual invalid sigs —
-        on batch failure falls back to per-set verification, exactly like
-        the reference (:213-221)."""
+    def prepare_unaggregated_attestations(self, attestations) -> list:
+        """Host-side phase of batch gossip verification: committee lookup,
+        dedup, signature-set construction. Returns [(att, attesting, set)]
+        ready for one device submission."""
         spec = self.spec
         get_pubkey = self.pubkey_cache.pubkey_getter()
         prepared = []
-        sets = []
         for att in attestations:
             data = att.data
             epoch = data.target.epoch
@@ -364,7 +608,10 @@ class BeaconChain:
                 h.compute_epoch_at_slot(data.slot, spec),
             ):
                 continue
-            committee = self._committee_for(data)
+            try:
+                committee = self._committee_for(data)
+            except AttestationError:
+                continue
             if len(att.aggregation_bits) != len(committee):
                 continue
             attesting = [i for i, b in zip(committee, att.aggregation_bits) if b]
@@ -382,18 +629,56 @@ class BeaconChain:
             except sigs.SignatureSetError:
                 continue
             prepared.append((att, attesting, s))
-            sets.append(s)
+        return prepared
 
-        if not sets:
-            return []
-        ok = bls.verify_signature_sets(sets)
+    def complete_attestation_batch(self, prepared, ok: bool) -> list:
+        """Device-result phase: on batch failure fall back to per-set
+        verification (attestation_verification/batch.rs:213-221), record
+        observed attesters, return verified (att, attesting_indices)."""
         results = []
         for att, attesting, s in prepared:
             valid = ok or bls.verify_signature_sets([s])
             if valid:
                 self.observed_attesters.add((att.data.target.epoch, attesting[0]))
+                self.naive_attestation_pool.insert(
+                    att, types_for_slot(self.spec, att.data.slot)
+                )
                 results.append((att, attesting))
         return results
+
+    def verify_unaggregated_attestations(self, attestations) -> list:
+        """Batch gossip verification (batch_verify_unaggregated_attestations,
+        attestation_verification/batch.rs:140): prepare + ONE device batch +
+        complete. The split phases let the beacon processor overlap host
+        marshalling with in-flight device batches
+        (submit_attestation_batch)."""
+        prepared = self.prepare_unaggregated_attestations(attestations)
+        if not prepared:
+            return []
+        ok = bls.verify_signature_sets([s for _, _, s in prepared])
+        return self.complete_attestation_batch(prepared, ok)
+
+    def submit_attestation_batch(self, attestations, on_done=None):
+        """Pipelined form: prepare on host, submit async to the device, and
+        return (handle, continuation). The continuation — run when the
+        processor resolves the handle — completes verification and applies
+        fork-choice votes. Returns None if nothing verifiable."""
+        prepared = self.prepare_unaggregated_attestations(attestations)
+        if not prepared:
+            if on_done is not None:
+                on_done([])
+            return None
+        handle = bls.verify_signature_sets_async([s for _, _, s in prepared])
+
+        def continuation(ok: bool):
+            results = self.complete_attestation_batch(prepared, ok)
+            for att, indices in results:
+                self.apply_attestation_to_fork_choice(att, indices)
+            if on_done is not None:
+                on_done(results)
+            return results
+
+        return handle, continuation
 
     def verify_aggregated_attestations(self, signed_aggregates) -> list:
         """Batch gossip verification of SignedAggregateAndProof messages:
@@ -467,11 +752,117 @@ class BeaconChain:
         s = sigs.sync_committee_message_set(state, spec, msg, get_pubkey)
         return bls.verify_signature_sets([s])
 
+    def verify_signed_contribution(self, signed) -> bool:
+        """Gossip verification of a SignedContributionAndProof: selection
+        proof + aggregator signature + aggregate sync signature, one batch
+        (sync_committee_verification.rs contribution path)."""
+        spec = self.spec
+        state = self.head_state()
+        msg = signed.message
+        contrib = msg.contribution
+        get_pubkey = self.pubkey_cache.pubkey_getter()
+        types = types_for_slot(spec, contrib.slot)
+        sub_size = spec.preset.SYNC_COMMITTEE_SIZE // spec.sync_committee_subnet_count
+        # participant pubkeys for the contribution signature
+        start = int(contrib.subcommittee_index) * sub_size
+        pks = [
+            bytes(state.current_sync_committee.pubkeys[start + i])
+            for i, b in enumerate(contrib.aggregation_bits)
+            if b
+        ]
+        if not pks:
+            return False
+        try:
+            trio = [
+                sigs.sync_selection_proof_set(
+                    state, spec, types, contrib.slot, contrib.subcommittee_index,
+                    msg.aggregator_index, msg.selection_proof, get_pubkey,
+                ),
+                sigs.contribution_and_proof_set(state, spec, types, signed, get_pubkey),
+            ]
+            # aggregate sync signature over the block root
+            from ..types.spec import DOMAIN_SYNC_COMMITTEE
+
+            epoch = h.compute_epoch_at_slot(contrib.slot, spec)
+            domain = h.get_domain(state, spec, DOMAIN_SYNC_COMMITTEE, epoch)
+            root = h.compute_signing_root_from_root(
+                bytes(contrib.beacon_block_root), domain
+            )
+            by_bytes = sigs.get_pubkey_by_bytes
+            trio.append(
+                bls.SignatureSet(
+                    bls.Signature.deserialize(bytes(contrib.signature)),
+                    [by_bytes(get_pubkey, pk) for pk in pks],
+                    root,
+                )
+            )
+        except sigs.SignatureSetError:
+            return False
+        return bls.verify_signature_sets(trio)
+
+    def sync_subcommittee_positions(self, validator_index: int) -> list[tuple[int, int]]:
+        """(subcommittee_index, index_in_subcommittee) pairs for a validator
+        in the CURRENT sync committee (duplicates possible by spec)."""
+        state = self.head_state()
+        spec = self.spec
+        pk = bytes(state.validators[validator_index].pubkey)
+        sub_size = spec.preset.SYNC_COMMITTEE_SIZE // spec.sync_committee_subnet_count
+        out = []
+        for i, cpk in enumerate(state.current_sync_committee.pubkeys):
+            if bytes(cpk) == pk:
+                out.append((i // sub_size, i % sub_size))
+        return out
+
+    def process_sync_committee_messages(self, msgs) -> int:
+        """Verify a batch of sync-committee messages in ONE device batch and
+        feed the naive contribution pool. Returns messages accepted."""
+        spec = self.spec
+        state = self.head_state()
+        get_pubkey = self.pubkey_cache.pubkey_getter()
+        prepared = []
+        for msg in msgs:
+            try:
+                positions = self.sync_subcommittee_positions(int(msg.validator_index))
+            except (IndexError, AttributeError):
+                continue
+            if not positions:
+                continue
+            try:
+                s = sigs.sync_committee_message_set(state, spec, msg, get_pubkey)
+            except sigs.SignatureSetError:
+                continue
+            prepared.append((msg, positions, s))
+        if not prepared:
+            return 0
+        ok = bls.verify_signature_sets([s for _, _, s in prepared])
+        accepted = 0
+        for msg, positions, s in prepared:
+            if ok or bls.verify_signature_sets([s]):
+                for sub_idx, pos in positions:
+                    self.naive_sync_pool.insert(
+                        int(msg.slot), bytes(msg.beacon_block_root), sub_idx, pos,
+                        bytes(msg.signature),
+                    )
+                accepted += 1
+        return accepted
+
     # ------------------------------------------------------------ production
 
-    def produce_block(self, slot: int, randao_reveal: bytes, op_pool=None, graffiti: bytes = b"\x00" * 32):
+    def produce_block(
+        self,
+        slot: int,
+        randao_reveal: bytes,
+        op_pool=None,
+        graffiti: bytes = b"\x00" * 32,
+        blobs_bundle=None,
+    ):
         """Produce an unsigned block on the head state
-        (produce_block_on_state, beacon_chain.rs:4720 analog)."""
+        (produce_block_on_state, beacon_chain.rs:4720 analog).
+
+        blobs_bundle: optional (blobs, commitments, proofs) from the EL's
+        getPayload (deneb+); commitments go into the body, and the caller
+        builds sidecars from the signed block via
+        data_availability.build_sidecars."""
         from ..state_transition.block import SignatureStrategy
         from ..types.spec import ForkName
 
@@ -512,7 +903,9 @@ class BeaconChain:
         if fork >= ForkName.capella and "bls_to_execution_changes" not in body_kwargs:
             body_kwargs["bls_to_execution_changes"] = []
         if fork >= ForkName.deneb:
-            body_kwargs["blob_kzg_commitments"] = []
+            body_kwargs["blob_kzg_commitments"] = (
+                list(blobs_bundle[1]) if blobs_bundle is not None else []
+            )
 
         block = types.BeaconBlock.make(
             slot=slot,

@@ -1,0 +1,267 @@
+"""Blob sidecar verification + data-availability checking (deneb+).
+
+Parity surface:
+  - gossip blob-sidecar verification — index bounds, slot/finalization
+    windows, parent checks, header proposer signature, KZG commitment
+    inclusion proof, KZG blob proof, (block_root, index) dedup
+    (/root/reference/beacon_node/beacon_chain/src/blob_verification.rs).
+  - availability checking — joining blocks and their blob sidecars before
+    import, holding whichever side arrives first; import is gated on all
+    commitments having a verified matching sidecar
+    (/root/reference/beacon_node/beacon_chain/src/data_availability_checker.rs:40,
+     overflow_lru_cache.rs). Here the pending store is a bounded in-memory
+    LRU (the reference spills to disk beyond capacity; a node that falls
+    that far behind re-requests over RPC anyway).
+
+KZG proofs of all sidecars of a block verify as ONE batch through the shared
+pairing kernel (crypto/kzg.verify_blob_kzg_proof_batch — the same device
+path as BLS, the north-star workload sharing noted in SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass, field
+
+from ..crypto import kzg as ckzg
+from ..ssz.proof import branch_for, build_tree, verify_branch
+from ..types.containers import KZGCommitment
+from ..types import helpers as h
+
+class BlobError(Exception):
+    """Blob sidecar rejected (blob_verification.rs GossipBlobError analog)."""
+
+
+class AvailabilityPendingError(Exception):
+    """Block cannot import yet: blobs missing (held in the DA checker)."""
+
+    def __init__(self, block_root: bytes, missing: list[int]):
+        super().__init__(f"awaiting blobs {missing} for {block_root.hex()[:8]}")
+        self.block_root = block_root
+        self.missing = missing
+
+
+# --------------------------------------------------- inclusion proofs
+
+
+def _commitments_field_index(types) -> int:
+    for i, f in enumerate(types.BeaconBlockBody.fields):
+        if f.name == "blob_kzg_commitments":
+            return i
+    raise ValueError("body has no blob_kzg_commitments")
+
+
+def _list_depth(limit: int) -> int:
+    d = 0
+    while (1 << d) < limit:
+        d += 1
+    return d
+
+
+def commitment_inclusion_proof(types, spec, body, index: int) -> list[bytes]:
+    """Branch proving body.blob_kzg_commitments[index] under the body root
+    (bottom-up: list data tree, length mix-in, body container levels)."""
+    commitments = list(body.blob_kzg_commitments)
+    limit = spec.preset.MAX_BLOB_COMMITMENTS_PER_BLOCK
+    roots = [KZGCommitment.hash_tree_root(c) for c in commitments]
+    layers = build_tree(roots, limit)
+    branch = branch_for(layers, index)
+    branch.append(len(commitments).to_bytes(32, "little"))  # mix-in sibling
+
+    chunks = [
+        f.type.hash_tree_root(getattr(body, f.name)) for f in types.BeaconBlockBody.fields
+    ]
+    body_layers = build_tree(chunks, len(types.BeaconBlockBody.fields))
+    branch += branch_for(body_layers, _commitments_field_index(types))
+    return branch
+
+
+def verify_commitment_inclusion(types, spec, sidecar) -> bool:
+    """Verify sidecar.kzg_commitment_inclusion_proof against the header's
+    body_root (blob_verification.rs verify_kzg_commitment_inclusion_proof)."""
+    leaf = KZGCommitment.hash_tree_root(sidecar.kzg_commitment)
+    list_depth = _list_depth(spec.preset.MAX_BLOB_COMMITMENTS_PER_BLOCK)
+    # position bits bottom-up: leaf index | data-root-left (0) | field index
+    pos = int(sidecar.index) | (_commitments_field_index(types) << (list_depth + 1))
+    body_root = bytes(sidecar.signed_block_header.message.body_root)
+    branch = [bytes(b) for b in sidecar.kzg_commitment_inclusion_proof]
+    if len(branch) != list_depth + 1 + _list_depth(len(types.BeaconBlockBody.fields)):
+        return False
+    return verify_branch(leaf, branch, pos, body_root)
+
+
+def build_sidecars(types, spec, signed_block, blobs, proofs):
+    """Sidecars for a produced block: inclusion proofs over its own body
+    (the production mirror of verification; beacon_chain.rs blob sidecar
+    construction on publish)."""
+    block = signed_block.message
+    header = types.BeaconBlockHeader.make(
+        slot=block.slot,
+        proposer_index=block.proposer_index,
+        parent_root=block.parent_root,
+        state_root=block.state_root,
+        body_root=types.BeaconBlockBody.hash_tree_root(block.body),
+    )
+    signed_header = types.SignedBeaconBlockHeader.make(
+        message=header, signature=signed_block.signature
+    )
+    out = []
+    for i, (blob, proof) in enumerate(zip(blobs, proofs)):
+        out.append(
+            types.BlobSidecar.make(
+                index=i,
+                blob=blob,
+                kzg_commitment=block.body.blob_kzg_commitments[i],
+                kzg_proof=proof,
+                signed_block_header=signed_header,
+                kzg_commitment_inclusion_proof=commitment_inclusion_proof(
+                    types, spec, block.body, i
+                ),
+            )
+        )
+    return out
+
+
+# --------------------------------------------------- availability checker
+
+
+@dataclass
+class _PendingComponents:
+    block: object | None = None          # SignedBeaconBlock
+    types: object | None = None
+    blobs: dict = field(default_factory=dict)   # index -> sidecar (verified)
+
+
+class DataAvailabilityChecker:
+    """Joins blocks and blob sidecars before import (bounded LRU)."""
+
+    def __init__(self, spec, setup: "ckzg.TrustedSetup | None" = None, capacity: int = 64):
+        self.spec = spec
+        self.setup = setup
+        self._pending: OrderedDict[bytes, _PendingComponents] = OrderedDict()
+        self.capacity = capacity
+
+    def _entry(self, block_root: bytes) -> _PendingComponents:
+        e = self._pending.get(block_root)
+        if e is None:
+            e = _PendingComponents()
+            self._pending[block_root] = e
+            while len(self._pending) > self.capacity:
+                self._pending.popitem(last=False)
+        else:
+            self._pending.move_to_end(block_root)
+        return e
+
+    def put_block(self, block_root: bytes, signed_block, types):
+        """Register a block awaiting blobs. Returns (block, sidecars) if now
+        fully available, else None."""
+        e = self._entry(block_root)
+        e.block, e.types = signed_block, types
+        return self._check(block_root)
+
+    def put_blob(self, block_root: bytes, sidecar):
+        """Register a gossip-verified sidecar. Returns (block, sidecars) if
+        its block is now fully available, else None."""
+        e = self._entry(block_root)
+        e.blobs[int(sidecar.index)] = sidecar
+        return self._check(block_root)
+
+    def missing_indices(self, block_root: bytes) -> list[int]:
+        e = self._pending.get(block_root)
+        if e is None or e.block is None:
+            return []
+        n = len(e.block.message.body.blob_kzg_commitments)
+        return [i for i in range(n) if i not in e.blobs]
+
+    def _check(self, block_root: bytes):
+        e = self._pending.get(block_root)
+        if e is None or e.block is None:
+            return None
+        commitments = list(e.block.message.body.blob_kzg_commitments)
+        sidecars = []
+        for i, c in enumerate(commitments):
+            sc = e.blobs.get(i)
+            if sc is None or bytes(sc.kzg_commitment) != bytes(c):
+                return None
+            sidecars.append(sc)
+        self._pending.pop(block_root)
+        return e.block, sidecars
+
+    def verify_kzg_proofs(self, sidecars) -> bool:
+        """One batched pairing check for all sidecars (kzg batch verify)."""
+        if not sidecars:
+            return True
+        if self.setup is None:
+            raise BlobError("no KZG trusted setup loaded")
+        return ckzg.verify_blob_kzg_proof_batch(
+            [bytes(sc.blob) for sc in sidecars],
+            [bytes(sc.kzg_commitment) for sc in sidecars],
+            [bytes(sc.kzg_proof) for sc in sidecars],
+            self.setup,
+        )
+
+
+# --------------------------------------------------- gossip verification
+
+
+def verify_blob_sidecar_for_gossip(chain, sidecar, verify_kzg: bool = True) -> bytes:
+    """Full gossip checks for one sidecar; returns the block root.
+
+    Mirrors blob_verification.rs GossipVerifiedBlob::new order: index bound,
+    slot window, (root, index) dedup, parent known + slot ordering, not
+    pre-finalization, inclusion proof, proposer signature (batched through
+    the BLS backend), KZG proof."""
+    from ..state_transition import signature_sets as sigs
+    from ..state_transition.block import SignatureBatch
+    from ..state_transition.slot import types_for_slot
+
+    spec = chain.spec
+    header = sidecar.signed_block_header.message
+    slot = header.slot
+    fork = spec.fork_name_at_slot(slot)
+    types = types_for_slot(spec, slot)
+    block_root = types.BeaconBlockHeader.hash_tree_root(header)
+
+    if int(sidecar.index) >= spec.max_blobs(fork):
+        raise BlobError(f"blob index {sidecar.index} out of range")
+    if slot > chain.current_slot:
+        raise BlobError("future slot")
+    key = (block_root, int(sidecar.index))
+    if key in chain.observed_blob_sidecars:
+        raise BlobError("sidecar already seen")
+    fin_epoch = chain.fork_choice.store.finalized_checkpoint[0]
+    if slot <= h.compute_start_slot_at_epoch(fin_epoch, spec):
+        raise BlobError("sidecar older than finalization")
+    parent_root = bytes(header.parent_root)
+    if not chain.store.block_exists(parent_root):
+        raise BlobError("parent unknown")
+    parent_slot = chain.block_slots.get(parent_root)
+    if parent_slot is not None and parent_slot >= slot:
+        raise BlobError("not later than parent")
+
+    if not verify_commitment_inclusion(types, spec, sidecar):
+        raise BlobError("bad commitment inclusion proof")
+
+    # proposer signature over the header (same domain as block proposals)
+    state = chain._state_for_block(parent_root, slot)
+    if int(header.proposer_index) >= len(state.validators):
+        raise BlobError("proposer index out of range")
+    batch = SignatureBatch()
+    try:
+        batch.add(
+            sigs.block_header_set(
+                state, spec, types, sidecar.signed_block_header,
+                chain.pubkey_cache.pubkey_getter(),
+            )
+        )
+    except sigs.SignatureSetError as e:
+        raise BlobError(f"undecodable header signature: {e}") from e
+    if not batch.verify():
+        raise BlobError("invalid header proposer signature")
+
+    if verify_kzg:
+        if not chain.data_availability.verify_kzg_proofs([sidecar]):
+            raise BlobError("KZG proof invalid")
+
+    chain.observed_blob_sidecars.add(key)
+    return block_root

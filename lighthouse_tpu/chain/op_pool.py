@@ -117,6 +117,16 @@ class OperationPool:
                 <= data.slot + spec.preset.SLOTS_PER_EPOCH
             ):
                 continue
+            # the source must still match the packing state's justified
+            # checkpoint, or process_attestation rejects the block (stale
+            # attestations straddling a justification advance)
+            justified = (
+                state.current_justified_checkpoint
+                if data.target.epoch == current_epoch
+                else state.previous_justified_checkpoint
+            )
+            if data.source != justified:
+                continue
             participation = (
                 state.current_epoch_participation
                 if data.target.epoch == current_epoch

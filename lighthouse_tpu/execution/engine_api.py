@@ -149,6 +149,9 @@ class MockExecutionLayer:
     invalid_hashes: set = field(default_factory=set)
     payload_counter: int = 0
     pending_payloads: dict = field(default_factory=dict)
+    # deneb: queued (blob, commitment, proof) triples served with the next
+    # getPayload as a blobsBundle (ExecutionBlockGenerator blob support)
+    queued_blobs: list = field(default_factory=list)
 
     def __post_init__(self):
         self.blocks[self.head] = {"number": 0, "parent": None}
@@ -191,7 +194,7 @@ class MockExecutionLayer:
         parent = info["parent"]
         number = self.blocks[parent]["number"] + 1
         block_hash = hashlib.sha256(b"mock-el" + parent + number.to_bytes(8, "big")).digest()
-        return {
+        out = {
             "executionPayload": {
                 "parentHash": "0x" + parent.hex(),
                 "blockHash": "0x" + block_hash.hex(),
@@ -200,3 +203,11 @@ class MockExecutionLayer:
                 "prevRandao": info["prevRandao"],
             }
         }
+        if self.queued_blobs:
+            triples, self.queued_blobs = self.queued_blobs, []
+            out["blobsBundle"] = {
+                "blobs": [b for b, _, _ in triples],
+                "commitments": [c for _, c, _ in triples],
+                "proofs": [p for _, _, p in triples],
+            }
+        return out

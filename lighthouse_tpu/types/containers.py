@@ -401,13 +401,26 @@ class SpecTypes:
         ])
 
         if fork >= ForkName.deneb:
+            # proof depth = list data tree + length mix-in + body container
+            # (17 on mainnet: 12 + 1 + 4)
+            def _log2ceil(n):
+                d = 0
+                while (1 << d) < n:
+                    d += 1
+                return d
+
+            proof_depth = (
+                _log2ceil(p.MAX_BLOB_COMMITMENTS_PER_BLOCK)
+                + 1
+                + _log2ceil(len(body_fields))
+            )
             self.BlobSidecar = C("BlobSidecar", [
                 ("index", uint64),
                 ("blob", self.Blob),
                 ("kzg_commitment", KZGCommitment),
                 ("kzg_proof", KZGProof),
                 ("signed_block_header", self.SignedBeaconBlockHeader),
-                ("kzg_commitment_inclusion_proof", Vector(Bytes32, 17)),
+                ("kzg_commitment_inclusion_proof", Vector(Bytes32, proof_depth)),
             ])
 
         # ---- beacon state (per fork)
