@@ -42,20 +42,18 @@ class ForkChoiceStore:
 
 class ForkChoice:
     def __init__(self, spec: ChainSpec, anchor_root: bytes, anchor_slot: int, anchor_state):
-        jc = (
-            anchor_state.current_justified_checkpoint.epoch,
-            bytes(anchor_state.current_justified_checkpoint.root),
-        )
-        fc = (
-            anchor_state.finalized_checkpoint.epoch,
-            bytes(anchor_state.finalized_checkpoint.root),
-        )
-        # anchor acts as both justified+finalized root at startup
+        # Spec get_forkchoice_store: the anchor IS both the justified and
+        # finalized checkpoint at startup (required for checkpoint sync,
+        # where the state's own checkpoints reference pre-anchor blocks the
+        # proto array will never contain).
         epoch = h.compute_epoch_at_slot(anchor_slot, spec)
-        jc = (jc[0], anchor_root) if jc[1] == b"\x00" * 32 else jc
-        fc = (fc[0], anchor_root) if fc[1] == b"\x00" * 32 else fc
+        jc = (epoch, anchor_root)
+        fc = (epoch, anchor_root)
         self.spec = spec
-        self.proto = ProtoArrayForkChoice(anchor_root, anchor_slot, jc, fc)
+        self.proto = ProtoArrayForkChoice(
+            anchor_root, anchor_slot, jc, fc,
+            slots_per_epoch=spec.preset.SLOTS_PER_EPOCH,
+        )
         self.store = ForkChoiceStore(
             current_slot=anchor_slot,
             justified_checkpoint=jc,
@@ -235,6 +233,7 @@ class ForkChoice:
             jc[1],
             new_balances=self.store.justified_balances,
             proposer_boost_amount=boost,
+            current_epoch=self.store.current_slot // self.spec.preset.SLOTS_PER_EPOCH,
         )
 
     def prune(self):

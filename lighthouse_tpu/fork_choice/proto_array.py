@@ -56,7 +56,9 @@ class ProtoArrayForkChoice:
         finalized_slot: int,
         justified_checkpoint: tuple[int, bytes],
         finalized_checkpoint: tuple[int, bytes],
+        slots_per_epoch: int = 32,
     ):
+        self.slots_per_epoch = slots_per_epoch
         self.nodes: list[ProtoNode] = []
         self.index_by_root: dict[bytes, int] = {}
         self.votes: list[VoteTracker] = []
@@ -178,15 +180,31 @@ class ProtoArrayForkChoice:
     _last_boost_amount = 0
     _last_boost_root = b"\x00" * 32
 
-    def _node_viable(self, idx: int) -> bool:
+    def _node_viable(self, idx: int, current_epoch: int | None = None) -> bool:
+        """Spec filter_block_tree viability: the node's VOTING SOURCE (its
+        unrealized justification for blocks from prior epochs, realized for
+        current-epoch blocks) must match the store's justified epoch, with
+        the 2-epoch lag tolerance; finalization must be consistent."""
         n = self.nodes[idx]
         if n.execution_status == ExecutionStatus.invalid:
             return False
-        jc = n.unrealized_justified_checkpoint or n.justified_checkpoint
+        if current_epoch is None:
+            current_epoch = self._current_epoch_hint
+        block_epoch = n.slot // self.slots_per_epoch
+        if block_epoch < current_epoch and n.unrealized_justified_checkpoint is not None:
+            voting_source = n.unrealized_justified_checkpoint
+        else:
+            voting_source = n.justified_checkpoint
+        ok_j = (
+            self.justified_checkpoint[0] == 0
+            or voting_source[0] == self.justified_checkpoint[0]
+            or voting_source[0] + 2 >= current_epoch
+        )
         fc = n.unrealized_finalized_checkpoint or n.finalized_checkpoint
-        ok_j = self.justified_checkpoint[0] == 0 or jc == self.justified_checkpoint
-        ok_f = self.finalized_checkpoint[0] == 0 or fc[0] == self.finalized_checkpoint[0]
+        ok_f = self.finalized_checkpoint[0] == 0 or fc[0] >= self.finalized_checkpoint[0]
         return ok_j and ok_f
+
+    _current_epoch_hint = 0
 
     def _viable_for_head(self, idx: int) -> bool:
         bd = self._best_descendant[idx]
@@ -198,7 +216,10 @@ class ProtoArrayForkChoice:
         justified_root: bytes,
         new_balances: list[int] | None = None,
         proposer_boost_amount: int = 0,
+        current_epoch: int | None = None,
     ) -> bytes:
+        if current_epoch is not None:
+            self._current_epoch_hint = current_epoch
         if new_balances is None:
             new_balances = self.balances
         deltas = self._score_changes(new_balances, proposer_boost_amount)

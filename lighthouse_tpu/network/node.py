@@ -1,0 +1,257 @@
+"""NetworkNode: one node's full networking stack over real TCP.
+
+Assembly mirror of /root/reference/beacon_node/network/src/service.rs +
+router.rs: owns the transport (TcpHost), the gossipsub router, the Req/Resp
+server (RpcHandler), the peer manager and the sync manager, and dispatches
+gossip topics into the beacon chain's verification pipelines
+(network_beacon_processor/gossip_methods.rs analogs)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..chain.beacon_chain import AttestationError, BlockError
+from ..chain.data_availability import AvailabilityPendingError, BlobError
+from ..state_transition.slot import types_for_slot
+from . import gossip as gs
+from .gossipsub import Gossipsub
+from .peer_manager import PeerManager
+from .rpc import Protocol, RpcHandler
+from .sync import SyncManager
+from .transport import RemotePeer, TcpHost
+
+
+class NetworkNode:
+    def __init__(
+        self,
+        chain,
+        node_id: str,
+        fork_digest: bytes = b"\x00" * 4,
+        port: int = 0,
+        heartbeat_interval: float = 0.3,
+        subnets: int | None = None,
+        op_pool=None,
+    ):
+        self.chain = chain
+        self.node_id = node_id
+        self.fork_digest = fork_digest
+        self.op_pool = op_pool
+        self.peer_manager = PeerManager()
+        self.rpc = RpcHandler(chain, fork_digest)
+        self.sync = SyncManager(chain)
+        self.gossipsub = Gossipsub(node_id, self._gossip_send, self.peer_manager)
+        self.host = TcpHost(self, node_id, port=port)
+        self.heartbeat_interval = heartbeat_interval
+        self._hb_stop = threading.Event()
+        self._hb_thread = threading.Thread(target=self._heartbeat_loop, daemon=True)
+        self._hb_thread.start()
+        self._lock = threading.Lock()  # serializes chain mutation from gossip
+
+        self._subscribe_core(subnets)
+
+    # ------------------------------------------------------------ topics
+
+    def _subscribe_core(self, subnets: int | None) -> None:
+        spec = self.chain.spec
+        fd = self.fork_digest
+        self.gossipsub.subscribe(gs.topic_name(fd, "beacon_block"), self._on_block)
+        self.gossipsub.subscribe(
+            gs.topic_name(fd, "beacon_aggregate_and_proof"), self._on_aggregate
+        )
+        n_subnets = subnets if subnets is not None else spec.attestation_subnet_count
+        for i in range(n_subnets):
+            self.gossipsub.subscribe(
+                gs.attestation_subnet_topic(fd, i), self._mk_attestation_handler()
+            )
+        from ..types.spec import ForkName
+
+        fork = spec.fork_name_at_slot(self.chain.current_slot)
+        if fork >= ForkName.deneb:
+            for i in range(spec.max_blobs(fork)):
+                self.gossipsub.subscribe(gs.blob_sidecar_topic(fd, i), self._on_blob)
+
+    # ------------------------------------------------------------ transport glue
+
+    def _gossip_send(self, peer_id: str, rpc_bytes: bytes) -> None:
+        conn = self.host.connections.get(peer_id)
+        if conn is None:
+            raise ConnectionError(f"no connection to {peer_id}")
+        conn.send_gossip(rpc_bytes)
+
+    def _serve_rpc(self, peer_id: str, protocol_str: str, request_bytes: bytes):
+        try:
+            protocol = Protocol(protocol_str)
+        except ValueError:
+            return []
+        return self.rpc.handle(peer_id or "?", protocol, request_bytes)
+
+    def _on_gossip(self, peer_id: str, rpc_bytes: bytes) -> None:
+        if peer_id is None:
+            return
+        self.gossipsub.on_rpc(peer_id, rpc_bytes)
+
+    def _register_connection(self, conn) -> None:
+        self.host.connections[conn.peer_id] = conn
+        self.peer_manager.connect(conn.peer_id)
+        self.gossipsub.add_peer(conn.peer_id)
+        # the Status handshake is a blocking round trip and we are ON this
+        # connection's reader thread — hand it to a helper thread or the
+        # response could never be read (deadlock)
+        threading.Thread(
+            target=self.sync.add_peer,
+            args=(conn.peer_id, RemotePeer(conn)),
+            daemon=True,
+        ).start()
+
+    def _unregister_connection(self, conn) -> None:
+        if conn.peer_id is None:
+            return
+        self.host.connections.pop(conn.peer_id, None)
+        self.peer_manager.disconnect(conn.peer_id)
+        self.gossipsub.remove_peer(conn.peer_id)
+        self.sync.remove_peer(conn.peer_id)
+
+    def connect(self, other: "NetworkNode") -> None:
+        host, port = other.host.listen_addr
+        self.host.dial(host, port)
+
+    def _heartbeat_loop(self) -> None:
+        while not self._hb_stop.wait(self.heartbeat_interval):
+            try:
+                self.gossipsub.heartbeat()
+            except Exception:
+                pass
+
+    def close(self) -> None:
+        self._hb_stop.set()
+        self.host.close()
+
+    # ------------------------------------------------------------ handlers
+
+    def _on_block(self, msg) -> bool:
+        """process_gossip_block analog: verify -> propagate -> import."""
+        spec = self.chain.spec
+        # decode with the right fork types: peek the slot (first 8 bytes of
+        # the message body after the 96-byte signature container layout is
+        # fork-independent for slot: use latest types to read slot)
+        payload = msg.decompressed
+        types = types_for_slot(spec, self.chain.current_slot)
+        try:
+            signed = types.SignedBeaconBlock.deserialize(payload)
+        except Exception:
+            return False
+        with self._lock:
+            try:
+                root = self.chain.verify_block_for_gossip(signed)
+            except BlockError as e:
+                if "already known" in str(e):
+                    return False
+                if "parent unknown" in str(e):
+                    # parent lookup via the sender
+                    self._lookup_parent(msg.source_peer, signed)
+                    return False
+                return False
+            try:
+                self.chain.process_block(
+                    signed, block_root=root, proposal_already_verified=True
+                )
+            except AvailabilityPendingError:
+                return True          # propagate; blobs will complete it
+            except BlockError:
+                return False
+        return True
+
+    def _lookup_parent(self, peer_id: str, signed) -> None:
+        try:
+            self.sync.lookup_parent_chain(peer_id, bytes(signed.message.parent_root))
+            self.chain.process_block(signed)
+        except Exception:
+            pass
+
+    def _mk_attestation_handler(self):
+        def handler(msg) -> bool:
+            spec = self.chain.spec
+            types = types_for_slot(spec, self.chain.current_slot)
+            try:
+                att = types.Attestation.deserialize(msg.decompressed)
+            except Exception:
+                return False
+            with self._lock:
+                try:
+                    results = self.chain.verify_unaggregated_attestations([att])
+                except (AttestationError, BlockError):
+                    return False
+                for a, indices in results:
+                    self.chain.apply_attestation_to_fork_choice(a, indices)
+                    if self.op_pool is not None:
+                        self.op_pool.insert_attestation(a, indices, types)
+                return bool(results)
+
+        return handler
+
+    def _on_aggregate(self, msg) -> bool:
+        spec = self.chain.spec
+        types = types_for_slot(spec, self.chain.current_slot)
+        try:
+            signed = types.SignedAggregateAndProof.deserialize(msg.decompressed)
+        except Exception:
+            return False
+        with self._lock:
+            try:
+                results = self.chain.verify_aggregated_attestations([signed])
+            except (AttestationError, BlockError):
+                return False
+            for att, indices in results:
+                self.chain.apply_attestation_to_fork_choice(att, indices)
+                if self.op_pool is not None:
+                    self.op_pool.insert_attestation(att, indices, types)
+            return bool(results)
+
+    def _on_blob(self, msg) -> bool:
+        spec = self.chain.spec
+        types = types_for_slot(spec, self.chain.current_slot)
+        try:
+            sidecar = types.BlobSidecar.deserialize(msg.decompressed)
+        except Exception:
+            return False
+        with self._lock:
+            try:
+                self.chain.process_gossip_blob(sidecar)
+            except BlobError:
+                return False
+            except (BlockError, AvailabilityPendingError):
+                return True          # sidecar itself was valid; propagate
+        return True
+
+    # ------------------------------------------------------------ publishing
+
+    def publish_block(self, signed_block) -> None:
+        types = types_for_slot(self.chain.spec, signed_block.message.slot)
+        self.gossipsub.publish(
+            gs.topic_name(self.fork_digest, "beacon_block"),
+            types.SignedBeaconBlock.serialize(signed_block),
+        )
+
+    def publish_attestation(self, att, subnet_id: int) -> None:
+        types = types_for_slot(self.chain.spec, att.data.slot)
+        self.gossipsub.publish(
+            gs.attestation_subnet_topic(self.fork_digest, subnet_id),
+            types.Attestation.serialize(att),
+        )
+
+    def publish_aggregate(self, signed_agg) -> None:
+        types = types_for_slot(self.chain.spec, signed_agg.message.aggregate.data.slot)
+        self.gossipsub.publish(
+            gs.topic_name(self.fork_digest, "beacon_aggregate_and_proof"),
+            types.SignedAggregateAndProof.serialize(signed_agg),
+        )
+
+    def publish_blob(self, sidecar) -> None:
+        types = types_for_slot(
+            self.chain.spec, sidecar.signed_block_header.message.slot
+        )
+        self.gossipsub.publish(
+            gs.blob_sidecar_topic(self.fork_digest, int(sidecar.index)),
+            types.BlobSidecar.serialize(sidecar),
+        )

@@ -220,6 +220,43 @@ class RpcHandler:
                         )
                     )
             return out
+        if protocol == Protocol.blobs_by_range:
+            req = BlocksByRangeRequest.deserialize(decode_chunk(request_bytes)[0])
+            from ..state_transition.slot import types_for_slot
+
+            out = []
+            by_slot = {s: r for r, s in self.chain.block_slots.items()}
+            count = min(req.count, self.MAX_REQUEST_BLOCKS)
+            for slot in range(req.start_slot, req.start_slot + count):
+                root = by_slot.get(slot)
+                if root is None:
+                    continue
+                for sc in self.chain.get_blobs(root):
+                    types = types_for_slot(self.chain.spec, slot)
+                    out.append(
+                        encode_response_chunk(
+                            RESP_SUCCESS, types.BlobSidecar.serialize(sc)
+                        )
+                    )
+            return out
+        if protocol == Protocol.blobs_by_root:
+            payload, _ = decode_chunk(request_bytes)
+            roots = [payload[i : i + 32] for i in range(0, len(payload), 32)]
+            from ..state_transition.slot import types_for_slot
+
+            out = []
+            for root in roots[: self.MAX_REQUEST_BLOCKS]:
+                slot = self.chain.block_slots.get(root)
+                if slot is None:
+                    continue
+                types = types_for_slot(self.chain.spec, slot)
+                for sc in self.chain.get_blobs(root):
+                    out.append(
+                        encode_response_chunk(
+                            RESP_SUCCESS, types.BlobSidecar.serialize(sc)
+                        )
+                    )
+            return out
         if protocol == Protocol.blocks_by_root:
             payload, _ = decode_chunk(request_bytes)
             roots = [payload[i : i + 32] for i in range(0, len(payload), 32)]

@@ -30,6 +30,22 @@ from .rpc import (
 EPOCHS_PER_BATCH = 2
 
 
+def peek_block_slot(ssz: bytes) -> int:
+    """Slot of a serialized SignedBeaconBlock without full decode: the
+    message offset sits at [0:4], and slot is the message's first field —
+    this is how fork-aware decoding picks the right container for mixed-
+    fork ranges (the reference selects by fork context instead)."""
+    off = int.from_bytes(ssz[0:4], "little")
+    return int.from_bytes(ssz[off : off + 8], "little")
+
+
+def peek_sidecar_slot(spec, ssz: bytes) -> int:
+    """Header slot of a serialized BlobSidecar: fixed layout up to the
+    header (index u64, blob, commitment 48, proof 48, then header.slot)."""
+    off = 8 + spec.preset.FIELD_ELEMENTS_PER_BLOB * 32 + 48 + 48
+    return int.from_bytes(ssz[off : off + 8], "little")
+
+
 class SyncState(Enum):
     idle = "idle"
     syncing_finalized = "syncing_finalized"
@@ -43,6 +59,72 @@ class BatchRequest:
     count: int
     peer_id: str
     attempts: int = 0
+
+
+class BackFillSync:
+    """Downward sync from the checkpoint anchor to genesis
+    (backfill_sync/mod.rs): batches of EPOCHS_PER_BATCH requested BELOW the
+    oldest known block, hash-linked to it, and signature-verified as ONE
+    batch per segment via chain.import_historical_blocks.
+
+    Skipped-slot runs longer than one batch are handled by WIDENING the
+    request window (up to MAX_WINDOW_EPOCHS) before a peer is blamed — an
+    empty range is not by itself misbehavior."""
+
+    MAX_WINDOW_EPOCHS = 32
+
+    def __init__(self, chain):
+        self.chain = chain
+        self.window_epochs = EPOCHS_PER_BATCH
+
+    def complete(self) -> bool:
+        return self.chain.oldest_block_slot == 0
+
+    def request_and_import(self, rpc_peer, peer_id: str) -> int:
+        """One batch: request [start, oldest) by range, import. Returns
+        blocks imported; 0 with an exhausted window means the peer failed
+        (caller drops it), otherwise the window was widened for retry."""
+        spec = self.chain.spec
+        oldest = self.chain.oldest_block_slot
+        if oldest == 0:
+            return 0
+        batch_slots = self.window_epochs * spec.preset.SLOTS_PER_EPOCH
+        start = max(0, oldest - batch_slots)
+        count = oldest - start
+        msg = BlocksByRangeRequest.make(start_slot=start, count=count, step=1)
+        try:
+            chunks = rpc_peer.handle(
+                peer_id, Protocol.blocks_by_range,
+                encode_chunk(BlocksByRangeRequest.serialize(msg)),
+            )
+        except Exception:
+            return 0
+        blocks = []
+        for c in chunks:
+            code, payload = decode_response_chunk(c)
+            if code != RESP_SUCCESS:
+                return 0
+            types = types_for_slot(spec, peek_block_slot(payload))
+            blocks.append(types.SignedBeaconBlock.deserialize(payload))
+        if not blocks:
+            return self._widen(start)
+        try:
+            got = self.chain.import_historical_blocks(blocks)
+        except Exception:
+            if start > 0:
+                # maybe the linkage parent lies below the window: widen once
+                return self._widen(start)
+            return 0
+        self.window_epochs = EPOCHS_PER_BATCH
+        return got
+
+    def _widen(self, start: int) -> int:
+        """Empty/unlinked response: widen the window unless exhausted.
+        Returns -1 ("retry, not peer's fault") or 0 (give up on peer)."""
+        if start == 0 or self.window_epochs >= self.MAX_WINDOW_EPOCHS:
+            return 0
+        self.window_epochs = min(self.MAX_WINDOW_EPOCHS, self.window_epochs * 2)
+        return -1
 
 
 class SyncManager:
@@ -107,8 +189,12 @@ class SyncManager:
                 # peer advertised higher head but served nothing: lies -> drop
                 self.remove_peer(peer_id)
                 continue
+            blobs_by_root = self._request_blobs_for(req, blocks)
+            if blobs_by_root is None:
+                self.remove_peer(peer_id)
+                continue
             try:
-                self.chain.process_chain_segment(blocks)
+                self.chain.process_chain_segment(blocks, blobs_by_root=blobs_by_root)
             except Exception:
                 self.failed_batches.append(req)
                 self.remove_peer(peer_id)
@@ -133,10 +219,70 @@ class SyncManager:
             code, payload = decode_response_chunk(c)
             if code != RESP_SUCCESS:
                 return None
-            # decode with fork types at the advertised slot range
-            types = types_for_slot(self.chain.spec, req.start_slot)
+            # fork-aware decode: pick container types by the block's own slot
+            types = types_for_slot(self.chain.spec, peek_block_slot(payload))
             blocks.append(types.SignedBeaconBlock.deserialize(payload))
         return blocks
+
+    def _request_blobs_for(self, req: BatchRequest, blocks):
+        """Fetch the range's blob sidecars when any block carries
+        commitments; returns {block_root: [sidecar]} (block_sidecar_coupling
+        analog), None on peer failure."""
+        from ..types.spec import ForkName
+
+        spec = self.chain.spec
+        need = any(
+            spec.fork_name_at_slot(b.message.slot) >= ForkName.deneb
+            and len(b.message.body.blob_kzg_commitments) > 0
+            for b in blocks
+        )
+        if not need:
+            return {}
+        peer = self.peers.get(req.peer_id)
+        if peer is None:
+            return None
+        msg = BlocksByRangeRequest.make(
+            start_slot=req.start_slot, count=req.count, step=1
+        )
+        try:
+            chunks = peer.handle(
+                req.peer_id, Protocol.blobs_by_range,
+                encode_chunk(BlocksByRangeRequest.serialize(msg)),
+            )
+        except Exception:
+            return None
+        out: dict[bytes, list] = {}
+        for c in chunks:
+            code, payload = decode_response_chunk(c)
+            if code != RESP_SUCCESS:
+                return None
+            types = types_for_slot(spec, peek_sidecar_slot(spec, payload))
+            sc = types.BlobSidecar.deserialize(payload)
+            hdr = sc.signed_block_header.message
+            root = types.BeaconBlockHeader.hash_tree_root(hdr)
+            out.setdefault(root, []).append(sc)
+        for scs in out.values():
+            scs.sort(key=lambda s: int(s.index))
+        return out
+
+    # ------------------------------------------------------------- backfill
+
+    def backfill(self) -> int:
+        """Drive BackFillSync to genesis; returns blocks stored."""
+        bf = BackFillSync(self.chain)
+        total = 0
+        while not bf.complete():
+            peer_id = next(iter(self.peers), None)
+            if peer_id is None:
+                return total
+            got = bf.request_and_import(self.peers[peer_id], peer_id)
+            if got == 0:
+                self.remove_peer(peer_id)
+                continue
+            if got > 0:
+                total += got
+            # got == -1: window widened, retry the same peer
+        return total
 
     # ------------------------------------------------------------- lookups
 

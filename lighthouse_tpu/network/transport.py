@@ -1,0 +1,254 @@
+"""TCP transport: framed, multiplexed peer connections.
+
+The real-socket layer under Req/Resp and gossipsub. The reference runs
+libp2p (tcp + noise + mplex/yamux); here the host-side transport is a
+deliberately small equivalent: length-prefixed frames over TCP, one
+connection per peer pair, with RPC streams multiplexed by id and gossip
+pushed as fire-and-forget frames
+(/root/reference/beacon_node/lighthouse_network/src/service/mod.rs is the
+structural model; encryption/mplex are not consensus-relevant and stay out).
+
+Frame format (big-endian): [u8 type][u32 length][payload]
+  HELLO      0: peer_id utf-8 (each side sends one on connect)
+  REQ        1: [u64 stream][u16 proto_len][protocol][request bytes]
+  RESP_CHUNK 2: [u64 stream][chunk bytes]
+  RESP_END   3: [u64 stream]
+  GOSSIP     4: gossipsub RPC (see gossipsub.encode_rpc)
+  CLOSE      5: goodbye
+
+Threading model: a reader thread per connection; outbound requests block on
+a per-stream queue (the synchronous `handle()` surface SyncManager already
+consumes); gossip frames dispatch into the node's gossipsub router.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import struct
+import threading
+import time
+
+HELLO, REQ, RESP_CHUNK, RESP_END, GOSSIP, CLOSE = range(6)
+
+MAX_FRAME = 16 * 1024 * 1024
+
+
+class TransportError(Exception):
+    pass
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        got = sock.recv(n - len(buf))
+        if not got:
+            raise TransportError("connection closed")
+        buf += got
+    return buf
+
+
+def read_frame(sock: socket.socket) -> tuple[int, bytes]:
+    hdr = _recv_exact(sock, 5)
+    ftype, ln = hdr[0], struct.unpack(">I", hdr[1:])[0]
+    if ln > MAX_FRAME:
+        raise TransportError("frame too large")
+    return ftype, _recv_exact(sock, ln)
+
+
+def write_frame(sock: socket.socket, ftype: int, payload: bytes) -> None:
+    sock.sendall(bytes([ftype]) + struct.pack(">I", len(payload)) + payload)
+
+
+class Connection:
+    """One live peer connection (either direction)."""
+
+    def __init__(self, sock: socket.socket, local_id: str, node):
+        self.sock = sock
+        self.node = node
+        self.local_id = local_id
+        self.peer_id: str | None = None
+        self._send_lock = threading.Lock()
+        self._streams: dict[int, queue.Queue] = {}
+        self._next_stream = 1
+        self._stream_lock = threading.Lock()
+        self.alive = True
+
+    # ------------------------------------------------------------- sending
+
+    def _send(self, ftype: int, payload: bytes) -> None:
+        with self._send_lock:
+            write_frame(self.sock, ftype, payload)
+
+    def send_hello(self) -> None:
+        self._send(HELLO, self.local_id.encode())
+
+    def send_gossip(self, rpc_bytes: bytes) -> None:
+        try:
+            self._send(GOSSIP, rpc_bytes)
+        except OSError:
+            self.close()
+
+    def request(self, protocol: str, request_bytes: bytes, timeout: float = 10.0) -> list[bytes]:
+        """Blocking Req/Resp round trip; returns response chunks."""
+        with self._stream_lock:
+            sid = self._next_stream
+            self._next_stream += 1
+            q: queue.Queue = queue.Queue()
+            self._streams[sid] = q
+        proto = protocol.encode()
+        self._send(
+            REQ,
+            struct.pack(">QH", sid, len(proto)) + proto + request_bytes,
+        )
+        chunks = []
+        deadline = time.monotonic() + timeout
+        try:
+            while True:
+                remain = deadline - time.monotonic()
+                if remain <= 0:
+                    raise TransportError("request timeout")
+                try:
+                    item = q.get(timeout=remain)
+                except queue.Empty:
+                    raise TransportError("request timeout") from None
+                if item is None:
+                    return chunks
+                chunks.append(item)
+        finally:
+            with self._stream_lock:
+                self._streams.pop(sid, None)
+
+    # ------------------------------------------------------------- receiving
+
+    def run_reader(self) -> None:
+        """Reader loop (own thread): dispatch frames until close."""
+        try:
+            while self.alive:
+                ftype, payload = read_frame(self.sock)
+                if ftype == HELLO:
+                    self.peer_id = payload.decode()
+                    self.node._register_connection(self)
+                elif ftype == REQ:
+                    sid, plen = struct.unpack(">QH", payload[:10])
+                    protocol = payload[10 : 10 + plen].decode()
+                    req = payload[10 + plen :]
+                    threading.Thread(
+                        target=self._serve, args=(sid, protocol, req), daemon=True
+                    ).start()
+                elif ftype == RESP_CHUNK:
+                    sid = struct.unpack(">Q", payload[:8])[0]
+                    q = self._streams.get(sid)
+                    if q is not None:
+                        q.put(payload[8:])
+                elif ftype == RESP_END:
+                    sid = struct.unpack(">Q", payload[:8])[0]
+                    q = self._streams.get(sid)
+                    if q is not None:
+                        q.put(None)
+                elif ftype == GOSSIP:
+                    self.node._on_gossip(self.peer_id, payload)
+                elif ftype == CLOSE:
+                    break
+        except (TransportError, OSError):
+            pass
+        finally:
+            self.close()
+
+    def _serve(self, sid: int, protocol: str, req: bytes) -> None:
+        try:
+            chunks = self.node._serve_rpc(self.peer_id, protocol, req)
+        except Exception:
+            chunks = []
+        try:
+            for c in chunks:
+                self._send(RESP_CHUNK, struct.pack(">Q", sid) + c)
+            self._send(RESP_END, struct.pack(">Q", sid))
+        except OSError:
+            self.close()
+
+    def close(self) -> None:
+        if not self.alive:
+            return
+        self.alive = False
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        # unblock pending requests
+        with self._stream_lock:
+            for q in self._streams.values():
+                q.put(None)
+        self.node._unregister_connection(self)
+
+
+class RemotePeer:
+    """Synchronous Req/Resp proxy over a Connection — duck-types the
+    `handle(peer_id, protocol, request_bytes)` surface SyncManager and the
+    in-process rigs already consume."""
+
+    def __init__(self, conn: Connection):
+        self.conn = conn
+
+    def handle(self, _peer_id: str, protocol, request_bytes: bytes) -> list[bytes]:
+        proto = protocol.value if hasattr(protocol, "value") else str(protocol)
+        return self.conn.request(proto, request_bytes)
+
+
+class TcpHost:
+    """Listens for inbound connections and dials outbound ones.
+
+    The owning `node` must expose:
+      _serve_rpc(peer_id, protocol_str, request_bytes) -> list[chunks]
+      _on_gossip(peer_id, rpc_bytes)
+      _register_connection(conn) / _unregister_connection(conn)
+    """
+
+    def __init__(self, node, local_id: str, host: str = "127.0.0.1", port: int = 0):
+        self.node = node
+        self.local_id = local_id
+        self.server = socket.create_server((host, port))
+        self.host, self.port = self.server.getsockname()
+        self.connections: dict[str, Connection] = {}
+        self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self.running = True
+        self._accept_thread.start()
+
+    @property
+    def listen_addr(self) -> tuple[str, int]:
+        return (self.host, self.port)
+
+    def _accept_loop(self) -> None:
+        while self.running:
+            try:
+                sock, _addr = self.server.accept()
+            except OSError:
+                return
+            self._spawn(sock)
+
+    def _spawn(self, sock: socket.socket) -> Connection:
+        conn = Connection(sock, self.local_id, self.node)
+        threading.Thread(target=conn.run_reader, daemon=True).start()
+        conn.send_hello()
+        return conn
+
+    def dial(self, host: str, port: int, timeout: float = 5.0) -> Connection:
+        sock = socket.create_connection((host, port), timeout=timeout)
+        sock.settimeout(None)
+        conn = self._spawn(sock)
+        # wait until HELLO exchanged and registered
+        deadline = time.monotonic() + timeout
+        while conn.peer_id is None:
+            if time.monotonic() > deadline:
+                raise TransportError("hello timeout")
+            time.sleep(0.005)
+        return conn
+
+    def close(self) -> None:
+        self.running = False
+        try:
+            self.server.close()
+        except OSError:
+            pass
+        for conn in list(self.connections.values()):
+            conn.close()

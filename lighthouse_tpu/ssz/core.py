@@ -600,6 +600,23 @@ def _make_value_class(name: str, field_names: list[str], ssz_type: Container):
     )
     cls.ssz_type = ssz_type
 
+    # Structural equality across type instances: the same container name is
+    # materialized once per (preset, fork) SpecTypes, and values migrate
+    # across fork boundaries (e.g. a Checkpoint built under phase0 types
+    # inside an upgraded state vs one deserialized under deneb types).
+    # Dataclass __eq__ demands identical classes, which made such equal
+    # values compare unequal — a consensus-visible landmine.
+    def _eq(self, other):
+        if getattr(other.__class__, "__name__", None) != name:
+            return NotImplemented
+        try:
+            return all(getattr(self, n) == getattr(other, n) for n in field_names)
+        except AttributeError:
+            return NotImplemented
+
+    cls.__eq__ = _eq
+    cls.__hash__ = None
+
     def serialize(self):
         return ssz_type.serialize(self)
 

@@ -1,0 +1,119 @@
+"""Checkpoint sync + backfill + resume:
+node B starts from node A's finalized state (weak subjectivity), range-syncs
+forward, backfills to genesis with one batched signature verify per segment,
+persists, restarts from disk, and keeps importing
+(client/src/builder.rs:366-528, historical_blocks.rs:189, resume path)."""
+
+import pytest
+
+from lighthouse_tpu.chain.beacon_chain import BeaconChain, BlockError
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.network.rpc import RpcHandler
+from lighthouse_tpu.network.sync import BackFillSync, SyncManager
+from lighthouse_tpu.state_transition.slot import types_for_slot
+from lighthouse_tpu.testing.harness import StateHarness, clone_state
+from lighthouse_tpu.types.spec import minimal_spec
+
+VALIDATORS = 64
+
+
+@pytest.fixture(scope="module")
+def chain_a():
+    """Node A: a chain extended far enough to finalize."""
+    bls.set_backend("fake")
+    spec = minimal_spec()
+    harness = StateHarness.new(spec, VALIDATORS)
+    chain = BeaconChain(spec, clone_state(harness.state, spec))
+    pending = []
+    slots = 4 * spec.preset.SLOTS_PER_EPOCH
+    for _ in range(slots):
+        slot = harness.state.slot + 1
+        signed, _post = harness.produce_block(slot, attestations=pending, full_sync=False)
+        harness.apply_block(signed)
+        chain.slot_clock.set_slot(slot)
+        chain.per_slot_task()
+        root = chain.verify_block_for_gossip(signed)
+        chain.process_block(signed, block_root=root, proposal_already_verified=True)
+        types = types_for_slot(spec, slot)
+        head_root = types.BeaconBlock.hash_tree_root(signed.message)
+        pending = harness.build_attestations(
+            clone_state(harness.state, spec), slot, head_root
+        )
+    assert chain.fork_choice.store.finalized_checkpoint[0] >= 2
+    return harness, chain
+
+
+def _checkpoint_material(chain):
+    """The (state, block) pair a checkpoint-sync server would hand out."""
+    fin_epoch, fin_root = chain.fork_choice.store.finalized_checkpoint
+    slot = chain.block_slots[fin_root]
+    types = types_for_slot(chain.spec, slot)
+    block = chain.store.get_block(fin_root, types)
+    state = chain.store.get_state(chain.state_root_by_block[fin_root], types)
+    return state, block, fin_root
+
+
+def test_checkpoint_sync_forward_then_backfill(chain_a):
+    harness, a = chain_a
+    spec = a.spec
+    state, block, fin_root = _checkpoint_material(a)
+
+    b = BeaconChain.from_checkpoint(spec, clone_state(state, spec), block)
+    assert b.head_root == fin_root
+    assert b.oldest_block_slot == state.slot
+
+    # forward range-sync from A
+    b.slot_clock.set_slot(a.current_slot)
+    sync = SyncManager(b)
+    sync.add_peer("nodeA", RpcHandler(a))
+    imported = sync.sync()
+    assert imported > 0
+    assert b.head_state().slot == a.head_state().slot
+    assert b.head_root == a.head_root
+
+    # backfill down to genesis: batched historical verification
+    total = sync.backfill()
+    assert b.oldest_block_slot == 0
+    assert total == state.slot  # every pre-anchor slot had a block
+    # every backfilled block is now queryable
+    for slot in range(0, int(state.slot)):
+        root = next(r for r, s in b.block_slots.items() if s == slot)
+        assert b.store.block_exists(root)
+
+    # a corrupted historical segment is rejected as one batch
+    bad = a.store.get_block(
+        next(r for r, s in b.block_slots.items() if s == 3),
+        types_for_slot(spec, 3),
+    )
+    with pytest.raises(BlockError):
+        b.import_historical_blocks([bad])
+
+
+def test_persist_and_resume(chain_a):
+    harness, a = chain_a
+    spec = a.spec
+    state, block, fin_root = _checkpoint_material(a)
+
+    b = BeaconChain.from_checkpoint(spec, clone_state(state, spec), block)
+    b.slot_clock.set_slot(a.current_slot)
+    sync = SyncManager(b)
+    sync.add_peer("nodeA", RpcHandler(a))
+    sync.sync()
+    head_before = b.head_root
+    b.persist()
+
+    # "restart": a new chain object over the same store
+    c = BeaconChain.resume(spec, b.store)
+    assert c.head_root == head_before
+    assert c.head_state().slot == b.head_state().slot
+    assert c.oldest_block_slot == b.oldest_block_slot
+
+    # the resumed node keeps importing new blocks produced on A's chain
+    slot = harness.state.slot + 1
+    signed, _post = harness.produce_block(slot, attestations=[], full_sync=False)
+    harness.apply_block(signed)
+    for ch in (a, c):
+        ch.slot_clock.set_slot(slot)
+        ch.per_slot_task()
+        ch.process_block(signed)
+    assert c.head_root == a.head_root

@@ -61,6 +61,11 @@ def test_packing_skips_already_covered():
     st.validators = [types.Validator.default() for _ in range(8)]
     st.current_epoch_participation = [0] * 8
     st.previous_epoch_participation = [0] * 8
+    # packing requires the attestation source to match the state's justified
+    # checkpoint (stale-source attestations are unincludable)
+    st.current_justified_checkpoint = types.Checkpoint.make(
+        epoch=0, root=b"\x02" * 32
+    )
     # validator 3 already has target participation
     from lighthouse_tpu.state_transition import accessors as acc
 

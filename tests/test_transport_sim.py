@@ -1,0 +1,175 @@
+"""Real-socket networking: framed transport, gossipsub mesh semantics, and
+the 4-node localhost simulation gossiping blocks/attestations to
+justification (basic_sim.rs checks analog, over actual TCP)."""
+
+import time
+
+import pytest
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.network.gossipsub import (
+    Gossipsub,
+    Rpc,
+    decode_rpc,
+    encode_rpc,
+)
+from lighthouse_tpu.types.spec import minimal_spec
+
+
+def test_rpc_encoding_roundtrip():
+    rpc = Rpc(
+        subs=[(True, "/eth2/aa/beacon_block/ssz_snappy"), (False, "t2")],
+        msgs=[("t", b"payload"), ("t2", b"\x00" * 100)],
+        ihave=[("t", [bytes([i]) * 20 for i in range(3)])],
+        iwant=[[b"\x07" * 20]],
+        graft=["t"],
+        prune=["t2", "t3"],
+    )
+    got = decode_rpc(encode_rpc(rpc))
+    assert got.subs == rpc.subs
+    assert got.msgs == rpc.msgs
+    assert got.ihave == rpc.ihave
+    assert got.iwant == rpc.iwant
+    assert got.graft == rpc.graft
+    assert got.prune == rpc.prune
+
+
+class Net:
+    """In-memory wiring for gossipsub unit tests (no sockets)."""
+
+    def __init__(self):
+        self.routers: dict[str, Gossipsub] = {}
+
+    def add(self, name: str) -> Gossipsub:
+        g = Gossipsub(name, lambda peer, rpc, _n=name: self.routers[peer].on_rpc(_n, rpc))
+        self.routers[name] = g
+        return g
+
+    def connect(self, a: str, b: str):
+        self.routers[a].add_peer(b)
+        self.routers[b].add_peer(a)
+
+
+def test_gossipsub_mesh_and_delivery():
+    net = Net()
+    names = [f"n{i}" for i in range(6)]
+    routers = [net.add(n) for n in names]
+    received: dict[str, list[bytes]] = {n: [] for n in names}
+    for n, g in zip(names, routers):
+        g.subscribe("topic", lambda msg, _n=n: received[_n].append(msg.decompressed) or True)
+    # connect a line topology: n0-n1-n2-n3-n4-n5 (forces multi-hop forwarding)
+    for i in range(5):
+        net.connect(names[i], names[i + 1])
+    for g in routers:
+        g.heartbeat()
+    routers[0].publish("topic", b"hello gossip")
+    # line topology: message must traverse hop by hop via mesh forwarding
+    assert all(received[n] == [b"hello gossip"] for n in names[1:])
+    # no duplicate delivery anywhere
+    routers[2].publish("topic", b"hello gossip")  # same id -> seen, no redeliver
+    assert all(len(received[n]) <= 1 for n in names)
+
+
+def test_gossipsub_ihave_iwant_recovery():
+    """A peer outside every mesh still converges via IHAVE/IWANT."""
+    net = Net()
+    a, b = net.add("a"), net.add("b")
+    got = []
+    a.subscribe("t", lambda m: True)
+    b.subscribe("t", lambda m: got.append(m.decompressed) or True)
+    net.connect("a", "b")
+    # simulate a missed delivery: a publishes while b's link dropped it
+    a.mesh["t"] = set()          # no mesh members -> flood set empty
+    a.peer_topics["b"].discard("t")
+    a.publish("t", b"missed")
+    assert got == []
+    # restore knowledge; keep b OUT of the mesh (prune backoff) so delivery
+    # must happen via IHAVE -> IWANT, not a mesh graft
+    a.peer_topics["b"].add("t")
+    a.backoff[("b", "t")] = time.monotonic() + 100
+    a.heartbeat()
+    assert got == [b"missed"]
+
+
+def test_gossipsub_invalid_message_scoring():
+    net = Net()
+    a, b = net.add("a"), net.add("b")
+    a.subscribe("t", lambda m: True)
+    b.subscribe("t", lambda m: False)   # b rejects everything
+    net.connect("a", "b")
+    for g in (a, b):
+        g.heartbeat()
+    a.publish("t", b"junk")
+    assert b.rejected == 1
+    assert b.scores["a"] < 0
+
+
+def test_transport_rpc_roundtrip():
+    """TCP transport: REQ/RESP multiplexing + gossip frames end to end."""
+    from lighthouse_tpu.network.transport import RemotePeer, TcpHost
+
+    class EchoNode:
+        def __init__(self):
+            self.gossip = []
+            self.host = None
+
+        def _serve_rpc(self, peer_id, protocol, req):
+            return [b"echo:" + req, b"second"]
+
+        def _on_gossip(self, peer_id, rpc_bytes):
+            self.gossip.append((peer_id, rpc_bytes))
+
+        def _register_connection(self, conn):
+            self.host.connections[conn.peer_id] = conn
+
+        def _unregister_connection(self, conn):
+            self.host.connections.pop(conn.peer_id, None)
+
+    n1, n2 = EchoNode(), EchoNode()
+    h1 = TcpHost(n1, "alpha")
+    h2 = TcpHost(n2, "beta")
+    n1.host, n2.host = h1, h2
+    conn = h1.dial(*h2.listen_addr)
+    assert conn.peer_id == "beta"
+    chunks = conn.request("/test/proto", b"ping")
+    assert chunks == [b"echo:ping", b"second"]
+    # reverse direction over the same socket
+    deadline = time.monotonic() + 5
+    while "alpha" not in h2.connections and time.monotonic() < deadline:
+        time.sleep(0.01)
+    back = RemotePeer(h2.connections["alpha"])
+    assert back.handle("x", "/test/proto", b"pong") == [b"echo:pong", b"second"]
+    conn.send_gossip(b"gsp")
+    deadline = time.monotonic() + 5
+    while not n2.gossip and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert n2.gossip[0] == ("alpha", b"gsp")
+    h1.close()
+    h2.close()
+
+
+@pytest.mark.slow
+def test_four_node_sim_justifies_over_sockets():
+    """4 nodes, 64 validators split 16/16/16/16, real TCP gossip: chain
+    converges every slot and reaches justification within 3 epochs."""
+    from lighthouse_tpu.testing.simulator import Simulator
+
+    bls.set_backend("fake")
+    spec = minimal_spec()
+    sim = Simulator(spec, n_nodes=4, n_validators=64, subnets=4)
+    try:
+        sim.run_epochs(3)
+        assert sim.heads_agree()
+        fc = sim.nodes[0].chain.fork_choice.store
+        assert fc.justified_checkpoint[0] >= 2, (
+            f"no justification: justified={fc.justified_checkpoint}"
+        )
+        # all nodes share the same finalized/justified view
+        views = {
+            (n.chain.fork_choice.store.justified_checkpoint,
+             n.chain.fork_choice.store.finalized_checkpoint)
+            for n in sim.nodes
+        }
+        assert len(views) == 1
+    finally:
+        sim.close()
