@@ -74,6 +74,10 @@ class BeaconProcessorConfig:
     max_attestation_batch: int = DEFAULT_MAX_ATTESTATION_BATCH
     max_aggregate_batch: int = DEFAULT_MAX_AGGREGATE_BATCH
     num_workers: int = 2
+    # max device batches in flight before the pump blocks on the oldest —
+    # the double-buffering depth (SURVEY §7 step 2: host marshals batch N+1
+    # while the device verifies batch N)
+    max_inflight: int = 4
 
 
 class BeaconProcessor:
@@ -88,6 +92,9 @@ class BeaconProcessor:
         self.dropped: dict[WorkKind, int] = {k: 0 for k in WorkKind}
         self.processed: dict[WorkKind, int] = {k: 0 for k in WorkKind}
         self.batches_formed = 0
+        self.pipelined_batches = 0
+        # in-flight device submissions: (handle, continuation) FIFO
+        self._inflight: deque = deque()
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stop = threading.Event()
@@ -137,14 +144,47 @@ class BeaconProcessor:
             kind = batch[0].kind
             runner = batch[0].run_batch
             payloads = [it.payload for it in batch]
-            runner(payloads)
+            self._handle_result(runner(payloads))
             self.processed[kind] += len(batch)
         elif single is not None:
             if single.run is not None:
-                single.run()
+                self._handle_result(single.run())
             elif single.run_batch is not None:
-                single.run_batch([single.payload])
+                self._handle_result(single.run_batch([single.payload]))
             self.processed[single.kind] += 1
+
+    def _handle_result(self, result) -> None:
+        """A runner may return (handle, continuation): the device batch is
+        in flight and the continuation runs when it resolves. The pump keeps
+        pulling (and marshalling) new work while up to max_inflight device
+        batches verify — the host/device overlap the reference gets from
+        its worker pool (beacon_processor/src/lib.rs:732-1100)."""
+        if (
+            isinstance(result, tuple)
+            and len(result) == 2
+            and hasattr(result[0], "result")
+            and callable(result[1])
+        ):
+            with self._lock:
+                self._inflight.append(result)
+                self.pipelined_batches += 1
+                over = len(self._inflight) > self.config.max_inflight
+            if over:
+                self._resolve_oldest()
+
+    def _resolve_oldest(self) -> bool:
+        with self._lock:
+            if not self._inflight:
+                return False
+            handle, cont = self._inflight.popleft()
+        cont(handle.result())
+        return True
+
+    def drain_inflight(self) -> int:
+        n = 0
+        while self._resolve_oldest():
+            n += 1
+        return n
 
     def run_until_idle(self) -> int:
         """Synchronously drain everything (test/deterministic mode)."""
@@ -152,9 +192,16 @@ class BeaconProcessor:
         while True:
             single, batch = self._next_work()
             if single is None and batch is None:
-                return n
+                n += self.drain_inflight()
+                if self.queues_empty():
+                    return n
+                continue
             self._execute(single, batch)
             n += 1
+
+    def queues_empty(self) -> bool:
+        with self._lock:
+            return all(not q for q in self.queues.values()) and not self._inflight
 
     # ------------------------------------------------------------- threads
 
@@ -169,6 +216,8 @@ class BeaconProcessor:
         while not self._stop.is_set():
             single, batch = self._next_work()
             if single is None and batch is None:
+                if self._resolve_oldest():
+                    continue
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
                 continue

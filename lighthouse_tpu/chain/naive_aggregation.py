@@ -1,0 +1,125 @@
+"""Naive aggregation pools: self-built aggregates from gossip singles.
+
+Parity surface: /root/reference/beacon_node/beacon_chain/src/
+naive_aggregation_pool.rs — the BN aggregates every verified single-bit
+attestation (and sync-committee message) it sees on its subnets, so that
+when a local validator turns out to be an aggregator it can serve
+`aggregate_attestation` / `sync_committee_contribution` without having seen
+someone else's aggregate. Aggregation here is signature point addition over
+the active BLS backend's G2 math; slots are pruned once stale."""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+from ..crypto import bls
+from ..crypto.bls381 import curve as cv
+
+SLOT_RETENTION = 3
+
+
+def _sig_point(sig_bytes: bytes):
+    return bls.Signature.deserialize(bytes(sig_bytes))
+
+
+def _agg_bytes(points) -> bytes:
+    acc = None
+    fake = bls.get_backend().name == "fake"
+    if fake:
+        # fake backend: signatures aren't points; carry the first one through
+        return points[0].serialize() if points else bls.INFINITY_SIGNATURE_BYTES
+    for s in points:
+        acc = cv.g2_add(acc, s.point)
+    return bls.Signature(acc).serialize()
+
+
+class NaiveAttestationPool:
+    """data_root -> aggregated bits + signature per slot."""
+
+    def __init__(self, spec):
+        self.spec = spec
+        # slot -> data_root -> (data, bits list, [sig objects])
+        self._by_slot: dict[int, dict] = defaultdict(dict)
+
+    def insert(self, att, types) -> bool:
+        """Insert a verified single attestation; returns True if it added
+        new bits."""
+        slot = int(att.data.slot)
+        key = types.AttestationData.hash_tree_root(att.data)
+        bucket = self._by_slot[slot].get(key)
+        bits = list(att.aggregation_bits)
+        sig = _sig_point(att.signature)
+        if bucket is None:
+            self._by_slot[slot][key] = (att.data, bits, [sig])
+            return True
+        _data, cur, sigs = bucket
+        new = [b and not c for b, c in zip(bits, cur)]
+        if not any(new):
+            return False
+        merged = [b or c for b, c in zip(bits, cur)]
+        self._by_slot[slot][key] = (_data, merged, sigs + [sig])
+        return True
+
+    def get_aggregate(self, slot: int, data_root: bytes, types):
+        bucket = self._by_slot.get(slot, {}).get(data_root)
+        if bucket is None:
+            return None
+        data, bits, sigs = bucket
+        return types.Attestation.make(
+            aggregation_bits=bits, data=data, signature=_agg_bytes(sigs)
+        )
+
+    def prune(self, current_slot: int) -> None:
+        for s in list(self._by_slot):
+            if s + SLOT_RETENTION < current_slot:
+                del self._by_slot[s]
+
+
+class NaiveSyncContributionPool:
+    """(slot, root, subcommittee) -> aggregated sync contribution."""
+
+    def __init__(self, spec):
+        self.spec = spec
+        self._by_slot: dict[int, dict] = defaultdict(dict)
+
+    def insert(self, slot: int, beacon_block_root: bytes, subcommittee_index: int,
+               index_in_subcommittee: int, signature_bytes: bytes) -> bool:
+        key = (bytes(beacon_block_root), subcommittee_index)
+        size = (
+            self.spec.preset.SYNC_COMMITTEE_SIZE
+            // self.spec.sync_committee_subnet_count
+        )
+        bucket = self._by_slot[slot].get(key)
+        sig = _sig_point(signature_bytes)
+        if bucket is None:
+            bits = [False] * size
+            bits[index_in_subcommittee] = True
+            self._by_slot[slot][key] = (bits, [sig])
+            return True
+        bits, sigs = bucket
+        if bits[index_in_subcommittee]:
+            return False
+        bits[index_in_subcommittee] = True
+        sigs.append(sig)
+        return True
+
+    def get_contribution(self, slot: int, beacon_block_root: bytes,
+                         subcommittee_index: int, types):
+        bucket = self._by_slot.get(slot, {}).get(
+            (bytes(beacon_block_root), subcommittee_index)
+        )
+        if bucket is None:
+            return None
+        bits, sigs = bucket
+        return types.SyncCommitteeContribution.make(
+            slot=slot,
+            beacon_block_root=beacon_block_root,
+            subcommittee_index=subcommittee_index,
+            aggregation_bits=bits,
+            signature=_agg_bytes(sigs),
+        )
+
+    def prune(self, current_slot: int) -> None:
+        for s in list(self._by_slot):
+            if s + SLOT_RETENTION < current_slot:
+                del self._by_slot[s]

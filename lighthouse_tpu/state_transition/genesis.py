@@ -70,13 +70,18 @@ def _interop_genesis_state(
         block_hash=eth1_block_hash,
     )
     state.eth1_deposit_index = len(keypairs)
-    body = types.BeaconBlockBody.default()
+    # The genesis header commits to the GENESIS FORK's empty body (a chain
+    # starting at deneb has a deneb body_root here, exactly like the spec's
+    # initialize_beacon_state_from_eth1 instantiated at that fork) — this
+    # keeps hash(genesis block) == hash(header), which backfill relies on.
+    genesis_types = spec_types(spec.preset, fork)
+    body = genesis_types.BeaconBlockBody.default()
     state.latest_block_header = types.BeaconBlockHeader.make(
         slot=0,
         proposer_index=0,
         parent_root=b"\x00" * 32,
         state_root=b"\x00" * 32,
-        body_root=types.BeaconBlockBody.hash_tree_root(body),
+        body_root=genesis_types.BeaconBlockBody.hash_tree_root(body),
     )
     state.randao_mixes = [eth1_block_hash] * spec.preset.EPOCHS_PER_HISTORICAL_VECTOR
 

@@ -53,6 +53,26 @@ def block_proposal_set(state, spec: ChainSpec, types, signed_block, get_pubkey, 
     return bls.SignatureSet(_sig(signed_block.signature), (pk,), message)
 
 
+def historical_block_proposal_set(
+    spec: ChainSpec, types, signed_block, genesis_validators_root: bytes, get_pubkey
+):
+    """Proposer signature set for a block BELOW the current anchor — no
+    historical state needed: the domain is derived from the fork schedule +
+    genesis_validators_root alone, and the pubkey from the (append-only)
+    registry. This is what backfill batch verification runs on
+    (/root/reference/beacon_node/beacon_chain/src/historical_blocks.rs:189)."""
+    block = signed_block.message
+    epoch = h.compute_epoch_at_slot(block.slot, spec)
+    fork_version = spec.fork_version(spec.fork_name_at_epoch(epoch))
+    domain = h.compute_domain(
+        DOMAIN_BEACON_PROPOSER, fork_version, genesis_validators_root
+    )
+    block_root = types.BeaconBlock.hash_tree_root(block)
+    message = h.compute_signing_root_from_root(block_root, domain)
+    pk = get_pubkey(block.proposer_index)
+    return bls.SignatureSet(_sig(signed_block.signature), (pk,), message)
+
+
 def block_header_set(state, spec: ChainSpec, types, signed_header, get_pubkey):
     hdr = signed_header.message
     domain = h.get_domain(

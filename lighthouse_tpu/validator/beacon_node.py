@@ -36,6 +36,13 @@ class ProposerDuty:
     slot: int
 
 
+@dataclass
+class SyncDuty:
+    pubkey: bytes
+    validator_index: int
+    positions: list   # [(subcommittee_index, index_in_subcommittee)]
+
+
 class BeaconNodeError(Exception):
     pass
 
@@ -159,6 +166,83 @@ class InProcessBeaconNode:
         for att, indices in verified:
             self.chain.apply_attestation_to_fork_choice(att, indices)
         return len(verified)
+
+    def aggregate_attestation(self, slot: int, data_root: bytes):
+        """Serve an aggregate from the naive aggregation pool
+        (GET /eth/v1/validator/aggregate_attestation)."""
+        if not self.healthy:
+            raise BeaconNodeError("node down")
+        types = types_for_slot(self.chain.spec, slot)
+        agg = self.chain.naive_attestation_pool.get_aggregate(slot, data_root, types)
+        if agg is None:
+            raise BeaconNodeError("no aggregate known")
+        return agg
+
+    def publish_aggregates(self, signed_aggregates) -> int:
+        if not self.healthy:
+            raise BeaconNodeError("node down")
+        verified = self.chain.verify_aggregated_attestations(signed_aggregates)
+        for att, indices in verified:
+            self.chain.apply_attestation_to_fork_choice(att, indices)
+        return len(verified)
+
+    # -- sync committee flow ----------------------------------------------
+
+    def sync_duties(self, epoch: int, indices: list[int]) -> list["SyncDuty"]:
+        """Current-period sync-committee membership for the given validators
+        (POST /eth/v1/validator/duties/sync)."""
+        if not self.healthy:
+            raise BeaconNodeError("node down")
+        duties = []
+        state = self.chain.head_state()
+        if not hasattr(state, "current_sync_committee"):
+            return duties
+        for vi in indices:
+            positions = self.chain.sync_subcommittee_positions(vi)
+            if positions:
+                duties.append(
+                    SyncDuty(
+                        pubkey=bytes(state.validators[vi].pubkey),
+                        validator_index=vi,
+                        positions=positions,
+                    )
+                )
+        return duties
+
+    def publish_sync_messages(self, msgs) -> int:
+        if not self.healthy:
+            raise BeaconNodeError("node down")
+        return self.chain.process_sync_committee_messages(msgs)
+
+    def sync_committee_contribution(self, slot: int, subcommittee_index: int, beacon_block_root: bytes):
+        if not self.healthy:
+            raise BeaconNodeError("node down")
+        types = types_for_slot(self.chain.spec, slot)
+        contrib = self.chain.naive_sync_pool.get_contribution(
+            slot, beacon_block_root, subcommittee_index, types
+        )
+        if contrib is None:
+            raise BeaconNodeError("no contribution known")
+        return contrib
+
+    def publish_contributions(self, signed_contributions) -> int:
+        if not self.healthy:
+            raise BeaconNodeError("node down")
+        n = 0
+        for sc in signed_contributions:
+            if self.chain.verify_signed_contribution(sc):
+                n += 1
+        return n
+
+    # -- preparation ------------------------------------------------------
+
+    def prepare_beacon_proposer(self, preparations) -> int:
+        """Record fee recipients (POST /eth/v1/validator/prepare_beacon_proposer)."""
+        if not self.healthy:
+            raise BeaconNodeError("node down")
+        for p in preparations:
+            self.chain.proposer_preparations[p["validator_index"]] = p["fee_recipient"]
+        return len(preparations)
 
     # -- blocks ----------------------------------------------------------
 

@@ -1,0 +1,202 @@
+"""Validator-client keymanager HTTP API.
+
+Parity surface: /root/reference/validator_client/src/http_api/ — the
+standard keymanager endpoints:
+  GET/POST/DELETE /eth/v1/keystores       (local keystore management,
+                                           EIP-2335 import, slashing-
+                                           protection export on delete)
+  GET/POST/DELETE /eth/v1/remotekeys      (web3signer-backed keys)
+  GET/POST       /eth/v1/validator/{pubkey}/feerecipient
+  GET            /lighthouse/version
+Auth: a bearer api-token (the reference writes api-token.txt; here the
+token is generated per server and exposed as `.api_token`)."""
+
+from __future__ import annotations
+
+import json
+import re
+import secrets
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..crypto import bls
+from ..crypto.keystore import decrypt_keystore
+from .web3signer import Web3Signer
+
+
+class KeymanagerServer:
+    def __init__(self, store, preparation=None, host="127.0.0.1", port=0):
+        self.store = store
+        self.preparation = preparation
+        self.api_token = "api-token-" + secrets.token_hex(16)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            # -------------------------------------------------- plumbing
+
+            def _authed(self) -> bool:
+                auth = self.headers.get("Authorization", "")
+                return auth == f"Bearer {outer.api_token}"
+
+            def _json(self, payload, code=200):
+                out = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+
+            def _body(self):
+                ln = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(ln).decode()) if ln else {}
+
+            def _route(self, method):
+                if not self._authed():
+                    return self._json({"message": "unauthorized"}, 401)
+                path = self.path.split("?")[0]
+                try:
+                    if path == "/eth/v1/keystores":
+                        return getattr(outer, f"{method}_keystores")(self)
+                    if path == "/eth/v1/remotekeys":
+                        return getattr(outer, f"{method}_remotekeys")(self)
+                    m = re.match(r"^/eth/v1/validator/0x([0-9a-f]{96})/feerecipient$", path)
+                    if m:
+                        return getattr(outer, f"{method}_feerecipient")(
+                            self, bytes.fromhex(m.group(1))
+                        )
+                    if path == "/lighthouse/version" and method == "get":
+                        return self._json({"data": {"version": "lighthouse-tpu-vc"}})
+                except AttributeError:
+                    pass
+                except Exception as e:  # noqa: BLE001
+                    return self._json({"message": str(e)}, 500)
+                return self._json({"message": "not found"}, 404)
+
+            def do_GET(self):
+                self._route("get")
+
+            def do_POST(self):
+                self._route("post")
+
+            def do_DELETE(self):
+                self._route("delete")
+
+        self.server = ThreadingHTTPServer((host, port), Handler)
+        self.url = f"http://{host}:{self.server.server_address[1]}"
+        self._thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+        self._thread.start()
+        self._remote_keys: set[bytes] = set()
+
+    def close(self):
+        self.server.shutdown()
+
+    # ---------------------------------------------------------- keystores
+
+    def get_keystores(self, rq):
+        data = [
+            {
+                "validating_pubkey": "0x" + pk.hex(),
+                "derivation_path": "",
+                "readonly": pk in self._remote_keys,
+            }
+            for pk in self.store.voting_pubkeys()
+        ]
+        rq._json({"data": data})
+
+    def post_keystores(self, rq):
+        body = rq._body()
+        statuses = []
+        for ks_json, password in zip(body.get("keystores", []), body.get("passwords", [])):
+            try:
+                ks = json.loads(ks_json) if isinstance(ks_json, str) else ks_json
+                sk_bytes = decrypt_keystore(ks, password)
+                sk = bls.SecretKey(int.from_bytes(sk_bytes, "big"))
+                self.store.add_validator(sk)
+                statuses.append({"status": "imported"})
+            except Exception as e:  # noqa: BLE001
+                statuses.append({"status": "error", "message": str(e)})
+        rq._json({"data": statuses})
+
+    def delete_keystores(self, rq):
+        body = rq._body()
+        statuses = []
+        wanted = {bytes.fromhex(p[2:]) for p in body.get("pubkeys", [])}
+        full = self.store.slashing_db.export_interchange(
+            self.store.genesis_validators_root
+        )
+        full["data"] = [
+            rec
+            for rec in full.get("data", [])
+            if bytes.fromhex(rec["pubkey"][2:]) in wanted
+        ]
+        for pk_hex in body.get("pubkeys", []):
+            pk = bytes.fromhex(pk_hex[2:])
+            if pk in self.store.validators:
+                del self.store.validators[pk]
+                statuses.append({"status": "deleted"})
+            else:
+                statuses.append({"status": "not_found"})
+        rq._json({"data": statuses, "slashing_protection": json.dumps(full)})
+
+    # ---------------------------------------------------------- remotekeys
+
+    def get_remotekeys(self, rq):
+        data = [
+            {"pubkey": "0x" + pk.hex(), "url": getattr(
+                self.store.validators[pk].signer, "url", ""
+            ), "readonly": False}
+            for pk in self.store.voting_pubkeys()
+            if pk in self._remote_keys
+        ]
+        rq._json({"data": data})
+
+    def post_remotekeys(self, rq):
+        from .validator_store import InitializedValidator
+
+        body = rq._body()
+        statuses = []
+        for item in body.get("remote_keys", []):
+            pk = bytes.fromhex(item["pubkey"][2:])
+            signer = Web3Signer(item["url"], pk)
+            self.store.slashing_db.register_validator(pk)
+            self.store.validators[pk] = InitializedValidator(pubkey=pk, signer=signer)
+            self._remote_keys.add(pk)
+            statuses.append({"status": "imported"})
+        rq._json({"data": statuses})
+
+    def delete_remotekeys(self, rq):
+        body = rq._body()
+        statuses = []
+        for pk_hex in body.get("pubkeys", []):
+            pk = bytes.fromhex(pk_hex[2:])
+            if pk in self._remote_keys:
+                self._remote_keys.discard(pk)
+                self.store.validators.pop(pk, None)
+                statuses.append({"status": "deleted"})
+            else:
+                statuses.append({"status": "not_found"})
+        rq._json({"data": statuses})
+
+    # ---------------------------------------------------------- fee recipient
+
+    def get_feerecipient(self, rq, pk: bytes):
+        if self.preparation is None:
+            return rq._json({"message": "no preparation service"}, 500)
+        addr = self.preparation.fee_recipients.get(
+            pk, self.preparation.default_fee_recipient
+        )
+        rq._json(
+            {"data": {"pubkey": "0x" + pk.hex(), "ethaddress": "0x" + addr.hex()}}
+        )
+
+    def post_feerecipient(self, rq, pk: bytes):
+        if self.preparation is None:
+            return rq._json({"message": "no preparation service"}, 500)
+        body = rq._body()
+        self.preparation.set_fee_recipient(
+            pk, bytes.fromhex(body["ethaddress"][2:])
+        )
+        rq._json({}, 202)

@@ -111,6 +111,173 @@ class AttestationService:
         return produced
 
 
+def _is_aggregator(selection_proof: bytes, committee_len: int, target: int) -> bool:
+    """Spec is_aggregator: hash(proof)[0:8] LE mod max(1, len // target) == 0."""
+    import hashlib
+
+    modulo = max(1, committee_len // target)
+    return int.from_bytes(hashlib.sha256(selection_proof).digest()[:8], "little") % modulo == 0
+
+
+@dataclass
+class AggregationService:
+    """The slot+2/3 aggregate phase of attestation_service.rs:493 — for each
+    duty where we are the selected aggregator, fetch the naive-pool
+    aggregate from the BN, wrap and sign AggregateAndProof, publish."""
+
+    spec: ChainSpec
+    store: ValidatorStore
+    duties: DutiesService
+    nodes: BeaconNodeFallback
+    published: int = 0
+
+    def aggregate(self, slot: int) -> int:
+        duties = self.duties.attesters_at_slot(slot)
+        if not duties:
+            return 0
+        types = types_for_slot(self.spec, slot)
+        count = 0
+        for d in duties:
+            try:
+                proof = self.store.sign_selection_proof(d.pubkey, slot)
+            except (SlashingProtectionError, DoppelgangerProtected):
+                continue
+            if not _is_aggregator(
+                proof, d.committee_length, self.spec.target_aggregators_per_committee
+            ):
+                continue
+            data = self.nodes.first_success("attestation_data", slot, d.committee_index)
+            data_root = types.AttestationData.hash_tree_root(data)
+            try:
+                agg = self.nodes.first_success("aggregate_attestation", slot, data_root)
+            except Exception:
+                continue
+            msg = types.AggregateAndProof.make(
+                aggregator_index=d.validator_index,
+                aggregate=agg,
+                selection_proof=proof,
+            )
+            sig = self.store.sign_aggregate_and_proof(d.pubkey, msg, types)
+            signed = types.SignedAggregateAndProof.make(message=msg, signature=sig)
+            count += self.nodes.first_success("publish_aggregates", [signed]) or 0
+        self.published += count
+        return count
+
+
+@dataclass
+class SyncCommitteeService:
+    """Sync-committee duty flow (sync_committee_service.rs): each slot sign
+    the head root as a SyncCommitteeMessage per duty; at the aggregation
+    phase produce SignedContributionAndProof for selected aggregators."""
+
+    spec: ChainSpec
+    store: ValidatorStore
+    nodes: BeaconNodeFallback
+    duties: list = field(default_factory=list)     # [SyncDuty]
+    published_messages: int = 0
+    published_contributions: int = 0
+
+    def poll(self, epoch: int) -> None:
+        indices = [
+            v.index for v in self.store.validators.values() if v.index is not None
+        ]
+        my_pubkeys = set(self.store.voting_pubkeys())
+        duties = self.nodes.first_success("sync_duties", epoch, indices)
+        self.duties = [d for d in duties if d.pubkey in my_pubkeys]
+
+    def sign_and_publish(self, slot: int, head_root: bytes) -> int:
+        if not self.duties:
+            return 0
+        types = types_for_slot(self.spec, slot)
+        msgs = []
+        for d in self.duties:
+            try:
+                sig = self.store.sign_sync_committee_message(d.pubkey, head_root)
+            except DoppelgangerProtected:
+                continue
+            msgs.append(
+                types.SyncCommitteeMessage.make(
+                    slot=slot,
+                    beacon_block_root=head_root,
+                    validator_index=d.validator_index,
+                    signature=sig,
+                )
+            )
+        if not msgs:
+            return 0
+        n = self.nodes.first_success("publish_sync_messages", msgs)
+        self.published_messages += n
+        return n
+
+    def aggregate(self, slot: int, head_root: bytes) -> int:
+        if not self.duties:
+            return 0
+        types = types_for_slot(self.spec, slot)
+        sub_size = (
+            self.spec.preset.SYNC_COMMITTEE_SIZE
+            // self.spec.sync_committee_subnet_count
+        )
+        count = 0
+        for d in self.duties:
+            for sub_idx in sorted({s for s, _ in d.positions}):
+                try:
+                    proof = self.store.sign_sync_selection_proof(
+                        d.pubkey, slot, sub_idx, types
+                    )
+                except DoppelgangerProtected:
+                    continue
+                if not _is_aggregator(
+                    proof, sub_size, self.spec.target_aggregators_per_sync_subcommittee
+                ):
+                    continue
+                try:
+                    contrib = self.nodes.first_success(
+                        "sync_committee_contribution", slot, sub_idx, head_root
+                    )
+                except Exception:
+                    continue
+                msg = types.ContributionAndProof.make(
+                    aggregator_index=d.validator_index,
+                    contribution=contrib,
+                    selection_proof=proof,
+                )
+                sig = self.store.sign_contribution_and_proof(d.pubkey, msg, types)
+                signed = types.SignedContributionAndProof.make(message=msg, signature=sig)
+                count += self.nodes.first_success("publish_contributions", [signed])
+        self.published_contributions += count
+        return count
+
+
+@dataclass
+class PreparationService:
+    """Proposer preparation (preparation_service.rs): push fee recipients to
+    the BN every epoch so payload building can attribute fees."""
+
+    spec: ChainSpec
+    store: ValidatorStore
+    nodes: BeaconNodeFallback
+    fee_recipients: dict = field(default_factory=dict)   # pubkey -> address(20B)
+    default_fee_recipient: bytes = b"\x00" * 20
+
+    def set_fee_recipient(self, pubkey: bytes, address: bytes) -> None:
+        self.fee_recipients[pubkey] = address
+
+    def prepare(self, _epoch: int) -> int:
+        preparations = [
+            {
+                "validator_index": v.index,
+                "fee_recipient": self.fee_recipients.get(
+                    pk, self.default_fee_recipient
+                ),
+            }
+            for pk, v in self.store.validators.items()
+            if v.index is not None
+        ]
+        if not preparations:
+            return 0
+        return self.nodes.first_success("prepare_beacon_proposer", preparations)
+
+
 @dataclass
 class BlockService:
     spec: ChainSpec

@@ -135,6 +135,27 @@ class ValidatorStore:
         root = h.compute_signing_root_from_root(block_root, domain)
         return v.signer.sign(root).serialize()
 
+    def sign_sync_selection_proof(self, pubkey: bytes, slot: int, subcommittee_index: int, types) -> bytes:
+        from ..types.spec import DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF
+
+        v = self._validator(pubkey)
+        domain = self._domain(DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF)
+        data = types.SyncAggregatorSelectionData.make(
+            slot=slot, subcommittee_index=subcommittee_index
+        )
+        root = h.compute_signing_root(types.SyncAggregatorSelectionData, data, domain)
+        return v.signer.sign(root).serialize()
+
+    def sign_contribution_and_proof(self, pubkey: bytes, contrib_and_proof, types) -> bytes:
+        from ..types.spec import DOMAIN_CONTRIBUTION_AND_PROOF
+
+        v = self._validator(pubkey)
+        domain = self._domain(DOMAIN_CONTRIBUTION_AND_PROOF)
+        root = h.compute_signing_root(
+            types.ContributionAndProof, contrib_and_proof, domain
+        )
+        return v.signer.sign(root).serialize()
+
     def sign_voluntary_exit(self, pubkey: bytes, exit_msg, types) -> bytes:
         # exits are NOT slashable; no protection needed
         v = self.validators[pubkey]  # doppelganger does not block exits

@@ -62,3 +62,100 @@ def test_threaded_pump():
     finally:
         bp.stop()
     assert count[0] == 100
+
+
+def test_pipelined_batch_continuations():
+    """A runner returning (handle, continuation) keeps the pump pulling new
+    work while the batch is 'in flight'; continuations all resolve by idle."""
+    from lighthouse_tpu.chain.beacon_processor import (
+        BeaconProcessor,
+        BeaconProcessorConfig,
+        WorkItem,
+        WorkKind,
+    )
+
+    order = []
+
+    class SlowHandle:
+        def __init__(self, tag):
+            self.tag = tag
+
+        def result(self):
+            order.append(("resolve", self.tag))
+            return True
+
+    proc = BeaconProcessor(BeaconProcessorConfig(max_inflight=2, max_attestation_batch=1))
+    done = []
+
+    def mk_runner(tag):
+        def run_batch(payloads):
+            order.append(("submit", tag))
+            return SlowHandle(tag), lambda ok: done.append((tag, ok))
+
+        return run_batch
+
+    for i in range(5):
+        proc.submit(
+            WorkItem(kind=WorkKind.gossip_attestation, payload=i, run_batch=mk_runner(i))
+        )
+    proc.run_until_idle()
+    assert sorted(done) == [(i, True) for i in range(5)]
+    # pipelining: at least one later submit happened before an earlier resolve
+    first_resolve = order.index(("resolve", 0))
+    assert ("submit", 1) in order[:first_resolve]
+    assert proc.pipelined_batches == 5
+
+
+def test_chain_submit_attestation_batch_pipelined():
+    """End-to-end: chain.submit_attestation_batch returns a continuation the
+    processor resolves, applying fork-choice votes."""
+    import pytest
+    from lighthouse_tpu.chain.beacon_chain import BeaconChain
+    from lighthouse_tpu.chain.beacon_processor import (
+        BeaconProcessor,
+        WorkItem,
+        WorkKind,
+    )
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.state_transition.slot import types_for_slot
+    from lighthouse_tpu.testing.harness import StateHarness, clone_state
+    from lighthouse_tpu.types.spec import minimal_spec
+
+    bls.set_backend("fake")
+    spec = minimal_spec()
+    harness = StateHarness.new(spec, 64)
+    chain = BeaconChain(spec, clone_state(harness.state, spec))
+    slot = 1
+    signed, _ = harness.produce_block(slot, attestations=[], full_sync=False)
+    harness.apply_block(signed)
+    chain.slot_clock.set_slot(slot)
+    chain.per_slot_task()
+    chain.process_block(signed)
+    types = types_for_slot(spec, slot)
+    head_root = types.BeaconBlock.hash_tree_root(signed.message)
+    aggs = harness.build_attestations(clone_state(harness.state, spec), slot, head_root)
+    # split into single-bit attestations
+    singles = []
+    for agg in aggs:
+        n = len(agg.aggregation_bits)
+        for pos in range(n):
+            if agg.aggregation_bits[pos]:
+                bits = [p == pos for p in range(n)]
+                singles.append(
+                    types.Attestation.make(
+                        aggregation_bits=bits, data=agg.data, signature=agg.signature
+                    )
+                )
+    got = []
+    proc = BeaconProcessor()
+    proc.submit(
+        WorkItem(
+            kind=WorkKind.gossip_attestation,
+            payload=None,
+            run_batch=lambda _p: chain.submit_attestation_batch(
+                singles, on_done=got.extend
+            ),
+        )
+    )
+    proc.run_until_idle()
+    assert len(got) == len(singles)

@@ -1,0 +1,116 @@
+"""Keymanager HTTP API + web3signer signing route
+(validator_client/src/http_api + signing_method.rs Web3Signer)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.crypto.keystore import encrypt_keystore
+from lighthouse_tpu.types.spec import minimal_spec
+from lighthouse_tpu.validator.http_api import KeymanagerServer
+from lighthouse_tpu.validator.validator_store import ValidatorStore
+from lighthouse_tpu.validator.web3signer import MockWeb3SignerServer, Web3Signer
+
+
+@pytest.fixture(scope="module")
+def env():
+    bls.set_backend("python")
+    spec = minimal_spec()
+    store = ValidatorStore(spec, b"\x22" * 32)
+    prep = None
+    from lighthouse_tpu.validator.beacon_node import BeaconNodeFallback
+    from lighthouse_tpu.validator.services import PreparationService
+
+    prep = PreparationService(spec, store, BeaconNodeFallback([]))
+    km = KeymanagerServer(store, preparation=prep)
+    yield store, km, prep
+    km.close()
+
+
+def _call(km, method, path, body=None, token=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        km.url + path,
+        data=data,
+        method=method,
+        headers={
+            "Authorization": f"Bearer {token if token is not None else km.api_token}",
+            "Content-Type": "application/json",
+        },
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.status, json.loads(resp.read().decode() or "{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode() or "{}")
+
+
+def test_auth_required(env):
+    store, km, prep = env
+    code, _ = _call(km, "GET", "/eth/v1/keystores", token="wrong")
+    assert code == 401
+
+
+def test_keystore_import_list_delete(env):
+    store, km, prep = env
+    kp = bls.interop_keypair(0)
+    ks = encrypt_keystore(
+        kp.sk.serialize(), "passw0rd", kdf_function="pbkdf2"
+    )
+    code, out = _call(
+        km, "POST", "/eth/v1/keystores",
+        {"keystores": [ks], "passwords": ["passw0rd"]},
+    )
+    assert code == 200 and out["data"][0]["status"] == "imported"
+    pk_hex = "0x" + kp.pk.serialize().hex()
+
+    code, out = _call(km, "GET", "/eth/v1/keystores")
+    assert any(k["validating_pubkey"] == pk_hex for k in out["data"])
+
+    code, out = _call(km, "DELETE", "/eth/v1/keystores", {"pubkeys": [pk_hex]})
+    assert out["data"][0]["status"] == "deleted"
+    sp = json.loads(out["slashing_protection"])
+    assert "metadata" in sp
+    code, out = _call(km, "GET", "/eth/v1/keystores")
+    assert not any(k["validating_pubkey"] == pk_hex for k in out["data"])
+
+
+def test_remotekeys_and_web3signer_roundtrip(env):
+    store, km, prep = env
+    kp = bls.interop_keypair(1)
+    mock = MockWeb3SignerServer([kp])
+    try:
+        pk_hex = "0x" + kp.pk.serialize().hex()
+        code, out = _call(
+            km, "POST", "/eth/v1/remotekeys",
+            {"remote_keys": [{"pubkey": pk_hex, "url": mock.url}]},
+        )
+        assert out["data"][0]["status"] == "imported"
+        code, out = _call(km, "GET", "/eth/v1/remotekeys")
+        assert out["data"][0]["pubkey"] == pk_hex
+
+        # signing through the store routes over HTTP to the mock signer
+        root = b"\x07" * 32
+        sig = store.validators[kp.pk.serialize()].signer.sign(root)
+        assert bls.verify(kp.pk, root, sig)
+
+        code, out = _call(km, "DELETE", "/eth/v1/remotekeys", {"pubkeys": [pk_hex]})
+        assert out["data"][0]["status"] == "deleted"
+    finally:
+        mock.close()
+
+
+def test_fee_recipient_endpoints(env):
+    store, km, prep = env
+    kp = bls.interop_keypair(2)
+    store.add_validator(kp.sk, index=2)
+    pk_hex = "0x" + kp.pk.serialize().hex()
+    code, out = _call(
+        km, "POST", f"/eth/v1/validator/{pk_hex}/feerecipient",
+        {"ethaddress": "0x" + "ab" * 20},
+    )
+    assert code == 202
+    code, out = _call(km, "GET", f"/eth/v1/validator/{pk_hex}/feerecipient")
+    assert out["data"]["ethaddress"] == "0x" + "ab" * 20
