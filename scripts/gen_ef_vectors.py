@@ -1,0 +1,307 @@
+#!/usr/bin/env python
+"""Generate regression vectors in the official consensus-spec-tests layout.
+
+Usage: python scripts/gen_ef_vectors.py [output_root]
+
+Writes minimal-preset vectors under tests/ef/vectors/ in the exact
+directory/file format of ethereum/consensus-spec-tests
+({config}/{fork}/{runner}/{handler}/{suite}/{case}/...), generated from
+this implementation with the pure-python crypto backend. They are FROZEN
+REGRESSION vectors (this environment has no egress to fetch the official
+tarballs): they pin current behavior so refactors — in particular the
+TPU-kernel rewrites of the crypto — are diffed against a known-good state.
+Official vectors dropped in the same root run through the same harness
+(lighthouse_tpu/testing/ef_runner.py).
+"""
+
+from __future__ import annotations
+
+import random
+import shutil
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import yaml
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.network import snappy
+from lighthouse_tpu.state_transition.slot import process_slots, types_for_slot
+from lighthouse_tpu.testing.harness import StateHarness, clone_state
+from lighthouse_tpu.types.helpers import compute_shuffled_index
+from lighthouse_tpu.types.spec import minimal_spec
+
+CONFIG = "minimal"
+FORK = "deneb"   # minimal_spec runs all forks from genesis; containers are deneb
+VALIDATORS = 64
+
+
+def w_ssz(case: Path, name: str, data: bytes) -> None:
+    case.mkdir(parents=True, exist_ok=True)
+    (case / f"{name}.ssz_snappy").write_bytes(snappy.compress(data))
+
+
+def w_yaml(case: Path, name: str, obj) -> None:
+    case.mkdir(parents=True, exist_ok=True)
+    (case / f"{name}.yaml").write_text(yaml.safe_dump(obj))
+
+
+def gen_sanity_and_ops(root: Path) -> None:
+    spec = minimal_spec()
+    harness = StateHarness.new(spec, VALIDATORS)
+    types = types_for_slot(spec, 0)
+    S = types.BeaconState
+
+    # ---- sanity/slots
+    for n in (1, spec.preset.SLOTS_PER_EPOCH):
+        case = root / CONFIG / FORK / "sanity" / "slots" / "pyspec_tests" / f"slots_{n}"
+        pre = clone_state(harness.state, spec)
+        w_ssz(case, "pre", S.serialize(pre))
+        w_yaml(case, "slots", n)
+        post = clone_state(pre, spec)
+        process_slots(post, spec, post.slot + n)
+        w_ssz(case, "post", S.serialize(post))
+
+    # ---- sanity/blocks: extend a chain, dump block cases with pre/post
+    pending = []
+    for i in range(3):
+        slot = harness.state.slot + 1
+        pre = clone_state(harness.state, spec)
+        signed, post = harness.produce_block(slot, attestations=pending, full_sync=True)
+        harness.apply_block(signed)
+        head_root = types.BeaconBlock.hash_tree_root(signed.message)
+        pending = harness.build_attestations(
+            clone_state(harness.state, spec), slot, head_root
+        )
+        case = (
+            root / CONFIG / FORK / "sanity" / "blocks" / "pyspec_tests" / f"block_{i}"
+        )
+        w_ssz(case, "pre", S.serialize(pre))
+        w_yaml(case, "meta", {"blocks_count": 1})
+        w_ssz(case, "blocks_0", types.SignedBeaconBlock.serialize(signed))
+        w_ssz(case, "post", S.serialize(harness.state))
+
+    # invalid-block case: bad state root => no post
+    slot = harness.state.slot + 1
+    signed, _post = harness.produce_block(slot, attestations=pending, full_sync=True)
+    bad_block = signed.message.copy_with(state_root=b"\xde" * 32)
+    bad = types.SignedBeaconBlock.make(message=bad_block, signature=signed.signature)
+    case = root / CONFIG / FORK / "sanity" / "blocks" / "pyspec_tests" / "invalid_state_root"
+    w_ssz(case, "pre", S.serialize(harness.state))
+    w_yaml(case, "meta", {"blocks_count": 1})
+    w_ssz(case, "blocks_0", types.SignedBeaconBlock.serialize(bad))
+
+    # ---- operations/attestation from the pending set
+    st = clone_state(harness.state, spec)
+    process_slots(st, spec, st.slot + 1)
+    for i, att in enumerate(pending[:2]):
+        case = (
+            root / CONFIG / FORK / "operations" / "attestation" / "pyspec_tests" / f"att_{i}"
+        )
+        pre = clone_state(st, spec)
+        w_ssz(case, "pre", S.serialize(pre))
+        w_ssz(case, "attestation", types.Attestation.serialize(att))
+        from lighthouse_tpu.testing.ef_runner import _op_attestation
+
+        post = clone_state(pre, spec)
+        _op_attestation(post, spec, types, att, spec.fork_name_at_slot(post.slot))
+        w_ssz(case, "post", S.serialize(post))
+
+    # invalid attestation (future target) => no post
+    bad_att_data = pending[0].data.copy_with(slot=pending[0].data.slot + 1000)
+    bad_att = pending[0].copy_with(data=bad_att_data)
+    case = root / CONFIG / FORK / "operations" / "attestation" / "pyspec_tests" / "invalid_future"
+    w_ssz(case, "pre", S.serialize(st))
+    w_ssz(case, "attestation", types.Attestation.serialize(bad_att))
+
+    # ---- epoch_processing on an epoch-boundary state
+    st2 = clone_state(harness.state, spec)
+    target = (st2.slot // spec.preset.SLOTS_PER_EPOCH + 1) * spec.preset.SLOTS_PER_EPOCH
+    process_slots(st2, spec, target - 1)
+    from lighthouse_tpu.testing.ef_runner import EPOCH_RUNNERS
+    from lighthouse_tpu.types.spec import ForkName
+
+    for handler in (
+        "justification_and_finalization", "inactivity_updates",
+        "rewards_and_penalties", "registry_updates", "slashings",
+        "effective_balance_updates", "eth1_data_reset", "slashings_reset",
+        "randao_mixes_reset", "historical_summaries_update",
+        "participation_flag_updates", "sync_committee_updates",
+    ):
+        case = (
+            root / CONFIG / FORK / "epoch_processing" / handler / "pyspec_tests" / "boundary"
+        )
+        pre = clone_state(st2, spec)
+        w_ssz(case, "pre", S.serialize(pre))
+        post = clone_state(pre, spec)
+        EPOCH_RUNNERS[handler](post, spec, types, ForkName[FORK])
+        w_ssz(case, "post", S.serialize(post))
+
+    # ---- ssz_static for a few containers
+    samples = {
+        "AttestationData": pending[0].data,
+        "Attestation": pending[0],
+        "BeaconBlockHeader": harness.state.latest_block_header,
+        "Checkpoint": harness.state.finalized_checkpoint,
+        "Validator": harness.state.validators[0],
+        "BeaconState": harness.state,
+    }
+    for name, value in samples.items():
+        ctype = getattr(types, name)
+        case = (
+            root / CONFIG / FORK / "ssz_static" / name / "ssz_random" / "case_0"
+        )
+        w_ssz(case, "serialized", ctype.serialize(value))
+        w_yaml(case, "roots", {"root": "0x" + ctype.hash_tree_root(value).hex()})
+
+    # ---- shuffling
+    rng = random.Random(0x5EED)
+    for i in range(2):
+        seed = bytes(rng.randrange(256) for _ in range(32))
+        count = 64
+        rounds = spec.preset.SHUFFLE_ROUND_COUNT
+        mapping = [compute_shuffled_index(j, count, seed, rounds) for j in range(count)]
+        case = (
+            root / CONFIG / FORK / "shuffling" / "core" / "shuffle" / f"shuffle_{i}"
+        )
+        w_yaml(
+            case, "mapping",
+            {"seed": "0x" + seed.hex(), "count": count, "mapping": mapping},
+        )
+
+
+def gen_bls(root: Path) -> None:
+    rng = random.Random(0xB1)
+    from lighthouse_tpu.crypto.bls381.constants import R
+
+    def case_dir(handler, name):
+        return root / "general" / "phase0" / "bls" / handler / "bls_tests" / name
+
+    sks = [bls.SecretKey(rng.randrange(1, R)) for _ in range(4)]
+    msgs = [bytes([i]) * 32 for i in range(4)]
+
+    # sign + verify
+    for i, (sk, msg) in enumerate(zip(sks, msgs)):
+        sig = bls.sign(sk, msg)
+        w_yaml(
+            case_dir("sign", f"sign_{i}"), "data",
+            {
+                "input": {"privkey": hex(sk.scalar), "message": "0x" + msg.hex()},
+                "output": "0x" + sig.serialize().hex(),
+            },
+        )
+        w_yaml(
+            case_dir("verify", f"verify_ok_{i}"), "data",
+            {
+                "input": {
+                    "pubkey": "0x" + sk.public_key().serialize().hex(),
+                    "message": "0x" + msg.hex(),
+                    "signature": "0x" + sig.serialize().hex(),
+                },
+                "output": True,
+            },
+        )
+    # wrong-message verify
+    sig0 = bls.sign(sks[0], msgs[0])
+    w_yaml(
+        case_dir("verify", "verify_wrong_msg"), "data",
+        {
+            "input": {
+                "pubkey": "0x" + sks[0].public_key().serialize().hex(),
+                "message": "0x" + msgs[1].hex(),
+                "signature": "0x" + sig0.serialize().hex(),
+            },
+            "output": False,
+        },
+    )
+    # aggregate + fast_aggregate_verify
+    agg = bls.AggregateSignature.empty()
+    for sk in sks:
+        agg.add_assign(bls.sign(sk, msgs[0]))
+    w_yaml(
+        case_dir("aggregate", "agg_4"), "data",
+        {
+            "input": ["0x" + bls.sign(sk, msgs[0]).serialize().hex() for sk in sks],
+            "output": "0x" + agg.serialize().hex(),
+        },
+    )
+    w_yaml(
+        case_dir("fast_aggregate_verify", "fav_ok"), "data",
+        {
+            "input": {
+                "pubkeys": ["0x" + sk.public_key().serialize().hex() for sk in sks],
+                "message": "0x" + msgs[0].hex(),
+                "signature": "0x" + agg.serialize().hex(),
+            },
+            "output": True,
+        },
+    )
+    w_yaml(
+        case_dir("fast_aggregate_verify", "fav_missing_key"), "data",
+        {
+            "input": {
+                "pubkeys": ["0x" + sk.public_key().serialize().hex() for sk in sks[:3]],
+                "message": "0x" + msgs[0].hex(),
+                "signature": "0x" + agg.serialize().hex(),
+            },
+            "output": False,
+        },
+    )
+    # aggregate_verify (distinct messages)
+    agg2 = bls.AggregateSignature.empty()
+    for sk, m in zip(sks, msgs):
+        agg2.add_assign(bls.sign(sk, m))
+    w_yaml(
+        case_dir("aggregate_verify", "av_ok"), "data",
+        {
+            "input": {
+                "pubkeys": ["0x" + sk.public_key().serialize().hex() for sk in sks],
+                "messages": ["0x" + m.hex() for m in msgs],
+                "signature": "0x" + agg2.serialize().hex(),
+            },
+            "output": True,
+        },
+    )
+    # batch_verify
+    w_yaml(
+        case_dir("batch_verify", "bv_ok"), "data",
+        {
+            "input": {
+                "pubkeys": ["0x" + sk.public_key().serialize().hex() for sk in sks],
+                "messages": ["0x" + m.hex() for m in msgs],
+                "signatures": [
+                    "0x" + bls.sign(sk, m).serialize().hex() for sk, m in zip(sks, msgs)
+                ],
+            },
+            "output": True,
+        },
+    )
+    w_yaml(
+        case_dir("batch_verify", "bv_one_bad"), "data",
+        {
+            "input": {
+                "pubkeys": ["0x" + sk.public_key().serialize().hex() for sk in sks],
+                "messages": ["0x" + m.hex() for m in msgs],
+                "signatures": [
+                    "0x" + bls.sign(sk, msgs[0]).serialize().hex() for sk in sks
+                ],
+            },
+            "output": False,
+        },
+    )
+
+
+def main():
+    out = Path(sys.argv[1] if len(sys.argv) > 1 else "tests/ef/vectors")
+    bls.set_backend("python")
+    if out.exists():
+        shutil.rmtree(out)
+    gen_sanity_and_ops(out)
+    gen_bls(out)
+    n = sum(1 for _ in out.rglob("*") if _.is_file())
+    print(f"wrote {n} vector files under {out}")
+
+
+if __name__ == "__main__":
+    main()
