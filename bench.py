@@ -63,11 +63,16 @@ def build_fixture(rng):
         return lb.from_mont(x), lb.from_mont(y)
 
     t0 = time.time()
-    digs = jnp.asarray(co.scalars_to_digits(sks, 256))
     mul_g1 = jax.jit(lambda d: batched_gen_mul(co.g1_to_device(cv.G1_GEN), d, co.FQ_OPS))
-    xs, ys = mul_g1(digs)
-    xs = lb.unpack_batch(np.asarray(xs))
-    ys = lb.unpack_batch(np.asarray(ys))
+    # chunked device calls: one fixed-shape compile, bounded per-call size
+    # (very large single dispatches stall the remote-TPU tunnel)
+    CHUNK = 1024
+    xs, ys = [], []
+    for i in range(0, n_keys, CHUNK):
+        digs = jnp.asarray(co.scalars_to_digits(sks[i : i + CHUNK], 256))
+        cx, cy = mul_g1(digs)
+        xs.extend(lb.unpack_batch(np.asarray(cx)))
+        ys.extend(lb.unpack_batch(np.asarray(cy)))
     log(f"pubkey gen (device): {time.time()-t0:.1f}s")
 
     pks = [bls.PublicKey((x, y)) for x, y in zip(xs, ys)]

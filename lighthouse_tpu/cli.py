@@ -18,13 +18,23 @@ import time
 
 
 def _add_spec_arg(p):
-    p.add_argument("--spec", choices=["mainnet", "minimal"], default="mainnet")
+    p.add_argument(
+        "--spec", default="mainnet",
+        help="network name (mainnet/minimal/sepolia/holesky/gnosis) or a "
+             "path to a config.yaml",
+    )
 
 
 def _load_spec(args):
-    from .types.spec import mainnet_spec, minimal_spec
+    import os
 
-    return minimal_spec() if args.spec == "minimal" else mainnet_spec()
+    from .types.network_config import config_from_yaml, get_network_config
+
+    looks_like_path = os.sep in args.spec or args.spec.endswith((".yaml", ".yml"))
+    if looks_like_path and os.path.isfile(args.spec):
+        with open(args.spec) as f:
+            return config_from_yaml(f.read())
+    return get_network_config(args.spec)
 
 
 # ------------------------------------------------------------------ bn
@@ -239,6 +249,30 @@ def cmd_validator_create(args):
     return 0
 
 
+def cmd_boot_node(args):
+    """Standalone discovery bootstrap node (boot_node/src analog)."""
+    import json
+    import time as _time
+
+    from .network.discovery import NodeRecord, run_boot_node
+
+    svc = run_boot_node(host=args.host, port=args.port)
+    if args.advertise_ip:
+        svc.record = NodeRecord(
+            **{**svc.record.to_json(), "ip": args.advertise_ip}
+        )
+    print(json.dumps({"record": svc.record.to_json()}), flush=True)
+    try:
+        while True:
+            _time.sleep(5)
+            print(
+                json.dumps({"known_peers": len(svc.table)}), flush=True
+            )
+    except KeyboardInterrupt:
+        svc.close()
+    return 0
+
+
 def cmd_db_inspect(args):
     from .store.native_kv import NativeKVStore
     from .store.kv import Column
@@ -319,6 +353,16 @@ def build_parser() -> argparse.ArgumentParser:
     vcv.add_argument("--seed", default=None, help="hex seed (EIP-2333)")
     vcv.add_argument("--kdf-rounds", type=int, default=262144)
     vcv.set_defaults(fn=cmd_validator_create)
+
+    boot = sub.add_parser("boot-node", help="run a standalone discovery boot node")
+    boot.add_argument("--host", default="0.0.0.0")
+    boot.add_argument("--port", type=int, default=9000)
+    boot.add_argument(
+        "--advertise-ip", default=None,
+        help="routable address put in the published node record (required "
+             "when binding 0.0.0.0 — the bind address is not dialable)",
+    )
+    boot.set_defaults(fn=cmd_boot_node)
 
     db = sub.add_parser("db", help="inspect/compact a native store")
     db.add_argument("--db", required=True)

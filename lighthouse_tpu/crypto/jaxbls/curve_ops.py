@@ -197,13 +197,18 @@ def scalar_mul_windowed(p_jac, digits, ops, window: int = 4):
     # stack: tuple of coords, each (nt,) + batch + elem shape
     table_arr = tuple(jnp.stack([t[i] for t in table]) for i in range(3))
 
+    nt_range = jnp.arange(nt, dtype=jnp.uint32)
+
     def gather(digit):
-        # digit: (...,) -> select table entries per lane
+        # digit: (...,) -> select table entries per lane via a one-hot
+        # masked sum (16 elementwise mult-adds). A take_along_axis gather
+        # here made XLA:TPU compile times explode with batch size; the
+        # mask-select form lowers to plain VPU ops.
         def g(coord):
-            # coord: (nt, ...batch, *elem); digit broadcasts over elem dims
-            idx = digit[(None, ...) + (None,) * (coord.ndim - 1 - digit.ndim)]
-            idx = jnp.broadcast_to(idx, (1,) + coord.shape[1:])
-            return jnp.take_along_axis(coord, idx, axis=0)[0]
+            # coord: (nt, ...batch, *elem)
+            oh = digit[None, ...] == nt_range[(slice(None),) + (None,) * digit.ndim]
+            oh = oh[(...,) + (None,) * (coord.ndim - 1 - digit.ndim)]
+            return jnp.sum(coord * jnp.asarray(oh, coord.dtype), axis=0)
         return tuple(g(c) for c in table_arr)
 
     moved = jnp.moveaxis(digits, -1, 0)

@@ -116,6 +116,39 @@ class NetworkNode:
         host, port = other.host.listen_addr
         self.host.dial(host, port)
 
+    # ------------------------------------------------------------ discovery
+
+    def enable_discovery(self, boot_nodes=(), attnets: int = 0):
+        """Attach a UDP discovery endpoint advertising this node's TCP
+        listen address (discovery/mod.rs + ENR analog)."""
+        from .discovery import DiscoveryService, NodeRecord
+
+        host, port = self.host.listen_addr
+        rec = NodeRecord(
+            id=self.node_id, ip=host, tcp_port=port, udp_port=0,
+            fork_digest=self.fork_digest.hex(), attnets=attnets,
+        )
+        self.discovery = DiscoveryService(record=rec, host=host, boot_nodes=list(boot_nodes))
+        return self.discovery
+
+    def discover_and_dial(self, max_peers: int = 8) -> int:
+        """Bootstrap discovery and dial found peers not yet connected."""
+        if getattr(self, "discovery", None) is None:
+            return 0
+        self.discovery.bootstrap()
+        dialed = 0
+        for rec in list(self.discovery.table.values()):
+            if dialed >= max_peers:
+                break
+            if rec.id in self.host.connections or rec.tcp_port == 0:
+                continue
+            try:
+                self.host.dial(rec.ip, rec.tcp_port)
+                dialed += 1
+            except Exception:
+                continue
+        return dialed
+
     def _heartbeat_loop(self) -> None:
         while not self._hb_stop.wait(self.heartbeat_interval):
             try:

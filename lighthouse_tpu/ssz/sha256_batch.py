@@ -1,0 +1,109 @@
+"""Vectorized batched SHA-256 for merkleization.
+
+The reference leans on hand-tuned assembly sha256 (ethereum_hashing with
+SHA-NI) because tree hashing dominates state-root computation
+(/root/reference/consensus/cached_tree_hash + SURVEY.md §2.4). The
+TPU-native equivalent is DATA-PARALLEL hashing: every tree level hashes all
+its sibling pairs at once. This module implements the SHA-256 compression
+schedule over uint lanes (numpy here; the same straight-line schedule is
+the basis for a jnp/Pallas device tree-hash of large leaf sets — the
+batched-sha256 path noted in SURVEY §2.4).
+
+Measured honestly: on HOST CPU this does NOT beat hashlib's OpenSSL
+SHA-NI assembly (~0.5us per 64-byte hash); merkleize() therefore keeps the
+hashlib ladder, and this module exists as the verified vector formulation
+for the device path. Correctness is pinned against hashlib in
+tests/test_sha256_batch.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_K = np.array([
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2,
+], dtype=np.uint64)
+
+_H0 = np.array([
+    0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+    0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19,
+], dtype=np.uint64)
+
+_MASK = np.uint64(0xFFFFFFFF)
+
+# Padding block for a 64-byte message: 0x80, zeros, bit length 512.
+_PAD_WORDS = np.zeros(16, dtype=np.uint64)
+_PAD_WORDS[0] = 0x80000000
+_PAD_WORDS[15] = 512
+
+
+def _rotr(x, n):
+    return ((x >> np.uint64(n)) | (x << np.uint64(32 - n))) & _MASK
+
+
+def _compress(state, w16):
+    """One compression round batch: state (8, n), w16 (16, n) u64 lanes."""
+    w = np.empty((64,) + w16.shape[1:], dtype=np.uint64)
+    w[:16] = w16
+    for t in range(16, 64):
+        s0 = _rotr(w[t - 15], 7) ^ _rotr(w[t - 15], 18) ^ (w[t - 15] >> np.uint64(3))
+        s1 = _rotr(w[t - 2], 17) ^ _rotr(w[t - 2], 19) ^ (w[t - 2] >> np.uint64(10))
+        w[t] = (w[t - 16] + s0 + w[t - 7] + s1) & _MASK
+
+    a, b, c, d, e, f, g, h = state
+    for t in range(64):
+        S1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g) & _MASK
+        t1 = (h + S1 + ch + _K[t] + w[t]) & _MASK
+        S0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = (S0 + maj) & _MASK
+        h, g, f, e, d, c, b, a = g, f, e, (d + t1) & _MASK, c, b, a, (t1 + t2) & _MASK
+    out = np.stack([a, b, c, d, e, f, g, h])
+    return (out + state) & _MASK
+
+
+def sha256_pairs(left: np.ndarray, right: np.ndarray) -> np.ndarray:
+    """sha256(left[i] || right[i]) for all i.
+
+    left/right: (n, 32) uint8 arrays. Returns (n, 32) uint8."""
+    n = left.shape[0]
+    msg = np.concatenate([left, right], axis=1)           # (n, 64)
+    w16 = (
+        msg.reshape(n, 16, 4).astype(np.uint64)
+        @ np.array([1 << 24, 1 << 16, 1 << 8, 1], dtype=np.uint64)
+    ).T                                                    # (16, n) big-endian words
+    state = np.broadcast_to(_H0[:, None], (8, n)).copy()
+    state = _compress(state, w16)
+    pad = np.broadcast_to(_PAD_WORDS[:, None], (16, n))
+    state = _compress(state, pad)
+    # (8, n) words -> (n, 32) bytes big-endian
+    out = np.empty((n, 32), dtype=np.uint8)
+    s = state.T                                            # (n, 8)
+    for j in range(4):
+        out[:, j::4] = (s >> np.uint64(24 - 8 * j)).astype(np.uint8)
+    return out
+
+
+def hash_level(layer: list[bytes], pad: bytes) -> list[bytes]:
+    """Hash one merkle level (list of 32-byte chunks, odd tail padded)."""
+    odd = len(layer) & 1
+    if odd:
+        layer = layer + [pad]
+    arr = np.frombuffer(b"".join(layer), dtype=np.uint8).reshape(-1, 32)
+    out = sha256_pairs(arr[0::2], arr[1::2])
+    return [out[i].tobytes() for i in range(out.shape[0])]
+
+
+# below this many pairs the numpy batch constant factor loses to hashlib
+BATCH_THRESHOLD = 64

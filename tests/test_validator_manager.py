@@ -1,0 +1,56 @@
+"""validator-manager: create -> import -> list -> move between two VCs
+(validator_manager/src analog driven over the real keymanager HTTP API)."""
+
+import pytest
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.tools import validator_manager as vm
+from lighthouse_tpu.types.spec import minimal_spec
+from lighthouse_tpu.validator.http_api import KeymanagerServer
+from lighthouse_tpu.validator.validator_store import ValidatorStore
+
+PASSWORD = "vm-test-pass"
+
+
+@pytest.fixture(scope="module")
+def two_vcs():
+    bls.set_backend("python")
+    spec = minimal_spec()
+    servers = []
+    for _ in range(2):
+        store = ValidatorStore(spec, b"\x33" * 32)
+        servers.append(KeymanagerServer(store))
+    yield servers
+    for s in servers:
+        s.close()
+
+
+def test_create_import_move(two_vcs):
+    src, dest = two_vcs
+    created = vm.create_validators(b"\x01" * 32, 3, PASSWORD)
+    assert len({c["voting_pubkey"] for c in created}) == 3
+
+    statuses = vm.import_validators(src.url, src.api_token, created, PASSWORD)
+    assert statuses == ["imported"] * 3
+    assert set(vm.list_validators(src.url, src.api_token)) == {
+        c["voting_pubkey"] for c in created
+    }
+
+    # move two of them to the destination VC
+    move = vm.move_validators(
+        src.url, src.api_token, dest.url, dest.api_token,
+        [c["voting_pubkey"] for c in created[:2]],
+        [c["keystore"] for c in created[:2]],
+        PASSWORD,
+    )
+    assert move["deleted"] == ["deleted"] * 2
+    assert move["imported"] == ["imported"] * 2
+    assert move["slashing_protection"] is not None
+    assert len(vm.list_validators(src.url, src.api_token)) == 1
+    assert len(vm.list_validators(dest.url, dest.api_token)) == 2
+
+
+def test_bad_token_rejected(two_vcs):
+    src, _ = two_vcs
+    with pytest.raises(vm.ValidatorManagerError):
+        vm.list_validators(src.url, "wrong-token")
