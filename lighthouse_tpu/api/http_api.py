@@ -404,6 +404,254 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
             self.event_bus.publish("block", {"slot": _u(signed.message.slot), "block": _hex(root)})
         self._json({})
 
+    # -------------------------------------------------- route expansion r2
+
+    def _query(self) -> dict:
+        from urllib.parse import parse_qs, urlsplit
+
+        q = parse_qs(urlsplit(self.path).query)
+        return {k: v[0] for k, v in q.items()}
+
+    def get_blob_sidecars(self, block_id):
+        """GET /eth/v1/beacon/blob_sidecars/{block_id}."""
+        root = self._block_root_by_id(block_id)
+        sidecars = self.chain.get_blobs(root)
+        out = []
+        for sc in sidecars:
+            out.append(
+                {
+                    "index": _u(sc.index),
+                    "blob": _hex(sc.blob),
+                    "kzg_commitment": _hex(sc.kzg_commitment),
+                    "kzg_proof": _hex(sc.kzg_proof),
+                    "kzg_commitment_inclusion_proof": [
+                        _hex(b) for b in sc.kzg_commitment_inclusion_proof
+                    ],
+                }
+            )
+        self._json({"data": out})
+
+    def get_committees(self, state_id):
+        st = self._state_by_id(state_id)
+        spec = self.chain.spec
+        epoch = acc.get_current_epoch(st, spec)
+        q = self._query()
+        if "epoch" in q:
+            epoch = int(q["epoch"])
+        cache = acc.build_committee_cache(st, spec, epoch)
+        out = []
+        start = h.compute_start_slot_at_epoch(epoch, spec)
+        for slot in range(start, start + spec.preset.SLOTS_PER_EPOCH):
+            if "slot" in q and int(q["slot"]) != slot:
+                continue
+            for cidx in range(cache.committees_per_slot):
+                if "index" in q and int(q["index"]) != cidx:
+                    continue
+                out.append(
+                    {
+                        "index": _u(cidx),
+                        "slot": _u(slot),
+                        "validators": [_u(v) for v in cache.committee(slot, cidx)],
+                    }
+                )
+        self._json({"data": out})
+
+    def get_sync_committees(self, state_id):
+        st = self._state_by_id(state_id)
+        if not hasattr(st, "current_sync_committee"):
+            raise ApiError(400, "pre-altair state")
+        pk_to_idx = {bytes(v.pubkey): i for i, v in enumerate(st.validators)}
+        indices = [
+            pk_to_idx.get(bytes(pk), 0) for pk in st.current_sync_committee.pubkeys
+        ]
+        self._json({"data": {"validators": [_u(i) for i in indices]}})
+
+    def get_fork_schedule(self):
+        spec = self.chain.spec
+        from ..types.spec import ForkName
+
+        out = []
+        prev = spec.genesis_fork_version
+        for fork in ForkName:
+            epoch = spec.fork_epoch(fork)
+            if epoch is None:
+                continue
+            ver = spec.fork_version(fork)
+            out.append(
+                {
+                    "previous_version": _hex(prev),
+                    "current_version": _hex(ver),
+                    "epoch": _u(epoch),
+                }
+            )
+            prev = ver
+        self._json({"data": out})
+
+    def get_deposit_contract(self):
+        spec = self.chain.spec
+        self._json(
+            {
+                "data": {
+                    "chain_id": _u(spec.deposit_chain_id),
+                    "address": _hex(spec.deposit_contract_address),
+                }
+            }
+        )
+
+    def get_identity(self):
+        net = getattr(self.chain, "_network_node", None)
+        self._json(
+            {
+                "data": {
+                    "peer_id": net.node_id if net else "in-process",
+                    "enr": "",
+                    "p2p_addresses": (
+                        [f"/ip4/{net.host.listen_addr[0]}/tcp/{net.host.listen_addr[1]}"]
+                        if net
+                        else []
+                    ),
+                    "metadata": {"seq_number": "1", "attnets": "0x00"},
+                }
+            }
+        )
+
+    def get_peers(self):
+        net = getattr(self.chain, "_network_node", None)
+        peers = []
+        if net is not None:
+            for pid in net.host.connections:
+                peers.append(
+                    {
+                        "peer_id": pid,
+                        "state": "connected",
+                        "direction": "outbound",
+                        "score": net.peer_manager.score(pid),
+                    }
+                )
+        self._json({"data": peers, "meta": {"count": len(peers)}})
+
+    def post_sync_duties(self, epoch):
+        body = self._read_body()
+        indices = [int(i) for i in body]
+        duties = []
+        st = self.chain.head_state()
+        for vi in indices:
+            positions = self.chain.sync_subcommittee_positions(vi)
+            if positions:
+                duties.append(
+                    {
+                        "pubkey": _hex(st.validators[vi].pubkey),
+                        "validator_index": _u(vi),
+                        "validator_sync_committee_indices": [
+                            _u(s * (self.chain.spec.preset.SYNC_COMMITTEE_SIZE
+                                    // self.chain.spec.sync_committee_subnet_count) + p)
+                            for s, p in positions
+                        ],
+                    }
+                )
+        self._json({"data": duties})
+
+    def get_aggregate_attestation(self):
+        q = self._query()
+        slot = int(q.get("slot", 0))
+        root = bytes.fromhex(q.get("attestation_data_root", "0x")[2:])
+        types = types_for_slot(self.chain.spec, slot)
+        agg = self.chain.naive_attestation_pool.get_aggregate(slot, root, types)
+        if agg is None:
+            raise ApiError(404, "no aggregate known")
+        from ..ssz.core import Bitlist
+
+        bits = list(agg.aggregation_bits)
+        bits_ssz = Bitlist(max(len(bits), 1)).serialize(bits)
+        self._json(
+            {
+                "data": {
+                    "aggregation_bits": _hex(bits_ssz),
+                    "signature": _hex(agg.signature),
+                    "data": {
+                        "slot": _u(agg.data.slot),
+                        "index": _u(agg.data.index),
+                        "beacon_block_root": _hex(agg.data.beacon_block_root),
+                        "source": _checkpoint(agg.data.source),
+                        "target": _checkpoint(agg.data.target),
+                    },
+                }
+            }
+        )
+
+    def post_liveness(self, epoch):
+        """POST /eth/v1/validator/liveness/{epoch}: seen-on-chain/gossip
+        indicator per validator (the reference answers from its liveness
+        cache; here the observed-attesters gossip dedup set)."""
+        body = self._read_body()
+        epoch = int(epoch)
+        data = [
+            {
+                "index": _u(int(i)),
+                "is_live": (epoch, int(i)) in self.chain.observed_attesters,
+            }
+            for i in body
+        ]
+        self._json({"data": data})
+
+    def post_prepare_proposer(self):
+        body = self._read_body()
+        for item in body:
+            self.chain.proposer_preparations[int(item["validator_index"])] = bytes.fromhex(
+                item["fee_recipient"][2:]
+            )
+        self._json({}, 200)
+
+    def post_subscriptions(self):
+        # beacon_committee/sync_committee subscriptions: acknowledged; subnet
+        # topic management is the network node's job
+        self._read_body()
+        self._json({}, 200)
+
+    def get_debug_state(self, state_id):
+        st = self._state_by_id(state_id)
+        types = types_for_slot(self.chain.spec, st.slot)
+        self._json(
+            {
+                "version": self.chain.spec.fork_name_at_slot(st.slot).name,
+                "data": _hex(types.BeaconState.serialize(st)),
+            }
+        )
+
+    def post_pool_voluntary_exits(self):
+        body = self._read_body()
+        types = types_for_slot(self.chain.spec, self.chain.current_slot)
+        exit_ = types.SignedVoluntaryExit.make(
+            message=types.VoluntaryExit.make(
+                epoch=int(body["message"]["epoch"]),
+                validator_index=int(body["message"]["validator_index"]),
+            ),
+            signature=bytes.fromhex(body["signature"][2:]),
+        )
+        if self.op_pool is not None:
+            self.op_pool.insert_voluntary_exit(exit_)
+        if self.event_bus is not None:
+            self.event_bus.publish(
+                "voluntary_exit",
+                {"validator_index": body["message"]["validator_index"]},
+            )
+        self._json({})
+
+    def get_pool_voluntary_exits(self):
+        out = []
+        if self.op_pool is not None:
+            for e in self.op_pool.voluntary_exits.values():
+                out.append(
+                    {
+                        "message": {
+                            "epoch": _u(e.message.epoch),
+                            "validator_index": _u(e.message.validator_index),
+                        },
+                        "signature": _hex(e.signature),
+                    }
+                )
+        self._json({"data": out})
+
 
 def _bits_from_hex(hex_str: str):
     from ..ssz.core import Bitlist
@@ -432,6 +680,22 @@ _ROUTES = [
     (r"/eth/v1/validator/duties/proposer/(\d+)", "GET", BeaconApiHandler.get_proposer_duties),
     (r"/eth/v1/beacon/pool/attestations", "POST", BeaconApiHandler.post_pool_attestations),
     (r"/eth/v2/beacon/blocks", "POST", BeaconApiHandler.post_publish_block),
+    (r"/eth/v1/beacon/blob_sidecars/([^/]+)", "GET", BeaconApiHandler.get_blob_sidecars),
+    (r"/eth/v1/beacon/states/([^/]+)/committees", "GET", BeaconApiHandler.get_committees),
+    (r"/eth/v1/beacon/states/([^/]+)/sync_committees", "GET", BeaconApiHandler.get_sync_committees),
+    (r"/eth/v1/config/fork_schedule", "GET", BeaconApiHandler.get_fork_schedule),
+    (r"/eth/v1/config/deposit_contract", "GET", BeaconApiHandler.get_deposit_contract),
+    (r"/eth/v1/node/identity", "GET", BeaconApiHandler.get_identity),
+    (r"/eth/v1/node/peers", "GET", BeaconApiHandler.get_peers),
+    (r"/eth/v1/validator/duties/sync/(\d+)", "POST", BeaconApiHandler.post_sync_duties),
+    (r"/eth/v1/validator/aggregate_attestation", "GET", BeaconApiHandler.get_aggregate_attestation),
+    (r"/eth/v1/validator/liveness/(\d+)", "POST", BeaconApiHandler.post_liveness),
+    (r"/eth/v1/validator/prepare_beacon_proposer", "POST", BeaconApiHandler.post_prepare_proposer),
+    (r"/eth/v1/validator/beacon_committee_subscriptions", "POST", BeaconApiHandler.post_subscriptions),
+    (r"/eth/v1/validator/sync_committee_subscriptions", "POST", BeaconApiHandler.post_subscriptions),
+    (r"/eth/v2/debug/beacon/states/([^/]+)", "GET", BeaconApiHandler.get_debug_state),
+    (r"/eth/v1/beacon/pool/voluntary_exits", "POST", BeaconApiHandler.post_pool_voluntary_exits),
+    (r"/eth/v1/beacon/pool/voluntary_exits", "GET", BeaconApiHandler.get_pool_voluntary_exits),
 ]
 
 

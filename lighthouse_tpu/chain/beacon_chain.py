@@ -135,8 +135,22 @@ class BeaconChain:
         self.fork_choice = ForkChoice(
             spec, self.genesis_block_root, genesis_state.slot, genesis_state
         )
-        # head state kept in memory (state_cache analog: root -> state)
-        self.state_cache: dict[bytes, object] = {state_root: genesis_state}
+        # head states kept in memory: bounded LRU with build promises
+        from .caches import (
+            AttesterCache,
+            BlockTimesCache,
+            EarlyAttesterCache,
+            ObservedSlashable,
+            StateLRU,
+        )
+
+        self.state_cache = StateLRU(capacity=32)
+        self.state_cache[state_root] = genesis_state
+        self.block_times = BlockTimesCache()
+        self.attester_cache = AttesterCache()
+        self.early_attester_cache = EarlyAttesterCache()
+        self.observed_slashable = ObservedSlashable()
+        self.slasher = None           # optional slasher feed (set by the node)
         self.block_slots: dict[bytes, int] = {self.genesis_block_root: genesis_state.slot}
         self.state_root_by_block: dict[bytes, bytes] = {
             self.genesis_block_root: state_root
@@ -299,6 +313,10 @@ class BeaconChain:
         self.fork_choice.on_tick(self.current_slot)
         self.naive_attestation_pool.prune(self.current_slot)
         self.naive_sync_pool.prune(self.current_slot)
+        self.observed_slashable.prune(
+            self.fork_choice.store.finalized_checkpoint[0],
+            self.spec.preset.SLOTS_PER_EPOCH,
+        )
 
     # ---------------------------------------------------------------- head
 
@@ -337,11 +355,9 @@ class BeaconChain:
         fin_slot = h.compute_start_slot_at_epoch(fin_epoch, spec)
         if block.slot <= fin_slot:
             raise BlockError("block older than finalization")
-        key = (block.slot, block.proposer_index)
-        if key in self.observed_block_producers:
-            raise BlockError("proposer equivocation for slot")
-
-        # proposer signature over a cheaply-advanced parent state
+        # proposer signature over a cheaply-advanced parent state — MUST
+        # come before any equivocation bookkeeping, or unverifiable spam
+        # could poison the observed caches against the honest proposer
         state = self._state_for_block(parent_root, block.slot)
         batch = SignatureBatch()
         try:
@@ -356,9 +372,60 @@ class BeaconChain:
         if not batch.verify():
             raise BlockError("invalid proposer signature")
 
+        key = (block.slot, block.proposer_index)
+        prior = self.observed_slashable.peek_proposal(
+            int(block.proposer_index), int(block.slot), block_root
+        )
+        if prior is not None or key in self.observed_block_producers:
+            # a VERIFIED conflicting proposal: feed the slasher both signed
+            # headers (the prior one reconstructed from the store) and reject
+            self._report_proposer_equivocation(signed_block, block_root, prior, types)
+            raise BlockError("proposer equivocation for slot")
+
+        self.observed_slashable.record_proposal(
+            int(block.proposer_index), int(block.slot), block_root
+        )
         self.observed_block_producers.add(key)
         self.observed_blocks.add(block_root)
+        self.block_times.observed(block_root)
+        if self.slasher is not None:
+            self.slasher.accept_proposal(
+                self._proposal_record(signed_block, block_root, types)
+            )
         return block_root
+
+    def _proposal_record(self, signed_block, block_root: bytes, types):
+        from ..slasher.slasher import ProposalRecord
+
+        block = signed_block.message
+        hdr = types.BeaconBlockHeader.make(
+            slot=block.slot,
+            proposer_index=block.proposer_index,
+            parent_root=block.parent_root,
+            state_root=block.state_root,
+            body_root=types.BeaconBlockBody.hash_tree_root(block.body),
+        )
+        return ProposalRecord(
+            proposer_index=int(block.proposer_index),
+            slot=int(block.slot),
+            block_root=block_root,
+            signed_header=types.SignedBeaconBlockHeader.make(
+                message=hdr, signature=signed_block.signature
+            ),
+        )
+
+    def _report_proposer_equivocation(self, signed_block, block_root, prior_root, types):
+        if self.slasher is None:
+            return
+        self.slasher.accept_proposal(
+            self._proposal_record(signed_block, block_root, types)
+        )
+        if prior_root is not None:
+            prior_block = self.store.get_block(prior_root, types)
+            if prior_block is not None:
+                self.slasher.accept_proposal(
+                    self._proposal_record(prior_block, prior_root, types)
+                )
 
     def _state_for_block(self, parent_root: bytes, slot: int):
         """Parent post-state advanced to `slot` (cheap_state_advance)."""
@@ -489,8 +556,33 @@ class BeaconChain:
         timely = self.current_slot == block.slot
         self.fork_choice.on_tick(self.current_slot)
         self.fork_choice.on_block(signed_block, block_root, state, is_timely=timely)
+        self.block_times.imported(block_root)
+        # early-attester data: attest to this block before the head moves
+        from .caches import AttesterData
+
+        epoch = h.compute_epoch_at_slot(block.slot, spec)
+        self.early_attester_cache.add(
+            int(block.slot),
+            AttesterData(
+                beacon_block_root=block_root,
+                source_epoch=int(state.current_justified_checkpoint.epoch),
+                source_root=bytes(state.current_justified_checkpoint.root),
+                target_epoch=epoch,
+                target_root=self._target_root_for(state, epoch, block_root),
+            ),
+        )
+        prev_head = self.head_root
         self.recompute_head()
-        self._prune_state_cache()
+        from ..utils.metrics import BLOCK_OBSERVED_TO_HEAD, BLOCK_OBSERVED_TO_IMPORT
+
+        d = self.block_times.import_delay(block_root)
+        if d is not None:
+            BLOCK_OBSERVED_TO_IMPORT.observe(d)
+        if self.head_root != prev_head:
+            self.block_times.became_head(self.head_root)
+            d = self.block_times.head_delay(self.head_root)
+            if d is not None:
+                BLOCK_OBSERVED_TO_HEAD.observe(d)
         return block_root
 
     def process_gossip_blob(self, sidecar):
@@ -564,14 +656,13 @@ class BeaconChain:
             )
         return roots
 
-    def _prune_state_cache(self, keep: int = 8):
-        if len(self.state_cache) <= keep:
-            return
-        # keep the most recent states by slot
-        by_slot = sorted(
-            self.state_cache.items(), key=lambda kv: kv[1].slot, reverse=True
+    def _target_root_for(self, state, epoch: int, head_root: bytes) -> bytes:
+        start = h.compute_start_slot_at_epoch(epoch, self.spec)
+        if state.slot <= start:
+            return head_root
+        return bytes(
+            state.block_roots[start % self.spec.preset.SLOTS_PER_HISTORICAL_ROOT]
         )
-        self.state_cache = dict(by_slot[:keep])
 
     # ------------------------------------------------------------ attestations
 

@@ -62,8 +62,18 @@ def cmd_bn(args):
         print("error: provide --interop-validators N (checkpoint sync: use --checkpoint-state)", file=sys.stderr)
         return 1
 
+    from .utils.task_executor import Lockfile, TaskExecutor
+
     store = None
+    lock = None
     if args.datadir:
+        import os
+
+        os.makedirs(args.datadir, exist_ok=True)
+        # exclusive datadir ownership (common/lockfile): two nodes sharing a
+        # datadir is how operators get slashed
+        lock = Lockfile(f"{args.datadir}/beacon.lock")
+        lock.acquire()
         store = HotColdDB(
             spec,
             hot=NativeKVStore(f"{args.datadir}/hot.db"),
@@ -77,16 +87,27 @@ def cmd_bn(args):
     mserver, mport = metrics_http_server(port=args.metrics_port)
     print(f"metrics on :{mport}/metrics")
 
-    try:
-        while True:
-            time.sleep(clock.duration_to_next_slot())
+    executor = TaskExecutor(
+        name="bn", log=lambda m: print(f"[executor] {m}", file=sys.stderr)
+    )
+
+    def slot_timer(exit_signal):
+        while not exit_signal.wait(clock.duration_to_next_slot()):
             chain.per_slot_task()
             HEAD_SLOT.set(chain.head_state().slot)
             print(f"slot {clock.now()} head {chain.head_root.hex()[:8]}")
+
+    executor.spawn(slot_timer, "slot-timer")
+    try:
+        executor.exit_signal.wait()
     except KeyboardInterrupt:
+        executor.shutdown("SIGINT")
+    finally:
         server.shutdown()
         mserver.shutdown()
-    return 0
+        if lock is not None:
+            lock.release()
+    return 1 if executor.panicked else 0
 
 
 # ------------------------------------------------------------------ vc

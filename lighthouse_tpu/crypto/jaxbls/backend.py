@@ -43,7 +43,7 @@ from . import pairing_ops as po
 
 MIN_SETS = 4          # smallest bucket (pairs axis = sets + 1 rounded up)
 MIN_PKS = 1
-Z_WINDOW = 4
+Z_WINDOW = 1          # z-scaling digit width: 1 = plain double-and-add bits
 Z_DIGITS = 64 // Z_WINDOW
 
 
@@ -112,27 +112,17 @@ def _batched_affine(z_pk, h_jac, sig_acc):
     return (px, py, p_inf), (qx, qy, q_inf), (sx, sy, s_inf)
 
 
-def _verify_kernel(pk_x, pk_y, pk_mask, sig_x, sig_y, us, z_digits, set_mask):
-    """The jitted device program. Shapes:
-      pk_x/pk_y: (n, m, NL)  padded pubkey affine coords, STANDARD form
-      pk_mask:   (n, m)      1 = real pubkey
-      sig_x/sig_y: (n, 2, NL) signature affine G2 coords, standard form
-                   (infinity rejected host-side per blst semantics)
-      us:        (n, 2, 2, NL) hash_to_field outputs per message (standard)
-      z_digits:  (n, 16)     base-16 digits of the coefficients, MSB first
-      set_mask:  (n,)        1 = real set
-    Returns (ok, any_bad_aggpk)."""
+def _stage_prepare(pk_x, pk_y, pk_mask, sig_x, sig_y, z_digits, set_mask):
+    """Stage 1: mont conversion, pubkey tree-aggregation, z-scaling of
+    aggregate pubkeys and signatures, signature tree-sum."""
     import jax.numpy as jnp
 
-    n = pk_x.shape[0]
-
-    # 0. Montgomery-domain conversion on device (host sends standard limbs)
     pk_x = _to_mont_dev(pk_x)
     pk_y = _to_mont_dev(pk_y)
     sig_x = _to_mont_dev(sig_x)
     sig_y = _to_mont_dev(sig_y)
 
-    # 1. aggregate pubkeys per set: (n, m) -> (n,)
+    # aggregate pubkeys per set: (n, m) -> (n,)
     pk_jac = co.affine_to_jac(co.FQ_OPS, (pk_x, pk_y), inf_mask=jnp.logical_not(pk_mask))
     pk_jac_t = tuple(jnp.moveaxis(c, 1, 0) for c in pk_jac)
     m = pk_x.shape[1]
@@ -147,15 +137,13 @@ def _verify_kernel(pk_x, pk_y, pk_mask, sig_x, sig_y, us, z_digits, set_mask):
     aggpk_inf = co.FQ_OPS.is_zero(aggpk[2])
     bad_aggpk = jnp.any(jnp.logical_and(aggpk_inf, set_mask))
 
-    # 2. z_i * aggpk_i (windowed)
-    z_pk = co.scalar_mul_windowed(aggpk, z_digits, co.FQ_OPS, window=Z_WINDOW)
+    # z_i * aggpk_i (double-and-add: the windowed form's runtime table
+    # build added ~25k HLO ops per instance and dominated kernel compiles)
+    z_pk = co.scalar_mul_bits(aggpk, z_digits, co.FQ_OPS)
 
-    # 3. hash messages to G2 (SSWU + isogeny + psi cofactor clearing)
-    h_jac = h2.hash_to_g2_jacobian(us)
-
-    # 4. sum_i z_i * sig_i  (mask padded sets to identity first)
+    # sum_i z_i * sig_i  (mask padded sets to identity first)
     sig_jac = co.affine_to_jac(co.FQ2_OPS, (sig_x, sig_y), inf_mask=jnp.logical_not(set_mask))
-    z_sig = co.scalar_mul_windowed(sig_jac, z_digits, co.FQ2_OPS, window=Z_WINDOW)
+    z_sig = co.scalar_mul_bits(sig_jac, z_digits, co.FQ2_OPS)
     z_sig = co.pt_select(
         co.FQ2_OPS,
         jnp.asarray(set_mask, bool),
@@ -163,12 +151,16 @@ def _verify_kernel(pk_x, pk_y, pk_mask, sig_x, sig_y, us, z_digits, set_mask):
         tuple(jnp.broadcast_to(c, x.shape) for c, x in zip(co.identity(co.FQ2_OPS), z_sig)),
     )
     sig_acc = co.tree_sum(z_sig, co.FQ2_OPS)               # single jacobian G2
+    return z_pk, sig_acc, bad_aggpk
 
-    # 5. affine conversions (single batched inversion) + multi-pairing
+
+def _stage_pairs(z_pk, h_jac, sig_acc, set_mask):
+    """Stage 3: batched affine conversion + pair-array assembly."""
+    import jax.numpy as jnp
+
     (p1x, p1y, p1inf), (qx, qy, qinf), (sx, sy, sinf) = _batched_affine(
         z_pk, h_jac, sig_acc
     )
-
     # pairs: n set-pairs + 1 signature pair (exact count — the shared-f
     # Miller loop takes any pair count, no pow2 padding needed)
     neg_g1x = jnp.broadcast_to(_NEG_G1_GEN[0], (1,) + _NEG_G1_GEN[0].shape)
@@ -182,8 +174,35 @@ def _verify_kernel(pk_x, pk_y, pk_mask, sig_x, sig_y, us, z_digits, set_mask):
     # signature accumulator can legitimately be identity (all-zero z*sig)
     side_inf = jnp.concatenate([jnp.logical_or(p1inf, qinf), sinf[None]])
     pair_mask = jnp.logical_and(pair_mask, jnp.logical_not(side_inf))
+    return px, py, qxx, qyy, pair_mask
 
-    ok = po.pairing_product_is_one((px, py), (qxx, qyy), pair_mask)
+
+def _stage_pairing(px, py, qxx, qyy, pair_mask):
+    """Stage 4: shared-accumulator multi-Miller loop + final exponentiation."""
+    return po.pairing_product_is_one((px, py), (qxx, qyy), pair_mask)
+
+
+def _verify_kernel(pk_x, pk_y, pk_mask, sig_x, sig_y, us, z_digits, set_mask):
+    """The full device program as ONE composition (kept for the sharding
+    tests and the multichip dryrun; the hot path runs the stages as
+    SEPARATE jit calls — smaller programs compile minutes faster and cache
+    independently, and intermediates stay device-resident between calls).
+
+    Shapes:
+      pk_x/pk_y: (n, m, NL)  padded pubkey affine coords, STANDARD form
+      pk_mask:   (n, m)      1 = real pubkey
+      sig_x/sig_y: (n, 2, NL) signature affine G2 coords, standard form
+                   (infinity rejected host-side per blst semantics)
+      us:        (n, 2, 2, NL) hash_to_field outputs per message (standard)
+      z_digits:  (n, 64)     coefficient bits, MSB first
+      set_mask:  (n,)        1 = real set
+    Returns (ok, any_bad_aggpk)."""
+    z_pk, sig_acc, bad_aggpk = _stage_prepare(
+        pk_x, pk_y, pk_mask, sig_x, sig_y, z_digits, set_mask
+    )
+    h_jac = h2.hash_to_g2_jacobian(us)
+    px, py, qxx, qyy, pair_mask = _stage_pairs(z_pk, h_jac, sig_acc, set_mask)
+    ok = _stage_pairing(px, py, qxx, qyy, pair_mask)
     return ok, bad_aggpk
 
 
@@ -191,13 +210,35 @@ _NEG_G1_GEN = None
 _kernel_cache: dict = {}
 
 
-def _get_kernel():
+def _init_consts():
     global _NEG_G1_GEN
-    import jax
-
     if _NEG_G1_GEN is None:
         gx, gy = pc.g1_neg(pc.G1_GEN)
         _NEG_G1_GEN = (tw.fq_to_device(gx), tw.fq_to_device(gy))
+
+
+def _get_stages():
+    """Jitted stage functions (each cached separately on disk)."""
+    import jax
+
+    _init_consts()
+    if "stages" not in _kernel_cache:
+        from ...utils.jaxcfg import setup_compilation_cache
+
+        setup_compilation_cache()
+        _kernel_cache["stages"] = (
+            jax.jit(_stage_prepare),
+            jax.jit(h2.hash_to_g2_jacobian),
+            jax.jit(_stage_pairs),
+            jax.jit(_stage_pairing),
+        )
+    return _kernel_cache["stages"]
+
+
+def _get_kernel():
+    import jax
+
+    _init_consts()
     if "k" not in _kernel_cache:
         from ...utils.jaxcfg import setup_compilation_cache
 
@@ -282,7 +323,7 @@ class JaxBackend:
         return dx, dy, dm
 
     def verify_signature_sets_async(self, sets, rands) -> VerifyHandle:
-        kernel = _get_kernel()
+        prepare, h2c_stage, pairs_stage, pairing_stage = _get_stages()
         n_real = len(sets)
         n = max(MIN_SETS, _next_pow2(n_real))
         m = max(MIN_PKS, _next_pow2(max(len(s.signing_keys) for s in sets)))
@@ -314,7 +355,13 @@ class JaxBackend:
         us = np.zeros((n, 2, 2, lb.NL), np.uint32)
         us[:n_real] = h2.hash_to_field_batch([s.message for s in sets], self.dst)
 
-        ok, bad = kernel(pk_x, pk_y, pk_mask, sig_x, sig_y, us, z_digits, set_mask)
+        # staged dispatch: intermediates stay on device between jit calls
+        z_pk, sig_acc, bad = prepare(
+            pk_x, pk_y, pk_mask, sig_x, sig_y, z_digits, set_mask
+        )
+        h_jac = h2c_stage(us)
+        px, py, qxx, qyy, pair_mask = pairs_stage(z_pk, h_jac, sig_acc, set_mask)
+        ok = pairing_stage(px, py, qxx, qyy, pair_mask)
         return VerifyHandle(ok, bad)
 
     def verify_signature_sets(self, sets, rands) -> bool:
@@ -356,18 +403,21 @@ class JaxBackend:
 
         us = np.zeros((n, 2, 2, lb.NL), np.uint32)
         us[:n_real] = h2.hash_to_field_batch(list(messages), self.dst)
-        ok = kernel(pk_x, pk_y, mask, sig_xy, us)
+        _, h2c_stage, _, pairing_stage = _get_stages()
+        h_jac = h2c_stage(us)
+        px, py, qxx, qyy, pair_mask = kernel(pk_x, pk_y, mask, sig_xy, h_jac)
+        ok = pairing_stage(px, py, qxx, qyy, pair_mask)
         return bool(np.asarray(ok))
 
 
-def _aggregate_kernel(pk_x, pk_y, mask, sig_xy, us):
+def _aggregate_kernel(pk_x, pk_y, mask, sig_xy, h_jac):
+    """Pair assembly for distinct-message AggregateVerify (h2c + pairing run
+    as the shared stages)."""
     import jax.numpy as jnp
 
-    n = pk_x.shape[0]
     pk_x = _to_mont_dev(pk_x)
     pk_y = _to_mont_dev(pk_y)
     sig_xy = _to_mont_dev(sig_xy)
-    h_jac = h2.hash_to_g2_jacobian(us)
     qx, qy, qinf = co.jac_to_affine(h_jac, co.FQ2_OPS)
 
     neg_g1x = _NEG_G1_GEN[0][None]
@@ -380,13 +430,13 @@ def _aggregate_kernel(pk_x, pk_y, mask, sig_xy, us):
         [jnp.logical_and(jnp.asarray(mask, bool), jnp.logical_not(qinf)),
          jnp.asarray([True])]
     )
-    return po.pairing_product_is_one((px, py), (qxx, qyy), pair_mask)
+    return px, py, qxx, qyy, pair_mask
 
 
 def _get_aggregate_kernel():
     import jax
 
-    _get_kernel()  # ensures constants + cache initialized
+    _get_stages()  # ensures constants + cache initialized
     if "agg" not in _kernel_cache:
         _kernel_cache["agg"] = jax.jit(_aggregate_kernel)
     return _kernel_cache["agg"]

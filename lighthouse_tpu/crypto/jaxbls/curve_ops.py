@@ -62,49 +62,95 @@ def is_identity(ops, p):
     return ops.is_zero(p[2])
 
 
+def _stk(ops, *els):
+    """Stack field elements along a new lane axis just above the element
+    dims (Fq: (..., NL) -> (..., k, NL); Fq2: (..., 2, NL) -> (..., k, 2, NL)).
+    Lane stacking is THE compile-time lever: each ops.mul call costs a fixed
+    ~400 HLO ops regardless of lane count, so point formulas gather their
+    independent products into few wide calls (the same trick the tower
+    uses for fq6/fq12)."""
+    axis = -1 if ops is FQ_OPS else -2
+    axis -= 1
+    return jnp.stack(els, axis=axis)
+
+
+def _lanes(ops, stacked, k):
+    axis = stacked.ndim - (1 if ops is FQ_OPS else 2) - 1
+    return tuple(jnp.take(stacked, i, axis=axis) for i in range(k))
+
+
 def jac_double(p, ops):
     """Identity-safe Jacobian doubling (Z=0 stays Z=0; no y=0 points in the
-    prime-order subgroups of BLS12-381)."""
+    prime-order subgroups of BLS12-381). 8 field products in 3 stacked
+    multiply calls."""
     X, Y, Z = p
-    A = ops.sqr(X)
-    B = ops.sqr(Y)
-    C = ops.sqr(B)
-    t = ops.sqr(ops.add(X, B))
-    D = ops.small(ops.sub(ops.sub(t, A), C), 2)
+    # round 1: A = X^2, B = Y^2, YZ = Y*Z                (one call, 3 lanes)
+    r1 = ops.mul(_stk(ops, X, Y, Y), _stk(ops, X, Y, Z))
+    A, B, YZ = _lanes(ops, r1, 3)
+    # round 2: C = B^2, t = (X+B)^2, F = (3A)^2          (one call, 3 lanes)
     E = ops.small(A, 3)
-    F = ops.sqr(E)
+    XB = ops.add(X, B)
+    r2 = ops.mul(_stk(ops, B, XB, E), _stk(ops, B, XB, E))
+    C, t, F = _lanes(ops, r2, 3)
+    D = ops.small(ops.sub(ops.sub(t, A), C), 2)
     X3 = ops.sub(F, ops.small(D, 2))
+    # round 3: E*(D - X3)                                 (one call, 1 lane)
     Y3 = ops.sub(ops.mul(E, ops.sub(D, X3)), ops.small(C, 8))
-    Z3 = ops.small(ops.mul(Y, Z), 2)
+    Z3 = ops.small(YZ, 2)
     return (X3, Y3, Z3)
 
 
 def jac_add(p1, p2, ops):
-    """Complete Jacobian addition via selects (handles identity/equal/negation)."""
+    """Complete Jacobian addition via selects (handles identity/equal/
+    negation). The general case and the embedded doubling (for P == Q)
+    share stacked multiply calls — ~6 wide multiplies total instead of ~20
+    narrow ones, which is what keeps chained adds compilable."""
     X1, Y1, Z1 = p1
     X2, Y2, Z2 = p2
-    Z1Z1 = ops.sqr(Z1)
-    Z2Z2 = ops.sqr(Z2)
-    U1 = ops.mul(X1, Z2Z2)
-    U2 = ops.mul(X2, Z1Z1)
-    S1 = ops.mul(ops.mul(Y1, Z2), Z2Z2)
-    S2 = ops.mul(ops.mul(Y2, Z1), Z1Z1)
+    # round 1: Z1Z1, Z2Z2, Y1Z2, Y2Z1, Y1^2(dbl B), Y1Z1(dbl YZ)
+    r1 = ops.mul(
+        _stk(ops, Z1, Z2, Y1, Y2, Y1, Y1),
+        _stk(ops, Z1, Z2, Z2, Z1, Y1, Z1),
+    )
+    Z1Z1, Z2Z2, Y1Z2, Y2Z1, dB, dYZ = _lanes(ops, r1, 6)
+    # round 2: U1, U2, S1, S2 + dbl lanes: A = X1^2, C = dB^2, t = (X1+dB)^2
+    dXB = ops.add(X1, dB)
+    r2 = ops.mul(
+        _stk(ops, X1, X2, Y1Z2, Y2Z1, X1, dB, dXB),
+        _stk(ops, Z2Z2, Z1Z1, Z2Z2, Z1Z1, X1, dB, dXB),
+    )
+    U1, U2, S1, S2, dA, dC, dt = _lanes(ops, r2, 7)
     H = ops.sub(U2, U1)
     r = ops.sub(S2, S1)
-    HH = ops.sqr(H)
-    HHH = ops.mul(H, HH)
-    V = ops.mul(U1, HH)
-    X3 = ops.sub(ops.sub(ops.sqr(r), HHH), ops.small(V, 2))
-    Y3 = ops.sub(ops.mul(r, ops.sub(V, X3)), ops.mul(S1, HHH))
-    Z3 = ops.mul(ops.mul(Z1, Z2), H)
+    dE = ops.small(dA, 3)
+    # round 3: HH = H^2, rr = r^2, Z1Z2 = Z1*Z2, dF = dE^2
+    r3 = ops.mul(_stk(ops, H, r, Z1, dE), _stk(ops, H, r, Z2, dE))
+    HH, rr, Z1Z2, dF = _lanes(ops, r3, 4)
+    dD = ops.small(ops.sub(ops.sub(dt, dA), dC), 2)
+    dX3 = ops.sub(dF, ops.small(dD, 2))
+    # round 4: HHH = H*HH, V = U1*HH, Z3 = Z1Z2*H, dY3a = dE*(dD - dX3)
+    r4 = ops.mul(
+        _stk(ops, H, U1, Z1Z2, dE),
+        _stk(ops, HH, HH, H, ops.sub(dD, dX3)),
+    )
+    HHH, V, Z3, dY3a = _lanes(ops, r4, 4)
+    X3 = ops.sub(ops.sub(rr, HHH), ops.small(V, 2))
+    # round 5: r*(V - X3), S1*HHH
+    r5 = ops.mul(_stk(ops, r, S1), _stk(ops, ops.sub(V, X3), HHH))
+    rVX3, S1HHH = _lanes(ops, r5, 2)
+    Y3 = ops.sub(rVX3, S1HHH)
     general = (X3, Y3, Z3)
+
+    dY3 = ops.sub(dY3a, ops.small(dC, 8))
+    dZ3 = ops.small(dYZ, 2)
+    doubled = (dX3, dY3, dZ3)
 
     h_zero = ops.is_zero(H)
     r_zero = ops.is_zero(r)
     p1_inf = ops.is_zero(Z1)
     p2_inf = ops.is_zero(Z2)
 
-    out = pt_select(ops, jnp.logical_and(h_zero, r_zero), jac_double(p1, ops), general)
+    out = pt_select(ops, jnp.logical_and(h_zero, r_zero), doubled, general)
     inf = jax.tree_util.tree_map(lambda c, g: jnp.broadcast_to(c, g.shape), identity(ops), general)
     out = pt_select(ops, jnp.logical_and(h_zero, jnp.logical_not(r_zero)), inf, out)
     out = pt_select(ops, p1_inf, p2, out)
@@ -192,8 +238,18 @@ def scalar_mul_windowed(p_jac, digits, ops, window: int = 4):
     table[0] = jax.tree_util.tree_map(
         lambda c, x: jnp.broadcast_to(c, x.shape), table[0], p_jac
     )
-    for _ in range(2, nt):
-        table.append(jac_add(table[-1], p_jac, ops))
+    # Build [2..nt-1]*P in log rounds of ONE stacked jac_add each
+    # (j*P = (j//2)*P + (j - j//2)*P, both halves < len(table)): 4 add
+    # instances for w=4 instead of a 14-long sequential chain — the chain
+    # dominated kernel compile time.
+    while len(table) < nt:
+        m = len(table)
+        idx = list(range(m, min(2 * (m - 1), nt - 1) + 1))
+        A = tuple(jnp.stack([table[j // 2][ci] for j in idx]) for ci in range(3))
+        B = tuple(jnp.stack([table[j - j // 2][ci] for j in idx]) for ci in range(3))
+        S = jac_add(A, B, ops)
+        for k, _j in enumerate(idx):
+            table.append(tuple(S[ci][k] for ci in range(3)))
     # stack: tuple of coords, each (nt,) + batch + elem shape
     table_arr = tuple(jnp.stack([t[i] for t in table]) for i in range(3))
 

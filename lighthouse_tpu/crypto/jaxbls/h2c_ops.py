@@ -104,9 +104,18 @@ def fq2_pow_static(a, bits: np.ndarray, window: int = 4):
         e >>= window
     digits.reverse()
 
+    # log-round stacked table build (a^j = a^(j//2) * a^(j-j//2))
+    nt = 1 << window
     table = [jnp.broadcast_to(tw.FQ2_ONE, a.shape), a]
-    for _ in range(2, 1 << window):
-        table.append(tw.fq2_mul(table[-1], a))
+    while len(table) < nt:
+        m = len(table)
+        idx = list(range(m, min(2 * (m - 1), nt - 1) + 1))
+        prod = tw.fq2_mul(
+            jnp.stack([table[j // 2] for j in idx]),
+            jnp.stack([table[j - j // 2] for j in idx]),
+        )
+        for k in range(len(idx)):
+            table.append(prod[k])
     table_arr = jnp.stack(table)
 
     acc = table_arr[digits[0]]

@@ -275,22 +275,46 @@ def _banded(b, na: int, ncols: int):
 _POLY_SHIFT = False  # flipped only while tracing Pallas bodies (Mosaic
                      # lowers shift-accumulate; gathers/einsum poorly)
 
+# static anti-diagonal scatter matrices M[j*nb + l, k] = (j + l == k),
+# cached per (na, nb, ncols)
+_ANTIDIAG: dict = {}
+
+
+def _antidiag(na: int, nb: int, ncols: int):
+    key = (na, nb, ncols)
+    got = _ANTIDIAG.get(key)
+    if got is None:
+        m = np.zeros((na * nb, ncols), np.uint32)
+        for j in range(na):
+            for l in range(nb):
+                if j + l < ncols:
+                    m[j * nb + l, j + l] = 1
+        _ANTIDIAG[key] = m
+        got = m
+    return jnp.asarray(got)
+
 
 def _poly_mul(a, b, ncols: int):
     """Carry-free limb product: a (..., na) * b (..., nb) -> (..., ncols)
-    column sums. Inputs are 16-bit-valued u32; the 8-bit split of `a` keeps
-    every dot-product partial sum < 2^31 (no u32 overflow)."""
+    column sums, as ONE outer product + ONE matmul against a static 0/1
+    anti-diagonal matrix (dot_general maps onto the MXU; the banded-gather
+    einsum it replaces lowered to gathers that bloated both compile time
+    and runtime). The 8-bit split of `a` keeps every partial sum < 2^31."""
     if _POLY_SHIFT:
         return _poly_mul_shift(a, b, ncols)
     na = a.shape[-1]
-    B = _banded(b, na, ncols)
-    a_lo = a & 0xFF
-    a_hi = a >> 8
-    c_lo = jnp.einsum("...j,...jk->...k", a_lo, B)
-    c_hi = jnp.einsum("...j,...jk->...k", a_hi, B)
+    nb = b.shape[-1]
+    M = _antidiag(na, nb, ncols)
+    a_lo = (a & 0xFF)[..., :, None]
+    a_hi = (a >> 8)[..., :, None]
+    bb = b[..., None, :]
+    z_lo = (a_lo * bb).reshape(a.shape[:-1] + (na * nb,))   # each < 2^24
+    z_hi = (a_hi * bb).reshape(a.shape[:-1] + (na * nb,))
+    c_lo = z_lo @ M                                          # columns < 2^29
+    c_hi = z_hi @ M
     col = c_lo + ((c_hi & 0xFF) << 8)
     col = col.at[..., 1:].add(c_hi[..., :-1] >> 8)
-    return col                                          # each < 2^31
+    return col                                               # each < 2^30
 
 
 # -P^-1 mod 2^384, full-width Montgomery constant for non-interleaved REDC.
@@ -300,20 +324,25 @@ NPRIME_HOST = pack((-pow(P, -1, 1 << (NL * LB))) % (1 << (NL * LB)))
 def mont_mul(a, b):
     """Montgomery product a*b*R^-1 mod P. a, b: (..., NL) canonical limbs.
 
-    Non-interleaved REDC with all three limb products as shift-accumulate
-    schoolbook convolutions:
+    Non-interleaved REDC with all three limb products as banded
+    convolutions:
       T = a*b ; m = (T mod R) * N' mod R ; res = (T + m*N) / R ; cond-sub.
-    """
+    T itself stays in REDUNDANT column form for the final sum (columns of
+    both T and m*N are < 2^30, so T + mN fits u32) — only T's low NL
+    columns are normalized, because the m product needs canonical 16-bit
+    inputs. One fewer full carry chain per multiply."""
     batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
     a = jnp.broadcast_to(a, batch + (NL,))
     b = jnp.broadcast_to(b, batch + (NL,))
 
-    t = _poly_mul(a, b, 2 * NL + 1)
-    t, _ = carry_normalize(t)                          # canonical T, 2NL+1 limbs
-    m = _poly_mul(t[..., :NL], jnp.asarray(NPRIME_HOST), NL)
+    t = _poly_mul(a, b, 2 * NL + 1)                    # columns < 2^30
+    # T mod R needs only the low NL columns canonicalized (the carry past
+    # 2^384 is dropped by the mod)
+    t_low, _ = carry_normalize(t[..., :NL])
+    m = _poly_mul(t_low, jnp.asarray(NPRIME_HOST), NL)
     m, _ = carry_normalize(m)                          # mod 2^384 via truncation
     mn = _poly_mul(m, jnp.asarray(N_HOST), 2 * NL + 1)
-    s = t + mn                                         # < 2^31 + 2^16 per column
+    s = t + mn                                         # columns < 2^31
     s, _ = carry_normalize(s)
     res = s[..., NL:]                                  # (..., NL+1), value < 2N
     return _cond_sub_n(res)
@@ -401,10 +430,19 @@ def mont_pow_static(a, exponent: int, window: int = 4):
         e >>= window
     digits.reverse()
 
-    # table[i] = a^i, built with 2^w - 2 sequential multiplies
+    # table[i] = a^i in log rounds of ONE stacked multiply each
+    # (a^j = a^(j//2) * a^(j-j//2)) — sequential chains dominate compile
+    nt = 1 << window
     table = [jnp.broadcast_to(ONE_MONT, a.shape), a]
-    for _ in range(2, 1 << window):
-        table.append(mont_mul(table[-1], a))
+    while len(table) < nt:
+        m = len(table)
+        idx = list(range(m, min(2 * (m - 1), nt - 1) + 1))
+        prod = mont_mul(
+            jnp.stack([table[j // 2] for j in idx]),
+            jnp.stack([table[j - j // 2] for j in idx]),
+        )
+        for k in range(len(idx)):
+            table.append(prod[k])
     table_arr = jnp.stack(table)                     # (2^w, ..., NL)
 
     acc = table_arr[digits[0]]

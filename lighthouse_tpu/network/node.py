@@ -34,6 +34,7 @@ class NetworkNode:
         op_pool=None,
     ):
         self.chain = chain
+        chain._network_node = self          # identity/peers API surface
         self.node_id = node_id
         self.fork_digest = fork_digest
         self.op_pool = op_pool

@@ -113,7 +113,7 @@ class Simulator:
         owner.net.publish_block(signed)
         self._wait(
             lambda: all(n.chain.head_root == root for n in self.nodes),
-            10.0,
+            30.0,
             f"block propagation at slot {slot}",
         )
 
@@ -176,7 +176,7 @@ class Simulator:
 
         self._wait(
             lambda: all(pooled(n) >= want for n in self.nodes),
-            10.0,
+            30.0,
             f"attestation propagation at slot {slot}",
         )
         return root

@@ -1,0 +1,229 @@
+"""watch — chain-history indexer + REST server.
+
+Parity surface: /root/reference/watch/ — an updater that walks canonical
+blocks from a beacon node into a SQL database (the reference uses Postgres;
+here stdlib sqlite3 — same schema shape, same queries), tracking per-slot
+canonical roots, proposer, attestation-packing quality and per-validator
+suboptimal attestation flags, plus a small REST server over the indexed
+data (watch/src/server). The updater is incremental: it resumes from the
+highest indexed slot."""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..state_transition import accessors as acc
+from ..state_transition.slot import types_for_slot
+
+SCHEMA = """
+CREATE TABLE IF NOT EXISTS canonical_slots (
+    slot INTEGER PRIMARY KEY,
+    root BLOB NOT NULL,
+    skipped INTEGER NOT NULL DEFAULT 0
+);
+CREATE TABLE IF NOT EXISTS beacon_blocks (
+    slot INTEGER PRIMARY KEY,
+    root BLOB NOT NULL,
+    parent_root BLOB NOT NULL,
+    proposer INTEGER NOT NULL,
+    attestation_count INTEGER NOT NULL,
+    attesting_validators INTEGER NOT NULL
+);
+CREATE TABLE IF NOT EXISTS proposer_info (
+    slot INTEGER PRIMARY KEY,
+    proposer INTEGER NOT NULL,
+    graffiti TEXT
+);
+CREATE TABLE IF NOT EXISTS suboptimal_attestations (
+    epoch INTEGER NOT NULL,
+    validator_index INTEGER NOT NULL,
+    source INTEGER NOT NULL,
+    target INTEGER NOT NULL,
+    head INTEGER NOT NULL,
+    PRIMARY KEY (epoch, validator_index)
+);
+"""
+
+
+class WatchDB:
+    def __init__(self, path: str = ":memory:"):
+        self.conn = sqlite3.connect(path, check_same_thread=False)
+        self.conn.executescript(SCHEMA)
+        self._lock = threading.Lock()
+
+    def highest_slot(self) -> int:
+        row = self.conn.execute("SELECT MAX(slot) FROM canonical_slots").fetchone()
+        return row[0] if row[0] is not None else -1
+
+    # ------------------------------------------------------------- updater
+
+    def update_from_chain(self, chain) -> int:
+        """Index canonical slots above the highest indexed one
+        (watch/src/updater incremental walk). Canonicity comes from walking
+        the HEAD's parent chain — chain.block_slots also contains orphaned
+        fork blocks that must not be indexed as canonical."""
+        spec = chain.spec
+        head_slot = int(chain.head_state().slot)
+        start = self.highest_slot() + 1
+        # canonical walk: head -> parents
+        by_slot: dict[int, bytes] = {}
+        root = chain.head_root
+        while root is not None:
+            slot = chain.block_slots.get(root)
+            if slot is None or slot < start:
+                break
+            by_slot[slot] = root
+            types = types_for_slot(spec, slot)
+            blk = chain.store.get_block(root, types)
+            if blk is None or slot == 0:
+                break
+            root = bytes(blk.message.parent_root)
+        n = 0
+        with self._lock:
+            last_root = b""
+            for slot in range(start, head_slot + 1):
+                root = by_slot.get(slot)
+                if root is None:
+                    # skipped slot: canonical root is the last block's
+                    self.conn.execute(
+                        "INSERT OR REPLACE INTO canonical_slots VALUES (?, ?, 1)",
+                        (slot, last_root),
+                    )
+                    continue
+                last_root = root
+                types = types_for_slot(spec, slot)
+                block = chain.store.get_block(root, types)
+                if block is None:
+                    continue
+                body = block.message.body
+                attesting = sum(
+                    sum(1 for b in a.aggregation_bits if b)
+                    for a in body.attestations
+                )
+                self.conn.execute(
+                    "INSERT OR REPLACE INTO canonical_slots VALUES (?, ?, 0)",
+                    (slot, root),
+                )
+                self.conn.execute(
+                    "INSERT OR REPLACE INTO beacon_blocks VALUES (?, ?, ?, ?, ?, ?)",
+                    (
+                        slot, root, bytes(block.message.parent_root),
+                        int(block.message.proposer_index),
+                        len(body.attestations), attesting,
+                    ),
+                )
+                self.conn.execute(
+                    "INSERT OR REPLACE INTO proposer_info VALUES (?, ?, ?)",
+                    (
+                        slot, int(block.message.proposer_index),
+                        bytes(body.graffiti).rstrip(b"\x00").decode("utf-8", "replace"),
+                    ),
+                )
+                n += 1
+            self.conn.commit()
+        return n
+
+    def record_participation(self, chain) -> int:
+        """Mark validators with missing/suboptimal participation flags for
+        the previous epoch (watch suboptimal-attestations tracking)."""
+        spec = chain.spec
+        state = chain.head_state()
+        epoch = acc.get_previous_epoch(state, spec)
+        n = 0
+        with self._lock:
+            for i, flags in enumerate(state.previous_epoch_participation):
+                src = acc.has_flag(flags, acc.TIMELY_SOURCE_FLAG_INDEX)
+                tgt = acc.has_flag(flags, acc.TIMELY_TARGET_FLAG_INDEX)
+                head = acc.has_flag(flags, acc.TIMELY_HEAD_FLAG_INDEX)
+                if src and tgt and head:
+                    continue
+                self.conn.execute(
+                    "INSERT OR REPLACE INTO suboptimal_attestations VALUES (?, ?, ?, ?, ?)",
+                    (epoch, i, int(src), int(tgt), int(head)),
+                )
+                n += 1
+            self.conn.commit()
+        return n
+
+    # ------------------------------------------------------------- queries
+
+    def block_at_slot(self, slot: int):
+        row = self.conn.execute(
+            "SELECT slot, root, parent_root, proposer, attestation_count, "
+            "attesting_validators FROM beacon_blocks WHERE slot = ?", (slot,)
+        ).fetchone()
+        if row is None:
+            return None
+        return {
+            "slot": row[0], "root": "0x" + row[1].hex(),
+            "parent_root": "0x" + row[2].hex(), "proposer": row[3],
+            "attestation_count": row[4], "attesting_validators": row[5],
+        }
+
+    def proposer_counts(self) -> dict[int, int]:
+        return dict(
+            self.conn.execute(
+                "SELECT proposer, COUNT(*) FROM beacon_blocks GROUP BY proposer"
+            ).fetchall()
+        )
+
+    def suboptimal_for_epoch(self, epoch: int) -> list[dict]:
+        rows = self.conn.execute(
+            "SELECT validator_index, source, target, head FROM "
+            "suboptimal_attestations WHERE epoch = ?", (epoch,)
+        ).fetchall()
+        return [
+            {"validator_index": r[0], "source": bool(r[1]),
+             "target": bool(r[2]), "head": bool(r[3])}
+            for r in rows
+        ]
+
+
+class WatchServer:
+    """REST surface over the index (watch/src/server analog)."""
+
+    def __init__(self, db: WatchDB, host="127.0.0.1", port=0):
+        outer_db = db
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, payload, code=200):
+                out = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+
+            def do_GET(self):
+                import re
+
+                m = re.match(r"^/v1/blocks/(\d+)$", self.path)
+                if m:
+                    got = outer_db.block_at_slot(int(m.group(1)))
+                    if got is None:
+                        return self._json({"message": "not found"}, 404)
+                    return self._json(got)
+                m = re.match(r"^/v1/validators/suboptimal/(\d+)$", self.path)
+                if m:
+                    return self._json(outer_db.suboptimal_for_epoch(int(m.group(1))))
+                if self.path == "/v1/proposers":
+                    return self._json(
+                        {str(k): v for k, v in outer_db.proposer_counts().items()}
+                    )
+                if self.path == "/v1/status":
+                    return self._json({"highest_slot": outer_db.highest_slot()})
+                return self._json({"message": "not found"}, 404)
+
+        self.server = ThreadingHTTPServer((host, port), Handler)
+        self.url = f"http://{host}:{self.server.server_address[1]}"
+        self._thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def close(self):
+        self.server.shutdown()

@@ -158,6 +158,14 @@ ATTESTATION_BATCHES = REGISTRY.counter(
     "gossip_attestation_batches_total", "Coalesced attestation batches"
 )
 HEAD_SLOT = REGISTRY.gauge("beacon_head_slot", "Canonical head slot")
+BLOCK_OBSERVED_TO_IMPORT = REGISTRY.histogram(
+    "beacon_block_observed_to_import_seconds",
+    "Gossip arrival to import latency (BlockTimesCache)",
+)
+BLOCK_OBSERVED_TO_HEAD = REGISTRY.histogram(
+    "beacon_block_observed_to_head_seconds",
+    "Gossip arrival to becoming head (BlockTimesCache)",
+)
 
 
 def metrics_http_server(host="127.0.0.1", port=0, registry=REGISTRY):

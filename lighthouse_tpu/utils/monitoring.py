@@ -1,0 +1,144 @@
+"""Remote monitoring pusher + system health snapshot.
+
+Parity surface: /root/reference/common/monitoring_api/src/ (periodic POST
+of process/system health JSON to a remote monitoring endpoint, the
+beaconcha.in client-stats format) and /root/reference/common/system_health
+(sysinfo snapshot). Host metrics come from /proc (no psutil in the image).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+VERSION = "lighthouse-tpu/0.2.0"
+
+
+def system_health() -> dict:
+    """CPU/memory/disk snapshot from /proc + os (system_health analog)."""
+    out: dict = {"os": os.uname().sysname.lower()}
+    try:
+        with open("/proc/meminfo") as f:
+            mem = {}
+            for line in f:
+                k, v = line.split(":", 1)
+                mem[k] = int(v.strip().split()[0]) * 1024
+        out["sys_virt_mem_total"] = mem.get("MemTotal", 0)
+        out["sys_virt_mem_available"] = mem.get("MemAvailable", 0)
+        out["sys_virt_mem_used"] = (
+            mem.get("MemTotal", 0) - mem.get("MemAvailable", 0)
+        )
+    except OSError:
+        pass
+    try:
+        out["sys_loadavg_1"], out["sys_loadavg_5"], out["sys_loadavg_15"] = os.getloadavg()
+    except OSError:
+        pass
+    try:
+        st = os.statvfs("/")
+        out["disk_node_bytes_total"] = st.f_blocks * st.f_frsize
+        out["disk_node_bytes_free"] = st.f_bavail * st.f_frsize
+    except OSError:
+        pass
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    out["process_mem_rss"] = int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    out["pid"] = os.getpid()
+    return out
+
+
+class MonitoringService:
+    """Periodic POST of {beacon_node, validator, system} health blobs to a
+    remote endpoint (monitoring_api lib.rs analog). `chain` and `vc` are
+    optional sources; either side can run standalone."""
+
+    def __init__(self, endpoint: str, chain=None, vc_store=None,
+                 period: float = 60.0, post_fn=None):
+        self.endpoint = endpoint
+        self.chain = chain
+        self.vc_store = vc_store
+        self.period = period
+        self.sent = 0
+        self.errors = 0
+        self._post = post_fn or self._http_post
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def _http_post(self, payload: list) -> None:
+        req = urllib.request.Request(
+            self.endpoint,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=10):
+            pass
+
+    def collect(self) -> list:
+        now_ms = int(time.time() * 1000)
+        out = [
+            {
+                "version": 1,
+                "timestamp": now_ms,
+                "process": "system",
+                **system_health(),
+            }
+        ]
+        if self.chain is not None:
+            fc = self.chain.fork_choice.store
+            out.append(
+                {
+                    "version": 1,
+                    "timestamp": now_ms,
+                    "process": "beaconnode",
+                    "client_name": VERSION,
+                    "sync_beacon_head_slot": int(self.chain.head_state().slot),
+                    "sync_eth2_synced": True,
+                    "slasher_active": False,
+                    "justified_epoch": fc.justified_checkpoint[0],
+                    "finalized_epoch": fc.finalized_checkpoint[0],
+                }
+            )
+        if self.vc_store is not None:
+            out.append(
+                {
+                    "version": 1,
+                    "timestamp": now_ms,
+                    "process": "validator",
+                    "client_name": VERSION,
+                    "validator_total": len(self.vc_store.validators),
+                    "validator_active": sum(
+                        1
+                        for v in self.vc_store.validators.values()
+                        if v.doppelganger_safe
+                    ),
+                }
+            )
+        return out
+
+    def tick(self) -> bool:
+        try:
+            self._post(self.collect())
+            self.sent += 1
+            return True
+        except Exception:  # noqa: BLE001 — monitoring must never kill the node
+            self.errors += 1
+            return False
+
+    def start(self) -> None:
+        def loop():
+            while not self._stop.wait(self.period):
+                self.tick()
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -134,22 +134,46 @@ class InProcessBeaconNode:
             raise BeaconNodeError("node down")
         chain = self.chain
         spec = chain.spec
-        state = chain.head_state()
         types = types_for_slot(spec, slot)
         epoch = h.compute_epoch_at_slot(slot, spec)
+
+        # early-attester path: a block imported THIS slot can be attested
+        # to before the head recompute publishes it (early_attester_cache.rs)
+        early = chain.early_attester_cache.try_attest(slot)
+        if early is not None:
+            return types.AttestationData.make(
+                slot=slot,
+                index=committee_index,
+                beacon_block_root=early.beacon_block_root,
+                source=types.Checkpoint.make(
+                    epoch=early.source_epoch, root=early.source_root
+                ),
+                target=types.Checkpoint.make(
+                    epoch=early.target_epoch, root=early.target_root
+                ),
+            )
+
         head_root = chain.head_root
-        start_slot = h.compute_start_slot_at_epoch(epoch, spec)
-        if state.slot <= start_slot:
-            target_root = head_root
+        # attester cache: (epoch, head) -> (source, target_root) without
+        # touching the full state (attester_cache.rs)
+        cached = chain.attester_cache.get(epoch, head_root)
+        if cached is not None:
+            source, target_root = cached
         else:
-            target_root = state.block_roots[
-                start_slot % spec.preset.SLOTS_PER_HISTORICAL_ROOT
-            ]
-        source = (
-            state.current_justified_checkpoint
-            if epoch == acc.get_current_epoch(state, spec)
-            else state.previous_justified_checkpoint
-        )
+            state = chain.head_state()
+            start_slot = h.compute_start_slot_at_epoch(epoch, spec)
+            if state.slot <= start_slot:
+                target_root = head_root
+            else:
+                target_root = bytes(
+                    state.block_roots[start_slot % spec.preset.SLOTS_PER_HISTORICAL_ROOT]
+                )
+            source = (
+                state.current_justified_checkpoint
+                if epoch == acc.get_current_epoch(state, spec)
+                else state.previous_justified_checkpoint
+            )
+            chain.attester_cache.put(epoch, head_root, source, target_root)
         return types.AttestationData.make(
             slot=slot,
             index=committee_index,

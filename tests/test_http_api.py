@@ -20,7 +20,9 @@ def api():
     spec = minimal_spec()
     harness = StateHarness.new(spec, VALIDATORS)
     chain = BeaconChain(spec, clone_state(harness.state, spec))
-    server, thread, port = serve(chain)
+    from lighthouse_tpu.chain.op_pool import OperationPool
+
+    server, thread, port = serve(chain, op_pool=OperationPool(spec))
     client = BeaconNodeHttpClient(f"http://127.0.0.1:{port}")
     yield harness, chain, client
     server.shutdown()
@@ -76,3 +78,74 @@ def test_block_publish_and_query(api):
     hdr = client.header("head")
     assert int(hdr["header"]["message"]["slot"]) == slot
     assert client.block_root("head") == chain.head_root
+
+
+def _get(client, path):
+    import json
+    import urllib.request
+
+    with urllib.request.urlopen(client.base_url + path, timeout=5) as r:
+        return json.loads(r.read().decode())
+
+
+def _post(client, path, body):
+    import json
+    import urllib.request
+
+    req = urllib.request.Request(
+        client.base_url + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=5) as r:
+        return json.loads(r.read().decode() or "{}")
+
+
+def test_expanded_route_families(api):
+    harness, chain, client = api
+    # config family
+    fs = _get(client, "/eth/v1/config/fork_schedule")["data"]
+    assert fs and fs[0]["epoch"] == "0"
+    dc = _get(client, "/eth/v1/config/deposit_contract")["data"]
+    assert dc["chain_id"] == str(chain.spec.deposit_chain_id)
+    # node family
+    ident = _get(client, "/eth/v1/node/identity")["data"]
+    assert "peer_id" in ident
+    peers = _get(client, "/eth/v1/node/peers")
+    assert peers["meta"]["count"] == 0
+    # committees
+    comm = _get(client, "/eth/v1/beacon/states/head/committees")["data"]
+    assert comm and all("validators" in c for c in comm)
+    sc = _get(client, "/eth/v1/beacon/states/head/sync_committees")["data"]
+    assert len(sc["validators"]) == chain.spec.preset.SYNC_COMMITTEE_SIZE
+    # sync duties + liveness + preparation
+    duties = _post(client, "/eth/v1/validator/duties/sync/0", ["0", "1"])["data"]
+    assert isinstance(duties, list)
+    lv = _post(client, "/eth/v1/validator/liveness/0", ["0"])["data"]
+    assert lv[0]["is_live"] in (False, True)
+    _post(
+        client, "/eth/v1/validator/prepare_beacon_proposer",
+        [{"validator_index": "0", "fee_recipient": "0x" + "aa" * 20}],
+    )
+    assert chain.proposer_preparations[0] == b"\xaa" * 20
+    # subscriptions ack
+    _post(client, "/eth/v1/validator/beacon_committee_subscriptions", [])
+    # debug state round-trips
+    dbg = _get(client, "/eth/v2/debug/beacon/states/head")
+    from lighthouse_tpu.state_transition.slot import types_for_slot as tfs
+
+    types = tfs(chain.spec, chain.head_state().slot)
+    st2 = types.BeaconState.deserialize(bytes.fromhex(dbg["data"][2:]))
+    assert st2.slot == chain.head_state().slot
+    # blob sidecars (none stored for genesis chain)
+    blobs = _get(client, "/eth/v1/beacon/blob_sidecars/head")["data"]
+    assert blobs == []
+    # voluntary exit pool roundtrip
+    _post(
+        client, "/eth/v1/beacon/pool/voluntary_exits",
+        {
+            "message": {"epoch": "0", "validator_index": "3"},
+            "signature": "0x" + "00" * 96,
+        },
+    )
+    got = _get(client, "/eth/v1/beacon/pool/voluntary_exits")["data"]
+    assert got[0]["message"]["validator_index"] == "3"

@@ -1,0 +1,68 @@
+"""TaskExecutor supervision (panic => shutdown) + datadir Lockfile."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from lighthouse_tpu.utils.task_executor import Lockfile, LockfileError, TaskExecutor
+
+
+def test_clean_task_and_exit_signal():
+    ex = TaskExecutor()
+    ran = []
+
+    def svc(exit_signal):
+        ran.append(True)
+        exit_signal.wait(5)
+        ran.append("stopped")
+
+    ex.spawn(svc, "svc")
+    time.sleep(0.05)
+    ex.shutdown("test over")
+    ex.join()
+    assert ran == [True, "stopped"]
+
+
+def test_critical_panic_triggers_shutdown():
+    fatal = []
+    ex = TaskExecutor(on_fatal=fatal.append)
+
+    def bad(exit_signal):
+        raise RuntimeError("boom")
+
+    ex.spawn(bad, "bad")
+    ex.join()
+    assert ex.exit_signal.is_set()
+    assert ex.panicked == "bad"
+    assert fatal and "bad" in fatal[0]
+
+
+def test_noncritical_panic_does_not_shutdown():
+    ex = TaskExecutor()
+
+    def bad(exit_signal):
+        raise RuntimeError("boom")
+
+    ex.spawn(bad, "bad", critical=False)
+    ex.join()
+    assert not ex.exit_signal.is_set()
+
+
+def test_lockfile_excludes_live_and_takes_over_stale(tmp_path):
+    path = str(tmp_path / "beacon.lock")
+    with Lockfile(path):
+        with pytest.raises(LockfileError):
+            Lockfile(path).acquire()
+    # released: can acquire again
+    lk = Lockfile(path)
+    lk.acquire()
+    lk.release()
+    # stale lock (dead pid): taken over
+    with open(path, "w") as f:
+        f.write("999999999")
+    lk2 = Lockfile(path)
+    lk2.acquire()
+    assert int(open(path).read()) == os.getpid()
+    lk2.release()

@@ -1,0 +1,88 @@
+"""watch indexer + REST server and the remote-monitoring pusher."""
+
+import json
+import urllib.request
+
+import pytest
+
+from lighthouse_tpu.chain.beacon_chain import BeaconChain
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.state_transition.slot import types_for_slot
+from lighthouse_tpu.testing.harness import StateHarness, clone_state
+from lighthouse_tpu.tools.watch import WatchDB, WatchServer
+from lighthouse_tpu.types.spec import minimal_spec
+from lighthouse_tpu.utils.monitoring import MonitoringService, system_health
+
+
+@pytest.fixture(scope="module")
+def chain():
+    bls.set_backend("fake")
+    spec = minimal_spec()
+    harness = StateHarness.new(spec, 32)
+    ch = BeaconChain(spec, clone_state(harness.state, spec))
+    pending = []
+    for _ in range(10):
+        slot = harness.state.slot + 1
+        signed, _ = harness.produce_block(slot, attestations=pending, full_sync=False)
+        harness.apply_block(signed)
+        ch.slot_clock.set_slot(slot)
+        ch.per_slot_task()
+        ch.process_block(signed)
+        types = types_for_slot(spec, slot)
+        pending = harness.build_attestations(
+            clone_state(harness.state, spec), slot,
+            types.BeaconBlock.hash_tree_root(signed.message),
+        )
+    return ch
+
+
+def test_watch_indexes_and_serves(chain):
+    db = WatchDB()
+    n = db.update_from_chain(chain)
+    assert n == 11  # 10 produced + genesis
+    assert db.highest_slot() == 10
+    # incremental: nothing new on re-run
+    assert db.update_from_chain(chain) == 0
+    blk = db.block_at_slot(5)
+    assert blk["slot"] == 5 and blk["attestation_count"] >= 0
+    assert sum(db.proposer_counts().values()) == 11
+
+    db.record_participation(chain)
+    srv = WatchServer(db)
+    try:
+        with urllib.request.urlopen(srv.url + "/v1/blocks/5", timeout=5) as r:
+            got = json.loads(r.read().decode())
+        assert got["root"] == blk["root"]
+        with urllib.request.urlopen(srv.url + "/v1/status", timeout=5) as r:
+            assert json.loads(r.read().decode())["highest_slot"] == 10
+        with urllib.request.urlopen(srv.url + "/v1/proposers", timeout=5) as r:
+            assert sum(json.loads(r.read().decode()).values()) == 11
+    finally:
+        srv.close()
+
+
+def test_monitoring_payloads(chain):
+    posted = []
+    svc = MonitoringService(
+        "http://unused.invalid", chain=chain, period=0.01,
+        post_fn=posted.append,
+    )
+    assert svc.tick()
+    assert svc.sent == 1
+    kinds = {p["process"] for p in posted[0]}
+    assert kinds == {"system", "beaconnode"}
+    bn = next(p for p in posted[0] if p["process"] == "beaconnode")
+    assert bn["sync_beacon_head_slot"] == 10
+
+    sh = system_health()
+    assert sh["sys_virt_mem_total"] > 0
+    assert "process_mem_rss" in sh
+
+
+def test_monitoring_post_failure_counted():
+    def boom(_):
+        raise OSError("no route")
+
+    svc = MonitoringService("http://unused.invalid", post_fn=boom)
+    assert not svc.tick()
+    assert svc.errors == 1
