@@ -54,11 +54,12 @@ def build_fixture(rng):
     n_keys = N_SETS * N_PKS
     sks = [rng.randrange(1, R) for _ in range(n_keys)]
 
-    def batched_gen_mul(gen_jac_single, digits, ops):
+    def batched_gen_mul(gen_jac_single, bits, ops):
         base = jax.tree_util.tree_map(
-            lambda c: jnp.broadcast_to(c, (digits.shape[0],) + c.shape), gen_jac_single
+            lambda c: jnp.broadcast_to(c, (bits.shape[0],) + c.shape), gen_jac_single
         )
-        acc = co.scalar_mul_windowed(base, digits, ops)
+        # double-and-add: tiny scan body keeps the remote compile bounded
+        acc = co.scalar_mul_bits(base, bits, ops)
         x, y, inf = co.jac_to_affine(acc, ops)
         return lb.from_mont(x), lb.from_mont(y)
 
@@ -66,10 +67,10 @@ def build_fixture(rng):
     mul_g1 = jax.jit(lambda d: batched_gen_mul(co.g1_to_device(cv.G1_GEN), d, co.FQ_OPS))
     # chunked device calls: one fixed-shape compile, bounded per-call size
     # (very large single dispatches stall the remote-TPU tunnel)
-    CHUNK = 1024
+    CHUNK = 512
     xs, ys = [], []
     for i in range(0, n_keys, CHUNK):
-        digs = jnp.asarray(co.scalars_to_digits(sks[i : i + CHUNK], 256))
+        digs = jnp.asarray(co.scalars_to_bits(sks[i : i + CHUNK], 256))
         cx, cy = mul_g1(digs)
         xs.extend(lb.unpack_batch(np.asarray(cx)))
         ys.extend(lb.unpack_batch(np.asarray(cy)))
@@ -90,10 +91,10 @@ def build_fixture(rng):
         msgs.append(msg)
         hs.append(ph2c.hash_to_g2(msg, DST_POP))
     hd = co.g2_batch_to_device(hs)
-    sdigs = jnp.asarray(co.scalars_to_digits(agg_sks, 256))
+    sdigs = jnp.asarray(co.scalars_to_bits(agg_sks, 256))
     mul_g2 = jax.jit(
         lambda h, d: (lambda acc: co.jac_to_affine(acc, co.FQ2_OPS))(
-            co.scalar_mul_windowed(h, d, co.FQ2_OPS)
+            co.scalar_mul_bits(h, d, co.FQ2_OPS)
         )
     )
     sx, sy, _ = mul_g2(hd, sdigs)

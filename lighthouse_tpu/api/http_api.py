@@ -461,9 +461,12 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
         if not hasattr(st, "current_sync_committee"):
             raise ApiError(400, "pre-altair state")
         pk_to_idx = {bytes(v.pubkey): i for i, v in enumerate(st.validators)}
-        indices = [
-            pk_to_idx.get(bytes(pk), 0) for pk in st.current_sync_committee.pubkeys
-        ]
+        try:
+            indices = [
+                pk_to_idx[bytes(pk)] for pk in st.current_sync_committee.pubkeys
+            ]
+        except KeyError:
+            raise ApiError(500, "sync committee pubkey missing from registry")
         self._json({"data": {"validators": [_u(i) for i in indices]}})
 
     def get_fork_schedule(self):

@@ -302,6 +302,59 @@ class BeaconChain:
         chain.recompute_head()
         return chain
 
+    def revert_to_fork_boundary(self, bad_root: bytes):
+        """Corrupt-head recovery (fork_revert.rs): rebuild fork choice from
+        the finalized anchor, replaying every stored block EXCEPT the bad
+        block and its descendants. Returns the new head root."""
+        fin_epoch, fin_root = self.fork_choice.store.finalized_checkpoint
+        if fin_root == b"\x00" * 32 or fin_root not in self.block_slots:
+            fin_root = self.genesis_block_root
+        fin_slot = self.block_slots[fin_root]
+        types = types_for_slot(self.spec, fin_slot)
+        fin_state_root = self.state_root_by_block.get(fin_root)
+        fin_state = (
+            self.state_cache.get(fin_state_root)
+            or self.store.get_state(fin_state_root, types)
+            if fin_state_root
+            else None
+        )
+        if fin_state is None:
+            raise BlockError("finalized state unavailable for fork revert")
+        if fin_state_root:
+            self.state_cache[fin_state_root] = fin_state
+
+        self.fork_choice = ForkChoice(self.spec, fin_root, fin_slot, fin_state)
+        # replay stored descendants, skipping the bad branch
+        banned = {bad_root}
+        replay = sorted(
+            (slot, root)
+            for root, slot in self.block_slots.items()
+            if slot > fin_slot
+        )
+        for slot, root in replay:
+            t = types_for_slot(self.spec, slot)
+            sb = self.store.get_block(root, t)
+            if sb is None:
+                continue
+            if bytes(sb.message.parent_root) in banned or root in banned:
+                banned.add(root)
+                continue
+            sroot = self.state_root_by_block.get(root)
+            st = self.state_cache.get(sroot) if sroot else None
+            if st is None and sroot:
+                st = self.store.get_state(sroot, t)
+            if st is None:
+                banned.add(root)        # no state -> can't vouch for branch
+                continue
+            self.fork_choice.on_tick(max(self.current_slot, slot))
+            self.fork_choice.on_block(sb, root, st)
+        for root in banned:
+            self.block_slots.pop(root, None)
+            self.state_root_by_block.pop(root, None)
+            self.store.delete_block(root)
+        self.fork_choice.on_tick(self.current_slot)
+        return self.recompute_head()
+
     # ---------------------------------------------------------------- time
 
     @property
@@ -321,7 +374,16 @@ class BeaconChain:
     # ---------------------------------------------------------------- head
 
     def head_state(self):
-        return self.state_cache[self.state_root_by_block[self.head_root]]
+        sroot = self.state_root_by_block[self.head_root]
+        st = self.state_cache.get(sroot)
+        if st is None:
+            # evicted from the LRU (deep reorg/revert): reload from store
+            types = types_for_slot(self.spec, self.block_slots[self.head_root])
+            st = self.store.get_state(sroot, types)
+            if st is None:
+                raise BlockError("head state unavailable")
+            self.state_cache[sroot] = st
+        return st
 
     def head_block(self):
         types = types_for_slot(self.spec, self.block_slots[self.head_root])
@@ -565,6 +627,7 @@ class BeaconChain:
             int(block.slot),
             AttesterData(
                 beacon_block_root=block_root,
+                parent_root=parent_root,
                 source_epoch=int(state.current_justified_checkpoint.epoch),
                 source_root=bytes(state.current_justified_checkpoint.root),
                 target_epoch=epoch,

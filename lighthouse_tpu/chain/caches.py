@@ -128,6 +128,7 @@ class AttesterData:
     """Everything needed to serve attestation_data for one (slot, index)."""
 
     beacon_block_root: bytes
+    parent_root: bytes
     source_epoch: int
     source_root: bytes
     target_epoch: int
@@ -136,7 +137,9 @@ class AttesterData:
 
 class EarlyAttesterCache:
     """Serve attestations for the block imported THIS slot before the head
-    recompute publishes it (early_attester_cache.rs)."""
+    recompute publishes it (early_attester_cache.rs). Only consulted when
+    the cached block IS the head or extends it — an imported fork block
+    that LOST fork choice must not hijack attestation data."""
 
     def __init__(self):
         self._item: tuple[int, AttesterData] | None = None   # (slot, data)
@@ -144,9 +147,12 @@ class EarlyAttesterCache:
     def add(self, slot: int, data: AttesterData) -> None:
         self._item = (slot, data)
 
-    def try_attest(self, slot: int) -> AttesterData | None:
-        if self._item is not None and self._item[0] == slot:
-            return self._item[1]
+    def try_attest(self, slot: int, head_root: bytes) -> AttesterData | None:
+        if self._item is None or self._item[0] != slot:
+            return None
+        data = self._item[1]
+        if data.beacon_block_root == head_root or data.parent_root == head_root:
+            return data
         return None
 
 

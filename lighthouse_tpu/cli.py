@@ -270,6 +270,47 @@ def cmd_validator_create(args):
     return 0
 
 
+def cmd_wallet(args):
+    """account-manager wallet create/recover/validator-derive
+    (account_manager/src/wallet + validator create --wallet-name)."""
+    import json
+    import os
+
+    from .crypto import wallet as wl
+
+    if args.wallet_command == "create":
+        w = wl.create_wallet(args.name, args.password)
+        with open(args.output, "w") as f:
+            json.dump(w, f, indent=2)
+        print(f"wallet {w['uuid']} ({args.name}) -> {args.output}")
+        return 0
+    if args.wallet_command == "recover":
+        w = wl.recover_wallet(args.name, args.password, bytes.fromhex(args.seed))
+        with open(args.output, "w") as f:
+            json.dump(w, f, indent=2)
+        print(f"recovered wallet {w['uuid']} -> {args.output}")
+        return 0
+    if args.wallet_command == "validator":
+        with open(args.wallet) as f:
+            w = json.load(f)
+        os.makedirs(args.output_dir, exist_ok=True)
+        for _ in range(args.count):
+            idx = w["nextaccount"]
+            w, vk, wk = wl.create_validator(w, args.password, args.keystore_password)
+            with open(os.path.join(args.output_dir, f"keystore-{idx}.json"), "w") as f:
+                json.dump(vk, f)
+            with open(
+                os.path.join(args.output_dir, f"keystore-withdrawal-{idx}.json"), "w"
+            ) as f:
+                json.dump(wk, f)
+            print(f"validator {idx}: 0x{vk['pubkey']}")
+        with open(args.wallet, "w") as f:
+            json.dump(w, f, indent=2)
+        return 0
+    print("unknown wallet command", file=sys.stderr)
+    return 1
+
+
 def cmd_boot_node(args):
     """Standalone discovery bootstrap node (boot_node/src analog)."""
     import json
@@ -374,6 +415,26 @@ def build_parser() -> argparse.ArgumentParser:
     vcv.add_argument("--seed", default=None, help="hex seed (EIP-2333)")
     vcv.add_argument("--kdf-rounds", type=int, default=262144)
     vcv.set_defaults(fn=cmd_validator_create)
+
+    w = sub.add_parser("wallet", help="EIP-2386 wallet management")
+    wsub = w.add_subparsers(dest="wallet_command", required=True)
+    wc = wsub.add_parser("create")
+    wc.add_argument("--name", required=True)
+    wc.add_argument("--password", required=True)
+    wc.add_argument("--output", required=True)
+    wr = wsub.add_parser("recover")
+    wr.add_argument("--name", required=True)
+    wr.add_argument("--password", required=True)
+    wr.add_argument("--seed", required=True, help="hex seed")
+    wr.add_argument("--output", required=True)
+    wv = wsub.add_parser("validator")
+    wv.add_argument("--wallet", required=True)
+    wv.add_argument("--password", required=True, help="wallet password")
+    wv.add_argument("--keystore-password", required=True)
+    wv.add_argument("--count", type=int, default=1)
+    wv.add_argument("--output-dir", required=True)
+    for p_ in (wc, wr, wv):
+        p_.set_defaults(fn=cmd_wallet)
 
     boot = sub.add_parser("boot-node", help="run a standalone discovery boot node")
     boot.add_argument("--host", default="0.0.0.0")

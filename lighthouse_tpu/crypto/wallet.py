@@ -1,0 +1,79 @@
+"""EIP-2386 hierarchical-deterministic wallets.
+
+Parity surface: /root/reference/crypto/eth2_wallet — a JSON wallet holding
+an ENCRYPTED seed (the same EIP-2335 crypto module as keystores), a
+`nextaccount` counter, and EIP-2334-path account derivation:
+validator i's signing key is m/12381/3600/{i}/0/0 from the wallet seed.
+`create_validator` decrypts the seed, derives the next account, bumps the
+counter, and returns a passworded keystore — the account_manager wallet
+flow (account_manager/src/wallet + validator create --wallet-name)."""
+
+from __future__ import annotations
+
+import secrets
+import uuid
+
+from .key_derivation import derive_path, validator_signing_key_path, validator_withdrawal_key_path
+from .keystore import decrypt_keystore, encrypt_keystore
+
+
+class WalletError(Exception):
+    pass
+
+
+def create_wallet(name: str, password: str, seed: bytes | None = None) -> dict:
+    """New EIP-2386 wallet JSON (type hierarchical deterministic)."""
+    seed = seed if seed is not None else secrets.token_bytes(32)
+    crypto = encrypt_keystore(seed, password, kdf_function="pbkdf2")["crypto"]
+    return {
+        "crypto": crypto,
+        "name": name,
+        "nextaccount": 0,
+        "type": "hierarchical deterministic",
+        "uuid": str(uuid.uuid4()),
+        "version": 1,
+    }
+
+
+def decrypt_seed(wallet: dict, password: str) -> bytes:
+    if wallet.get("version") != 1:
+        raise WalletError(f"unsupported wallet version {wallet.get('version')}")
+    try:
+        return decrypt_keystore({"crypto": wallet["crypto"], "version": 4}, password)
+    except Exception as e:  # noqa: BLE001
+        raise WalletError(f"wallet decryption failed: {e}") from e
+
+
+def create_validator(wallet: dict, wallet_password: str,
+                     keystore_password: str) -> tuple[dict, dict, dict]:
+    """Derive the wallet's next account; returns (updated_wallet,
+    voting_keystore, withdrawal_keystore)."""
+    from . import bls
+
+    seed = decrypt_seed(wallet, wallet_password)
+    index = int(wallet["nextaccount"])
+
+    voting_sk = bls.SecretKey(derive_path(seed, validator_signing_key_path(index)))
+    withdrawal_sk = bls.SecretKey(derive_path(seed, validator_withdrawal_key_path(index)))
+
+    voting_ks = encrypt_keystore(
+        voting_sk.serialize(), keystore_password,
+        pubkey_hex=voting_sk.public_key().serialize().hex(),
+        path=validator_signing_key_path(index),
+        kdf_function="pbkdf2",
+    )
+    withdrawal_ks = encrypt_keystore(
+        withdrawal_sk.serialize(), keystore_password,
+        pubkey_hex=withdrawal_sk.public_key().serialize().hex(),
+        path=validator_withdrawal_key_path(index),
+        kdf_function="pbkdf2",
+    )
+    updated = dict(wallet, nextaccount=index + 1)
+    return updated, voting_ks, withdrawal_ks
+
+
+def recover_wallet(name: str, password: str, seed: bytes) -> dict:
+    """Re-create a wallet from a known seed (account_manager wallet
+    recover): derivation is deterministic, so accounts re-derive
+    identically."""
+    return create_wallet(name, password, seed=seed)

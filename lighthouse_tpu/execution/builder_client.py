@@ -1,0 +1,168 @@
+"""MEV builder (relay) client + blinded-block flow.
+
+Parity surface: /root/reference/beacon_node/builder_client/src/lib.rs and
+the builder paths of beacon_node/execution_layer/src/lib.rs — the
+builder-API trio:
+    POST /eth/v1/builder/validators            (validator registrations)
+    GET  /eth/v1/builder/header/{slot}/{parent_hash}/{pubkey}
+    POST /eth/v1/builder/blinded_blocks        (reveal the full payload)
+plus the bid-vs-local comparison the node applies before choosing the
+builder's header over the local payload (lib.rs builder-bid weighing).
+An in-process MockRelay (test_utils/mock_builder.rs analog) serves bids
+for payloads it builds over the mock EL."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from dataclasses import dataclass
+
+
+class BuilderError(Exception):
+    pass
+
+
+@dataclass
+class BuilderBid:
+    header: dict            # execution payload header (json fields)
+    value_wei: int
+    pubkey: bytes
+
+
+class BuilderHttpClient:
+    """Typed client for a builder relay (builder_client/src/lib.rs)."""
+
+    def __init__(self, url: str, timeout: float = 5.0):
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+
+    def _call(self, method: str, path: str, body=None):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.url + path, data=data, method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                return json.loads(r.read().decode() or "{}")
+        except urllib.error.HTTPError as e:
+            raise BuilderError(f"{method} {path} -> {e.code}") from e
+        except urllib.error.URLError as e:
+            raise BuilderError(f"{method} {path}: {e}") from e
+
+    def register_validators(self, registrations: list[dict]) -> None:
+        self._call("POST", "/eth/v1/builder/validators", registrations)
+
+    def get_header(self, slot: int, parent_hash: bytes, pubkey: bytes) -> BuilderBid:
+        got = self._call(
+            "GET",
+            f"/eth/v1/builder/header/{slot}/0x{parent_hash.hex()}/0x{pubkey.hex()}",
+        )
+        data = got["data"]["message"]
+        return BuilderBid(
+            header=data["header"],
+            value_wei=int(data["value"]),
+            pubkey=bytes.fromhex(got["data"]["message"]["pubkey"][2:]),
+        )
+
+    def submit_blinded_block(self, signed_blinded: dict) -> dict:
+        got = self._call("POST", "/eth/v1/builder/blinded_blocks", signed_blinded)
+        return got["data"]
+
+
+def choose_builder_or_local(bid: "BuilderBid | None", local_value_wei: int,
+                            builder_boost_factor: int = 100) -> str:
+    """The node's bid-weighing rule (execution_layer lib.rs): take the
+    builder payload only when boosted bid value beats the local payload.
+    builder_boost_factor is a percentage (100 = neutral, 0 = never)."""
+    if bid is None:
+        return "local"
+    if bid.value_wei * builder_boost_factor // 100 > local_value_wei:
+        return "builder"
+    return "local"
+
+
+class MockRelay:
+    """In-process builder relay over HTTP (mock_builder.rs analog): builds
+    payloads against a MockExecutionLayer and serves signed-ish bids."""
+
+    def __init__(self, el, value_wei: int = 10**18, host="127.0.0.1", port=0):
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        outer = self
+        self.el = el
+        self.value_wei = value_wei
+        self.registrations: list[dict] = []
+        self.revealed: list[dict] = []
+        self._payloads: dict[str, dict] = {}
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, payload, code=200):
+                out = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+
+            def do_POST(self):
+                ln = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(ln).decode() or "null")
+                if self.path == "/eth/v1/builder/validators":
+                    outer.registrations.extend(body)
+                    return self._json({})
+                if self.path == "/eth/v1/builder/blinded_blocks":
+                    outer.revealed.append(body)
+                    bh = body.get("block_hash", "")
+                    payload = outer._payloads.get(bh)
+                    if payload is None:
+                        return self._json({"message": "unknown header"}, 400)
+                    return self._json({"data": payload})
+                self._json({"message": "not found"}, 404)
+
+            def do_GET(self):
+                import re
+
+                m = re.match(
+                    r"^/eth/v1/builder/header/(\d+)/0x([0-9a-f]+)/0x([0-9a-f]+)$",
+                    self.path,
+                )
+                if not m:
+                    return self._json({"message": "not found"}, 404)
+                slot, parent_hash = int(m.group(1)), m.group(2)
+                # build a payload on the mock EL for this parent
+                resp = outer.el.forkchoice_updated(
+                    bytes.fromhex(parent_hash), b"\x00" * 32, b"\x00" * 32,
+                    attrs={"timestamp": slot * 12, "prevRandao": "0x00"},
+                )
+                pid = resp.get("payloadId")
+                if pid is None:
+                    return self._json({"message": "unknown parent"}, 400)
+                payload = outer.el.get_payload(pid)["executionPayload"]
+                outer._payloads[payload["blockHash"]] = payload
+                header = {k: v for k, v in payload.items() if k != "transactions"}
+                return self._json(
+                    {
+                        "version": "deneb",
+                        "data": {
+                            "message": {
+                                "header": header,
+                                "value": str(outer.value_wei),
+                                "pubkey": "0x" + "bb" * 48,
+                            },
+                            "signature": "0x" + "00" * 96,
+                        },
+                    }
+                )
+
+        self.server = ThreadingHTTPServer((host, port), Handler)
+        self.url = f"http://{host}:{self.server.server_address[1]}"
+        self._thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def close(self):
+        self.server.shutdown()

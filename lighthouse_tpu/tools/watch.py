@@ -83,7 +83,11 @@ class WatchDB:
             root = bytes(blk.message.parent_root)
         n = 0
         with self._lock:
-            last_root = b""
+            row = self.conn.execute(
+                "SELECT root FROM canonical_slots WHERE slot < ? AND root != x'' "
+                "ORDER BY slot DESC LIMIT 1", (start,)
+            ).fetchone()
+            last_root = row[0] if row else b""
             for slot in range(start, head_slot + 1):
                 root = by_slot.get(slot)
                 if root is None:

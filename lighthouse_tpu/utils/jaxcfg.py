@@ -23,7 +23,12 @@ def setup_compilation_cache(cache_dir: str | None = None) -> None:
         return
     import jax
 
-    path = cache_dir or _DEFAULT_CACHE_DIR
+    # Separate cache directories per platform: mixing CPU and axon/TPU
+    # entries in one directory made the AOT loader pull executables built
+    # with mismatched machine features (observed: cpu_aot_loader warnings
+    # followed by a segfault inside the cache writer).
+    platform = str(jax.config.jax_platforms or "default").split(",")[0]
+    path = cache_dir or os.path.join(_DEFAULT_CACHE_DIR, platform)
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
     # Cache everything, including small/fast compiles.

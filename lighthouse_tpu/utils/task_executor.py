@@ -72,28 +72,42 @@ class Lockfile:
         self._held = False
 
     def acquire(self) -> None:
-        while True:
+        import fcntl
+
+        # The check-stale/unlink/create sequence must be atomic across
+        # processes or two simultaneous starters can BOTH take over a stale
+        # lock (A unlinks B's fresh lock after B replaced the stale one).
+        # An flock on a side guard file serializes the whole attempt.
+        guard = os.open(self.path + ".guard", os.O_CREAT | os.O_RDWR)
+        try:
+            fcntl.flock(guard, fcntl.LOCK_EX)
+            while True:
+                try:
+                    fd = os.open(self.path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                    os.write(fd, str(os.getpid()).encode())
+                    os.close(fd)
+                    self._held = True
+                    return
+                except FileExistsError:
+                    try:
+                        with open(self.path) as f:
+                            pid = int(f.read().strip() or "0")
+                    except (OSError, ValueError):
+                        pid = 0
+                    if pid and _pid_alive(pid):
+                        raise LockfileError(
+                            f"{self.path} held by live pid {pid}"
+                        ) from None
+                    # stale lock: remove and retry (still under the guard)
+                    try:
+                        os.unlink(self.path)
+                    except OSError:
+                        pass
+        finally:
             try:
-                fd = os.open(self.path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-                os.write(fd, str(os.getpid()).encode())
-                os.close(fd)
-                self._held = True
-                return
-            except FileExistsError:
-                try:
-                    with open(self.path) as f:
-                        pid = int(f.read().strip() or "0")
-                except (OSError, ValueError):
-                    pid = 0
-                if pid and _pid_alive(pid):
-                    raise LockfileError(
-                        f"{self.path} held by live pid {pid}"
-                    ) from None
-                # stale lock: remove and retry
-                try:
-                    os.unlink(self.path)
-                except OSError:
-                    pass
+                fcntl.flock(guard, fcntl.LOCK_UN)
+            finally:
+                os.close(guard)
 
     def release(self) -> None:
         if self._held:

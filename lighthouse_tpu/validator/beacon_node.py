@@ -139,7 +139,7 @@ class InProcessBeaconNode:
 
         # early-attester path: a block imported THIS slot can be attested
         # to before the head recompute publishes it (early_attester_cache.rs)
-        early = chain.early_attester_cache.try_attest(slot)
+        early = chain.early_attester_cache.try_attest(slot, chain.head_root)
         if early is not None:
             return types.AttestationData.make(
                 slot=slot,

@@ -155,3 +155,46 @@ def test_attestation_gossip_batch(env):
         chain.apply_attestation_to_fork_choice(att, indices)
     # duplicates are deduped on second submission
     assert chain.verify_unaggregated_attestations(singles) == []
+
+
+def test_fork_revert_drops_bad_branch(env):
+    """revert_to_fork_boundary rebuilds fork choice without the bad branch
+    (fork_revert.rs analog)."""
+    harness, chain = env
+    # extend the canonical chain a couple more blocks
+    _produce_and_import(harness, chain, 2)
+    head_before = chain.head_root
+    head_slot = chain.head_state().slot
+
+    # declare the head block corrupt and revert
+    new_head = chain.revert_to_fork_boundary(head_before)
+    assert new_head != head_before
+    assert chain.head_state().slot == head_slot - 1
+    assert head_before not in chain.block_slots
+    assert not chain.store.block_exists(head_before)
+    # chain continues importing after the revert
+    _produce_and_import_after_revert(harness, chain)
+
+
+def _produce_and_import_after_revert(harness, chain):
+    """Produce a replacement block on the reverted head."""
+    from lighthouse_tpu.testing.harness import clone_state
+
+    # harness state is ahead of the chain (it applied the reverted block);
+    # produce via the chain's own produce_block on its head instead
+    slot = chain.head_state().slot + 2
+    chain.slot_clock.set_slot(slot)
+    chain.per_slot_task()
+    st = clone_state(chain.head_state(), chain.spec)
+    from lighthouse_tpu.state_transition.slot import process_slots, types_for_slot
+    import lighthouse_tpu.state_transition.accessors as acc
+
+    process_slots(st, chain.spec, slot)
+    proposer = acc.get_beacon_proposer_index(st, chain.spec)
+    epoch = slot // chain.spec.preset.SLOTS_PER_EPOCH
+    reveal = harness.randao_reveal(st, proposer, epoch)
+    block = chain.produce_block(slot, reveal)
+    types = types_for_slot(chain.spec, slot)
+    signed = harness.sign_block(block, types)
+    root = chain.process_block(signed)
+    assert chain.head_root == root

@@ -1,0 +1,58 @@
+"""MEV builder client against the in-process mock relay
+(builder_client + mock_builder.rs analog)."""
+
+import pytest
+
+from lighthouse_tpu.execution.builder_client import (
+    BuilderError,
+    BuilderHttpClient,
+    MockRelay,
+    choose_builder_or_local,
+)
+from lighthouse_tpu.execution.engine_api import MockExecutionLayer
+
+
+@pytest.fixture()
+def relay():
+    el = MockExecutionLayer()
+    r = MockRelay(el, value_wei=5 * 10**17)
+    yield el, r
+    r.close()
+
+
+def test_register_header_reveal_roundtrip(relay):
+    el, r = relay
+    client = BuilderHttpClient(r.url)
+    client.register_validators(
+        [{"message": {"pubkey": "0x" + "aa" * 48, "gas_limit": "30000000"}}]
+    )
+    assert len(r.registrations) == 1
+
+    parent = el.head
+    bid = client.get_header(5, parent, b"\xaa" * 48)
+    assert bid.value_wei == 5 * 10**17
+    assert bid.header["parentHash"] == "0x" + parent.hex()
+    # reveal: submitting the blinded block returns the full payload
+    payload = client.submit_blinded_block({"block_hash": bid.header["blockHash"]})
+    assert payload["blockHash"] == bid.header["blockHash"]
+    assert r.revealed
+
+
+def test_header_for_unknown_parent_rejected(relay):
+    el, r = relay
+    client = BuilderHttpClient(r.url)
+    with pytest.raises(BuilderError):
+        client.get_header(5, b"\x77" * 32, b"\xaa" * 48)
+
+
+def test_bid_weighing():
+    from lighthouse_tpu.execution.builder_client import BuilderBid
+
+    bid = BuilderBid(header={}, value_wei=100, pubkey=b"")
+    assert choose_builder_or_local(None, 0) == "local"
+    assert choose_builder_or_local(bid, 99) == "builder"
+    assert choose_builder_or_local(bid, 101) == "local"
+    # boost factor 0: never builder
+    assert choose_builder_or_local(bid, 0, builder_boost_factor=0) == "local"
+    # boost 200: builder wins up to 2x local value
+    assert choose_builder_or_local(bid, 150, builder_boost_factor=200) == "builder"

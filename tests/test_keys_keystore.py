@@ -66,3 +66,40 @@ def test_password_nfkd_control_strip():
     )
     # control chars are stripped per EIP-2335
     assert decrypt_keystore(ks, "password") == secret
+
+
+def test_eip2386_wallet_roundtrip():
+    """Wallet create -> derive validators -> recover from seed re-derives
+    the same keys (eth2_wallet parity)."""
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.crypto.keystore import decrypt_keystore
+    from lighthouse_tpu.crypto.wallet import (
+        WalletError,
+        create_validator,
+        create_wallet,
+        decrypt_seed,
+        recover_wallet,
+    )
+    import pytest
+
+    seed = b"\x42" * 32
+    w = create_wallet("w1", "wallet-pass", seed=seed)
+    assert w["nextaccount"] == 0 and w["type"] == "hierarchical deterministic"
+    assert decrypt_seed(w, "wallet-pass") == seed
+    with pytest.raises(WalletError):
+        decrypt_seed(w, "wrong")
+
+    w1, vk0, wk0 = create_validator(w, "wallet-pass", "ks-pass")
+    assert w1["nextaccount"] == 1
+    w2, vk1, _ = create_validator(w1, "wallet-pass", "ks-pass")
+    assert w2["nextaccount"] == 2
+    assert vk0["pubkey"] != vk1["pubkey"]
+    assert vk0["path"] == "m/12381/3600/0/0/0"
+
+    # recovery from the same seed re-derives account 0 identically
+    rw = recover_wallet("w1-recovered", "other-pass", seed)
+    _, rvk0, _ = create_validator(rw, "other-pass", "ks-pass")
+    assert rvk0["pubkey"] == vk0["pubkey"]
+    sk = decrypt_keystore(rvk0, "ks-pass")
+    pk = bls.SecretKey(int.from_bytes(sk, "big")).public_key().serialize()
+    assert pk.hex() == vk0["pubkey"]
