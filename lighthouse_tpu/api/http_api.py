@@ -621,6 +621,55 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
             }
         )
 
+    def get_lc_bootstrap(self, block_root_hex):
+        """GET /eth/v1/beacon/light_client/bootstrap/{block_root}."""
+        lc = getattr(self.chain, "light_client_cache", None)
+        if lc is None:
+            raise ApiError(404, "light client server not enabled")
+        root = bytes.fromhex(block_root_hex[2:])
+        bs = lc.bootstraps.get(root)
+        if bs is None:
+            raise ApiError(404, "no bootstrap for block")
+        self._json(
+            {
+                "data": {
+                    "header": {"beacon": {"slot": _u(bs.header.slot)}},
+                    "current_sync_committee_branch": [
+                        _hex(b) for b in bs.current_sync_committee_branch
+                    ],
+                }
+            }
+        )
+
+    def get_lc_optimistic(self):
+        lc = getattr(self.chain, "light_client_cache", None)
+        if lc is None or lc.latest_optimistic_update is None:
+            raise ApiError(404, "no optimistic update")
+        u = lc.latest_optimistic_update
+        self._json(
+            {
+                "data": {
+                    "attested_header": {"beacon": {"slot": _u(u.attested_header.slot)}},
+                    "signature_slot": _u(u.signature_slot),
+                }
+            }
+        )
+
+    def get_lc_finality(self):
+        lc = getattr(self.chain, "light_client_cache", None)
+        if lc is None or lc.latest_finality_update is None:
+            raise ApiError(404, "no finality update")
+        u = lc.latest_finality_update
+        self._json(
+            {
+                "data": {
+                    "attested_header": {"beacon": {"slot": _u(u.attested_header.slot)}},
+                    "finalized_header": {"beacon": {"slot": _u(u.finalized_header.slot)}},
+                    "signature_slot": _u(u.signature_slot),
+                }
+            }
+        )
+
     def post_pool_voluntary_exits(self):
         body = self._read_body()
         types = types_for_slot(self.chain.spec, self.chain.current_slot)
@@ -697,6 +746,9 @@ _ROUTES = [
     (r"/eth/v1/validator/beacon_committee_subscriptions", "POST", BeaconApiHandler.post_subscriptions),
     (r"/eth/v1/validator/sync_committee_subscriptions", "POST", BeaconApiHandler.post_subscriptions),
     (r"/eth/v2/debug/beacon/states/([^/]+)", "GET", BeaconApiHandler.get_debug_state),
+    (r"/eth/v1/beacon/light_client/bootstrap/(0x[0-9a-f]+)", "GET", BeaconApiHandler.get_lc_bootstrap),
+    (r"/eth/v1/beacon/light_client/optimistic_update", "GET", BeaconApiHandler.get_lc_optimistic),
+    (r"/eth/v1/beacon/light_client/finality_update", "GET", BeaconApiHandler.get_lc_finality),
     (r"/eth/v1/beacon/pool/voluntary_exits", "POST", BeaconApiHandler.post_pool_voluntary_exits),
     (r"/eth/v1/beacon/pool/voluntary_exits", "GET", BeaconApiHandler.get_pool_voluntary_exits),
 ]

@@ -141,6 +141,160 @@ class Eth1Cache:
         )
 
 
+class Eth1Service:
+    """Deposit-log scraper service (eth1/src/service.rs analog): polls an
+    eth1 JSON-RPC endpoint for DepositEvent logs from the deposit contract
+    and new block headers, feeding the Eth1Cache + DepositTree that back
+    eth1-data voting and deposit inclusion. The endpoint is duck-typed
+    (`eth_getLogs`/`eth_blockNumber`/`eth_getBlockByNumber` via .call) so
+    the mock EL's JSON-RPC double and a real HTTP client both slot in."""
+
+    DEPOSIT_EVENT_TOPIC = bytes.fromhex(
+        "649bbc62d0e31342afea4e5cd82d4049e7e1ee912fc0889aa790803be39038c5"
+    )
+
+    def __init__(self, rpc, spec, types, cache: "Eth1Cache | None" = None,
+                 follow_distance: int = 0, batch_blocks: int = 1000):
+        self.rpc = rpc
+        self.spec = spec
+        self.types = types
+        self.cache = cache or Eth1Cache()
+        self.follow_distance = follow_distance
+        self.batch_blocks = batch_blocks
+        self.last_processed_block = -1
+        self.errors = 0
+
+    @staticmethod
+    def decode_deposit_log(data: bytes):
+        """ABI-decode a DepositEvent log payload: five dynamic bytes fields
+        (pubkey, withdrawal_credentials, amount[le64], signature, index)."""
+        def dyn(offset_slot: int) -> bytes:
+            off = int.from_bytes(data[offset_slot * 32 : offset_slot * 32 + 32], "big")
+            ln = int.from_bytes(data[off : off + 32], "big")
+            return data[off + 32 : off + 32 + ln]
+
+        pubkey = dyn(0)
+        wc = dyn(1)
+        amount = int.from_bytes(dyn(2), "little")
+        signature = dyn(3)
+        index = int.from_bytes(dyn(4), "little")
+        return pubkey, wc, amount, signature, index
+
+    def poll_once(self) -> int:
+        """One scrape round: fetch logs/blocks up to head-follow_distance.
+        Returns deposits ingested; errors are counted, never raised (the
+        reference's service loop survives flaky endpoints)."""
+        try:
+            head = int(self.rpc.call("eth_blockNumber", []), 16)
+            target = head - self.follow_distance
+            if target <= self.last_processed_block:
+                return 0
+            frm = self.last_processed_block + 1
+            to = min(target, frm + self.batch_blocks - 1)
+            logs = self.rpc.call(
+                "eth_getLogs",
+                [
+                    {
+                        "fromBlock": hex(frm),
+                        "toBlock": hex(to),
+                        "address": "0x" + self.spec.deposit_contract_address.hex(),
+                        "topics": ["0x" + self.DEPOSIT_EVENT_TOPIC.hex()],
+                    }
+                ],
+            )
+            n = 0
+            for lg in logs:
+                pk, wc, amount, sig, _idx = self.decode_deposit_log(
+                    bytes.fromhex(lg["data"][2:])
+                )
+                dd = self.types.DepositData.make(
+                    pubkey=pk, withdrawal_credentials=wc, amount=amount, signature=sig
+                )
+                self.cache.add_deposit(dd, self.types)
+                n += 1
+            blk = self.rpc.call("eth_getBlockByNumber", [hex(to), False])
+            if blk is not None:
+                self.cache.add_block(
+                    Eth1Block(
+                        number=to,
+                        hash=bytes.fromhex(blk["hash"][2:]),
+                        timestamp=int(blk["timestamp"], 16),
+                        deposit_count=len(self.cache.tree),
+                        deposit_root=self.cache.tree.root(),
+                    )
+                )
+            self.last_processed_block = to
+            return n
+        except Exception:  # noqa: BLE001 — endpoint flakiness must not kill the node
+            self.errors += 1
+            return 0
+
+
+class MockEth1Rpc:
+    """JSON-RPC double serving deposit logs (mock eth1 endpoint for tests
+    and the simulator: eth1/src/service tests use the same shape)."""
+
+    def __init__(self, deposit_contract_address: bytes):
+        self.address = deposit_contract_address
+        self.blocks: list[dict] = [
+            {"hash": "0x" + "00" * 32, "timestamp": hex(1_600_000_000), "number": "0x0"}
+        ]
+        self.logs: list[dict] = []
+
+    def add_block(self, timestamp: int) -> int:
+        import hashlib
+
+        n = len(self.blocks)
+        h = hashlib.sha256(f"eth1-{n}".encode()).digest()
+        self.blocks.append(
+            {"hash": "0x" + h.hex(), "timestamp": hex(timestamp), "number": hex(n)}
+        )
+        return n
+
+    def add_deposit_log(self, block_number: int, pubkey: bytes, wc: bytes,
+                        amount_gwei: int, signature: bytes, index: int) -> None:
+        def dyn_tuple(fields: list[bytes]) -> bytes:
+            head = b""
+            tail = b""
+            base = 32 * len(fields)
+            for f in fields:
+                head += (base + len(tail)).to_bytes(32, "big")
+                tail += len(f).to_bytes(32, "big") + f + b"\x00" * ((32 - len(f) % 32) % 32)
+            return head + tail
+
+        data = dyn_tuple(
+            [
+                pubkey,
+                wc,
+                amount_gwei.to_bytes(8, "little"),
+                signature,
+                index.to_bytes(8, "little"),
+            ]
+        )
+        self.logs.append(
+            {
+                "blockNumber": hex(block_number),
+                "address": "0x" + self.address.hex(),
+                "topics": ["0x" + Eth1Service.DEPOSIT_EVENT_TOPIC.hex()],
+                "data": "0x" + data.hex(),
+            }
+        )
+
+    def call(self, method: str, params: list):
+        if method == "eth_blockNumber":
+            return hex(len(self.blocks) - 1)
+        if method == "eth_getBlockByNumber":
+            n = int(params[0], 16)
+            return self.blocks[n] if n < len(self.blocks) else None
+        if method == "eth_getLogs":
+            f = params[0]
+            frm, to = int(f["fromBlock"], 16), int(f["toBlock"], 16)
+            return [
+                lg for lg in self.logs if frm <= int(lg["blockNumber"], 16) <= to
+            ]
+        raise ValueError(f"unknown method {method}")
+
+
 def _voting_period_start_time(state, spec) -> int:
     period_slots = spec.preset.EPOCHS_PER_ETH1_VOTING_PERIOD * spec.preset.SLOTS_PER_EPOCH
     start_slot = state.slot - state.slot % period_slots

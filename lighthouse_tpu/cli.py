@@ -270,6 +270,41 @@ def cmd_validator_create(args):
     return 0
 
 
+def cmd_pretty_ssz(args):
+    """Decode an SSZ file and pretty-print it (lcli pretty-ssz analog)."""
+    import json as _json
+
+    from .state_transition.slot import types_for_slot
+
+    spec = _load_spec(args)
+    types = types_for_slot(spec, args.slot)
+    ctype = getattr(types, args.type, None)
+    if ctype is None:
+        print(f"unknown container type {args.type}", file=sys.stderr)
+        return 1
+    with open(args.file, "rb") as f:
+        value = ctype.deserialize(f.read())
+
+    def render(v):
+        if isinstance(v, (bytes, bytearray)):
+            return "0x" + bytes(v).hex()
+        if isinstance(v, (list, tuple)):
+            return [render(x) for x in v]
+        if hasattr(v, "ssz_type"):
+            return {
+                fld.name: render(getattr(v, fld.name))
+                for fld in v.ssz_type.fields
+            }
+        if isinstance(v, bool):
+            return v
+        if isinstance(v, int):
+            return str(v)
+        return v
+
+    print(_json.dumps(render(value), indent=2))
+    return 0
+
+
 def cmd_wallet(args):
     """account-manager wallet create/recover/validator-derive
     (account_manager/src/wallet + validator create --wallet-name)."""
@@ -336,20 +371,39 @@ def cmd_boot_node(args):
 
 
 def cmd_db_inspect(args):
+    """database_manager inspect/compact/prune/version analog."""
     from .store.native_kv import NativeKVStore
     from .store.kv import Column
 
     store = NativeKVStore(args.db)
+    print(f"schema version: {DB_SCHEMA_VERSION}")
     print(f"total entries: {len(store)}")
     for col in Column:
         n = sum(1 for _ in store.iter_column(col))
         if n:
             print(f"  {col.name}: {n}")
+    if getattr(args, "prune_states", False):
+        # drop hot states except the newest N (database_manager prune-states)
+        keep = args.keep_states
+        entries = []
+        for key, val in store.iter_column(Column.state_summary):
+            slot = int.from_bytes(val[:8], "little")
+            entries.append((slot, key))
+        entries.sort(reverse=True)
+        dropped = 0
+        for _slot, key in entries[keep:]:
+            store.delete(Column.state, key)
+            store.delete(Column.state_summary, key)
+            dropped += 1
+        print(f"pruned {dropped} states (kept {min(keep, len(entries))})")
     if args.compact:
         store.compact()
         print("compacted")
     store.close()
     return 0
+
+
+DB_SCHEMA_VERSION = 1
 
 
 # ------------------------------------------------------------------ parser
@@ -416,6 +470,13 @@ def build_parser() -> argparse.ArgumentParser:
     vcv.add_argument("--kdf-rounds", type=int, default=262144)
     vcv.set_defaults(fn=cmd_validator_create)
 
+    ps = sub.add_parser("pretty-ssz", help="decode + pretty-print an SSZ file")
+    _add_spec_arg(ps)
+    ps.add_argument("--type", required=True, help="container name, e.g. BeaconState")
+    ps.add_argument("--file", required=True)
+    ps.add_argument("--slot", type=int, default=0, help="fork selection slot")
+    ps.set_defaults(fn=cmd_pretty_ssz)
+
     w = sub.add_parser("wallet", help="EIP-2386 wallet management")
     wsub = w.add_subparsers(dest="wallet_command", required=True)
     wc = wsub.add_parser("create")
@@ -446,9 +507,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     boot.set_defaults(fn=cmd_boot_node)
 
-    db = sub.add_parser("db", help="inspect/compact a native store")
+    db = sub.add_parser("db", help="inspect/compact/prune a native store")
     db.add_argument("--db", required=True)
     db.add_argument("--compact", action="store_true")
+    db.add_argument("--prune-states", action="store_true")
+    db.add_argument("--keep-states", type=int, default=32)
     db.set_defaults(fn=cmd_db_inspect)
 
     return p

@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Warm the persistent compile cache for the production kernel stages.
+
+Usage: python scripts/warm_kernels.py [--sets 64] [--pks 128]
+
+Compiles each verification stage at the bench/production bucket shapes so
+subsequent processes (bench.py, the node) start with hot caches. Stages are
+warmed one at a time with progress logging — on the remote-TPU tunnel a
+compile must NEVER be interrupted (orphaned server-side compiles wedge the
+queue), so run this to completion."""
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sets", type=int, default=64)
+    ap.add_argument("--pks", type=int, default=128)
+    args = ap.parse_args()
+
+    from lighthouse_tpu.utils.jaxcfg import setup_compilation_cache
+
+    setup_compilation_cache()
+    import numpy as np
+    import jax
+
+    print(f"devices: {jax.devices()}", file=sys.stderr, flush=True)
+    from lighthouse_tpu.crypto.jaxbls import backend as be, h2c_ops as h2, limbs as lb
+
+    n, m = args.sets, args.pks
+    rng = np.random.default_rng(1)
+
+    def rl(shape):
+        a = rng.integers(0, 1 << 16, size=shape + (lb.NL,), dtype=np.uint32)
+        a[..., -1] = 0
+        return a
+
+    prepare, h2c_stage, pairs_stage, pairing_stage = be._get_stages()
+
+    stages = []
+
+    def warm(name, fn, *xs):
+        t0 = time.time()
+        r = fn(*xs)
+        jax.block_until_ready(r)
+        print(f"{name}: {time.time()-t0:.1f}s", file=sys.stderr, flush=True)
+        stages.append(name)
+        return r
+
+    z_pk, sig_acc, bad = warm(
+        "stage 1 prepare",
+        prepare,
+        rl((n, m)), rl((n, m)), np.ones((n, m), np.uint32),
+        rl((n, 2)), rl((n, 2)),
+        np.ones((n, be.Z_DIGITS), np.uint32), np.ones((n,), np.uint32),
+    )
+    h_jac = warm("stage 2 hash-to-G2", h2c_stage, rl((n, 2, 2)))
+    px, py, qxx, qyy, mask = warm(
+        "stage 3 pairs", pairs_stage, z_pk, h_jac, sig_acc,
+        np.ones((n,), np.uint32),
+    )
+    warm("stage 4 pairing", pairing_stage, px, py, qxx, qyy, mask)
+    print(f"warmed {len(stages)} stages at sets={n} pks={m}")
+
+
+if __name__ == "__main__":
+    main()

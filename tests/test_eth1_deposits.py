@@ -85,3 +85,45 @@ def test_eth1_vote_follow_distance():
     cache.add_block(recent)
     vote = cache.eth1_vote(state, spec, types)
     assert bytes(vote.block_hash) == old.hash
+
+
+def test_eth1_service_scrapes_logs():
+    """Eth1Service polls a JSON-RPC double, ABI-decodes DepositEvents and
+    feeds the cache/tree (eth1/src/service.rs analog)."""
+    from lighthouse_tpu.chain.eth1 import Eth1Service, MockEth1Rpc
+    from lighthouse_tpu.types.containers import spec_types
+    from lighthouse_tpu.types.spec import MINIMAL_PRESET, ForkName, minimal_spec
+
+    spec = minimal_spec()
+    types = spec_types(MINIMAL_PRESET, ForkName.deneb)
+    rpc = MockEth1Rpc(spec.deposit_contract_address)
+    svc = Eth1Service(rpc, spec, types, follow_distance=1)
+
+    for i in range(3):
+        bn = rpc.add_block(timestamp=1_600_000_000 + 14 * (i + 1))
+        rpc.add_deposit_log(
+            bn, pubkey=bytes([i]) * 48, wc=b"\x00" * 32,
+            amount_gwei=32 * 10**9, signature=b"\x01" * 96, index=i,
+        )
+
+    got = svc.poll_once()
+    # follow distance 1: the newest block is not yet scraped
+    assert got == 2
+    assert len(svc.cache.tree) == 2
+    assert svc.last_processed_block == 2
+    # incremental: nothing new until another block lands
+    assert svc.poll_once() == 0
+    rpc.add_block(timestamp=1_600_000_100)
+    assert svc.poll_once() == 1
+    assert len(svc.cache.tree) == 3
+    # decoded deposit data round-trips
+    dd = svc.cache.deposits[0]
+    assert bytes(dd.pubkey) == b"\x00" * 48
+    assert int(dd.amount) == 32 * 10**9
+    # endpoint failure is survived, not raised
+    class Boom:
+        def call(self, *a):
+            raise OSError("down")
+
+    svc.rpc = Boom()
+    assert svc.poll_once() == 0 and svc.errors == 1

@@ -149,3 +149,25 @@ def test_expanded_route_families(api):
     )
     got = _get(client, "/eth/v1/beacon/pool/voluntary_exits")["data"]
     assert got[0]["message"]["validator_index"] == "3"
+
+
+def test_light_client_routes(api):
+    harness, chain, client = api
+    import urllib.error
+
+    # not enabled -> 404
+    try:
+        _get(client, "/eth/v1/beacon/light_client/finality_update")
+        raise AssertionError("expected 404")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+    from lighthouse_tpu.chain.light_client import LightClientServerCache
+
+    lc = LightClientServerCache(chain.spec)
+    st = chain.head_state()
+    hdr = st.latest_block_header
+    lc.on_head(hdr, None, int(st.slot) + 1)
+    chain.light_client_cache = lc
+    got = _get(client, "/eth/v1/beacon/light_client/optimistic_update")["data"]
+    assert got["signature_slot"] == str(int(st.slot) + 1)

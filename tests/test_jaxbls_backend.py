@@ -26,6 +26,62 @@ def _mk_set(n_pks: int, msg: bytes, valid=True):
     return bls.SignatureSet(sig, pks, msg)
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _warm_stages_parallel():
+    """Cold-compile the four stage programs in PARALLEL THREADS at the test
+    bucket shapes (n=4 sets, m in {1,2,4,8}) before the tests run — XLA
+    releases the GIL while compiling, so the wall-clock cost of a cold
+    suite is max(stage) instead of sum(stages)."""
+    import threading
+
+    import numpy as np
+
+    from lighthouse_tpu.crypto.jaxbls import backend as be, h2c_ops as h2, limbs as lb
+
+    prepare, h2c_stage, pairs_stage, pairing_stage = be._get_stages()
+    rng_ = np.random.default_rng(0)
+
+    def rl(shape):
+        a = rng_.integers(0, 1 << 16, size=shape + (lb.NL,), dtype=np.uint32)
+        a[..., -1] = 0
+        return a
+
+    import jax
+
+    n = be.MIN_SETS
+
+    def w_prepare():
+        for m in (1, 2, 4, 8):
+            jax.block_until_ready(
+                prepare(
+                    rl((n, m)), rl((n, m)), np.ones((n, m), np.uint32),
+                    rl((n, 2)), rl((n, 2)),
+                    np.ones((n, be.Z_DIGITS), np.uint32), np.ones((n,), np.uint32),
+                )
+            )
+
+    def w_h2c():
+        jax.block_until_ready(h2c_stage(rl((n, 2, 2))))
+
+    def w_pairs_pairing():
+        z_pk = (rl((n,)), rl((n,)), rl((n,)))                 # (n,) G1 jac
+        h_jac = (rl((n, 2)), rl((n, 2)), rl((n, 2)))          # (n,) G2 jac
+        sig_acc = (rl((2,)), rl((2,)), rl((2,)))              # single G2 jac
+        out = pairs_stage(z_pk, h_jac, sig_acc, np.ones((n,), np.uint32))
+        jax.block_until_ready(out)
+        jax.block_until_ready(pairing_stage(*out))
+
+    threads = [
+        threading.Thread(target=f)
+        for f in (w_prepare, w_h2c, w_pairs_pairing)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    yield
+
+
 @pytest.fixture(autouse=True)
 def _restore_backend():
     yield
