@@ -145,6 +145,7 @@ class BeaconChain:
         )
 
         self.state_cache = StateLRU(capacity=32)
+        self._advanced: dict = {}   # state-advance timer output (head -> next-slot state)
         self.state_cache[state_root] = genesis_state
         self.block_times = BlockTimesCache()
         self.attester_cache = AttesterCache()
@@ -373,6 +374,24 @@ class BeaconChain:
 
     # ---------------------------------------------------------------- head
 
+    def advance_head_state(self) -> bool:
+        """state_advance_timer.rs analog: during the slot TAIL, pre-compute
+        the head state advanced to the next slot so block production and
+        first-thing-next-slot attestation serving skip the epoch-transition
+        latency. The advanced state is cached under a synthetic key that
+        _state_for_block consults first."""
+        next_slot = self.current_slot + 1
+        head = self.head_root
+        cached = self._advanced.get(head)
+        if cached is not None and cached.slot >= next_slot:
+            return False
+        state = clone_state(self.head_state(), self.spec)
+        if state.slot >= next_slot:
+            return False
+        process_slots(state, self.spec, next_slot)
+        self._advanced = {head: state}      # only ever one entry (the head)
+        return True
+
     def head_state(self):
         sroot = self.state_root_by_block[self.head_root]
         st = self.state_cache.get(sroot)
@@ -490,7 +509,14 @@ class BeaconChain:
                 )
 
     def _state_for_block(self, parent_root: bytes, slot: int):
-        """Parent post-state advanced to `slot` (cheap_state_advance)."""
+        """Parent post-state advanced to `slot` (cheap_state_advance).
+
+        Consults the state-advance timer's pre-computed next-slot state
+        first — the common case (a block building on the head at the next
+        slot) then skips the advance entirely."""
+        adv = self._advanced.get(parent_root)
+        if adv is not None and adv.slot == slot:
+            return clone_state(adv, self.spec)
         state_root = self.state_root_by_block.get(parent_root)
         if state_root is None or state_root not in self.state_cache:
             raise BlockError("parent state unavailable")

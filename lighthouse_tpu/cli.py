@@ -96,6 +96,9 @@ def cmd_bn(args):
             chain.per_slot_task()
             HEAD_SLOT.set(chain.head_state().slot)
             print(f"slot {clock.now()} head {chain.head_root.hex()[:8]}")
+            # slot tail: pre-compute the next-slot head state
+            # (state_advance_timer analog)
+            chain.advance_head_state()
 
     executor.spawn(slot_timer, "slot-timer")
     try:

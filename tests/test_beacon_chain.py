@@ -198,3 +198,18 @@ def _produce_and_import_after_revert(harness, chain):
     signed = harness.sign_block(block, types)
     root = chain.process_block(signed)
     assert chain.head_root == root
+
+
+def test_state_advance_timer(env):
+    """advance_head_state pre-computes the next-slot state; the next
+    block's cheap_state_advance hits it (state_advance_timer.rs)."""
+    harness, chain = env
+    head = chain.head_root
+    assert chain.advance_head_state() is True
+    adv = chain._advanced[head]
+    assert adv.slot == chain.current_slot + 1
+    # idempotent for the same slot
+    assert chain.advance_head_state() is False
+    # the pre-advanced state serves _state_for_block without re-advancing
+    got = chain._state_for_block(head, int(adv.slot))
+    assert got.slot == adv.slot
