@@ -153,6 +153,40 @@ class BeaconNodeHttpClient:
         self._post("/eth/v1/beacon/pool/attestations", payload)
         return len(attestations)
 
+    def attestation_data(self, slot: int, committee_index: int, types=None):
+        got = self._get(
+            f"/eth/v1/validator/attestation_data?slot={slot}"
+            f"&committee_index={committee_index}"
+        )["data"]
+        if types is None:
+            from ..state_transition.slot import types_for_slot
+
+            types = types_for_slot(self.spec_obj, slot) if hasattr(self, "spec_obj") else None
+        if types is None:
+            return got
+        return types.AttestationData.make(
+            slot=int(got["slot"]),
+            index=int(got["index"]),
+            beacon_block_root=bytes.fromhex(got["beacon_block_root"][2:]),
+            source=types.Checkpoint.make(
+                epoch=int(got["source"]["epoch"]),
+                root=bytes.fromhex(got["source"]["root"][2:]),
+            ),
+            target=types.Checkpoint.make(
+                epoch=int(got["target"]["epoch"]),
+                root=bytes.fromhex(got["target"]["root"][2:]),
+            ),
+        )
+
+    def produce_block(self, slot: int, randao_reveal: bytes, types, graffiti: bytes | None = None):
+        path = (
+            f"/eth/v3/validator/blocks/{slot}?randao_reveal=0x{randao_reveal.hex()}"
+        )
+        if graffiti is not None:
+            path += f"&graffiti=0x{graffiti.hex()}"
+        got = self._get(path)
+        return types.BeaconBlock.deserialize(bytes.fromhex(got["data"][2:]))
+
     def publish_block(self, signed_block, types) -> None:
         self._post(
             "/eth/v2/beacon/blocks",

@@ -621,6 +621,48 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
             }
         )
 
+    def get_attestation_data(self):
+        """GET /eth/v1/validator/attestation_data?slot=&committee_index=."""
+        from ..validator.beacon_node import InProcessBeaconNode
+
+        q = self._query()
+        slot = int(q["slot"])
+        cidx = int(q.get("committee_index", 0))
+        data = InProcessBeaconNode(self.chain).attestation_data(slot, cidx)
+        self._json(
+            {
+                "data": {
+                    "slot": _u(data.slot),
+                    "index": _u(data.index),
+                    "beacon_block_root": _hex(data.beacon_block_root),
+                    "source": _checkpoint(data.source),
+                    "target": _checkpoint(data.target),
+                }
+            }
+        )
+
+    def get_produce_block(self, slot):
+        """GET /eth/v3/validator/blocks/{slot}?randao_reveal=0x... — returns
+        the unsigned block as SSZ hex (the VC signs and POSTs it back)."""
+        q = self._query()
+        reveal_hex = q.get("randao_reveal")
+        if not reveal_hex:
+            raise ApiError(400, "randao_reveal required")
+        slot = int(slot)
+        graffiti = bytes.fromhex(q["graffiti"][2:]) if "graffiti" in q else b"\x00" * 32
+        block = self.chain.produce_block(
+            slot, bytes.fromhex(reveal_hex[2:]),
+            op_pool=self.op_pool, graffiti=graffiti,
+        )
+        types = types_for_slot(self.chain.spec, slot)
+        self._json(
+            {
+                "version": self.chain.spec.fork_name_at_slot(slot).name,
+                "execution_payload_blinded": False,
+                "data": _hex(types.BeaconBlock.serialize(block)),
+            }
+        )
+
     def get_lc_bootstrap(self, block_root_hex):
         """GET /eth/v1/beacon/light_client/bootstrap/{block_root}."""
         lc = getattr(self.chain, "light_client_cache", None)
@@ -746,6 +788,8 @@ _ROUTES = [
     (r"/eth/v1/validator/beacon_committee_subscriptions", "POST", BeaconApiHandler.post_subscriptions),
     (r"/eth/v1/validator/sync_committee_subscriptions", "POST", BeaconApiHandler.post_subscriptions),
     (r"/eth/v2/debug/beacon/states/([^/]+)", "GET", BeaconApiHandler.get_debug_state),
+    (r"/eth/v1/validator/attestation_data", "GET", BeaconApiHandler.get_attestation_data),
+    (r"/eth/v3/validator/blocks/(\d+)", "GET", BeaconApiHandler.get_produce_block),
     (r"/eth/v1/beacon/light_client/bootstrap/(0x[0-9a-f]+)", "GET", BeaconApiHandler.get_lc_bootstrap),
     (r"/eth/v1/beacon/light_client/optimistic_update", "GET", BeaconApiHandler.get_lc_optimistic),
     (r"/eth/v1/beacon/light_client/finality_update", "GET", BeaconApiHandler.get_lc_finality),

@@ -164,10 +164,22 @@ class OperationPool:
             )
         ][: spec.preset.MAX_PROPOSER_SLASHINGS]
         attester_slashings = self.attester_slashings[: spec.preset.MAX_ATTESTER_SLASHINGS]
+        def exit_includable(e) -> bool:
+            # mirror process_voluntary_exit's non-signature checks: packing
+            # an op the state transition would reject invalidates the block
+            vi = int(e.message.validator_index)
+            if vi >= len(state.validators):
+                return False
+            v = state.validators[vi]
+            return (
+                v.exit_epoch == 2**64 - 1
+                and h.is_active_validator(v, epoch)
+                and epoch >= e.message.epoch
+                and epoch >= v.activation_epoch + spec.shard_committee_period
+            )
+
         exits = [
-            e
-            for e in self.voluntary_exits.values()
-            if state.validators[e.message.validator_index].exit_epoch == 2**64 - 1
+            e for e in self.voluntary_exits.values() if exit_includable(e)
         ][: spec.preset.MAX_VOLUNTARY_EXITS]
         changes = list(self.bls_changes.values())[
             : spec.preset.MAX_BLS_TO_EXECUTION_CHANGES

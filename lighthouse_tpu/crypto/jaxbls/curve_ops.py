@@ -298,10 +298,13 @@ _PSI_CONSTS: dict = {}
 
 
 def _psi_consts():
+    # cache NUMPY arrays and convert per use: caching a jnp array built
+    # lazily INSIDE a traced call leaks that trace's constant-tracer into
+    # every later trace (UnexpectedTracerError once another jit reuses it)
     if not _PSI_CONSTS:
-        _PSI_CONSTS["cx"] = tw.fq2_to_device(pc.PSI_CX)
-        _PSI_CONSTS["cy"] = tw.fq2_to_device(pc.PSI_CY)
-    return _PSI_CONSTS["cx"], _PSI_CONSTS["cy"]
+        _PSI_CONSTS["cx"] = np.asarray(tw._fq2_const_np(pc.PSI_CX))
+        _PSI_CONSTS["cy"] = np.asarray(tw._fq2_const_np(pc.PSI_CY))
+    return jnp.asarray(_PSI_CONSTS["cx"]), jnp.asarray(_PSI_CONSTS["cy"])
 
 
 def psi_jac(p):

@@ -23,3 +23,13 @@ jax.config.update("jax_platforms", "cpu")
 from lighthouse_tpu.utils.jaxcfg import setup_compilation_cache
 
 setup_compilation_cache()
+
+# Under pytest the persistent cache is READ-ONLY by default: XLA:CPU's
+# executable serializer intermittently segfaults when writing cache entries
+# late in a long multi-program process (observed at jax 0.9.0 in
+# compilation_cache.put_executable_and_time after ~150 compiled programs;
+# standalone compiles of the same programs never crash). Warming runs opt
+# back in with LIGHTHOUSE_TPU_CACHE_WRITE=1 (scripts/warm_test_cache.sh) —
+# re-run until green; each pass extends the cache, normal runs only read.
+if os.environ.get("LIGHTHOUSE_TPU_CACHE_WRITE") != "1":
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 10**9)

@@ -171,3 +171,32 @@ def test_light_client_routes(api):
     chain.light_client_cache = lc
     got = _get(client, "/eth/v1/beacon/light_client/optimistic_update")["data"]
     assert got["signature_slot"] == str(int(st.slot) + 1)
+
+
+def test_attestation_data_and_block_production_over_http(api):
+    harness, chain, client = api
+    from lighthouse_tpu.state_transition.slot import types_for_slot
+    import lighthouse_tpu.state_transition.accessors as acc
+    from lighthouse_tpu.testing.harness import clone_state
+    from lighthouse_tpu.state_transition.slot import process_slots
+
+    slot = chain.head_state().slot + 1
+    chain.slot_clock.set_slot(slot)
+    chain.per_slot_task()
+    types = types_for_slot(chain.spec, slot)
+    data = client.attestation_data(slot, 0, types)
+    assert int(data.slot) == slot
+    assert bytes(data.beacon_block_root) == chain.head_root
+
+    st = clone_state(chain.head_state(), chain.spec)
+    process_slots(st, chain.spec, slot)
+    proposer = acc.get_beacon_proposer_index(st, chain.spec)
+    epoch = slot // chain.spec.preset.SLOTS_PER_EPOCH
+    reveal = harness.randao_reveal(st, proposer, epoch)
+    block = client.produce_block(slot, bytes(96), types) if False else client.produce_block(
+        slot, __import__("builtins").bytes(reveal), types
+    )
+    assert int(block.slot) == slot
+    signed = harness.sign_block(block, types)
+    client.publish_block(signed, types)
+    assert chain.head_root == types.BeaconBlock.hash_tree_root(block)

@@ -92,17 +92,34 @@ def jax_backend():
     return bls_api.set_backend("jax")
 
 
-def _run_sharded(mesh, args):
+def _run_staged(args, mesh=None):
+    """The production staged pipeline; with a mesh, every input is sharded
+    along the sets axis (collectives cross shards in the reductions)."""
     from lighthouse_tpu.crypto.jaxbls import backend as be
+    from lighthouse_tpu.crypto.jaxbls import h2c_ops as h2
 
-    be._get_kernel()
-    shardings = tuple(
-        NamedSharding(mesh, Pspec("sets", *([None] * (a.ndim - 1)))) for a in args
+    be._init_consts()
+    pk_x, pk_y, pk_mask, sig_x, sig_y, us, z_digits, set_mask = args
+    if mesh is not None:
+        def shard(a):
+            return jax.device_put(
+                a, NamedSharding(mesh, Pspec("sets", *([None] * (a.ndim - 1))))
+            )
+        pk_x, pk_y, pk_mask, sig_x, sig_y, us, z_digits, set_mask = (
+            shard(a) for a in (pk_x, pk_y, pk_mask, sig_x, sig_y, us, z_digits, set_mask)
+        )
+    prepare, h2c_stage, pairs_stage, pairing_stage = be._get_stages()
+    z_pk, sig_acc, bad = prepare(
+        pk_x, pk_y, pk_mask, sig_x, sig_y, z_digits, set_mask
     )
-    placed = tuple(jax.device_put(a, s) for a, s in zip(args, shardings))
-    step = jax.jit(be._verify_kernel, in_shardings=shardings)
-    ok, bad = step(*placed)
+    h_jac = h2c_stage(us)
+    px, py, qxx, qyy, pair_mask = pairs_stage(z_pk, h_jac, sig_acc, set_mask)
+    ok = pairing_stage(px, py, qxx, qyy, pair_mask)
     return bool(np.asarray(ok)) and not bool(np.asarray(bad))
+
+
+def _run_sharded(mesh, args):
+    return _run_staged(args, mesh=mesh)
 
 
 def test_sharded_valid_batch_verifies(mesh, jax_backend):
@@ -123,13 +140,9 @@ def test_sharded_invalid_batch_rejects(mesh, jax_backend):
 
 
 def test_sharded_matches_unsharded_bit_identical(mesh, jax_backend):
-    from lighthouse_tpu.crypto.jaxbls import backend as be
-
     sets, rands = _build_sets(8, 2, seed=0x53)
     args = _marshal(jax_backend, sets, rands)
 
-    kernel = jax.jit(be._verify_kernel)
-    ok1, bad1 = kernel(*args)
+    unsharded = _run_staged(args, mesh=None)
     sharded = _run_sharded(mesh, args)
-    unsharded = bool(np.asarray(ok1)) and not bool(np.asarray(bad1))
     assert sharded == unsharded == True  # noqa: E712
