@@ -621,6 +621,45 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
             }
         )
 
+    def get_lh_database_info(self):
+        """/lighthouse_tpu/database/info (ops endpoint family analog)."""
+        chain = self.chain
+        store = chain.store
+        counts = {}
+        try:
+            from ..store.kv import Column
+
+            for col in Column:
+                n = sum(1 for _ in store.hot.iter_column(col))
+                if n:
+                    counts[col.name] = n
+        except Exception:  # noqa: BLE001 — memory stores may not iterate
+            pass
+        self._json(
+            {
+                "data": {
+                    "split_slot": _u(store.split_slot),
+                    "anchor_slot": _u(chain.anchor_slot),
+                    "oldest_block_slot": _u(chain.oldest_block_slot),
+                    "hot_columns": counts,
+                }
+            }
+        )
+
+    def get_lh_health(self):
+        """/lighthouse_tpu/health: process+system snapshot."""
+        from ..utils.monitoring import system_health
+
+        self._json({"data": system_health()})
+
+    def get_lh_peers_scores(self):
+        net = getattr(self.chain, "_network_node", None)
+        out = []
+        if net is not None:
+            for pid in net.peer_manager.connected_peers():
+                out.append({"peer_id": pid, "score": net.peer_manager.score(pid)})
+        self._json({"data": out})
+
     def get_attestation_data(self):
         """GET /eth/v1/validator/attestation_data?slot=&committee_index=."""
         from ..validator.beacon_node import InProcessBeaconNode
@@ -788,6 +827,9 @@ _ROUTES = [
     (r"/eth/v1/validator/beacon_committee_subscriptions", "POST", BeaconApiHandler.post_subscriptions),
     (r"/eth/v1/validator/sync_committee_subscriptions", "POST", BeaconApiHandler.post_subscriptions),
     (r"/eth/v2/debug/beacon/states/([^/]+)", "GET", BeaconApiHandler.get_debug_state),
+    (r"/lighthouse_tpu/database/info", "GET", BeaconApiHandler.get_lh_database_info),
+    (r"/lighthouse_tpu/health", "GET", BeaconApiHandler.get_lh_health),
+    (r"/lighthouse_tpu/peers/scores", "GET", BeaconApiHandler.get_lh_peers_scores),
     (r"/eth/v1/validator/attestation_data", "GET", BeaconApiHandler.get_attestation_data),
     (r"/eth/v3/validator/blocks/(\d+)", "GET", BeaconApiHandler.get_produce_block),
     (r"/eth/v1/beacon/light_client/bootstrap/(0x[0-9a-f]+)", "GET", BeaconApiHandler.get_lc_bootstrap),

@@ -820,9 +820,24 @@ class BeaconChain:
             valid = ok or bls.verify_signature_sets([s])
             if valid:
                 self.observed_attesters.add((att.data.target.epoch, attesting[0]))
-                self.naive_attestation_pool.insert(
-                    att, types_for_slot(self.spec, att.data.slot)
-                )
+                types = types_for_slot(self.spec, att.data.slot)
+                self.naive_attestation_pool.insert(att, types)
+                if self.slasher is not None:
+                    from ..slasher.slasher import AttestationRecord
+
+                    indexed = types.IndexedAttestation.make(
+                        attesting_indices=attesting, data=att.data,
+                        signature=att.signature,
+                    )
+                    self.slasher.accept_attestation(
+                        AttestationRecord(
+                            validator_index=attesting[0],
+                            source=int(att.data.source.epoch),
+                            target=int(att.data.target.epoch),
+                            data_root=types.AttestationData.hash_tree_root(att.data),
+                            indexed=indexed,
+                        )
+                    )
                 results.append((att, attesting))
         return results
 

@@ -82,7 +82,21 @@ def cmd_bn(args):
     clock = SystemTimeSlotClock(state.genesis_time, spec.seconds_per_slot)
     chain = BeaconChain(spec, state, store=store, slot_clock=clock)
 
-    server, _t, port = serve(chain, port=args.http_port)
+    from .chain.op_pool import OperationPool
+
+    op_pool = OperationPool(spec)
+    slasher_svc = None
+    if args.slasher:
+        from .slasher.service import SlasherService
+        from .state_transition.slot import types_for_slot as _tfs
+
+        slasher_svc = SlasherService(
+            op_pool=op_pool, types=_tfs(spec, 0)
+        )
+        chain.slasher = slasher_svc
+        print("slasher enabled")
+
+    server, _t, port = serve(chain, op_pool=op_pool, port=args.http_port)
     print(f"HTTP API on :{port}")
     mserver, mport = metrics_http_server(port=args.metrics_port)
     print(f"metrics on :{mport}/metrics")
@@ -96,6 +110,11 @@ def cmd_bn(args):
             chain.per_slot_task()
             HEAD_SLOT.set(chain.head_state().slot)
             print(f"slot {clock.now()} head {chain.head_root.hex()[:8]}")
+            now = clock.now() or 0
+            if slasher_svc is not None and now % spec.preset.SLOTS_PER_EPOCH == 0:
+                found = slasher_svc.process()
+                if found:
+                    print(f"slasher: broadcast {found} slashings")
             # slot tail: pre-compute the next-slot head state
             # (state_advance_timer analog)
             chain.advance_head_state()
@@ -424,6 +443,7 @@ def build_parser() -> argparse.ArgumentParser:
     bn.add_argument("--interop-validators", type=int, default=None)
     bn.add_argument("--genesis-time", type=int, default=None)
     bn.add_argument("--bls-backend", default="python", choices=["python", "jax", "fake"])
+    bn.add_argument("--slasher", action="store_true", help="enable the slasher")
     bn.set_defaults(fn=cmd_bn)
 
     vc = sub.add_parser("vc", help="run a validator client")

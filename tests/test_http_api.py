@@ -200,3 +200,13 @@ def test_attestation_data_and_block_production_over_http(api):
     signed = harness.sign_block(block, types)
     client.publish_block(signed, types)
     assert chain.head_root == types.BeaconBlock.hash_tree_root(block)
+
+
+def test_lighthouse_ops_endpoints(api):
+    harness, chain, client = api
+    info = _get(client, "/lighthouse_tpu/database/info")["data"]
+    assert "split_slot" in info and "oldest_block_slot" in info
+    health = _get(client, "/lighthouse_tpu/health")["data"]
+    assert health["sys_virt_mem_total"] > 0
+    scores = _get(client, "/lighthouse_tpu/peers/scores")["data"]
+    assert scores == []

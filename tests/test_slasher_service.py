@@ -1,0 +1,109 @@
+"""SlasherService end-to-end: chain-fed equivocations become on-chain
+slashing containers in the op pool (slasher/service analog)."""
+
+import pytest
+
+from lighthouse_tpu.chain.beacon_chain import BeaconChain, BlockError
+from lighthouse_tpu.chain.op_pool import OperationPool
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.slasher.service import SlasherService
+from lighthouse_tpu.state_transition.slot import types_for_slot
+from lighthouse_tpu.testing.harness import StateHarness, clone_state
+from lighthouse_tpu.types.spec import MINIMAL_PRESET, ForkName, minimal_spec
+from lighthouse_tpu.types.containers import spec_types
+
+
+@pytest.fixture()
+def env():
+    bls.set_backend("fake")
+    spec = minimal_spec()
+    harness = StateHarness.new(spec, 32)
+    chain = BeaconChain(spec, clone_state(harness.state, spec))
+    op_pool = OperationPool(spec)
+    types = spec_types(MINIMAL_PRESET, ForkName.deneb)
+    svc = SlasherService(op_pool=op_pool, types=types)
+    chain.slasher = svc
+    return harness, chain, op_pool, svc
+
+
+def test_double_proposal_becomes_proposer_slashing(env):
+    harness, chain, op_pool, svc = env
+    slot = harness.state.slot + 1
+    chain.slot_clock.set_slot(slot)
+    chain.per_slot_task()
+    # two DIFFERENT blocks for the same (slot, proposer)
+    signed_a, _ = harness.produce_block(slot, attestations=[], full_sync=False)
+    block_b = signed_a.message.copy_with(graffiti=b"\x99" * 32) if hasattr(
+        signed_a.message, "graffiti"
+    ) else None
+    if block_b is None:
+        body_b = signed_a.message.body.copy_with(graffiti=b"\x99" * 32)
+        block_b = signed_a.message.copy_with(body=body_b)
+    types = types_for_slot(harness.spec, slot)
+    signed_b = harness.sign_block(block_b, types)
+
+    chain.verify_block_for_gossip(signed_a)
+    chain.process_block(signed_a)
+    with pytest.raises(BlockError, match="equivocation"):
+        chain.verify_block_for_gossip(signed_b)
+
+    assert svc.process() == 1
+    ps = list(op_pool.proposer_slashings.values())
+    assert len(ps) == 1
+    s = ps[0]
+    assert s.signed_header_1.message.slot == slot
+    assert (
+        types.BeaconBlockHeader.hash_tree_root(s.signed_header_1.message)
+        != types.BeaconBlockHeader.hash_tree_root(s.signed_header_2.message)
+    )
+
+
+def test_double_vote_becomes_attester_slashing(env):
+    harness, chain, op_pool, svc = env
+    slot = harness.state.slot + 1
+    chain.slot_clock.set_slot(slot)
+    chain.per_slot_task()
+    signed, _ = harness.produce_block(slot, attestations=[], full_sync=False)
+    harness.apply_block(signed)
+    chain.process_block(signed)
+    types = types_for_slot(harness.spec, slot)
+    head_root = types.BeaconBlock.hash_tree_root(signed.message)
+
+    aggs = harness.build_attestations(
+        clone_state(harness.state, harness.spec), slot, head_root
+    )
+    # validator v attests twice to the SAME target epoch with different data
+    agg = aggs[0]
+    n = len(agg.aggregation_bits)
+    pos = next(i for i, b in enumerate(agg.aggregation_bits) if b)
+    bits = [i == pos for i in range(n)]
+    att1 = types.Attestation.make(
+        aggregation_bits=bits, data=agg.data, signature=agg.signature
+    )
+    data2 = agg.data.copy_with(beacon_block_root=b"\x13" * 32)
+    att2 = types.Attestation.make(
+        aggregation_bits=bits, data=data2, signature=agg.signature
+    )
+    r1 = chain.verify_unaggregated_attestations([att1])
+    assert r1
+    # dedup guard would drop the second in gossip; feed the slasher directly
+    # (the reference slasher also ingests from blocks and RPC)
+    from lighthouse_tpu.slasher.slasher import AttestationRecord
+
+    v = r1[0][1][0]
+    indexed2 = types.IndexedAttestation.make(
+        attesting_indices=[v], data=data2, signature=att2.signature
+    )
+    svc.accept_attestation(
+        AttestationRecord(
+            validator_index=v,
+            source=int(data2.source.epoch),
+            target=int(data2.target.epoch),
+            data_root=types.AttestationData.hash_tree_root(data2),
+            indexed=indexed2,
+        )
+    )
+    assert svc.process() == 1
+    assert len(op_pool.attester_slashings) == 1
+    sl = op_pool.attester_slashings[0]
+    assert list(sl.attestation_1.attesting_indices) == [v]

@@ -80,3 +80,30 @@ def test_committees_partition():
     parts = [h.compute_committee(ids, i, 3) for i in range(3)]
     flat = [x for p in parts for x in p]
     assert flat == ids
+
+
+def test_compare_fields_reports_paths():
+    """compare_fields pinpoints the diverging field (compare_fields_derive
+    analog for tests)."""
+    import pytest
+
+    from lighthouse_tpu.testing.compare_fields import assert_equal, compare_fields
+    from lighthouse_tpu.types.containers import spec_types
+    from lighthouse_tpu.types.spec import MINIMAL_PRESET, ForkName
+
+    t = spec_types(MINIMAL_PRESET, ForkName.deneb)
+    a = t.Checkpoint.make(epoch=1, root=b"\x01" * 32)
+    b = t.Checkpoint.make(epoch=2, root=b"\x01" * 32)
+    diffs = compare_fields(a, b)
+    assert diffs == [("epoch", 1, 2)]
+
+    h1 = t.BeaconBlockHeader.make(
+        slot=1, proposer_index=2, parent_root=b"\x00" * 32,
+        state_root=b"\x03" * 32, body_root=b"\x04" * 32,
+    )
+    h2 = h1.copy_with(state_root=b"\x05" * 32)
+    diffs = compare_fields(h1, h2)
+    assert [p for p, *_ in diffs] == ["state_root"]
+    with pytest.raises(AssertionError, match="state_root"):
+        assert_equal(h1, h2)
+    assert_equal(h1, h1)
