@@ -112,9 +112,39 @@ def build_fixture(rng):
     return sets
 
 
+WATCHDOG_SECS = 40 * 60
+
+
+def _arm_watchdog():
+    """If the remote-TPU tunnel wedges (a known failure mode: orphaned
+    server-side compiles serialize the queue), fail loudly with a JSON line
+    instead of hanging the driver forever."""
+    import signal
+
+    def on_alarm(_sig, _frm):
+        print(
+            json.dumps(
+                {
+                    "metric": "BLS signature-sets verified/sec (TPU tunnel unresponsive; watchdog fired)",
+                    "value": 0,
+                    "unit": "sets/s",
+                    "vs_baseline": 0,
+                }
+            ),
+            flush=True,
+        )
+        import os
+
+        os._exit(3)
+
+    signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(WATCHDOG_SECS)
+
+
 def main():
     from lighthouse_tpu.utils.jaxcfg import setup_compilation_cache
 
+    _arm_watchdog()
     setup_compilation_cache()
     import jax
     import random

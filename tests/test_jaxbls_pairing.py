@@ -42,6 +42,21 @@ _full_pairing = jax.jit(
 _product_check = jax.jit(po.pairing_product_is_one)
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _no_cache_writes_for_this_module():
+    """Serializing this module's product-check executable reproducibly
+    segfaults the XLA:CPU cache writer when it follows the full suite's
+    compile sequence (5/5 warming passes died at the same line). Disable
+    persistent-cache WRITES for the module; its programs recompile each
+    cold run instead of crashing the process."""
+    import jax as _jax
+
+    prev = _jax.config.jax_persistent_cache_min_compile_time_secs
+    _jax.config.update("jax_persistent_cache_min_compile_time_secs", 10**9)
+    yield
+    _jax.config.update("jax_persistent_cache_min_compile_time_secs", prev)
+
+
 def test_single_pairing_matches_python():
     a = rng.randrange(1, R)
     b = rng.randrange(1, R)
