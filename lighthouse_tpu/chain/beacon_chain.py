@@ -645,23 +645,27 @@ class BeaconChain:
         self.fork_choice.on_tick(self.current_slot)
         self.fork_choice.on_block(signed_block, block_root, state, is_timely=timely)
         self.block_times.imported(block_root)
-        # early-attester data: attest to this block before the head moves
-        from .caches import AttesterData
-
-        epoch = h.compute_epoch_at_slot(block.slot, spec)
-        self.early_attester_cache.add(
-            int(block.slot),
-            AttesterData(
-                beacon_block_root=block_root,
-                parent_root=parent_root,
-                source_epoch=int(state.current_justified_checkpoint.epoch),
-                source_root=bytes(state.current_justified_checkpoint.root),
-                target_epoch=epoch,
-                target_root=self._target_root_for(state, epoch, block_root),
-            ),
-        )
         prev_head = self.head_root
         self.recompute_head()
+        # Early-attester data: serve attestations for the block imported this
+        # slot — but only when fork choice actually selected it as head
+        # (beacon_chain.rs only caches on `new_head_root == block_root`); a
+        # losing fork block must not hijack attestation data.
+        if self.head_root == block_root:
+            from .caches import AttesterData
+
+            epoch = h.compute_epoch_at_slot(block.slot, spec)
+            self.early_attester_cache.add(
+                int(block.slot),
+                AttesterData(
+                    beacon_block_root=block_root,
+                    parent_root=parent_root,
+                    source_epoch=int(state.current_justified_checkpoint.epoch),
+                    source_root=bytes(state.current_justified_checkpoint.root),
+                    target_epoch=epoch,
+                    target_root=self._target_root_for(state, epoch, block_root),
+                ),
+            )
         from ..utils.metrics import BLOCK_OBSERVED_TO_HEAD, BLOCK_OBSERVED_TO_IMPORT
 
         d = self.block_times.import_delay(block_root)

@@ -96,6 +96,13 @@ class BeaconProcessor:
         # in-flight device submissions: (handle, continuation) FIFO
         self._inflight: deque = deque()
         self._lock = threading.Lock()
+        # Serializes chain-mutating execution (runners + continuations)
+        # across workers: without it two workers could concurrently mutate
+        # observed-* caches / naive pools / fork-choice votes that the
+        # gossip path otherwise serializes. Device waits (handle.result())
+        # deliberately happen OUTSIDE this lock so workers still overlap
+        # host marshalling with device verification.
+        self._exec_lock = threading.RLock()
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -144,13 +151,19 @@ class BeaconProcessor:
             kind = batch[0].kind
             runner = batch[0].run_batch
             payloads = [it.payload for it in batch]
-            self._handle_result(runner(payloads))
+            with self._exec_lock:
+                result = runner(payloads)
+            self._handle_result(result)
             self.processed[kind] += len(batch)
         elif single is not None:
             if single.run is not None:
-                self._handle_result(single.run())
+                with self._exec_lock:
+                    result = single.run()
+                self._handle_result(result)
             elif single.run_batch is not None:
-                self._handle_result(single.run_batch([single.payload]))
+                with self._exec_lock:
+                    result = single.run_batch([single.payload])
+                self._handle_result(result)
             self.processed[single.kind] += 1
 
     def _handle_result(self, result) -> None:
@@ -177,7 +190,9 @@ class BeaconProcessor:
             if not self._inflight:
                 return False
             handle, cont = self._inflight.popleft()
-        cont(handle.result())
+        res = handle.result()          # device wait: outside the exec lock
+        with self._exec_lock:
+            cont(res)                  # chain mutation: serialized
         return True
 
     def drain_inflight(self) -> int:

@@ -9,8 +9,9 @@ first-class components).
   "seen before?" — this answers "seen a CONFLICTING one?".
 - BlockTimesCache — gossip-arrival/import/head timestamps per root, the
   observability + re-org-decision feed (block_times_cache.rs).
-- EarlyAttesterCache — attest to a just-imported block before the head
-  recompute lands (early_attester_cache.rs).
+- EarlyAttesterCache — serve attestation data for the block imported this
+  slot, populated only when fork choice selected it as head
+  (early_attester_cache.rs).
 - AttesterCache — the minimal (justified, target) data needed to serve
   attestation_data without holding a full state (attester_cache.rs).
 - StateLRU — bounded promise-style state cache with insertion-order
@@ -136,10 +137,11 @@ class AttesterData:
 
 
 class EarlyAttesterCache:
-    """Serve attestations for the block imported THIS slot before the head
-    recompute publishes it (early_attester_cache.rs). Only consulted when
-    the cached block IS the head or extends it — an imported fork block
-    that LOST fork choice must not hijack attestation data."""
+    """Serve attestations for the block imported THIS slot
+    (early_attester_cache.rs). Populated only when fork choice selected the
+    imported block as head (beacon_chain.rs `new_head_root == block_root`),
+    and served only while that block is still the head — an imported fork
+    block that LOST fork choice must not hijack attestation data."""
 
     def __init__(self):
         self._item: tuple[int, AttesterData] | None = None   # (slot, data)
@@ -151,7 +153,7 @@ class EarlyAttesterCache:
         if self._item is None or self._item[0] != slot:
             return None
         data = self._item[1]
-        if data.beacon_block_root == head_root or data.parent_root == head_root:
+        if data.beacon_block_root == head_root:
             return data
         return None
 

@@ -32,6 +32,27 @@ class BlobError(Exception):
     """Blob sidecar rejected (blob_verification.rs GossipBlobError analog)."""
 
 
+class BlobIgnoreError(Exception):
+    """Blob sidecar gossip IGNORE: do not propagate, do not penalize the
+    sender (blob_verification.rs maps these to GossipBlobError variants
+    handled as ignore, not reject).
+
+    `retriable=True` means verification could not run YET (parent/state
+    unavailable, future slot): a retransmission should be re-validated once
+    the dependency arrives. `retriable=False` is terminal (duplicate,
+    pre-finalization): the dedup cache must keep suppressing replays or a
+    peer could farm free validation work by replaying old sidecars.
+    `missing_parent` is set when the blocking dependency is specifically an
+    unimported parent block — the condition a local reprocess queue can key
+    a retry on (other retriable causes have no import event to wait for)."""
+
+    def __init__(self, msg: str, retriable: bool = True,
+                 missing_parent: bytes | None = None):
+        super().__init__(msg)
+        self.retriable = retriable
+        self.missing_parent = missing_parent
+
+
 class AvailabilityPendingError(Exception):
     """Block cannot import yet: blobs missing (held in the DA checker)."""
 
@@ -225,16 +246,16 @@ def verify_blob_sidecar_for_gossip(chain, sidecar, verify_kzg: bool = True) -> b
     if int(sidecar.index) >= spec.max_blobs(fork):
         raise BlobError(f"blob index {sidecar.index} out of range")
     if slot > chain.current_slot:
-        raise BlobError("future slot")
+        raise BlobIgnoreError("future slot")
     key = (block_root, int(sidecar.index))
     if key in chain.observed_blob_sidecars:
-        raise BlobError("sidecar already seen")
+        raise BlobIgnoreError("sidecar already seen", retriable=False)
     fin_epoch = chain.fork_choice.store.finalized_checkpoint[0]
     if slot <= h.compute_start_slot_at_epoch(fin_epoch, spec):
-        raise BlobError("sidecar older than finalization")
+        raise BlobIgnoreError("sidecar older than finalization", retriable=False)
     parent_root = bytes(header.parent_root)
     if not chain.store.block_exists(parent_root):
-        raise BlobError("parent unknown")
+        raise BlobIgnoreError("parent unknown", missing_parent=parent_root)
     parent_slot = chain.block_slots.get(parent_root)
     if parent_slot is not None and parent_slot >= slot:
         raise BlobError("not later than parent")
@@ -242,8 +263,15 @@ def verify_blob_sidecar_for_gossip(chain, sidecar, verify_kzg: bool = True) -> b
     if not verify_commitment_inclusion(types, spec, sidecar):
         raise BlobError("bad commitment inclusion proof")
 
-    # proposer signature over the header (same domain as block proposals)
-    state = chain._state_for_block(parent_root, slot)
+    # proposer signature over the header (same domain as block proposals).
+    # State unavailability means verification CANNOT RUN — that must surface
+    # as ignore, not accept (the sig/KZG checks below never happened).
+    from .beacon_chain import BlockError
+
+    try:
+        state = chain._state_for_block(parent_root, slot)
+    except BlockError as e:
+        raise BlobIgnoreError(f"state unavailable: {e}") from e
     if int(header.proposer_index) >= len(state.validators):
         raise BlobError("proposer index out of range")
     batch = SignatureBatch()

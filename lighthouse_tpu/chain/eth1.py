@@ -202,11 +202,24 @@ class Eth1Service:
                     }
                 ],
             )
+            decoded = [
+                self.decode_deposit_log(bytes.fromhex(lg["data"][2:])) for lg in logs
+            ]
+            # A missing/duplicated/reordered log would silently corrupt the
+            # deposit tree root and every later proof: each event's own index
+            # MUST be the next tree leaf (service.rs errors on non-consecutive
+            # deposit logs). A retried range may legitimately re-serve an
+            # already-ingested prefix (a prior round ingested, then failed
+            # before advancing last_processed_block) — skip idx < base, then
+            # require the remainder to be exactly consecutive from base.
+            # Validate BEFORE ingesting so a bad range is retried intact.
+            base = len(self.cache.tree)
+            fresh = [d for d in decoded if d[4] >= base]
+            if any(idx != base + i for i, (_, _, _, _, idx) in enumerate(fresh)):
+                self.errors += 1
+                return 0
             n = 0
-            for lg in logs:
-                pk, wc, amount, sig, _idx = self.decode_deposit_log(
-                    bytes.fromhex(lg["data"][2:])
-                )
+            for pk, wc, amount, sig, _idx in fresh:
                 dd = self.types.DepositData.make(
                     pubkey=pk, withdrawal_credentials=wc, amount=amount, signature=sig
                 )

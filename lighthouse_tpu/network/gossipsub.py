@@ -44,6 +44,15 @@ SCORE_FIRST_DELIVERY = 1.0
 SCORE_INVALID_MESSAGE = -20.0
 SCORE_IWANT_SPAM = -1.0
 
+# Handler sentinel: ignore AND allow redelivery to re-validate (validation
+# could not run yet). Distinct from None, which is a terminal ignore that
+# keeps the message deduped.
+IGNORE_RETRY = object()
+# After this many retriable ignores of the same message id the ignore
+# becomes terminal: the mid stays deduped, so replaying one dependency-less
+# message cannot farm unbounded validation work.
+MAX_IGNORE_RETRIES = 3
+
 
 @dataclass
 class Rpc:
@@ -173,7 +182,11 @@ class Gossipsub:
 
     `send(peer_id, rpc_bytes)` is injected by the owner (transport layer);
     validation handlers are registered per topic and return True (accept +
-    propagate) or False (reject)."""
+    propagate), False (reject + penalize), None (terminal ignore: no
+    propagation, no score change, message stays deduped), or IGNORE_RETRY
+    (ignore because validation could not run yet — additionally drops the
+    message from the seen cache so a retransmission re-validates once the
+    missing dependency arrives)."""
 
     def __init__(self, local_id: str, send, peer_manager=None, rng=None):
         self.local_id = local_id
@@ -190,6 +203,9 @@ class Gossipsub:
         self.seen: dict[bytes, float] = {}
         self.backoff: dict[tuple[str, str], float] = {}   # (peer, topic) -> until
         self.scores: dict[str, float] = defaultdict(float)
+        # mid -> count of IGNORE_RETRY outcomes; caps how many times one
+        # message can reopen its own dedup slot (replay-farming guard)
+        self._ignore_retries: dict[bytes, int] = {}
         self._lock = threading.RLock()
 
         # stats
@@ -341,9 +357,31 @@ class Gossipsub:
                 msg = GossipMessage(topic, data, mid, peer_id)
                 msg.decompressed = payload
                 try:
-                    accept = bool(handler(msg))
+                    accept = handler(msg)
                 except Exception:
                     accept = False
+        if accept is IGNORE_RETRY:
+            # Validation could not run yet (e.g. parent unavailable) —
+            # neither propagate nor penalize the sender, and drop the
+            # message id from the seen cache so a retransmission can
+            # re-validate once the missing dependency arrives (redelivery
+            # plus the owner's local reprocess queue stand in for the
+            # reference's ReprocessQueue). Bounded per mid: past
+            # MAX_IGNORE_RETRIES the ignore turns terminal and the mid
+            # stays deduped.
+            with self._lock:
+                n = self._ignore_retries.get(mid, 0) + 1
+                if n <= MAX_IGNORE_RETRIES:
+                    self._ignore_retries[mid] = n
+                    self.seen.pop(mid, None)
+                else:
+                    self._ignore_retries.pop(mid, None)
+            return
+        if accept is None:
+            # Terminal IGNORE (duplicate, pre-finalization): no propagation,
+            # no score change — but the seen entry MUST stay, or replaying
+            # one old message would farm unbounded free validation work.
+            return
         if not accept:
             self.rejected += 1
             self._score(peer_id, SCORE_INVALID_MESSAGE)
@@ -366,6 +404,11 @@ class Gossipsub:
             for mid, ts in list(self.seen.items()):
                 if now - ts > SEEN_TTL:
                     del self.seen[mid]
+                    self._ignore_retries.pop(mid, None)
+            # retry counters for mids no longer deduped die with the mesh
+            # churn; hard-bound the map so it cannot grow without limit
+            while len(self._ignore_retries) > 4096:
+                self._ignore_retries.pop(next(iter(self._ignore_retries)))
             for topic in list(self.subscriptions):
                 mesh = self.mesh[topic]
                 mesh &= self.peers  # drop vanished peers

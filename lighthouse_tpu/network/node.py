@@ -12,10 +12,14 @@ import threading
 import time
 
 from ..chain.beacon_chain import AttestationError, BlockError
-from ..chain.data_availability import AvailabilityPendingError, BlobError
+from ..chain.data_availability import (
+    AvailabilityPendingError,
+    BlobError,
+    BlobIgnoreError,
+)
 from ..state_transition.slot import types_for_slot
 from . import gossip as gs
-from .gossipsub import Gossipsub
+from .gossipsub import IGNORE_RETRY, Gossipsub
 from .peer_manager import PeerManager
 from .rpc import Protocol, RpcHandler
 from .sync import SyncManager
@@ -48,6 +52,13 @@ class NetworkNode:
         self._hb_thread = threading.Thread(target=self._heartbeat_loop, daemon=True)
         self._hb_thread.start()
         self._lock = threading.Lock()  # serializes chain mutation from gossip
+        # Local reprocess queue (ReprocessQueue analog): sidecars whose
+        # parent block hasn't arrived yet, keyed by the missing parent root.
+        # Gossip redelivery is NOT guaranteed (mesh peers forward once), so
+        # retriable-ignored sidecars are retried locally when a block
+        # imports; by-root sync remains the fallback of last resort.
+        self._pending_sidecars: dict[bytes, list] = {}
+        self._pending_sidecar_count = 0
 
         self._subscribe_core(subnets)
 
@@ -191,17 +202,75 @@ class NetworkNode:
                     signed, block_root=root, proposal_already_verified=True
                 )
             except AvailabilityPendingError:
+                # block is NOT in the store yet — child sidecars still can't
+                # verify, so no pending retry here (it would drop them)
                 return True          # propagate; blobs will complete it
             except BlockError:
                 return False
+            self._retry_pending_sidecars(root)
         return True
 
+    MAX_PENDING_SIDECARS = 64
+
+    @staticmethod
+    def _sidecar_key(sidecar) -> tuple:
+        # the proposer signature commits to the whole header; (sig, index)
+        # identifies a sidecar without a tree-hash
+        return (int(sidecar.index), bytes(sidecar.signed_block_header.signature))
+
+    def _stash_pending_sidecar(self, parent: bytes, sidecar) -> None:
+        """Hold a sidecar blocked on an unimported parent for local retry.
+        Deduped per bucket: IGNORE_RETRY redeliveries of the same sidecar
+        must not eat multiple stash slots."""
+        bucket = self._pending_sidecars.setdefault(parent, [])
+        key = self._sidecar_key(sidecar)
+        if any(self._sidecar_key(sc) == key for sc in bucket):
+            return
+        if self._pending_sidecar_count >= self.MAX_PENDING_SIDECARS:
+            # evict the oldest dependency bucket wholesale
+            victim = next(iter(self._pending_sidecars), None)
+            if victim is None:
+                return
+            evicted = self._pending_sidecars.pop(victim)
+            self._pending_sidecar_count -= len(evicted)
+            if victim == parent:
+                bucket = self._pending_sidecars.setdefault(parent, [])
+        bucket.append(sidecar)
+        self._pending_sidecar_count += 1
+
+    def _retry_pending_sidecars(self, imported_root: bytes) -> None:
+        """A block just imported: sidecars of its children can now verify.
+        A retry that fails RETRIABLY (e.g. on a different missing parent)
+        is re-stashed rather than dropped. Caller holds self._lock."""
+        waiting = self._pending_sidecars.pop(imported_root, None)
+        if not waiting:
+            return
+        self._pending_sidecar_count -= len(waiting)
+        for sc in waiting:
+            try:
+                self.chain.process_gossip_blob(sc)
+            except BlobIgnoreError as e:
+                if e.retriable and e.missing_parent is not None:
+                    self._stash_pending_sidecar(e.missing_parent, sc)
+            except Exception:
+                continue
+
     def _lookup_parent(self, peer_id: str, signed) -> None:
+        parent_root = bytes(signed.message.parent_root)
         try:
-            self.sync.lookup_parent_chain(peer_id, bytes(signed.message.parent_root))
-            self.chain.process_block(signed)
+            self.sync.lookup_parent_chain(peer_id, parent_root)
         except Exception:
-            pass
+            return
+        # the parent just imported: this block's OWN stashed sidecars (keyed
+        # on its parent) must be fed to the DA checker BEFORE process_block,
+        # or the block would raise AvailabilityPending while the node holds
+        # every sidecar locally
+        self._retry_pending_sidecars(parent_root)
+        try:
+            root = self.chain.process_block(signed)
+        except Exception:
+            return
+        self._retry_pending_sidecars(root)
 
     def _mk_attestation_handler(self):
         def handler(msg) -> bool:
@@ -242,7 +311,7 @@ class NetworkNode:
                     self.op_pool.insert_attestation(att, indices, types)
             return bool(results)
 
-    def _on_blob(self, msg) -> bool:
+    def _on_blob(self, msg):
         spec = self.chain.spec
         types = types_for_slot(spec, self.chain.current_slot)
         try:
@@ -252,10 +321,22 @@ class NetworkNode:
         with self._lock:
             try:
                 self.chain.process_gossip_blob(sidecar)
+            except BlobIgnoreError as e:
+                # verification could not run (retriable: allow redelivery;
+                # if the blocker is a missing parent, also queue a local
+                # retry for that parent's import) vs terminal ignore
+                # (duplicate/finalized: stay deduped)
+                if e.retriable:
+                    if e.missing_parent is not None:
+                        self._stash_pending_sidecar(e.missing_parent, sidecar)
+                    return IGNORE_RETRY
+                return None
             except BlobError:
                 return False
             except (BlockError, AvailabilityPendingError):
-                return True          # sidecar itself was valid; propagate
+                # sidecar itself fully verified; only the joined block could
+                # not import (yet) — still propagate
+                return True
         return True
 
     # ------------------------------------------------------------ publishing

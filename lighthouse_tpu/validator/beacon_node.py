@@ -137,8 +137,9 @@ class InProcessBeaconNode:
         types = types_for_slot(spec, slot)
         epoch = h.compute_epoch_at_slot(slot, spec)
 
-        # early-attester path: a block imported THIS slot can be attested
-        # to before the head recompute publishes it (early_attester_cache.rs)
+        # early-attester path: serve the block imported this slot straight
+        # from the cache (populated only when it won fork choice) without
+        # touching a full state (early_attester_cache.rs)
         early = chain.early_attester_cache.try_attest(slot, chain.head_root)
         if early is not None:
             return types.AttestationData.make(

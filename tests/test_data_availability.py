@@ -13,6 +13,7 @@ from lighthouse_tpu.chain.beacon_chain import BeaconChain, BlockError
 from lighthouse_tpu.chain.data_availability import (
     AvailabilityPendingError,
     BlobError,
+    BlobIgnoreError,
     DataAvailabilityChecker,
     build_sidecars,
     commitment_inclusion_proof,
@@ -150,9 +151,9 @@ def test_gossip_blob_rejections(env):
     with pytest.raises(BlobError):
         verify_blob_sidecar_for_gossip(chain, bad)
 
-    # accept + dedup
+    # accept + dedup (duplicates are IGNOREd, not penalized)
     assert verify_blob_sidecar_for_gossip(chain, sc)
-    with pytest.raises(BlobError, match="seen"):
+    with pytest.raises(BlobIgnoreError, match="seen"):
         verify_blob_sidecar_for_gossip(chain, sc)
 
 

@@ -98,6 +98,124 @@ def test_message_id_stable():
     assert mid1 == mid2 and len(mid1) == 20
 
 
+def test_gossipsub_ignore_semantics():
+    """Tri-state validation: IGNORE_RETRY reopens dedup (bounded), terminal
+    ignore (None) keeps the message deduped, neither moves the score."""
+    from lighthouse_tpu.network.gossipsub import (
+        IGNORE_RETRY,
+        MAX_IGNORE_RETRIES,
+        Gossipsub,
+    )
+
+    g = Gossipsub("local", lambda p, b: None)
+    calls = {"n": 0}
+    mode = {"v": IGNORE_RETRY}
+
+    def handler(msg):
+        calls["n"] += 1
+        return mode["v"]
+
+    g.subscribe("t", handler)
+    g.add_peer("p")
+    payload = snappy.compress(b"dep-missing")
+    mid = message_id("t", payload)
+
+    # retriable ignore: handler re-runs on redelivery, but only up to the cap
+    for i in range(MAX_IGNORE_RETRIES + 3):
+        g._on_message("p", "t", payload)
+    assert calls["n"] == MAX_IGNORE_RETRIES + 1   # cap+1 runs, then deduped
+    assert mid in g.seen                           # escalated to terminal
+    assert g.scores["p"] == 0                      # never penalized
+
+    # terminal ignore: one run, stays deduped, no score change
+    payload2 = snappy.compress(b"duplicate")
+    mode["v"] = None
+    calls["n"] = 0
+    g._on_message("p", "t", payload2)
+    g._on_message("p", "t", payload2)
+    assert calls["n"] == 1
+    assert message_id("t", payload2) in g.seen
+    assert g.scores["p"] == 0
+
+
+def test_pending_sidecar_reprocess_queue():
+    """Sidecars ignored for a missing parent are retried locally when that
+    parent imports (ReprocessQueue analog) — gossip redelivery alone is not
+    guaranteed in a fully-meshed network."""
+    from lighthouse_tpu.network.node import NetworkNode
+
+    from lighthouse_tpu.chain.data_availability import BlobIgnoreError
+
+    class Hdr:
+        def __init__(self, parent):
+            self.parent_root = parent
+
+    class SignedHdr:
+        def __init__(self, parent, sig):
+            self.message = Hdr(parent)
+            self.signature = sig
+
+    class SC:
+        _n = 0
+
+        def __init__(self, parent, sig=None):
+            SC._n += 1
+            self.index = 0
+            self.signed_block_header = SignedHdr(
+                parent, sig if sig is not None else SC._n.to_bytes(96, "big")
+            )
+
+    class FakeChain:
+        def __init__(self):
+            self.retried = []
+            self.raise_for = {}      # sidecar id -> exception
+
+        def process_gossip_blob(self, sc):
+            exc = self.raise_for.get(id(sc))
+            if exc is not None:
+                raise exc
+            self.retried.append(sc)
+
+    node = object.__new__(NetworkNode)   # skip socket setup
+    node.chain = FakeChain()
+    node._pending_sidecars = {}
+    node._pending_sidecar_count = 0
+
+    parent = b"\xaa" * 32
+    sc1, sc2 = SC(parent), SC(parent)
+    node._stash_pending_sidecar(parent, sc1)
+    node._stash_pending_sidecar(parent, sc2)
+    node._stash_pending_sidecar(b"\xbb" * 32, SC(b"\xbb" * 32))
+    assert node._pending_sidecar_count == 3
+
+    # redelivery of the SAME sidecar (same signature+index) is deduped
+    node._stash_pending_sidecar(parent, SC(parent, sig=bytes(sc1.signed_block_header.signature)))
+    assert node._pending_sidecar_count == 3
+
+    node._retry_pending_sidecars(parent)
+    assert node.chain.retried == [sc1, sc2]
+    assert node._pending_sidecar_count == 1
+    # unrelated import: nothing happens
+    node._retry_pending_sidecars(b"\xcc" * 32)
+    assert node._pending_sidecar_count == 1
+
+    # a retry failing on ANOTHER missing parent is re-stashed, not dropped
+    other_parent = b"\xdd" * 32
+    sc3 = SC(b"\xbb" * 32)
+    node._stash_pending_sidecar(b"\xee" * 32, sc3)
+    node.chain.raise_for[id(sc3)] = BlobIgnoreError(
+        "parent unknown", missing_parent=other_parent
+    )
+    node._retry_pending_sidecars(b"\xee" * 32)
+    assert other_parent in node._pending_sidecars
+    assert node._pending_sidecars[other_parent] == [sc3]
+
+    # bounded: eviction keeps the count at the cap
+    for i in range(NetworkNode.MAX_PENDING_SIDECARS + 10):
+        node._stash_pending_sidecar(i.to_bytes(32, "big"), SC(i.to_bytes(32, "big")))
+    assert node._pending_sidecar_count <= NetworkNode.MAX_PENDING_SIDECARS
+
+
 # ------------------------------------------------------------------ rpc
 
 
