@@ -399,7 +399,15 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
         types = types_for_slot(chain.spec, chain.current_slot)
         signed = types.SignedBeaconBlock.deserialize(raw)
         root = chain.verify_block_for_gossip(signed)
-        chain.process_block(signed, block_root=root, proposal_already_verified=True)
+        # locally-produced deneb blocks: rebuild sidecars from the blobs
+        # bundle the EL returned at production time (publish_blocks.rs)
+        sidecars = chain.sidecars_for_produced_block(signed)
+        chain.process_block(
+            signed,
+            block_root=root,
+            proposal_already_verified=True,
+            blobs=sidecars or None,
+        )
         if self.event_bus is not None:
             self.event_bus.publish("block", {"slot": _u(signed.message.slot), "block": _hex(root)})
         self._json({})

@@ -33,7 +33,7 @@ from ..state_transition.block import (
 )
 from ..state_transition.slot import process_slots, types_for_slot
 from ..store.hot_cold import HotColdDB
-from ..testing.harness import clone_state
+from ..types.state_util import clone_state
 from ..types import helpers as h
 from ..types.spec import ChainSpec, DOMAIN_BEACON_ATTESTER
 from ..utils.slot_clock import SlotClock
@@ -82,6 +82,7 @@ class BeaconChain:
         config: ChainConfig | None = None,
         kzg_setup=None,
         anchor_block=None,
+        execution_layer=None,
     ):
         """genesis_state doubles as the ANCHOR state: pass a finalized
         checkpoint state (+ its anchor_block) to start from a weak-
@@ -178,6 +179,25 @@ class BeaconChain:
         self.naive_sync_pool = NaiveSyncContributionPool(spec)
         # validator_index -> fee recipient, fed by prepare_beacon_proposer
         self.proposer_preparations: dict[int, bytes] = {}
+        # eth1 deposit/block cache feeding production (eth1_chain.rs); set
+        # by the node when an eth1 endpoint is configured
+        self.eth1_cache = None
+
+        # ---- execution layer circuit (execution_payload.rs analog)
+        self.execution_layer = execution_layer
+        # block root -> execution block hash of its chain (inherited through
+        # pre-merge/empty payloads) — feeds forkchoiceUpdated + getPayload
+        genesis_payload_hash = b"\x00" * 32
+        hdr = getattr(genesis_state, "latest_execution_payload_header", None)
+        if hdr is not None:
+            genesis_payload_hash = bytes(hdr.block_hash)
+        self.payload_hash_by_block: dict[bytes, bytes] = {
+            self.genesis_block_root: genesis_payload_hash
+        }
+        self._el_last_head_sent: bytes | None = None
+        # blobs bundles from locally-built payloads, keyed by their
+        # commitment list: served back when the signed block is published
+        self._produced_bundles: dict[tuple, tuple] = {}
 
     # ------------------------------------------------- checkpoint / resume
 
@@ -412,7 +432,49 @@ class BeaconChain:
         self.fork_choice.on_tick(self.current_slot)
         head = self.fork_choice.get_head()
         self.head_root = head
+        self._notify_el_of_head(head)
         return head
+
+    def process_invalid_execution_payload(self, block_root: bytes) -> bytes:
+        """An EL verdict (late newPayload / fcU error) invalidated an
+        already-imported optimistic block: poison it and its descendants in
+        fork choice and move the head off the invalid subtree
+        (proto_array execution-status invalidation)."""
+        self.fork_choice.proto.on_invalid_execution_payload(block_root)
+        return self.recompute_head()
+
+    def _notify_el_of_head(self, head: bytes) -> None:
+        """Send engine_forkchoiceUpdated on head change (canonical_head.rs
+        update_execution_engine_forkchoice analog). Skipped pre-merge (no
+        execution chain to steer) and deduplicated per head root. An
+        INVALID verdict on an optimistically-imported head poisons its
+        subtree and moves the head off it."""
+        if self.execution_layer is None or head == self._el_last_head_sent:
+            return
+        head_hash = self.payload_hash_by_block.get(head, b"\x00" * 32)
+        if head_hash == b"\x00" * 32:
+            return
+        jc_root = self.fork_choice.store.justified_checkpoint[1]
+        fc_root = self.fork_choice.store.finalized_checkpoint[1]
+        safe_hash = self.payload_hash_by_block.get(jc_root, b"\x00" * 32)
+        fin_hash = self.payload_hash_by_block.get(fc_root, b"\x00" * 32)
+        try:
+            res = self.execution_layer.notify_forkchoice_updated(
+                head_hash, safe_hash, fin_hash
+            )
+        except Exception:
+            # engine flakiness must not break head updates (retried on the
+            # next head recompute); the health machine tracks failures
+            return
+        self._el_last_head_sent = head
+        status = (res or {}).get("payloadStatus", {}).get("status")
+        from ..execution.engine_api import PayloadStatus
+
+        if status == PayloadStatus.invalid.value:
+            # invalidation moves the head off this subtree; the recursive
+            # recompute_head -> _notify_el_of_head chain terminates because
+            # every step invalidates at least one block
+            self.process_invalid_execution_payload(head)
 
     # ------------------------------------------------------------ gossip block
 
@@ -624,6 +686,29 @@ class BeaconChain:
         if bytes(block.state_root) != state_root:
             raise BlockError("state root mismatch")
 
+        # Execution validity: hand the payload to the EL BEFORE import
+        # (execution_payload.rs:113 notify_new_payload). INVALID rejects the
+        # block and poisons its would-be subtree; SYNCING/ACCEPTED imports
+        # optimistically (fork choice keeps the node optimistic until a
+        # later fcU/newPayload confirms).
+        el_status = None
+        payload_hash = self.payload_hash_by_block.get(parent_root, b"\x00" * 32)
+        if fork >= ForkName.bellatrix and hasattr(block.body, "execution_payload"):
+            payload = block.body.execution_payload
+            if bytes(payload.block_hash) != b"\x00" * 32:
+                payload_hash = bytes(payload.block_hash)
+                if self.execution_layer is not None:
+                    from ..execution.engine_api import PayloadStatus
+
+                    try:
+                        el_status = self.execution_layer.notify_new_payload(payload)
+                    except Exception:
+                        # engine unreachable: import optimistically, exactly
+                        # like a SYNCING verdict (engines.rs offline state)
+                        el_status = PayloadStatus.syncing.value
+                    if el_status == PayloadStatus.invalid.value:
+                        raise BlockError("execution payload invalid")
+
         # import: store + caches + fork choice
         self.store.put_block(block_root, signed_block, types)
         if sidecars:
@@ -641,9 +726,17 @@ class BeaconChain:
         self.state_root_by_block[block_root] = state_root
         self.pubkey_cache.import_new_pubkeys(state)
 
+        self.payload_hash_by_block[block_root] = payload_hash
+
         timely = self.current_slot == block.slot
         self.fork_choice.on_tick(self.current_slot)
         self.fork_choice.on_block(signed_block, block_root, state, is_timely=timely)
+        if el_status is not None:
+            from ..execution.engine_api import PayloadStatus
+
+            if el_status == PayloadStatus.valid.value:
+                # VALID verdict also confirms all optimistic ancestors
+                self.fork_choice.proto.on_valid_execution_payload(block_root)
         self.block_times.imported(block_root)
         prev_head = self.head_root
         self.recompute_head()
@@ -1075,14 +1168,30 @@ class BeaconChain:
         if op_pool is not None:
             attestations = op_pool.get_attestations_for_block(state, types)
 
+        # eth1 voting + deposit inclusion (eth1_chain.rs): the vote may flip
+        # state.eth1_data inside process_eth1_data, and deposits are checked
+        # against the POST-vote data — compute the effective value the same
+        # way the verifier will.
+        eth1_data = state.eth1_data
+        deposits = []
+        if self.eth1_cache is not None:
+            from ..state_transition.block import eth1_data_after_vote
+
+            eth1_data = self.eth1_cache.eth1_vote(state, spec, types)
+            deposits = self.eth1_cache.deposits_for_block_inclusion(
+                state, spec, types,
+                eth1_data=eth1_data_after_vote(state, spec, eth1_data),
+                fork=fork,
+            )
+
         body_kwargs = dict(
             randao_reveal=randao_reveal,
-            eth1_data=state.eth1_data,
+            eth1_data=eth1_data,
             graffiti=graffiti,
             proposer_slashings=[],
             attester_slashings=[],
             attestations=attestations,
-            deposits=[],
+            deposits=deposits,
             voluntary_exits=[],
         )
         if op_pool is not None:
@@ -1093,18 +1202,42 @@ class BeaconChain:
             if fork >= ForkName.capella:
                 body_kwargs["bls_to_execution_changes"] = changes
         if fork >= ForkName.altair:
-            body_kwargs["sync_aggregate"] = types.SyncAggregate.make(
+            # pack the sync aggregate built from last slot's subnet
+            # contributions signing our parent (the head)
+            agg = self.naive_sync_pool.get_sync_aggregate(
+                max(slot, 1) - 1, self.head_root, types
+            )
+            body_kwargs["sync_aggregate"] = agg or types.SyncAggregate.make(
                 sync_committee_bits=[False] * spec.preset.SYNC_COMMITTEE_SIZE,
                 sync_committee_signature=bls.INFINITY_SIGNATURE_BYTES,
             )
         if fork >= ForkName.bellatrix:
-            body_kwargs["execution_payload"] = types.ExecutionPayload.default()
+            payload = types.ExecutionPayload.default()
+            if self.execution_layer is not None:
+                payload, el_bundle = self._request_el_payload(
+                    state, spec, types, fork, proposer
+                )
+                if el_bundle is not None and blobs_bundle is None:
+                    blobs_bundle = el_bundle
+            body_kwargs["execution_payload"] = payload
         if fork >= ForkName.capella and "bls_to_execution_changes" not in body_kwargs:
             body_kwargs["bls_to_execution_changes"] = []
         if fork >= ForkName.deneb:
             body_kwargs["blob_kzg_commitments"] = (
                 list(blobs_bundle[1]) if blobs_bundle is not None else []
             )
+            if blobs_bundle is not None:
+                # stash so publish can rebuild sidecars after signing;
+                # slot-stamped so unpublished bundles (VC refusal, failover
+                # to another BN) don't leak for the process lifetime
+                self._produced_bundles[
+                    tuple(bytes(c) for c in blobs_bundle[1])
+                ] = (int(slot), blobs_bundle)
+                horizon = int(slot) - 2 * spec.preset.SLOTS_PER_EPOCH
+                for k in [
+                    k for k, (s, _) in self._produced_bundles.items() if s < horizon
+                ]:
+                    del self._produced_bundles[k]
 
         block = types.BeaconBlock.make(
             slot=slot,
@@ -1120,6 +1253,61 @@ class BeaconChain:
             strategy=SignatureStrategy.NO_VERIFICATION, verify_block_root=True,
         )
         return block.copy_with(state_root=types.BeaconState.hash_tree_root(post))
+
+    def _request_el_payload(self, state, spec, types, fork, proposer: int):
+        """fcU-with-attributes + getPayload against the EL for a block being
+        produced on `state` (already advanced to the proposal slot)
+        (execution_layer/src/lib.rs get_payload flow). Returns
+        (ExecutionPayload, blobs_bundle | None)."""
+        from ..state_transition.block import (
+            compute_timestamp_at_slot,
+            get_expected_withdrawals,
+        )
+        from ..types.spec import ForkName
+
+        head_hash = self.payload_hash_by_block.get(self.head_root, b"\x00" * 32)
+        jc_root = self.fork_choice.store.justified_checkpoint[1]
+        fc_root = self.fork_choice.store.finalized_checkpoint[1]
+        withdrawals = None
+        if fork >= ForkName.capella:
+            withdrawals, _ = get_expected_withdrawals(state, spec, types)
+        payload, bundle = self.execution_layer.produce_payload(
+            types,
+            head_payload_hash=head_hash,
+            safe_hash=self.payload_hash_by_block.get(jc_root, b"\x00" * 32),
+            finalized_hash=self.payload_hash_by_block.get(fc_root, b"\x00" * 32),
+            timestamp=compute_timestamp_at_slot(state, spec, state.slot),
+            prev_randao=acc.h.get_randao_mix(
+                state, spec, acc.get_current_epoch(state, spec)
+            ),
+            fee_recipient=self.proposer_preparations.get(proposer),
+            withdrawals=withdrawals,
+        )
+        return payload, bundle
+
+    def sidecars_for_produced_block(self, signed_block):
+        """Build blob sidecars for a locally-produced block that was just
+        signed, from the blobs bundle the EL returned at production time
+        (publish_blocks.rs builds sidecars from cached payload contents).
+        Returns [] when the block carries no commitments or no bundle is
+        stashed (e.g. produced without an EL)."""
+        from .data_availability import build_sidecars
+
+        body = signed_block.message.body
+        commitments = tuple(
+            bytes(c) for c in getattr(body, "blob_kzg_commitments", ())
+        )
+        if not commitments:
+            return []
+        # NON-destructive lookup: a failed import must be retryable with
+        # the same bundle (slot-horizon pruning in produce_block bounds the
+        # stash instead)
+        entry = self._produced_bundles.get(commitments)
+        if entry is None:
+            return []
+        _, (blobs, _, proofs) = entry
+        types = types_for_slot(self.spec, signed_block.message.slot)
+        return build_sidecars(types, self.spec, signed_block, blobs, proofs)
 
     def apply_attestation_to_fork_choice(self, att, attesting_indices):
         self.fork_choice.on_attestation(

@@ -44,13 +44,17 @@ class BlobIgnoreError(Exception):
     peer could farm free validation work by replaying old sidecars.
     `missing_parent` is set when the blocking dependency is specifically an
     unimported parent block — the condition a local reprocess queue can key
-    a retry on (other retriable causes have no import event to wait for)."""
+    a retry on. `retry_at_slot` is set when the dependency is TIME (a
+    future-slot sidecar): terminal for gossip dedup, but the owner should
+    queue it locally and re-validate once that slot starts."""
 
     def __init__(self, msg: str, retriable: bool = True,
-                 missing_parent: bytes | None = None):
+                 missing_parent: bytes | None = None,
+                 retry_at_slot: int | None = None):
         super().__init__(msg)
         self.retriable = retriable
         self.missing_parent = missing_parent
+        self.retry_at_slot = retry_at_slot
 
 
 class AvailabilityPendingError(Exception):
@@ -246,7 +250,10 @@ def verify_blob_sidecar_for_gossip(chain, sidecar, verify_kzg: bool = True) -> b
     if int(sidecar.index) >= spec.max_blobs(fork):
         raise BlobError(f"blob index {sidecar.index} out of range")
     if slot > chain.current_slot:
-        raise BlobIgnoreError("future slot")
+        # terminal for gossip dedup (same-instant mesh duplicates must not
+        # burn retry budget) — the owner queues it locally for the slot
+        # start via retry_at_slot (ReprocessQueue early-block semantics)
+        raise BlobIgnoreError("future slot", retriable=False, retry_at_slot=int(slot))
     key = (block_root, int(sidecar.index))
     if key in chain.observed_blob_sidecars:
         raise BlobIgnoreError("sidecar already seen", retriable=False)

@@ -107,17 +107,26 @@ class Eth1Cache:
     def add_block(self, block: Eth1Block) -> None:
         self.blocks.append(block)
 
-    def deposits_for_block_inclusion(self, state, spec, types):
+    def deposits_for_block_inclusion(self, state, spec, types, eth1_data=None,
+                                     fork=None):
         """Deposits the next block must include (eth1_deposit_index ..
-        eth1_data.deposit_count), with proofs against the state's
-        eth1_data.deposit_root."""
+        eth1_data.deposit_count), with proofs against `eth1_data` —
+        pass the POST-vote eth1_data when the block's own vote will flip it
+        (process_eth1_data runs before process_operations). Electra caps the
+        legacy bridge at deposit_requests_start_index (EIP-6110)."""
+        ed = eth1_data if eth1_data is not None else state.eth1_data
         start = state.eth1_deposit_index
-        count = min(
-            state.eth1_data.deposit_count - start, spec.preset.MAX_DEPOSITS
-        )
+        limit = ed.deposit_count
+        from ..types.spec import ForkName
+
+        if fork is not None and fork >= ForkName.electra:
+            limit = min(limit, state.deposit_requests_start_index)
+            if start >= limit:
+                return []
+        count = min(limit - start, spec.preset.MAX_DEPOSITS)
         out = []
         for i in range(start, start + count):
-            proof = self.tree.proof(i, count=state.eth1_data.deposit_count)
+            proof = self.tree.proof(i, count=ed.deposit_count)
             out.append(types.Deposit.make(proof=proof, data=self.deposits[i]))
         return out
 

@@ -119,6 +119,33 @@ class NaiveSyncContributionPool:
             signature=_agg_bytes(sigs),
         )
 
+    def get_sync_aggregate(self, slot: int, beacon_block_root: bytes, types):
+        """Merge every subcommittee's contribution for (slot, root) into a
+        block-ready SyncAggregate (operation_pool get_sync_aggregate analog,
+        /root/reference/beacon_node/operation_pool/src/lib.rs:158). Returns
+        None when no contribution matches."""
+        size = self.spec.preset.SYNC_COMMITTEE_SIZE
+        n_sub = self.spec.sync_committee_subnet_count
+        sub_size = size // n_sub
+        bits = [False] * size
+        points = []
+        found = False
+        for sub in range(n_sub):
+            bucket = self._by_slot.get(slot, {}).get((bytes(beacon_block_root), sub))
+            if bucket is None:
+                continue
+            found = True
+            sub_bits, sub_sigs = bucket
+            for i, bit in enumerate(sub_bits):
+                if bit:
+                    bits[sub * sub_size + i] = True
+            points.extend(sub_sigs)
+        if not found:
+            return None
+        return types.SyncAggregate.make(
+            sync_committee_bits=bits, sync_committee_signature=_agg_bytes(points)
+        )
+
     def prune(self, current_slot: int) -> None:
         for s in list(self._by_slot):
             if s + SLOT_RETENTION < current_slot:

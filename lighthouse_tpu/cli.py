@@ -79,8 +79,49 @@ def cmd_bn(args):
             hot=NativeKVStore(f"{args.datadir}/hot.db"),
             cold=NativeKVStore(f"{args.datadir}/cold.db"),
         )
+    execution_layer = None
+    if args.engine:
+        from .chain.execution_layer import ExecutionLayer
+        from .execution.engine_api import EngineApiClient, MockExecutionLayer
+
+        if args.engine == "mock":
+            engine = MockExecutionLayer()
+        else:
+            if not args.jwt_secret:
+                print("error: --engine requires --jwt-secret", file=sys.stderr)
+                return 1
+            with open(args.jwt_secret) as f:
+                secret = bytes.fromhex(f.read().strip().removeprefix("0x"))
+            engine = EngineApiClient(args.engine, secret)
+        fee = (
+            bytes.fromhex(args.fee_recipient[2:])
+            if args.fee_recipient
+            else b"\x00" * 20
+        )
+        execution_layer = ExecutionLayer(engine, spec, default_fee_recipient=fee)
+        print(f"execution engine: {args.engine}")
+
     clock = SystemTimeSlotClock(state.genesis_time, spec.seconds_per_slot)
-    chain = BeaconChain(spec, state, store=store, slot_clock=clock)
+    chain = BeaconChain(
+        spec, state, store=store, slot_clock=clock, execution_layer=execution_layer
+    )
+
+    eth1_service = None
+    if args.eth1:
+        from .chain.eth1 import Eth1Service, MockEth1Rpc
+        from .state_transition.slot import types_for_slot as _tfs
+
+        if args.eth1 == "mock":
+            eth1_rpc = MockEth1Rpc(spec.deposit_contract_address)
+        else:
+            from .execution.engine_api import EngineApiClient
+
+            # plain JSON-RPC (no JWT) — reuse the HTTP transport with an
+            # empty secret; eth1 nodes ignore the Authorization header
+            eth1_rpc = EngineApiClient(args.eth1, b"\x00" * 32)
+        eth1_service = Eth1Service(eth1_rpc, spec, _tfs(spec, 0))
+        chain.eth1_cache = eth1_service.cache
+        print(f"eth1 endpoint: {args.eth1}")
 
     from .chain.op_pool import OperationPool
 
@@ -115,6 +156,10 @@ def cmd_bn(args):
                 found = slasher_svc.process()
                 if found:
                     print(f"slasher: broadcast {found} slashings")
+            if eth1_service is not None:
+                n = eth1_service.poll_once()
+                if n:
+                    print(f"eth1: ingested {n} deposit logs")
             # slot tail: pre-compute the next-slot head state
             # (state_advance_timer analog)
             chain.advance_head_state()
@@ -156,6 +201,7 @@ def cmd_vc(args):
             store.add_validator(kp.sk, index=i)
     duties = DutiesService(spec, store, nodes)
     atts = AttestationService(spec, store, duties, nodes)
+    blocks = BlockService(spec, store, duties, nodes)
     genesis = clients[0].genesis()
     genesis_time = int(genesis["genesis_time"])
     from .utils.slot_clock import SystemTimeSlotClock
@@ -164,14 +210,18 @@ def cmd_vc(args):
     print(f"VC started with {len(store.validators)} validators")
     try:
         while True:
-            time.sleep(clock.duration_to_next_slot() + spec.seconds_per_slot / 3)
+            # slot start: propose (block_service.rs fires at slot start,
+            # attestations at slot+1/3)
+            time.sleep(clock.duration_to_next_slot())
             slot = clock.now()
             if slot is None:
                 continue
             epoch = slot // spec.preset.SLOTS_PER_EPOCH
             duties.poll(epoch)
+            b = blocks.propose(slot)
+            time.sleep(spec.seconds_per_slot / 3)
             n = atts.attest(slot)
-            print(f"slot {slot}: attested {n}")
+            print(f"slot {slot}: proposed {b} attested {n}")
     except KeyboardInterrupt:
         return 0
 
@@ -444,6 +494,23 @@ def build_parser() -> argparse.ArgumentParser:
     bn.add_argument("--genesis-time", type=int, default=None)
     bn.add_argument("--bls-backend", default="python", choices=["python", "jax", "fake"])
     bn.add_argument("--slasher", action="store_true", help="enable the slasher")
+    bn.add_argument(
+        "--engine", default=None,
+        help="execution engine URL (engine API JSON-RPC), or 'mock' for the "
+             "in-process EL double",
+    )
+    bn.add_argument(
+        "--jwt-secret", default=None,
+        help="path to the hex-encoded engine-API JWT secret file",
+    )
+    bn.add_argument(
+        "--fee-recipient", default=None,
+        help="default fee recipient address (0x-hex, 20 bytes)",
+    )
+    bn.add_argument(
+        "--eth1", default=None,
+        help="eth1 JSON-RPC endpoint for deposit-log scraping, or 'mock'",
+    )
     bn.set_defaults(fn=cmd_bn)
 
     vc = sub.add_parser("vc", help="run a validator client")

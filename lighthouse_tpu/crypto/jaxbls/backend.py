@@ -312,7 +312,9 @@ class JaxBackend:
             pk_x[i, : len(keys)] = xs
             pk_y[i, : len(keys)] = ys
             pk_mask[i, : len(keys)] = 1
-        dx, dy, dm = jax.device_put(pk_x), jax.device_put(pk_y), jax.device_put(pk_mask)
+        from ...parallel import put_sets
+
+        dx, dy, dm = put_sets(pk_x), put_sets(pk_y), put_sets(pk_mask)
         # keep strong refs to the key objects so ids stay valid while cached
         keepalive = (fp, [pk for s in sets for pk in s.signing_keys])
         self._pk_cache[fp] = (dx, dy, dm, keepalive)
@@ -323,9 +325,14 @@ class JaxBackend:
         return dx, dy, dm
 
     def verify_signature_sets_async(self, sets, rands) -> VerifyHandle:
+        from ...parallel import pad_sets, put_sets
+
         prepare, h2c_stage, pairs_stage, pairing_stage = _get_stages()
         n_real = len(sets)
-        n = max(MIN_SETS, _next_pow2(n_real))
+        # pad the set axis to the compile bucket AND to a multiple of the
+        # device mesh (multi-chip: sets are data-parallel over the mesh,
+        # the cross-set reductions become collectives — parallel/mesh.py)
+        n = pad_sets(max(MIN_SETS, _next_pow2(n_real)))
         m = max(MIN_PKS, _next_pow2(max(len(s.signing_keys) for s in sets)))
 
         pk_x, pk_y, pk_mask = self._marshal_pubkeys(sets, n, m)
@@ -355,7 +362,13 @@ class JaxBackend:
         us = np.zeros((n, 2, 2, lb.NL), np.uint32)
         us[:n_real] = h2.hash_to_field_batch([s.message for s in sets], self.dst)
 
-        # staged dispatch: intermediates stay on device between jit calls
+        # staged dispatch: intermediates stay on device between jit calls,
+        # inputs placed with the set axis sharded over the mesh (no-op on
+        # one device)
+        sig_x, sig_y, z_digits, set_mask, us = (
+            put_sets(sig_x), put_sets(sig_y), put_sets(z_digits),
+            put_sets(set_mask), put_sets(us),
+        )
         z_pk, sig_acc, bad = prepare(
             pk_x, pk_y, pk_mask, sig_x, sig_y, z_digits, set_mask
         )

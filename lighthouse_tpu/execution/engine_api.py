@@ -120,6 +120,11 @@ class EngineApiClient:
             raise RuntimeError(f"engine error: {resp['error']}")
         return resp.get("result")
 
+    # public generic JSON-RPC entry (duck-typed with MockEth1Rpc.call so the
+    # same transport serves the eth1 scraper against a real endpoint)
+    def call(self, method: str, params: list):
+        return self._call(method, params)
+
     def new_payload(self, payload_json: dict) -> dict:
         return self._call("engine_newPayloadV3", [payload_json])
 
@@ -179,32 +184,47 @@ class MockExecutionLayer:
         if attrs is not None:
             self.payload_counter += 1
             payload_id = f"0x{self.payload_counter:016x}"
-            self.pending_payloads[payload_id] = {
-                "parent": head,
-                "timestamp": attrs.get("timestamp"),
-                "prevRandao": attrs.get("prevRandao"),
-            }
+            self.pending_payloads[payload_id] = {"parent": head, "attrs": dict(attrs)}
         return {
             "payloadStatus": {"status": PayloadStatus.valid.value},
             "payloadId": payload_id,
         }
 
     def get_payload(self, payload_id: str) -> dict:
+        """Build a payload echoing the fcU attributes (the real EL honors
+        timestamp/prevRandao/feeRecipient/withdrawals from the attrs —
+        ExecutionBlockGenerator does the same for the reference's tests)."""
         info = self.pending_payloads.pop(payload_id)
         parent = info["parent"]
+        attrs = info["attrs"]
         number = self.blocks[parent]["number"] + 1
-        block_hash = hashlib.sha256(b"mock-el" + parent + number.to_bytes(8, "big")).digest()
-        out = {
-            "executionPayload": {
-                "parentHash": "0x" + parent.hex(),
-                "blockHash": "0x" + block_hash.hex(),
-                "blockNumber": hex(number),
-                "timestamp": info["timestamp"],
-                "prevRandao": info["prevRandao"],
-            }
+        seed = b"mock-el" + parent + number.to_bytes(8, "big") + repr(
+            sorted(attrs.items())
+        ).encode()
+        block_hash = hashlib.sha256(seed).digest()
+        payload = {
+            "parentHash": "0x" + parent.hex(),
+            "feeRecipient": attrs.get("suggestedFeeRecipient", "0x" + "00" * 20),
+            "stateRoot": "0x" + hashlib.sha256(b"state" + seed).hexdigest(),
+            "receiptsRoot": "0x" + "00" * 32,
+            "logsBloom": "0x" + "00" * 256,
+            "prevRandao": attrs.get("prevRandao", "0x" + "00" * 32),
+            "blockNumber": hex(number),
+            "gasLimit": hex(30_000_000),
+            "gasUsed": hex(21_000),
+            "timestamp": attrs.get("timestamp", "0x0"),
+            "extraData": "0x",
+            "baseFeePerGas": hex(7),
+            "blockHash": "0x" + block_hash.hex(),
+            "transactions": [],
         }
+        if "withdrawals" in attrs:
+            payload["withdrawals"] = attrs["withdrawals"]
+        out = {"executionPayload": payload}
         if self.queued_blobs:
             triples, self.queued_blobs = self.queued_blobs, []
+            payload["blobGasUsed"] = hex(0)
+            payload["excessBlobGas"] = hex(0)
             out["blobsBundle"] = {
                 "blobs": [b for b, _, _ in triples],
                 "commitments": [c for _, c, _ in triples],

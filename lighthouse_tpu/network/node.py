@@ -59,6 +59,9 @@ class NetworkNode:
         # imports; by-root sync remains the fallback of last resort.
         self._pending_sidecars: dict[bytes, list] = {}
         self._pending_sidecar_count = 0
+        # sidecars that arrived a moment early (future slot): retried by the
+        # heartbeat once their slot starts — gossip dedup stays intact
+        self._early_sidecars: dict[int, list] = {}
 
         self._subscribe_core(subnets)
 
@@ -167,6 +170,10 @@ class NetworkNode:
                 self.gossipsub.heartbeat()
             except Exception:
                 pass
+            try:
+                self._drain_early_sidecars()
+            except Exception:
+                pass
 
     def close(self) -> None:
         self._hb_stop.set()
@@ -241,19 +248,42 @@ class NetworkNode:
     def _retry_pending_sidecars(self, imported_root: bytes) -> None:
         """A block just imported: sidecars of its children can now verify.
         A retry that fails RETRIABLY (e.g. on a different missing parent)
-        is re-stashed rather than dropped. Caller holds self._lock."""
+        is re-stashed rather than dropped; a retry that itself completes an
+        import cascades to ITS waiters (recursion bounded by the stash
+        cap). Caller holds self._lock."""
         waiting = self._pending_sidecars.pop(imported_root, None)
         if not waiting:
             return
         self._pending_sidecar_count -= len(waiting)
         for sc in waiting:
             try:
-                self.chain.process_gossip_blob(sc)
+                root = self.chain.process_gossip_blob(sc)
+                if root is not None:
+                    self._retry_pending_sidecars(root)
             except BlobIgnoreError as e:
                 if e.retriable and e.missing_parent is not None:
                     self._stash_pending_sidecar(e.missing_parent, sc)
             except Exception:
                 continue
+
+    def _drain_early_sidecars(self) -> None:
+        """Heartbeat hook: re-validate sidecars whose slot has started."""
+        now = self.chain.current_slot
+        with self._lock:
+            # `due` must be computed under the lock: gossip threads mutate
+            # the dict (insert/evict) while holding it
+            due = [s for s in self._early_sidecars if s <= now]
+            for s in due:
+                for sc in self._early_sidecars.pop(s, ()):
+                    try:
+                        root = self.chain.process_gossip_blob(sc)
+                        if root is not None:
+                            self._retry_pending_sidecars(root)
+                    except BlobIgnoreError as e:
+                        if e.retriable and e.missing_parent is not None:
+                            self._stash_pending_sidecar(e.missing_parent, sc)
+                    except Exception:
+                        continue
 
     def _lookup_parent(self, peer_id: str, signed) -> None:
         parent_root = bytes(signed.message.parent_root)
@@ -320,12 +350,34 @@ class NetworkNode:
             return False
         with self._lock:
             try:
-                self.chain.process_gossip_blob(sidecar)
+                root = self.chain.process_gossip_blob(sidecar)
+                # a returned root means the sidecar COMPLETED a block
+                # import: children waiting on that block can now verify
+                if root is not None:
+                    self._retry_pending_sidecars(root)
             except BlobIgnoreError as e:
-                # verification could not run (retriable: allow redelivery;
-                # if the blocker is a missing parent, also queue a local
-                # retry for that parent's import) vs terminal ignore
-                # (duplicate/finalized: stay deduped)
+                # verification could not run. Three cases:
+                #  - missing parent: retriable over gossip AND queued for a
+                #    local retry when the parent imports
+                #  - future slot: terminal for dedup (mesh duplicates must
+                #    not burn retries) but queued for the slot start
+                #  - duplicate/finalized: terminal, stay deduped
+                if e.retry_at_slot is not None:
+                    # hard-capped: these sidecars are UNVERIFIED (the
+                    # future-slot check precedes proof/signature checks), so
+                    # a flood of distinct junk must not grow memory
+                    if (
+                        sum(len(v) for v in self._early_sidecars.values())
+                        < self.MAX_PENDING_SIDECARS
+                    ):
+                        self._early_sidecars.setdefault(
+                            e.retry_at_slot, []
+                        ).append(sidecar)
+                        while len(self._early_sidecars) > 4:
+                            self._early_sidecars.pop(
+                                next(iter(self._early_sidecars))
+                            )
+                    return None
                 if e.retriable:
                     if e.missing_parent is not None:
                         self._stash_pending_sidecar(e.missing_parent, sidecar)

@@ -1,0 +1,6 @@
+"""Multi-chip parallelism: the device mesh + sharding layout of the
+verification pipeline (see mesh.py)."""
+
+from .mesh import get_mesh, pad_sets, put_sets, reset_mesh_cache, sets_sharding
+
+__all__ = ["get_mesh", "pad_sets", "put_sets", "reset_mesh_cache", "sets_sharding"]

@@ -1,0 +1,80 @@
+"""Device-mesh layer: production multi-chip sharding of signature batches.
+
+The framework's scaling unit is the signature-set axis (SURVEY.md §5
+"distributed communication backend"): every tensor in the verification
+pipeline carries the set index as its leading axis, so data-parallel
+sharding over a 1-D `sets` mesh makes the per-set stages embarrassingly
+parallel while the two cross-set reductions — the signature tree-sum in
+stage 1 and the shared-accumulator Fq12 pair product in stage 4 — become
+XLA collectives over ICI. This module owns mesh discovery and input
+placement; `crypto/jaxbls/backend.py` consults it on every dispatch, so
+`verify_signature_sets` transparently uses however many chips are attached
+(the analog of blst scaling across cores, except the "cores" are chips).
+"""
+
+from __future__ import annotations
+
+import os
+
+SET_AXIS = "sets"
+
+_cached: list = []  # [mesh_or_None] once resolved
+
+
+def get_mesh():
+    """The process-wide 1-D device mesh over the `sets` axis, or None when
+    only one device is attached (or LIGHTHOUSE_TPU_MESH=0). Resolved once —
+    device topology does not change within a process."""
+    if _cached:
+        return _cached[0]
+    mesh = None
+    if os.environ.get("LIGHTHOUSE_TPU_MESH", "1") != "0":
+        import jax
+
+        devices = jax.devices()
+        if len(devices) > 1:
+            import numpy as np
+            from jax.sharding import Mesh
+
+            mesh = Mesh(np.array(devices), (SET_AXIS,))
+    _cached.append(mesh)
+    return mesh
+
+
+def reset_mesh_cache() -> None:
+    """Testing hook: force re-discovery (e.g. after forcing a virtual CPU
+    device count)."""
+    _cached.clear()
+
+
+def sets_sharding(mesh, ndim: int):
+    """NamedSharding partitioning the leading (set) axis, replicating the
+    rest."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec(SET_AXIS, *([None] * (ndim - 1))))
+
+
+def put_sets(a, mesh=None):
+    """Place an array with its leading axis sharded over the mesh; plain
+    device_put when no mesh. The leading dimension must divide the mesh
+    size (callers pad the set axis with masked entries — see pad_sets)."""
+    import jax
+
+    if mesh is None:
+        mesh = get_mesh()
+    if mesh is None:
+        return jax.device_put(a)
+    import numpy as np
+
+    return jax.device_put(a, sets_sharding(mesh, np.ndim(a)))
+
+
+def pad_sets(n: int, mesh=None) -> int:
+    """Round a set count up so it divides evenly across the mesh."""
+    if mesh is None:
+        mesh = get_mesh()
+    if mesh is None:
+        return n
+    size = mesh.devices.size
+    return ((n + size - 1) // size) * size

@@ -23,6 +23,12 @@ import hashlib
 from dataclasses import dataclass
 from typing import Any, Sequence
 
+import numpy as np
+
+# lists with at least this many 32-byte leaves go through the incremental
+# tree cache (ssz/tree_cache.py); below it plain merkleize wins
+_TREE_CACHE_MIN = 256
+
 BYTES_PER_CHUNK = 32
 OFFSET_BYTES = 4
 
@@ -393,13 +399,41 @@ class List(SSZType):
     def hash_tree_root(self, value) -> bytes:
         items = list(value)
         if isinstance(self.element, Uint) or self.element is boolean:
-            data = b"".join(self.element.serialize(v) for v in items)
+            data = self._pack_basic(items)
             limit_chunks = (self.limit * self.element.fixed_size() + 31) // 32
-            root = merkleize(pack_bytes(data), limit_chunks)
+            chunks = pack_bytes(data)
+            if len(chunks) >= _TREE_CACHE_MIN:
+                root = self._cached_root(
+                    np.frombuffer(b"".join(chunks), np.uint8).reshape(-1, 32),
+                    limit_chunks,
+                )
+            else:
+                root = merkleize(chunks, limit_chunks)
         else:
             roots = [self.element.hash_tree_root(v) for v in items]
-            root = merkleize(roots, self.limit)
+            if len(roots) >= _TREE_CACHE_MIN:
+                root = self._cached_root(
+                    np.frombuffer(b"".join(roots), np.uint8).reshape(-1, 32),
+                    self.limit,
+                )
+            else:
+                root = merkleize(roots, self.limit)
         return mix_in_length(root, len(items))
+
+    def _pack_basic(self, items) -> bytes:
+        """Serialize a basic-type list; numpy fast path for the big uint
+        lists (balances, participation, inactivity scores) whose per-item
+        int.to_bytes loop dominated packing at validator scale."""
+        size = self.element.fixed_size()
+        if isinstance(self.element, Uint) and size in (1, 2, 4, 8) and len(items) >= 64:
+            return np.asarray(items, dtype=f"<u{size}").tobytes()
+        return b"".join(self.element.serialize(v) for v in items)
+
+    def _cached_root(self, leaves, limit: int) -> bytes:
+        from .tree_cache import GLOBAL_LIST_CACHE
+
+        depth = (next_pow2(limit)).bit_length() - 1
+        return GLOBAL_LIST_CACHE.root(self, leaves, depth)
 
     def default(self):
         return []
@@ -459,6 +493,36 @@ class Field:
         self.type = type_
 
 
+#: Container names whose VALUE INSTANCES are immutable by convention
+#: everywhere in the codebase (every mutation goes through copy_with, which
+#: builds a fresh instance) — their tree roots are memoized per instance.
+#: BeaconState is deliberately absent: its attributes are reassigned in
+#: place by the state transition. This memoization is the host-side analog
+#: of the reference's cached_tree_hash: at 16k+ validators, re-hashing an
+#: unchanged Validator (~15 sha256 + dispatch) per state root dominates
+#: state-root time (consensus/cached_tree_hash/src/lib.rs:1).
+MEMOIZED_ROOT_TYPES = frozenset(
+    {
+        "Validator",
+        "PendingAttestation",
+        "AttestationData",
+        "Checkpoint",
+        "Eth1Data",
+        "Fork",
+        "DepositData",
+        "SyncCommittee",
+        "ExecutionPayloadHeader",
+        "HistoricalBatch",
+        "HistoricalSummary",
+        "Withdrawal",
+        "PendingDeposit",
+        "PendingPartialWithdrawal",
+        "PendingConsolidation",
+        "BeaconBlockHeader",
+    }
+)
+
+
 class Container(SSZType):
     """Container descriptor built from (name, type) pairs; values are
     instances of a generated dataclass-like value type."""
@@ -466,6 +530,7 @@ class Container(SSZType):
     def __init__(self, name: str, fields: Sequence[tuple[str, SSZType]]):
         self.name = name
         self.fields = [Field(n, t) for n, t in fields]
+        self.memoize_root = name in MEMOIZED_ROOT_TYPES
         self._value_cls = _make_value_class(name, [f.name for f in self.fields], self)
 
     def __repr__(self):
@@ -546,8 +611,15 @@ class Container(SSZType):
         return self._value_cls(**fixed_vals)
 
     def hash_tree_root(self, value) -> bytes:
+        if self.memoize_root:
+            cached = getattr(value, "_htr", None)
+            if cached is not None:
+                return cached
         roots = [f.type.hash_tree_root(getattr(value, f.name)) for f in self.fields]
-        return merkleize(roots, len(self.fields))
+        root = merkleize(roots, len(self.fields))
+        if self.memoize_root:
+            object.__setattr__(value, "_htr", root)
+        return root
 
     def default(self):
         return self._value_cls(**{f.name: f.type.default() for f in self.fields})

@@ -163,14 +163,19 @@ def process_randao(state, spec, types, block, strategy, handle, get_pubkey):
     state.randao_mixes[epoch % spec.preset.EPOCHS_PER_HISTORICAL_VECTOR] = mix
 
 
-def process_eth1_data(state, spec, types, body):
-    state.eth1_data_votes.append(body.eth1_data)
+def eth1_data_after_vote(state, spec, vote):
+    """The eth1_data that process_eth1_data will leave in place after this
+    vote is cast — shared by the verifier (below) and the block producer
+    (deposit inclusion must be computed against the POST-vote value)."""
     period_slots = spec.preset.EPOCHS_PER_ETH1_VOTING_PERIOD * spec.preset.SLOTS_PER_EPOCH
-    if (
-        sum(1 for v in state.eth1_data_votes if v == body.eth1_data) * 2
-        > period_slots
-    ):
-        state.eth1_data = body.eth1_data
+    count = sum(1 for v in state.eth1_data_votes if v == vote) + 1
+    return vote if count * 2 > period_slots else state.eth1_data
+
+
+def process_eth1_data(state, spec, types, body):
+    effective = eth1_data_after_vote(state, spec, body.eth1_data)
+    state.eth1_data_votes.append(body.eth1_data)
+    state.eth1_data = effective
 
 
 # ------------------------------------------------------------ operations

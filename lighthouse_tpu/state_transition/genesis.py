@@ -33,15 +33,15 @@ def interop_genesis_state(
     spec: ChainSpec,
     eth1_block_hash: bytes = b"\x42" * 32,
 ):
-    import copy
+    from ..types.state_util import clone_state
 
     key = (repr(spec), len(keypairs), genesis_time, eth1_block_hash)
     hit = _genesis_cache.get(key)
     if hit is not None and hit[1] == [kp.pk.serialize() for kp in keypairs]:
-        return copy.deepcopy(hit[0])
+        return clone_state(hit[0], spec)
     state = _interop_genesis_state(keypairs, genesis_time, spec, eth1_block_hash)
     _genesis_cache[key] = (
-        copy.deepcopy(state),
+        clone_state(state, spec),
         [kp.pk.serialize() for kp in keypairs],
     )
     return state

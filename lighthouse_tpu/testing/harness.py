@@ -29,10 +29,9 @@ from ..state_transition.genesis import interop_genesis_state
 from ..state_transition.slot import process_slots, state_transition, types_for_slot
 
 
-def clone_state(state, spec: ChainSpec):
-    """Deep state copy. Containers are plain dataclasses over lists/bytes —
-    copy.deepcopy is correct; SSZ roundtrip is the fallback ground truth."""
-    return copy.deepcopy(state)
+# Re-export: clone_state is production consensus code and lives with the
+# type layer; the harness keeps the historical import path for tests.
+from ..types.state_util import clone_state  # noqa: F401
 
 
 def _sign(sk, root: bytes) -> "bls.Signature":

@@ -1,0 +1,27 @@
+"""State cloning — consensus-critical structural sharing.
+
+Lives with the type layer (not the test harness) because its semantics are
+load-bearing for production: the chain clones states on every block import
+and production, and the memoized container roots
+(ssz/core.py MEMOIZED_ROOT_TYPES) only carry across clones because
+unchanged element instances are SHARED."""
+
+from __future__ import annotations
+
+
+def clone_state(state, spec=None):
+    """Copy-on-write state clone with structural sharing (the milhouse
+    idea, /root/reference/consensus/types/src/beacon_state.rs:34, done the
+    Python way): the clone gets fresh LIST objects (so appends and element
+    assignment stay private) but SHARES every element and non-list field.
+    Sound because the codebase's mutation discipline is copy-on-write for
+    all container values — every Validator/header/etc. update goes through
+    copy_with — and ints/bytes are immutable.
+
+    `spec` is accepted for call-site compatibility and unused."""
+    cls = state.__class__
+    vals = {}
+    for f in cls.ssz_type.fields:
+        v = getattr(state, f.name)
+        vals[f.name] = list(v) if isinstance(v, list) else v
+    return cls(**vals)

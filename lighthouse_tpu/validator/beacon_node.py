@@ -129,7 +129,7 @@ class InProcessBeaconNode:
 
     # -- attestation flow ------------------------------------------------
 
-    def attestation_data(self, slot: int, committee_index: int):
+    def attestation_data(self, slot: int, committee_index: int, types=None):
         if not self.healthy:
             raise BeaconNodeError("node down")
         chain = self.chain
@@ -183,7 +183,7 @@ class InProcessBeaconNode:
             target=types.Checkpoint.make(epoch=epoch, root=target_root),
         )
 
-    def publish_attestations(self, attestations) -> int:
+    def publish_attestations(self, attestations, types=None) -> int:
         """BN re-verifies and gossips; returns count accepted."""
         if not self.healthy:
             raise BeaconNodeError("node down")
@@ -203,7 +203,7 @@ class InProcessBeaconNode:
             raise BeaconNodeError("no aggregate known")
         return agg
 
-    def publish_aggregates(self, signed_aggregates) -> int:
+    def publish_aggregates(self, signed_aggregates, types=None) -> int:
         if not self.healthy:
             raise BeaconNodeError("node down")
         verified = self.chain.verify_aggregated_attestations(signed_aggregates)
@@ -271,7 +271,7 @@ class InProcessBeaconNode:
 
     # -- blocks ----------------------------------------------------------
 
-    def publish_block(self, signed_block) -> bytes:
+    def publish_block(self, signed_block, types=None) -> bytes:
         if not self.healthy:
             raise BeaconNodeError("node down")
         root = self.chain.verify_block_for_gossip(signed_block)

@@ -90,7 +90,7 @@ class AttestationService:
             by_committee[d.committee_index].append(d)
         produced = 0
         for cidx, ds in by_committee.items():
-            data = self.nodes.first_success("attestation_data", slot, cidx)
+            data = self.nodes.first_success("attestation_data", slot, cidx, types)
             atts = []
             for d in ds:
                 bits = [False] * d.committee_length
@@ -106,7 +106,9 @@ class AttestationService:
                     )
                 )
             if atts:
-                produced += self.nodes.first_success("publish_attestations", atts)
+                produced += self.nodes.first_success(
+                    "publish_attestations", atts, types
+                )
         self.published += produced
         return produced
 
@@ -294,13 +296,16 @@ class BlockService:
             types = types_for_slot(self.spec, slot)
             epoch = slot // self.spec.preset.SLOTS_PER_EPOCH
             randao = self.store.sign_randao(d.pubkey, epoch)
-            block = self.produce_block_fn(slot, randao)
+            if self.produce_block_fn is not None:
+                block = self.produce_block_fn(slot, randao)
+            else:
+                block = self.nodes.first_success("produce_block", slot, randao, types)
             try:
                 sig = self.store.sign_block(d.pubkey, block, types)
             except (SlashingProtectionError, DoppelgangerProtected):
                 continue
             signed = types.SignedBeaconBlock.make(message=block, signature=sig)
-            self.nodes.first_success("publish_block", signed)
+            self.nodes.first_success("publish_block", signed, types)
             count += 1
         self.published += count
         return count

@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Micro-bench: BeaconState.hash_tree_root at validator scale.
+
+Measures the tree-hash caching layer (ssz/core.py MEMOIZED_ROOT_TYPES +
+the structural-sharing clone_state): `cold` is a first-ever root (every
+validator hashed), `steady` is the production pattern — clone the state,
+mutate a handful of validators/balances (one block's worth), re-root.
+The reference gets the same effect from milhouse + cached_tree_hash
+(/root/reference/consensus/cached_tree_hash/src/lib.rs:1).
+
+Usage: python scripts/bench_state_root.py [--validators 16384]
+"""
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def build_state(n):
+    """Synthetic n-validator deneb state (pubkeys are opaque bytes for
+    hashing purposes; no key derivation needed)."""
+    from lighthouse_tpu.types.spec import minimal_spec, FAR_FUTURE_EPOCH
+    from lighthouse_tpu.state_transition.slot import types_for_slot
+
+    spec = minimal_spec()
+    types = types_for_slot(spec, 0)
+    validators = [
+        types.Validator.make(
+            pubkey=i.to_bytes(48, "big"),
+            withdrawal_credentials=i.to_bytes(32, "big"),
+            effective_balance=32 * 10**9,
+            slashed=False,
+            activation_eligibility_epoch=0,
+            activation_epoch=0,
+            exit_epoch=FAR_FUTURE_EPOCH,
+            withdrawable_epoch=FAR_FUTURE_EPOCH,
+        )
+        for i in range(n)
+    ]
+    state = types.BeaconState.default()
+    state.validators = validators
+    state.balances = [32 * 10**9] * n
+    state.previous_epoch_participation = [0] * n
+    state.current_epoch_participation = [0] * n
+    state.inactivity_scores = [0] * n
+    return spec, types, state
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--validators", type=int, default=16384)
+    args = ap.parse_args()
+
+    from lighthouse_tpu.testing.harness import clone_state
+
+    spec, types, state = build_state(args.validators)
+
+    t0 = time.time()
+    root_cold = types.BeaconState.hash_tree_root(state)
+    cold = time.time() - t0
+
+    # steady state: clone + one block's worth of mutation + re-root
+    st2 = clone_state(state, spec)
+    for i in range(8):
+        st2.validators[i * 7] = st2.validators[i * 7].copy_with(
+            effective_balance=31 * 10**9
+        )
+        st2.balances[i * 7] = 31 * 10**9
+    st2.slot = 1
+    t0 = time.time()
+    root_steady = types.BeaconState.hash_tree_root(st2)
+    steady = time.time() - t0
+    assert root_steady != root_cold
+
+    # ground truth: the steady root must equal a from-scratch rehash of an
+    # identical state with no caches anywhere
+    import copy
+
+    st3 = copy.deepcopy(st2)
+    for v in st3.validators:
+        if hasattr(v, "_htr"):
+            object.__delattr__(v, "_htr")
+    t0 = time.time()
+    root_check = types.BeaconState.hash_tree_root(st3)
+    uncached = time.time() - t0
+    assert root_check == root_steady, "cached root diverged from ground truth"
+
+    print(
+        f"validators={args.validators} cold={cold:.3f}s "
+        f"steady={steady:.3f}s uncached={uncached:.3f}s "
+        f"speedup_steady_vs_uncached={uncached / steady:.1f}x"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""THE one-command test suite: `python scripts/run_tests.py`.
+
+Runs every test file in its own pytest subprocess. Rationale: XLA:CPU has
+process-lifetime instability — its executable serializer / compile path
+intermittently aborts the interpreter late in a long multi-program process
+(observed at jax 0.9.0 after ~150 compiled programs; each file passes in
+isolation). Per-file processes bound the program count per interpreter, so
+the whole suite runs green in one command. Files run serially: this image
+has one core, so in-process parallelism would only thrash the compiler.
+
+Exit code 0 iff every file passed. Output: one line per file + a summary.
+
+Options:
+  --fail-fast     stop at the first failing file
+  --filter SUBSTR only files whose name contains SUBSTR
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+# Longest files first is deliberately NOT used: alphabetical order keeps
+# output stable and diffs between runs readable.
+
+
+def test_files() -> list[Path]:
+    files = sorted((REPO / "tests").glob("test_*.py"))
+    files += sorted((REPO / "tests" / "ef").glob("test_*.py"))
+    return files
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--fail-fast", action="store_true")
+    ap.add_argument("--filter", default=None)
+    args = ap.parse_args()
+
+    files = test_files()
+    if args.filter:
+        files = [f for f in files if args.filter in f.name]
+    if not files:
+        print("no test files matched", file=sys.stderr)
+        return 2
+
+    total_pass = total_fail = 0
+    failed_files = []
+    t_start = time.time()
+    for f in files:
+        rel = f.relative_to(REPO)
+        t0 = time.time()
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", str(rel), "-q", "--no-header", "-p", "no:cacheprovider"],
+            cwd=REPO,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        dt = time.time() - t0
+        tail = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+        summary = tail[-1] if tail else "(no output)"
+        status = "ok " if proc.returncode == 0 else "FAIL"
+        print(f"[{status}] {rel} ({dt:.0f}s) — {summary}", flush=True)
+        # pytest exit 5 = no tests collected; treat as pass (e.g. vectors
+        # dir present but empty on a fresh checkout)
+        if proc.returncode in (0, 5):
+            total_pass += 1
+        else:
+            total_fail += 1
+            failed_files.append(str(rel))
+            if proc.returncode != 1:
+                # not plain test failures: interpreter crash / usage error —
+                # show the tail for diagnosis
+                print("\n".join(tail[-15:]), flush=True)
+            if args.fail_fast:
+                break
+
+    dt_all = time.time() - t_start
+    print(
+        f"\n{total_pass}/{total_pass + total_fail} files green "
+        f"in {dt_all/60:.1f} min"
+    )
+    if failed_files:
+        print("failed files:")
+        for ff in failed_files:
+            print(f"  {ff}")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,198 @@
+"""The execution-layer circuit through the chain: newPayload on import,
+forkchoiceUpdated on head change, getPayload in production, invalidation.
+
+Reference behavior being mirrored:
+/root/reference/beacon_node/beacon_chain/src/execution_payload.rs:113
+(notify_new_payload on import), canonical_head.rs (fcU on head change),
+/root/reference/beacon_node/execution_layer/src/lib.rs:807 (get_payload
+production flow), proto_array execution-status invalidation."""
+
+import dataclasses
+
+import pytest
+
+from lighthouse_tpu.chain.beacon_chain import BeaconChain, BlockError
+from lighthouse_tpu.chain.execution_layer import (
+    ExecutionLayer,
+    payload_from_json,
+    payload_to_json,
+)
+from lighthouse_tpu.crypto import bls, kzg
+from lighthouse_tpu.execution.engine_api import MockExecutionLayer, PayloadStatus
+from lighthouse_tpu.fork_choice.proto_array import ExecutionStatus
+from lighthouse_tpu.state_transition.slot import process_slots, types_for_slot
+from lighthouse_tpu.testing.harness import StateHarness, clone_state
+from lighthouse_tpu.types.spec import MINIMAL_PRESET, minimal_spec
+
+VALIDATORS = 64
+N_FE = 8
+
+
+@pytest.fixture()
+def env():
+    bls.set_backend("python")
+    spec = minimal_spec(
+        preset=dataclasses.replace(MINIMAL_PRESET, FIELD_ELEMENTS_PER_BLOB=N_FE)
+    )
+    setup = kzg.TrustedSetup.insecure_dev_setup(N_FE)
+    harness = StateHarness.new(spec, VALIDATORS)
+    engine = MockExecutionLayer()
+    el = ExecutionLayer(engine, spec)
+    chain = BeaconChain(
+        spec,
+        clone_state(harness.state, spec),
+        kzg_setup=setup,
+        execution_layer=el,
+    )
+    return harness, chain, engine, setup
+
+
+def _produce_signed(harness, chain, slot, blobs_bundle=None):
+    """Produce on the chain (EL-backed) and sign with the harness keys."""
+    spec = harness.spec
+    types = types_for_slot(spec, slot)
+    import lighthouse_tpu.state_transition.accessors as acc
+
+    st = clone_state(harness.state, spec)
+    if st.slot < slot:
+        process_slots(st, spec, slot)
+    proposer = acc.get_beacon_proposer_index(st, spec)
+    reveal = harness.randao_reveal(st, proposer, slot // spec.preset.SLOTS_PER_EPOCH)
+    chain.slot_clock.set_slot(slot)
+    chain.per_slot_task()
+    block = chain.produce_block(slot, reveal, blobs_bundle=blobs_bundle)
+    return harness.sign_block(block, types)
+
+
+def test_produced_block_carries_el_payload(env):
+    harness, chain, engine, _ = env
+    signed = _produce_signed(harness, chain, 1)
+    payload = signed.message.body.execution_payload
+
+    # the EL built a real payload: non-zero hash, linked to the EL genesis,
+    # consensus-consistent randao + timestamp (verified by import below)
+    assert bytes(payload.block_hash) != b"\x00" * 32
+    assert bytes(payload.parent_hash) == b"\x00" * 32  # mock EL genesis
+    assert len(payload.withdrawals) >= 0  # capella field present
+
+    root = chain.process_block(signed)
+    harness.apply_block(signed)
+    assert chain.head_root == root
+    # newPayload was called on import and the verdict confirmed the block
+    assert engine.blocks[bytes(payload.block_hash)]["number"] == 1
+    st = chain.fork_choice.proto.nodes[
+        chain.fork_choice.proto.index_by_root[root]
+    ].execution_status
+    assert st == ExecutionStatus.valid
+    # payload hash tracked for fcU/production linkage
+    assert chain.payload_hash_by_block[root] == bytes(payload.block_hash)
+
+
+def test_payload_chain_links_and_fcu_follows_head(env):
+    harness, chain, engine, _ = env
+    hashes = []
+    for slot in range(1, 4):
+        signed = _produce_signed(harness, chain, slot)
+        chain.process_block(signed)
+        harness.apply_block(signed)
+        hashes.append(bytes(signed.message.body.execution_payload.block_hash))
+    # payloads form a chain
+    for i in range(1, len(hashes)):
+        assert engine.blocks[hashes[i]]["parent"] == hashes[i - 1]
+    # the EL head followed the consensus head via forkchoiceUpdated
+    assert engine.head == hashes[-1]
+
+
+def test_invalid_payload_rejected_and_not_imported(env):
+    harness, chain, engine, _ = env
+    signed = _produce_signed(harness, chain, 1)
+    bad_hash = bytes(signed.message.body.execution_payload.block_hash)
+    engine.invalid_hashes.add(bad_hash)
+
+    with pytest.raises(BlockError, match="payload invalid"):
+        chain.process_block(signed)
+    assert chain.head_root == chain.genesis_block_root
+    assert bad_hash not in engine.blocks
+
+
+def test_optimistic_import_then_invalidation_moves_head(env):
+    harness, chain, engine, _ = env
+    # import a valid block first
+    s1 = _produce_signed(harness, chain, 1)
+    r1 = chain.process_block(s1)
+    harness.apply_block(s1)
+
+    # second block imports optimistically (engine says SYNCING: parent
+    # missing from a pruned EL double)
+    s2 = _produce_signed(harness, chain, 2)
+    h2 = bytes(s2.message.body.execution_payload.block_hash)
+    engine.blocks.pop(bytes(s1.message.body.execution_payload.block_hash))
+    r2 = chain.process_block(s2)
+    node = chain.fork_choice.proto.nodes[chain.fork_choice.proto.index_by_root[r2]]
+    assert node.execution_status == ExecutionStatus.optimistic
+    assert chain.head_root == r2
+
+    # a later EL verdict invalidates it: head must revert to the valid block
+    head = chain.process_invalid_execution_payload(r2)
+    assert head == r1
+    assert chain.head_root == r1
+
+
+def test_produced_deneb_block_carries_el_blob_commitments(env):
+    harness, chain, engine, setup = env
+    # EL has blobs queued for the next payload (what a real EL mempool does)
+    blobs = [b"".join((j + 1).to_bytes(32, "big") for j in range(N_FE))]
+    from lighthouse_tpu.crypto.bls381 import serde
+
+    comms = [serde.g1_compress(kzg.blob_to_kzg_commitment(b, setup)) for b in blobs]
+    proofs = [
+        serde.g1_compress(kzg.compute_blob_kzg_proof(b, c, setup))
+        for b, c in zip(blobs, comms)
+    ]
+    engine.queued_blobs = list(zip(blobs, comms, proofs))
+
+    signed = _produce_signed(harness, chain, 1)
+    body = signed.message.body
+    assert [bytes(c) for c in body.blob_kzg_commitments] == comms
+
+    # the publish path rebuilds sidecars from the stashed bundle and the
+    # block imports with its blobs available
+    sidecars = chain.sidecars_for_produced_block(signed)
+    assert len(sidecars) == 1
+    root = chain.process_block(signed, blobs=sidecars)
+    harness.apply_block(signed)
+    assert chain.head_root == root
+    assert [bytes(s.blob) for s in chain.get_blobs(root)] == blobs
+
+
+def test_engine_offline_imports_optimistically(env):
+    harness, chain, engine, _ = env
+
+    class Exploding:
+        def new_payload(self, j):
+            raise ConnectionError("engine down")
+
+        def forkchoice_updated(self, *a, **k):
+            raise ConnectionError("engine down")
+
+        def get_payload(self, pid):
+            raise ConnectionError("engine down")
+
+    signed = _produce_signed(harness, chain, 1)     # produced while healthy
+    chain.execution_layer.engine = Exploding()
+    root = chain.process_block(signed)              # imported while down
+    harness.apply_block(signed)
+    node = chain.fork_choice.proto.nodes[chain.fork_choice.proto.index_by_root[root]]
+    assert node.execution_status == ExecutionStatus.optimistic
+    assert chain.head_root == root
+
+
+def test_payload_json_roundtrip(env):
+    harness, chain, engine, _ = env
+    signed = _produce_signed(harness, chain, 1)
+    payload = signed.message.body.execution_payload
+    types = types_for_slot(harness.spec, 1)
+    again = payload_from_json(types, payload_to_json(payload))
+    assert types.ExecutionPayload.hash_tree_root(
+        again
+    ) == types.ExecutionPayload.hash_tree_root(payload)

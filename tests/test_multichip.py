@@ -146,3 +146,40 @@ def test_sharded_matches_unsharded_bit_identical(mesh, jax_backend):
     unsharded = _run_staged(args, mesh=None)
     sharded = _run_sharded(mesh, args)
     assert sharded == unsharded == True  # noqa: E712
+
+
+# --------------------------------------------------------- backend path
+# The production JaxBackend discovers the mesh itself (parallel/mesh.py):
+# verify_signature_sets(_async) is the SAME call sites the chain uses.
+
+
+def test_backend_dispatch_uses_mesh(jax_backend):
+    from lighthouse_tpu import parallel
+
+    parallel.reset_mesh_cache()
+    m = parallel.get_mesh()
+    assert m is not None and m.devices.size == N_DEV
+
+    sets, rands = _build_sets(8, 2, seed=0x54)
+    assert jax_backend.verify_signature_sets(sets, rands) is True
+    bad, bad_rands = _build_sets(8, 2, seed=0x55, tamper=3)
+    assert jax_backend.verify_signature_sets(bad, bad_rands) is False
+    # async path too (what the beacon processor drives)
+    h = jax_backend.verify_signature_sets_async(sets, rands)
+    assert h.result() is True
+
+
+def test_backend_mesh_agrees_with_single_device(jax_backend, monkeypatch):
+    from lighthouse_tpu import parallel
+
+    sets, rands = _build_sets(8, 2, seed=0x56)
+    monkeypatch.setenv("LIGHTHOUSE_TPU_MESH", "0")
+    parallel.reset_mesh_cache()
+    assert parallel.get_mesh() is None
+    single = jax_backend.verify_signature_sets(sets, rands)
+    monkeypatch.setenv("LIGHTHOUSE_TPU_MESH", "1")
+    parallel.reset_mesh_cache()
+    assert parallel.get_mesh() is not None
+    meshed = jax_backend.verify_signature_sets(sets, rands)
+    parallel.reset_mesh_cache()
+    assert single == meshed == True  # noqa: E712
