@@ -668,6 +668,26 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
                 out.append({"peer_id": pid, "score": net.peer_manager.score(pid)})
         self._json({"data": out})
 
+    def get_lh_logs(self):
+        """/lighthouse_tpu/logs: recent structured log records (the SSE
+        log-streaming idiom of common/logging, served as a snapshot)."""
+        from ..utils.logging import RECENT
+
+        self._json(
+            {
+                "data": [
+                    {
+                        "ts": ts,
+                        "level": level,
+                        "component": component,
+                        "msg": msg,
+                        **{k: str(v) for k, v in fields.items()},
+                    }
+                    for ts, level, component, msg, fields in list(RECENT)[-128:]
+                ]
+            }
+        )
+
     def get_attestation_data(self):
         """GET /eth/v1/validator/attestation_data?slot=&committee_index=."""
         from ..validator.beacon_node import InProcessBeaconNode
@@ -838,6 +858,7 @@ _ROUTES = [
     (r"/lighthouse_tpu/database/info", "GET", BeaconApiHandler.get_lh_database_info),
     (r"/lighthouse_tpu/health", "GET", BeaconApiHandler.get_lh_health),
     (r"/lighthouse_tpu/peers/scores", "GET", BeaconApiHandler.get_lh_peers_scores),
+    (r"/lighthouse_tpu/logs", "GET", BeaconApiHandler.get_lh_logs),
     (r"/eth/v1/validator/attestation_data", "GET", BeaconApiHandler.get_attestation_data),
     (r"/eth/v3/validator/blocks/(\d+)", "GET", BeaconApiHandler.get_produce_block),
     (r"/eth/v1/beacon/light_client/bootstrap/(0x[0-9a-f]+)", "GET", BeaconApiHandler.get_lc_bootstrap),

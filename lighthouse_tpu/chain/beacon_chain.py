@@ -701,7 +701,13 @@ class BeaconChain:
                     from ..execution.engine_api import PayloadStatus
 
                     try:
-                        el_status = self.execution_layer.notify_new_payload(payload)
+                        el_status = self.execution_layer.notify_new_payload(
+                            payload,
+                            parent_beacon_block_root=parent_root,
+                            kzg_commitments=getattr(
+                                block.body, "blob_kzg_commitments", ()
+                            ),
+                        )
                     except Exception:
                         # engine unreachable: import optimistically, exactly
                         # like a SYNCING verdict (engines.rs offline state)
@@ -728,7 +734,16 @@ class BeaconChain:
 
         self.payload_hash_by_block[block_root] = payload_hash
 
-        timely = self.current_slot == block.slot
+        # Timely = arrived within the attestation deadline (1/3 slot) of its
+        # OWN slot — not merely "imported during its slot". A block landing
+        # after attesters voted for its parent must count as late, or the
+        # proposer re-org (get_proposer_head) can never fire for the
+        # canonical late-block case. Manual clocks sit at the slot start, so
+        # logical-time tests keep their on-time semantics.
+        timely = (
+            self.current_slot == block.slot
+            and self.slot_clock.seconds_into_slot() < self.spec.seconds_per_slot / 3
+        )
         self.fork_choice.on_tick(self.current_slot)
         self.fork_choice.on_block(signed_block, block_root, state, is_timely=timely)
         if el_status is not None:
@@ -1161,7 +1176,11 @@ class BeaconChain:
         spec = self.spec
         types = types_for_slot(spec, slot)
         fork = spec.fork_name_at_slot(slot)
-        state = self._state_for_block(self.head_root, slot)
+        # proposer re-org: build on the head's PARENT when the head is a
+        # weak late block that fork choice deems safe to orphan
+        # (get_proposer_head, fork_choice.rs:516)
+        parent_root = self.fork_choice.get_proposer_head(self.head_root, slot)
+        state = self._state_for_block(parent_root, slot)
         proposer = acc.get_beacon_proposer_index(state, spec)
 
         attestations = []
@@ -1203,9 +1222,9 @@ class BeaconChain:
                 body_kwargs["bls_to_execution_changes"] = changes
         if fork >= ForkName.altair:
             # pack the sync aggregate built from last slot's subnet
-            # contributions signing our parent (the head)
+            # contributions signing our parent
             agg = self.naive_sync_pool.get_sync_aggregate(
-                max(slot, 1) - 1, self.head_root, types
+                max(slot, 1) - 1, parent_root, types
             )
             body_kwargs["sync_aggregate"] = agg or types.SyncAggregate.make(
                 sync_committee_bits=[False] * spec.preset.SYNC_COMMITTEE_SIZE,
@@ -1215,7 +1234,7 @@ class BeaconChain:
             payload = types.ExecutionPayload.default()
             if self.execution_layer is not None:
                 payload, el_bundle = self._request_el_payload(
-                    state, spec, types, fork, proposer
+                    state, spec, types, fork, proposer, parent_root
                 )
                 if el_bundle is not None and blobs_bundle is None:
                     blobs_bundle = el_bundle
@@ -1242,19 +1261,20 @@ class BeaconChain:
         block = types.BeaconBlock.make(
             slot=slot,
             proposer_index=proposer,
-            parent_root=self.head_root,
+            parent_root=parent_root,
             state_root=b"\x00" * 32,
             body=types.BeaconBlockBody.make(**body_kwargs),
         )
         trial = types.SignedBeaconBlock.make(message=block, signature=b"\x00" * 96)
-        post = self._state_for_block(self.head_root, slot)
+        post = self._state_for_block(parent_root, slot)
         per_block_processing(
             post, trial, spec, types,
             strategy=SignatureStrategy.NO_VERIFICATION, verify_block_root=True,
         )
         return block.copy_with(state_root=types.BeaconState.hash_tree_root(post))
 
-    def _request_el_payload(self, state, spec, types, fork, proposer: int):
+    def _request_el_payload(self, state, spec, types, fork, proposer: int,
+                            parent_root: bytes | None = None):
         """fcU-with-attributes + getPayload against the EL for a block being
         produced on `state` (already advanced to the proposal slot)
         (execution_layer/src/lib.rs get_payload flow). Returns
@@ -1265,7 +1285,9 @@ class BeaconChain:
         )
         from ..types.spec import ForkName
 
-        head_hash = self.payload_hash_by_block.get(self.head_root, b"\x00" * 32)
+        if parent_root is None:
+            parent_root = self.head_root
+        head_hash = self.payload_hash_by_block.get(parent_root, b"\x00" * 32)
         jc_root = self.fork_choice.store.justified_checkpoint[1]
         fc_root = self.fork_choice.store.finalized_checkpoint[1]
         withdrawals = None
@@ -1282,6 +1304,7 @@ class BeaconChain:
             ),
             fee_recipient=self.proposer_preparations.get(proposer),
             withdrawals=withdrawals,
+            parent_beacon_block_root=parent_root if fork >= ForkName.deneb else None,
         )
         return payload, bundle
 

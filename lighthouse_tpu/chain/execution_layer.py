@@ -126,10 +126,14 @@ class ExecutionLayer:
     """Holds the engine handle + chain-side policy (execution_layer/src/lib.rs
     trimmed to the consensus-facing surface)."""
 
-    def __init__(self, engine, spec, default_fee_recipient: bytes = b"\x00" * 20):
+    def __init__(self, engine, spec, default_fee_recipient: bytes = b"\x00" * 20,
+                 verify_block_hashes: bool = False):
         self.engine = engine
         self.spec = spec
         self.default_fee_recipient = default_fee_recipient
+        # cross-check payload.block_hash == keccak(rlp(header)) on import
+        # (block_hash.rs); OFF for test doubles whose hashes are synthetic
+        self.verify_block_hashes = verify_block_hashes
         # metrics-ish counters
         self.new_payloads = 0
         self.forkchoice_updates = 0
@@ -137,11 +141,32 @@ class ExecutionLayer:
 
     # ---- import side (execution_payload.rs notify_new_payload)
 
-    def notify_new_payload(self, payload) -> str:
+    def notify_new_payload(self, payload, parent_beacon_block_root=None,
+                           kzg_commitments=()) -> str:
         """Submit an imported block's payload; returns the engine verdict
-        (VALID / INVALID / SYNCING / ACCEPTED)."""
+        (VALID / INVALID / SYNCING / ACCEPTED). When enabled, the payload's
+        claimed block_hash is first re-derived locally — a wrong hash is
+        INVALID without consulting the engine (block_hash.rs).
+        `kzg_commitments` (the block body's) become the V3 call's expected
+        blob versioned hashes (sha256(commitment) with a 0x01 version
+        byte)."""
+        if self.verify_block_hashes:
+            from ..execution.block_hash import verify_payload_block_hash
+
+            if not verify_payload_block_hash(payload, parent_beacon_block_root):
+                return PayloadStatus.invalid.value
         self.new_payloads += 1
-        res = self.engine.new_payload(payload_to_json(payload))
+        import hashlib
+
+        versioned = [
+            b"\x01" + hashlib.sha256(bytes(c)).digest()[1:]
+            for c in kzg_commitments
+        ]
+        res = self.engine.new_payload(
+            payload_to_json(payload),
+            versioned_hashes=versioned,
+            parent_beacon_block_root=parent_beacon_block_root,
+        )
         return res.get("status", PayloadStatus.syncing.value)
 
     # ---- head side (canonical_head.rs fcU)
@@ -164,6 +189,7 @@ class ExecutionLayer:
         prev_randao: bytes,
         fee_recipient: bytes | None = None,
         withdrawals=None,
+        parent_beacon_block_root: bytes | None = None,
     ):
         """fcU-with-attributes + getPayload. Returns (ExecutionPayload,
         blobs_bundle | None) where blobs_bundle = (blobs, commitments,
@@ -175,6 +201,9 @@ class ExecutionLayer:
         }
         if withdrawals is not None:
             attrs["withdrawals"] = [withdrawal_to_json(w) for w in withdrawals]
+        if parent_beacon_block_root is not None:
+            # PayloadAttributesV3 (deneb+): required or the fcU is rejected
+            attrs["parentBeaconBlockRoot"] = _hexb(parent_beacon_block_root)
         res = self.notify_forkchoice_updated(
             head_payload_hash, safe_hash, finalized_hash, attrs
         )

@@ -186,6 +186,79 @@ class OperationPool:
         ]
         return proposer_slashings, attester_slashings, exits, changes
 
+    # --------------------------------------------------------- persistence
+
+    OP_POOL_KEY = b"persisted-op-pool"
+
+    def persist(self, store, types) -> None:
+        """Serialize the pool into the chain store so a restart does not
+        drop pending operations (operation_pool/src/persistence.rs).
+        Containers go through SSZ (their classes are built dynamically per
+        SpecTypes, so pickling the objects directly would not round-trip)."""
+        import pickle
+
+        payload = {
+            "attestations": [
+                (
+                    types.AttestationData.serialize(e.data),
+                    list(e.aggregation_bits),
+                    e.signature,
+                    sorted(e.attesting_indices),
+                )
+                for bucket in self.attestations.values()
+                for e in bucket
+            ],
+            "proposer_slashings": [
+                types.ProposerSlashing.serialize(s)
+                for s in self.proposer_slashings.values()
+            ],
+            "attester_slashings": [
+                types.AttesterSlashing.serialize(s) for s in self.attester_slashings
+            ],
+            "voluntary_exits": [
+                types.SignedVoluntaryExit.serialize(e)
+                for e in self.voluntary_exits.values()
+            ],
+            "bls_changes": [
+                types.SignedBLSToExecutionChange.serialize(c)
+                for c in self.bls_changes.values()
+            ]
+            if hasattr(types, "SignedBLSToExecutionChange")
+            else [],
+        }
+        store.put_chain_item(self.OP_POOL_KEY, pickle.dumps(payload))
+
+    @classmethod
+    def load(cls, store, spec, types) -> "OperationPool":
+        """Rebuild a pool persisted by `persist`; empty pool when none."""
+        import pickle
+        import types as _pytypes
+
+        pool = cls(spec)
+        raw = store.get_chain_item(cls.OP_POOL_KEY)
+        if raw is None:
+            return pool
+        payload = pickle.loads(raw)
+        for data_ssz, bits, sig, indices in payload["attestations"]:
+            att = _pytypes.SimpleNamespace(
+                data=types.AttestationData.deserialize(data_ssz),
+                aggregation_bits=bits,
+                signature=sig,
+            )
+            pool.insert_attestation(att, indices, types)
+        for s in payload["proposer_slashings"]:
+            pool.insert_proposer_slashing(types.ProposerSlashing.deserialize(s))
+        for s in payload["attester_slashings"]:
+            pool.insert_attester_slashing(types.AttesterSlashing.deserialize(s))
+        for e in payload["voluntary_exits"]:
+            pool.insert_voluntary_exit(types.SignedVoluntaryExit.deserialize(e))
+        if hasattr(types, "SignedBLSToExecutionChange"):
+            for c in payload.get("bls_changes", []):
+                pool.insert_bls_change(
+                    types.SignedBLSToExecutionChange.deserialize(c)
+                )
+        return pool
+
     # ------------------------------------------------------------- pruning
 
     def prune(self, state) -> None:

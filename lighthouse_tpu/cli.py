@@ -42,6 +42,9 @@ def _load_spec(args):
 
 def cmd_bn(args):
     """Run a beacon node: chain + HTTP API + metrics (client/builder.rs)."""
+    from .utils.logging import get_logger
+
+    log = get_logger("beacon_node")
     from .chain.beacon_chain import BeaconChain
     from .api.http_api import serve
     from .crypto import bls
@@ -99,7 +102,7 @@ def cmd_bn(args):
             else b"\x00" * 20
         )
         execution_layer = ExecutionLayer(engine, spec, default_fee_recipient=fee)
-        print(f"execution engine: {args.engine}")
+        log.info("execution engine connected", url=args.engine)
 
     clock = SystemTimeSlotClock(state.genesis_time, spec.seconds_per_slot)
     chain = BeaconChain(
@@ -121,11 +124,16 @@ def cmd_bn(args):
             eth1_rpc = EngineApiClient(args.eth1, b"\x00" * 32)
         eth1_service = Eth1Service(eth1_rpc, spec, _tfs(spec, 0))
         chain.eth1_cache = eth1_service.cache
-        print(f"eth1 endpoint: {args.eth1}")
+        log.info("eth1 endpoint connected", url=args.eth1)
 
     from .chain.op_pool import OperationPool
+    from .state_transition.slot import types_for_slot as _tfs_pool
 
-    op_pool = OperationPool(spec)
+    if store is not None:
+        # pending operations survive restarts (persistence.rs)
+        op_pool = OperationPool.load(store, spec, _tfs_pool(spec, 0))
+    else:
+        op_pool = OperationPool(spec)
     slasher_svc = None
     if args.slasher:
         from .slasher.service import SlasherService
@@ -135,31 +143,29 @@ def cmd_bn(args):
             op_pool=op_pool, types=_tfs(spec, 0)
         )
         chain.slasher = slasher_svc
-        print("slasher enabled")
+        log.info("slasher enabled")
 
     server, _t, port = serve(chain, op_pool=op_pool, port=args.http_port)
-    print(f"HTTP API on :{port}")
+    log.info("HTTP API started", port=port)
     mserver, mport = metrics_http_server(port=args.metrics_port)
-    print(f"metrics on :{mport}/metrics")
+    log.info("metrics server started", port=mport)
 
-    executor = TaskExecutor(
-        name="bn", log=lambda m: print(f"[executor] {m}", file=sys.stderr)
-    )
+    executor = TaskExecutor(name="bn", log=lambda m: log.info(m))
 
     def slot_timer(exit_signal):
         while not exit_signal.wait(clock.duration_to_next_slot()):
             chain.per_slot_task()
             HEAD_SLOT.set(chain.head_state().slot)
-            print(f"slot {clock.now()} head {chain.head_root.hex()[:8]}")
+            log.info("slot", slot=clock.now(), head=chain.head_root.hex()[:8])
             now = clock.now() or 0
             if slasher_svc is not None and now % spec.preset.SLOTS_PER_EPOCH == 0:
                 found = slasher_svc.process()
                 if found:
-                    print(f"slasher: broadcast {found} slashings")
+                    log.warn("slasher broadcast slashings", count=found)
             if eth1_service is not None:
                 n = eth1_service.poll_once()
                 if n:
-                    print(f"eth1: ingested {n} deposit logs")
+                    log.info("eth1 deposits ingested", count=n)
             # slot tail: pre-compute the next-slot head state
             # (state_advance_timer analog)
             chain.advance_head_state()
@@ -172,6 +178,8 @@ def cmd_bn(args):
     finally:
         server.shutdown()
         mserver.shutdown()
+        if store is not None:
+            op_pool.persist(store, _tfs_pool(spec, 0))
         if lock is not None:
             lock.release()
     return 1 if executor.panicked else 0
@@ -207,7 +215,10 @@ def cmd_vc(args):
     from .utils.slot_clock import SystemTimeSlotClock
 
     clock = SystemTimeSlotClock(genesis_time, spec.seconds_per_slot)
-    print(f"VC started with {len(store.validators)} validators")
+    from .utils.logging import get_logger
+
+    vlog = get_logger("validator_client")
+    vlog.info("started", validators=len(store.validators))
     try:
         while True:
             # slot start: propose (block_service.rs fires at slot start,
@@ -221,7 +232,7 @@ def cmd_vc(args):
             b = blocks.propose(slot)
             time.sleep(spec.seconds_per_slot / 3)
             n = atts.attest(slot)
-            print(f"slot {slot}: proposed {b} attested {n}")
+            vlog.info("slot duties done", slot=slot, proposed=b, attested=n)
     except KeyboardInterrupt:
         return 0
 

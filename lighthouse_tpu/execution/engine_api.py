@@ -125,8 +125,19 @@ class EngineApiClient:
     def call(self, method: str, params: list):
         return self._call(method, params)
 
-    def new_payload(self, payload_json: dict) -> dict:
-        return self._call("engine_newPayloadV3", [payload_json])
+    def new_payload(self, payload_json: dict, versioned_hashes=None,
+                    parent_beacon_block_root: bytes | None = None) -> dict:
+        """engine_newPayloadV3 requires THREE params: the payload, the
+        expected blob versioned hashes, and the parent beacon block root —
+        a real EL rejects the call without them."""
+        return self._call(
+            "engine_newPayloadV3",
+            [
+                payload_json,
+                ["0x" + h.hex() for h in (versioned_hashes or [])],
+                "0x" + (parent_beacon_block_root or b"\x00" * 32).hex(),
+            ],
+        )
 
     def forkchoice_updated(self, head: bytes, safe: bytes, finalized: bytes, attrs=None) -> dict:
         state = {
@@ -163,7 +174,8 @@ class MockExecutionLayer:
 
     # engine API surface (duck-typed like EngineApiClient)
 
-    def new_payload(self, payload_json: dict) -> dict:
+    def new_payload(self, payload_json: dict, versioned_hashes=None,
+                    parent_beacon_block_root: bytes | None = None) -> dict:
         block_hash = bytes.fromhex(payload_json["blockHash"][2:])
         parent = bytes.fromhex(payload_json["parentHash"][2:])
         if block_hash in self.invalid_hashes:

@@ -144,6 +144,7 @@ class ForkChoice:
             unrealized_finalized_checkpoint=ufc,
             execution_block_hash=exec_hash,
             execution_status=exec_status,
+            timely=bool(is_timely and block.slot == self.store.current_slot),
         )
         if is_timely and block.slot == self.store.current_slot:
             self.proto.set_proposer_boost(block_root)
@@ -235,6 +236,53 @@ class ForkChoice:
             proposer_boost_amount=boost,
             current_epoch=self.store.current_slot // self.spec.preset.SLOTS_PER_EPOCH,
         )
+
+    def get_proposer_head(self, head_root: bytes, proposal_slot: int) -> bytes:
+        """Root the proposer should build on: the canonical head, or its
+        PARENT when the head is a weak, late block that is safe to re-org
+        out (fork_choice.rs:516 get_proposer_head + the re-org thresholds
+        of proto_array_fork_choice.rs:192-357). Every guard must pass or
+        the answer is the head:
+
+          - single-slot re-org (head is exactly one slot behind) and the
+            head itself did not skip a slot (proposer-shuffling stability)
+          - the head block arrived LATE (not timely)
+          - finalization is recent (no deep re-orgs during non-finality)
+          - FFG-competitive: head and parent carry the same justification
+          - the head subtree is weak (< reorg_head_weight_threshold % of a
+            per-slot committee's weight) and the parent strong
+            (>= reorg_parent_weight_threshold %)
+        """
+        spec = self.spec
+        proto = self.proto
+        i = proto.index_by_root.get(head_root)
+        if i is None:
+            return head_root
+        node = proto.nodes[i]
+        if node.parent is None:
+            return head_root
+        parent = proto.nodes[node.parent]
+        if node.slot + 1 != proposal_slot or parent.slot + 1 != node.slot:
+            return head_root
+        if node.timely:
+            return head_root
+        cur_epoch = self.store.current_slot // spec.preset.SLOTS_PER_EPOCH
+        if (
+            cur_epoch - self.store.finalized_checkpoint[0]
+            > spec.reorg_max_epochs_since_finalization
+        ):
+            return head_root
+        if node.justified_checkpoint != parent.justified_checkpoint:
+            return head_root
+        total = sum(self.store.justified_balances)
+        committee_weight = total // spec.preset.SLOTS_PER_EPOCH
+        head_weight = proto.subtree_weight(head_root)
+        parent_weight = proto.subtree_weight(parent.root)
+        if head_weight * 100 >= committee_weight * spec.reorg_head_weight_threshold:
+            return head_root
+        if parent_weight * 100 < committee_weight * spec.reorg_parent_weight_threshold:
+            return head_root
+        return parent.root
 
     def prune(self):
         froot = self.store.finalized_checkpoint[1]

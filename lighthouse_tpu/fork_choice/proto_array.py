@@ -40,6 +40,9 @@ class ProtoNode:
     unrealized_finalized_checkpoint: tuple[int, bytes] | None = None
     execution_block_hash: bytes | None = None
     execution_status: ExecutionStatus = ExecutionStatus.irrelevant
+    # arrived within the attestation deadline of its own slot — late blocks
+    # are re-org candidates (proto_array_fork_choice.rs:192-357)
+    timely: bool = True
 
 
 @dataclass
@@ -91,6 +94,7 @@ class ProtoArrayForkChoice:
         unrealized_finalized_checkpoint=None,
         execution_block_hash: bytes | None = None,
         execution_status: ExecutionStatus = ExecutionStatus.irrelevant,
+        timely: bool = True,
     ) -> None:
         if root in self.index_by_root:
             return
@@ -107,6 +111,7 @@ class ProtoArrayForkChoice:
                 unrealized_finalized_checkpoint=unrealized_finalized_checkpoint,
                 execution_block_hash=execution_block_hash,
                 execution_status=execution_status,
+                timely=timely,
             )
         )
         self.index_by_root[root] = idx
@@ -257,11 +262,20 @@ class ProtoArrayForkChoice:
 
         self._best_child = best_child
         self._best_descendant = best_descendant
+        self._last_subtree = subtree          # for re-org weight queries
 
         j = self.index_by_root[justified_root]
         bd = best_descendant[j]
         head = int(bd) if bd != NONE else j
         return self.nodes[head].root
+
+    def subtree_weight(self, root: bytes) -> int:
+        """Subtree vote weight from the most recent find_head pass."""
+        sub = getattr(self, "_last_subtree", None)
+        i = self.index_by_root.get(root)
+        if sub is None or i is None or i >= len(sub):
+            return 0
+        return int(sub[i])
 
     def _node_viable_with(self, best_descendant, idx: int) -> bool:
         bd = best_descendant[idx]

@@ -374,9 +374,10 @@ class NetworkNode:
                             e.retry_at_slot, []
                         ).append(sidecar)
                         while len(self._early_sidecars) > 4:
-                            self._early_sidecars.pop(
-                                next(iter(self._early_sidecars))
-                            )
+                            # evict the FARTHEST future slot: junk for
+                            # slot+5 must not displace the nearest-due
+                            # bucket (which is about to be drained)
+                            self._early_sidecars.pop(max(self._early_sidecars))
                     return None
                 if e.retriable:
                     if e.missing_parent is not None:

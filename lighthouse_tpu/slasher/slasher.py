@@ -55,7 +55,8 @@ class SlashingEvidence:
 
 class Slasher:
     def __init__(self, store: KeyValueStore | None = None):
-        self.store = store or MemoryStore()
+        # `is not None`, not truthiness: an EMPTY store with __len__ is falsy
+        self.store = store if store is not None else MemoryStore()
         self.attestation_queue: list[AttestationRecord] = []
         self.proposal_queue: list[ProposalRecord] = []
         self.found: list[SlashingEvidence] = []

@@ -25,8 +25,6 @@ from .core import ZERO_HASHES
 
 _sha = hashlib.sha256
 
-#: lists shorter than this merkleize directly — cache bookkeeping loses
-MIN_CACHE_LEAVES = 256
 _RING = 4
 
 

@@ -211,10 +211,24 @@ def process_withdrawal_request(state, spec: ChainSpec, types, request) -> None:
 
 
 def _pubkey_index(state, pubkey: bytes):
-    for i, v in enumerate(state.validators):
-        if bytes(v.pubkey) == pubkey:
-            return i
-    return None
+    """pubkey -> validator index via a per-state lazy map.
+
+    The naive registry scan made every withdrawal/consolidation request and
+    pending deposit O(n) — O(n*m) per block at mainnet scale. The map is
+    built once per state instance and extended incrementally as the
+    registry grows (the validator_pubkey_cache.rs idea applied at the
+    state-transition layer; pubkeys are append-only and never change)."""
+    cache = getattr(state, "_pubkey_idx", None)
+    n = len(state.validators)
+    if cache is None:
+        cache = [{}, 0]
+        object.__setattr__(state, "_pubkey_idx", cache)
+    idx_map, built = cache
+    if built < n:
+        for i in range(built, n):
+            idx_map[bytes(state.validators[i].pubkey)] = i
+        cache[1] = n
+    return idx_map.get(pubkey)
 
 
 def _is_valid_switch_to_compounding_request(state, spec: ChainSpec, request) -> bool:

@@ -132,61 +132,97 @@ def process_inactivity_updates(state, spec):
             )
 
 
-def process_rewards_and_penalties_altair(state, spec, fork):
+def _eligible_validator_indices(state, spec):
+    prev = acc.get_previous_epoch(state, spec)
+    active_prev = set(h.get_active_validator_indices(state, prev))
+    return [
+        i
+        for i, v in enumerate(state.validators)
+        if i in active_prev or (v.slashed and prev + 1 < v.withdrawable_epoch)
+    ]
+
+
+def get_flag_index_deltas(state, spec, flag_index: int, fork, eligible=None):
+    """(rewards, penalties) for one participation flag — the altair pyspec
+    shape, exposed so the EF `rewards` runner can compare per-flag deltas
+    (/root/reference/testing/ef_tests/src/cases/rewards.rs analog).
+    `eligible` lets the epoch transition share ONE registry scan across the
+    four delta sets."""
+    n = len(state.validators)
+    rewards = [0] * n
+    penalties = [0] * n
     if acc.get_current_epoch(state, spec) == 0:
-        return
+        return rewards, penalties
     prev = acc.get_previous_epoch(state, spec)
     total_active = acc.get_total_active_balance(state, spec)
     base_per_incr = acc.get_base_reward_per_increment(state, spec)
     leaking = acc.is_in_inactivity_leak(state, spec)
-    active_prev = set(h.get_active_validator_indices(state, prev))
-    eligible = [
-        i
-        for i, v in enumerate(state.validators)
-        if i in active_prev
-        or (v.slashed and prev + 1 < v.withdrawable_epoch)
-    ]
-    participating_by_flag = [
-        acc.get_unslashed_participating_indices(state, spec, f, prev) for f in range(3)
-    ]
-    balances_by_flag = [
-        acc.get_total_balance(state, spec, idxs) for idxs in participating_by_flag
-    ]
+    participating = acc.get_unslashed_participating_indices(
+        state, spec, flag_index, prev
+    )
+    flag_balance = acc.get_total_balance(state, spec, participating)
+    weight = acc.PARTICIPATION_FLAG_WEIGHTS[flag_index]
+    incr = spec.effective_balance_increment
+    if eligible is None:
+        eligible = _eligible_validator_indices(state, spec)
+    for i in eligible:
+        eff = state.validators[i].effective_balance
+        base_reward = (eff // incr) * base_per_incr
+        if i in participating:
+            if not leaking:
+                reward_numerator = base_reward * weight * (flag_balance // incr)
+                rewards[i] = reward_numerator // (
+                    (total_active // incr) * acc.WEIGHT_DENOMINATOR
+                )
+        elif flag_index != acc.TIMELY_HEAD_FLAG_INDEX:
+            penalties[i] = base_reward * weight // acc.WEIGHT_DENOMINATOR
+    return rewards, penalties
+
+
+def get_inactivity_penalty_deltas(state, spec, fork, eligible=None):
+    """(rewards, penalties) from the inactivity leak (altair pyspec)."""
+    n = len(state.validators)
+    rewards = [0] * n
+    penalties = [0] * n
+    if acc.get_current_epoch(state, spec) == 0:
+        return rewards, penalties
+    prev = acc.get_previous_epoch(state, spec)
+    participating = acc.get_unslashed_participating_indices(
+        state, spec, acc.TIMELY_TARGET_FLAG_INDEX, prev
+    )
     if fork == ForkName.altair:
         inactivity_quotient = spec.inactivity_penalty_quotient_altair
     else:
         inactivity_quotient = spec.inactivity_penalty_quotient_bellatrix
-
+    if eligible is None:
+        eligible = _eligible_validator_indices(state, spec)
     for i in eligible:
-        eff = state.validators[i].effective_balance
-        base_reward = (eff // spec.effective_balance_increment) * base_per_incr
-        for flag_index, weight in enumerate(acc.PARTICIPATION_FLAG_WEIGHTS):
-            if i in participating_by_flag[flag_index] and not leaking:
-                reward_numerator = (
-                    base_reward
-                    * weight
-                    * (balances_by_flag[flag_index] // spec.effective_balance_increment)
-                )
-                mut.increase_balance(
-                    state,
-                    i,
-                    reward_numerator
-                    // (
-                        (total_active // spec.effective_balance_increment)
-                        * acc.WEIGHT_DENOMINATOR
-                    ),
-                )
-            elif i not in participating_by_flag[flag_index]:
-                if flag_index != acc.TIMELY_HEAD_FLAG_INDEX:
-                    mut.decrease_balance(
-                        state, i, base_reward * weight // acc.WEIGHT_DENOMINATOR
-                    )
-        # inactivity penalties (target non-participants)
-        if i not in participating_by_flag[acc.TIMELY_TARGET_FLAG_INDEX]:
+        if i not in participating:
+            eff = state.validators[i].effective_balance
             penalty_numerator = eff * state.inactivity_scores[i]
-            mut.decrease_balance(
-                state, i, penalty_numerator // (spec.inactivity_score_bias * inactivity_quotient)
+            penalties[i] = penalty_numerator // (
+                spec.inactivity_score_bias * inactivity_quotient
             )
+    return rewards, penalties
+
+
+def process_rewards_and_penalties_altair(state, spec, fork):
+    if acc.get_current_epoch(state, spec) == 0:
+        return
+    # pyspec application order: each delta set is applied across the whole
+    # registry before the next (matters only at the zero-balance clamp)
+    eligible = _eligible_validator_indices(state, spec)
+    deltas = [
+        get_flag_index_deltas(state, spec, f, fork, eligible=eligible)
+        for f in range(len(acc.PARTICIPATION_FLAG_WEIGHTS))
+    ]
+    deltas.append(
+        get_inactivity_penalty_deltas(state, spec, fork, eligible=eligible)
+    )
+    for rewards, penalties in deltas:
+        for i in range(len(state.validators)):
+            mut.increase_balance(state, i, rewards[i])
+            mut.decrease_balance(state, i, penalties[i])
 
 
 def process_registry_updates(state, spec):

@@ -36,9 +36,13 @@ class HotColdDB:
         config: StoreConfig | None = None,
     ):
         self.spec = spec
-        self.hot = hot or MemoryStore()
-        self.cold = cold or MemoryStore()
-        self.blobs_db = blobs or self.hot
+        # `is not None`, NOT truthiness: stores define __len__, so a FRESH
+        # (empty) NativeKVStore is falsy and `hot or MemoryStore()` would
+        # silently swap the durable store for an in-memory one on first
+        # boot — every "persisted" write would vanish on restart.
+        self.hot = hot if hot is not None else MemoryStore()
+        self.cold = cold if cold is not None else MemoryStore()
+        self.blobs_db = blobs if blobs is not None else self.hot
         self.config = config or StoreConfig()
         self.split_slot = 0  # boundary: slots < split are in the freezer
 

@@ -78,8 +78,27 @@ class VectorAccess:
             )
 
 
-def _spec_for(config: str):
-    return minimal_spec() if config == "minimal" else mainnet_spec()
+_FORK_ORDER = ["phase0", "altair", "bellatrix", "capella", "deneb", "electra"]
+
+
+def spec_at_fork(config: str, fork: str, fork_epoch_overrides: dict | None = None):
+    """A spec with `fork` active from genesis and LATER forks disabled —
+    vectors under {config}/{fork}/ must run with that fork's rules (the
+    reference monomorphizes per fork; we pin the runtime spec instead)."""
+    overrides: dict = {}
+    for i, f in enumerate(_FORK_ORDER[1:], start=1):
+        overrides[f + "_fork_epoch"] = 0 if i <= _FORK_ORDER.index(fork) else None
+    if fork_epoch_overrides:
+        overrides.update(fork_epoch_overrides)
+    if config == "minimal":
+        return minimal_spec(**overrides)
+    import dataclasses
+
+    return dataclasses.replace(mainnet_spec(), **overrides)
+
+
+def _spec_for(config: str, fork: str = "deneb"):
+    return spec_at_fork(config, fork)
 
 
 def _fork_types(spec, fork: str):
@@ -121,6 +140,26 @@ def _op_bls_change(st, sp, t, op, f):
     _verify_now(sets)
 
 
+def _op_sync_aggregate(st, sp, t, op, f):
+    import types as _pytypes
+
+    shim = _pytypes.SimpleNamespace(
+        slot=st.slot, body=_pytypes.SimpleNamespace(sync_aggregate=op)
+    )
+    sets: list = []
+    blk.process_sync_aggregate(st, sp, t, shim, sets.append, _pkg(st))
+    _verify_now(sets)
+
+
+def _electra_op(fn):
+    def run(st, sp, t, op, f):
+        from ..state_transition import electra as el
+
+        getattr(el, fn)(st, sp, t, op)
+
+    return run
+
+
 OPERATION_RUNNERS = {
     # handler name -> (input file stem, apply(state, spec, types, op, fork))
     "attestation": ("attestation", _op_attestation),
@@ -129,22 +168,88 @@ OPERATION_RUNNERS = {
     "deposit": ("deposit", lambda st, sp, t, op, f: blk.process_deposit(st, sp, t, op, f)),
     "voluntary_exit": ("voluntary_exit", _op_voluntary_exit),
     "bls_to_execution_change": ("address_change", _op_bls_change),
+    "sync_aggregate": ("sync_aggregate", _op_sync_aggregate),
+    # electra execution requests (EIP-6110/7002/7251)
+    "deposit_request": ("deposit_request", _electra_op("process_deposit_request")),
+    "withdrawal_request": (
+        "withdrawal_request", _electra_op("process_withdrawal_request"),
+    ),
+    "consolidation_request": (
+        "consolidation_request", _electra_op("process_consolidation_request"),
+    ),
 }
 
+def _rewards_and_penalties(st, sp, t, f):
+    if f == ForkName.phase0:
+        ep._process_rewards_and_penalties_phase0(st, sp, t)
+    else:
+        ep.process_rewards_and_penalties_altair(st, sp, f)
+
+
+def _registry_updates(st, sp, t, f):
+    if f >= ForkName.electra:
+        from ..state_transition import electra as el
+
+        el.process_registry_updates_electra(st, sp)
+    else:
+        ep.process_registry_updates(st, sp)
+
+
+def _slashings(st, sp, t, f):
+    if f >= ForkName.electra:
+        from ..state_transition import electra as el
+
+        el.process_slashings_electra(st, sp)
+    else:
+        ep.process_slashings(st, sp, f)
+
+
+def _effective_balances(st, sp, t, f):
+    if f >= ForkName.electra:
+        from ..state_transition import electra as el
+
+        el.process_effective_balance_updates_electra(st, sp)
+    else:
+        ep.process_effective_balance_updates(st, sp)
+
+
+def _participation_records(st, sp, t, f):
+    # phase0: rotate the pending-attestation records
+    st.previous_epoch_attestations = st.current_epoch_attestations
+    st.current_epoch_attestations = []
+
+
+def _pending_deposits(st, sp, t, f):
+    from ..state_transition import electra as el
+
+    el.process_pending_deposits(st, sp, t)
+
+
+def _pending_consolidations(st, sp, t, f):
+    from ..state_transition import electra as el
+
+    el.process_pending_consolidations(st, sp)
+
+
 EPOCH_RUNNERS = {
-    # handler -> fn(state, spec, types, fork)
+    # handler -> fn(state, spec, types, fork); fork-dispatching where the
+    # spec's transition differs per fork
     "justification_and_finalization": lambda st, sp, t, f: ep.process_justification_and_finalization(st, sp, t, f),
     "inactivity_updates": lambda st, sp, t, f: ep.process_inactivity_updates(st, sp),
-    "rewards_and_penalties": lambda st, sp, t, f: ep.process_rewards_and_penalties_altair(st, sp, f),
-    "registry_updates": lambda st, sp, t, f: ep.process_registry_updates(st, sp),
-    "slashings": lambda st, sp, t, f: ep.process_slashings(st, sp, f),
-    "effective_balance_updates": lambda st, sp, t, f: ep.process_effective_balance_updates(st, sp),
+    "rewards_and_penalties": _rewards_and_penalties,
+    "registry_updates": _registry_updates,
+    "slashings": _slashings,
+    "effective_balance_updates": _effective_balances,
     "eth1_data_reset": lambda st, sp, t, f: ep.process_eth1_data_reset(st, sp),
     "slashings_reset": lambda st, sp, t, f: ep.process_slashings_reset(st, sp),
     "randao_mixes_reset": lambda st, sp, t, f: ep.process_randao_mixes_reset(st, sp),
+    "historical_roots_update": lambda st, sp, t, f: ep.process_historical_roots_update(st, sp, t),
     "historical_summaries_update": lambda st, sp, t, f: ep.process_historical_summaries_update(st, sp, t),
     "participation_flag_updates": lambda st, sp, t, f: ep.process_participation_flag_updates(st),
+    "participation_record_updates": _participation_records,
     "sync_committee_updates": lambda st, sp, t, f: ep.process_sync_committee_updates(st, sp, t),
+    "pending_deposits": _pending_deposits,
+    "pending_consolidations": _pending_consolidations,
 }
 
 
@@ -163,7 +268,7 @@ def _pkg(state):
 def run_case(va: VectorAccess, config: str, fork: str, runner: str,
              handler: str, case_dir: Path) -> None:
     """Dispatch one case directory. Raises EfTestError on mismatch."""
-    spec = _spec_for(config)
+    spec = _spec_for(config, fork)
     types = _fork_types(spec, fork)
 
     if runner == "ssz_static":
@@ -180,8 +285,14 @@ def run_case(va: VectorAccess, config: str, fork: str, runner: str,
         _run_operation(va, spec, types, fork, handler, case_dir)
     elif runner == "epoch_processing":
         _run_epoch(va, spec, types, fork, handler, case_dir)
+    elif runner == "rewards":
+        _run_rewards(va, spec, types, fork, case_dir)
     elif runner == "fork":
         _run_fork_upgrade(va, spec, fork, case_dir)
+    elif runner == "transition":
+        _run_transition(va, config, fork, case_dir)
+    elif runner == "fork_choice":
+        _run_fork_choice(va, spec, fork, case_dir)
     elif runner == "bls":
         _run_bls(va, handler, case_dir)
     elif runner == "kzg":
@@ -280,6 +391,10 @@ def _run_operation(va, spec, types, fork, handler, case_dir):
         "deposit": "Deposit",
         "voluntary_exit": "SignedVoluntaryExit",
         "bls_to_execution_change": "SignedBLSToExecutionChange",
+        "sync_aggregate": "SyncAggregate",
+        "deposit_request": "DepositRequest",
+        "withdrawal_request": "WithdrawalRequest",
+        "consolidation_request": "ConsolidationRequest",
     }[handler]
     op = getattr(types, op_type).deserialize(op_ssz)
     try:
@@ -302,6 +417,177 @@ def _run_epoch(va, spec, types, fork, handler, case_dir):
             return
         raise EfTestError(f"epoch transition failed: {e}") from e
     _check_post(types, pre, post, True)
+
+
+def _deltas_type(spec):
+    from ..ssz.core import Container, List as SSZList, uint64
+
+    limit = spec.preset.VALIDATOR_REGISTRY_LIMIT
+    return Container(
+        "Deltas",
+        [("rewards", SSZList(uint64, limit)), ("penalties", SSZList(uint64, limit))],
+    )
+
+
+def _run_rewards(va, spec, types, fork, case_dir):
+    """Official rewards vectors: per-component (rewards, penalties) lists
+    (ef_tests/src/cases/rewards.rs). Altair+ flags map to
+    source/target/head deltas plus the inactivity penalty deltas."""
+    if ForkName[fork] == ForkName.phase0:
+        raise EfTestError("phase0 rewards runner not implemented")
+    pre = types.BeaconState.deserialize(va.read_ssz(case_dir, "pre.ssz_snappy"))
+    D = _deltas_type(spec)
+    names = ["source_deltas", "target_deltas", "head_deltas"]
+    for flag_index, name in enumerate(names):
+        want = D.deserialize(va.read_ssz(case_dir, f"{name}.ssz_snappy"))
+        rewards, penalties = ep.get_flag_index_deltas(
+            pre, spec, flag_index, ForkName[fork]
+        )
+        if list(want.rewards) != rewards or list(want.penalties) != penalties:
+            raise EfTestError(f"{name} mismatch")
+    want = D.deserialize(
+        va.read_ssz(case_dir, "inactivity_penalty_deltas.ssz_snappy")
+    )
+    rewards, penalties = ep.get_inactivity_penalty_deltas(pre, spec, ForkName[fork])
+    if list(want.rewards) != rewards or list(want.penalties) != penalties:
+        raise EfTestError("inactivity_penalty_deltas mismatch")
+
+
+def _run_transition(va, config, fork, case_dir):
+    """Official transition vectors: blocks crossing a fork boundary
+    (ef_tests/src/cases/transition.rs). `fork` is the POST fork; meta gives
+    the activation epoch; pre is a PRE-fork state."""
+    meta = va.read_yaml(case_dir, "meta.yaml")
+    post_fork = meta.get("post_fork", fork)
+    fork_epoch = int(meta["fork_epoch"])
+    n_blocks = int(meta["blocks_count"])
+    pre_fork = _FORK_ORDER[_FORK_ORDER.index(post_fork) - 1]
+    spec = spec_at_fork(config, pre_fork, {post_fork + "_fork_epoch": fork_epoch})
+    pre_types = spec_types(spec.preset, ForkName[pre_fork])
+    post_types = spec_types(spec.preset, ForkName[post_fork])
+    state = pre_types.BeaconState.deserialize(va.read_ssz(case_dir, "pre.ssz_snappy"))
+    for i in range(n_blocks):
+        raw = va.read_ssz(case_dir, f"blocks_{i}.ssz_snappy")
+        bt = types_for_slot(spec, fork_epoch * spec.preset.SLOTS_PER_EPOCH)
+        # block fork is decided by its slot (the transition block itself is
+        # a post-fork block)
+        # peek slot: first 8 bytes of the message after the 100-byte
+        # envelope is fork-agnostic; simpler: try post types then pre
+        try:
+            sb = post_types.SignedBeaconBlock.deserialize(raw)
+            bt = types_for_slot(spec, sb.message.slot)
+            sb = bt.SignedBeaconBlock.deserialize(raw)
+        except Exception:
+            sb = pre_types.SignedBeaconBlock.deserialize(raw)
+            bt = pre_types
+        if state.slot < sb.message.slot:
+            process_slots(state, spec, sb.message.slot)
+        per_block_processing(
+            state, sb, spec, bt,
+            strategy=SignatureStrategy.VERIFY_BULK, verify_block_root=True,
+        )
+    post = post_types.BeaconState.deserialize(va.read_ssz(case_dir, "post.ssz_snappy"))
+    _check_post(post_types, state, post, True)
+
+
+def _run_fork_choice(va, spec, fork, case_dir):
+    """Official fork-choice vectors: a step script driving an anchored
+    store (ef_tests/src/cases/fork_choice.rs). Supported steps: tick,
+    block (+ optional `valid: false`), attestation, checks {head,
+    justified_checkpoint, finalized_checkpoint, proposer_boost_root}."""
+    from ..fork_choice.fork_choice import ForkChoice
+    from ..types.state_util import clone_state
+
+    types = _fork_types(spec, fork)
+    anchor_state = types.BeaconState.deserialize(
+        va.read_ssz(case_dir, "anchor_state.ssz_snappy")
+    )
+    anchor_block = types.BeaconBlock.deserialize(
+        va.read_ssz(case_dir, "anchor_block.ssz_snappy")
+    )
+    anchor_root = types.BeaconBlock.hash_tree_root(anchor_block)
+    fc = ForkChoice(spec, anchor_root, anchor_block.slot, anchor_state)
+    states = {anchor_root: anchor_state}
+    genesis_time = int(anchor_state.genesis_time)
+    steps = va.read_yaml(case_dir, "steps.yaml")
+
+    def current_head():
+        return fc.get_head()
+
+    for step in steps:
+        if "tick" in step:
+            slot = (int(step["tick"]) - genesis_time) // spec.seconds_per_slot
+            fc.on_tick(slot)
+        elif "block" in step:
+            raw = va.read_ssz(case_dir, f"{step['block']}.ssz_snappy")
+            bt = types_for_slot(spec, 0)
+            sb = bt.SignedBeaconBlock.deserialize(raw)
+            bt = types_for_slot(spec, sb.message.slot)
+            sb = bt.SignedBeaconBlock.deserialize(raw)
+            root = bt.BeaconBlock.hash_tree_root(sb.message)
+            parent = bytes(sb.message.parent_root)
+            try:
+                if parent not in states:
+                    raise EfTestError("unknown parent")
+                st = clone_state(states[parent], spec)
+                if st.slot < sb.message.slot:
+                    process_slots(st, spec, sb.message.slot)
+                per_block_processing(
+                    st, sb, spec, bt,
+                    strategy=SignatureStrategy.VERIFY_BULK, verify_block_root=True,
+                )
+                fc.on_block(sb, root, st)
+                states[root] = st
+            except Exception as e:  # noqa: BLE001
+                if step.get("valid", True):
+                    raise EfTestError(f"valid block rejected: {e}") from e
+                continue
+            if not step.get("valid", True):
+                raise EfTestError("invalid block accepted")
+        elif "attestation" in step:
+            raw = va.read_ssz(case_dir, f"{step['attestation']}.ssz_snappy")
+            att = types.Attestation.deserialize(raw)
+            target_root = bytes(att.data.target.root)
+            st = states.get(target_root) or states.get(
+                bytes(att.data.beacon_block_root)
+            )
+            if st is None:
+                raise EfTestError("attestation references unknown state")
+            indices = acc.get_attesting_indices(
+                st, spec, att.data, att.aggregation_bits, None
+            )
+            fc.on_attestation(
+                att.data.slot, indices, bytes(att.data.beacon_block_root),
+                att.data.target.epoch,
+            )
+        elif "checks" in step:
+            checks = step["checks"]
+            if "head" in checks:
+                head = current_head()
+                want = checks["head"]
+                if "0x" + head.hex() != want["root"]:
+                    raise EfTestError(
+                        f"head mismatch: 0x{head.hex()} != {want['root']}"
+                    )
+                got_slot = int(states[head].latest_block_header.slot)
+                if got_slot != int(want["slot"]):
+                    raise EfTestError(f"head slot {got_slot} != {want['slot']}")
+            if "justified_checkpoint" in checks:
+                je, jr = fc.store.justified_checkpoint
+                want = checks["justified_checkpoint"]
+                if int(want["epoch"]) != je or want["root"] != "0x" + jr.hex():
+                    raise EfTestError("justified checkpoint mismatch")
+            if "finalized_checkpoint" in checks:
+                fe, fr = fc.store.finalized_checkpoint
+                want = checks["finalized_checkpoint"]
+                if int(want["epoch"]) != fe or want["root"] != "0x" + fr.hex():
+                    raise EfTestError("finalized checkpoint mismatch")
+            if "proposer_boost_root" in checks:
+                got = fc.proto.proposer_boost_root
+                if checks["proposer_boost_root"] != "0x" + got.hex():
+                    raise EfTestError("proposer boost root mismatch")
+        else:
+            raise EfTestError(f"unknown fork-choice step {sorted(step)}")
 
 
 def _run_fork_upgrade(va, spec, fork, case_dir):

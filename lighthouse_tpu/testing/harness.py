@@ -85,11 +85,16 @@ class StateHarness:
         return self.keypairs[validator_index].sk
 
     def sign_block(self, block, types):
-        domain = h.get_domain(
-            self.state,
-            self.spec,
-            DOMAIN_BEACON_PROPOSER,
-            h.compute_epoch_at_slot(block.slot, self.spec),
+        # Domain from the SPEC's fork schedule at the block's epoch, not
+        # from self.state: the pre-block state still carries the old fork
+        # at an upgrade boundary, and the verifier's advanced state would
+        # use the new one (a real-crypto-only mismatch the fake lane never
+        # sees).
+        epoch = h.compute_epoch_at_slot(block.slot, self.spec)
+        version = self.spec.fork_version(self.spec.fork_name_at_epoch(epoch))
+        domain = h.compute_domain(
+            DOMAIN_BEACON_PROPOSER, version,
+            bytes(self.state.genesis_validators_root),
         )
         root = h.compute_signing_root(types.BeaconBlock, block, domain)
         sig = _sign(self.sk(block.proposer_index), root)

@@ -3,8 +3,9 @@
 
 Usage: python scripts/gen_ef_vectors.py [output_root]
 
-Writes minimal-preset vectors under tests/ef/vectors/ in the exact
-directory/file format of ethereum/consensus-spec-tests
+Writes minimal-preset vectors for EVERY fork (phase0..electra) under
+tests/ef/vectors/ in the exact directory/file format of
+ethereum/consensus-spec-tests
 ({config}/{fork}/{runner}/{handler}/{suite}/{case}/...), generated from
 this implementation with the pure-python crypto backend. They are FROZEN
 REGRESSION vectors (this environment has no egress to fetch the official
@@ -12,6 +13,11 @@ tarballs): they pin current behavior so refactors — in particular the
 TPU-kernel rewrites of the crypto — are diffed against a known-good state.
 Official vectors dropped in the same root run through the same harness
 (lighthouse_tpu/testing/ef_runner.py).
+
+Runners covered: sanity/{slots,blocks}, finality, operations/*,
+epoch_processing/*, rewards (altair+), fork, transition, fork_choice,
+ssz_static, shuffling, bls, kzg-free (kzg vectors come from
+tests/test_kzg.py's dev setup instead).
 """
 
 from __future__ import annotations
@@ -28,13 +34,45 @@ import yaml
 from lighthouse_tpu.crypto import bls
 from lighthouse_tpu.network import snappy
 from lighthouse_tpu.state_transition.slot import process_slots, types_for_slot
+from lighthouse_tpu.testing.ef_runner import spec_at_fork, EPOCH_RUNNERS
 from lighthouse_tpu.testing.harness import StateHarness, clone_state
 from lighthouse_tpu.types.helpers import compute_shuffled_index
-from lighthouse_tpu.types.spec import minimal_spec
+from lighthouse_tpu.types.spec import ForkName
 
 CONFIG = "minimal"
-FORK = "deneb"   # minimal_spec runs all forks from genesis; containers are deneb
 VALIDATORS = 64
+FORKS = ["phase0", "altair", "bellatrix", "capella", "deneb", "electra"]
+
+EPOCH_HANDLERS_COMMON = [
+    "justification_and_finalization", "rewards_and_penalties",
+    "registry_updates", "slashings", "effective_balance_updates",
+    "eth1_data_reset", "slashings_reset", "randao_mixes_reset",
+]
+EPOCH_HANDLERS = {
+    "phase0": EPOCH_HANDLERS_COMMON
+    + ["historical_roots_update", "participation_record_updates"],
+    "altair": EPOCH_HANDLERS_COMMON
+    + ["inactivity_updates", "historical_roots_update",
+       "participation_flag_updates", "sync_committee_updates"],
+    "bellatrix": EPOCH_HANDLERS_COMMON
+    + ["inactivity_updates", "historical_roots_update",
+       "participation_flag_updates", "sync_committee_updates"],
+    "capella": EPOCH_HANDLERS_COMMON
+    + ["inactivity_updates", "historical_summaries_update",
+       "participation_flag_updates", "sync_committee_updates"],
+    "deneb": EPOCH_HANDLERS_COMMON
+    + ["inactivity_updates", "historical_summaries_update",
+       "participation_flag_updates", "sync_committee_updates"],
+    "electra": EPOCH_HANDLERS_COMMON
+    + ["inactivity_updates", "historical_summaries_update",
+       "participation_flag_updates", "sync_committee_updates",
+       "pending_deposits", "pending_consolidations"],
+}
+
+SSZ_STATIC_COMMON = [
+    "AttestationData", "Attestation", "BeaconBlockHeader", "Checkpoint",
+    "Validator", "BeaconState", "SignedBeaconBlock",
+]
 
 
 def w_ssz(case: Path, name: str, data: bytes) -> None:
@@ -47,15 +85,38 @@ def w_yaml(case: Path, name: str, obj) -> None:
     (case / f"{name}.yaml").write_text(yaml.safe_dump(obj))
 
 
-def gen_sanity_and_ops(root: Path) -> None:
-    spec = minimal_spec()
+def _extended_harness(spec, slots: int, harness=None):
+    """A harness advanced `slots` with full participation, collecting the
+    produced blocks."""
+    harness = harness or StateHarness.new(spec, VALIDATORS)
+    blocks = []
+    pending = []
+    types = types_for_slot(spec, 0)
+    for _ in range(slots):
+        slot = harness.state.slot + 1
+        signed, _post = harness.produce_block(
+            slot, attestations=pending, full_sync=True
+        )
+        harness.apply_block(signed)
+        bt = types_for_slot(spec, slot)
+        head_root = bt.BeaconBlock.hash_tree_root(signed.message)
+        pending = harness.build_attestations(
+            clone_state(harness.state, spec), slot, head_root
+        )
+        blocks.append(signed)
+    return harness, blocks, pending
+
+
+def gen_fork(root: Path, fork: str) -> None:
+    spec = spec_at_fork(CONFIG, fork)
     harness = StateHarness.new(spec, VALIDATORS)
     types = types_for_slot(spec, 0)
     S = types.BeaconState
+    base = root / CONFIG / fork
 
     # ---- sanity/slots
     for n in (1, spec.preset.SLOTS_PER_EPOCH):
-        case = root / CONFIG / FORK / "sanity" / "slots" / "pyspec_tests" / f"slots_{n}"
+        case = base / "sanity" / "slots" / "pyspec_tests" / f"slots_{n}"
         pre = clone_state(harness.state, spec)
         w_ssz(case, "pre", S.serialize(pre))
         w_yaml(case, "slots", n)
@@ -63,23 +124,22 @@ def gen_sanity_and_ops(root: Path) -> None:
         process_slots(post, spec, post.slot + n)
         w_ssz(case, "post", S.serialize(post))
 
-    # ---- sanity/blocks: extend a chain, dump block cases with pre/post
+    # ---- sanity/blocks
     pending = []
     for i in range(3):
         slot = harness.state.slot + 1
         pre = clone_state(harness.state, spec)
-        signed, post = harness.produce_block(slot, attestations=pending, full_sync=True)
+        signed, _post = harness.produce_block(slot, attestations=pending, full_sync=True)
         harness.apply_block(signed)
-        head_root = types.BeaconBlock.hash_tree_root(signed.message)
+        bt = types_for_slot(spec, slot)
+        head_root = bt.BeaconBlock.hash_tree_root(signed.message)
         pending = harness.build_attestations(
             clone_state(harness.state, spec), slot, head_root
         )
-        case = (
-            root / CONFIG / FORK / "sanity" / "blocks" / "pyspec_tests" / f"block_{i}"
-        )
+        case = base / "sanity" / "blocks" / "pyspec_tests" / f"block_{i}"
         w_ssz(case, "pre", S.serialize(pre))
         w_yaml(case, "meta", {"blocks_count": 1})
-        w_ssz(case, "blocks_0", types.SignedBeaconBlock.serialize(signed))
+        w_ssz(case, "blocks_0", bt.SignedBeaconBlock.serialize(signed))
         w_ssz(case, "post", S.serialize(harness.state))
 
     # invalid-block case: bad state root => no post
@@ -87,88 +147,385 @@ def gen_sanity_and_ops(root: Path) -> None:
     signed, _post = harness.produce_block(slot, attestations=pending, full_sync=True)
     bad_block = signed.message.copy_with(state_root=b"\xde" * 32)
     bad = types.SignedBeaconBlock.make(message=bad_block, signature=signed.signature)
-    case = root / CONFIG / FORK / "sanity" / "blocks" / "pyspec_tests" / "invalid_state_root"
+    case = base / "sanity" / "blocks" / "pyspec_tests" / "invalid_state_root"
     w_ssz(case, "pre", S.serialize(harness.state))
     w_yaml(case, "meta", {"blocks_count": 1})
     w_ssz(case, "blocks_0", types.SignedBeaconBlock.serialize(bad))
 
-    # ---- operations/attestation from the pending set
+    # ---- finality: two full epochs of blocks in ONE case; the post state
+    # pins the justification/finalization outcome
+    fin_pre = clone_state(harness.state, spec)
+    fin_blocks = []
+    h2 = StateHarness(spec=spec, keypairs=harness.keypairs,
+                      state=clone_state(harness.state, spec))
+    fin_pending = pending
+    for _ in range(2 * spec.preset.SLOTS_PER_EPOCH):
+        slot = h2.state.slot + 1
+        signed, _post = h2.produce_block(slot, attestations=fin_pending, full_sync=True)
+        h2.apply_block(signed)
+        bt = types_for_slot(spec, slot)
+        head_root = bt.BeaconBlock.hash_tree_root(signed.message)
+        fin_pending = h2.build_attestations(
+            clone_state(h2.state, spec), slot, head_root
+        )
+        fin_blocks.append(signed)
+    case = base / "finality" / "finality" / "pyspec_tests" / "two_epochs"
+    w_ssz(case, "pre", S.serialize(fin_pre))
+    w_yaml(case, "meta", {"blocks_count": len(fin_blocks)})
+    for i, b in enumerate(fin_blocks):
+        bt = types_for_slot(spec, b.message.slot)
+        w_ssz(case, f"blocks_{i}", bt.SignedBeaconBlock.serialize(b))
+    w_ssz(case, "post", S.serialize(h2.state))
+
+    # ---- operations/attestation
     st = clone_state(harness.state, spec)
     process_slots(st, spec, st.slot + 1)
     for i, att in enumerate(pending[:2]):
-        case = (
-            root / CONFIG / FORK / "operations" / "attestation" / "pyspec_tests" / f"att_{i}"
-        )
+        case = base / "operations" / "attestation" / "pyspec_tests" / f"att_{i}"
         pre = clone_state(st, spec)
         w_ssz(case, "pre", S.serialize(pre))
         w_ssz(case, "attestation", types.Attestation.serialize(att))
         from lighthouse_tpu.testing.ef_runner import _op_attestation
 
         post = clone_state(pre, spec)
-        _op_attestation(post, spec, types, att, spec.fork_name_at_slot(post.slot))
+        _op_attestation(post, spec, types, att, ForkName[fork])
         w_ssz(case, "post", S.serialize(post))
 
     # invalid attestation (future target) => no post
-    bad_att_data = pending[0].data.copy_with(slot=pending[0].data.slot + 1000)
-    bad_att = pending[0].copy_with(data=bad_att_data)
-    case = root / CONFIG / FORK / "operations" / "attestation" / "pyspec_tests" / "invalid_future"
-    w_ssz(case, "pre", S.serialize(st))
-    w_ssz(case, "attestation", types.Attestation.serialize(bad_att))
+    if pending:
+        bad_att_data = pending[0].data.copy_with(slot=pending[0].data.slot + 1000)
+        bad_att = pending[0].copy_with(data=bad_att_data)
+        case = base / "operations" / "attestation" / "pyspec_tests" / "invalid_future"
+        w_ssz(case, "pre", S.serialize(st))
+        w_ssz(case, "attestation", types.Attestation.serialize(bad_att))
 
-    # ---- epoch_processing on an epoch-boundary state
+    # ---- operations/sync_aggregate (altair+): lift one from a full-sync block
+    if ForkName[fork] >= ForkName.altair:
+        from lighthouse_tpu.testing.ef_runner import _op_sync_aggregate
+
+        agg = fin_blocks[0].message.body.sync_aggregate
+        st_sa = clone_state(fin_pre, spec)
+        process_slots(st_sa, spec, fin_blocks[0].message.slot)
+        case = base / "operations" / "sync_aggregate" / "pyspec_tests" / "full_participation"
+        w_ssz(case, "pre", S.serialize(st_sa))
+        w_ssz(case, "sync_aggregate", types.SyncAggregate.serialize(agg))
+        post = clone_state(st_sa, spec)
+        _op_sync_aggregate(post, spec, types, agg, ForkName[fork])
+        w_ssz(case, "post", S.serialize(post))
+
+    # ---- operations: electra execution requests
+    if ForkName[fork] >= ForkName.electra:
+        _gen_electra_request_ops(base, spec, types, harness)
+
+    # ---- epoch_processing at an epoch boundary
     st2 = clone_state(harness.state, spec)
     target = (st2.slot // spec.preset.SLOTS_PER_EPOCH + 1) * spec.preset.SLOTS_PER_EPOCH
     process_slots(st2, spec, target - 1)
-    from lighthouse_tpu.testing.ef_runner import EPOCH_RUNNERS
-    from lighthouse_tpu.types.spec import ForkName
-
-    for handler in (
-        "justification_and_finalization", "inactivity_updates",
-        "rewards_and_penalties", "registry_updates", "slashings",
-        "effective_balance_updates", "eth1_data_reset", "slashings_reset",
-        "randao_mixes_reset", "historical_summaries_update",
-        "participation_flag_updates", "sync_committee_updates",
-    ):
-        case = (
-            root / CONFIG / FORK / "epoch_processing" / handler / "pyspec_tests" / "boundary"
-        )
+    for handler in EPOCH_HANDLERS[fork]:
+        case = base / "epoch_processing" / handler / "pyspec_tests" / "boundary"
         pre = clone_state(st2, spec)
         w_ssz(case, "pre", S.serialize(pre))
         post = clone_state(pre, spec)
-        EPOCH_RUNNERS[handler](post, spec, types, ForkName[FORK])
+        EPOCH_RUNNERS[handler](post, spec, types, ForkName[fork])
         w_ssz(case, "post", S.serialize(post))
 
-    # ---- ssz_static for a few containers
+    # ---- rewards (altair+): per-flag deltas on the boundary state
+    if ForkName[fork] >= ForkName.altair:
+        from lighthouse_tpu.state_transition import epoch as ep
+        from lighthouse_tpu.testing.ef_runner import _deltas_type
+
+        D = _deltas_type(spec)
+        case = base / "rewards" / "basic" / "pyspec_tests" / "boundary"
+        w_ssz(case, "pre", S.serialize(st2))
+        for flag_index, name in enumerate(
+            ["source_deltas", "target_deltas", "head_deltas"]
+        ):
+            rw, pn = ep.get_flag_index_deltas(st2, spec, flag_index, ForkName[fork])
+            w_ssz(case, name, D.serialize(D.make(rewards=rw, penalties=pn)))
+        rw, pn = ep.get_inactivity_penalty_deltas(st2, spec, ForkName[fork])
+        w_ssz(
+            case, "inactivity_penalty_deltas",
+            D.serialize(D.make(rewards=rw, penalties=pn)),
+        )
+
+    # ---- ssz_static
+    sample_block = fin_blocks[0]
     samples = {
-        "AttestationData": pending[0].data,
-        "Attestation": pending[0],
+        "AttestationData": pending[0].data if pending else None,
+        "Attestation": pending[0] if pending else None,
         "BeaconBlockHeader": harness.state.latest_block_header,
         "Checkpoint": harness.state.finalized_checkpoint,
         "Validator": harness.state.validators[0],
         "BeaconState": harness.state,
+        "SignedBeaconBlock": sample_block,
     }
+    if ForkName[fork] >= ForkName.altair:
+        samples["SyncAggregate"] = sample_block.message.body.sync_aggregate
+    if ForkName[fork] >= ForkName.bellatrix:
+        samples["ExecutionPayload"] = sample_block.message.body.execution_payload
     for name, value in samples.items():
+        if value is None:
+            continue
         ctype = getattr(types, name)
-        case = (
-            root / CONFIG / FORK / "ssz_static" / name / "ssz_random" / "case_0"
-        )
+        case = base / "ssz_static" / name / "ssz_random" / "case_0"
         w_ssz(case, "serialized", ctype.serialize(value))
         w_yaml(case, "roots", {"root": "0x" + ctype.hash_tree_root(value).hex()})
 
     # ---- shuffling
-    rng = random.Random(0x5EED)
+    rng = random.Random(0x5EED + FORKS.index(fork))
     for i in range(2):
         seed = bytes(rng.randrange(256) for _ in range(32))
         count = 64
         rounds = spec.preset.SHUFFLE_ROUND_COUNT
         mapping = [compute_shuffled_index(j, count, seed, rounds) for j in range(count)]
-        case = (
-            root / CONFIG / FORK / "shuffling" / "core" / "shuffle" / f"shuffle_{i}"
-        )
+        case = base / "shuffling" / "core" / "shuffle" / f"shuffle_{i}"
         w_yaml(
             case, "mapping",
             {"seed": "0x" + seed.hex(), "count": count, "mapping": mapping},
         )
+
+
+def _gen_electra_request_ops(base: Path, spec, types, harness) -> None:
+    """operations/{deposit_request,withdrawal_request,consolidation_request}."""
+    from lighthouse_tpu.state_transition import electra as el
+
+    S = types.BeaconState
+    st = clone_state(harness.state, spec)
+    # give validator 0 eth1 credentials so withdrawal requests can act
+    addr = b"\xaa" * 20
+    st.validators[0] = st.validators[0].copy_with(
+        withdrawal_credentials=b"\x01" + b"\x00" * 11 + addr
+    )
+    # and validator 1 compounding credentials (consolidation target)
+    st.validators[1] = st.validators[1].copy_with(
+        withdrawal_credentials=b"\x02" + b"\x00" * 11 + b"\xbb" * 20
+    )
+
+    # deposit_request
+    case = base / "operations" / "deposit_request" / "pyspec_tests" / "new_pubkey"
+    req = types.DepositRequest.make(
+        pubkey=b"\x77" * 48, withdrawal_credentials=b"\x00" + b"\x11" * 31,
+        amount=32 * 10**9, signature=b"\x88" * 96, index=1000,
+    )
+    w_ssz(case, "pre", S.serialize(st))
+    w_ssz(case, "deposit_request", types.DepositRequest.serialize(req))
+    post = clone_state(st, spec)
+    el.process_deposit_request(post, spec, types, req)
+    w_ssz(case, "post", S.serialize(post))
+
+    # withdrawal_request: full exit of validator 0
+    case = base / "operations" / "withdrawal_request" / "pyspec_tests" / "full_exit"
+    req = types.WithdrawalRequest.make(
+        source_address=addr,
+        validator_pubkey=bytes(st.validators[0].pubkey),
+        amount=0,   # FULL_EXIT_REQUEST_AMOUNT
+    )
+    w_ssz(case, "pre", S.serialize(st))
+    w_ssz(case, "withdrawal_request", types.WithdrawalRequest.serialize(req))
+    post = clone_state(st, spec)
+    el.process_withdrawal_request(post, spec, types, req)
+    w_ssz(case, "post", S.serialize(post))
+
+    # consolidation_request: switch validator 0 to compounding
+    case = (
+        base / "operations" / "consolidation_request" / "pyspec_tests"
+        / "switch_to_compounding"
+    )
+    req = types.ConsolidationRequest.make(
+        source_address=addr,
+        source_pubkey=bytes(st.validators[0].pubkey),
+        target_pubkey=bytes(st.validators[0].pubkey),
+    )
+    w_ssz(case, "pre", S.serialize(st))
+    w_ssz(case, "consolidation_request", types.ConsolidationRequest.serialize(req))
+    post = clone_state(st, spec)
+    el.process_consolidation_request(post, spec, types, req)
+    w_ssz(case, "post", S.serialize(post))
+
+
+def gen_fork_upgrades(root: Path) -> None:
+    """fork/ (single-state upgrade) + transition/ (blocks across the
+    boundary) for every fork pair."""
+    from lighthouse_tpu.state_transition.slot import upgrade_state
+    from lighthouse_tpu.types.containers import spec_types
+
+    for pre_fork, post_fork in zip(FORKS[:-1], FORKS[1:]):
+        # ---- fork/: state at an epoch boundary, upgraded
+        spec = spec_at_fork(CONFIG, pre_fork)
+        harness, _blocks, _pending = _extended_harness(
+            spec, spec.preset.SLOTS_PER_EPOCH
+        )
+        pre_types = spec_types(spec.preset, ForkName[pre_fork])
+        post_types = spec_types(spec.preset, ForkName[post_fork])
+        st = clone_state(harness.state, spec)
+        case = (
+            root / CONFIG / post_fork / "fork" / "fork" / "pyspec_tests"
+            / f"fork_{pre_fork}_to_{post_fork}"
+        )
+        w_yaml(case, "meta", {"fork": post_fork})
+        w_ssz(case, "pre", pre_types.BeaconState.serialize(st))
+        post = clone_state(st, spec)
+        upgrade_state(post, spec, ForkName[pre_fork], ForkName[post_fork])
+        w_ssz(case, "post", post_types.BeaconState.serialize(post))
+
+        # ---- transition/: chain crosses the boundary at epoch 1
+        tspec = spec_at_fork(
+            CONFIG, pre_fork, {post_fork + "_fork_epoch": 1}
+        )
+        h2 = StateHarness.new(tspec, VALIDATORS)
+        pre_state = clone_state(h2.state, tspec)
+        blocks = []
+        pending = []
+        for _ in range(tspec.preset.SLOTS_PER_EPOCH + 2):
+            slot = h2.state.slot + 1
+            signed, _post = h2.produce_block(
+                slot, attestations=pending, full_sync=True
+            )
+            h2.apply_block(signed)
+            bt = types_for_slot(tspec, slot)
+            head_root = bt.BeaconBlock.hash_tree_root(signed.message)
+            pending = h2.build_attestations(
+                clone_state(h2.state, tspec), slot, head_root
+            )
+            blocks.append(signed)
+        case = (
+            root / CONFIG / post_fork / "transition" / "core" / "pyspec_tests"
+            / f"transition_{pre_fork}_to_{post_fork}"
+        )
+        w_yaml(
+            case, "meta",
+            {"post_fork": post_fork, "fork_epoch": 1, "blocks_count": len(blocks)},
+        )
+        w_ssz(case, "pre", spec_types(tspec.preset, ForkName[pre_fork]).BeaconState.serialize(pre_state))
+        for i, b in enumerate(blocks):
+            bt = types_for_slot(tspec, b.message.slot)
+            w_ssz(case, f"blocks_{i}", bt.SignedBeaconBlock.serialize(b))
+        w_ssz(
+            case, "post",
+            spec_types(tspec.preset, ForkName[post_fork]).BeaconState.serialize(h2.state),
+        )
+
+
+def gen_fork_choice(root: Path) -> None:
+    """fork_choice/: a step script over an anchored store — linear growth,
+    a competing fork, attestations flipping the head."""
+    from lighthouse_tpu.fork_choice.fork_choice import ForkChoice
+    from lighthouse_tpu.state_transition import accessors as acc
+
+    fork = "deneb"
+    spec = spec_at_fork(CONFIG, fork)
+    harness = StateHarness.new(spec, VALIDATORS)
+    types = types_for_slot(spec, 0)
+    S = types.BeaconState
+    genesis_time = int(harness.state.genesis_time)
+
+    case = (
+        root / CONFIG / fork / "fork_choice" / "get_head" / "pyspec_tests"
+        / "competing_branch"
+    )
+    anchor_state = clone_state(harness.state, spec)
+    hdr = anchor_state.latest_block_header
+    if bytes(hdr.state_root) == b"\x00" * 32:
+        hdr = hdr.copy_with(state_root=S.hash_tree_root(anchor_state))
+    anchor_block = types.BeaconBlock.make(
+        slot=0, proposer_index=hdr.proposer_index, parent_root=hdr.parent_root,
+        state_root=hdr.state_root, body=types.BeaconBlockBody.default(),
+    )
+    w_ssz(case, "anchor_state", S.serialize(anchor_state))
+    w_ssz(case, "anchor_block", types.BeaconBlock.serialize(anchor_block))
+
+    anchor_root = types.BeaconBlock.hash_tree_root(anchor_block)
+    fc = ForkChoice(spec, anchor_root, 0, anchor_state)
+    states = {anchor_root: anchor_state}
+    steps = []
+
+    def tick_to(slot):
+        t = genesis_time + slot * spec.seconds_per_slot
+        steps.append({"tick": t})
+        fc.on_tick(slot)
+
+    def add_block(signed, name):
+        bt = types_for_slot(spec, signed.message.slot)
+        root = bt.BeaconBlock.hash_tree_root(signed.message)
+        w_ssz(case, name, bt.SignedBeaconBlock.serialize(signed))
+        steps.append({"block": name})
+        st = clone_state(states[bytes(signed.message.parent_root)], spec)
+        from lighthouse_tpu.state_transition.block import (
+            SignatureStrategy, per_block_processing,
+        )
+
+        if st.slot < signed.message.slot:
+            process_slots(st, spec, signed.message.slot)
+        per_block_processing(
+            st, signed, spec, bt,
+            strategy=SignatureStrategy.VERIFY_BULK, verify_block_root=True,
+        )
+        fc.on_block(signed, root, st)
+        states[root] = st
+        return root, st
+
+    def check():
+        head = fc.get_head()
+        je, jr = fc.store.justified_checkpoint
+        fe, fr = fc.store.finalized_checkpoint
+        steps.append(
+            {
+                "checks": {
+                    "head": {
+                        "slot": int(states[head].latest_block_header.slot),
+                        "root": "0x" + head.hex(),
+                    },
+                    "justified_checkpoint": {"epoch": je, "root": "0x" + jr.hex()},
+                    "finalized_checkpoint": {"epoch": fe, "root": "0x" + fr.hex()},
+                }
+            }
+        )
+
+    # linear chain of 2 blocks
+    pending = []
+    for i in range(2):
+        slot = harness.state.slot + 1
+        tick_to(slot)
+        signed, _ = harness.produce_block(slot, attestations=pending, full_sync=True)
+        harness.apply_block(signed)
+        bt = types_for_slot(spec, slot)
+        head_root = bt.BeaconBlock.hash_tree_root(signed.message)
+        pending = harness.build_attestations(
+            clone_state(harness.state, spec), slot, head_root
+        )
+        add_block(signed, f"block_{i}")
+        check()
+
+    # competing block at the next slot, on the same parent as a canonical
+    # one: the canonical branch should win via attestation weight
+    slot = harness.state.slot + 1
+    tick_to(slot)
+    canon, _ = harness.produce_block(slot, attestations=pending, full_sync=True)
+    fork_h = StateHarness(
+        spec=spec, keypairs=harness.keypairs, state=clone_state(harness.state, spec)
+    )
+    rival, _ = fork_h.produce_block(slot, attestations=(), full_sync=False)
+    harness.apply_block(canon)
+    r_canon, st_canon = add_block(canon, "block_canon")
+    add_block(rival, "block_rival")
+
+    # attestations for the canonical head break the tie
+    atts = harness.build_attestations(
+        clone_state(harness.state, spec), slot, r_canon
+    )
+    tick_to(slot + 1)
+    for i, att in enumerate(atts[:4]):
+        w_ssz(case, f"attestation_{i}", types.Attestation.serialize(att))
+        steps.append({"attestation": f"attestation_{i}"})
+        indices = acc.get_attesting_indices(
+            st_canon, spec, att.data, att.aggregation_bits, None
+        )
+        fc.on_attestation(
+            att.data.slot, indices, bytes(att.data.beacon_block_root),
+            att.data.target.epoch,
+        )
+    check()
+    w_yaml(case, "steps", steps)
 
 
 def gen_bls(root: Path) -> None:
@@ -181,7 +538,6 @@ def gen_bls(root: Path) -> None:
     sks = [bls.SecretKey(rng.randrange(1, R)) for _ in range(4)]
     msgs = [bytes([i]) * 32 for i in range(4)]
 
-    # sign + verify
     for i, (sk, msg) in enumerate(zip(sks, msgs)):
         sig = bls.sign(sk, msg)
         w_yaml(
@@ -202,7 +558,6 @@ def gen_bls(root: Path) -> None:
                 "output": True,
             },
         )
-    # wrong-message verify
     sig0 = bls.sign(sks[0], msgs[0])
     w_yaml(
         case_dir("verify", "verify_wrong_msg"), "data",
@@ -215,7 +570,6 @@ def gen_bls(root: Path) -> None:
             "output": False,
         },
     )
-    # aggregate + fast_aggregate_verify
     agg = bls.AggregateSignature.empty()
     for sk in sks:
         agg.add_assign(bls.sign(sk, msgs[0]))
@@ -248,7 +602,6 @@ def gen_bls(root: Path) -> None:
             "output": False,
         },
     )
-    # aggregate_verify (distinct messages)
     agg2 = bls.AggregateSignature.empty()
     for sk, m in zip(sks, msgs):
         agg2.add_assign(bls.sign(sk, m))
@@ -263,7 +616,6 @@ def gen_bls(root: Path) -> None:
             "output": True,
         },
     )
-    # batch_verify
     w_yaml(
         case_dir("batch_verify", "bv_ok"), "data",
         {
@@ -297,7 +649,13 @@ def main():
     bls.set_backend("python")
     if out.exists():
         shutil.rmtree(out)
-    gen_sanity_and_ops(out)
+    for fork in FORKS:
+        gen_fork(out, fork)
+        print(f"fork {fork}: done", file=sys.stderr, flush=True)
+    gen_fork_upgrades(out)
+    print("fork/transition: done", file=sys.stderr, flush=True)
+    gen_fork_choice(out)
+    print("fork_choice: done", file=sys.stderr, flush=True)
     gen_bls(out)
     n = sum(1 for _ in out.rglob("*") if _.is_file())
     print(f"wrote {n} vector files under {out}")

@@ -127,3 +127,65 @@ def test_eth1_service_scrapes_logs():
 
     svc.rpc = Boom()
     assert svc.poll_once() == 0 and svc.errors == 1
+
+
+def test_genesis_from_deposit_logs():
+    """Full eth1-genesis path: deposits scraped into the cache trigger
+    genesis once MIN_GENESIS_ACTIVE_VALIDATOR_COUNT is reached
+    (genesis/src/eth1_genesis_service.rs)."""
+    from lighthouse_tpu.chain.eth1 import Eth1Block, Eth1Cache
+    from lighthouse_tpu.state_transition.genesis import (
+        Eth1GenesisService,
+        is_valid_genesis_state,
+    )
+
+    bls.set_backend("python")
+    spec = minimal_spec(
+        min_genesis_active_validator_count=4,
+        min_genesis_time=0,
+        genesis_delay=10,
+    )
+    types = types_for_slot(spec, 0)
+    cache = Eth1Cache()
+    keypairs = bls.interop_keypairs(4)
+    for kp in keypairs:
+        pk = kp.pk.serialize()
+        wc = b"\x00" + hlp.sha256(pk)[1:]
+        msg = types.DepositMessage.make(
+            pubkey=pk, withdrawal_credentials=wc, amount=spec.max_effective_balance
+        )
+        domain = hlp.compute_domain(
+            DOMAIN_DEPOSIT, spec.genesis_fork_version, b"\x00" * 32
+        )
+        root = hlp.compute_signing_root(types.DepositMessage, msg, domain)
+        sig = bls.sign(kp.sk, root).serialize()
+        cache.add_deposit(
+            types.DepositData.make(
+                pubkey=pk, withdrawal_credentials=wc,
+                amount=spec.max_effective_balance, signature=sig,
+            ),
+            types,
+        )
+
+    svc = Eth1GenesisService(cache, spec)
+    # not enough deposits followed by an eth1 block yet
+    cache.add_block(Eth1Block(number=1, hash=b"\x11" * 32, timestamp=100,
+                              deposit_root=cache.tree.root(), deposit_count=2))
+    assert svc.try_genesis() is None
+
+    cache.add_block(Eth1Block(number=2, hash=b"\x22" * 32, timestamp=200,
+                              deposit_root=cache.tree.root(), deposit_count=4))
+    state = svc.try_genesis()
+    assert state is not None
+    assert is_valid_genesis_state(state, spec)
+    assert len(state.validators) == 4
+    assert all(v.activation_epoch == 0 for v in state.validators)
+    assert state.genesis_time == 200 + spec.genesis_delay
+    assert bytes(state.eth1_data.block_hash) == b"\x22" * 32
+    assert int(state.eth1_data.deposit_count) == 4
+    assert int(state.eth1_deposit_index) == 4
+    # genesis states are usable: the fork matches the spec's genesis fork
+    assert bytes(state.fork.current_version) == spec.fork_version(
+        spec.fork_name_at_epoch(0)
+    )
+    bls.set_backend("fake")

@@ -52,3 +52,97 @@ def test_mock_el_payload_flow():
     # forced invalid
     el.invalid_hashes.add(bytes.fromhex(payload["blockHash"][2:]))
     assert el.new_payload(payload)["status"] == PayloadStatus.invalid.value
+
+
+def test_keccak256_known_vectors():
+    from lighthouse_tpu.execution.block_hash import keccak256
+
+    assert keccak256(b"").hex() == (
+        "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470"
+    )
+    assert keccak256(b"abc").hex() == (
+        "4e03657aea45a94fc7d47ba826c8d667c0d1e6e33a64a036ec44f58fa12d6c45"
+    )
+    # padding boundary: exactly one pad byte free (len % 136 == 135) must
+    # merge the 0x01 and 0x80 bits into a single 0x81 byte
+    for n in (134, 135, 136, 137, 271, 272):
+        assert len(keccak256(b"a" * n)) == 32
+    assert len({keccak256(b"a" * n) for n in (134, 135, 136)}) == 3
+
+
+def test_rlp_encoding_known_vectors():
+    from lighthouse_tpu.execution.block_hash import rlp_encode
+
+    assert rlp_encode(b"") == b"\x80"
+    assert rlp_encode(b"\x00") == b"\x00"
+    assert rlp_encode(b"\x7f") == b"\x7f"
+    assert rlp_encode(b"\x80") == b"\x81\x80"
+    assert rlp_encode(b"dog") == b"\x83dog"
+    assert rlp_encode([b"cat", b"dog"]) == b"\xc8\x83cat\x83dog"
+    assert rlp_encode([]) == b"\xc0"
+    assert rlp_encode(0) == b"\x80"
+    assert rlp_encode(15) == b"\x0f"
+    assert rlp_encode(1024) == b"\x82\x04\x00"
+    # the canonical lorem-ipsum 56+ byte string case
+    s = b"Lorem ipsum dolor sit amet, consectetur adipisicing elit"
+    assert rlp_encode(s) == b"\xb8\x38" + s
+
+
+def test_ordered_trie_root_empty_and_known():
+    from lighthouse_tpu.execution.block_hash import (
+        EMPTY_TRIE_ROOT,
+        keccak256,
+        ordered_trie_root,
+        rlp_encode,
+    )
+
+    assert ordered_trie_root([]) == EMPTY_TRIE_ROOT
+    # single-entry trie: root = keccak(rlp([hex_prefix(path), value]))
+    v = b"\x01" * 40
+    root1 = ordered_trie_root([v])
+    assert len(root1) == 32 and root1 != EMPTY_TRIE_ROOT
+    # deterministic + order-sensitive
+    a, b = b"\x11" * 40, b"\x22" * 40
+    assert ordered_trie_root([a, b]) == ordered_trie_root([a, b])
+    assert ordered_trie_root([a, b]) != ordered_trie_root([b, a])
+
+
+def test_payload_block_hash_roundtrip():
+    """A payload whose block_hash was computed by our own header
+    construction verifies; a tampered field fails."""
+    from lighthouse_tpu.execution.block_hash import (
+        compute_block_hash,
+        verify_payload_block_hash,
+    )
+    from lighthouse_tpu.types.containers import spec_types
+    from lighthouse_tpu.types.spec import ForkName, MINIMAL_PRESET
+
+    types = spec_types(MINIMAL_PRESET, ForkName.deneb)
+    payload = types.ExecutionPayload.make(
+        parent_hash=b"\x01" * 32,
+        fee_recipient=b"\x02" * 20,
+        state_root=b"\x03" * 32,
+        receipts_root=b"\x04" * 32,
+        logs_bloom=b"\x00" * 256,
+        prev_randao=b"\x05" * 32,
+        block_number=7,
+        gas_limit=30_000_000,
+        gas_used=21_000,
+        timestamp=12_345,
+        extra_data=b"geth",
+        base_fee_per_gas=7,
+        block_hash=b"\x00" * 32,
+        transactions=[b"\xf8\x6b" + b"\x01" * 40],
+        withdrawals=[
+            types.Withdrawal.make(index=0, validator_index=3, address=b"\x09" * 20, amount=10)
+        ],
+        blob_gas_used=0,
+        excess_blob_gas=0,
+    )
+    root = b"\x0b" * 32
+    good = payload.copy_with(block_hash=compute_block_hash(payload, root))
+    assert verify_payload_block_hash(good, root)
+    assert not verify_payload_block_hash(
+        good.copy_with(gas_used=22_000), root
+    )
+    assert not verify_payload_block_hash(good, b"\x0c" * 32)

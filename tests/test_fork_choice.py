@@ -115,3 +115,58 @@ def test_prune():
     assert root(7) not in fc.index_by_root
     assert root(2) in fc.index_by_root and root(5) in fc.index_by_root
     assert fc.find_head(root(2)) == root(5)
+
+
+def test_get_proposer_head_reorgs_weak_late_head():
+    """A late, voteless head whose parent is strong gets re-orged by the
+    next proposer; every failed guard falls back to the head
+    (fork_choice.rs:516 get_proposer_head)."""
+    from lighthouse_tpu.fork_choice.fork_choice import ForkChoice, ForkChoiceStore
+    from lighthouse_tpu.types.spec import minimal_spec
+
+    spec = minimal_spec()
+    per_slot = spec.preset.SLOTS_PER_EPOCH
+
+    def build(timely: bool, votes_for_parent: int = 16):
+        fc = object.__new__(ForkChoice)
+        fc.spec = spec
+        proto = ProtoArrayForkChoice(
+            root(0), 0, JC, FC, slots_per_epoch=per_slot
+        )
+        proto.on_block(1, root(1), root(0), JC, FC)            # strong parent
+        proto.on_block(2, root(2), root(1), JC, FC, timely=timely)  # head
+        balances = [32] * votes_for_parent
+        for vi in range(votes_for_parent):
+            proto.process_attestation(vi, root(1), 1)
+        proto.find_head(root(0), balances)      # populate subtree weights
+        fc.proto = proto
+        fc.store = ForkChoiceStore(
+            current_slot=3,
+            justified_checkpoint=JC,
+            finalized_checkpoint=FC,
+            unrealized_justified_checkpoint=JC,
+            unrealized_finalized_checkpoint=FC,
+            justified_balances=balances,
+        )
+        return fc
+
+    # late weak head, strong parent -> build on the parent
+    fc = build(timely=False)
+    assert fc.get_proposer_head(root(2), 3) == root(1)
+
+    # timely head -> never re-orged
+    fc = build(timely=True)
+    assert fc.get_proposer_head(root(2), 3) == root(2)
+
+    # not a single-slot re-org (proposal two slots later) -> head
+    fc = build(timely=False)
+    assert fc.get_proposer_head(root(2), 4) == root(2)
+
+    # voteless parent -> head (re-org would likely fail)
+    fc = build(timely=False, votes_for_parent=0)
+    assert fc.get_proposer_head(root(2), 3) == root(2)
+
+    # stale finalization -> head
+    fc = build(timely=False)
+    fc.store.current_slot = per_slot * 10
+    assert fc.get_proposer_head(root(2), per_slot * 10) == root(2)

@@ -50,3 +50,47 @@ def test_same_name_returns_same_metric():
     a = reg.counter("dup_total")
     b = reg.counter("dup_total")
     assert a is b
+
+
+def test_structured_logging():
+    """Structured logger: level filtering, component scoping, kv fields,
+    JSON mode, and the RECENT ring feeding the ops API."""
+    import io
+    import json as _json
+
+    from lighthouse_tpu.utils import logging as lg
+
+    buf = io.StringIO()
+    lg.set_sink(buf)
+    old_level = lg._global_level
+    try:
+        lg.set_level("info")
+        log = lg.get_logger("test_component")
+        log.debug("dropped", x=1)                 # below level
+        log.info("block imported", slot=7, root="0xab")
+        log.warn("late block", delay_ms=4300)
+        out = buf.getvalue()
+        assert "dropped" not in out
+        assert "block imported" in out and "slot: 7" in out
+        assert "test_component" in out
+        assert "WARN" in out and "delay_ms: 4300" in out
+
+        # ring buffer captured the emitted records
+        recent = [r for r in lg.RECENT if r[2] == "test_component"]
+        assert [r[3] for r in recent[-2:]] == ["block imported", "late block"]
+
+        # JSON mode round-trips
+        buf2 = io.StringIO()
+        lg.set_sink(buf2)
+        lg._json_mode = True
+        log.error("engine offline", attempts=3)
+        rec = _json.loads(buf2.getvalue().strip())
+        assert rec["level"] == "ERROR" and rec["attempts"] == 3
+        assert rec["component"] == "test_component"
+
+        # child scoping
+        assert log.child("sub").component == "test_component/sub"
+    finally:
+        lg._json_mode = False
+        lg.set_sink(None)
+        lg._global_level = old_level

@@ -91,3 +91,45 @@ def test_prune_drops_stale():
     st.slot = 40 * spec.preset.SLOTS_PER_EPOCH
     pool.prune(st)
     assert not pool.attestations
+
+
+def test_persistence_roundtrip():
+    """The pool survives a restart: persist to the chain store, load into a
+    fresh pool, contents identical (operation_pool/src/persistence.rs)."""
+    from lighthouse_tpu.store.hot_cold import HotColdDB
+
+    spec = minimal_spec()
+    types = spec_types(MINIMAL_PRESET, ForkName.deneb)
+    pool = OperationPool(spec)
+    att = _mk_att(types, [True, True, False, False])
+    pool.insert_attestation(att, [2, 3], types)
+    exit_ = types.SignedVoluntaryExit.make(
+        message=types.VoluntaryExit.make(epoch=1, validator_index=7),
+        signature=b"\x0a" * 96,
+    )
+    pool.insert_voluntary_exit(exit_)
+    change = types.SignedBLSToExecutionChange.make(
+        message=types.BLSToExecutionChange.make(
+            validator_index=9, from_bls_pubkey=b"\x0b" * 48,
+            to_execution_address=b"\x0c" * 20,
+        ),
+        signature=b"\x0d" * 96,
+    )
+    pool.insert_bls_change(change)
+
+    store = HotColdDB(spec)
+    pool.persist(store, types)
+    loaded = OperationPool.load(store, spec, types)
+
+    assert set(loaded.attestations) == set(pool.attestations)
+    got = next(iter(loaded.attestations.values()))[0]
+    assert got.attesting_indices == frozenset({2, 3})
+    assert got.signature == next(iter(pool.attestations.values()))[0].signature
+    assert 7 in loaded.voluntary_exits
+    assert loaded.voluntary_exits[7] == exit_
+    assert 9 in loaded.bls_changes
+    assert loaded.bls_changes[9] == change
+
+    # empty store -> empty pool, no error
+    empty = OperationPool.load(HotColdDB(spec), spec, types)
+    assert not empty.attestations and not empty.voluntary_exits

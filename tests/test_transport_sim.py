@@ -149,22 +149,55 @@ def test_transport_rpc_roundtrip():
 
 
 @pytest.mark.slow
-def test_four_node_sim_justifies_over_sockets():
+def test_four_node_sim_finalizes_over_sockets():
     """4 nodes, 64 validators split 16/16/16/16, real TCP gossip: chain
-    converges every slot and reaches justification within 3 epochs."""
+    converges every slot, justifies, and FINALIZES (the reference sim's
+    checks.rs asserts finalization, not just justification)."""
     from lighthouse_tpu.testing.simulator import Simulator
 
     bls.set_backend("fake")
     spec = minimal_spec()
     sim = Simulator(spec, n_nodes=4, n_validators=64, subnets=4)
     try:
-        sim.run_epochs(3)
+        sim.run_epochs(4)
         assert sim.heads_agree()
         fc = sim.nodes[0].chain.fork_choice.store
         assert fc.justified_checkpoint[0] >= 2, (
             f"no justification: justified={fc.justified_checkpoint}"
         )
+        assert sim.finalized_epoch() >= 1, (
+            f"no finalization: finalized={fc.finalized_checkpoint}"
+        )
         # all nodes share the same finalized/justified view
+        views = {
+            (n.chain.fork_choice.store.justified_checkpoint,
+             n.chain.fork_choice.store.finalized_checkpoint)
+            for n in sim.nodes
+        }
+        assert len(views) == 1
+    finally:
+        sim.close()
+
+
+@pytest.mark.slow
+def test_four_node_sim_crosses_fork_boundary():
+    """The socket sim runs THROUGH a fork transition (deneb -> electra at
+    epoch 2) and keeps converging + finalizing on the other side (the
+    reference sim's fork-transition checks)."""
+    from lighthouse_tpu.testing.simulator import Simulator
+    from lighthouse_tpu.types.spec import ForkName
+
+    bls.set_backend("fake")
+    spec = minimal_spec(electra_fork_epoch=2)
+    assert spec.fork_name_at_epoch(0) == ForkName.deneb
+    sim = Simulator(spec, n_nodes=4, n_validators=64, subnets=4)
+    try:
+        sim.run_epochs(4)
+        assert sim.heads_agree()
+        st = sim.nodes[0].chain.head_state()
+        assert bytes(st.fork.current_version) == spec.electra_fork_version
+        assert hasattr(st, "pending_deposits")       # electra state shape
+        assert sim.finalized_epoch() >= 1
         views = {
             (n.chain.fork_choice.store.justified_checkpoint,
              n.chain.fork_choice.store.finalized_checkpoint)
