@@ -867,16 +867,32 @@ class BeaconChain:
 
     # ------------------------------------------------------------ attestations
 
-    def _committee_for(self, data):
+    @staticmethod
+    def _attestation_committee_index(att) -> int:
+        """The committee an attestation covers. Electra (EIP-7549) moved
+        the index out of AttestationData (data.index MUST be 0) into the
+        committee_bits field; gossip attestations/aggregates set exactly
+        one bit."""
+        cb = getattr(att, "committee_bits", None)
+        if cb is None:
+            return int(att.data.index)
+        set_bits = [i for i, b in enumerate(cb) if b]
+        if len(set_bits) != 1:
+            raise AttestationError("expected exactly one committee bit")
+        if int(att.data.index) != 0:
+            raise AttestationError("electra attestation data.index must be 0")
+        return set_bits[0]
+
+    def _committee_for(self, data, committee_index: int | None = None):
         spec = self.spec
         epoch = data.target.epoch
-        head_state = self.head_state()
         cache = self.shuffling_cache.get_or_build(
             self._attestation_state(data), spec, epoch, bytes(data.target.root)
         )
-        if data.index >= cache.committees_per_slot:
+        idx = int(data.index) if committee_index is None else committee_index
+        if idx >= cache.committees_per_slot:
             raise AttestationError("bad committee index")
-        return cache.committee(data.slot, data.index)
+        return cache.committee(data.slot, idx)
 
     def _attestation_state(self, data):
         """A state usable to compute the committee for `data`."""
@@ -901,7 +917,9 @@ class BeaconChain:
             ):
                 continue
             try:
-                committee = self._committee_for(data)
+                committee = self._committee_for(
+                    data, self._attestation_committee_index(att)
+                )
             except AttestationError:
                 continue
             if len(att.aggregation_bits) != len(committee):
@@ -1005,7 +1023,9 @@ class BeaconChain:
             if key in self.observed_aggregators:
                 continue
             try:
-                committee = self._committee_for(data)
+                committee = self._committee_for(
+                    data, self._attestation_committee_index(att)
+                )
             except AttestationError:
                 continue
             if len(att.aggregation_bits) != len(committee):

@@ -34,40 +34,71 @@ def _agg_bytes(points) -> bytes:
 
 
 class NaiveAttestationPool:
-    """data_root -> aggregated bits + signature per slot."""
+    """(data_root, committee) -> aggregated bits + signature per slot.
+
+    Electra (EIP-7549) note: all committees of a slot share ONE
+    AttestationData (index=0), so the data root alone cannot bucket — the
+    committee index (from committee_bits) is part of the key, and served
+    aggregates carry the committee's bit set."""
 
     def __init__(self, spec):
         self.spec = spec
-        # slot -> data_root -> (data, bits list, [sig objects])
+        # slot -> (data_root, committee|None) -> (data, cb, bits, [sigs])
         self._by_slot: dict[int, dict] = defaultdict(dict)
+
+    @staticmethod
+    def _committee_of(att):
+        cb = getattr(att, "committee_bits", None)
+        if cb is None:
+            return None, None
+        set_bits = [i for i, b in enumerate(cb) if b]
+        if len(set_bits) != 1:
+            raise ValueError("expected exactly one committee bit")
+        return set_bits[0], tuple(cb)
 
     def insert(self, att, types) -> bool:
         """Insert a verified single attestation; returns True if it added
         new bits."""
         slot = int(att.data.slot)
-        key = types.AttestationData.hash_tree_root(att.data)
+        cidx, cb = self._committee_of(att)
+        key = (types.AttestationData.hash_tree_root(att.data), cidx)
         bucket = self._by_slot[slot].get(key)
         bits = list(att.aggregation_bits)
         sig = _sig_point(att.signature)
         if bucket is None:
-            self._by_slot[slot][key] = (att.data, bits, [sig])
+            self._by_slot[slot][key] = (att.data, cb, bits, [sig])
             return True
-        _data, cur, sigs = bucket
+        _data, _cb, cur, sigs = bucket
         new = [b and not c for b, c in zip(bits, cur)]
         if not any(new):
             return False
         merged = [b or c for b, c in zip(bits, cur)]
-        self._by_slot[slot][key] = (_data, merged, sigs + [sig])
+        self._by_slot[slot][key] = (_data, _cb, merged, sigs + [sig])
         return True
 
-    def get_aggregate(self, slot: int, data_root: bytes, types):
-        bucket = self._by_slot.get(slot, {}).get(data_root)
+    def get_aggregate(self, slot: int, data_root: bytes, types,
+                      committee_index: int | None = None):
+        """Best aggregate for (slot, data root[, committee]). Pre-electra
+        callers omit committee_index; electra aggregation duties supply it
+        (the v2 aggregate_attestation API carries it)."""
+        slot_map = self._by_slot.get(slot, {})
+        bucket = slot_map.get((data_root, committee_index))
+        if bucket is None and committee_index is None:
+            # electra entries under an unspecified committee: serve the
+            # first matching data root
+            for (root, _cidx), b in slot_map.items():
+                if root == data_root:
+                    bucket = b
+                    break
         if bucket is None:
             return None
-        data, bits, sigs = bucket
-        return types.Attestation.make(
+        data, cb, bits, sigs = bucket
+        kwargs = dict(
             aggregation_bits=bits, data=data, signature=_agg_bytes(sigs)
         )
+        if cb is not None:
+            kwargs["committee_bits"] = list(cb)
+        return types.Attestation.make(**kwargs)
 
     def prune(self, current_slot: int) -> None:
         for s in list(self._by_slot):

@@ -24,6 +24,9 @@ class PooledAttestation:
     aggregation_bits: tuple[bool, ...]
     signature: bytes
     attesting_indices: frozenset[int]
+    # electra (EIP-7549): which committees the aggregate covers; None for
+    # pre-electra attestations (committee identified by data.index instead)
+    committee_bits: tuple[bool, ...] | None = None
 
 
 def max_cover(items: list[tuple[frozenset, float, object]], limit: int) -> list[object]:
@@ -66,12 +69,14 @@ class OperationPool:
 
     def insert_attestation(self, att, attesting_indices, types) -> None:
         key = types.AttestationData.hash_tree_root(att.data)
+        cb = getattr(att, "committee_bits", None)
         entry = PooledAttestation(
             data_key=key,
             data=att.data,
             aggregation_bits=tuple(att.aggregation_bits),
             signature=bytes(att.signature),
             attesting_indices=frozenset(attesting_indices),
+            committee_bits=tuple(cb) if cb is not None else None,
         )
         bucket = self.attestations[key]
         # drop if strictly covered by an existing aggregate
@@ -141,16 +146,36 @@ class OperationPool:
                 if not fresh:
                     continue
                 items.append((fresh, 1.0, entry))
-        chosen = max_cover(items, spec.preset.MAX_ATTESTATIONS)
+        # the block's fork decides the container shape: electra blocks can
+        # only carry electra-shaped (committee_bits) attestations and vice
+        # versa — at the fork boundary the mismatched pool tail is dropped,
+        # exactly like the reference (and the test harness) does
+        electra_block = any(
+            f.name == "committee_bits" for f in types.Attestation.fields
+        )
+        limit = (
+            spec.preset.MAX_ATTESTATIONS_ELECTRA
+            if electra_block
+            else spec.preset.MAX_ATTESTATIONS
+        )
+        chosen = max_cover(
+            [
+                it
+                for it in items
+                if (it[2].committee_bits is not None) == electra_block
+            ],
+            limit,
+        )
         out = []
         for entry in chosen:
-            out.append(
-                types.Attestation.make(
-                    aggregation_bits=list(entry.aggregation_bits),
-                    data=entry.data,
-                    signature=entry.signature,
-                )
+            kwargs = dict(
+                aggregation_bits=list(entry.aggregation_bits),
+                data=entry.data,
+                signature=entry.signature,
             )
+            if electra_block:
+                kwargs["committee_bits"] = list(entry.committee_bits)
+            out.append(types.Attestation.make(**kwargs))
         return out
 
     def get_slashings_and_exits(self, state, types):
@@ -204,6 +229,7 @@ class OperationPool:
                     list(e.aggregation_bits),
                     e.signature,
                     sorted(e.attesting_indices),
+                    list(e.committee_bits) if e.committee_bits is not None else None,
                 )
                 for bucket in self.attestations.values()
                 for e in bucket
@@ -239,12 +265,18 @@ class OperationPool:
         if raw is None:
             return pool
         payload = pickle.loads(raw)
-        for data_ssz, bits, sig, indices in payload["attestations"]:
+        for entry in payload["attestations"]:
+            # tolerate the pre-committee_bits 4-tuple format (a store
+            # persisted by an older build must not abort startup)
+            data_ssz, bits, sig, indices = entry[:4]
+            cb = entry[4] if len(entry) > 4 else None
             att = _pytypes.SimpleNamespace(
                 data=types.AttestationData.deserialize(data_ssz),
                 aggregation_bits=bits,
                 signature=sig,
             )
+            if cb is not None:
+                att.committee_bits = cb
             pool.insert_attestation(att, indices, types)
         for s in payload["proposer_slashings"]:
             pool.insert_proposer_slashing(types.ProposerSlashing.deserialize(s))

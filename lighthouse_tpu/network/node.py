@@ -303,7 +303,7 @@ class NetworkNode:
         self._retry_pending_sidecars(root)
 
     def _mk_attestation_handler(self):
-        def handler(msg) -> bool:
+        def handler(msg):
             spec = self.chain.spec
             types = types_for_slot(spec, self.chain.current_slot)
             try:
@@ -319,7 +319,10 @@ class NetworkNode:
                     self.chain.apply_attestation_to_fork_choice(a, indices)
                     if self.op_pool is not None:
                         self.op_pool.insert_attestation(a, indices, types)
-                return bool(results)
+                # empty results = every attester already observed (a relayed
+                # duplicate): gossip IGNORE, never a penalty — penalizing
+                # honest relays −20 per duplicate decays the whole mesh
+                return True if results else None
 
         return handler
 
@@ -339,7 +342,10 @@ class NetworkNode:
                 self.chain.apply_attestation_to_fork_choice(att, indices)
                 if self.op_pool is not None:
                     self.op_pool.insert_attestation(att, indices, types)
-            return bool(results)
+            # empty results = duplicate aggregator (already observed):
+            # IGNORE, never a penalty (same mesh-decay hazard as the
+            # unaggregated handler)
+            return True if results else None
 
     def _on_blob(self, msg):
         spec = self.chain.spec

@@ -224,12 +224,22 @@ class TcpHost:
                 sock, _addr = self.server.accept()
             except OSError:
                 return
-            self._spawn(sock)
+            try:
+                self._spawn(sock)
+            except OSError:
+                # a peer that connected and instantly reset (scanner,
+                # health probe) must not kill the accept thread
+                continue
 
     def _spawn(self, sock: socket.socket) -> Connection:
         conn = Connection(sock, self.local_id, self.node)
-        threading.Thread(target=conn.run_reader, daemon=True).start()
+        # HELLO must hit the wire BEFORE the reader starts: processing the
+        # remote HELLO triggers registration, whose subscription announce
+        # would otherwise overtake our own HELLO — the remote then drops
+        # the announce frame (peer unidentified) and never learns our
+        # topics, silently partitioning gossip.
         conn.send_hello()
+        threading.Thread(target=conn.run_reader, daemon=True).start()
         return conn
 
     def dial(self, host: str, port: int, timeout: float = 5.0) -> Connection:

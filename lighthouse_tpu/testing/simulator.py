@@ -68,7 +68,20 @@ class Simulator:
                 a.net.connect(b.net)
         self._wait(lambda: all(
             len(n.net.host.connections) == n_nodes - 1 for n in self.nodes
-        ), 5.0, "node connections")
+        ), 20.0, "node connections")
+        # Subscription announcements ride the connections asynchronously;
+        # publishing before every peer KNOWS every other peer subscribes
+        # races the flood-publish fallback (a message can miss a node with
+        # no mesh to relay it yet). Wait until the block topic is mutually
+        # known — the real node tolerates this via IHAVE recovery windows,
+        # the lock-step sim must not start with a partitioned view.
+        block_topic = gs.topic_name(self.nodes[0].net.fork_digest, "beacon_block")
+        self._wait(lambda: all(
+            block_topic in a.net.gossipsub.peer_topics.get(b.net.node_id, set())
+            for a in self.nodes
+            for b in self.nodes
+            if a is not b
+        ), 20.0, "subscription propagation")
 
     # ------------------------------------------------------------ helpers
 
@@ -113,7 +126,7 @@ class Simulator:
         owner.net.publish_block(signed)
         self._wait(
             lambda: all(n.chain.head_root == root for n in self.nodes),
-            30.0,
+            60.0,
             f"block propagation at slot {slot}",
         )
 
@@ -176,7 +189,7 @@ class Simulator:
 
         self._wait(
             lambda: all(pooled(n) >= want for n in self.nodes),
-            30.0,
+            60.0,
             f"attestation propagation at slot {slot}",
         )
         return root

@@ -33,3 +33,7 @@ setup_compilation_cache()
 # re-run until green; each pass extends the cache, normal runs only read.
 if os.environ.get("LIGHTHOUSE_TPU_CACHE_WRITE") != "1":
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 10**9)
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long multi-node simulations")
