@@ -141,15 +141,42 @@ def _arm_watchdog():
     signal.alarm(WATCHDOG_SECS)
 
 
+def _tunnel_down(reason: str):
+    """Emit a well-formed zero measurement instead of dying rc!=0: the
+    remote-TPU tunnel being unavailable is an environment condition, not a
+    benchmark result, and the driver should record it as such."""
+    log(f"TPU unavailable: {reason}")
+    print(
+        json.dumps(
+            {
+                "metric": "BLS signature-sets verified/sec "
+                          "(TPU tunnel UNAVAILABLE at bench time)",
+                "value": 0,
+                "unit": "sets/s",
+                "vs_baseline": 0,
+            }
+        ),
+        flush=True,
+    )
+    sys.exit(0)
+
+
 def main():
     from lighthouse_tpu.utils.jaxcfg import setup_compilation_cache
 
     _arm_watchdog()
     setup_compilation_cache()
-    import jax
     import random
 
-    log(f"devices: {jax.devices()}")
+    try:
+        import jax
+
+        devices = jax.devices()
+    except RuntimeError as e:
+        _tunnel_down(str(e))
+        return
+
+    log(f"devices: {devices}")
 
     from lighthouse_tpu.crypto.bls import api as bls_api
 

@@ -39,8 +39,20 @@ while true; do
     if python scripts/warm_kernels.py >> "$LOG" 2>&1; then
       log "warm complete — running bench.py"
       if python bench.py > /tmp/bench_result.json 2>> "$LOG"; then
-        log "bench complete: $(cat /tmp/bench_result.json)"
-        exit 0
+        # bench exits 0 with a ZERO measurement when the tunnel drops
+        # mid-session — that is an outage record, not a result: keep
+        # retrying until a real (value > 0) measurement lands
+        if python - <<'PY'
+import json, sys
+rec = json.load(open("/tmp/bench_result.json"))
+sys.exit(0 if rec.get("value", 0) > 0 else 1)
+PY
+        then
+          log "bench complete: $(cat /tmp/bench_result.json)"
+          exit 0
+        else
+          log "bench returned a zero measurement (tunnel flap) — retrying"
+        fi
       else
         log "bench FAILED rc=$? — retrying after cooldown"
       fi
