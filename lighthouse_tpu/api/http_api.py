@@ -813,6 +813,214 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
                 )
         self._json({"data": out})
 
+    # ---------------------------------------------- pool: slashings/changes
+
+    def post_pool_bls_changes(self):
+        body = self._read_body() or []
+        types = types_for_slot(self.chain.spec, self.chain.current_slot)
+        if isinstance(body, dict):
+            body = [body]
+        for c in body:
+            change = types.SignedBLSToExecutionChange.make(
+                message=types.BLSToExecutionChange.make(
+                    validator_index=int(c["message"]["validator_index"]),
+                    from_bls_pubkey=bytes.fromhex(c["message"]["from_bls_pubkey"][2:]),
+                    to_execution_address=bytes.fromhex(
+                        c["message"]["to_execution_address"][2:]
+                    ),
+                ),
+                signature=bytes.fromhex(c["signature"][2:]),
+            )
+            if self.op_pool is not None:
+                self.op_pool.insert_bls_change(change)
+        self._json({})
+
+    def get_pool_bls_changes(self):
+        out = []
+        if self.op_pool is not None:
+            for c in self.op_pool.bls_changes.values():
+                out.append(
+                    {
+                        "message": {
+                            "validator_index": _u(c.message.validator_index),
+                            "from_bls_pubkey": _hex(c.message.from_bls_pubkey),
+                            "to_execution_address": _hex(
+                                c.message.to_execution_address
+                            ),
+                        },
+                        "signature": _hex(c.signature),
+                    }
+                )
+        self._json({"data": out})
+
+    def get_pool_attester_slashings(self):
+        def indexed(a):
+            return {
+                "attesting_indices": [_u(i) for i in a.attesting_indices],
+                "data": {
+                    "slot": _u(a.data.slot),
+                    "index": _u(a.data.index),
+                    "beacon_block_root": _hex(a.data.beacon_block_root),
+                    "source": _checkpoint(a.data.source),
+                    "target": _checkpoint(a.data.target),
+                },
+                "signature": _hex(a.signature),
+            }
+
+        out = []
+        if self.op_pool is not None:
+            for sl in self.op_pool.attester_slashings:
+                out.append(
+                    {
+                        "attestation_1": indexed(sl.attestation_1),
+                        "attestation_2": indexed(sl.attestation_2),
+                    }
+                )
+        self._json({"data": out})
+
+    def post_pool_attester_slashings(self):
+        body = self._read_body()
+        types = types_for_slot(self.chain.spec, self.chain.current_slot)
+        ssz_hex = body.get("ssz") if isinstance(body, dict) else None
+        if not ssz_hex:
+            raise ApiError(400, "expected {'ssz': '0x...'} body")
+        slashing = types.AttesterSlashing.deserialize(bytes.fromhex(ssz_hex[2:]))
+        try:
+            self.chain.verify_slashing_for_pool(slashing, "attester")
+        except Exception as e:
+            raise ApiError(400, f"invalid attester slashing: {e}")
+        if self.op_pool is not None:
+            self.op_pool.insert_attester_slashing(slashing)
+        if self.event_bus is not None:
+            self.event_bus.publish("attester_slashing", {})
+        self._json({})
+
+    def get_pool_proposer_slashings(self):
+        def header(sh):
+            m = sh.message
+            return {
+                "message": {
+                    "slot": _u(m.slot),
+                    "proposer_index": _u(m.proposer_index),
+                    "parent_root": _hex(m.parent_root),
+                    "state_root": _hex(m.state_root),
+                    "body_root": _hex(m.body_root),
+                },
+                "signature": _hex(sh.signature),
+            }
+
+        out = []
+        if self.op_pool is not None:
+            for sl in self.op_pool.proposer_slashings.values():
+                out.append(
+                    {
+                        "signed_header_1": header(sl.signed_header_1),
+                        "signed_header_2": header(sl.signed_header_2),
+                    }
+                )
+        self._json({"data": out})
+
+    def post_pool_proposer_slashings(self):
+        body = self._read_body()
+        types = types_for_slot(self.chain.spec, self.chain.current_slot)
+        ssz_hex = body.get("ssz") if isinstance(body, dict) else None
+        if not ssz_hex:
+            raise ApiError(400, "expected {'ssz': '0x...'} body")
+        slashing = types.ProposerSlashing.deserialize(bytes.fromhex(ssz_hex[2:]))
+        try:
+            self.chain.verify_slashing_for_pool(slashing, "proposer")
+        except Exception as e:
+            raise ApiError(400, f"invalid proposer slashing: {e}")
+        if self.op_pool is not None:
+            self.op_pool.insert_proposer_slashing(slashing)
+        if self.event_bus is not None:
+            self.event_bus.publish("proposer_slashing", {})
+        self._json({})
+
+    def post_pool_sync_committees(self):
+        """POST /eth/v1/beacon/pool/sync_committees: verified in one batch
+        and fed to the naive contribution pool (the VC's sync-message
+        publish path)."""
+        body = self._read_body() or []
+        types = types_for_slot(self.chain.spec, self.chain.current_slot)
+        msgs = [
+            types.SyncCommitteeMessage.make(
+                slot=int(m["slot"]),
+                beacon_block_root=bytes.fromhex(m["beacon_block_root"][2:]),
+                validator_index=int(m["validator_index"]),
+                signature=bytes.fromhex(m["signature"][2:]),
+            )
+            for m in body
+        ]
+        accepted = self.chain.process_sync_committee_messages(msgs)
+        if accepted != len(msgs):
+            raise ApiError(400, f"{len(msgs) - accepted} messages failed")
+        self._json({})
+
+    # ---------------------------------------------- states: balances/randao
+
+    def get_state_validator_balances(self, state_id):
+        st = self._state_by_id(state_id)
+        q = self._query()
+        wanted = None
+        if "id" in q:
+            wanted = set()
+            by_pubkey = None
+            for ident in q["id"].split(","):
+                if ident.startswith("0x"):
+                    if by_pubkey is None:
+                        by_pubkey = {
+                            bytes(v.pubkey): i for i, v in enumerate(st.validators)
+                        }
+                    idx = by_pubkey.get(bytes.fromhex(ident[2:]))
+                    if idx is not None:
+                        wanted.add(idx)
+                elif ident.isdigit():
+                    wanted.add(int(ident))
+                else:
+                    raise ApiError(400, f"bad validator id {ident!r}")
+        self._json(
+            {
+                "data": [
+                    {"index": _u(i), "balance": _u(b)}
+                    for i, b in enumerate(st.balances)
+                    if wanted is None or i in wanted
+                ]
+            }
+        )
+
+    def get_state_randao(self, state_id):
+        from ..state_transition import accessors as acc
+
+        st = self._state_by_id(state_id)
+        spec = self.chain.spec
+        current = acc.get_current_epoch(st, spec)
+        epoch = current
+        q = self._query()
+        if "epoch" in q:
+            epoch = int(q["epoch"])
+        # get_randao_mix indexes modulo EPOCHS_PER_HISTORICAL_VECTOR: an
+        # out-of-range epoch would silently alias an unrelated mix
+        lo = max(0, current - spec.preset.EPOCHS_PER_HISTORICAL_VECTOR + 1)
+        if not (lo <= epoch <= current):
+            raise ApiError(400, f"epoch {epoch} outside stored randao range")
+        mix = h.get_randao_mix(st, spec, epoch)
+        self._json({"data": {"randao": _hex(mix)}})
+
+    def get_node_peer_count(self):
+        net = getattr(self.chain, "_network_node", None)
+        connected = len(net.peer_manager.connected_peers()) if net else 0
+        self._json(
+            {
+                "data": {
+                    "disconnected": "0",
+                    "connecting": "0",
+                    "connected": str(connected),
+                    "disconnecting": "0",
+                }
+            }
+        )
+
 
 def _bits_from_hex(hex_str: str):
     from ..ssz.core import Bitlist
@@ -855,6 +1063,16 @@ _ROUTES = [
     (r"/eth/v1/validator/beacon_committee_subscriptions", "POST", BeaconApiHandler.post_subscriptions),
     (r"/eth/v1/validator/sync_committee_subscriptions", "POST", BeaconApiHandler.post_subscriptions),
     (r"/eth/v2/debug/beacon/states/([^/]+)", "GET", BeaconApiHandler.get_debug_state),
+    (r"/eth/v1/beacon/pool/bls_to_execution_changes", "GET", BeaconApiHandler.get_pool_bls_changes),
+    (r"/eth/v1/beacon/pool/bls_to_execution_changes", "POST", BeaconApiHandler.post_pool_bls_changes),
+    (r"/eth/v1/beacon/pool/attester_slashings", "GET", BeaconApiHandler.get_pool_attester_slashings),
+    (r"/eth/v1/beacon/pool/attester_slashings", "POST", BeaconApiHandler.post_pool_attester_slashings),
+    (r"/eth/v1/beacon/pool/proposer_slashings", "GET", BeaconApiHandler.get_pool_proposer_slashings),
+    (r"/eth/v1/beacon/pool/proposer_slashings", "POST", BeaconApiHandler.post_pool_proposer_slashings),
+    (r"/eth/v1/beacon/pool/sync_committees", "POST", BeaconApiHandler.post_pool_sync_committees),
+    (r"/eth/v1/beacon/states/([^/]+)/validator_balances", "GET", BeaconApiHandler.get_state_validator_balances),
+    (r"/eth/v1/beacon/states/([^/]+)/randao", "GET", BeaconApiHandler.get_state_randao),
+    (r"/eth/v1/node/peer_count", "GET", BeaconApiHandler.get_node_peer_count),
     (r"/lighthouse_tpu/database/info", "GET", BeaconApiHandler.get_lh_database_info),
     (r"/lighthouse_tpu/health", "GET", BeaconApiHandler.get_lh_health),
     (r"/lighthouse_tpu/peers/scores", "GET", BeaconApiHandler.get_lh_peers_scores),

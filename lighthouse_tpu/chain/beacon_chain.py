@@ -435,6 +435,35 @@ class BeaconChain:
         self._notify_el_of_head(head)
         return head
 
+    def verify_slashing_for_pool(self, slashing, kind: str) -> None:
+        """Validate an externally-submitted slashing BEFORE it can reach the
+        op pool: run the real state-transition processing (slashability
+        checks + signature sets) against a clone of the head state. A
+        garbage or spent slashing packed into a produced block would make
+        the node's own blocks invalid (observed_operations.rs + the gossip
+        verification the HTTP publish path must mirror). Raises
+        BlockProcessingError/AttestationError on anything unincludable."""
+        from ..state_transition import block as blk
+
+        spec = self.spec
+        state = clone_state(self.head_state(), spec)
+        types = types_for_slot(spec, state.slot)
+        fork = spec.fork_name_at_slot(state.slot)
+        get_pubkey = self.pubkey_cache.pubkey_getter()
+        batch = SignatureBatch()
+        if kind == "attester":
+            blk.process_attester_slashing(
+                state, spec, types, slashing, fork, batch.add, get_pubkey
+            )
+        elif kind == "proposer":
+            blk.process_proposer_slashing(
+                state, spec, types, slashing, fork, batch.add, get_pubkey
+            )
+        else:
+            raise ValueError(kind)
+        if not batch.verify():
+            raise BlockProcessingError("slashing signature invalid")
+
     def process_invalid_execution_payload(self, block_root: bytes) -> bytes:
         """An EL verdict (late newPayload / fcU error) invalidated an
         already-imported optimistic block: poison it and its descendants in

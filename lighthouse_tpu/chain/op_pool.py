@@ -93,6 +93,9 @@ class OperationPool:
         self.proposer_slashings[slashing.signed_header_1.message.proposer_index] = slashing
 
     def insert_attester_slashing(self, slashing) -> None:
+        # dedup by content: a retried POST / regossip must not stack copies
+        if any(s == slashing for s in self.attester_slashings):
+            return
         self.attester_slashings.append(slashing)
 
     def insert_voluntary_exit(self, signed_exit) -> None:
@@ -188,7 +191,28 @@ class OperationPool:
                 state.validators[s.signed_header_1.message.proposer_index], epoch
             )
         ][: spec.preset.MAX_PROPOSER_SLASHINGS]
-        attester_slashings = self.attester_slashings[: spec.preset.MAX_ATTESTER_SLASHINGS]
+        def attester_slashing_includable(s) -> bool:
+            # process_attester_slashing requires >=1 still-slashable common
+            # index; packing a spent slashing invalidates the whole block
+            common = set(s.attestation_1.attesting_indices) & set(
+                s.attestation_2.attesting_indices
+            )
+            return any(
+                i < len(state.validators)
+                and h.is_slashable_validator(state.validators[i], epoch)
+                for i in common
+            )
+
+        limit = getattr(
+            spec.preset, "MAX_ATTESTER_SLASHINGS_ELECTRA", None
+        ) if any(
+            f.name == "committee_bits" for f in types.Attestation.fields
+        ) else spec.preset.MAX_ATTESTER_SLASHINGS
+        if limit is None:
+            limit = spec.preset.MAX_ATTESTER_SLASHINGS
+        attester_slashings = [
+            s for s in self.attester_slashings if attester_slashing_includable(s)
+        ][:limit]
         def exit_includable(e) -> bool:
             # mirror process_voluntary_exit's non-signature checks: packing
             # an op the state transition would reject invalidates the block
@@ -316,3 +340,14 @@ class OperationPool:
             for i, s in self.proposer_slashings.items()
             if not state.validators[i].slashed
         }
+        epoch = acc.get_current_epoch(state, self.spec)
+        self.attester_slashings = [
+            s
+            for s in self.attester_slashings
+            if any(
+                i < len(state.validators)
+                and h.is_slashable_validator(state.validators[i], epoch)
+                for i in set(s.attestation_1.attesting_indices)
+                & set(s.attestation_2.attesting_indices)
+            )
+        ]
