@@ -174,7 +174,9 @@ class BeaconChain:
         from .data_availability import DataAvailabilityChecker
         from .naive_aggregation import NaiveAttestationPool, NaiveSyncContributionPool
 
-        self.data_availability = DataAvailabilityChecker(spec, kzg_setup)
+        self.data_availability = DataAvailabilityChecker(
+            spec, kzg_setup, store=self.store
+        )
         self.naive_attestation_pool = NaiveAttestationPool(spec)
         self.naive_sync_pool = NaiveSyncContributionPool(spec)
         # validator_index -> fee recipient, fed by prepare_beacon_proposer
@@ -387,9 +389,11 @@ class BeaconChain:
         self.fork_choice.on_tick(self.current_slot)
         self.naive_attestation_pool.prune(self.current_slot)
         self.naive_sync_pool.prune(self.current_slot)
-        self.observed_slashable.prune(
-            self.fork_choice.store.finalized_checkpoint[0],
-            self.spec.preset.SLOTS_PER_EPOCH,
+        fin_epoch = self.fork_choice.store.finalized_checkpoint[0]
+        self.observed_slashable.prune(fin_epoch, self.spec.preset.SLOTS_PER_EPOCH)
+        # pending DA joins at/below finalization can never import
+        self.data_availability.prune_finalized(
+            fin_epoch * self.spec.preset.SLOTS_PER_EPOCH
         )
 
     # ---------------------------------------------------------------- head

@@ -8,10 +8,12 @@ Parity surface:
   - availability checking — joining blocks and their blob sidecars before
     import, holding whichever side arrives first; import is gated on all
     commitments having a verified matching sidecar
-    (/root/reference/beacon_node/beacon_chain/src/data_availability_checker.rs:40,
-     overflow_lru_cache.rs). Here the pending store is a bounded in-memory
-    LRU (the reference spills to disk beyond capacity; a node that falls
-    that far behind re-requests over RPC anyway).
+    (/root/reference/beacon_node/beacon_chain/src/data_availability_checker.rs:40).
+    The pending store is a bounded in-memory LRU that SPILLS evicted
+    entries to the store's blob column and transparently faults them back
+    on access (overflow_lru_cache.rs OverflowLRUCache semantics): under
+    blob spam the in-memory footprint stays capped while no verified
+    component is lost.
 
 KZG proofs of all sidecars of a block verify as ONE batch through the shared
 pairing kernel (crypto/kzg.verify_blob_kzg_proof_batch — the same device
@@ -157,25 +159,197 @@ class _PendingComponents:
     blobs: dict = field(default_factory=dict)   # index -> sidecar (verified)
 
 
-class DataAvailabilityChecker:
-    """Joins blocks and blob sidecars before import (bounded LRU)."""
+_SPILL_PREFIX = b"da-pending:"
 
-    def __init__(self, spec, setup: "ckzg.TrustedSetup | None" = None, capacity: int = 64):
+
+class DataAvailabilityChecker:
+    """Joins blocks and blob sidecars before import.
+
+    Bounded in-memory LRU; with a backing store, LRU evictions spill the
+    serialized pending components to the blob column and accesses fault
+    them back in (overflow_lru_cache.rs)."""
+
+    def __init__(
+        self,
+        spec,
+        setup: "ckzg.TrustedSetup | None" = None,
+        capacity: int = 64,
+        store=None,
+    ):
         self.spec = spec
         self.setup = setup
         self._pending: OrderedDict[bytes, _PendingComponents] = OrderedDict()
         self.capacity = capacity
+        self.store = store  # HotColdDB or None
+        self.spilled = 0     # metric: total entries written to disk
+        # root -> slot of the spilled entry (slot drives finalization pruning)
+        self._on_disk: dict[bytes, int] = {}
+        if store is not None:
+            self._recover_spilled()
+
+    def _recover_spilled(self) -> None:
+        """Rebuild the disk index after a restart — otherwise spilled
+        entries would be orphaned forever (unbounded disk growth under
+        blob spam across restarts)."""
+        from ..store.kv import Column
+
+        for key, raw in self.store.blobs_db.iter_column(Column.blob):
+            if key.startswith(_SPILL_PREFIX):
+                root = key[len(_SPILL_PREFIX):]
+                self._on_disk[root] = self._entry_slot_from_bytes(raw)
+
+    @staticmethod
+    def _entry_slot_from_bytes(raw: bytes) -> int:
+        """Slot of a serialized entry without full deserialization: the
+        block slot if present, else the first sidecar's header slot."""
+        if raw[0] == 1:
+            return int.from_bytes(raw[1:9], "little")
+        # no block: u16 count then first sidecar slot
+        return int.from_bytes(raw[3:11], "little")
+
+    @staticmethod
+    def _entry_slot(e: _PendingComponents) -> int:
+        if e.block is not None:
+            return int(e.block.message.slot)
+        first = next(iter(e.blobs.values()))
+        return int(first.signed_block_header.message.slot)
+
+    def prune_finalized(self, finalized_slot: int) -> int:
+        """Drop spilled entries at or below the finalized slot (the
+        reference prunes its overflow cache at finalization —
+        overflow_lru_cache.rs). Returns the number deleted."""
+        if self.store is None:
+            return 0
+        from ..store.kv import Column
+
+        victims = [r for r, s in self._on_disk.items() if s <= finalized_slot]
+        for root in victims:
+            self.store.blobs_db.delete(Column.blob, self._spill_key(root))
+            del self._on_disk[root]
+        # in-memory entries too: a finalized-slot pending join can never
+        # complete into a viable block
+        mem_victims = [
+            r for r, e in self._pending.items()
+            if (e.block is not None or e.blobs)
+            and self._entry_slot(e) <= finalized_slot
+        ]
+        for root in mem_victims:
+            self._pending.pop(root, None)
+        return len(victims) + len(mem_victims)
+
+    # ------------------------------------------------------- spill plumbing
+
+    def _spill_key(self, block_root: bytes) -> bytes:
+        return _SPILL_PREFIX + block_root
+
+    def _serialize_entry(self, e: _PendingComponents) -> bytes | None:
+        """has_block u8 | [slot u64 | len u32 | block] | n u16 |
+        (slot u64 | len u32 | sidecar)* — slots resolve SSZ types back."""
+        from ..state_transition.slot import types_for_slot
+
+        out = bytearray()
+        if e.block is not None:
+            raw = e.types.SignedBeaconBlock.serialize(e.block)
+            out += b"\x01" + int(e.block.message.slot).to_bytes(8, "little")
+            out += len(raw).to_bytes(4, "little") + raw
+        else:
+            out += b"\x00"
+        out += len(e.blobs).to_bytes(2, "little")
+        for idx in sorted(e.blobs):
+            sc = e.blobs[idx]
+            slot = int(sc.signed_block_header.message.slot)
+            types = types_for_slot(self.spec, slot)
+            raw = types.BlobSidecar.serialize(sc)
+            out += slot.to_bytes(8, "little")
+            out += len(raw).to_bytes(4, "little") + raw
+        return bytes(out)
+
+    def _deserialize_entry(self, raw: bytes) -> _PendingComponents:
+        from ..state_transition.slot import types_for_slot
+
+        e = _PendingComponents()
+        off = 1
+        if raw[0] == 1:
+            slot = int.from_bytes(raw[off : off + 8], "little")
+            off += 8
+            n = int.from_bytes(raw[off : off + 4], "little")
+            off += 4
+            e.types = types_for_slot(self.spec, slot)
+            e.block = e.types.SignedBeaconBlock.deserialize(raw[off : off + n])
+            off += n
+        count = int.from_bytes(raw[off : off + 2], "little")
+        off += 2
+        for _ in range(count):
+            slot = int.from_bytes(raw[off : off + 8], "little")
+            off += 8
+            n = int.from_bytes(raw[off : off + 4], "little")
+            off += 4
+            types = types_for_slot(self.spec, slot)
+            sc = types.BlobSidecar.deserialize(raw[off : off + n])
+            off += n
+            e.blobs[int(sc.index)] = sc
+        return e
+
+    def _evict_one(self) -> None:
+        root, e = self._pending.popitem(last=False)
+        if self.store is None:
+            return  # memory-only mode: oldest entry is dropped
+        if e.block is None and not e.blobs:
+            return  # nothing worth preserving
+        from ..store.kv import Column
+
+        raw = self._serialize_entry(e)
+        self.store.blobs_db.put(Column.blob, self._spill_key(root), raw)
+        self._on_disk[root] = self._entry_slot(e)
+        self.spilled += 1
+
+    def _fault_in(self, block_root: bytes) -> _PendingComponents | None:
+        """Load a spilled entry back into memory (removing the disk copy)."""
+        if self.store is None or block_root not in self._on_disk:
+            return None
+        from ..store.kv import Column
+
+        raw = self.store.blobs_db.get(Column.blob, self._spill_key(block_root))
+        if raw is None:
+            self._on_disk.pop(block_root, None)
+            return None
+        self.store.blobs_db.delete(Column.blob, self._spill_key(block_root))
+        self._on_disk.pop(block_root, None)
+        e = self._deserialize_entry(raw)
+        self._pending[block_root] = e
+        while len(self._pending) > self.capacity:
+            self._evict_one()
+        return e
 
     def _entry(self, block_root: bytes) -> _PendingComponents:
         e = self._pending.get(block_root)
         if e is None:
+            e = self._fault_in(block_root)
+        if e is None:
             e = _PendingComponents()
             self._pending[block_root] = e
             while len(self._pending) > self.capacity:
-                self._pending.popitem(last=False)
+                self._evict_one()
         else:
             self._pending.move_to_end(block_root)
         return e
+
+    def _lookup(self, block_root: bytes) -> _PendingComponents | None:
+        """Read-only view: spilled entries are deserialized WITHOUT moving
+        them back into memory (faulting in would evict + re-write another
+        entry — needless disk churn for a pure query)."""
+        e = self._pending.get(block_root)
+        if e is not None or self.store is None or block_root not in self._on_disk:
+            return e
+        from ..store.kv import Column
+
+        raw = self.store.blobs_db.get(Column.blob, self._spill_key(block_root))
+        if raw is None:
+            self._on_disk.pop(block_root, None)
+            return None
+        return self._deserialize_entry(raw)
+
+    # ------------------------------------------------------------ interface
 
     def put_block(self, block_root: bytes, signed_block, types):
         """Register a block awaiting blobs. Returns (block, sidecars) if now
@@ -192,11 +366,15 @@ class DataAvailabilityChecker:
         return self._check(block_root)
 
     def missing_indices(self, block_root: bytes) -> list[int]:
-        e = self._pending.get(block_root)
+        e = self._lookup(block_root)
         if e is None or e.block is None:
             return []
         n = len(e.block.message.body.blob_kzg_commitments)
         return [i for i in range(n) if i not in e.blobs]
+
+    def pending_count(self) -> int:
+        """Entries tracked in memory + spilled to disk (observability)."""
+        return len(self._pending) + len(self._on_disk)
 
     def _check(self, block_root: bytes):
         e = self._pending.get(block_root)

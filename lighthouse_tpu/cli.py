@@ -454,12 +454,21 @@ def cmd_boot_node(args):
 
 
 def cmd_db_inspect(args):
-    """database_manager inspect/compact/prune/version analog."""
+    """database_manager inspect/compact/prune/version/migrate analog."""
+    from .store import metadata as md
     from .store.native_kv import NativeKVStore
     from .store.kv import Column
 
     store = NativeKVStore(args.db)
-    print(f"schema version: {DB_SCHEMA_VERSION}")
+    version = md.get_schema_version(store)
+    print(f"schema version: {version if version is not None else 'unset (pre-v1)'}"
+          f" (current: {md.CURRENT_SCHEMA_VERSION})")
+    if getattr(args, "migrate", False):
+        applied = md.migrate_schema(store)
+        if applied:
+            print(f"migrated through versions: {applied}")
+        else:
+            print("already at current schema version")
     print(f"total entries: {len(store)}")
     for col in Column:
         n = sum(1 for _ in store.iter_column(col))
@@ -484,9 +493,6 @@ def cmd_db_inspect(args):
         print("compacted")
     store.close()
     return 0
-
-
-DB_SCHEMA_VERSION = 1
 
 
 # ------------------------------------------------------------------ parser
@@ -608,8 +614,10 @@ def build_parser() -> argparse.ArgumentParser:
     )
     boot.set_defaults(fn=cmd_boot_node)
 
-    db = sub.add_parser("db", help="inspect/compact/prune a native store")
+    db = sub.add_parser("db", help="inspect/compact/prune/migrate a native store")
     db.add_argument("--db", required=True)
+    db.add_argument("--migrate", action="store_true",
+                    help="apply pending schema migrations")
     db.add_argument("--compact", action="store_true")
     db.add_argument("--prune-states", action="store_true")
     db.add_argument("--keep-states", type=int, default=32)

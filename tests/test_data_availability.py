@@ -165,6 +165,97 @@ def test_mismatched_sidecars_rejected(env):
         chain.process_block(signed, blobs=[wrong])
 
 
+def test_da_checker_spills_to_disk_under_blob_spam(env):
+    """overflow_lru_cache.rs semantics: pending entries past the memory cap
+    spill to the blobs column; in-memory count stays bounded at 10x the cap
+    while every spilled entry remains joinable."""
+    from lighthouse_tpu.store.hot_cold import HotColdDB
+
+    harness, chain, setup = env
+    spec = harness.spec
+    signed, sidecars = _blob_block(harness, chain, setup, 1)
+    store = HotColdDB(spec)
+    cap = 4
+    da = DataAvailabilityChecker(spec, setup, capacity=cap, store=store)
+
+    roots = [bytes([i + 1]) + b"\x00" * 31 for i in range(10 * cap)]
+    for r in roots:
+        assert da.put_blob(r, sidecars[0]) is None
+        assert len(da._pending) <= cap          # memory bounded
+    assert da.pending_count() == 10 * cap       # nothing lost
+    assert da.spilled >= 10 * cap - cap         # the rest went to disk
+
+    # the OLDEST (long-spilled) entry still joins when its block arrives
+    types = types_for_slot(spec, signed.message.slot)
+    got = da.put_block(roots[0], signed, types)
+    assert got is not None
+    block, scs = got
+    assert [int(s.index) for s in scs] == [0]
+    assert bytes(scs[0].kzg_commitment) == bytes(sidecars[0].kzg_commitment)
+    # faulting it back removed the disk copy
+    assert roots[0] not in da._on_disk
+    assert da.pending_count() == 10 * cap - 1
+
+
+def test_da_checker_spill_preserves_block_side(env):
+    """A pending BLOCK (not just blobs) survives the spill round-trip."""
+    from lighthouse_tpu.store.hot_cold import HotColdDB
+
+    harness, chain, setup = env
+    spec = harness.spec
+    signed, sidecars = _blob_block(harness, chain, setup, 2)
+    store = HotColdDB(spec)
+    da = DataAvailabilityChecker(spec, setup, capacity=1, store=store)
+    types = types_for_slot(spec, signed.message.slot)
+    root = b"\x77" * 32
+    assert da.put_block(root, signed, types) is None     # awaiting 2 blobs
+    da.put_blob(b"\x78" * 32, sidecars[0])               # evicts root to disk
+    assert root in da._on_disk
+    assert da.missing_indices(root) == [0, 1]            # read-only peek
+    assert root in da._on_disk                           # ...didn't fault in
+    assert da.put_blob(root, sidecars[0]) is None
+    got = da.put_blob(root, sidecars[1])
+    assert got is not None and got[0] == signed
+
+
+def test_da_checker_spill_survives_restart_and_prunes_at_finalization(env):
+    """Spilled entries are re-indexed by a NEW checker on the same store
+    (no orphaned disk junk after restart) and dropped once finalized."""
+    from lighthouse_tpu.store.hot_cold import HotColdDB
+
+    harness, chain, setup = env
+    spec = harness.spec
+    signed, sidecars = _blob_block(harness, chain, setup, 1)
+    store = HotColdDB(spec)
+    da = DataAvailabilityChecker(spec, setup, capacity=2, store=store)
+    roots = [bytes([i + 1]) + b"\x11" * 31 for i in range(6)]
+    for r in roots:
+        da.put_blob(r, sidecars[0])
+    assert len(da._on_disk) == 4
+
+    # "restart": fresh checker over the same store recovers the index
+    da2 = DataAvailabilityChecker(spec, setup, capacity=2, store=store)
+    assert set(da2._on_disk) == set(da._on_disk)
+    # recovered entries are still joinable
+    types = types_for_slot(spec, signed.message.slot)
+    spilled_root = next(iter(da2._on_disk))
+    assert da2.put_block(spilled_root, signed, types) is not None
+
+    # finalization at/after the sidecar slot prunes everything pending
+    sc_slot = int(sidecars[0].signed_block_header.message.slot)
+    dropped = da2.prune_finalized(sc_slot)
+    assert dropped > 0
+    assert da2.pending_count() == 0
+    assert da2._on_disk == {}
+    from lighthouse_tpu.store.kv import Column
+
+    leftovers = [
+        k for k, _v in store.blobs_db.iter_column(Column.blob)
+        if k.startswith(b"da-pending:")
+    ]
+    assert leftovers == []
+
+
 def test_da_checker_lru_bounds():
     spec = minimal_spec()
     da = DataAvailabilityChecker(spec, None, capacity=2)

@@ -1,0 +1,264 @@
+"""Gossipsub v1.1 peer-score function: per-topic terms, decay, thresholds,
+and score-driven mesh pruning/graylisting in the router.
+
+Parity surface: gossipsub/src/peer_score/{mod,params}.rs and
+service/gossipsub_scoring_parameters.rs.
+"""
+
+from lighthouse_tpu.network.gossipsub import Gossipsub
+from lighthouse_tpu.network.peer_score import (
+    PeerScore,
+    PeerScoreParams,
+    PeerScoreThresholds,
+    TopicScoreParams,
+    beacon_score_params,
+)
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def mk(topic="t", **topic_kw):
+    clock = Clock()
+    params = PeerScoreParams(topics={topic: TopicScoreParams(**topic_kw)})
+    ps = PeerScore(params, now=clock)
+    ps.add_peer("p")
+    return ps, clock
+
+
+def test_first_deliveries_positive_and_capped():
+    ps, _ = mk(first_message_deliveries_cap=3.0, first_message_deliveries_weight=2.0)
+    for _ in range(10):
+        ps.deliver_message("p", "t")
+    # capped at 3, weight 2, topic weight 1
+    assert ps.score("p") == 6.0
+
+
+def test_mesh_delivery_deficit_quadratic():
+    ps, clock = mk(
+        mesh_message_deliveries_threshold=4.0,
+        mesh_message_deliveries_weight=-1.0,
+        mesh_message_deliveries_activation=2.0,
+    )
+    ps.graft("p", "t")
+    # within the activation grace period: no penalty yet
+    assert ps.score("p") == 0.0
+    clock.t = 5.0
+    # 0 of 4 delivered -> deficit 4 -> -16
+    assert ps.score("p") == -16.0
+    ps.deliver_message("p", "t")
+    ps.duplicate_message("p", "t")
+    # 2 of 4 -> deficit 2 -> -4 (+ first-delivery term 1.0)
+    assert ps.score("p") == -4.0 + 1.0
+
+
+def test_mesh_failure_penalty_sticks_after_prune():
+    ps, clock = mk(
+        mesh_message_deliveries_threshold=3.0,
+        mesh_failure_penalty_weight=-1.0,
+        mesh_message_deliveries_activation=1.0,
+        mesh_failure_penalty_decay=0.5,
+    )
+    ps.graft("p", "t")
+    clock.t = 10.0
+    ps.prune("p", "t")           # in deficit (0 of 3) -> sticky 9
+    assert ps.score("p") == -9.0
+    ps.refresh()
+    assert ps.score("p") == -4.5  # decays, but follows the peer out of mesh
+
+
+def test_invalid_messages_quadratic():
+    ps, _ = mk(invalid_message_deliveries_weight=-10.0)
+    ps.reject_message("p", "t")
+    ps.reject_message("p", "t")
+    assert ps.score("p") == -40.0
+
+
+def test_behaviour_penalty_threshold():
+    ps, _ = mk()
+    ps.params.behaviour_penalty_threshold = 2.0
+    ps.params.behaviour_penalty_weight = -5.0
+    ps.add_penalty("p", 2)
+    assert ps.score("p") == 0.0          # at threshold: no penalty
+    ps.add_penalty("p", 2)               # excess 2 -> -5 * 4
+    assert ps.score("p") == -20.0
+
+
+def test_topic_weight_scales_and_cap_applies():
+    clock = Clock()
+    params = PeerScoreParams(
+        topics={
+            "big": TopicScoreParams(topic_weight=0.5, first_message_deliveries_cap=100),
+            "small": TopicScoreParams(topic_weight=0.015625, first_message_deliveries_cap=100),
+        },
+        topic_score_cap=10.0,
+    )
+    ps = PeerScore(params, now=clock)
+    ps.add_peer("p")
+    for _ in range(4):
+        ps.deliver_message("p", "big")
+        ps.deliver_message("p", "small")
+    assert ps.score("p") == 4 * 0.5 + 4 * 0.015625
+    for _ in range(100):
+        ps.deliver_message("p", "big")
+    assert ps.score("p") == 10.0         # positive contribution capped
+
+
+def test_decay_and_ghost_expiry():
+    ps, clock = mk(first_message_deliveries_decay=0.5)
+    ps.deliver_message("p", "t")
+    ps.refresh()
+    assert ps.score("p") == 0.5
+    ps.remove_peer("p")
+    clock.t = ps.params.retain_score + 1
+    ps.refresh()
+    assert "p" not in ps.peers           # retained window elapsed
+
+
+def test_beacon_params_shape():
+    p = beacon_score_params(
+        block_topic="blocks", aggregate_topic="aggs",
+        subnet_topics=[f"sub{i}" for i in range(64)],
+    )
+    assert p.topics["blocks"].topic_weight == 0.5
+    assert p.topics["sub0"].topic_weight < p.topics["aggs"].topic_weight
+
+
+# ---------------------------------------------------------------- router
+
+
+class Net:
+    def __init__(self):
+        self.routers = {}
+
+    def add(self, name):
+        g = Gossipsub(
+            name, lambda peer, rpc, _n=name: self.routers[peer].on_rpc(_n, rpc)
+        )
+        self.routers[name] = g
+        return g
+
+    def connect(self, a, b):
+        self.routers[a].add_peer(b)
+        self.routers[b].add_peer(a)
+
+
+def test_misbehaving_node_gets_score_pruned():
+    """4-node mesh; one node floods invalid messages and is pruned from the
+    honest meshes and eventually graylisted."""
+    net = Net()
+    names = ["a", "b", "c", "bad"]
+    routers = {n: net.add(n) for n in names}
+    for n, g in routers.items():
+        g.subscribe("t", lambda m: b"evil" not in m.decompressed)
+    for i, x in enumerate(names):
+        for y in names[i + 1 :]:
+            net.connect(x, y)
+    for g in routers.values():
+        g.heartbeat()
+    assert "bad" in routers["a"].mesh["t"]
+
+    for i in range(12):
+        routers["bad"].publish("t", b"evil %d" % i)
+    a = routers["a"]
+    assert a.rejected >= 1
+    assert a.scores["bad"] < 0
+    a.heartbeat()
+    assert "bad" not in a.mesh["t"]                  # score-pruned
+    assert ("bad", "t") in a.backoff                 # with a re-graft backoff
+    # honest peers unaffected
+    assert a.scores["b"] >= 0
+
+    # keep flooding until the graylist threshold trips: RPCs then dropped
+    for i in range(30):
+        routers["bad"].publish("t", b"evil more %d" % i)
+    assert a.scores["bad"] < a.thresholds.graylist_threshold
+    before = a.graylisted
+    routers["bad"].publish("t", b"one more")
+    assert a.graylisted > before
+
+
+def test_rejected_duplicate_penalized_not_credited():
+    """Replaying a known-invalid message must penalize, not earn mesh
+    credit (peer_score.rs duplicate-of-Rejected)."""
+    net = Net()
+    a, b, c = net.add("a"), net.add("b"), net.add("c")
+    a.subscribe("t", lambda m: False)      # a rejects everything
+    for g in (b, c):
+        g.subscribe("t", lambda m: True)
+    net.connect("a", "b")
+    net.connect("a", "c")
+    for g in (a, b, c):
+        g.heartbeat()
+    from lighthouse_tpu.network.gossipsub import Rpc, encode_rpc
+    from lighthouse_tpu.network import snappy
+
+    data = snappy.compress(b"bad payload")
+    a.on_rpc("b", encode_rpc(Rpc(msgs=[("t", data)])))
+    s_b = a.scores["b"]
+    assert s_b < 0
+    # c replays the same (rejected) message: penalized, no mesh credit
+    a.on_rpc("c", encode_rpc(Rpc(msgs=[("t", data)])))
+    assert a.scores["c"] < 0
+    assert a.peer_score.peers["c"].topics["t"].mesh_message_deliveries == 0
+
+
+def test_duplicate_credit_requires_delivery_window():
+    """Echoing a message long after first delivery earns nothing."""
+    import lighthouse_tpu.network.gossipsub as gs_mod
+
+    net = Net()
+    a, b, c = net.add("a"), net.add("b"), net.add("c")
+    for g in (a, b, c):
+        g.subscribe("t", lambda m: True)
+    net.connect("a", "b")
+    net.connect("a", "c")
+    for g in (a, b, c):
+        g.heartbeat()
+    from lighthouse_tpu.network.gossipsub import Rpc, encode_rpc
+    from lighthouse_tpu.network import snappy
+
+    data = snappy.compress(b"payload")
+    a.on_rpc("b", encode_rpc(Rpc(msgs=[("t", data)])))
+    # age the first-delivery stamp past the window
+    mid = next(iter(a._deliverers))
+    ts, senders = a._deliverers[mid]
+    a._deliverers[mid] = (ts - gs_mod.DELIVERY_WINDOW - 1, senders)
+    a.on_rpc("c", encode_rpc(Rpc(msgs=[("t", data)])))
+    assert a.peer_score.peers["c"].topics["t"].mesh_message_deliveries == 0
+
+
+def test_deficit_peer_pruned_from_mesh():
+    """A mesh member that never forwards anything is pruned on deficit
+    alone — no invalid message required."""
+    net = Net()
+    a, lazy, chatty = net.add("a"), net.add("lazy"), net.add("chatty")
+    for g in (a, lazy, chatty):
+        g.subscribe("t", lambda m: True)
+    net.connect("a", "lazy")
+    net.connect("a", "chatty")
+    net.connect("lazy", "chatty")
+    for g in (a, lazy, chatty):
+        g.heartbeat()
+    assert "lazy" in a.mesh["t"]
+    # lazy goes silent: receives but never forwards (a free-riding peer)
+    lazy._send_raw = lambda peer, rpc: None
+    clock = Clock()
+    a.peer_score.now = clock          # control mesh-time for activation
+    a.peer_score.graft("lazy", "t")   # re-stamp graft under the fake clock
+    a.peer_score.graft("chatty", "t")
+    # chatty forwards traffic; lazy never does (we bypass lazy's router by
+    # injecting directly from chatty only)
+    for i in range(8):
+        chatty.publish("t", b"m%d" % i)
+    clock.t = 10.0                     # activation window elapsed
+    assert a.scores["lazy"] < 0        # deficit bites
+    assert a.scores["chatty"] > 0
+    a.heartbeat()
+    assert "lazy" not in a.mesh["t"]
+    assert "chatty" in a.mesh["t"]

@@ -1,0 +1,268 @@
+"""Store metadata + schema migrations + historic state reconstruction.
+
+Covers /root/reference/beacon_node/store/src/metadata.rs (version record,
+anchor/blob/split items), the atomic one-step migration driver (a crash
+mid-migration leaves the DB wholly at the old version), forwards/reverse
+block-root iterators, and reconstruct.rs-style state rebuilds: a pruned
+state comes back byte-identical from a restore point + block replay.
+"""
+
+import pytest
+
+from lighthouse_tpu.store import metadata as md
+from lighthouse_tpu.store.hot_cold import HotColdDB, StoreConfig
+from lighthouse_tpu.store.kv import Column, MemoryStore
+from lighthouse_tpu.types.containers import spec_types
+from lighthouse_tpu.types.spec import ForkName, MINIMAL_PRESET, minimal_spec
+
+
+def test_fresh_db_stamped_current():
+    hot = MemoryStore()
+    db = HotColdDB(minimal_spec(), hot=hot)
+    assert db.schema_version() == md.CURRENT_SCHEMA_VERSION
+    assert db.schema_migrations_applied == []
+
+
+def test_v1_to_v2_migration_materializes_metadata():
+    hot = MemoryStore()
+    md.put_schema_version(hot, 1)  # simulate a round-3 era DB
+    db = HotColdDB(minimal_spec(), hot=hot)
+    assert db.schema_version() == md.CURRENT_SCHEMA_VERSION
+    assert db.schema_migrations_applied == [2]
+    assert md.get_split(hot) is not None
+    assert md.get_blob_info(hot) is not None
+
+
+def test_legacy_db_without_version_record_walks_migrations():
+    # a rounds-1-3 DB: has data but no version record -> treated as v1
+    hot = MemoryStore()
+    hot.put(Column.block, b"r" * 32, b"some block")
+    db = HotColdDB(minimal_spec(), hot=hot)
+    assert db.schema_version() == md.CURRENT_SCHEMA_VERSION
+    assert db.schema_migrations_applied == [2]
+    assert md.get_blob_info(hot) is not None  # v1->v2 actually ran
+
+
+def test_downgrade_refused():
+    hot = MemoryStore()
+    md.put_schema_version(hot, md.CURRENT_SCHEMA_VERSION + 5)
+    with pytest.raises(md.MigrationError):
+        md.migrate_schema(hot)
+
+
+class CrashingStore(MemoryStore):
+    """Fails the Nth atomic batch BEFORE applying anything — the native
+    log's all-or-nothing batch semantics under a crash."""
+
+    def __init__(self, fail_on_batch: int):
+        super().__init__()
+        self._countdown = fail_on_batch
+
+    def do_atomically(self, ops):
+        self._countdown -= 1
+        if self._countdown == 0:
+            raise IOError("injected crash")
+        super().do_atomically(ops)
+
+
+def test_crash_mid_migration_leaves_old_version_then_resumes():
+    hot = CrashingStore(fail_on_batch=2)  # batch 1 = version stamp below
+    md.put_schema_version(hot, 1)
+    with pytest.raises(IOError):
+        md.migrate_schema(hot)
+    # untouched: still at v1, no partial records
+    assert md.get_schema_version(hot) == 1
+    assert md.get_split(hot) is None
+    # restart (no more faults): migration completes
+    applied = md.migrate_schema(hot)
+    assert applied == [2]
+    assert md.get_schema_version(hot) == md.CURRENT_SCHEMA_VERSION
+    assert md.get_split(hot) is not None
+
+
+def test_split_persists_across_reopen():
+    spec = minimal_spec()
+    types = spec_types(MINIMAL_PRESET, ForkName.deneb)
+    hot, cold = MemoryStore(), MemoryStore()
+    db = HotColdDB(spec, hot=hot, cold=cold, config=StoreConfig(slots_per_restore_point=4))
+    segment = []
+    for slot in range(8):
+        st = types.BeaconState.default()
+        st.slot = slot
+        sroot = bytes([0xA1 + slot]) + b"\x00" * 31
+        broot = bytes([0xB0 + slot]) + b"\x00" * 31
+        db.put_state(sroot, st, types)
+        segment.append((slot, broot, sroot))
+    db.migrate_to_freezer(8, segment, types)
+    assert db.split_slot == 8
+    db2 = HotColdDB(spec, hot=hot, cold=cold)
+    assert db2.split_slot == 8
+
+
+def test_anchor_blob_pruning_roundtrip():
+    db = HotColdDB(minimal_spec())
+    assert db.get_anchor_info() is None
+    info = md.AnchorInfo(
+        anchor_slot=64,
+        oldest_block_slot=32,
+        oldest_block_parent=b"\x11" * 32,
+        state_upper_limit=64,
+        state_lower_limit=0,
+    )
+    db.put_anchor_info(info)
+    got = db.get_anchor_info()
+    assert got == info
+    assert not got.block_backfill_complete(0)
+    assert got.block_backfill_complete(32)
+    db.put_anchor_info(None)
+    assert db.get_anchor_info() is None
+
+    bi = md.BlobInfo(oldest_blob_slot=7, blobs_db=True)
+    db.put_blob_info(bi)
+    assert db.get_blob_info() == bi
+
+    cp = md.PruningCheckpoint(epoch=3, root=b"\x22" * 32)
+    md.put_pruning_checkpoint(db.hot, cp)
+    assert md.get_pruning_checkpoint(db.hot) == cp
+
+
+def test_block_root_iterators_carry_skip_slots():
+    spec = minimal_spec()
+    types = spec_types(MINIMAL_PRESET, ForkName.deneb)
+    db = HotColdDB(spec)
+    # chain with a skip: blocks at slots 0,1,3 (slot 2 skipped -> repeats 1's root)
+    roots = {0: b"\x01" * 32, 1: b"\x02" * 32, 2: b"\x02" * 32, 3: b"\x03" * 32}
+    segment = [(s, roots[s], bytes([0x40 + s]) + b"\x00" * 31) for s in range(4)]
+    db.migrate_to_freezer(4, segment, types)
+    fwd = list(db.forwards_block_roots_iterator(0, 3))
+    assert fwd == [(0, roots[0]), (1, roots[1]), (2, roots[1]), (3, roots[3])]
+    rev = list(db.reverse_block_roots_iterator(3, 0))
+    assert rev[0] == (3, roots[3]) and rev[-1] == (0, roots[0])
+
+
+@pytest.fixture(scope="module")
+def replayed_chain():
+    """A short real chain (fake-crypto lane) whose states we can prune and
+    reconstruct."""
+    from lighthouse_tpu.crypto.bls import api as bls_api
+    from lighthouse_tpu.testing.harness import StateHarness, clone_state
+
+    prev = bls_api.get_backend().name
+    bls_api.set_backend("fake")
+    try:
+        spec = minimal_spec()
+        harness = StateHarness.new(spec, 32)
+        types = spec_types(MINIMAL_PRESET, ForkName.deneb)
+        snapshots = []  # (slot, state_root, serialized state) after each block
+        blocks = []
+        genesis = clone_state(harness.state)
+        for _ in range(9):
+            signed = harness.extend_chain(1)[0]
+            blocks.append(signed)
+            snapshots.append(
+                (
+                    int(harness.state.slot),
+                    types.BeaconState.hash_tree_root(harness.state),
+                    types.BeaconState.serialize(harness.state),
+                )
+            )
+        yield spec, types, genesis, blocks, snapshots
+    finally:
+        bls_api.set_backend(prev)
+
+
+def _populate_freezer(spec, types, genesis, blocks, snapshots, sprp=4):
+    db = HotColdDB(spec, config=StoreConfig(slots_per_restore_point=sprp))
+    g_root = types.BeaconState.hash_tree_root(genesis)
+    db.put_state(g_root, genesis, types)
+    segment = [(0, b"\x00" * 32, g_root)]
+    for signed, (slot, sroot, _raw) in zip(blocks, snapshots):
+        broot = types.BeaconBlock.hash_tree_root(signed.message)
+        db.put_block(broot, signed, types)
+        db.put_state(sroot, types.BeaconState.deserialize(_raw), types)
+        segment.append((slot, broot, sroot))
+    db.migrate_to_freezer(snapshots[-1][0] + 1, segment, types)
+    return db
+
+
+def test_pruned_state_rebuilt_byte_identical(replayed_chain):
+    spec, types, genesis, blocks, snapshots = replayed_chain
+    db = _populate_freezer(spec, types, genesis, blocks, snapshots)
+    # states are pruned from hot by migration; restore points exist at 0,4,8
+    for slot, sroot, raw in snapshots:
+        assert not db.state_exists(sroot)
+    # rebuild a mid-interval state (slot 6: restore point 4 + blocks 5,6)
+    slot, sroot, raw = snapshots[5]
+    assert slot == 6
+    rebuilt = db.load_cold_state_by_slot(slot)
+    assert rebuilt is not None
+    assert types.BeaconState.serialize(rebuilt) == raw
+    assert types.BeaconState.hash_tree_root(rebuilt) == sroot
+
+
+def test_reconstruct_historic_states_fills_restore_points(replayed_chain):
+    spec, types, genesis, blocks, snapshots = replayed_chain
+    db = _populate_freezer(spec, types, genesis, blocks, snapshots)
+    # simulate checkpoint-sync: drop the intermediate restore points, keep 0
+    for slot, sroot, _raw in snapshots:
+        if slot % 4 == 0:
+            db.cold.delete(Column.freezer_chunks, sroot)
+    anchor = md.AnchorInfo(
+        anchor_slot=snapshots[-1][0],
+        oldest_block_slot=0,
+        oldest_block_parent=b"\x00" * 32,
+        state_upper_limit=snapshots[-1][0],
+        state_lower_limit=0,
+    )
+    db.put_anchor_info(anchor)
+    assert db.reconstruct_historic_states(batch_slots=2)
+    assert db.get_anchor_info() is None  # complete => anchor dropped
+    # restore points at 4 and 8 are back and byte-identical
+    for slot, sroot, raw in snapshots:
+        if slot % 4 == 0:
+            got = db.get_restore_point_state(sroot, types)
+            assert got is not None
+            assert types.BeaconState.serialize(got) == raw
+
+
+def test_missing_block_is_an_integrity_error(replayed_chain):
+    from lighthouse_tpu.store.hot_cold import MissingBlockError
+
+    spec, types, genesis, blocks, snapshots = replayed_chain
+    db = _populate_freezer(spec, types, genesis, blocks, snapshots)
+    # prune a block the freezer still references
+    victim = types.BeaconBlock.hash_tree_root(blocks[4].message)
+    db.delete_block(victim)
+    with pytest.raises(MissingBlockError):
+        db.load_cold_state_by_slot(6)
+
+
+def test_no_retain_anchor_is_a_noop(replayed_chain):
+    spec, types, genesis, blocks, snapshots = replayed_chain
+    db = _populate_freezer(spec, types, genesis, blocks, snapshots)
+    anchor = md.AnchorInfo(
+        anchor_slot=8,
+        oldest_block_slot=0,
+        oldest_block_parent=b"\x00" * 32,
+        state_upper_limit=md.STATE_UPPER_LIMIT_NO_RETAIN,
+        state_lower_limit=0,
+    )
+    db.put_anchor_info(anchor)
+    assert db.reconstruct_historic_states()
+    assert db.get_anchor_info() == anchor  # untouched
+
+
+def test_reconstruct_requires_backfill_complete(replayed_chain):
+    spec, types, genesis, blocks, snapshots = replayed_chain
+    db = _populate_freezer(spec, types, genesis, blocks, snapshots)
+    db.put_anchor_info(
+        md.AnchorInfo(
+            anchor_slot=8,
+            oldest_block_slot=3,  # backfill unfinished
+            oldest_block_parent=b"\x00" * 32,
+            state_upper_limit=8,
+            state_lower_limit=0,
+        )
+    )
+    with pytest.raises(ValueError, match="backfill"):
+        db.reconstruct_historic_states()
