@@ -156,7 +156,14 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
         except ApiError as e:
             self._error(e.code, e.message)
         except Exception as e:  # noqa: BLE001
-            self._error(500, f"{type(e).__name__}: {e}")
+            from ..chain.beacon_chain import BlockError
+
+            if isinstance(e, BlockError):
+                # invalid submissions are client errors, not server faults
+                # (publish_blocks.rs maps verification failures to 400)
+                self._error(400, f"BlockError: {e}")
+            else:
+                self._error(500, f"{type(e).__name__}: {e}")
 
     # ------------------------------------------------------------- handlers
 
@@ -393,11 +400,19 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
         ssz_hex = body.get("ssz") if isinstance(body, dict) else None
         if not ssz_hex:
             raise ApiError(400, "expected {'ssz': '0x...'} body")
-        raw = bytes.fromhex(ssz_hex[2:])
-        # slot is the first 8 bytes of the message (after 100-byte envelope?)
-        # -> decode via head-fork types; forks with identical layouts decode fine
+        # decode via head-fork types; forks with identical layouts decode fine
         types = types_for_slot(chain.spec, chain.current_slot)
-        signed = types.SignedBeaconBlock.deserialize(raw)
+        try:
+            raw = bytes.fromhex(ssz_hex[2:])
+            signed = types.SignedBeaconBlock.deserialize(raw)
+        except Exception as e:  # noqa: BLE001
+            raise ApiError(400, f"undecodable block SSZ: {e}") from e
+        self._import_published_block(signed)
+
+    def _import_published_block(self, signed):
+        """Shared import path for full + blinded publishes
+        (publish_blocks.rs broadcast-then-import)."""
+        chain = self.chain
         root = chain.verify_block_for_gossip(signed)
         # locally-produced deneb blocks: rebuild sidecars from the blobs
         # bundle the EL returned at production time (publish_blocks.rs)
@@ -779,6 +794,228 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
             }
         )
 
+    # ---------------------------------------------------------- rewards
+
+    def get_rewards_blocks(self, block_id):
+        """GET /eth/v1/beacon/rewards/blocks/{block_id}
+        (standard_block_rewards.rs)."""
+        from . import rewards as rw
+
+        root = self._block_root_by_id(block_id)
+        try:
+            data = rw.compute_block_rewards(self.chain, root)
+        except KeyError as e:
+            raise ApiError(404, str(e)) from e
+        self._json(
+            {
+                "execution_optimistic": False,
+                "finalized": self._is_finalized_root(root),
+                "data": {k: str(v) for k, v in data.items()},
+            }
+        )
+
+    def post_rewards_attestations(self, epoch):
+        """POST /eth/v1/beacon/rewards/attestations/{epoch} with an optional
+        JSON array of validator indices/pubkeys (attestation_rewards.rs)."""
+        from . import rewards as rw
+
+        validators = self._read_body() or []
+        if not isinstance(validators, list):
+            raise ApiError(400, "body must be a JSON array")
+        try:
+            data = rw.compute_attestation_rewards(self.chain, int(epoch), validators)
+        except KeyError as e:
+            raise ApiError(404, str(e)) from e
+        except ValueError as e:
+            raise ApiError(400, str(e)) from e
+
+        def quoted(row):
+            return {k: str(v) for k, v in row.items()}
+
+        self._json(
+            {
+                "execution_optimistic": False,
+                "finalized": False,
+                "data": {
+                    "ideal_rewards": [quoted(r) for r in data["ideal_rewards"]],
+                    "total_rewards": [quoted(r) for r in data["total_rewards"]],
+                },
+            }
+        )
+
+    def post_rewards_sync_committee(self, block_id):
+        """POST /eth/v1/beacon/rewards/sync_committee/{block_id}
+        (sync_committee_rewards.rs)."""
+        from . import rewards as rw
+
+        root = self._block_root_by_id(block_id)
+        validators = self._read_body() or []
+        if not isinstance(validators, list):
+            raise ApiError(400, "body must be a JSON array")
+        try:
+            data = rw.compute_sync_committee_rewards(self.chain, root, validators)
+        except KeyError as e:
+            raise ApiError(404, str(e)) from e
+        except ValueError as e:
+            raise ApiError(400, str(e)) from e
+        self._json(
+            {
+                "execution_optimistic": False,
+                "finalized": self._is_finalized_root(root),
+                "data": [
+                    {"validator_index": str(r["validator_index"]),
+                     "reward": str(r["reward"])}
+                    for r in data
+                ],
+            }
+        )
+
+    def _is_finalized_root(self, root: bytes) -> bool:
+        slot = self.chain.block_slots.get(root)
+        if slot is None:
+            return False
+        fin_epoch = self.chain.fork_choice.store.finalized_checkpoint[0]
+        return slot <= fin_epoch * self.chain.spec.preset.SLOTS_PER_EPOCH
+
+    # ------------------------------------------------- blinded production
+
+    def get_produce_blinded_block(self, slot):
+        """GET /eth/v1/validator/blinded_blocks/{slot} — the block with its
+        execution payload replaced by the payload HEADER (produce_block.rs
+        blinded path; the VC signs it and POSTs to blinded_blocks)."""
+        q = self._query()
+        reveal_hex = q.get("randao_reveal")
+        if not reveal_hex:
+            raise ApiError(400, "randao_reveal required")
+        slot = int(slot)
+        graffiti = bytes.fromhex(q["graffiti"][2:]) if "graffiti" in q else b"\x00" * 32
+        block = self.chain.produce_block(
+            slot, bytes.fromhex(reveal_hex[2:]),
+            op_pool=self.op_pool, graffiti=graffiti,
+        )
+        types = types_for_slot(self.chain.spec, slot)
+        payload_header_json = None
+        payload = getattr(block.body, "execution_payload", None)
+        if payload is not None:
+            tx_type = next(
+                f.type for f in types.ExecutionPayload.fields
+                if f.name == "transactions"
+            )
+            payload_header_json = {
+                "block_hash": _hex(payload.block_hash),
+                "parent_hash": _hex(payload.parent_hash),
+                "block_number": _u(payload.block_number),
+                "transactions_root": _hex(tx_type.hash_tree_root(payload.transactions)),
+            }
+        self._json(
+            {
+                "version": self.chain.spec.fork_name_at_slot(slot).name,
+                "execution_payload_blinded": True,
+                "data": {
+                    "message": {
+                        "slot": _u(block.slot),
+                        "proposer_index": _u(block.proposer_index),
+                        "parent_root": _hex(block.parent_root),
+                        "state_root": _hex(block.state_root),
+                        "body": {"execution_payload_header": payload_header_json},
+                    },
+                    # full SSZ so the in-process publish path can reuse it
+                    "ssz": _hex(types.BeaconBlock.serialize(block)),
+                },
+            }
+        )
+
+    def post_publish_blinded_block(self):
+        """POST /eth/v1/beacon/blinded_blocks — accepts the signed blinded
+        block; the payload is recovered from the local production cache
+        (publish_blocks.rs ProvenancedBlock::Builder path, with the local-EL
+        unblinding shortcut)."""
+        body = self._read_body()
+        raw = body.get("ssz") if isinstance(body, dict) else None
+        if raw is None:
+            raise ApiError(400, "expected {'ssz': block hex, 'signature': sig hex}")
+        sig = body.get("signature")
+        if sig is None:
+            raise ApiError(400, "signature required")
+        # same fork resolution as the full publish path (types_for_slot of
+        # the CURRENT slot; re-resolved below once the real slot is known)
+        types = types_for_slot(self.chain.spec, self.chain.current_slot)
+        try:
+            block = types.BeaconBlock.deserialize(bytes.fromhex(raw[2:]))
+        except Exception as e:  # noqa: BLE001
+            raise ApiError(400, f"undecodable block SSZ: {e}") from e
+        types = types_for_slot(self.chain.spec, block.slot)
+        signed = types.SignedBeaconBlock.make(
+            message=block, signature=bytes.fromhex(sig[2:])
+        )
+        self._import_published_block(signed)
+
+    # ------------------------------------------------- deposit snapshot
+
+    def get_deposit_snapshot(self):
+        """GET /eth/v1/beacon/deposit_snapshot (EIP-4881; the reference
+        serves it from the eth1 service cache)."""
+        eth1 = getattr(self.chain, "eth1_cache", None)
+        if eth1 is None:
+            raise ApiError(404, "no eth1 deposit cache")
+        tree = eth1.tree
+        count = len(tree)
+        latest = eth1.blocks[-1] if eth1.blocks else None
+        self._json(
+            {
+                "data": {
+                    "finalized": [_hex(tree.root(count))],
+                    "deposit_root": _hex(tree.root(count)),
+                    "deposit_count": _u(count),
+                    "execution_block_hash": _hex(
+                        latest.hash if latest else b"\x00" * 32
+                    ),
+                    "execution_block_height": _u(latest.number if latest else 0),
+                }
+            }
+        )
+
+    # ------------------------------------------------- LC updates by range
+
+    def get_lc_updates(self):
+        """GET /eth/v1/beacon/light_client/updates?start_period=&count=
+        (http_api light_client updates-by-range)."""
+        lc = getattr(self.chain, "light_client_cache", None)
+        if lc is None:
+            raise ApiError(404, "light client server not enabled")
+        q = self._query()
+        try:
+            start = int(q["start_period"])
+            count = int(q["count"])
+        except (KeyError, ValueError) as e:
+            raise ApiError(400, "start_period and count required") from e
+        count = min(count, 128)  # MAX_REQUEST_LIGHT_CLIENT_UPDATES
+        out = []
+        for period in range(start, start + count):
+            u = lc.best_updates.get(period)
+            if u is None:
+                continue
+            out.append(
+                {
+                    "version": self.chain.spec.fork_name_at_slot(
+                        u.attested_header.slot
+                    ).value,
+                    "data": {
+                        "attested_header": {
+                            "beacon": {"slot": _u(u.attested_header.slot)}
+                        },
+                        "finalized_header": {
+                            "beacon": {"slot": _u(u.finalized_header.slot)}
+                        },
+                        "signature_slot": _u(u.signature_slot),
+                        "next_sync_committee_branch": [
+                            _hex(b) for b in u.next_sync_committee_branch
+                        ],
+                    },
+                }
+            )
+        self._json(out)
+
     def post_pool_voluntary_exits(self):
         body = self._read_body()
         types = types_for_slot(self.chain.spec, self.chain.current_slot)
@@ -1084,6 +1321,13 @@ _ROUTES = [
     (r"/eth/v1/beacon/light_client/finality_update", "GET", BeaconApiHandler.get_lc_finality),
     (r"/eth/v1/beacon/pool/voluntary_exits", "POST", BeaconApiHandler.post_pool_voluntary_exits),
     (r"/eth/v1/beacon/pool/voluntary_exits", "GET", BeaconApiHandler.get_pool_voluntary_exits),
+    (r"/eth/v1/beacon/rewards/blocks/([^/]+)", "GET", BeaconApiHandler.get_rewards_blocks),
+    (r"/eth/v1/beacon/rewards/attestations/(\d+)", "POST", BeaconApiHandler.post_rewards_attestations),
+    (r"/eth/v1/beacon/rewards/sync_committee/([^/]+)", "POST", BeaconApiHandler.post_rewards_sync_committee),
+    (r"/eth/v1/validator/blinded_blocks/(\d+)", "GET", BeaconApiHandler.get_produce_blinded_block),
+    (r"/eth/v1/beacon/blinded_blocks", "POST", BeaconApiHandler.post_publish_blinded_block),
+    (r"/eth/v1/beacon/deposit_snapshot", "GET", BeaconApiHandler.get_deposit_snapshot),
+    (r"/eth/v1/beacon/light_client/updates", "GET", BeaconApiHandler.get_lc_updates),
 ]
 
 

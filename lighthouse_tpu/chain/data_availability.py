@@ -10,7 +10,7 @@ Parity surface:
     commitments having a verified matching sidecar
     (/root/reference/beacon_node/beacon_chain/src/data_availability_checker.rs:40).
     The pending store is a bounded in-memory LRU that SPILLS evicted
-    entries to the store's blob column and transparently faults them back
+    entries to the store's da_spill column and transparently faults them back
     on access (overflow_lru_cache.rs OverflowLRUCache semantics): under
     blob spam the in-memory footprint stays capped while no verified
     component is lost.
@@ -159,14 +159,11 @@ class _PendingComponents:
     blobs: dict = field(default_factory=dict)   # index -> sidecar (verified)
 
 
-_SPILL_PREFIX = b"da-pending:"
-
-
 class DataAvailabilityChecker:
     """Joins blocks and blob sidecars before import.
 
     Bounded in-memory LRU; with a backing store, LRU evictions spill the
-    serialized pending components to the blob column and accesses fault
+    serialized pending components to the da_spill column and accesses fault
     them back in (overflow_lru_cache.rs)."""
 
     def __init__(
@@ -193,10 +190,8 @@ class DataAvailabilityChecker:
         blob spam across restarts)."""
         from ..store.kv import Column
 
-        for key, raw in self.store.blobs_db.iter_column(Column.blob):
-            if key.startswith(_SPILL_PREFIX):
-                root = key[len(_SPILL_PREFIX):]
-                self._on_disk[root] = self._entry_slot_from_bytes(raw)
+        for key, raw in self.store.blobs_db.iter_column(Column.da_spill):
+            self._on_disk[key] = self._entry_slot_from_bytes(raw)
 
     @staticmethod
     def _entry_slot_from_bytes(raw: bytes) -> int:
@@ -224,7 +219,7 @@ class DataAvailabilityChecker:
 
         victims = [r for r, s in self._on_disk.items() if s <= finalized_slot]
         for root in victims:
-            self.store.blobs_db.delete(Column.blob, self._spill_key(root))
+            self.store.blobs_db.delete(Column.da_spill, root)
             del self._on_disk[root]
         # in-memory entries too: a finalized-slot pending join can never
         # complete into a viable block
@@ -238,9 +233,6 @@ class DataAvailabilityChecker:
         return len(victims) + len(mem_victims)
 
     # ------------------------------------------------------- spill plumbing
-
-    def _spill_key(self, block_root: bytes) -> bytes:
-        return _SPILL_PREFIX + block_root
 
     def _serialize_entry(self, e: _PendingComponents) -> bytes | None:
         """has_block u8 | [slot u64 | len u32 | block] | n u16 |
@@ -299,7 +291,7 @@ class DataAvailabilityChecker:
         from ..store.kv import Column
 
         raw = self._serialize_entry(e)
-        self.store.blobs_db.put(Column.blob, self._spill_key(root), raw)
+        self.store.blobs_db.put(Column.da_spill, root, raw)
         self._on_disk[root] = self._entry_slot(e)
         self.spilled += 1
 
@@ -309,11 +301,11 @@ class DataAvailabilityChecker:
             return None
         from ..store.kv import Column
 
-        raw = self.store.blobs_db.get(Column.blob, self._spill_key(block_root))
+        raw = self.store.blobs_db.get(Column.da_spill, block_root)
         if raw is None:
             self._on_disk.pop(block_root, None)
             return None
-        self.store.blobs_db.delete(Column.blob, self._spill_key(block_root))
+        self.store.blobs_db.delete(Column.da_spill, block_root)
         self._on_disk.pop(block_root, None)
         e = self._deserialize_entry(raw)
         self._pending[block_root] = e
@@ -343,7 +335,7 @@ class DataAvailabilityChecker:
             return e
         from ..store.kv import Column
 
-        raw = self.store.blobs_db.get(Column.blob, self._spill_key(block_root))
+        raw = self.store.blobs_db.get(Column.da_spill, block_root)
         if raw is None:
             self._on_disk.pop(block_root, None)
             return None

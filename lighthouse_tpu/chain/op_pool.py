@@ -230,9 +230,20 @@ class OperationPool:
         exits = [
             e for e in self.voluntary_exits.values() if exit_includable(e)
         ][: spec.preset.MAX_VOLUNTARY_EXITS]
-        changes = list(self.bls_changes.values())[
-            : spec.preset.MAX_BLS_TO_EXECUTION_CHANGES
-        ]
+        def change_includable(c) -> bool:
+            # mirror process_bls_to_execution_change's non-signature checks
+            vi = int(c.message.validator_index)
+            if vi >= len(state.validators):
+                return False
+            wc = bytes(state.validators[vi].withdrawal_credentials)
+            return (
+                wc[:1] == b"\x00"
+                and wc[1:] == h.sha256(bytes(c.message.from_bls_pubkey))[1:]
+            )
+
+        changes = [
+            c for c in self.bls_changes.values() if change_includable(c)
+        ][: spec.preset.MAX_BLS_TO_EXECUTION_CHANGES]
         return proposer_slashings, attester_slashings, exits, changes
 
     # --------------------------------------------------------- persistence

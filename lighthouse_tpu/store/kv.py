@@ -22,6 +22,7 @@ class Column(str, Enum):
     state = "ste"
     state_summary = "ssm"
     blob = "blo"
+    da_spill = "das"          # DA-checker overflow entries (pending joins)
     beacon_chain = "bch"      # chain-level singletons (head, fork choice…)
     op_pool = "opo"
     eth1 = "et1"

@@ -15,8 +15,11 @@ log() { echo "[$(date +%H:%M:%S)] $*" >> "$LOG"; }
 
 probe() {
   # Backend-init failure is fast-ish and queues no compiles; a trivial jit
-  # compile proves the remote compile path end-to-end.
-  python - <<'EOF' >> "$LOG" 2>&1
+  # compile proves the remote compile path end-to-end. A hung INIT (observed
+  # r4: 22 min blocked in backend setup before UNAVAILABLE) is bounded by
+  # the timeout — killing a stuck init queues nothing server-side, unlike
+  # killing an in-flight compile.
+  timeout 1800 python - <<'EOF' >> "$LOG" 2>&1
 import time
 t0 = time.time()
 from lighthouse_tpu.utils.jaxcfg import setup_compilation_cache

@@ -249,10 +249,7 @@ def test_da_checker_spill_survives_restart_and_prunes_at_finalization(env):
     assert da2._on_disk == {}
     from lighthouse_tpu.store.kv import Column
 
-    leftovers = [
-        k for k, _v in store.blobs_db.iter_column(Column.blob)
-        if k.startswith(b"da-pending:")
-    ]
+    leftovers = list(store.blobs_db.iter_column(Column.da_spill))
     assert leftovers == []
 
 

@@ -82,22 +82,32 @@ def test_block_publish_and_query(api):
 
 def _get(client, path):
     import json
+    import urllib.error
     import urllib.request
 
-    with urllib.request.urlopen(client.base_url + path, timeout=5) as r:
-        return json.loads(r.read().decode())
+    try:
+        with urllib.request.urlopen(client.base_url + path, timeout=5) as r:
+            return json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        e.msg = f"{e.msg}: {e.read().decode()[:500]}"  # surface the body
+        raise
 
 
 def _post(client, path, body):
     import json
+    import urllib.error
     import urllib.request
 
     req = urllib.request.Request(
         client.base_url + path, data=json.dumps(body).encode(),
         headers={"Content-Type": "application/json"}, method="POST",
     )
-    with urllib.request.urlopen(req, timeout=5) as r:
-        return json.loads(r.read().decode() or "{}")
+    try:
+        with urllib.request.urlopen(req, timeout=5) as r:
+            return json.loads(r.read().decode() or "{}")
+    except urllib.error.HTTPError as e:
+        e.msg = f"{e.msg}: {e.read().decode()[:500]}"
+        raise
 
 
 def test_expanded_route_families(api):
@@ -306,3 +316,188 @@ def test_state_balinfo_and_peer_count(api):
     assert rnd.startswith("0x") and len(rnd) == 66
     pc = _get(client, "/eth/v1/node/peer_count")["data"]
     assert "connected" in pc
+
+
+# --------------------------------------------------------- round-4 routes
+
+
+def _http_error(fn):
+    import urllib.error
+
+    try:
+        fn()
+    except urllib.error.HTTPError as e:
+        return e.code
+    raise AssertionError("expected HTTPError")
+
+
+def _extend_with_attestations(harness, chain, n):
+    """Advance the shared chain n blocks with full attestation coverage.
+
+    Earlier tests may have published blocks produced by the CHAIN without
+    applying them to the harness state — resync the harness onto the chain
+    head so production continues the canonical lineage."""
+    if int(harness.state.slot) != int(chain.head_state().slot):
+        harness.state = clone_state(chain.head_state(), chain.spec)
+    for signed in harness.extend_chain(n):
+        slot = int(signed.message.slot)
+        chain.slot_clock.set_slot(slot)
+        chain.per_slot_task()
+        chain.process_block(signed)
+
+
+def test_rewards_block_route(api):
+    harness, chain, client = api
+    _extend_with_attestations(harness, chain, 3)
+    data = _get(client, "/eth/v1/beacon/rewards/blocks/head")["data"]
+    assert int(data["proposer_index"]) < VALIDATORS
+    assert int(data["total"]) == (
+        int(data["attestations"]) + int(data["sync_aggregate"])
+        + int(data["proposer_slashings"]) + int(data["attester_slashings"])
+    )
+    # blocks carry prior-slot attestations -> nonzero proposer reward
+    assert int(data["attestations"]) > 0
+    # unknown block id -> 404
+    assert _http_error(
+        lambda: _get(client, "/eth/v1/beacon/rewards/blocks/0x" + "ee" * 32)
+    ) == 404
+
+
+def test_rewards_attestations_route(api):
+    harness, chain, client = api
+    sp = chain.spec.preset.SLOTS_PER_EPOCH
+    # epoch 0 is judgeable once the head reaches the END of epoch 1
+    need = 2 * sp - 1 - int(chain.head_state().slot)
+    if need > 0:
+        _extend_with_attestations(harness, chain, need)
+    got = _post(client, "/eth/v1/beacon/rewards/attestations/0", [])["data"]
+    assert got["ideal_rewards"], "ideal rewards table must not be empty"
+    assert got["total_rewards"], "per-validator rewards must not be empty"
+    row = got["total_rewards"][0]
+    assert {"validator_index", "head", "target", "source"} <= set(row)
+    # filtered query returns only the requested validator
+    got1 = _post(client, "/eth/v1/beacon/rewards/attestations/0", ["1"])["data"]
+    assert [r["validator_index"] for r in got1["total_rewards"]] == ["1"]
+    # unjudgeable (future) epoch -> 404
+    assert _http_error(
+        lambda: _post(client, "/eth/v1/beacon/rewards/attestations/999", [])
+    ) == 404
+    # malformed body -> 400
+    assert _http_error(
+        lambda: _post(client, "/eth/v1/beacon/rewards/attestations/0", {"x": 1})
+    ) == 400
+
+
+def test_rewards_sync_committee_route(api):
+    harness, chain, client = api
+    got = _post(client, "/eth/v1/beacon/rewards/sync_committee/head", [])["data"]
+    assert got, "sync committee rewards must not be empty"
+    # full participation in the harness: all rewards positive
+    assert all(int(r["reward"]) > 0 for r in got)
+
+
+def test_blinded_block_production_and_publish(api):
+    harness, chain, client = api
+    from lighthouse_tpu.state_transition.slot import process_slots
+    import lighthouse_tpu.state_transition.accessors as acc
+
+    slot = int(chain.head_state().slot) + 1
+    chain.slot_clock.set_slot(slot)
+    chain.per_slot_task()
+    st = clone_state(chain.head_state(), chain.spec)
+    process_slots(st, chain.spec, slot)
+    proposer = acc.get_beacon_proposer_index(st, chain.spec)
+    reveal = harness.randao_reveal(st, proposer, slot // chain.spec.preset.SLOTS_PER_EPOCH)
+    resp = _get(
+        client,
+        f"/eth/v1/validator/blinded_blocks/{slot}?randao_reveal=0x{bytes(reveal).hex()}",
+    )
+    assert resp["execution_payload_blinded"] is True
+    hdr = resp["data"]["message"]["body"]["execution_payload_header"]
+    assert hdr is not None and hdr["block_hash"].startswith("0x")
+    types = types_for_slot(chain.spec, slot)
+    block = types.BeaconBlock.deserialize(bytes.fromhex(resp["data"]["ssz"][2:]))
+    signed = harness.sign_block(block, types)
+    harness.apply_block(signed)
+    _post(
+        client, "/eth/v1/beacon/blinded_blocks",
+        {"ssz": resp["data"]["ssz"], "signature": "0x" + signed.signature.serialize().hex()
+         if hasattr(signed.signature, "serialize") else "0x" + bytes(signed.signature).hex()},
+    )
+    assert int(chain.head_state().slot) == slot
+    # negative: missing signature -> 400
+    assert _http_error(
+        lambda: _post(client, "/eth/v1/beacon/blinded_blocks", {"ssz": "0x00"})
+    ) == 400
+
+
+def test_publish_negative_paths(api):
+    harness, chain, client = api
+    head_before = chain.head_root
+    # undecodable SSZ -> 400, head unchanged
+    assert _http_error(
+        lambda: _post(client, "/eth/v2/beacon/blocks", {"ssz": "0xdeadbeef"})
+    ) == 400
+    # missing body key -> 400
+    assert _http_error(lambda: _post(client, "/eth/v2/beacon/blocks", {})) == 400
+    # a valid-shape block with a garbage signature -> 400 (BlockError)
+    types = types_for_slot(chain.spec, int(chain.head_state().slot))
+    blk = types.SignedBeaconBlock.default()
+    raw = "0x" + types.SignedBeaconBlock.serialize(blk).hex()
+    assert _http_error(
+        lambda: _post(client, "/eth/v2/beacon/blocks", {"ssz": raw})
+    ) == 400
+    assert chain.head_root == head_before
+
+
+def test_deposit_snapshot_route(api):
+    harness, chain, client = api
+    # no cache -> 404
+    assert _http_error(
+        lambda: _get(client, "/eth/v1/beacon/deposit_snapshot")
+    ) == 404
+    from lighthouse_tpu.chain.eth1 import Eth1Block, Eth1Cache
+
+    cache = Eth1Cache()
+    types = types_for_slot(chain.spec, 0)
+    dd = types.DepositData.make(
+        pubkey=b"\xaa" * 48, withdrawal_credentials=b"\x00" * 32,
+        amount=32 * 10**9, signature=b"\x00" * 96,
+    )
+    cache.add_deposit(dd, types)
+    cache.add_block(Eth1Block(number=7, hash=b"\x42" * 32, timestamp=0,
+                              deposit_root=cache.tree.root(), deposit_count=1))
+    chain.eth1_cache = cache
+    snap = _get(client, "/eth/v1/beacon/deposit_snapshot")["data"]
+    assert snap["deposit_count"] == "1"
+    assert snap["execution_block_height"] == "7"
+    assert snap["execution_block_hash"] == "0x" + "42" * 32
+
+
+def test_lc_updates_by_range_route(api):
+    harness, chain, client = api
+    from lighthouse_tpu.chain.light_client import (
+        LightClientServerCache,
+        LightClientUpdate,
+    )
+
+    lc = getattr(chain, "light_client_cache", None) or LightClientServerCache(chain.spec)
+    chain.light_client_cache = lc
+    st = chain.head_state()
+    hdr = st.latest_block_header
+    lc.best_updates[0] = LightClientUpdate(
+        attested_header=hdr,
+        next_sync_committee=st.next_sync_committee,
+        next_sync_committee_branch=[b"\x00" * 32] * 5,
+        finalized_header=hdr,
+        finality_branch=[b"\x00" * 32] * 6,
+        sync_aggregate=None,
+        signature_slot=int(st.slot) + 1,
+    )
+    got = _get(client, "/eth/v1/beacon/light_client/updates?start_period=0&count=2")
+    assert len(got) == 1
+    assert got[0]["data"]["signature_slot"] == str(int(st.slot) + 1)
+    # missing params -> 400
+    assert _http_error(
+        lambda: _get(client, "/eth/v1/beacon/light_client/updates")
+    ) == 400
