@@ -353,6 +353,98 @@ def cmd_validator_create(args):
     return 0
 
 
+def cmd_validator_exit(args):
+    """Submit a VoluntaryExit for a keystore's validator via the Beacon API
+    (account_manager/src/validator/exit.rs flow: unlock keystore -> resolve
+    validator index + genesis data from the BN -> sign with the
+    voluntary-exit domain -> POST to the pool -> optionally wait)."""
+    import json
+    import time as _time
+    import urllib.request
+
+    from .crypto import bls
+    from .crypto import keystore as ks
+    from .types import helpers as th
+    from .types.spec import DOMAIN_VOLUNTARY_EXIT, ForkName, mainnet_spec, minimal_spec
+
+    spec = minimal_spec() if args.preset == "minimal" else mainnet_spec()
+
+    keystore = ks.load_keystore(args.keystore)
+    password = (
+        open(args.password_file).read().strip()
+        if args.password_file
+        else input("Enter the keystore password: ")
+    )
+    sk_bytes = ks.decrypt_keystore(keystore, password)
+    sk = bls.SecretKey(int.from_bytes(sk_bytes, "big"))
+    pk_hex = "0x" + sk.public_key().serialize().hex()
+
+    if not args.no_confirmation:
+        phrase = "Exit my validator"
+        print(f"Publishing a voluntary exit for validator {pk_hex}.")
+        print("WARNING: THIS IS AN IRREVERSIBLE OPERATION.")
+        answer = input(f'Type "{phrase}" to confirm: ')
+        if answer.strip() != phrase:
+            print("aborted")
+            return 1
+
+    def get(path):
+        with urllib.request.urlopen(args.beacon_node + path, timeout=10) as r:
+            return json.loads(r.read().decode())
+
+    genesis = get("/eth/v1/beacon/genesis")["data"]
+    gvr = bytes.fromhex(genesis["genesis_validators_root"][2:])
+    vdata = get(f"/eth/v1/beacon/states/head/validators/{pk_hex}")["data"]
+    validator_index = int(vdata["index"])
+    head_slot = int(get("/eth/v1/node/syncing")["data"]["head_slot"])
+    epoch = head_slot // spec.preset.SLOTS_PER_EPOCH
+
+    from .types.containers import spec_types
+
+    fork = spec.fork_name_at_slot(head_slot)
+    types = spec_types(spec.preset, fork)
+    exit_msg = types.VoluntaryExit.make(epoch=epoch, validator_index=validator_index)
+    # EIP-7044: deneb+ pins the exit domain to the capella fork version;
+    # earlier forks use the fork version at the exit epoch (matching
+    # signature_sets.voluntary_exit_set, the verifier side)
+    if fork >= ForkName.deneb:
+        version = spec.capella_fork_version
+    else:
+        version = spec.fork_version(spec.fork_name_at_epoch(epoch))
+    domain = th.compute_domain(DOMAIN_VOLUNTARY_EXIT, version, gvr)
+    root = th.compute_signing_root(types.VoluntaryExit, exit_msg, domain)
+    sig = bls.sign(sk, root)
+
+    payload = json.dumps(
+        {
+            "message": {
+                "epoch": str(epoch),
+                "validator_index": str(validator_index),
+            },
+            "signature": "0x" + sig.serialize().hex(),
+        }
+    ).encode()
+    req = urllib.request.Request(
+        args.beacon_node + "/eth/v1/beacon/pool/voluntary_exits",
+        data=payload, headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        r.read()
+    print(f"Successfully published voluntary exit for validator {validator_index}")
+
+    if not args.no_wait:
+        # poll until the exit is reflected in the validator's status
+        for _ in range(args.wait_polls):
+            v = get(f"/eth/v1/beacon/states/head/validators/{validator_index}")["data"]
+            exit_epoch = int(v["validator"]["exit_epoch"])
+            if exit_epoch != (1 << 64) - 1:
+                print(f"Exit accepted: validator exits at epoch {exit_epoch}")
+                return 0
+            _time.sleep(args.wait_interval)
+        print("Exit submitted; not yet processed into the state")
+    return 0
+
+
 def cmd_pretty_ssz(args):
     """Decode an SSZ file and pretty-print it (lcli pretty-ssz analog)."""
     import json as _json
@@ -576,6 +668,20 @@ def build_parser() -> argparse.ArgumentParser:
     vcv.add_argument("--seed", default=None, help="hex seed (EIP-2333)")
     vcv.add_argument("--kdf-rounds", type=int, default=262144)
     vcv.set_defaults(fn=cmd_validator_create)
+
+    vex = sub.add_parser(
+        "validator-exit",
+        help="submit a VoluntaryExit for a keystore's validator",
+    )
+    vex.add_argument("--keystore", required=True)
+    vex.add_argument("--password-file", default=None)
+    vex.add_argument("--beacon-node", default="http://localhost:5052")
+    vex.add_argument("--preset", default="mainnet", choices=["mainnet", "minimal"])
+    vex.add_argument("--no-confirmation", action="store_true")
+    vex.add_argument("--no-wait", action="store_true")
+    vex.add_argument("--wait-polls", type=int, default=10)
+    vex.add_argument("--wait-interval", type=float, default=2.0)
+    vex.set_defaults(fn=cmd_validator_exit)
 
     ps = sub.add_parser("pretty-ssz", help="decode + pretty-print an SSZ file")
     _add_spec_arg(ps)

@@ -1,0 +1,119 @@
+"""validator-exit CLI flow end-to-end: EIP-2335 keystore -> signed
+VoluntaryExit (EIP-7044 capella-pinned domain) -> Beacon API pool ->
+packed into a block -> validator's exit_epoch set.
+
+Parity surface: /root/reference/account_manager/src/validator/exit.rs.
+"""
+
+import pytest
+
+from lighthouse_tpu.api.http_api import serve
+from lighthouse_tpu.chain.beacon_chain import BeaconChain
+from lighthouse_tpu.chain.op_pool import OperationPool
+from lighthouse_tpu.cli import main as cli_main
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.crypto import keystore as ks
+from lighthouse_tpu.state_transition.slot import types_for_slot
+from lighthouse_tpu.testing.harness import StateHarness, clone_state
+from lighthouse_tpu.types.spec import minimal_spec
+
+VALIDATORS = 16
+FAR_FUTURE = (1 << 64) - 1
+
+
+@pytest.fixture(scope="module")
+def exit_env(tmp_path_factory):
+    bls.set_backend("python")
+    # shard_committee_period=0 so a freshly-activated validator may exit
+    # without simulating 256 epochs
+    spec = minimal_spec(shard_committee_period=0)
+    harness = StateHarness.new(spec, VALIDATORS)
+    chain = BeaconChain(spec, clone_state(harness.state, spec))
+    op_pool = OperationPool(spec)
+    server, thread, port = serve(chain, op_pool=op_pool)
+    yield harness, chain, op_pool, port, tmp_path_factory.mktemp("exit")
+    server.shutdown()
+
+
+def test_validator_exit_cli_flow(exit_env):
+    harness, chain, op_pool, port, tmp = exit_env
+    vidx = 5
+    sk = harness.sk(vidx)
+
+    keystore = ks.encrypt_keystore(
+        sk.serialize(),
+        "exitpass",
+        pubkey_hex=bytes(harness.state.validators[vidx].pubkey).hex(),
+        kdf_function="pbkdf2",
+        kdf_params={"c": 16, "prf": "hmac-sha256"},
+    )
+    kpath = tmp / "keystore.json"
+    ks.save_keystore(keystore, str(kpath))
+    ppath = tmp / "pass.txt"
+    ppath.write_text("exitpass\n")
+
+    rc = cli_main(
+        [
+            "validator-exit",
+            "--keystore", str(kpath),
+            "--password-file", str(ppath),
+            "--beacon-node", f"http://127.0.0.1:{port}",
+            "--preset", "minimal",
+            "--no-confirmation",
+            "--no-wait",
+        ]
+    )
+    assert rc == 0
+    # the signed exit is in the pool
+    assert vidx in op_pool.voluntary_exits
+    signed_exit = op_pool.voluntary_exits[vidx]
+    assert int(signed_exit.message.validator_index) == vidx
+
+    # pack it into the next block: the chain must accept the signature
+    # (VERIFY_BULK through the real backend) and set the exit epoch
+    slot = int(harness.state.slot) + 1
+    chain.slot_clock.set_slot(slot)
+    chain.per_slot_task()
+    import lighthouse_tpu.state_transition.accessors as acc
+    from lighthouse_tpu.state_transition.slot import process_slots
+
+    st = clone_state(chain.head_state(), chain.spec)
+    process_slots(st, chain.spec, slot)
+    proposer = acc.get_beacon_proposer_index(st, chain.spec)
+    reveal = harness.randao_reveal(st, proposer, slot // chain.spec.preset.SLOTS_PER_EPOCH)
+    block = chain.produce_block(slot, bytes(reveal), op_pool=op_pool)
+    assert len(block.body.voluntary_exits) == 1
+    types = types_for_slot(chain.spec, slot)
+    signed = harness.sign_block(block, types)
+    harness.apply_block(signed)
+    chain.process_block(signed)
+    assert int(chain.head_state().validators[vidx].exit_epoch) != FAR_FUTURE
+
+
+def test_validator_exit_wrong_password(exit_env):
+    harness, chain, op_pool, port, tmp = exit_env
+    vidx = 7
+    sk = harness.sk(vidx)
+    keystore = ks.encrypt_keystore(
+        sk.serialize(),
+        "rightpass",
+        pubkey_hex=bytes(harness.state.validators[vidx].pubkey).hex(),
+        kdf_function="pbkdf2",
+        kdf_params={"c": 16, "prf": "hmac-sha256"},
+    )
+    kpath = tmp / "keystore7.json"
+    ks.save_keystore(keystore, str(kpath))
+    ppath = tmp / "wrongpass.txt"
+    ppath.write_text("wrongpass\n")
+    with pytest.raises(Exception):
+        cli_main(
+            [
+                "validator-exit",
+                "--keystore", str(kpath),
+                "--password-file", str(ppath),
+                "--beacon-node", f"http://127.0.0.1:{port}",
+                "--preset", "minimal",
+                "--no-confirmation", "--no-wait",
+            ]
+        )
+    assert vidx not in op_pool.voluntary_exits
