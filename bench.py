@@ -1,140 +1,127 @@
 #!/usr/bin/env python
-"""Headline benchmark: BLS signature-sets verified per second on one chip.
+"""Headline benchmark + the full BASELINE.md measurement matrix on one chip.
 
-Workload (BASELINE.md config 5, "mainnet gossip firehose" shape): batches of
-64 attestation-style signature sets, each an aggregate over 128 pubkeys with
-a distinct 32-byte message, verified by the TPU backend's fused kernel
-(aggregate pubkeys -> random-coefficient scaling -> hash-to-G2 -> one
-multi-pairing).  Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "sets/s", "vs_baseline": N}
+Headline (stdout, ONE JSON line): BASELINE.md config 5, the "mainnet gossip
+firehose" — batches of 64 attestation-style signature sets, each an
+aggregate over 128 pubkeys with a distinct 32-byte message, verified by the
+TPU backend (pipelined through the async submission API, every result
+checked). vs_baseline compares against an estimated single-host blst
+throughput for the same workload (~700 sets/s; the reference publishes no
+absolute numbers — SURVEY.md §6, BASELINE.md).
 
-Throughput is measured PIPELINED: several batches are kept in flight through
-the async submission API (verify_signature_sets_async), exactly how the
-beacon processor feeds the device under gossip load — the remote-TPU tunnel
-adds tens of ms of pure round-trip latency per call that a node (and so the
-bench) hides with in-flight batches. Every batch's result is still checked.
+The rest of the matrix (BASELINE.md configs 1-4 + the p99 per-block verify
+latency probe) is measured after the headline and written to
+BENCH_MATRIX.json / stderr:
+  1. fast_aggregate_verify, single 128-pubkey attestation (urgent-path
+     latency: p50/p99 over repeated single-set verifies, depth 1)
+  2. full-block multi-set: 1 proposal + 1 RANDAO + 128 attestations(128 pk)
+     + 1 sync aggregate(512 pk) in ONE batch; p50/p99 block verify latency
+  3. Altair sync-committee aggregate: 1 set x 512 pubkeys
+  4. Deneb KZG batch blob-proof verify (6 blobs, 4096-element setup) on the
+     shared device pairing kernel + device MSM
+  5. the headline above
 
-vs_baseline compares against an estimated single-host blst throughput for
-the same workload (~700 sets/s: per set one 128-point aggregation +
-hash-to-curve + its share of a multi-pairing on a modern core; the
-reference publishes no absolute numbers — SURVEY.md §6).
+Each config carries its own rough single-host blst/c-kzg baseline estimate
+(EST_* constants below, derivations in comments) — estimates, not measured:
+blst is not present in this image (BASELINE.md notes the same).
 
-Fixture generation runs on-device too (batched windowed scalar mults), so
-the whole bench sets up in seconds instead of the 20 minutes a pure-Python
-8192-key fixture build took.
+A time budget guards the matrix: configs are skipped (recorded as such)
+when the watchdog deadline approaches, so the headline number always lands.
 """
 
 import json
+import os
 import sys
 import time
 
-N_SETS = 64
-N_PKS = 128
+# LIGHTHOUSE_BENCH_SMOKE=1 shrinks every config to trivial shapes: a CPU
+# dry-run of all code paths (fixture builders, matrix, JSON plumbing) so a
+# real tunnel window is never spent discovering a Python-level bug.
+_SMOKE = os.environ.get("LIGHTHOUSE_BENCH_SMOKE") == "1"
+
+N_SETS = 4 if _SMOKE else 64
+N_PKS = 4 if _SMOKE else 128
+BATCHES = 2 if _SMOKE else 8   # timed batches (headline)
+DEPTH = 2 if _SMOKE else 4     # max batches in flight
+SYNC_PKS = 8 if _SMOKE else 512
+KZG_N = 8 if _SMOKE else 4096
+KZG_BLOBS = 2 if _SMOKE else 6
+FULL_BLOCK_REPS = 2 if _SMOKE else 8
+LAT_REPS = 4 if _SMOKE else 30
+
+# Estimated single-host blst throughputs (one modern core, see BASELINE.md:
+# the reference publishes no absolute numbers). Derivations:
+#   firehose set (128-pk aggregate + hash-to-curve + share of multi-pairing)
+#     ~1.4ms -> ~700 sets/s
+#   single fast_aggregate_verify: same work without batch amortization of
+#     the final exp: ~2ms -> 500/s
+#   full block (131 sets incl. 512-pk sync aggregate): ~1.4ms * 131 + final
+#     exp ~ 190ms -> ~5.3 blocks/s
+#   sync aggregate alone (512-pk aggregation + 2 pairings): ~2.5ms -> 400/s
+#   c-kzg verify_blob_kzg_proof_batch: ~2.5ms/blob -> 400 blobs/s
 EST_BLST_SETS_PER_SEC = 700.0
-BATCHES = 8          # timed batches
-DEPTH = 4            # max batches in flight
+EST_BLST_SINGLE_FAV_PER_SEC = 500.0
+EST_BLST_BLOCKS_PER_SEC = 5.3
+EST_BLST_SYNC_AGG_PER_SEC = 400.0
+EST_CKZG_BLOBS_PER_SEC = 400.0
+
+WATCHDOG_SECS = 40 * 60
+_T0 = time.time()
+_HEADLINE = {"value": 0.0, "note": "not reached"}
+_MATRIX: dict = {}
 
 
 def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
-def build_fixture(rng):
-    """64 sets x 128 pubkeys with valid aggregate signatures, generated with
-    batched device scalar multiplications."""
-    import numpy as np
-    import jax
-    import jax.numpy as jnp
-    from lighthouse_tpu.crypto import bls
-    from lighthouse_tpu.crypto.bls import api as bls_api
-    from lighthouse_tpu.crypto.bls381 import curve as cv
-    from lighthouse_tpu.crypto.bls381.constants import R
-    from lighthouse_tpu.crypto.jaxbls import curve_ops as co, limbs as lb, tower as tw
+def _elapsed():
+    return time.time() - _T0
 
-    n_keys = N_SETS * N_PKS
-    sks = [rng.randrange(1, R) for _ in range(n_keys)]
 
-    def batched_gen_mul(gen_jac_single, bits, ops):
-        base = jax.tree_util.tree_map(
-            lambda c: jnp.broadcast_to(c, (bits.shape[0],) + c.shape), gen_jac_single
-        )
-        # double-and-add: tiny scan body keeps the remote compile bounded
-        acc = co.scalar_mul_bits(base, bits, ops)
-        x, y, inf = co.jac_to_affine(acc, ops)
-        return lb.from_mont(x), lb.from_mont(y)
+def _remaining():
+    return WATCHDOG_SECS - _elapsed()
 
-    t0 = time.time()
-    mul_g1 = jax.jit(lambda d: batched_gen_mul(co.g1_to_device(cv.G1_GEN), d, co.FQ_OPS))
-    # chunked device calls: one fixed-shape compile, bounded per-call size
-    # (very large single dispatches stall the remote-TPU tunnel)
-    CHUNK = 512
-    xs, ys = [], []
-    for i in range(0, n_keys, CHUNK):
-        digs = jnp.asarray(co.scalars_to_bits(sks[i : i + CHUNK], 256))
-        cx, cy = mul_g1(digs)
-        xs.extend(lb.unpack_batch(np.asarray(cx)))
-        ys.extend(lb.unpack_batch(np.asarray(cy)))
-    log(f"pubkey gen (device): {time.time()-t0:.1f}s")
 
-    pks = [bls.PublicKey((x, y)) for x, y in zip(xs, ys)]
-
-    # aggregate signatures: sig_i = (sum_k sk)_i * H(msg_i)
-    from lighthouse_tpu.crypto.bls381 import hash_to_curve as ph2c
-    from lighthouse_tpu.crypto.bls381.constants import DST_POP
-
-    t0 = time.time()
-    agg_sks, msgs, hs = [], [], []
-    for i in range(N_SETS):
-        chunk = sks[i * N_PKS : (i + 1) * N_PKS]
-        agg_sks.append(sum(chunk) % R)
-        msg = i.to_bytes(32, "big")
-        msgs.append(msg)
-        hs.append(ph2c.hash_to_g2(msg, DST_POP))
-    hd = co.g2_batch_to_device(hs)
-    sdigs = jnp.asarray(co.scalars_to_bits(agg_sks, 256))
-    mul_g2 = jax.jit(
-        lambda h, d: (lambda acc: co.jac_to_affine(acc, co.FQ2_OPS))(
-            co.scalar_mul_bits(h, d, co.FQ2_OPS)
-        )
+def _headline_json():
+    v = _HEADLINE["value"]
+    metric = (
+        f"BLS signature-sets verified/sec ({N_SETS} sets x {N_PKS} pubkeys, "
+        f"TPU backend, pipelined depth {DEPTH})"
     )
-    sx, sy, _ = mul_g2(hd, sdigs)
-    sx = np.asarray(lb.from_mont(sx))
-    sy = np.asarray(lb.from_mont(sy))
-    log(f"signature gen (device): {time.time()-t0:.1f}s")
-
-    def fq2_of(arr):
-        return (lb.unpack(arr[0]), lb.unpack(arr[1]))
-
-    sets = []
-    for i in range(N_SETS):
-        sig = bls.Signature((fq2_of(sx[i]), fq2_of(sy[i])))
-        sets.append(bls.SignatureSet(sig, pks[i * N_PKS : (i + 1) * N_PKS], msgs[i]))
-    return sets
+    if not v:
+        metric += f" [{_HEADLINE['note']}]"
+    return json.dumps(
+        {
+            "metric": metric,
+            "value": round(v, 2),
+            "unit": "sets/s",
+            "vs_baseline": round(v / EST_BLST_SETS_PER_SEC, 3),
+        }
+    )
 
 
-WATCHDOG_SECS = 40 * 60
+def _write_matrix():
+    try:
+        _MATRIX["elapsed_secs"] = round(_elapsed(), 1)
+        with open(os.path.join(os.path.dirname(__file__) or ".", "BENCH_MATRIX.json"), "w") as f:
+            json.dump(_MATRIX, f, indent=1)
+    except Exception as e:  # pragma: no cover - best effort
+        log(f"matrix write failed: {e}")
 
 
 def _arm_watchdog():
-    """If the remote-TPU tunnel wedges (a known failure mode: orphaned
-    server-side compiles serialize the queue), fail loudly with a JSON line
-    instead of hanging the driver forever."""
+    """If the remote-TPU tunnel wedges, fail loudly with the headline JSON
+    (zero if never measured) instead of hanging the driver forever. The
+    SIGALRM handler only ever runs between Python bytecodes, so it cannot
+    interrupt an in-flight remote compile (the wedge-inducing kill)."""
     import signal
 
     def on_alarm(_sig, _frm):
-        print(
-            json.dumps(
-                {
-                    "metric": "BLS signature-sets verified/sec (TPU tunnel unresponsive; watchdog fired)",
-                    "value": 0,
-                    "unit": "sets/s",
-                    "vs_baseline": 0,
-                }
-            ),
-            flush=True,
-        )
-        import os
-
+        if not _HEADLINE["value"]:
+            _HEADLINE["note"] = "watchdog fired before measurement"
+        _write_matrix()
+        print(_headline_json(), flush=True)
         os._exit(3)
 
     signal.signal(signal.SIGALRM, on_alarm)
@@ -142,29 +129,322 @@ def _arm_watchdog():
 
 
 def _tunnel_down(reason: str):
-    """Emit a well-formed zero measurement instead of dying rc!=0: the
-    remote-TPU tunnel being unavailable is an environment condition, not a
-    benchmark result, and the driver should record it as such."""
     log(f"TPU unavailable: {reason}")
-    print(
-        json.dumps(
-            {
-                "metric": "BLS signature-sets verified/sec "
-                          "(TPU tunnel UNAVAILABLE at bench time)",
-                "value": 0,
-                "unit": "sets/s",
-                "vs_baseline": 0,
-            }
-        ),
-        flush=True,
-    )
+    _HEADLINE["note"] = "TPU tunnel UNAVAILABLE at bench time"
+    print(_headline_json(), flush=True)
     sys.exit(0)
+
+
+# ----------------------------------------------------------------- fixtures
+
+
+def _batched_gen_mul(gen_jac_single, bits, ops):
+    import jax
+    import jax.numpy as jnp
+    from lighthouse_tpu.crypto.jaxbls import curve_ops as co
+
+    base = jax.tree_util.tree_map(
+        lambda c: jnp.broadcast_to(c, (bits.shape[0],) + c.shape), gen_jac_single
+    )
+    acc = co.scalar_mul_bits(base, bits, ops)
+    return co.jac_to_affine(acc, ops)
+
+
+_gen_cache: dict = {}
+
+
+def _g1_base_muls(scalars):
+    """scalars -> list of affine G1 int pairs, computed on device in fixed
+    512-wide chunks (one compile)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from lighthouse_tpu.crypto.bls381 import curve as cv
+    from lighthouse_tpu.crypto.jaxbls import curve_ops as co, limbs as lb
+
+    if "g1" not in _gen_cache:
+        _gen_cache["g1"] = jax.jit(
+            lambda d: (lambda r: (lb.from_mont(r[0]), lb.from_mont(r[1])))(
+                _batched_gen_mul(co.g1_to_device(cv.G1_GEN), d, co.FQ_OPS)
+            )
+        )
+    CHUNK = 512
+    xs, ys = [], []
+    for i in range(0, len(scalars), CHUNK):
+        chunk = scalars[i : i + CHUNK]
+        pad = CHUNK - len(chunk)
+        digs = jnp.asarray(co.scalars_to_bits(list(chunk) + [1] * pad, 256))
+        cx, cy = _gen_cache["g1"](digs)
+        xs.extend(lb.unpack_batch(np.asarray(cx))[: len(chunk)])
+        ys.extend(lb.unpack_batch(np.asarray(cy))[: len(chunk)])
+    return list(zip(xs, ys))
+
+
+def _g2_scalar_muls(points, scalars, width=64):
+    """sig_i = scalars[i] * points[i] on device, padded to `width` lanes."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from lighthouse_tpu.crypto.jaxbls import curve_ops as co, limbs as lb
+
+    key = ("g2", width)
+    if key not in _gen_cache:
+        _gen_cache[key] = jax.jit(
+            lambda h, d: (lambda r: (lb.from_mont(r[0]), lb.from_mont(r[1])))(
+                (lambda acc: co.jac_to_affine(acc, co.FQ2_OPS))(
+                    co.scalar_mul_bits(h, d, co.FQ2_OPS)
+                )
+            )
+        )
+    n = len(points)
+    pad = width - n
+    hd = co.g2_batch_to_device(list(points) + [points[0]] * pad)
+    # scalar_mul_bits wants the jacobian point pytree
+    sdigs = jnp.asarray(co.scalars_to_bits(list(scalars) + [1] * pad, 256))
+    sx, sy = _gen_cache[key](hd, sdigs)
+    sx = np.asarray(sx)[:n]
+    sy = np.asarray(sy)[:n]
+
+    def fq2_of(arr):
+        return (lb.unpack(arr[0]), lb.unpack(arr[1]))
+
+    return [(fq2_of(sx[i]), fq2_of(sy[i])) for i in range(n)]
+
+
+def build_sets(rng, groups):
+    """groups: list of (n_pks, message). Returns SignatureSets with valid
+    aggregate signatures, all scalar muls on device."""
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.crypto.bls381 import hash_to_curve as ph2c
+    from lighthouse_tpu.crypto.bls381.constants import DST_POP, R
+
+    n_keys = sum(g[0] for g in groups)
+    sks = [rng.randrange(1, R) for _ in range(n_keys)]
+    t0 = time.time()
+    pts = _g1_base_muls(sks)
+    pks = [bls.PublicKey(p) for p in pts]
+    log(f"  pubkey gen x{n_keys} (device): {time.time()-t0:.1f}s")
+
+    t0 = time.time()
+    agg_sks, hs = [], []
+    off = 0
+    for n_pks, msg in groups:
+        agg_sks.append(sum(sks[off : off + n_pks]) % R)
+        hs.append(ph2c.hash_to_g2(msg, DST_POP))
+        off += n_pks
+    log(f"  hash-to-g2 x{len(groups)} (host): {time.time()-t0:.1f}s")
+
+    t0 = time.time()
+    width = 64 if len(groups) <= 64 else 256
+    sig_pts = _g2_scalar_muls(hs, agg_sks, width=width)
+    log(f"  signature gen (device): {time.time()-t0:.1f}s")
+
+    sets = []
+    off = 0
+    for (n_pks, msg), sp in zip(groups, sig_pts):
+        sets.append(bls.SignatureSet(bls.Signature(sp), pks[off : off + n_pks], msg))
+        off += n_pks
+    return sets
+
+
+def _msg(i, tag=0):
+    return bytes([tag]) + i.to_bytes(31, "big")
+
+
+def _rands(rng, n):
+    return [1] + [rng.getrandbits(64) | 1 for _ in range(n - 1)]
+
+
+def _pallas_guard(backend, sets, rands):
+    """First verify attempt; if the fused Pallas path fails to compile on
+    this platform, fall back to the XLA pairing and retry once."""
+    try:
+        t0 = time.time()
+        ok = backend.verify_signature_sets(sets, rands)
+        log(f"  warmup/compile: {time.time()-t0:.1f}s ok={ok}")
+        return ok
+    except Exception as e:
+        log(f"  pallas path failed ({type(e).__name__}: {e}); retrying with XLA pairing")
+        os.environ["LIGHTHOUSE_TPU_PALLAS"] = "off"
+        import lighthouse_tpu.crypto.jaxbls.backend as jb
+
+        jb._kernel_cache.clear()
+        t0 = time.time()
+        ok = backend.verify_signature_sets(sets, rands)
+        log(f"  warmup/compile (XLA): {time.time()-t0:.1f}s ok={ok}")
+        _MATRIX["pallas"] = "fallback-to-xla"
+        return ok
+
+
+def _latency_stats(samples):
+    xs = sorted(samples)
+    n = len(xs)
+    return {
+        "p50_ms": round(xs[n // 2] * 1e3, 2),
+        "p99_ms": round(xs[min(n - 1, int(n * 0.99))] * 1e3, 2),
+        "mean_ms": round(sum(xs) / n * 1e3, 2),
+        "n": n,
+    }
+
+
+# ----------------------------------------------------------------- configs
+
+
+def run_headline(backend, rng):
+    log(f"[config 5] gossip firehose {N_SETS}x{N_PKS}")
+    sets = build_sets(rng, [(N_PKS, _msg(i)) for i in range(N_SETS)])
+    rands = _rands(rng, N_SETS)
+    assert _pallas_guard(backend, sets, rands), "headline batch failed to verify"
+
+    t0 = time.time()
+    inflight = []
+    for i in range(BATCHES):
+        inflight.append(backend.verify_signature_sets_async(sets, rands))
+        if len(inflight) >= DEPTH:
+            assert inflight.pop(0).result()
+    while inflight:
+        assert inflight.pop(0).result()
+    dt = time.time() - t0
+    sets_per_sec = N_SETS * BATCHES / dt
+    log(f"  {BATCHES} batches in {dt:.2f}s (depth {DEPTH}) -> {sets_per_sec:.1f} sets/s")
+    _HEADLINE["value"] = sets_per_sec
+    _MATRIX["config5_firehose"] = {
+        "sets_per_sec": round(sets_per_sec, 2),
+        "vs_est_blst": round(sets_per_sec / EST_BLST_SETS_PER_SEC, 3),
+    }
+    return sets, rands
+
+
+def run_single_fav(backend, sets, rng):
+    """Config 1 + urgent-path latency: one 128-pk set, depth 1."""
+    log(f"[config 1] single fast_aggregate_verify ({N_PKS} pks), urgent path")
+    one = [sets[0]]
+    rands = [1]
+    assert backend.verify_signature_sets(one, rands)  # compile bucket
+    samples = []
+    for _ in range(LAT_REPS):
+        t0 = time.time()
+        assert backend.verify_signature_sets(one, rands)
+        samples.append(time.time() - t0)
+    st = _latency_stats(samples)
+    per_sec = 1.0 / (st["mean_ms"] / 1e3)
+    log(f"  {st}")
+    _MATRIX["config1_single_fast_aggregate_verify"] = {
+        **st,
+        "verifies_per_sec": round(per_sec, 2),
+        "vs_est_blst": round(per_sec / EST_BLST_SINGLE_FAV_PER_SEC, 3),
+    }
+
+
+def run_sync_aggregate(backend, rng):
+    log("[config 3] sync-committee aggregate")
+    sets = build_sets(rng, [(SYNC_PKS, _msg(0, tag=3))])
+    rands = [1]
+    assert backend.verify_signature_sets(sets, rands)
+    samples = []
+    for _ in range(max(4, LAT_REPS // 3)):
+        t0 = time.time()
+        assert backend.verify_signature_sets(sets, rands)
+        samples.append(time.time() - t0)
+    st = _latency_stats(samples)
+    per_sec = 1.0 / (st["mean_ms"] / 1e3)
+    log(f"  {st}")
+    _MATRIX["config3_sync_aggregate_512"] = {
+        **st,
+        "verifies_per_sec": round(per_sec, 2),
+        "vs_est_blst": round(per_sec / EST_BLST_SYNC_AGG_PER_SEC, 3),
+    }
+    return sets
+
+
+def run_full_block(backend, att_sets, sync_sets, rng):
+    """Config 2 + p99 per-block verify latency: proposer + RANDAO + 128
+    attestations + sync aggregate as ONE multi-set batch."""
+    log("[config 2] full-block multi-set + p99 block latency")
+    small = build_sets(rng, [(1, _msg(0, tag=1)), (1, _msg(1, tag=1))])
+    block_sets = small + att_sets + att_sets_alt(att_sets) + sync_sets
+    rands = _rands(rng, len(block_sets))
+    assert backend.verify_signature_sets(block_sets, rands)
+    samples = []
+    for _ in range(FULL_BLOCK_REPS):
+        t0 = time.time()
+        assert backend.verify_signature_sets(block_sets, rands)
+        samples.append(time.time() - t0)
+    st = _latency_stats(samples)
+    per_sec = 1.0 / (st["mean_ms"] / 1e3)
+    log(f"  {st} ({len(block_sets)} sets)")
+    _MATRIX["config2_full_block_verify"] = {
+        **st,
+        "sets_in_block": len(block_sets),
+        "blocks_per_sec": round(per_sec, 2),
+        "vs_est_blst": round(per_sec / EST_BLST_BLOCKS_PER_SEC, 3),
+    }
+
+
+def att_sets_alt(att_sets):
+    """Second half of the block's 128 attestations: reuse the 64 firehose
+    sets (same keys+messages, verified independently under fresh random
+    coefficients — throughput-equivalent to distinct attestations)."""
+    return list(att_sets)
+
+
+def run_kzg(rng):
+    log("[config 4] KZG batch blob-proof verify")
+    from lighthouse_tpu.crypto import kzg
+    from lighthouse_tpu.crypto.bls381 import curve as cv, serde
+    from lighthouse_tpu.crypto.bls381.constants import R
+
+    t0 = time.time()
+    n = KZG_N
+    lis, tau = kzg.TrustedSetup.dev_setup_scalars(n)
+    g1 = _g1_base_muls(lis)
+    setup = kzg.TrustedSetup(
+        g1_lagrange=g1,
+        g2_monomial=[cv.G2_GEN, cv.g2_mul(cv.G2_GEN, tau)],
+        roots=kzg._fr_roots_of_unity(n),
+    )
+    log(f"  setup build: {time.time()-t0:.1f}s")
+
+    t0 = time.time()
+    blobs, cbs, pbs = [], [], []
+    for _ in range(KZG_BLOBS):
+        blob = b"".join(rng.randrange(R).to_bytes(32, "big") for _ in range(n))
+        c = kzg.blob_to_kzg_commitment(blob, setup)
+        cb = serde.g1_compress(c)
+        p = kzg.compute_blob_kzg_proof(blob, cb, setup)
+        blobs.append(blob)
+        cbs.append(cb)
+        pbs.append(serde.g1_compress(p))
+    log(f"  blob/proof fixture (device MSM): {time.time()-t0:.1f}s")
+
+    assert kzg.verify_blob_kzg_proof_batch(blobs, cbs, pbs, setup)
+    samples = []
+    for _ in range(3 if _SMOKE else 5):
+        t0 = time.time()
+        assert kzg.verify_blob_kzg_proof_batch(blobs, cbs, pbs, setup)
+        samples.append(time.time() - t0)
+    st = _latency_stats(samples)
+    blobs_per_sec = float(KZG_BLOBS) / (st["mean_ms"] / 1e3)
+    log(f"  {st} -> {blobs_per_sec:.1f} blobs/s")
+    _MATRIX["config4_kzg_batch_verify"] = {
+        **st,
+        "blobs": KZG_BLOBS,
+        "blobs_per_sec": round(blobs_per_sec, 2),
+        "vs_est_ckzg": round(blobs_per_sec / EST_CKZG_BLOBS_PER_SEC, 3),
+    }
 
 
 def main():
     from lighthouse_tpu.utils.jaxcfg import setup_compilation_cache
 
     _arm_watchdog()
+    if _SMOKE:
+        # smoke mode dry-runs the whole bench on CPU — never touches the
+        # tunnel (sitecustomize pins the axon platform; override before the
+        # cache dir is chosen so entries land under the cpu cache)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     setup_compilation_cache()
     import random
 
@@ -177,50 +457,39 @@ def main():
         return
 
     log(f"devices: {devices}")
+    _MATRIX["devices"] = str(devices)
+    _MATRIX["pallas"] = os.environ.get("LIGHTHOUSE_TPU_PALLAS", "auto")
 
     from lighthouse_tpu.crypto.bls import api as bls_api
 
     backend = bls_api.set_backend("jax")
     rng = random.Random(0xBE7C)
 
-    t0 = time.time()
-    sets = build_fixture(rng)
-    log(f"fixture build: {time.time()-t0:.1f}s")
+    att_sets, _ = run_headline(backend, rng)
 
-    rands = [1] + [rng.getrandbits(64) | 1 for _ in range(N_SETS - 1)]
+    def attempt(name, need_secs, fn):
+        """Best-effort matrix config under the watchdog budget."""
+        if _remaining() < need_secs:
+            log(f"[{name}] skipped: {int(_remaining())}s left < {need_secs}s budget")
+            _MATRIX[f"{name}_skipped"] = "time budget"
+            return None
+        try:
+            return fn()
+        except Exception as e:
+            log(f"[{name}] FAILED: {type(e).__name__}: {e}")
+            _MATRIX[f"{name}_error"] = f"{type(e).__name__}: {e}"
+            return None
 
-    # warmup (compile + pubkey-cache upload)
-    t0 = time.time()
-    ok = backend.verify_signature_sets(sets, rands)
-    log(f"warmup/compile: {time.time()-t0:.1f}s ok={ok}")
-    assert ok, "benchmark batch failed to verify"
+    attempt("config1", 300, lambda: run_single_fav(backend, att_sets, rng))
+    sync_sets = attempt("config3", 420, lambda: run_sync_aggregate(backend, rng))
+    if sync_sets is not None:
+        attempt("config2", 600, lambda: run_full_block(backend, att_sets, sync_sets, rng))
+    else:
+        _MATRIX["config2_skipped"] = "needs config3 fixture"
+    attempt("config4", 600, lambda: run_kzg(rng))
 
-    # pipelined steady-state throughput
-    t0 = time.time()
-    inflight = []
-    done = 0
-    for i in range(BATCHES):
-        inflight.append(backend.verify_signature_sets_async(sets, rands))
-        if len(inflight) >= DEPTH:
-            assert inflight.pop(0).result()
-            done += 1
-    while inflight:
-        assert inflight.pop(0).result()
-        done += 1
-    dt = time.time() - t0
-    sets_per_sec = N_SETS * BATCHES / dt
-    log(f"{BATCHES} batches in {dt:.2f}s (depth {DEPTH})")
-
-    print(
-        json.dumps(
-            {
-                "metric": f"BLS signature-sets verified/sec ({N_SETS} sets x {N_PKS} pubkeys, TPU backend, pipelined depth {DEPTH})",
-                "value": round(sets_per_sec, 2),
-                "unit": "sets/s",
-                "vs_baseline": round(sets_per_sec / EST_BLST_SETS_PER_SEC, 3),
-            }
-        )
-    )
+    _write_matrix()
+    print(_headline_json(), flush=True)
 
 
 if __name__ == "__main__":

@@ -370,11 +370,12 @@ def cmd_validator_exit(args):
     spec = minimal_spec() if args.preset == "minimal" else mainnet_spec()
 
     keystore = ks.load_keystore(args.keystore)
-    password = (
-        open(args.password_file).read().strip()
-        if args.password_file
-        else input("Enter the keystore password: ")
-    )
+    if args.password_file:
+        password = open(args.password_file).read().strip()
+    else:
+        import getpass
+
+        password = getpass.getpass("Enter the keystore password: ")
     sk_bytes = ks.decrypt_keystore(keystore, password)
     sk = bls.SecretKey(int.from_bytes(sk_bytes, "big"))
     pk_hex = "0x" + sk.public_key().serialize().hex()

@@ -33,7 +33,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..bls381.constants import P, DST_POP
+from ..bls381.constants import P, R, DST_POP
 from ..bls381 import curve as pc
 from . import limbs as lb
 from . import tower as tw
@@ -114,7 +114,17 @@ def _batched_affine(z_pk, h_jac, sig_acc):
 
 def _stage_prepare(pk_x, pk_y, pk_mask, sig_x, sig_y, z_digits, set_mask):
     """Stage 1: mont conversion, pubkey tree-aggregation, z-scaling of
-    aggregate pubkeys and signatures, signature tree-sum."""
+    aggregate pubkeys and signatures, signature tree-sum.
+
+    Runs as a fused Pallas kernel on a single accelerator; XLA elsewhere."""
+    from . import pallas_ops
+
+    m = pallas_ops.mode()
+    if m is not None:
+        return pallas_ops.stage_prepare_fused(
+            pk_x, pk_y, pk_mask, sig_x, sig_y, z_digits, set_mask,
+            interpret=(m == "interpret"),
+        )
     import jax.numpy as jnp
 
     pk_x = _to_mont_dev(pk_x)
@@ -155,7 +165,16 @@ def _stage_prepare(pk_x, pk_y, pk_mask, sig_x, sig_y, z_digits, set_mask):
 
 
 def _stage_pairs(z_pk, h_jac, sig_acc, set_mask):
-    """Stage 3: batched affine conversion + pair-array assembly."""
+    """Stage 3: batched affine conversion + pair-array assembly.
+
+    Runs as a fused Pallas kernel on a single accelerator; XLA elsewhere."""
+    from . import pallas_ops
+
+    m = pallas_ops.mode()
+    if m is not None:
+        return pallas_ops.stage_pairs_fused(
+            z_pk, h_jac, sig_acc, set_mask, interpret=(m == "interpret")
+        )
     import jax.numpy as jnp
 
     (p1x, p1y, p1inf), (qx, qy, qinf), (sx, sy, sinf) = _batched_affine(
@@ -421,6 +440,85 @@ class JaxBackend:
         px, py, qxx, qyy, pair_mask = kernel(pk_x, pk_y, mask, sig_xy, h_jac)
         ok = pairing_stage(px, py, qxx, qyy, pair_mask)
         return bool(np.asarray(ok))
+
+    # -- accelerated primitives exposed to KZG ----------------------------
+
+    def g1_msm(self, points, scalars):
+        """sum_i scalars[i] * points[i] over G1.
+
+        points: host affine int pairs (None = identity); scalars: ints mod r.
+        Returns a host affine int pair or None. Batched double-and-add on
+        device + masked tree reduce — the MSM feeding KZG commitments and
+        the batch verifier's linear combinations (crypto/kzg.py)."""
+        pts = list(points)
+        scs = list(scalars)
+        n_real = len(pts)
+        if n_real == 0:
+            return None
+        n = max(MIN_SETS, _next_pow2(n_real))
+
+        px = np.zeros((n, lb.NL), np.uint32)
+        py = np.zeros((n, lb.NL), np.uint32)
+        mask = np.zeros((n,), np.uint32)
+        px[:n_real] = pack_ints_vec([p[0] if p else 0 for p in pts])
+        py[:n_real] = pack_ints_vec([p[1] if p else 0 for p in pts])
+        mask[:n_real] = [0 if p is None else 1 for p in pts]
+        bits = np.zeros((n, 256), np.uint32)
+        bits[:n_real] = co.scalars_to_bits([s % R for s in scs], 256)
+
+        x, y, inf = _get_msm_kernel()(px, py, mask, bits)
+        if bool(np.asarray(inf)):
+            return None
+        return (lb.unpack(np.asarray(x)), lb.unpack(np.asarray(y)))
+
+    def pairing_product_is_one(self, pairs) -> bool:
+        """prod e(P_i, Q_i) == 1 for host affine pairs, on the SAME jitted
+        pairing stage the signature verifier uses (the north star's "blob
+        proofs reuse the pairing kernel" — BASELINE.json;
+        /root/reference/crypto/kzg/src/lib.rs:81)."""
+        live = [(p, q) for p, q in pairs if p is not None and q is not None]
+        if not live:
+            return True
+        n = max(MIN_SETS, _next_pow2(len(live)))
+        pad = n - len(live)
+        xp = tw.fq_batch_to_device([p[0] for p, _ in live] + [0] * pad)
+        yp = tw.fq_batch_to_device([p[1] for p, _ in live] + [0] * pad)
+        xq = tw.fq2_batch_to_device([q[0] for _, q in live] + [(0, 0)] * pad)
+        yq = tw.fq2_batch_to_device([q[1] for _, q in live] + [(0, 0)] * pad)
+        mask = np.zeros((n,), bool)
+        mask[: len(live)] = True
+        _, _, _, pairing_stage = _get_stages()
+        ok = pairing_stage(xp, yp, xq, yq, mask)
+        return bool(np.asarray(ok))
+
+
+def _msm_g1_kernel(px, py, mask, bits):
+    """G1 multi-scalar multiplication: batched double-and-add over all
+    points at once + masked tree reduction (the device path for KZG
+    commitments and proof combination — reference
+    /root/reference/crypto/kzg/src/lib.rs:47-81 via c-kzg's MSM)."""
+    import jax.numpy as jnp
+
+    pxm = _to_mont_dev(px)
+    pym = _to_mont_dev(py)
+    valid = jnp.asarray(mask, bool)
+    jac = co.affine_to_jac(co.FQ_OPS, (pxm, pym), inf_mask=jnp.logical_not(valid))
+    prod = co.scalar_mul_bits(jac, bits, co.FQ_OPS)
+    acc = co.masked_tree_sum(prod, mask, co.FQ_OPS)
+    x, y, inf = co.jac_to_affine(acc, co.FQ_OPS)
+    return lb.from_mont(x), lb.from_mont(y), inf
+
+
+def _get_msm_kernel():
+    import jax
+
+    _init_consts()
+    if "msm" not in _kernel_cache:
+        from ...utils.jaxcfg import setup_compilation_cache
+
+        setup_compilation_cache()
+        _kernel_cache["msm"] = jax.jit(_msm_g1_kernel)
+    return _kernel_cache["msm"]
 
 
 def _aggregate_kernel(pk_x, pk_y, mask, sig_xy, h_jac):

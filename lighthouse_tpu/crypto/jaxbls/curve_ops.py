@@ -22,13 +22,32 @@ from . import tower as tw
 
 
 class _Ops:
-    """Field-generic namespace so G1 (Fq) and G2 (Fq2) share point formulas."""
+    """Field-generic namespace so G1 (Fq) and G2 (Fq2) share point formulas.
 
-    __slots__ = ("add", "sub", "mul", "sqr", "neg", "small", "select", "inv", "is_zero", "eq", "zero", "one")
+    `zero`/`one` are PROPERTIES: zero materializes fresh (a broadcast of the
+    scalar 0 — never a captured array), one routes through
+    limbs.kernel_const so Pallas kernel bodies read it from a real input
+    instead of closing over a module-level device constant."""
 
-    def __init__(self, **kw):
+    __slots__ = (
+        "add", "sub", "mul", "sqr", "neg", "small", "select", "inv",
+        "is_zero", "eq", "_zero_shape", "_one_name", "_one_np",
+    )
+
+    def __init__(self, *, zero_shape, one_name, one_np, **kw):
         for k, v in kw.items():
             setattr(self, k, v)
+        self._zero_shape = zero_shape
+        self._one_name = one_name
+        self._one_np = one_np
+
+    @property
+    def zero(self):
+        return jnp.zeros(self._zero_shape, jnp.uint32)
+
+    @property
+    def one(self):
+        return lb.kernel_const(self._one_name, self._one_np)
 
 
 def _fq_select(cond, a, b):
@@ -38,13 +57,15 @@ def _fq_select(cond, a, b):
 FQ_OPS = _Ops(
     add=lb.add_mod, sub=lb.sub_mod, mul=lb.mont_mul, sqr=lb.mont_sqr,
     neg=lb.neg_mod, small=lb.mul_small, select=_fq_select, inv=lb.mont_inv,
-    is_zero=lb.is_zero, eq=lb.eq, zero=tw.FQ_ZERO, one=tw.FQ_ONE,
+    is_zero=lb.is_zero, eq=lb.eq,
+    zero_shape=(lb.NL,), one_name="FQ_ONE", one_np=tw._mont_const(1),
 )
 
 FQ2_OPS = _Ops(
     add=lb.add_mod, sub=lb.sub_mod, mul=tw.fq2_mul, sqr=tw.fq2_sqr,
     neg=lb.neg_mod, small=lb.mul_small, select=tw.fq2_select, inv=tw.fq2_inv,
-    is_zero=tw.fq2_is_zero, eq=tw.fq2_eq, zero=tw.FQ2_ZERO, one=tw.FQ2_ONE,
+    is_zero=tw.fq2_is_zero, eq=tw.fq2_eq,
+    zero_shape=(2, lb.NL), one_name="FQ2_ONE", one_np=tw._FQ2_ONE_NP,
 )
 
 
@@ -75,8 +96,10 @@ def _stk(ops, *els):
 
 
 def _lanes(ops, stacked, k):
-    axis = stacked.ndim - (1 if ops is FQ_OPS else 2) - 1
-    return tuple(jnp.take(stacked, i, axis=axis) for i in range(k))
+    # static integer indexing (a squeeze-slice) instead of jnp.take: take
+    # lowers through gather, which Mosaic cannot ingest in kernel bodies
+    tail = (slice(None),) * (1 if ops is FQ_OPS else 2)
+    return tuple(stacked[(Ellipsis, i) + tail] for i in range(k))
 
 
 def jac_double(p, ops):
@@ -212,6 +235,9 @@ def scalar_mul_static(p_jac, k: int, ops):
         X, Y, Z = p_jac
         p_jac = (X, ops.neg(Y), Z)
         k = -k
+    impl = lb.kernel_impl(("scalar_mul_static", k))
+    if impl is not None:
+        return impl(p_jac, ops)
     bits = jnp.asarray(np.array([int(b) for b in bin(k)[2:]], np.uint32))
 
     def body(acc, bit):
@@ -304,7 +330,10 @@ def _psi_consts():
     if not _PSI_CONSTS:
         _PSI_CONSTS["cx"] = np.asarray(tw._fq2_const_np(pc.PSI_CX))
         _PSI_CONSTS["cy"] = np.asarray(tw._fq2_const_np(pc.PSI_CY))
-    return jnp.asarray(_PSI_CONSTS["cx"]), jnp.asarray(_PSI_CONSTS["cy"])
+    return (
+        lb.kernel_const("PSI_CX", _PSI_CONSTS["cx"]),
+        lb.kernel_const("PSI_CY", _PSI_CONSTS["cy"]),
+    )
 
 
 def psi_jac(p):

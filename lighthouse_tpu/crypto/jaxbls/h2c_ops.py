@@ -30,12 +30,35 @@ from . import curve_ops as co
 Q = P * P  # order of Fq2
 
 # ------------------------------------------------------------ constants
+# Host np masters + kernel_const accessors: Pallas kernel bodies receive
+# these as real inputs (limbs.kernel_const), the XLA path materializes them
+# as ordinary device constants.
 
-ISO_A = tw.fq2_to_device(ph2c.ISO_A)
-ISO_B = tw.fq2_to_device(ph2c.ISO_B)
-ISO_Z = tw.fq2_to_device(ph2c.ISO_Z)
-_NEG_A = tw.fq2_to_device(pyf.fq2_neg(ph2c.ISO_A))
-_ZA = tw.fq2_to_device(pyf.fq2_mul(ph2c.ISO_Z, ph2c.ISO_A))
+_ISO_A_NP = np.asarray(tw._fq2_const_np(ph2c.ISO_A))
+_ISO_B_NP = np.asarray(tw._fq2_const_np(ph2c.ISO_B))
+_ISO_Z_NP = np.asarray(tw._fq2_const_np(ph2c.ISO_Z))
+_NEG_A_NP = np.asarray(tw._fq2_const_np(pyf.fq2_neg(ph2c.ISO_A)))
+_ZA_NP = np.asarray(tw._fq2_const_np(pyf.fq2_mul(ph2c.ISO_Z, ph2c.ISO_A)))
+
+
+def ISO_A_c():
+    return lb.kernel_const("ISO_A", _ISO_A_NP)
+
+
+def ISO_B_c():
+    return lb.kernel_const("ISO_B", _ISO_B_NP)
+
+
+def ISO_Z_c():
+    return lb.kernel_const("ISO_Z", _ISO_Z_NP)
+
+
+def _NEG_A_c():
+    return lb.kernel_const("ISO_NEG_A", _NEG_A_NP)
+
+
+def _ZA_c():
+    return lb.kernel_const("ISO_ZA", _ZA_NP)
 
 # sqrt_ratio exponent: s = u * v^7 * (u * v^15)^E with E = (q-9)/16 gives
 # s^2 = omega * u/v for an 8th root of unity omega.
@@ -65,25 +88,31 @@ for w in _NQR_OMEGAS:
     c = pyf.fq2_sqrt(pyf.fq2_mul(ph2c.ISO_Z, _py_inv(w)))
     assert c is not None, "Z/omega must be square for primitive 8th roots"
     _CANDS.append(c)
-CAND_CONSTS = jnp.asarray(np.stack([np.asarray(tw.fq2_to_device(c)) for c in _CANDS]))
+_CAND_CONSTS_NP = np.stack([np.asarray(tw._fq2_const_np(c)) for c in _CANDS])
+
+
+def CAND_CONSTS_c():
+    return lb.kernel_const("H2C_CANDS", _CAND_CONSTS_NP)
 
 # Isogeny coefficient matrix: 4 polynomials x 4 coefficients (padded), in the
 # shared monomial basis [xd^3, xn*xd^2, xn^2*xd, xn^3].
 def _poly4(coeffs):
     cs = list(coeffs) + [(0, 0)] * (4 - len(coeffs))
-    return np.stack([np.asarray(tw.fq2_to_device(c)) for c in cs])
+    return np.stack([np.asarray(tw._fq2_const_np(c)) for c in cs])
 
 
-ISO_K = jnp.asarray(
-    np.stack(
-        [
-            _poly4(ph2c.X_NUM),
-            _poly4(ph2c.X_DEN),
-            _poly4(ph2c.Y_NUM),
-            _poly4(ph2c.Y_DEN),
-        ]
-    )
+_ISO_K_NP = np.stack(
+    [
+        _poly4(ph2c.X_NUM),
+        _poly4(ph2c.X_DEN),
+        _poly4(ph2c.Y_NUM),
+        _poly4(ph2c.Y_DEN),
+    ]
 )  # (4 polys, 4 coeffs, 2, NL)
+
+
+def ISO_K_c():
+    return lb.kernel_const("ISO_K", _ISO_K_NP)
 
 
 # ------------------------------------------------------------ device pieces
@@ -142,6 +171,16 @@ def fq2_sgn0(a):
     return s0 | (jnp.asarray(z0, jnp.uint32) & s1)
 
 
+def _pow_e(a):
+    """a^E with E = (q-9)/16 — the one 761-bit exponentiation in SSWU.
+    Pallas kernel bodies plant a ref-reading loop ("POW_E"); the XLA path
+    uses the windowed static form."""
+    impl = lb.kernel_impl("POW_E")
+    if impl is not None:
+        return impl(a)
+    return fq2_pow_static(a, _E_BITS)
+
+
 def fq2_sqrt_ratio(u, v):
     """RFC 9380-style sqrt_ratio for Fq2 (q = p^2 ≡ 9 mod 16).
 
@@ -153,17 +192,24 @@ def fq2_sqrt_ratio(u, v):
     v7 = tw.fq2_mul(v4, tw.fq2_mul(v2, v))
     v15 = tw.fq2_mul(v8, v7)
     uv15 = tw.fq2_mul(u, v15)
-    s = tw.fq2_mul(tw.fq2_mul(u, v7), fq2_pow_static(uv15, _E_BITS))
+    s = tw.fq2_mul(tw.fq2_mul(u, v7), _pow_e(uv15))
 
-    ys = tw.fq2_mul(s[..., None, :, :], CAND_CONSTS)          # (..., 8, 2, NL)
+    ys = tw.fq2_mul(s[..., None, :, :], CAND_CONSTS_c())      # (..., 8, 2, NL)
     checks = tw.fq2_mul(tw.fq2_sqr(ys), v[..., None, :, :])   # y^2 * v
-    zu = tw.fq2_mul(jnp.broadcast_to(ISO_Z, u.shape), u)
+    zu = tw.fq2_mul(jnp.broadcast_to(ISO_Z_c(), u.shape), u)
     ok_qr = tw.fq2_eq(checks[..., :4, :, :], u[..., None, :, :])
     ok_nqr = tw.fq2_eq(checks[..., 4:, :, :], zu[..., None, :, :])
-    ok = jnp.concatenate([ok_qr, ok_nqr], axis=-1)            # (..., 8)
     is_qr = jnp.any(ok_qr, axis=-1)
-    idx = jnp.argmax(ok, axis=-1)                             # first matching
-    y = jnp.take_along_axis(ys, idx[..., None, None, None], axis=-3)[..., 0, :, :]
+
+    # first matching candidate via 8 unrolled masked selects (argmax +
+    # take_along_axis lowered to a gather, which Mosaic rejects in kernels)
+    ok = jnp.concatenate([ok_qr, ok_nqr], axis=-1)            # (..., 8)
+    y = jnp.zeros_like(u)
+    found = jnp.zeros(ok.shape[:-1], bool)
+    for i in range(8):
+        sel = jnp.logical_and(ok[..., i], jnp.logical_not(found))
+        y = tw.fq2_select(sel, ys[..., i, :, :], y)
+        found = jnp.logical_or(found, ok[..., i])
     return is_qr, y
 
 
@@ -172,16 +218,16 @@ def sswu_projective(u):
 
     Returns (xn, xd, y): affine x = xn/xd on E2', y affine."""
     shape = u.shape
-    Z = jnp.broadcast_to(ISO_Z, shape)
-    A = jnp.broadcast_to(ISO_A, shape)
-    B = jnp.broadcast_to(ISO_B, shape)
+    Z = jnp.broadcast_to(ISO_Z_c(), shape)
+    A = jnp.broadcast_to(ISO_A_c(), shape)
+    B = jnp.broadcast_to(ISO_B_c(), shape)
 
     u2 = tw.fq2_sqr(u)
     tv1 = tw.fq2_mul(Z, u2)
     tv2 = tw.fq2_add(tw.fq2_sqr(tv1), tv1)
-    x1n = tw.fq2_mul(B, tw.fq2_add(tv2, jnp.broadcast_to(tw.FQ2_ONE, shape)))
-    xd = tw.fq2_mul(jnp.broadcast_to(_NEG_A, shape), tv2)
-    xd = tw.fq2_select(tw.fq2_is_zero(xd), jnp.broadcast_to(_ZA, shape), xd)
+    x1n = tw.fq2_mul(B, tw.fq2_add(tv2, jnp.broadcast_to(tw.fq2_one(), shape)))
+    xd = tw.fq2_mul(jnp.broadcast_to(_NEG_A_c(), shape), tv2)
+    xd = tw.fq2_select(tw.fq2_is_zero(xd), jnp.broadcast_to(_ZA_c(), shape), xd)
 
     xd2 = tw.fq2_sqr(xd)
     xd3 = tw.fq2_mul(xd2, xd)
@@ -217,7 +263,7 @@ def iso_map_jacobian(xn, xd, y):
         ],
         axis=-3,
     )  # (..., 4, 2, NL)
-    terms = tw.fq2_mul(ISO_K, m[..., None, :, :, :])          # (..., 4, 4, 2, NL)
+    terms = tw.fq2_mul(ISO_K_c(), m[..., None, :, :, :])      # (..., 4, 4, 2, NL)
     sums = lb.add_mod(
         lb.add_mod(terms[..., 0, :, :], terms[..., 1, :, :]),
         lb.add_mod(terms[..., 2, :, :], terms[..., 3, :, :]),
@@ -266,6 +312,14 @@ def hash_to_field_batch(messages, dst: bytes) -> np.ndarray:
 
 def hash_to_g2_jacobian(us):
     """Device: (n, 2, 2, NL) STANDARD-form u-values -> batched Jacobian G2
-    points (converts to Montgomery on device first)."""
-    us = lb.mont_mul(us, jnp.broadcast_to(lb.R2, us.shape))
+    points (converts to Montgomery on device first).
+
+    On a single accelerator the whole map runs as a fused Pallas kernel
+    (pallas_ops.hash_to_g2_fused); plain XLA elsewhere."""
+    from . import pallas_ops
+
+    m = pallas_ops.mode()
+    if m is not None:
+        return pallas_ops.hash_to_g2_fused(us, interpret=(m == "interpret"))
+    us = lb.to_mont(us)
     return map_to_g2(us[:, 0], us[:, 1])

@@ -66,7 +66,6 @@ N_HOST = pack(P)
 N_EXT_HOST = np.concatenate([N_HOST, np.zeros(1, np.uint32)])
 R2 = jnp.asarray(pack(R2_INT))
 ZERO = jnp.zeros((NL,), U32)
-ONE_STD = jnp.asarray(pack(1))
 ONE_MONT = jnp.asarray(pack(R_MONT))
 
 
@@ -87,6 +86,75 @@ ONE_MONT = jnp.asarray(pack(R_MONT))
 # --------------------------------------------------------------------------
 
 _FAST = True
+
+# Kogge-Stone carry form for Pallas kernel bodies: Mosaic has no reliable
+# lowering for cumsum/cummax (the closed-form prefix), but handles the
+# log2(n) rounds of static lane shifts + logicals fine — and inside a fused
+# kernel the extra instruction count stays in VMEM/registers instead of
+# round-tripping HBM, so the XLA-compile-time argument against Kogge-Stone
+# does not apply there. Thread-local because kernel warming traces several
+# programs from parallel threads and the Pallas routing must not leak into
+# a concurrently-traced XLA program.
+import threading
+
+_TLS = threading.local()
+
+
+def _pallas_tracing() -> bool:
+    return getattr(_TLS, "pallas", False)
+
+
+def kernel_impl(name):
+    """Kernel-body implementation overrides (same mechanism as
+    kernel_const, for CODE): long pow/scalar-mul loops need their bit
+    patterns as SMEM refs inside Pallas kernels, so wrappers plant
+    ref-reading loop implementations that the shared tower/curve code
+    dispatches to while tracing a kernel body. Returns None outside."""
+    tab = getattr(_TLS, "impl_tab", None)
+    if tab is None:
+        return None
+    return tab.get(name)
+
+
+def kernel_const(name: str, default_np):
+    """Field constants inside Pallas kernel bodies.
+
+    Pallas rejects kernels that close over array constants ("captures
+    constants ... pass them as inputs"), and every mont_mul trace references
+    the modulus constants — so kernel wrappers pass them as real inputs and
+    plant the loaded values in a thread-local table (via `pallas_mode`);
+    this accessor is what the arithmetic consults. Outside kernel tracing it
+    materializes the ordinary jnp constant."""
+    tab = getattr(_TLS, "const_tab", None)
+    if tab is not None and name in tab:
+        return tab[name]
+    return jnp.asarray(default_np)
+
+
+class pallas_mode:
+    """Context manager active while TRACING Pallas kernel bodies: routes
+    limb products through the shift-accumulate form (`_poly_mul_shift` —
+    Mosaic lowers static lane shifts well, gathers/one-hot matmuls poorly)
+    and carries through the Kogge-Stone prefix (no cumsum/cummax). An
+    optional constants table redirects `kernel_const` lookups to values the
+    kernel received as inputs."""
+
+    def __init__(self, const_tab=None, impl_tab=None):
+        self._tab = const_tab
+        self._impls = impl_tab
+
+    def __enter__(self):
+        self._prev = (
+            getattr(_TLS, "pallas", False),
+            getattr(_TLS, "const_tab", None),
+            getattr(_TLS, "impl_tab", None),
+        )
+        _TLS.pallas = True
+        _TLS.const_tab = self._tab
+        _TLS.impl_tab = self._impls
+
+    def __exit__(self, *exc):
+        _TLS.pallas, _TLS.const_tab, _TLS.impl_tab = self._prev
 
 
 class fast_mode:
@@ -144,7 +212,10 @@ def _prefix_carry(g, p):
       G[k]   = S[k] + best[k] == 0
     TWO scan primitives + elementwise — replaces the Kogge-Stone form whose
     log2(NL) shift rounds emitted ~10x the HLO (slices/concats dominated
-    kernel compile time on both CPU and TPU)."""
+    kernel compile time on both CPU and TPU). Inside Pallas bodies the
+    Kogge-Stone form is used instead (`pallas_mode`)."""
+    if _pallas_tracing():
+        return _prefix_carry_ks(g, p)
     import jax
 
     PBIG = jnp.float32(1 << 20)
@@ -156,6 +227,24 @@ def _prefix_carry(g, p):
     best = jax.lax.cummax(logg - S, axis=axis)    # max_{j<=k} logg[j] - S[j]
     # term(j,k) = logg[j] + (S[k] - S[j]) == 0 iff g[j] and p[(j,k]] all set
     return (S + best) == 0
+
+
+def _prefix_carry_ks(g, p):
+    """Kogge-Stone (g, p) prefix: log2(n) rounds of static limb shifts.
+
+    Same contract as `_prefix_carry`; used inside Pallas kernel bodies
+    (see `pallas_mode`). Composition law per round with doubling span d:
+      g'[k] = g[k] | (p[k] & g[k-d]) ;  p'[k] = p[k] & p[k-d]
+    with out-of-range lanes contributing no generate and no propagate."""
+    g = jnp.asarray(g, U32)
+    p = jnp.asarray(p, U32)
+    n = g.shape[-1]
+    d = 1
+    while d < n:
+        g = g | (p & _shiftd(g, d))
+        p = p & _shiftd(p, d)
+        d *= 2
+    return g != 0
 
 
 def carry_normalize_fast(t):
@@ -227,7 +316,7 @@ def _sub_with_borrow_scan(a, b):
 
 def _cond_sub_n(t):
     """Reduce t (NL+1 canonical limbs, value < 2N) to t mod N (NL limbs)."""
-    n_ext = jnp.asarray(N_EXT_HOST)
+    n_ext = kernel_const("NEXT", N_EXT_HOST)
     n_b = jnp.broadcast_to(n_ext, t.shape)
     diff, borrow = _sub_with_borrow(t, n_b)
     keep = (borrow == 1)
@@ -300,7 +389,7 @@ def _poly_mul(a, b, ncols: int):
     anti-diagonal matrix (dot_general maps onto the MXU; the banded-gather
     einsum it replaces lowered to gathers that bloated both compile time
     and runtime). The 8-bit split of `a` keeps every partial sum < 2^31."""
-    if _POLY_SHIFT:
+    if _POLY_SHIFT or _pallas_tracing():
         return _poly_mul_shift(a, b, ncols)
     na = a.shape[-1]
     nb = b.shape[-1]
@@ -339,9 +428,9 @@ def mont_mul(a, b):
     # T mod R needs only the low NL columns canonicalized (the carry past
     # 2^384 is dropped by the mod)
     t_low, _ = carry_normalize(t[..., :NL])
-    m = _poly_mul(t_low, jnp.asarray(NPRIME_HOST), NL)
+    m = _poly_mul(t_low, kernel_const("NPRIME", NPRIME_HOST), NL)
     m, _ = carry_normalize(m)                          # mod 2^384 via truncation
-    mn = _poly_mul(m, jnp.asarray(N_HOST), 2 * NL + 1)
+    mn = _poly_mul(m, kernel_const("N", N_HOST), 2 * NL + 1)
     s = t + mn                                         # columns < 2^31
     s, _ = carry_normalize(s)
     res = s[..., NL:]                                  # (..., NL+1), value < 2N
@@ -361,7 +450,7 @@ def add_mod(a, b):
 
 def sub_mod(a, b):
     diff, borrow = _sub_with_borrow(a, b)
-    n_arr = jnp.broadcast_to(jnp.asarray(N_HOST), diff.shape)
+    n_arr = jnp.broadcast_to(kernel_const("N", N_HOST), diff.shape)
     fixed = diff + n_arr                               # ≤ 2^17 per limb
     fixed = jnp.concatenate([fixed, jnp.zeros(fixed.shape[:-1] + (1,), U32)], axis=-1)
     fixed, _ = carry_normalize(fixed)
@@ -371,7 +460,7 @@ def sub_mod(a, b):
 
 def neg_mod(a):
     """-a mod P (0 maps to 0)."""
-    n_arr = jnp.broadcast_to(jnp.asarray(N_HOST), a.shape)
+    n_arr = jnp.broadcast_to(kernel_const("N", N_HOST), a.shape)
     diff, _ = _sub_with_borrow(n_arr, a)
     nonzero = jnp.any(a != 0, axis=-1, keepdims=True)
     return jnp.where(nonzero, diff, a)
@@ -387,7 +476,7 @@ def eq(a, b):
 
 def _cond_sub_n_ext(t):
     """One conditional subtract of N on an (NL+1)-limb value; keeps NL+1 limbs."""
-    n_ext = jnp.broadcast_to(jnp.asarray(N_EXT_HOST), t.shape)
+    n_ext = jnp.broadcast_to(kernel_const("NEXT", N_EXT_HOST), t.shape)
     diff, borrow = _sub_with_borrow(t, n_ext)
     return jnp.where((borrow == 1)[..., None], t, diff)
 
@@ -406,12 +495,16 @@ def mul_small(a, k: int):
     return acc[..., :NL]
 
 
+R2_HOST = pack(R2_INT)
+ONE_STD_HOST = pack(1)
+
+
 def to_mont(a_std):
-    return mont_mul(a_std, jnp.broadcast_to(R2, a_std.shape))
+    return mont_mul(a_std, jnp.broadcast_to(kernel_const("R2", R2_HOST), a_std.shape))
 
 
 def from_mont(a_mont):
-    return mont_mul(a_mont, jnp.broadcast_to(ONE_STD, a_mont.shape))
+    return mont_mul(a_mont, jnp.broadcast_to(kernel_const("ONE_STD", ONE_STD_HOST), a_mont.shape))
 
 
 def mont_pow_static(a, exponent: int, window: int = 4):
@@ -461,7 +554,14 @@ def mont_pow_static(a, exponent: int, window: int = 4):
 
 
 def mont_inv(a):
-    """a^-1 in Montgomery domain (Fermat: a^(P-2))."""
+    """a^-1 in Montgomery domain (Fermat: a^(P-2)).
+
+    Pallas kernel bodies plant a ref-reading square-and-multiply loop
+    ("POW_PM2" — the windowed scan below needs a dynamic table gather that
+    Mosaic rejects); the XLA path keeps the windowed form."""
+    impl = kernel_impl("POW_PM2")
+    if impl is not None:
+        return impl(a)
     return mont_pow_static(a, P - 2)
 
 

@@ -151,7 +151,7 @@ def _mask_lines(line, valid_mask):
     """Replace invalid lanes with the identity line (1, 0, 0)."""
     l0, l1, l2 = line
     m = jnp.asarray(valid_mask, bool)
-    one = jnp.broadcast_to(tw.FQ2_ONE, l0.shape)
+    one = jnp.broadcast_to(tw.fq2_one(), l0.shape)
     zero = jnp.zeros_like(l0)
     return (
         tw.fq2_select(m, l0, one),
@@ -168,7 +168,7 @@ def _combine_lines(line, valid_mask):
     if n == 1:
         return _line_to_fq12((l0, l1, l2))[0]
     if n % 2:
-        one = jnp.broadcast_to(tw.FQ2_ONE, (1,) + l0.shape[1:])
+        one = jnp.broadcast_to(tw.fq2_one(), (1,) + l0.shape[1:])
         zero = jnp.zeros((1,) + l0.shape[1:], l0.dtype)
         l0 = jnp.concatenate([l0, one])
         l1 = jnp.concatenate([l1, zero])
@@ -299,7 +299,18 @@ def final_exponentiation(m):
 
 def pairing_product_is_one(p_aff, q_aff, valid_mask):
     """prod_{i valid} e(P_i, Q_i) == 1: shared-accumulator Miller loop
-    (any pair count) + one final exponentiation."""
+    (any pair count) + one final exponentiation.
+
+    On a single accelerator the Miller loop and the final-exp hard part run
+    as fused Pallas kernels (pallas_ops.py); the plain XLA path remains the
+    reference (and the mesh-sharded multi-chip path)."""
+    from . import pallas_ops
+
+    m = pallas_ops.mode()
+    if m is not None:
+        return pallas_ops.pairing_product_is_one_fused(
+            p_aff, q_aff, valid_mask, interpret=(m == "interpret")
+        )
     f = miller_loop_product(p_aff, q_aff, valid_mask)
     f = final_exponentiation(f)
     return tw.fq12_eq_one(f)

@@ -34,13 +34,27 @@ def _mont_const(x: int) -> np.ndarray:
 FQ_ZERO = jnp.zeros((NL,), jnp.uint32)
 FQ_ONE = jnp.asarray(_mont_const(1))
 
-FQ2_ZERO = jnp.zeros((2, NL), jnp.uint32)
-FQ2_ONE = jnp.asarray(np.stack([_mont_const(1), np.zeros(NL, np.uint32)]))
-FQ6_ZERO = jnp.zeros((3, 2, NL), jnp.uint32)
-FQ6_ONE = jnp.asarray(
-    np.stack([np.asarray(FQ2_ONE), np.zeros((2, NL), np.uint32), np.zeros((2, NL), np.uint32)])
+_FQ2_ONE_NP = np.stack([_mont_const(1), np.zeros(NL, np.uint32)])
+_FQ6_ONE_NP = np.stack(
+    [_FQ2_ONE_NP, np.zeros((2, NL), np.uint32), np.zeros((2, NL), np.uint32)]
 )
-FQ12_ONE = jnp.asarray(np.stack([np.asarray(FQ6_ONE), np.zeros((3, 2, NL), np.uint32)]))
+_FQ12_ONE_NP = np.stack([_FQ6_ONE_NP, np.zeros((3, 2, NL), np.uint32)])
+
+FQ2_ZERO = jnp.zeros((2, NL), jnp.uint32)
+FQ2_ONE = jnp.asarray(_FQ2_ONE_NP)
+FQ6_ZERO = jnp.zeros((3, 2, NL), jnp.uint32)
+FQ6_ONE = jnp.asarray(_FQ6_ONE_NP)
+FQ12_ONE = jnp.asarray(_FQ12_ONE_NP)
+
+
+def fq2_one():
+    """FQ2 one as a kernel-safe constant (limbs.kernel_const)."""
+    return lb.kernel_const("FQ2_ONE", _FQ2_ONE_NP)
+
+
+def fq12_one():
+    """FQ12 one as a kernel-safe constant (limbs.kernel_const)."""
+    return lb.kernel_const("FQ12_ONE", _FQ12_ONE_NP)
 
 
 # ----------------------------------------------------------------- Fq2
@@ -121,14 +135,20 @@ fq6_sub = lb.sub_mod
 fq6_neg = lb.neg_mod
 
 
+def _sel3(x, i, j, k):
+    """Static permutation x[..., [i, j, k], :, :] as slices + stack — list
+    indexing creates an i32[3] gather, which Pallas kernels cannot capture
+    and Mosaic lowers poorly; the stacked-slice form is equivalent."""
+    return jnp.stack([x[..., i, :, :], x[..., j, :, :], x[..., k, :, :]], axis=-3)
+
+
 def fq6_mul(a, b):
     """Devegili Karatsuba: 6 fq2 products in one batched fq2_mul call."""
     a, b = jnp.broadcast_arrays(a, b)
-    i1, i2 = [1, 0, 0], [2, 1, 2]
     # Operand sums for the three cross terms, a and b together: one add.
     sums = lb.add_mod(
-        jnp.concatenate([a[..., i1, :, :], b[..., i1, :, :]], axis=-3),
-        jnp.concatenate([a[..., i2, :, :], b[..., i2, :, :]], axis=-3),
+        jnp.concatenate([_sel3(a, 1, 0, 0), _sel3(b, 1, 0, 0)], axis=-3),
+        jnp.concatenate([_sel3(a, 2, 1, 2), _sel3(b, 2, 1, 2)], axis=-3),
     )
     A = jnp.concatenate([a, sums[..., :3, :, :]], axis=-3)   # (..., 6, 2, NL)
     B = jnp.concatenate([b, sums[..., 3:, :, :]], axis=-3)
@@ -137,7 +157,7 @@ def fq6_mul(a, b):
     m12, m01, m02 = t[..., 3, :, :], t[..., 4, :, :], t[..., 5, :, :]
 
     # pair sums (t1+t2, t0+t1, t0+t2) in one add, cross-minus in one sub
-    ps = lb.add_mod(t[..., [1, 0, 0], :, :], t[..., [2, 1, 2], :, :])
+    ps = lb.add_mod(_sel3(t, 1, 0, 0), _sel3(t, 2, 1, 2))
     um = lb.sub_mod(jnp.stack([m12, m01, m02], axis=-3), ps)
     u, v, w = um[..., 0, :, :], um[..., 1, :, :], um[..., 2, :, :]
     # xi-mults for u and t2 in one stacked call
@@ -362,21 +382,33 @@ def fq6_frobenius(a, power=1):
     return fq2_mul(conj, coeff)
 
 
+_FROB12_COEFF_NP: dict = {}
+
+
+def _frob12_coeff_np(power: int) -> np.ndarray:
+    """(2, 3, 2, NL) Frobenius coefficient block for fq12_frobenius, cached
+    per power mod 12 (host np — becomes a kernel input in Pallas bodies)."""
+    key = power % 12
+    if key not in _FROB12_COEFF_NP:
+        g = _FROB12_C1[key]
+        coeff0 = np.stack([_FQ2_ONE_NP, _FROB6_C1[key % 6], _FROB6_C2[key % 6]])
+        coeff1 = np.stack(
+            [
+                np.asarray(_fq2_mul_np(g, _FQ2_ONE_NP)),
+                _fq2_mul_np(_FROB6_C1[key % 6], g),
+                _fq2_mul_np(_FROB6_C2[key % 6], g),
+            ]
+        )
+        _FROB12_COEFF_NP[key] = np.stack([coeff0, coeff1])
+    return _FROB12_COEFF_NP[key]
+
+
 def fq12_frobenius(a, power=1):
     a0, a1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
     conj0 = a0 if power % 2 == 0 else fq2_conj(a0)
     conj1 = a1 if power % 2 == 0 else fq2_conj(a1)
-    g = _FROB12_C1[power % 12]
-    coeff0 = np.stack([np.asarray(FQ2_ONE), _FROB6_C1[power % 6], _FROB6_C2[power % 6]])
-    coeff1 = np.stack(
-        [
-            np.asarray(_fq2_mul_np(g, np.stack([_mont_const(1), np.zeros(NL, np.uint32)]))),
-            _fq2_mul_np(_FROB6_C1[power % 6], g),
-            _fq2_mul_np(_FROB6_C2[power % 6], g),
-        ]
-    )
     stacked = jnp.stack([conj0, conj1], axis=-4)
-    coeff = jnp.asarray(np.stack([coeff0, coeff1]))
+    coeff = lb.kernel_const(f"FROB12C_{power % 12}", _frob12_coeff_np(power))
     return fq2_mul(stacked, coeff)
 
 

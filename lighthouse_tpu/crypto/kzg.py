@@ -7,9 +7,12 @@ code path the BLS backend uses (bls381 + jaxbls) — the north star's
 "blob proofs reuse the pairing kernel" (BASELINE.json).
 
 Scalar-field (Fr) polynomial math runs host-side (barycentric evaluation is
-a few thousand bigint ops); the group operations (MSM commitment, proof
-combination, final pairing product) go through the generic curve/pairing
-layer, so the jax backend accelerates them on TPU.
+a few thousand bigint ops); the group operations dispatch to the ACTIVE BLS
+backend when it exposes accelerated primitives — the jax backend implements
+both `g1_msm` (batched device double-and-add + tree reduce) and
+`pairing_product_is_one` (the same jitted pairing stage the signature
+verifier runs) — and fall back to the pure-Python curve/pairing layer
+otherwise (e.g. under the "python" backend).
 
 Trusted setup: the production ceremony file (JSON with g1_lagrange /
 g2_monomial points) loads via `TrustedSetup.from_json`. For tests,
@@ -74,17 +77,23 @@ class TrustedSetup:
     @classmethod
     def insecure_dev_setup(cls, n: int = 64) -> "TrustedSetup":
         """Deterministic setup from a KNOWN tau — testing only."""
+        lis, tau = cls.dev_setup_scalars(n)
+        g1 = [cv.g1_mul(cv.G1_GEN, li) for li in lis]
+        g2 = [cv.G2_GEN, cv.g2_mul(cv.G2_GEN, tau)]
+        return cls(g1_lagrange=g1, g2_monomial=g2, roots=_fr_roots_of_unity(n))
+
+    @classmethod
+    def dev_setup_scalars(cls, n: int) -> tuple[list[int], int]:
+        """(lagrange-basis scalars at tau, tau) for the insecure dev setup —
+        lets callers with a batched device scalar-mul (bench.py) build the
+        big setup without n host point multiplications.
+        L_i(tau) = (tau^n - 1) * w_i / (n * (tau - w_i)) over the
+        bit-reversed domain. NEVER for production (tau is public)."""
         tau = int.from_bytes(hashlib.sha256(b"lighthouse-tpu-dev-tau").digest(), "big") % R
         roots = _fr_roots_of_unity(n)
-        # lagrange basis at tau over the bit-reversed domain:
-        # L_i(tau) = (tau^n - 1) * w_i / (n * (tau - w_i))
         tau_n = pow(tau, n, R)
-        g1 = []
-        for w in roots:
-            li = (tau_n - 1) * w % R * pow(n * (tau - w) % R, R - 2, R) % R
-            g1.append(cv.g1_mul(cv.G1_GEN, li))
-        g2 = [cv.G2_GEN, cv.g2_mul(cv.G2_GEN, tau)]
-        return cls(g1_lagrange=g1, g2_monomial=g2, roots=roots)
+        denom_invs = _fr_batch_inverse([n * (tau - w) % R for w in roots])
+        return [(tau_n - 1) * w % R * dinv % R for w, dinv in zip(roots, denom_invs)], tau
 
 
 # ------------------------------------------------------------ blob handling
@@ -103,6 +112,25 @@ def blob_to_polynomial(blob: bytes, setup: TrustedSetup) -> list[int]:
     return out
 
 
+def _fr_batch_inverse(xs: list[int]) -> list[int]:
+    """Montgomery batch inversion: ONE field exponentiation + 3(n-1)
+    multiplications for n inverses (vs n exponentiations) — the same trick
+    c-kzg uses; this is what keeps barycentric evaluation of a 4096-element
+    blob at ~milliseconds host-side. Zero entries map to zero."""
+    n = len(xs)
+    prefix = [1] * (n + 1)
+    for i, x in enumerate(xs):
+        prefix[i + 1] = prefix[i] * (x if x % R else 1) % R
+    inv_all = pow(prefix[n], R - 2, R)
+    out = [0] * n
+    for i in range(n - 1, -1, -1):
+        x = xs[i] % R
+        if x:
+            out[i] = inv_all * prefix[i] % R
+            inv_all = inv_all * x % R
+    return out
+
+
 def _evaluate_polynomial_in_evaluation_form(poly: list[int], z: int, setup: TrustedSetup) -> int:
     """Barycentric evaluation over the bit-reversed domain."""
     n = setup.n
@@ -110,9 +138,10 @@ def _evaluate_polynomial_in_evaluation_form(poly: list[int], z: int, setup: Trus
         if z == w:
             return poly[i]
     # p(z) = (z^n - 1)/n * sum_i p_i * w_i / (z - w_i)
+    invs = _fr_batch_inverse([(z - w) % R for w in setup.roots])
     total = 0
-    for p_i, w in zip(poly, setup.roots):
-        total = (total + p_i * w % R * pow(z - w, R - 2, R)) % R
+    for p_i, w, inv in zip(poly, setup.roots, invs):
+        total = (total + p_i * w % R * inv) % R
     return total * (pow(z, n, R) - 1) % R * pow(n, R - 2, R) % R
 
 
@@ -121,7 +150,7 @@ def _compute_quotient_eval_form(poly, z: int, y: int, setup: TrustedSetup) -> li
     handled by caller special-case)."""
     n = setup.n
     q = [0] * n
-    inverses = [pow((w - z) % R, R - 2, R) for w in setup.roots]
+    inverses = _fr_batch_inverse([(w - z) % R for w in setup.roots])
     special = None
     for i, w in enumerate(setup.roots):
         if w == z:
@@ -137,11 +166,12 @@ def _compute_quotient_eval_form(poly, z: int, y: int, setup: TrustedSetup) -> li
         q[i] = (poly[i] - y) * inverses[i] % R
     acc = 0
     wz = setup.roots[special]
+    denom_invs = _fr_batch_inverse([(wz - w) % R * wz % R for w in setup.roots])
     for i in range(n):
         if i == special:
             continue
         w = setup.roots[i]
-        term = (poly[i] - y) * w % R * pow((wz - w) % R * wz % R, R - 2, R) % R
+        term = (poly[i] - y) * w % R * denom_invs[i] % R
         acc = (acc + term) % R
     q[special] = acc
     return q
@@ -162,6 +192,19 @@ def _g1_lincomb(points, scalars) -> object:
             continue
         acc = cv.g1_add(acc, cv.g1_mul(pt, s))
     return acc
+
+
+def _pairing_product_is_one(pairs) -> bool:
+    """prod e(P_i, Q_i) == 1 via the active BLS backend's pairing kernel
+    when available (the jax backend's device pairing stage), else the
+    pure-Python pairing."""
+    from .bls import api as bls_api
+
+    backend = bls_api.get_backend()
+    check = getattr(backend, "pairing_product_is_one", None)
+    if check is not None:
+        return check(pairs)
+    return pr.multi_pairing_is_one(pairs)
 
 
 # ------------------------------------------------------------ public API
@@ -203,7 +246,7 @@ def verify_kzg_proof(commitment, z: int, y: int, proof, setup: TrustedSetup) -> 
        e(P - y*G1, H) * e(-W, (tau - z)*H) == 1."""
     p_min_y = cv.g1_add(commitment, cv.g1_neg(cv.g1_mul(cv.G1_GEN, y)))
     tau_min_z = cv.g2_add(setup.g2_monomial[1], cv.g2_neg(cv.g2_mul(cv.G2_GEN, z)))
-    return pr.multi_pairing_is_one(
+    return _pairing_product_is_one(
         [(p_min_y, cv.G2_GEN), (cv.g1_neg(proof), tau_min_z)]
     )
 
@@ -258,6 +301,6 @@ def verify_blob_kzg_proof_batch(blobs, commitments_bytes, proofs_bytes, setup: T
     w_prime = _g1_lincomb(proofs, r_pows)
     if w_prime is None:
         return False
-    return pr.multi_pairing_is_one(
+    return _pairing_product_is_one(
         [(c_prime, cv.G2_GEN), (cv.g1_neg(w_prime), setup.g2_monomial[1])]
     )

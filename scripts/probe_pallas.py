@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Probe Mosaic/Pallas support on the attached TPU, smallest-first.
+
+Stage 0: trivial elementwise kernel (does pallas_call lower at all?)
+Stage 1: one mont_mul in pallas_mode (shift-accumulate + Kogge-Stone carry)
+Stage 2: the fused Miller-loop kernel, 2 pairs
+Stage 3: the fused final-exp hard part
+Each stage checks bit-exactness against the XLA path. Run to completion —
+never interrupt a remote compile (docs/PERF_NOTES.md runbook)."""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from lighthouse_tpu.utils.jaxcfg import setup_compilation_cache
+
+setup_compilation_cache()
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+print("devices:", jax.devices(), flush=True)
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from lighthouse_tpu.crypto.jaxbls import limbs as lb, tower as tw, pallas_ops as plo
+
+
+def stage(name, fn):
+    t0 = time.time()
+    try:
+        fn()
+        print(f"[{name}] OK in {time.time()-t0:.1f}s", flush=True)
+        return True
+    except Exception as e:
+        print(f"[{name}] FAILED in {time.time()-t0:.1f}s: {type(e).__name__}: {e}",
+              flush=True)
+        return False
+
+
+def s0():
+    def k(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2 + 1
+
+    x = jnp.arange(8 * 128, dtype=jnp.uint32).reshape(8, 128)
+    out = pl.pallas_call(
+        k,
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.uint32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+    )(x)
+    assert (np.asarray(out) == np.asarray(x) * 2 + 1).all()
+
+
+def s1():
+    import random
+
+    rng = random.Random(7)
+    from lighthouse_tpu.crypto.bls381.constants import P
+
+    a_int = [rng.randrange(P) for _ in range(8)]
+    b_int = [rng.randrange(P) for _ in range(8)]
+    a = jnp.asarray(lb.pack_batch(a_int))
+    b = jnp.asarray(lb.pack_batch(b_int))
+    want = np.asarray(lb.mont_mul_jit(a, b))
+
+    def k(*refs):
+        tab = plo._const_tab(refs[: plo._n_consts()])
+        a_ref, b_ref, o_ref = refs[plo._n_consts() :]
+        with lb.pallas_mode(tab):
+            o_ref[...] = lb.mont_mul(a_ref[...], b_ref[...])
+
+    out = pl.pallas_call(
+        k,
+        out_shape=jax.ShapeDtypeStruct((8, lb.NL), jnp.uint32),
+        in_specs=plo._const_specs(pl, pltpu) + [pl.BlockSpec(memory_space=pltpu.VMEM)] * 2,
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+    )(*plo._const_inputs(), a, b)
+    got = np.asarray(out)
+    assert (got == want).all(), f"mismatch:\n{got}\n{want}"
+
+
+def _pairs():
+    import random
+
+    rng = random.Random(11)
+    from lighthouse_tpu.crypto.bls381 import curve as pc
+    from lighthouse_tpu.crypto.bls381.constants import R
+
+    a = rng.randrange(1, R)
+    b = rng.randrange(1, R)
+    p1 = pc.g1_mul(pc.G1_GEN, a)
+    q1 = pc.g2_mul(pc.G2_GEN, b)
+    p2 = pc.g1_neg(pc.g1_mul(pc.G1_GEN, a * b % R))
+    g1s = [p1, p2]
+    g2s = [q1, pc.G2_GEN]
+    xp = tw.fq_batch_to_device([p[0] for p in g1s])
+    yp = tw.fq_batch_to_device([p[1] for p in g1s])
+    xq = tw.fq2_batch_to_device([q[0] for q in g2s])
+    yq = tw.fq2_batch_to_device([q[1] for q in g2s])
+    return (xp, yp), (xq, yq), jnp.asarray(np.ones(2, bool))
+
+
+def s2():
+    from lighthouse_tpu.crypto.jaxbls import pairing_ops as po
+
+    dp, dq, mask = _pairs()
+    want = np.asarray(jax.jit(po.miller_loop_product)(dp, dq, mask))
+    got = np.asarray(jax.jit(plo.miller_loop_product_fused)(dp, dq, mask))
+    assert (want == got).all(), "miller mismatch"
+
+
+def s3():
+    from lighthouse_tpu.crypto.jaxbls import pairing_ops as po
+
+    dp, dq, mask = _pairs()
+    f = jax.jit(po.miller_loop_product)(dp, dq, mask)
+    want = np.asarray(jax.jit(po.final_exponentiation)(f))
+    got = np.asarray(jax.jit(plo.final_exponentiation_fused)(f))
+    assert (want == got).all(), "final exp mismatch"
+    ok = np.asarray(tw.fq12_eq_one(jnp.asarray(got)))
+    assert bool(ok), "bilinear product != 1"
+
+
+def s4():
+    """End-to-end: the backend's staged verify with ALL FIVE fused kernels
+    (prepare, hash-to-G2, pairs, Miller, final-exp hard part) compiled for
+    this platform, accept + reject."""
+    import os
+
+    os.environ["LIGHTHOUSE_TPU_PALLAS"] = "on"
+    from lighthouse_tpu.crypto import bls
+    import lighthouse_tpu.crypto.jaxbls.backend as jb
+
+    jb._kernel_cache.clear()
+    backend = bls.set_backend("jax")
+    sks = [bls.SecretKey(77 + i) for i in range(4)]
+    pks = [sk.public_key() for sk in sks]
+    m0, m1 = b"\x51" * 32, b"\x52" * 32
+    agg0 = bls.AggregateSignature.aggregate([bls.sign(sks[0], m0), bls.sign(sks[1], m0)])
+    agg1 = bls.AggregateSignature.aggregate([bls.sign(sks[2], m1), bls.sign(sks[3], m1)])
+    sets = [
+        bls.SignatureSet(agg0, pks[0:2], m0),
+        bls.SignatureSet(agg1, pks[2:4], m1),
+    ]
+    rands = [1, 12345678901 | 1]
+    assert backend.verify_signature_sets(sets, rands), "valid batch rejected"
+    bad = [bls.SignatureSet(agg0, pks[0:2], m1), sets[1]]
+    assert not backend.verify_signature_sets(bad, rands), "tampered batch accepted"
+
+
+ok = stage("s0 trivial", s0)
+ok = ok and stage("s1 mont_mul", s1)
+ok = ok and stage("s2 miller fused", s2)
+ok = ok and stage("s3 hard part fused", s3)
+ok = ok and stage("s4 all-stage verify fused", s4)
+print("PALLAS PROBE:", "ALL OK" if ok else "FAILED", flush=True)
+sys.exit(0 if ok else 1)

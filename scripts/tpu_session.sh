@@ -38,8 +38,20 @@ while true; do
   ATTEMPT=$((ATTEMPT + 1))
   log "probe attempt $ATTEMPT"
   if probe; then
-    log "tunnel is UP — warming kernels (do not interrupt)"
-    if python scripts/warm_kernels.py >> "$LOG" 2>&1; then
+    log "tunnel is UP — probing Pallas/Mosaic support (do not interrupt)"
+    # 90 min hard stop: only as a last resort against a wedged tunnel —
+    # the probe itself exits promptly on backend-init failure.
+    if timeout 5400 python scripts/probe_pallas.py >> "$LOG" 2>&1; then
+      log "pallas probe OK — fused kernels enabled"
+      # clear any stale off-export from a failed probe in a previous loop
+      # iteration, or the OK above would be a lie for warm+bench below
+      export LIGHTHOUSE_TPU_PALLAS=auto
+    else
+      log "pallas probe FAILED rc=$? — disabling fused kernels for this session"
+      export LIGHTHOUSE_TPU_PALLAS=off
+    fi
+    log "warming kernels (do not interrupt)"
+    if python scripts/warm_kernels.py --buckets 4x128,4x512,256x512 >> "$LOG" 2>&1; then
       log "warm complete — running bench.py"
       if python bench.py > /tmp/bench_result.json 2>> "$LOG"; then
         # bench exits 0 with a ZERO measurement when the tunnel drops

@@ -20,6 +20,12 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--sets", type=int, default=64)
     ap.add_argument("--pks", type=int, default=128)
+    ap.add_argument(
+        "--buckets",
+        default=None,
+        help="comma list of extra NxM set/pubkey buckets to warm after the "
+        "primary (bench matrix shapes, e.g. '4x128,4x512')",
+    )
     args = ap.parse_args()
 
     from lighthouse_tpu.utils.jaxcfg import setup_compilation_cache
@@ -51,20 +57,28 @@ def main():
         stages.append(name)
         return r
 
-    z_pk, sig_acc, bad = warm(
-        "stage 1 prepare",
-        prepare,
-        rl((n, m)), rl((n, m)), np.ones((n, m), np.uint32),
-        rl((n, 2)), rl((n, 2)),
-        np.ones((n, be.Z_DIGITS), np.uint32), np.ones((n,), np.uint32),
-    )
-    h_jac = warm("stage 2 hash-to-G2", h2c_stage, rl((n, 2, 2)))
-    px, py, qxx, qyy, mask = warm(
-        "stage 3 pairs", pairs_stage, z_pk, h_jac, sig_acc,
-        np.ones((n,), np.uint32),
-    )
-    warm("stage 4 pairing", pairing_stage, px, py, qxx, qyy, mask)
-    print(f"warmed {len(stages)} stages at sets={n} pks={m}")
+    def warm_bucket(n, m):
+        z_pk, sig_acc, bad = warm(
+            f"[{n}x{m}] stage 1 prepare",
+            prepare,
+            rl((n, m)), rl((n, m)), np.ones((n, m), np.uint32),
+            rl((n, 2)), rl((n, 2)),
+            np.ones((n, be.Z_DIGITS), np.uint32), np.ones((n,), np.uint32),
+        )
+        h_jac = warm(f"[{n}x{m}] stage 2 hash-to-G2", h2c_stage, rl((n, 2, 2)))
+        px, py, qxx, qyy, mask = warm(
+            f"[{n}x{m}] stage 3 pairs", pairs_stage, z_pk, h_jac, sig_acc,
+            np.ones((n,), np.uint32),
+        )
+        warm(f"[{n}x{m}] stage 4 pairing", pairing_stage, px, py, qxx, qyy, mask)
+
+    warm_bucket(n, m)
+    for spec in (args.buckets or "").split(","):
+        if not spec:
+            continue
+        bn, bm = (int(v) for v in spec.lower().split("x"))
+        warm_bucket(bn, bm)
+    print(f"warmed {len(stages)} stages (primary {n}x{m})")
 
 
 if __name__ == "__main__":

