@@ -675,6 +675,27 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
 
         self._json({"data": system_health()})
 
+    def post_lh_validator_metrics(self):
+        """/lighthouse_tpu/ui/validator-metrics: per-validator monitor
+        summaries for the requested indices (http_api/src/ui.rs
+        post_validator_monitor_metrics analog). Body:
+        {"indices": [..], "epoch": optional} — epoch defaults to the last
+        CLOSED epoch (current - 1)."""
+        body = self._read_body() or {}
+        indices = [int(i) for i in body.get("indices", [])]
+        spe = self.chain.spec.preset.SLOTS_PER_EPOCH
+        epoch = int(body.get("epoch", self.chain.current_slot // spe - 1))
+        for vi in indices:
+            self.chain.monitor.register(vi)   # ui semantics: watch on query
+        self._json(
+            {
+                "data": {
+                    "validators": self.chain.monitor.metrics_for(indices, epoch),
+                    "epoch": epoch,
+                }
+            }
+        )
+
     def get_lh_peers_scores(self):
         net = getattr(self.chain, "_network_node", None)
         out = []
@@ -1313,6 +1334,7 @@ _ROUTES = [
     (r"/lighthouse_tpu/database/info", "GET", BeaconApiHandler.get_lh_database_info),
     (r"/lighthouse_tpu/health", "GET", BeaconApiHandler.get_lh_health),
     (r"/lighthouse_tpu/peers/scores", "GET", BeaconApiHandler.get_lh_peers_scores),
+    (r"/lighthouse_tpu/ui/validator-metrics", "POST", BeaconApiHandler.post_lh_validator_metrics),
     (r"/lighthouse_tpu/logs", "GET", BeaconApiHandler.get_lh_logs),
     (r"/eth/v1/validator/attestation_data", "GET", BeaconApiHandler.get_attestation_data),
     (r"/eth/v3/validator/blocks/(\d+)", "GET", BeaconApiHandler.get_produce_block),

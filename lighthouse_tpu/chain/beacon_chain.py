@@ -164,6 +164,15 @@ class BeaconChain:
         self.shuffling_cache = ShufflingCache()
         self.proposer_cache: dict[tuple[int, bytes], list[int]] = {}
 
+        from .validator_monitor import ValidatorMonitor
+
+        # per-validator performance tracking (validator_monitor.rs): driven
+        # from the import path + epoch rollover below; inert until a
+        # validator is registered (CLI --monitor-validators / API)
+        self.monitor = ValidatorMonitor(spec)
+        self._monitor_epoch: int | None = None
+        self._monitor_sync_indices: tuple[int, list[int]] | None = None
+
         # observed-* gossip dedup (observed_attesters.rs etc.)
         self.observed_block_producers: set[tuple[int, int]] = set()
         self.observed_attesters: set[tuple[int, int]] = set()          # (epoch, validator)
@@ -389,8 +398,12 @@ class BeaconChain:
         self.fork_choice.on_tick(self.current_slot)
         self.naive_attestation_pool.prune(self.current_slot)
         self.naive_sync_pool.prune(self.current_slot)
+        if self.monitor.active:
+            self._monitor_epoch_rollover()
         fin_epoch = self.fork_choice.store.finalized_checkpoint[0]
         self.observed_slashable.prune(fin_epoch, self.spec.preset.SLOTS_PER_EPOCH)
+        if self.monitor.active and fin_epoch > 0:
+            self.monitor.prune(fin_epoch)
         # pending DA joins at/below finalization can never import
         self.data_availability.prune_finalized(
             fin_epoch * self.spec.preset.SLOTS_PER_EPOCH
@@ -817,7 +830,121 @@ class BeaconChain:
             d = self.block_times.head_delay(self.head_root)
             if d is not None:
                 BLOCK_OBSERVED_TO_HEAD.observe(d)
+        if self.monitor.active:
+            self._monitor_block_import(block, state, fork)
         return block_root
+
+    # ------------------------------------------------- validator monitor
+
+    def _monitor_block_import(self, block, post_state, fork) -> None:
+        """Feed the ValidatorMonitor from an imported block: proposal,
+        per-attestation attesting indices (recomputed from the post state —
+        only runs when validators are registered), sync-committee
+        participation, and slashings (validator_monitor.rs
+        register_attestation_in_block and friends)."""
+        from ..types.spec import ForkName
+
+        spec = self.spec
+        att_sets = []
+        for att in block.body.attestations:
+            epoch = int(att.data.target.epoch)
+            try:
+                # reuse the chain-wide shuffling cache, keyed exactly like
+                # the gossip attestation path (_committee_for)
+                cc = self.shuffling_cache.get_or_build(
+                    post_state, spec, epoch, bytes(att.data.target.root)
+                )
+            except Exception:
+                continue
+            try:
+                if fork >= ForkName.electra:
+                    indices = acc.get_attesting_indices_electra(
+                        post_state, spec, att, cc
+                    )
+                else:
+                    committee = cc.committee(att.data.slot, att.data.index)
+                    indices = [
+                        i for i, bit in zip(committee, att.aggregation_bits) if bit
+                    ]
+            except Exception:
+                continue
+            att_sets.append((att, indices))
+        self.monitor.on_block_imported(block, att_sets)
+
+        if fork >= ForkName.altair and hasattr(block.body, "sync_aggregate"):
+            self.monitor.on_sync_aggregate(
+                int(block.slot),
+                self._sync_committee_member_indices(post_state),
+                list(block.body.sync_aggregate.sync_committee_bits),
+            )
+
+        epoch = int(block.slot) // spec.preset.SLOTS_PER_EPOCH
+        for sl in block.body.proposer_slashings:
+            self.monitor.on_slashing(
+                int(sl.signed_header_1.message.proposer_index), epoch
+            )
+        for sl in block.body.attester_slashings:
+            a = set(sl.attestation_1.attesting_indices)
+            for vi in sorted(a & set(sl.attestation_2.attesting_indices)):
+                self.monitor.on_slashing(int(vi), epoch)
+
+    def _sync_committee_member_indices(self, state) -> list[int]:
+        """Validator indices of the CURRENT sync committee, cached per sync
+        period (pubkey -> index via the pubkey cache)."""
+        spec = self.spec
+        epoch = int(state.slot) // spec.preset.SLOTS_PER_EPOCH
+        period = epoch // spec.preset.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+        if self._monitor_sync_indices and self._monitor_sync_indices[0] == period:
+            return self._monitor_sync_indices[1]
+        indices = []
+        for pk in state.current_sync_committee.pubkeys:
+            got = self.pubkey_cache.get_index(bytes(pk))
+            indices.append(-1 if got is None else got)
+        self._monitor_sync_indices = (period, indices)
+        return indices
+
+    def _monitor_epoch_rollover(self) -> None:
+        """On entering a new epoch E: record E's expected proposers (for
+        missed-block detection) and close epoch E-2's books. Closing lags
+        ONE FULL EPOCH (like validator_monitor.rs): attestations from the
+        tail of E-1 are includable throughout E, so E-1's participation
+        flags are only complete once E ends — a state in epoch E-1 (whose
+        previous_epoch_participation is E-2, now final) is what we read."""
+        spe = self.spec.preset.SLOTS_PER_EPOCH
+        cur_epoch = self.current_slot // spe
+        if cur_epoch == self._monitor_epoch:
+            return
+        self._monitor_epoch = cur_epoch
+        try:
+            head = self.head_state()
+            start = cur_epoch * spe
+            st = head
+            if st.slot < start:
+                st = clone_state(head, self.spec)
+                process_slots(st, self.spec, start)
+            duties = [
+                (slot, acc.get_beacon_proposer_index(st, self.spec, slot))
+                for slot in range(start, start + spe)
+            ]
+            self.monitor.on_proposer_duties(cur_epoch, duties)
+
+            if cur_epoch >= 2:
+                # a state inside epoch E-1: previous participation == E-2
+                prev_start = (cur_epoch - 1) * spe
+                st_close = head
+                if st_close.slot < prev_start:
+                    st_close = clone_state(head, self.spec)
+                    process_slots(st_close, self.spec, prev_start)
+                in_prev_epoch = prev_start <= st_close.slot < start
+                self.monitor.finalize_epoch(
+                    cur_epoch - 2, st_close if in_prev_epoch else None
+                )
+        except Exception as e:
+            from ..utils.logging import get_logger
+
+            get_logger("validator_monitor").warn(
+                "epoch rollover bookkeeping failed", error=str(e)
+            )
 
     def process_gossip_blob(self, sidecar):
         """Gossip blob-sidecar entry: verify, feed the DA checker, and import

@@ -2,18 +2,56 @@
 
 Parity surface: /root/reference/beacon_node/beacon_chain/src/
 validator_monitor.rs (2.1k LoC): registered validators get per-epoch
-hit/miss accounting for attestations (source/target/head timeliness),
-block proposals, sync-committee duty, plus inclusion-delay tracking;
-summaries are logged/exposed at epoch boundaries.
+hit/miss accounting for attestations (source/target/head timeliness and
+inclusion delay), block proposals INCLUDING missed proposals, and
+sync-committee duty performance; epoch summaries are logged at epoch
+boundaries (misses at warning level — the operator alert), exported as
+Prometheus metrics, and served over the API
+(/lighthouse_tpu/ui/validator-metrics — ui.rs post_validator_monitor_metrics
+analog). BeaconChain drives the event methods from its import path and
+epoch rollover (beacon_chain.py), so a registered validator is observed
+with no further configuration anywhere else.
 """
 
 from __future__ import annotations
 
 from collections import defaultdict
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from ..state_transition import accessors as acc
 from ..types.spec import ChainSpec
+from ..utils.logging import get_logger
+from ..utils.metrics import REGISTRY
+
+log = get_logger("validator_monitor")
+
+MONITORED_VALIDATORS = REGISTRY.gauge(
+    "validator_monitor_validators", "Number of validators being monitored"
+)
+MONITOR_PROPOSALS = REGISTRY.counter(
+    "validator_monitor_blocks_proposed_total",
+    "Blocks proposed by monitored validators",
+)
+MONITOR_MISSED_BLOCKS = REGISTRY.counter(
+    "validator_monitor_blocks_missed_total",
+    "Proposals missed by monitored validators",
+)
+MONITOR_ATT_HITS = REGISTRY.counter(
+    "validator_monitor_attestation_timely_target_total",
+    "Timely-target attestation credits earned by monitored validators",
+)
+MONITOR_ATT_MISSES = REGISTRY.counter(
+    "validator_monitor_attestation_misses_total",
+    "Epochs with no timely-target credit for a monitored validator",
+)
+MONITOR_SYNC_HITS = REGISTRY.counter(
+    "validator_monitor_sync_signatures_total",
+    "Sync-committee signatures included for monitored validators",
+)
+MONITOR_SYNC_MISSES = REGISTRY.counter(
+    "validator_monitor_sync_misses_total",
+    "Sync-committee slots missed by monitored validators",
+)
 
 
 @dataclass
@@ -24,8 +62,24 @@ class EpochSummary:
     attestation_target_hits: int = 0
     attestation_head_hits: int = 0
     blocks_proposed: int = 0
+    blocks_missed: int = 0
     sync_signatures: int = 0
+    sync_misses: int = 0
     slashed: bool = False
+
+    def as_dict(self) -> dict:
+        return {
+            "attestations": self.attestations,
+            "attestation_min_inclusion_delay": self.attestation_min_delay,
+            "attestation_source_hits": self.attestation_source_hits,
+            "attestation_target_hits": self.attestation_target_hits,
+            "attestation_head_hits": self.attestation_head_hits,
+            "blocks_proposed": self.blocks_proposed,
+            "blocks_missed": self.blocks_missed,
+            "sync_signatures": self.sync_signatures,
+            "sync_misses": self.sync_misses,
+            "slashed": self.slashed,
+        }
 
 
 class ValidatorMonitor:
@@ -35,9 +89,19 @@ class ValidatorMonitor:
         self.watched: set[int] = set()
         # (validator_index, epoch) -> EpochSummary
         self.summaries: dict[tuple[int, int], EpochSummary] = defaultdict(EpochSummary)
+        # epoch -> [(slot, proposer_index)] expected duties (miss detection)
+        self._proposer_duties: dict[int, list[tuple[int, int]]] = {}
+        # slots that actually got an imported block, per epoch
+        self._proposed_slots: dict[int, set[int]] = defaultdict(set)
+        self._finalized_epochs: set[int] = set()
+
+    @property
+    def active(self) -> bool:
+        return self.auto_register or bool(self.watched)
 
     def register(self, validator_index: int) -> None:
-        self.watched.add(validator_index)
+        self.watched.add(int(validator_index))
+        MONITORED_VALIDATORS.set(len(self.watched))
 
     def _tracked(self, idx: int) -> bool:
         return self.auto_register or idx in self.watched
@@ -48,8 +112,15 @@ class ValidatorMonitor:
         """Called on import with the block and, per included attestation,
         its attesting indices + inclusion info."""
         epoch = block.slot // self.spec.preset.SLOTS_PER_EPOCH
+        self._proposed_slots[epoch].add(int(block.slot))
         if self._tracked(block.proposer_index):
             self.summaries[(block.proposer_index, epoch)].blocks_proposed += 1
+            MONITOR_PROPOSALS.inc()
+            log.info(
+                "monitored proposal included",
+                validator=int(block.proposer_index),
+                slot=int(block.slot),
+            )
         for att, indices in attesting_index_sets:
             delay = block.slot - att.data.slot
             att_epoch = att.data.target.epoch
@@ -60,6 +131,26 @@ class ValidatorMonitor:
                 s.attestations += 1
                 if s.attestation_min_delay is None or delay < s.attestation_min_delay:
                     s.attestation_min_delay = delay
+
+    def on_sync_aggregate(self, slot: int, committee_indices, bits) -> None:
+        """Per imported block: the sync-committee membership (validator
+        indices in committee order; negative = unknown pubkey, skipped)
+        and the block's participation bits."""
+        epoch = slot // self.spec.preset.SLOTS_PER_EPOCH
+        for vi, bit in zip(committee_indices, bits):
+            if vi < 0 or not self._tracked(vi):
+                continue
+            s = self.summaries[(vi, epoch)]
+            if bit:
+                s.sync_signatures += 1
+                MONITOR_SYNC_HITS.inc()
+            else:
+                s.sync_misses += 1
+                MONITOR_SYNC_MISSES.inc()
+
+    def on_proposer_duties(self, epoch: int, duties) -> None:
+        """Record expected proposers for an epoch: [(slot, validator_idx)]."""
+        self._proposer_duties[epoch] = [(int(s), int(v)) for s, v in duties]
 
     def on_attestation_participation(self, state, epoch: int) -> None:
         """Read participation flags after epoch processing (altair+)."""
@@ -79,6 +170,60 @@ class ValidatorMonitor:
     def on_slashing(self, validator_index: int, epoch: int) -> None:
         if self._tracked(validator_index):
             self.summaries[(validator_index, epoch)].slashed = True
+            log.warn(
+                "monitored validator slashed",
+                validator=int(validator_index),
+                epoch=int(epoch),
+            )
+
+    def finalize_epoch(self, epoch: int, state=None) -> None:
+        """Close the books for an epoch: read participation flags (state is
+        a post-state whose PREVIOUS epoch is `epoch`), detect missed
+        proposals against the recorded duties, and emit the operator-facing
+        epoch summary — misses at warning level (the missed-block /
+        missed-attestation alerting the reference provides)."""
+        if epoch < 0 or epoch in self._finalized_epochs:
+            return
+        self._finalized_epochs.add(epoch)
+        if state is not None:
+            self.on_attestation_participation(state, epoch)
+
+        proposed = self._proposed_slots.get(epoch, set())
+        for slot, vi in self._proposer_duties.pop(epoch, []):
+            if not self._tracked(vi):
+                continue
+            if slot not in proposed:
+                self.summaries[(vi, epoch)].blocks_missed += 1
+                MONITOR_MISSED_BLOCKS.inc()
+                log.warn(
+                    "monitored validator MISSED a block",
+                    validator=vi, slot=slot, epoch=epoch,
+                )
+
+        # explicit registrations always get a verdict (including "no data" ->
+        # miss); in auto mode, every validator the epoch produced data for
+        report_set = set(self.watched) | {
+            vi for (vi, e) in self.summaries.keys() if e == epoch
+        }
+        for vi in sorted(report_set):
+            s = self.summaries[(vi, epoch)]
+            if s.attestation_target_hits:
+                MONITOR_ATT_HITS.inc(s.attestation_target_hits)
+                log.info(
+                    "validator epoch summary", validator=vi, epoch=epoch,
+                    attestations=s.attestations,
+                    min_inclusion_delay=s.attestation_min_delay,
+                    target_hits=s.attestation_target_hits,
+                    head_hits=s.attestation_head_hits,
+                    proposed=s.blocks_proposed,
+                    sync_signatures=s.sync_signatures,
+                )
+            else:
+                MONITOR_ATT_MISSES.inc()
+                log.warn(
+                    "monitored validator MISSED attestation credit",
+                    validator=vi, epoch=epoch, attestations=s.attestations,
+                )
 
     # ------------------------------------------------------------- queries
 
@@ -86,12 +231,22 @@ class ValidatorMonitor:
         return self.summaries[(validator_index, epoch)]
 
     def epoch_report(self, epoch: int) -> dict[int, EpochSummary]:
-        return {
-            vi: s for (vi, e), s in self.summaries.items() if e == epoch
-        }
+        return {vi: s for (vi, e), s in self.summaries.items() if e == epoch}
+
+    def metrics_for(self, indices, epoch: int) -> dict:
+        """API payload: {index: summary dict} for the given epoch (the
+        /lighthouse_tpu/ui/validator-metrics response body)."""
+        out = {}
+        for vi in indices:
+            s = self.summaries.get((int(vi), epoch))
+            out[str(int(vi))] = (s or EpochSummary()).as_dict()
+        return out
 
     def prune(self, before_epoch: int) -> None:
         self.summaries = defaultdict(
             EpochSummary,
             {k: v for k, v in self.summaries.items() if k[1] >= before_epoch},
         )
+        for e in [e for e in self._proposed_slots if e < before_epoch]:
+            del self._proposed_slots[e]
+        self._finalized_epochs = {e for e in self._finalized_epochs if e >= before_epoch}

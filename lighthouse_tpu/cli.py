@@ -108,6 +108,16 @@ def cmd_bn(args):
     chain = BeaconChain(
         spec, state, store=store, slot_clock=clock, execution_layer=execution_layer
     )
+    if getattr(args, "monitor_validators", None):
+        if args.monitor_validators.strip().lower() == "auto":
+            chain.monitor.auto_register = True
+            log.info("validator monitor: tracking ALL validators")
+        else:
+            for tok in args.monitor_validators.split(","):
+                if tok.strip():
+                    chain.monitor.register(int(tok))
+            log.info("validator monitor enabled",
+                     watched=len(chain.monitor.watched))
 
     eth1_service = None
     if args.eth1:
@@ -620,6 +630,12 @@ def build_parser() -> argparse.ArgumentParser:
     bn.add_argument(
         "--eth1", default=None,
         help="eth1 JSON-RPC endpoint for deposit-log scraping, or 'mock'",
+    )
+    bn.add_argument(
+        "--monitor-validators", default=None,
+        help="comma list of validator indices to track (per-epoch summaries, "
+             "missed-block/attestation alerts, /lighthouse_tpu/ui/"
+             "validator-metrics), or 'auto' to track every validator",
     )
     bn.set_defaults(fn=cmd_bn)
 

@@ -213,3 +213,41 @@ def test_state_advance_timer(env):
     # the pre-advanced state serves _state_for_block without re-advancing
     got = chain._state_for_block(head, int(adv.slot))
     assert got.slot == adv.slot
+
+
+def test_validator_monitor_wired_into_import():
+    """Registering validators makes the import path and epoch rollover feed
+    the monitor: proposals, attestation inclusion, duties, epoch close.
+    Fresh harness+chain: the module fixture's chain may have diverged from
+    the harness in earlier fork-revert tests."""
+    spec = minimal_spec()
+    harness = StateHarness.new(spec, 32)
+    chain = BeaconChain(spec, clone_state(harness.state, spec))
+    spe = chain.spec.preset.SLOTS_PER_EPOCH
+    chain.monitor.auto_register = True
+    try:
+        n = 2 * spe + 2          # cross TWO epoch boundaries (close lags one epoch)
+        _produce_and_import(harness, chain, n, attest=True)
+
+        # every produced block's proposer got credited in its epoch
+        proposed = sum(
+            s.blocks_proposed for s in chain.monitor.summaries.values()
+        )
+        assert proposed >= n
+
+        # attestations were attributed with inclusion delay 1
+        att_tracked = [
+            s for s in chain.monitor.summaries.values() if s.attestations
+        ]
+        assert att_tracked, "no attestation inclusion recorded"
+        assert min(
+            s.attestation_min_delay for s in att_tracked
+        ) == 1
+
+        # epoch rollover recorded duties for the current epoch and closed
+        # an earlier one
+        cur_epoch = chain.current_slot // spe
+        assert chain.monitor._proposer_duties.get(cur_epoch), "no duties recorded"
+        assert chain.monitor._finalized_epochs, "no epoch finalized"
+    finally:
+        chain.monitor.auto_register = False

@@ -22,6 +22,51 @@ def test_block_and_attestation_tracking():
     assert vm.summary(3, 10 // spec.preset.SLOTS_PER_EPOCH).blocks_proposed == 1
 
 
+def test_missed_block_detection_and_epoch_close():
+    spec = minimal_spec()
+    spe = spec.preset.SLOTS_PER_EPOCH
+    vm = ValidatorMonitor(spec)
+    vm.register(3)
+    vm.register(4)
+    # epoch 2 duties: validator 3 proposes twice, validator 4 once
+    start = 2 * spe
+    vm.on_proposer_duties(2, [(start, 3), (start + 1, 4), (start + 2, 3)])
+    # only the first of validator 3's slots gets a block
+    block = SimpleNamespace(slot=start, proposer_index=3)
+    vm.on_block_imported(block, [])
+    vm.finalize_epoch(2)
+    assert vm.summary(3, 2).blocks_proposed == 1
+    assert vm.summary(3, 2).blocks_missed == 1
+    assert vm.summary(4, 2).blocks_missed == 1
+    # idempotent: finalizing again must not double-count
+    vm.finalize_epoch(2)
+    assert vm.summary(3, 2).blocks_missed == 1
+
+
+def test_sync_aggregate_tracking():
+    spec = minimal_spec()
+    vm = ValidatorMonitor(spec)
+    vm.register(11)
+    committee = [10, 11, 12, 11]     # members may repeat in a committee
+    vm.on_sync_aggregate(5, committee, [1, 1, 0, 0])
+    epoch = 5 // spec.preset.SLOTS_PER_EPOCH
+    s = vm.summary(11, epoch)
+    assert s.sync_signatures == 1 and s.sync_misses == 1
+    assert (10, epoch) not in vm.summaries
+
+
+def test_metrics_for_payload_shape():
+    spec = minimal_spec()
+    vm = ValidatorMonitor(spec)
+    vm.register(2)
+    block = SimpleNamespace(slot=1, proposer_index=2)
+    vm.on_block_imported(block, [])
+    out = vm.metrics_for([2, 99], 0)
+    assert out["2"]["blocks_proposed"] == 1
+    assert out["99"]["blocks_proposed"] == 0
+    assert "sync_misses" in out["2"] and "blocks_missed" in out["2"]
+
+
 def test_participation_flags_readout():
     spec = minimal_spec()
     vm = ValidatorMonitor(spec, auto_register=True)
