@@ -162,6 +162,10 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
                 # invalid submissions are client errors, not server faults
                 # (publish_blocks.rs maps verification failures to 400)
                 self._error(400, f"BlockError: {e}")
+            elif isinstance(e, (ValueError, KeyError, TypeError, json.JSONDecodeError)):
+                # malformed ids/params/bodies are client errors (warp's
+                # invalid-param rejections map to 400 in the reference)
+                self._error(400, f"invalid request: {type(e).__name__}: {e}")
             else:
                 self._error(500, f"{type(e).__name__}: {e}")
 
@@ -211,6 +215,8 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
         st = self._state_by_id(state_id)
         if vid.startswith("0x"):
             pkb = bytes.fromhex(vid[2:])
+            if len(pkb) != 48:
+                raise ApiError(400, "validator pubkey must be 48 bytes")
             for i, v in enumerate(st.validators):
                 if bytes(v.pubkey) == pkb:
                     return self._json({"data": _validator_json(i, v, st.balances[i])})
@@ -680,13 +686,16 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
         summaries for the requested indices (http_api/src/ui.rs
         post_validator_monitor_metrics analog). Body:
         {"indices": [..], "epoch": optional} — epoch defaults to the last
-        CLOSED epoch (current - 1)."""
+        CLOSED epoch (current - 2: books for E close once E+1 ends).
+        Read-only: registration is an operator decision
+        (--monitor-validators), not a side effect of an unauthenticated
+        query."""
         body = self._read_body() or {}
+        if not isinstance(body, dict):
+            raise ApiError(400, "body must be a JSON object")
         indices = [int(i) for i in body.get("indices", [])]
         spe = self.chain.spec.preset.SLOTS_PER_EPOCH
-        epoch = int(body.get("epoch", self.chain.current_slot // spe - 1))
-        for vi in indices:
-            self.chain.monitor.register(vi)   # ui semantics: watch on query
+        epoch = int(body.get("epoch", max(0, self.chain.current_slot // spe - 2)))
         self._json(
             {
                 "data": {

@@ -404,6 +404,13 @@ class BeaconChain:
         self.observed_slashable.prune(fin_epoch, self.spec.preset.SLOTS_PER_EPOCH)
         if self.monitor.active and fin_epoch > 0:
             self.monitor.prune(fin_epoch)
+        if (
+            self.slasher is not None
+            and hasattr(self.slasher, "prune")
+            and fin_epoch > getattr(self, "_slasher_pruned_at", 0)
+        ):
+            self._slasher_pruned_at = fin_epoch
+            self.slasher.prune(fin_epoch, self.spec.preset.SLOTS_PER_EPOCH)
         # pending DA joins at/below finalization can never import
         self.data_availability.prune_finalized(
             fin_epoch * self.spec.preset.SLOTS_PER_EPOCH
@@ -914,6 +921,7 @@ class BeaconChain:
         cur_epoch = self.current_slot // spe
         if cur_epoch == self._monitor_epoch:
             return
+        prev_epoch_seen = self._monitor_epoch
         self._monitor_epoch = cur_epoch
         try:
             head = self.head_state()
@@ -929,7 +937,13 @@ class BeaconChain:
             self.monitor.on_proposer_duties(cur_epoch, duties)
 
             if cur_epoch >= 2:
-                # a state inside epoch E-1: previous participation == E-2
+                # close every epoch whose books became final since the last
+                # tick (the clock may jump several epochs after a stall);
+                # only the newest target can read real participation flags —
+                # a state inside epoch E-1 has previous participation == E-2
+                oldest = 0 if prev_epoch_seen is None else max(0, prev_epoch_seen - 1)
+                for tgt in range(oldest, cur_epoch - 2):
+                    self.monitor.finalize_epoch(tgt, None)
                 prev_start = (cur_epoch - 1) * spe
                 st_close = head
                 if st_close.slot < prev_start:

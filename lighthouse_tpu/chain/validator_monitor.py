@@ -249,4 +249,6 @@ class ValidatorMonitor:
         )
         for e in [e for e in self._proposed_slots if e < before_epoch]:
             del self._proposed_slots[e]
+        for e in [e for e in self._proposer_duties if e < before_epoch]:
+            del self._proposer_duties[e]
         self._finalized_epochs = {e for e in self._finalized_epochs if e >= before_epoch}

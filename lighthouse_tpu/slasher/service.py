@@ -60,6 +60,24 @@ class SlasherService:
                         self.op_pool.insert_attester_slashing(built)
         return n
 
+    def prune(self, finalized_epoch: int, slots_per_epoch: int,
+              history_epochs: int = 4096) -> int:
+        """Drop detector + side-table history below the retention horizon
+        (finalized - history). The node calls this as finalization
+        advances (service/src/lib.rs prune cadence)."""
+        horizon = max(0, finalized_epoch - history_epochs)
+        if horizon == 0:
+            return 0
+        n = self.slasher.prune(horizon, before_slot=horizon * slots_per_epoch)
+        self._atts = {
+            k: v for k, v in self._atts.items() if k[1] >= horizon
+        }
+        self._headers = {
+            k: v for k, v in self._headers.items()
+            if k[1] >= horizon * slots_per_epoch
+        }
+        return n
+
     def _build_proposer_slashing(self, ev):
         if self.types is None:
             return None

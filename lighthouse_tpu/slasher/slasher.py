@@ -9,12 +9,20 @@ double-signing detection (block_queue.rs). Backing storage is the same
 KeyValueStore abstraction the beacon store uses (LMDB/MDBX role).
 
 Detection invariants (array.rs):
-  min_targets[v][e] = min target of attestations by v with source > e
-  max_targets[v][e] = max target of attestations by v with source < e
-  new att (s, t) is SURROUNDED by an existing one iff max_targets[v][s] > t
-  new att (s, t) SURROUNDS an existing one     iff min_targets[v][s] < t
-Arrays are stored in fixed-size chunks per validator (chunked columns), so
-the working set for an epoch batch stays small.
+  min_targets[v][e] = min target of attestations by v with source >= e
+                      (suffix aggregate — updating an insert at source s
+                      walks DOWN from s and stops at the first entry that
+                      is already <= t, so updates are amortized O(1))
+  max_targets[v][e] = max target of attestations by v with source <= e
+                      (prefix aggregate, walking UP with the same early
+                      stop)
+  new att (s, t) is SURROUNDED by an existing one iff max_targets[v][s-1] > t
+  new att (s, t) SURROUNDS an existing one        iff min_targets[v][s+1] < t
+Both queries are ONE chunk read. Arrays are stored in fixed-size chunks per
+validator (chunked columns); `prune()` drops records and chunks below the
+retention horizon (the slasher service calls it as finalization advances).
+tests/test_slasher_scale.py drives thousands-of-validators batches, a
+brute-force differential, chunk/window boundaries, and pruning.
 """
 
 from __future__ import annotations
@@ -99,24 +107,109 @@ class Slasher:
                 return SlashingEvidence("double_vote", rec.validator_index, raw, rec)
         return None
 
+    # Per-validator source-range bounds (L, S) and global extrema
+    # (G_min, G_max): the aggregate arrays are only materialized for source
+    # indices in [L, S]; queries outside that window answer from the global
+    # extrema (below L every attestation has source >= L; above S none do).
+    # This is what keeps updates O(gap) instead of O(MAX_HISTORY) on first
+    # insert — the array.rs role of the per-validator current-epoch cursor.
+
+    def _get_bounds(self, v: int):
+        raw = self.store.get(Column.metadata, b"bnd" + v.to_bytes(8, "little"))
+        if raw is None:
+            return None
+        return tuple(
+            int.from_bytes(raw[i * 8 : (i + 1) * 8], "little") for i in range(4)
+        )
+
+    def _put_bounds(self, v: int, lo: int, hi: int, gmin: int, gmax: int) -> None:
+        self.store.put(
+            Column.metadata,
+            b"bnd" + v.to_bytes(8, "little"),
+            b"".join(x.to_bytes(8, "little") for x in (lo, hi, gmin, gmax)),
+        )
+
     def _min_target_with_source_gt(self, v: int, source: int) -> int:
-        """min target over attestations with source > `source`."""
-        best = 2**63
-        for e in range(source + 1, source + 1 + MAX_HISTORY):
-            chunk = self._get_chunk(v, "minbysrc", e // CHUNK)
-            val = chunk[e % CHUNK]
-            if val != 2**63:
-                best = min(best, val)
-            if e % CHUNK == CHUNK - 1 and best != 2**63:
-                break
-        return best
+        """min target over attestations with source > `source`: ONE read of
+        the suffix-aggregate array at index source+1."""
+        bounds = self._get_bounds(v)
+        if bounds is None:
+            return 2**63
+        lo, hi, gmin, _gmax = bounds
+        e = source + 1
+        if e > hi:
+            return 2**63            # no attestation has source > hi
+        if e <= lo:
+            return gmin             # every attestation has source >= lo
+        return self._get_chunk(v, "minbysrc", e // CHUNK)[e % CHUNK]
 
     def _max_target_with_source_lt(self, v: int, source: int) -> int:
-        best = 0
-        for e in range(max(0, source - MAX_HISTORY), source):
-            chunk = self._get_chunk(v, "maxbysrc", e // CHUNK)
-            best = max(best, chunk[e % CHUNK])
-        return best
+        """max target over attestations with source < `source`: ONE read of
+        the prefix-aggregate array at index source-1."""
+        bounds = self._get_bounds(v)
+        if bounds is None or source == 0:
+            return 0
+        lo, hi, _gmin, gmax = bounds
+        e = source - 1
+        if e < lo:
+            return 0                # no attestation has source < lo
+        if e >= hi:
+            return gmax             # every attestation has source <= hi
+        return self._get_chunk(v, "maxbysrc", e // CHUNK)[e % CHUNK]
+
+    def _walk_chunks(self, v: int, kind: str, start: int, stop: int, step: int,
+                     value: int, early_stop) -> None:
+        """Write `value` into arr[e] for e from start to stop (inclusive,
+        direction `step`), stopping early when `early_stop(existing)` —
+        valid because both aggregates are monotone in e."""
+        e = start
+        while (e >= stop) if step < 0 else (e <= stop):
+            ci = e // CHUNK
+            chunk = self._get_chunk(v, kind, ci)
+            dirty = False
+            chunk_edge = ci * CHUNK if step < 0 else (ci + 1) * CHUNK - 1
+            bound = max(stop, chunk_edge) if step < 0 else min(stop, chunk_edge)
+            while (e >= bound) if step < 0 else (e <= bound):
+                if early_stop(chunk[e % CHUNK]):
+                    if dirty:
+                        self._put_chunk(v, kind, ci, chunk)
+                    return
+                chunk[e % CHUNK] = value
+                dirty = True
+                e += step
+            if dirty:
+                self._put_chunk(v, kind, ci, chunk)
+
+    def _record_attestation(self, v: int, source: int, target: int) -> None:
+        """Fold (source, target) into both aggregate arrays + the bounds."""
+        bounds = self._get_bounds(v)
+        if bounds is None:
+            self._walk_chunks(v, "minbysrc", source, source, -1, target,
+                              lambda x: x <= target)
+            self._walk_chunks(v, "maxbysrc", source, source, 1, target,
+                              lambda x: x >= target)
+            self._put_bounds(v, source, source, target, target)
+            return
+        lo, hi, gmin, gmax = bounds
+        if source > hi:
+            # extend the materialized window upward, carrying the prefix
+            # aggregate across the WHOLE gap — clamping the fill would
+            # leave a hole inside [lo, hi'] that reads as "no attestations"
+            # and mask surrounds that are well within the history window
+            # (the fill is chunk-granular, so even huge offline gaps cost
+            # gap/CHUNK writes exactly once)
+            self._walk_chunks(v, "maxbysrc", hi + 1, source, 1, gmax,
+                              lambda x: False)
+            hi = source
+        if source < lo:
+            self._walk_chunks(v, "minbysrc", lo - 1, source, -1, gmin,
+                              lambda x: False)
+            lo = source
+        self._walk_chunks(v, "minbysrc", source, max(lo, source - MAX_HISTORY),
+                          -1, target, lambda x: x <= target)
+        self._walk_chunks(v, "maxbysrc", source, min(hi, source + MAX_HISTORY),
+                          1, target, lambda x: x >= target)
+        self._put_bounds(v, lo, hi, min(gmin, target), max(gmax, target))
 
     def process_queued(self) -> list[SlashingEvidence]:
         """Epoch-batch processing (slasher.rs process_batch)."""
@@ -144,13 +237,7 @@ class Slasher:
                 + rec.target.to_bytes(8, "little")
                 + rec.data_root,
             )
-            ci = rec.source // CHUNK
-            mn = self._get_chunk(v, "minbysrc", ci)
-            mn[rec.source % CHUNK] = min(mn[rec.source % CHUNK], rec.target)
-            self._put_chunk(v, "minbysrc", ci, mn)
-            mx = self._get_chunk(v, "maxbysrc", ci)
-            mx[rec.source % CHUNK] = max(mx[rec.source % CHUNK], rec.target)
-            self._put_chunk(v, "maxbysrc", ci, mx)
+            self._record_attestation(v, rec.source, rec.target)
         self.attestation_queue.clear()
 
         for rec in self.proposal_queue:
@@ -166,3 +253,30 @@ class Slasher:
 
         self.found.extend(new_evidence)
         return new_evidence
+
+    # ------------------------------------------------------------- pruning
+
+    def prune(self, before_epoch: int, before_slot: int | None = None) -> int:
+        """Drop history below the retention horizon (slasher.rs prune):
+        attestation records with target < before_epoch, proposal records
+        below before_slot, and aggregate-array chunks lying wholly below
+        before_epoch. Aggregates above the horizon keep their values, so a
+        surround flagged against pruned history remains a TRUE offense —
+        only the prior's full record is no longer reproducible. Returns the
+        number of deleted keys (full column scan: call at finalization
+        cadence, not per batch)."""
+        doomed: list[bytes] = []
+        for key, _val in self.store.iter_column(Column.metadata):
+            if key.startswith(b"att") and len(key) == 19:
+                if int.from_bytes(key[11:19], "little") < before_epoch:
+                    doomed.append(key)
+            elif key.startswith(b"blk") and before_slot is not None and len(key) == 19:
+                if int.from_bytes(key[11:19], "little") < before_slot:
+                    doomed.append(key)
+            elif key.startswith((b"minbysrc", b"maxbysrc")) and len(key) == 24:
+                ci = int.from_bytes(key[16:24], "little")
+                if (ci + 1) * CHUNK <= before_epoch:
+                    doomed.append(key)
+        for key in doomed:
+            self.store.delete(Column.metadata, key)
+        return len(doomed)

@@ -501,3 +501,50 @@ def test_lc_updates_by_range_route(api):
     assert _http_error(
         lambda: _get(client, "/eth/v1/beacon/light_client/updates")
     ) == 400
+
+
+def test_error_paths_state_block_validator_ids(api):
+    """Negative paths across the query route families (http_api/tests error
+    lanes): bad state ids, unknown roots, malformed indices/params must map
+    to 400/404 JSON errors — never 500s or hangs."""
+    harness, chain, client = api
+
+    # state ids: garbage -> 400; unknown-but-valid root -> 404
+    assert _http_error(lambda: _get(client, "/eth/v1/beacon/states/notastate/root")) == 400
+    assert _http_error(
+        lambda: _get(client, "/eth/v1/beacon/states/0x" + "ee" * 32 + "/root")
+    ) == 404
+    # far-future slot state id -> 404
+    assert _http_error(
+        lambda: _get(client, "/eth/v1/beacon/states/99999999/root")
+    ) == 404
+
+    # block ids
+    assert _http_error(lambda: _get(client, "/eth/v2/beacon/blocks/zzz")) == 400
+    assert _http_error(
+        lambda: _get(client, "/eth/v2/beacon/blocks/0x" + "ab" * 32)
+    ) == 404
+
+    # validator ids: unknown index -> 404; malformed pubkey hex -> 400
+    assert _http_error(
+        lambda: _get(client, "/eth/v1/beacon/states/head/validators/424242")
+    ) == 404
+    assert _http_error(
+        lambda: _get(client, "/eth/v1/beacon/states/head/validators/0x1234")
+    ) == 400
+
+    # duties: malformed body (not a list of indices) -> 400
+    assert _http_error(
+        lambda: _post(client, "/eth/v1/validator/duties/attester/0", {"x": 1})
+    ) == 400
+
+    # pool publishes: structurally invalid operations -> 400, pool unchanged
+    assert _http_error(
+        lambda: _post(client, "/eth/v1/beacon/pool/voluntary_exits", {"bad": "shape"})
+    ) == 400
+    assert _http_error(
+        lambda: _post(client, "/eth/v1/beacon/pool/attestations", [{"bad": "shape"}])
+    ) == 400
+
+    # unknown route -> 404
+    assert _http_error(lambda: _get(client, "/eth/v1/nonsense")) == 404

