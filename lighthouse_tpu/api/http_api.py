@@ -65,6 +65,22 @@ class ApiError(Exception):
         self.message = message
 
 
+def _client_input():
+    """Context manager for BODY PARSING in submission handlers: a malformed
+    client payload (missing keys, wrong types, bad hex) maps to 400, while
+    the same exception types escaping chain internals stay 500 faults."""
+    from contextlib import contextmanager
+
+    @contextmanager
+    def cm():
+        try:
+            yield
+        except (KeyError, TypeError, ValueError) as e:
+            raise ApiError(400, f"malformed body: {type(e).__name__}: {e}") from e
+
+    return cm()
+
+
 class BeaconApiHandler(BaseHTTPRequestHandler):
     """Routes are matched with regexes against (method, path)."""
 
@@ -162,9 +178,11 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
                 # invalid submissions are client errors, not server faults
                 # (publish_blocks.rs maps verification failures to 400)
                 self._error(400, f"BlockError: {e}")
-            elif isinstance(e, (ValueError, KeyError, TypeError, json.JSONDecodeError)):
-                # malformed ids/params/bodies are client errors (warp's
-                # invalid-param rejections map to 400 in the reference)
+            elif isinstance(e, (ValueError, json.JSONDecodeError)):
+                # malformed ids/params are client errors (warp's invalid-
+                # param rejections map to 400); submission handlers wrap
+                # body parsing in _client_input() for the KeyError/TypeError
+                # shapes so internal faults keep surfacing as 500s
                 self._error(400, f"invalid request: {type(e).__name__}: {e}")
             else:
                 self._error(500, f"{type(e).__name__}: {e}")
@@ -371,26 +389,27 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
         chain = self.chain
         types = types_for_slot(chain.spec, chain.head_state().slot)
         atts = []
-        for a in body:
-            data = a["data"]
-            att = types.Attestation.make(
-                aggregation_bits=_bits_from_hex(a["aggregation_bits"]),
-                data=types.AttestationData.make(
-                    slot=int(data["slot"]),
-                    index=int(data["index"]),
-                    beacon_block_root=bytes.fromhex(data["beacon_block_root"][2:]),
-                    source=types.Checkpoint.make(
-                        epoch=int(data["source"]["epoch"]),
-                        root=bytes.fromhex(data["source"]["root"][2:]),
+        with _client_input():
+            for a in body:
+                data = a["data"]
+                att = types.Attestation.make(
+                    aggregation_bits=_bits_from_hex(a["aggregation_bits"]),
+                    data=types.AttestationData.make(
+                        slot=int(data["slot"]),
+                        index=int(data["index"]),
+                        beacon_block_root=bytes.fromhex(data["beacon_block_root"][2:]),
+                        source=types.Checkpoint.make(
+                            epoch=int(data["source"]["epoch"]),
+                            root=bytes.fromhex(data["source"]["root"][2:]),
+                        ),
+                        target=types.Checkpoint.make(
+                            epoch=int(data["target"]["epoch"]),
+                            root=bytes.fromhex(data["target"]["root"][2:]),
+                        ),
                     ),
-                    target=types.Checkpoint.make(
-                        epoch=int(data["target"]["epoch"]),
-                        root=bytes.fromhex(data["target"]["root"][2:]),
-                    ),
-                ),
-                signature=bytes.fromhex(a["signature"][2:]),
-            )
-            atts.append(att)
+                    signature=bytes.fromhex(a["signature"][2:]),
+                )
+                atts.append(att)
         verified = chain.verify_unaggregated_attestations(atts)
         for att, indices in verified:
             chain.apply_attestation_to_fork_choice(att, indices)
@@ -628,10 +647,11 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
 
     def post_prepare_proposer(self):
         body = self._read_body()
-        for item in body:
-            self.chain.proposer_preparations[int(item["validator_index"])] = bytes.fromhex(
-                item["fee_recipient"][2:]
-            )
+        with _client_input():
+            for item in body:
+                self.chain.proposer_preparations[int(item["validator_index"])] = bytes.fromhex(
+                    item["fee_recipient"][2:]
+                )
         self._json({}, 200)
 
     def post_subscriptions(self):
@@ -761,7 +781,7 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
         if not reveal_hex:
             raise ApiError(400, "randao_reveal required")
         slot = int(slot)
-        graffiti = bytes.fromhex(q["graffiti"][2:]) if "graffiti" in q else b"\x00" * 32
+        graffiti = bytes.fromhex(q["graffiti"][2:]) if "graffiti" in q else None
         block = self.chain.produce_block(
             slot, bytes.fromhex(reveal_hex[2:]),
             op_pool=self.op_pool, graffiti=graffiti,
@@ -918,7 +938,7 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
         if not reveal_hex:
             raise ApiError(400, "randao_reveal required")
         slot = int(slot)
-        graffiti = bytes.fromhex(q["graffiti"][2:]) if "graffiti" in q else b"\x00" * 32
+        graffiti = bytes.fromhex(q["graffiti"][2:]) if "graffiti" in q else None
         block = self.chain.produce_block(
             slot, bytes.fromhex(reveal_hex[2:]),
             op_pool=self.op_pool, graffiti=graffiti,
@@ -1049,13 +1069,14 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
     def post_pool_voluntary_exits(self):
         body = self._read_body()
         types = types_for_slot(self.chain.spec, self.chain.current_slot)
-        exit_ = types.SignedVoluntaryExit.make(
-            message=types.VoluntaryExit.make(
-                epoch=int(body["message"]["epoch"]),
-                validator_index=int(body["message"]["validator_index"]),
-            ),
-            signature=bytes.fromhex(body["signature"][2:]),
-        )
+        with _client_input():
+            exit_ = types.SignedVoluntaryExit.make(
+                message=types.VoluntaryExit.make(
+                    epoch=int(body["message"]["epoch"]),
+                    validator_index=int(body["message"]["validator_index"]),
+                ),
+                signature=bytes.fromhex(body["signature"][2:]),
+            )
         if self.op_pool is not None:
             self.op_pool.insert_voluntary_exit(exit_)
         if self.event_bus is not None:
@@ -1087,19 +1108,20 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
         types = types_for_slot(self.chain.spec, self.chain.current_slot)
         if isinstance(body, dict):
             body = [body]
-        for c in body:
-            change = types.SignedBLSToExecutionChange.make(
-                message=types.BLSToExecutionChange.make(
-                    validator_index=int(c["message"]["validator_index"]),
-                    from_bls_pubkey=bytes.fromhex(c["message"]["from_bls_pubkey"][2:]),
-                    to_execution_address=bytes.fromhex(
-                        c["message"]["to_execution_address"][2:]
+        with _client_input():
+            for c in body:
+                change = types.SignedBLSToExecutionChange.make(
+                    message=types.BLSToExecutionChange.make(
+                        validator_index=int(c["message"]["validator_index"]),
+                        from_bls_pubkey=bytes.fromhex(c["message"]["from_bls_pubkey"][2:]),
+                        to_execution_address=bytes.fromhex(
+                            c["message"]["to_execution_address"][2:]
+                        ),
                     ),
-                ),
-                signature=bytes.fromhex(c["signature"][2:]),
-            )
-            if self.op_pool is not None:
-                self.op_pool.insert_bls_change(change)
+                    signature=bytes.fromhex(c["signature"][2:]),
+                )
+                if self.op_pool is not None:
+                    self.op_pool.insert_bls_change(change)
         self._json({})
 
     def get_pool_bls_changes(self):
@@ -1210,15 +1232,16 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
         publish path)."""
         body = self._read_body() or []
         types = types_for_slot(self.chain.spec, self.chain.current_slot)
-        msgs = [
-            types.SyncCommitteeMessage.make(
-                slot=int(m["slot"]),
-                beacon_block_root=bytes.fromhex(m["beacon_block_root"][2:]),
-                validator_index=int(m["validator_index"]),
-                signature=bytes.fromhex(m["signature"][2:]),
-            )
-            for m in body
-        ]
+        with _client_input():
+            msgs = [
+                types.SyncCommitteeMessage.make(
+                    slot=int(m["slot"]),
+                    beacon_block_root=bytes.fromhex(m["beacon_block_root"][2:]),
+                    validator_index=int(m["validator_index"]),
+                    signature=bytes.fromhex(m["signature"][2:]),
+                )
+                for m in body
+            ]
         accepted = self.chain.process_sync_committee_messages(msgs)
         if accepted != len(msgs):
             raise ApiError(400, f"{len(msgs) - accepted} messages failed")

@@ -941,7 +941,13 @@ class BeaconChain:
                 # tick (the clock may jump several epochs after a stall);
                 # only the newest target can read real participation flags —
                 # a state inside epoch E-1 has previous participation == E-2
-                oldest = 0 if prev_epoch_seen is None else max(0, prev_epoch_seen - 1)
+                # bounded backfill: none on the first tick (a checkpoint
+                # start at epoch 300k must not reconcile 300k empty epochs)
+                # and at most 32 epochs after a stall
+                if prev_epoch_seen is None:
+                    oldest = cur_epoch - 2
+                else:
+                    oldest = max(prev_epoch_seen - 1, cur_epoch - 2 - 32, 0)
                 for tgt in range(oldest, cur_epoch - 2):
                     self.monitor.finalize_epoch(tgt, None)
                 prev_start = (cur_epoch - 1) * spe
@@ -1354,7 +1360,7 @@ class BeaconChain:
         slot: int,
         randao_reveal: bytes,
         op_pool=None,
-        graffiti: bytes = b"\x00" * 32,
+        graffiti: bytes | None = None,
         blobs_bundle=None,
     ):
         """Produce an unsigned block on the head state
@@ -1367,6 +1373,10 @@ class BeaconChain:
         from ..state_transition.block import SignatureStrategy
         from ..types.spec import ForkName
 
+        if graffiti is None:
+            # node default (--graffiti / graffiti_calculator.rs role);
+            # callers (API) still override per request
+            graffiti = getattr(self, "graffiti", b"\x00" * 32)
         spec = self.spec
         types = types_for_slot(spec, slot)
         fork = spec.fork_name_at_slot(slot)

@@ -73,7 +73,12 @@ class WorkItem:
 class BeaconProcessorConfig:
     max_attestation_batch: int = DEFAULT_MAX_ATTESTATION_BATCH
     max_aggregate_batch: int = DEFAULT_MAX_AGGREGATE_BATCH
-    num_workers: int = 2
+    # cores-wide like the reference's pool (beacon_processor/src/lib.rs:732
+    # sizes by num_cpus); capped — beyond a few workers the Python-side
+    # share of each task stops scaling under the GIL
+    num_workers: int = field(
+        default_factory=lambda: max(2, min(8, __import__("os").cpu_count() or 2))
+    )
     # max device batches in flight before the pump blocks on the oldest —
     # the double-buffering depth (SURVEY §7 step 2: host marshals batch N+1
     # while the device verifies batch N)

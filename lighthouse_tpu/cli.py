@@ -14,6 +14,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import threading
 import time
 
 
@@ -57,12 +58,41 @@ def cmd_bn(args):
     spec = _load_spec(args)
     bls.set_backend(args.bls_backend)
 
+    anchor_block = None
     if args.interop_validators:
         keypairs = bls.interop_keypairs(args.interop_validators)
         genesis_time = args.genesis_time or int(time.time())
         state = interop_genesis_state(keypairs, genesis_time, spec)
+    elif args.genesis_state:
+        from .state_transition.slot import types_for_slot as _tfs
+
+        raw = open(args.genesis_state, "rb").read()
+        state = _tfs(spec, 0).BeaconState.deserialize(raw)
+    elif args.checkpoint_state:
+        # weak-subjectivity start from a finalized state + its block
+        # (client/src/builder.rs:366-528); backfill then fetches history
+        from .state_transition.slot import types_for_slot as _tfs
+
+        if not args.checkpoint_block:
+            print("error: --checkpoint-state requires --checkpoint-block",
+                  file=sys.stderr)
+            return 1
+        raw = open(args.checkpoint_state, "rb").read()
+        # every fork's BeaconState starts genesis_time(8) ||
+        # genesis_validators_root(32) || slot(8): read the slot to pick the
+        # fork's container types before the full decode
+        slot = int.from_bytes(raw[40:48], "little")
+        types = _tfs(spec, slot)
+        state = types.BeaconState.deserialize(raw)
+        anchor_block = types.SignedBeaconBlock.deserialize(
+            open(args.checkpoint_block, "rb").read()
+        )
     else:
-        print("error: provide --interop-validators N (checkpoint sync: use --checkpoint-state)", file=sys.stderr)
+        print(
+            "error: provide --interop-validators N, --genesis-state FILE, or "
+            "--checkpoint-state FILE --checkpoint-block FILE",
+            file=sys.stderr,
+        )
         return 1
 
     from .utils.task_executor import Lockfile, TaskExecutor
@@ -106,8 +136,11 @@ def cmd_bn(args):
 
     clock = SystemTimeSlotClock(state.genesis_time, spec.seconds_per_slot)
     chain = BeaconChain(
-        spec, state, store=store, slot_clock=clock, execution_layer=execution_layer
+        spec, state, store=store, slot_clock=clock,
+        execution_layer=execution_layer, anchor_block=anchor_block,
     )
+    if args.graffiti:
+        chain.graffiti = args.graffiti.encode()[:32].ljust(32, b"\x00")
     if getattr(args, "monitor_validators", None):
         if args.monitor_validators.strip().lower() == "auto":
             chain.monitor.auto_register = True
@@ -155,6 +188,42 @@ def cmd_bn(args):
         chain.slasher = slasher_svc
         log.info("slasher enabled")
 
+    net = None
+    if not args.disable_p2p:
+        from .network.node import NetworkNode
+        from .types import helpers as _h
+
+        fork = spec.fork_name_at_slot(chain.current_slot)
+        digest = _h.compute_fork_digest(
+            spec.fork_version(fork), chain.genesis_validators_root
+        )
+        import os as _os
+
+        net = NetworkNode(
+            chain,
+            # unique even when --p2p-port 0 picks a random bound port
+            node_id=f"bn-{chain.genesis_block_root.hex()[:8]}-{_os.urandom(3).hex()}",
+            fork_digest=digest,
+            port=args.p2p_port,
+            op_pool=op_pool,
+        )
+        log.info("p2p listening", addr=str(net.host.listen_addr),
+                 fork_digest=digest.hex())
+        if args.boot_nodes:
+            net.enable_discovery(boot_nodes=args.boot_nodes.split(","))
+            dialed = net.discover_and_dial(max_peers=args.target_peers)
+            log.info("discovery bootstrap", dialed=dialed)
+        for addr in (args.static_peers or "").split(","):
+            if not addr:
+                continue
+            host_s, _, port_s = addr.partition(":")
+            try:
+                net.host.dial(host_s, int(port_s))
+            except Exception as e:
+                # an unreachable static peer must not abort startup; the
+                # epoch top-up keeps retrying connectivity
+                log.warn("static peer dial failed", peer=addr, error=str(e))
+
     server, _t, port = serve(chain, op_pool=op_pool, port=args.http_port)
     log.info("HTTP API started", port=port)
     mserver, mport = metrics_http_server(port=args.metrics_port)
@@ -179,6 +248,19 @@ def cmd_bn(args):
             # slot tail: pre-compute the next-slot head state
             # (state_advance_timer analog)
             chain.advance_head_state()
+            # keep the peer count topped up from discovery (peer_manager
+            # maintenance role), once per epoch — on a helper thread: each
+            # dial can block seconds and must not stall the slot timer
+            deficit = (
+                args.target_peers - len(net.host.connections)
+                if net is not None and getattr(net, "discovery", None) is not None
+                else 0
+            )
+            if deficit > 0 and now % spec.preset.SLOTS_PER_EPOCH == 1:
+                threading.Thread(
+                    target=lambda: net.discover_and_dial(max_peers=deficit),
+                    name="peer-topup", daemon=True,
+                ).start()
 
     executor.spawn(slot_timer, "slot-timer")
     try:
@@ -188,6 +270,8 @@ def cmd_bn(args):
     finally:
         server.shutdown()
         mserver.shutdown()
+        if net is not None:
+            net.close()
         if store is not None:
             op_pool.persist(store, _tfs_pool(spec, 0))
         if lock is not None:
@@ -637,6 +721,23 @@ def build_parser() -> argparse.ArgumentParser:
              "missed-block/attestation alerts, /lighthouse_tpu/ui/"
              "validator-metrics), or 'auto' to track every validator",
     )
+    bn.add_argument("--p2p-port", type=int, default=9000,
+                    help="TCP listen port for the p2p stack (0 = random)")
+    bn.add_argument("--disable-p2p", action="store_true",
+                    help="run without the p2p stack (HTTP/metrics only)")
+    bn.add_argument("--boot-nodes", default=None,
+                    help="comma list of discovery boot nodes (host:udp_port)")
+    bn.add_argument("--static-peers", default=None,
+                    help="comma list of peers to dial directly (host:tcp_port)")
+    bn.add_argument("--target-peers", type=int, default=16)
+    bn.add_argument("--graffiti", default=None,
+                    help="default block graffiti (<=32 bytes utf-8)")
+    bn.add_argument("--genesis-state", default=None,
+                    help="SSZ BeaconState file to start from (genesis)")
+    bn.add_argument("--checkpoint-state", default=None,
+                    help="SSZ finalized BeaconState for checkpoint start")
+    bn.add_argument("--checkpoint-block", default=None,
+                    help="SSZ SignedBeaconBlock matching --checkpoint-state")
     bn.set_defaults(fn=cmd_bn)
 
     vc = sub.add_parser("vc", help="run a validator client")

@@ -180,6 +180,26 @@ class Slasher:
             if dirty:
                 self._put_chunk(v, kind, ci, chunk)
 
+    def _fill_range(self, v: int, kind: str, lo_e: int, hi_e: int, value: int) -> None:
+        """Write `value` into arr[lo_e..hi_e] chunk-granularly: interior
+        chunks are written as ONE prebuilt constant chunk (no read), so an
+        offline gap of G epochs costs G/CHUNK puts — not G element writes."""
+        if hi_e < lo_e:
+            return
+        full = [value] * CHUNK
+        ci = lo_e // CHUNK
+        last_ci = hi_e // CHUNK
+        while ci <= last_ci:
+            c_lo, c_hi = ci * CHUNK, (ci + 1) * CHUNK - 1
+            if lo_e <= c_lo and c_hi <= hi_e:
+                self._put_chunk(v, kind, ci, full)
+            else:
+                chunk = self._get_chunk(v, kind, ci)
+                for e in range(max(lo_e, c_lo), min(hi_e, c_hi) + 1):
+                    chunk[e % CHUNK] = value
+                self._put_chunk(v, kind, ci, chunk)
+            ci += 1
+
     def _record_attestation(self, v: int, source: int, target: int) -> None:
         """Fold (source, target) into both aggregate arrays + the bounds."""
         bounds = self._get_bounds(v)
@@ -196,14 +216,10 @@ class Slasher:
             # aggregate across the WHOLE gap — clamping the fill would
             # leave a hole inside [lo, hi'] that reads as "no attestations"
             # and mask surrounds that are well within the history window
-            # (the fill is chunk-granular, so even huge offline gaps cost
-            # gap/CHUNK writes exactly once)
-            self._walk_chunks(v, "maxbysrc", hi + 1, source, 1, gmax,
-                              lambda x: False)
+            self._fill_range(v, "maxbysrc", hi + 1, source, gmax)
             hi = source
         if source < lo:
-            self._walk_chunks(v, "minbysrc", lo - 1, source, -1, gmin,
-                              lambda x: False)
+            self._fill_range(v, "minbysrc", source, lo - 1, gmin)
             lo = source
         self._walk_chunks(v, "minbysrc", source, max(lo, source - MAX_HISTORY),
                           -1, target, lambda x: x <= target)
