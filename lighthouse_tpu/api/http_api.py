@@ -65,20 +65,18 @@ class ApiError(Exception):
         self.message = message
 
 
+from contextlib import contextmanager
+
+
+@contextmanager
 def _client_input():
     """Context manager for BODY PARSING in submission handlers: a malformed
     client payload (missing keys, wrong types, bad hex) maps to 400, while
     the same exception types escaping chain internals stay 500 faults."""
-    from contextlib import contextmanager
-
-    @contextmanager
-    def cm():
-        try:
-            yield
-        except (KeyError, TypeError, ValueError) as e:
-            raise ApiError(400, f"malformed body: {type(e).__name__}: {e}") from e
-
-    return cm()
+    try:
+        yield
+    except (KeyError, TypeError, ValueError) as e:
+        raise ApiError(400, f"malformed body: {type(e).__name__}: {e}") from e
 
 
 class BeaconApiHandler(BaseHTTPRequestHandler):
@@ -341,7 +339,8 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
 
     def post_attester_duties(self, epoch):
         body = self._read_body() or []
-        indices = [int(i) for i in body]
+        with _client_input():
+            indices = [int(i) for i in body]
         from ..validator.beacon_node import InProcessBeaconNode
 
         node = InProcessBeaconNode(self.chain)
@@ -582,8 +581,9 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
         self._json({"data": peers, "meta": {"count": len(peers)}})
 
     def post_sync_duties(self, epoch):
-        body = self._read_body()
-        indices = [int(i) for i in body]
+        body = self._read_body() or []
+        with _client_input():
+            indices = [int(i) for i in body]
         duties = []
         st = self.chain.head_state()
         for vi in indices:
@@ -634,15 +634,16 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
         """POST /eth/v1/validator/liveness/{epoch}: seen-on-chain/gossip
         indicator per validator (the reference answers from its liveness
         cache; here the observed-attesters gossip dedup set)."""
-        body = self._read_body()
+        body = self._read_body() or []
         epoch = int(epoch)
-        data = [
-            {
-                "index": _u(int(i)),
-                "is_live": (epoch, int(i)) in self.chain.observed_attesters,
-            }
-            for i in body
-        ]
+        with _client_input():
+            data = [
+                {
+                    "index": _u(int(i)),
+                    "is_live": (epoch, int(i)) in self.chain.observed_attesters,
+                }
+                for i in body
+            ]
         self._json({"data": data})
 
     def post_prepare_proposer(self):

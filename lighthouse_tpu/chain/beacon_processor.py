@@ -19,6 +19,7 @@ for tests (manual time), `start`/`stop` run the pump in threads.
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 from collections import deque
@@ -77,7 +78,7 @@ class BeaconProcessorConfig:
     # sizes by num_cpus); capped — beyond a few workers the Python-side
     # share of each task stops scaling under the GIL
     num_workers: int = field(
-        default_factory=lambda: max(2, min(8, __import__("os").cpu_count() or 2))
+        default_factory=lambda: max(2, min(8, os.cpu_count() or 2))
     )
     # max device batches in flight before the pump blocks on the oldest —
     # the double-buffering depth (SURVEY §7 step 2: host marshals batch N+1

@@ -213,16 +213,25 @@ def cmd_bn(args):
             net.enable_discovery(boot_nodes=args.boot_nodes.split(","))
             dialed = net.discover_and_dial(max_peers=args.target_peers)
             log.info("discovery bootstrap", dialed=dialed)
+        static_peers = []
         for addr in (args.static_peers or "").split(","):
             if not addr:
                 continue
             host_s, _, port_s = addr.partition(":")
-            try:
-                net.host.dial(host_s, int(port_s))
-            except Exception as e:
-                # an unreachable static peer must not abort startup; the
-                # epoch top-up keeps retrying connectivity
-                log.warn("static peer dial failed", peer=addr, error=str(e))
+            if not port_s.isdigit():
+                log.warn("ignoring malformed static peer", peer=addr)
+                continue
+            static_peers.append((host_s, int(port_s)))
+
+        def dial_static():
+            for host_s, port_i in static_peers:
+                try:
+                    net.host.dial(host_s, port_i)
+                except Exception as e:
+                    log.warn("static peer dial failed",
+                             peer=f"{host_s}:{port_i}", error=str(e))
+
+        dial_static()
 
     server, _t, port = serve(chain, op_pool=op_pool, port=args.http_port)
     log.info("HTTP API started", port=port)
@@ -248,19 +257,24 @@ def cmd_bn(args):
             # slot tail: pre-compute the next-slot head state
             # (state_advance_timer analog)
             chain.advance_head_state()
-            # keep the peer count topped up from discovery (peer_manager
-            # maintenance role), once per epoch — on a helper thread: each
-            # dial can block seconds and must not stall the slot timer
+            # keep the peer count topped up, once per epoch — on a helper
+            # thread: each dial can block seconds and must not stall the
+            # slot timer. Peerless nodes re-dial their static peers too
+            # (transient startup failures must not strand the node).
             deficit = (
                 args.target_peers - len(net.host.connections)
-                if net is not None and getattr(net, "discovery", None) is not None
-                else 0
+                if net is not None else 0
             )
             if deficit > 0 and now % spec.preset.SLOTS_PER_EPOCH == 1:
-                threading.Thread(
-                    target=lambda: net.discover_and_dial(max_peers=deficit),
-                    name="peer-topup", daemon=True,
-                ).start()
+
+                def topup(deficit=deficit):
+                    if not net.host.connections:
+                        dial_static()
+                    if getattr(net, "discovery", None) is not None:
+                        net.discover_and_dial(max_peers=deficit)
+
+                threading.Thread(target=topup, name="peer-topup",
+                                 daemon=True).start()
 
     executor.spawn(slot_timer, "slot-timer")
     try:

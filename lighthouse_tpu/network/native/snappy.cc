@@ -1,0 +1,189 @@
+// Native Snappy block-format codec (C ABI for ctypes).
+//
+// The eth2 wire protocol snappy-frames every gossip message and Req/Resp
+// chunk; the reference links google/snappy via the `snap` crate. This is a
+// from-scratch implementation of the block format (format description:
+// varint uncompressed length + literal/copy tagged elements) — the same
+// format lighthouse_tpu/network/snappy.py implements in pure Python; the
+// Python module prefers this library and differential tests pin the two
+// together (tests/test_network.py).
+//
+// Exports:
+//   snp_uncompressed_length(src, n, *out) -> 0 | -1
+//   snp_decompress(src, n, dst, cap)      -> bytes written | -1 (malformed)
+//   snp_max_compressed_length(n)          -> worst-case bound
+//   snp_compress(src, n, dst)             -> bytes written (always succeeds
+//                                            into a max-length buffer)
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+static int read_varint(const uint8_t* p, uint64_t n, uint64_t* pos,
+                       uint64_t* out) {
+  uint64_t v = 0;
+  int shift = 0;
+  while (*pos < n) {
+    uint8_t b = p[(*pos)++];
+    v |= (uint64_t)(b & 0x7f) << shift;
+    if (!(b & 0x80)) {
+      *out = v;
+      return 0;
+    }
+    shift += 7;
+    if (shift > 35) return -1;
+  }
+  return -1;
+}
+
+int snp_uncompressed_length(const uint8_t* src, uint64_t n, uint64_t* out) {
+  uint64_t pos = 0;
+  return read_varint(src, n, &pos, out);
+}
+
+int64_t snp_decompress(const uint8_t* src, uint64_t n, uint8_t* dst,
+                       uint64_t cap) {
+  uint64_t pos = 0, expected = 0;
+  if (read_varint(src, n, &pos, &expected) != 0) return -1;
+  if (expected > cap) return -1;
+  uint64_t o = 0;  // write cursor in dst
+  while (pos < n) {
+    uint8_t tag = src[pos++];
+    uint32_t type = tag & 3;
+    if (type == 0) {  // literal
+      uint64_t len = tag >> 2;
+      if (len < 60) {
+        len += 1;
+      } else {
+        uint32_t extra = (uint32_t)len - 59;
+        if (pos + extra > n) return -1;
+        uint64_t v = 0;
+        for (uint32_t i = 0; i < extra; i++) v |= (uint64_t)src[pos + i] << (8 * i);
+        pos += extra;
+        len = v + 1;
+      }
+      if (pos + len > n || o + len > cap) return -1;
+      memcpy(dst + o, src + pos, len);
+      pos += len;
+      o += len;
+      continue;
+    }
+    uint64_t len, offset;
+    if (type == 1) {
+      len = ((tag >> 2) & 0x7) + 4;
+      if (pos >= n) return -1;
+      offset = ((uint64_t)(tag >> 5) << 8) | src[pos];
+      pos += 1;
+    } else if (type == 2) {
+      len = (tag >> 2) + 1;
+      if (pos + 2 > n) return -1;
+      offset = (uint64_t)src[pos] | ((uint64_t)src[pos + 1] << 8);
+      pos += 2;
+    } else {
+      len = (tag >> 2) + 1;
+      if (pos + 4 > n) return -1;
+      offset = (uint64_t)src[pos] | ((uint64_t)src[pos + 1] << 8) |
+               ((uint64_t)src[pos + 2] << 16) | ((uint64_t)src[pos + 3] << 24);
+      pos += 4;
+    }
+    if (offset == 0 || offset > o || o + len > cap) return -1;
+    // copies may overlap (RLE-style): byte-wise when the ranges overlap
+    if (offset >= len) {
+      memcpy(dst + o, dst + o - offset, len);
+      o += len;
+    } else {
+      for (uint64_t i = 0; i < len; i++, o++) dst[o] = dst[o - offset];
+    }
+  }
+  if (o != expected) return -1;
+  return (int64_t)o;
+}
+
+uint64_t snp_max_compressed_length(uint64_t n) {
+  // varint (<=5) + worst case all-literal: per 2^24-ish chunk a 5-byte
+  // header; 32 + n + n/6 is the classic safe bound
+  return 32 + n + n / 6;
+}
+
+static inline uint32_t load32(const uint8_t* p) {
+  uint32_t v;
+  memcpy(&v, p, 4);
+  return v;
+}
+
+static inline uint32_t hash4(uint32_t v) { return (v * 0x1e35a7bdu) >> 18; }  // 14 bits
+
+static uint64_t emit_literal(uint8_t* dst, uint64_t o, const uint8_t* src,
+                             uint64_t from, uint64_t len) {
+  uint64_t l = len - 1;
+  if (l < 60) {
+    dst[o++] = (uint8_t)(l << 2);
+  } else if (l < (1ull << 8)) {
+    dst[o++] = 60 << 2;
+    dst[o++] = (uint8_t)l;
+  } else if (l < (1ull << 16)) {
+    dst[o++] = 61 << 2;
+    dst[o++] = (uint8_t)l;
+    dst[o++] = (uint8_t)(l >> 8);
+  } else if (l < (1ull << 24)) {
+    dst[o++] = 62 << 2;
+    dst[o++] = (uint8_t)l;
+    dst[o++] = (uint8_t)(l >> 8);
+    dst[o++] = (uint8_t)(l >> 16);
+  } else {
+    dst[o++] = 63 << 2;
+    dst[o++] = (uint8_t)l;
+    dst[o++] = (uint8_t)(l >> 8);
+    dst[o++] = (uint8_t)(l >> 16);
+    dst[o++] = (uint8_t)(l >> 24);
+  }
+  memcpy(dst + o, src + from, len);
+  return o + len;
+}
+
+int64_t snp_compress(const uint8_t* src, uint64_t n, uint8_t* dst) {
+  uint64_t o = 0;
+  // varint length header
+  uint64_t v = n;
+  while (true) {
+    uint8_t b = v & 0x7f;
+    v >>= 7;
+    if (v) {
+      dst[o++] = b | 0x80;
+    } else {
+      dst[o++] = b;
+      break;
+    }
+  }
+  if (n == 0) return (int64_t)o;
+
+  static const uint32_t TABLE_SIZE = 1u << 14;
+  uint32_t table[TABLE_SIZE];
+  memset(table, 0xff, sizeof(table));  // 0xffffffff = empty
+
+  uint64_t i = 0, lit_start = 0;
+  while (i + 4 <= n) {
+    uint32_t key = load32(src + i);
+    uint32_t h = hash4(key);
+    uint32_t cand = table[h];
+    table[h] = (uint32_t)i;
+    if (cand != 0xffffffffu && i - cand <= 0xffff && load32(src + cand) == key) {
+      uint64_t len = 4;
+      while (i + len < n && len < 64 && src[cand + len] == src[i + len]) len++;
+      if (lit_start < i) o = emit_literal(dst, o, src, lit_start, i - lit_start);
+      uint64_t offset = i - cand;
+      dst[o++] = (uint8_t)(((len - 1) << 2) | 2);  // copy2
+      dst[o++] = (uint8_t)offset;
+      dst[o++] = (uint8_t)(offset >> 8);
+      i += len;
+      lit_start = i;
+    } else {
+      i++;
+    }
+  }
+  if (lit_start < n) o = emit_literal(dst, o, src, lit_start, n - lit_start);
+  return (int64_t)o;
+}
+
+}  // extern "C"

@@ -1,14 +1,17 @@
-"""Pure-Python Snappy block-format codec.
+"""Snappy block-format codec: native C++ fast path + pure-Python reference.
 
 The eth2 wire protocol frames gossip messages and Req/Resp chunks with
 snappy (raw block format for gossip, framed for RPC streams — the
 ssz_snappy encoding of /root/reference/beacon_node/lighthouse_network/src/
-rpc/codec/). Python ships no snappy, and the environment is dependency-
-frozen, so this implements the block format directly:
+rpc/codec/, which links google/snappy natively via the `snap` crate).
+Python ships no snappy and the environment is dependency-frozen, so this
+module implements the block format twice:
 
-  decompress: full support (literals + all copy element types)
-  compress:   hash-table LZ with literal fallback — always valid output,
-              compatible with any conformant decoder
+  native/snappy.cc — the production path (built with g++ on first use,
+      loaded via ctypes): where sync throughput spends its framing CPU
+  pure Python below — the always-available reference implementation and
+      fallback; differential tests pin the two bit-compatible on the
+      decode side and round-trip-compatible on encode
 
 Snappy block format: varint uncompressed length, then tagged elements:
   tag & 3 == 0: literal, length (tag>>2)+1 (or 1-4 extra length bytes)
@@ -22,6 +25,94 @@ from __future__ import annotations
 
 class SnappyError(Exception):
     pass
+
+
+# ------------------------------------------------------------ native path
+
+_native = None
+_native_tried = False
+
+
+# Decompression output bound: no eth2 message (gossip max ~10 MiB) comes
+# close; an attacker-controlled length varint must never size an
+# allocation (the claimed length is checked against this BEFORE any
+# buffer is created).
+MAX_UNCOMPRESSED_LEN = 32 << 20
+
+
+def _load_native():
+    """Build/load the C++ codec; returns the ctypes lib or None (logged —
+    a broken toolchain silently pinning production to the slow path would
+    otherwise be invisible)."""
+    global _native, _native_tried
+    if _native_tried:
+        return _native
+    _native_tried = True
+    try:
+        import ctypes
+        import os
+        import subprocess
+        from pathlib import Path
+
+        src = Path(__file__).parent / "native" / "snappy.cc"
+        lib_path = Path(__file__).parent / "native" / "libltsnappy.so"
+        if not lib_path.exists() or lib_path.stat().st_mtime < src.stat().st_mtime:
+            # build to a per-pid temp path + atomic rename: concurrent
+            # processes must never CDLL a half-written library
+            tmp = lib_path.with_suffix(f".tmp.{os.getpid()}")
+            subprocess.run(
+                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                 str(src), "-o", str(tmp)],
+                check=True, capture_output=True,
+            )
+            os.replace(tmp, lib_path)
+        lib = ctypes.CDLL(str(lib_path))
+        lib.snp_uncompressed_length.restype = ctypes.c_int
+        lib.snp_uncompressed_length.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64)
+        ]
+        lib.snp_decompress.restype = ctypes.c_int64
+        lib.snp_decompress.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64
+        ]
+        lib.snp_max_compressed_length.restype = ctypes.c_uint64
+        lib.snp_max_compressed_length.argtypes = [ctypes.c_uint64]
+        lib.snp_compress.restype = ctypes.c_int64
+        lib.snp_compress.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p]
+        _native = lib
+    except Exception as e:
+        from ..utils.logging import get_logger
+
+        get_logger("snappy").warn(
+            "native snappy unavailable; using the pure-Python codec",
+            error=f"{type(e).__name__}: {e}",
+        )
+        _native = None
+    return _native
+
+
+def _native_decompress(lib, data: bytes) -> bytes:
+    import ctypes
+
+    out_len = ctypes.c_uint64()
+    if lib.snp_uncompressed_length(data, len(data), ctypes.byref(out_len)) != 0:
+        raise SnappyError("truncated varint")
+    if out_len.value > MAX_UNCOMPRESSED_LEN:
+        raise SnappyError("uncompressed length over limit")
+    buf = ctypes.create_string_buffer(out_len.value)
+    written = lib.snp_decompress(data, len(data), buf, out_len.value)
+    if written < 0:
+        raise SnappyError("malformed snappy block")
+    return buf.raw[:written]
+
+
+def _native_compress(lib, data: bytes) -> bytes:
+    import ctypes
+
+    cap = lib.snp_max_compressed_length(len(data))
+    buf = ctypes.create_string_buffer(cap)
+    written = lib.snp_compress(data, len(data), buf)
+    return buf.raw[:written]
 
 
 def _read_varint(data: bytes, pos: int) -> tuple[int, int]:
@@ -53,7 +144,16 @@ def _write_varint(n: int) -> bytes:
 
 
 def decompress(data: bytes) -> bytes:
+    lib = _load_native()
+    if lib is not None:
+        return _native_decompress(lib, data)
+    return _py_decompress(data)
+
+
+def _py_decompress(data: bytes) -> bytes:
     expected, pos = _read_varint(data, 0)
+    if expected > MAX_UNCOMPRESSED_LEN:
+        raise SnappyError("uncompressed length over limit")
     out = bytearray()
     n = len(data)
     while pos < n:
@@ -122,6 +222,13 @@ def _emit_literal(out: bytearray, chunk: bytes) -> None:
 
 
 def compress(data: bytes) -> bytes:
+    lib = _load_native()
+    if lib is not None:
+        return _native_compress(lib, data)
+    return _py_compress(data)
+
+
+def _py_compress(data: bytes) -> bytes:
     """Greedy hash-table matcher (4-byte anchors, 64KB window)."""
     out = bytearray(_write_varint(len(data)))
     n = len(data)

@@ -51,6 +51,39 @@ def test_snappy_roundtrip(data):
         assert len(comp) < len(data) // 2  # actually compresses
 
 
+def test_snappy_native_vs_python_differential():
+    """The C++ codec and the pure-Python reference must be cross-compatible
+    in BOTH directions on varied payloads, and the native decoder must
+    reject what the Python decoder rejects."""
+    import random
+
+    lib = snappy._load_native()
+    assert lib is not None, "native snappy failed to build"
+    rng = random.Random(0x5A4)
+    payloads = [
+        b"", b"x", b"hello world " * 100,
+        bytes(rng.randrange(256) for _ in range(5000)),     # incompressible
+        bytes(rng.randrange(4) for _ in range(20000)),      # compressible
+        b"\x00" * 65536 + b"tail",                          # long RLE
+        bytes(range(256)) * 300,
+    ]
+    for data in payloads:
+        c_native = snappy._native_compress(lib, data)
+        c_py = snappy._py_compress(data)
+        # cross-decode both ways, both decoders
+        assert snappy._py_decompress(c_native) == data
+        assert snappy._native_decompress(lib, c_py) == data
+        assert snappy._native_decompress(lib, c_native) == data
+        assert snappy._py_decompress(c_py) == data
+
+    # malformed inputs rejected identically
+    for bad in (b"\x05\xff\xff", b"\x0a\x02\x00\x01", b"\xff" * 8):
+        with pytest.raises(snappy.SnappyError):
+            snappy._native_decompress(lib, bad)
+        with pytest.raises(snappy.SnappyError):
+            snappy._py_decompress(bad)
+
+
 def test_snappy_rejects_garbage():
     with pytest.raises(snappy.SnappyError):
         snappy.decompress(b"\xff\xff\xff\xff\xff\xff")
