@@ -42,6 +42,7 @@ MCACHE_LEN = 5      # message-cache windows kept
 MCACHE_GOSSIP = 3   # windows advertised in IHAVE
 SEEN_TTL = 120.0
 PRUNE_BACKOFF = 10.0
+PX_PEERS = 6      # max peer-exchange records per PRUNE (v1.1)
 # duplicates count toward a mesh member's delivery quota only this long
 # after first delivery (peer_score.rs mesh_message_deliveries_window —
 # without it, echoing stale messages farms P3 credit for free)
@@ -64,7 +65,9 @@ class Rpc:
     ihave: list = field(default_factory=list)     # (topic, [ids])
     iwant: list = field(default_factory=list)     # [ids]
     graft: list = field(default_factory=list)     # [topic]
-    prune: list = field(default_factory=list)     # [topic]
+    # prune entries: topic str, or (topic, [(peer_id, host, port)]) with
+    # PX peer-exchange candidates (gossipsub v1.1 PRUNE.peers)
+    prune: list = field(default_factory=list)
 
     def empty(self) -> bool:
         return not (self.subs or self.msgs or self.ihave or self.iwant or self.graft or self.prune)
@@ -98,8 +101,17 @@ def encode_rpc(rpc: Rpc) -> bytes:
     for topic in rpc.graft:
         out.append(_w_topic(topic))
     out.append(struct.pack(">H", len(rpc.prune)))
-    for topic in rpc.prune:
-        out.append(_w_topic(topic))
+    for entry in rpc.prune:
+        topic, px = entry if isinstance(entry, tuple) else (entry, [])
+        out.append(_w_topic(topic) + bytes([len(px)]))
+        for pid, host, port in px:
+            pid_b = pid.encode()
+            host_b = host.encode()
+            out.append(
+                struct.pack(">H", len(pid_b)) + pid_b
+                + struct.pack(">H", len(host_b)) + host_b
+                + struct.pack(">H", port)
+            )
     return b"".join(out)
 
 
@@ -147,7 +159,22 @@ def decode_rpc(buf: bytes) -> Rpc:
     pos += 2
     for _ in range(n):
         topic, pos = _r_topic(buf, pos)
-        rpc.prune.append(topic)
+        n_px = buf[pos]
+        pos += 1
+        px = []
+        for _i in range(n_px):
+            plen = struct.unpack_from(">H", buf, pos)[0]
+            pos += 2
+            pid = buf[pos : pos + plen].decode()
+            pos += plen
+            hlen = struct.unpack_from(">H", buf, pos)[0]
+            pos += 2
+            host = buf[pos : pos + hlen].decode()
+            pos += hlen
+            port = struct.unpack_from(">H", buf, pos)[0]
+            pos += 2
+            px.append((pid, host, port))
+        rpc.prune.append((topic, px))
     return rpc
 
 
@@ -207,12 +234,20 @@ class Gossipsub:
     missing dependency arrives)."""
 
     def __init__(self, local_id: str, send, peer_manager=None, rng=None,
-                 score_params=None, thresholds=None):
+                 score_params=None, thresholds=None, addr_provider=None,
+                 px_handler=None):
         from .peer_score import PeerScore, PeerScoreThresholds
 
         self.local_id = local_id
         self._send_raw = send
         self.peer_manager = peer_manager
+        # PX peer exchange (v1.1 PRUNE.peers): addr_provider(peer_id) ->
+        # (host, port)|None supplies dialable addresses for candidates we
+        # attach to our PRUNEs; px_handler(topic, [(pid, host, port)])
+        # receives candidates from peers' PRUNEs (only from non-negative-
+        # score peers — PX from a misbehaving peer is an eclipse vector)
+        self.addr_provider = addr_provider
+        self.px_handler = px_handler
         self.rng = rng or random.Random(hash(local_id) & 0xFFFFFFFF)
 
         self.peers: set[str] = set()
@@ -300,7 +335,7 @@ class Gossipsub:
             self.subscriptions.discard(topic)
             self.handlers.pop(topic, None)
             for p in list(self.mesh.get(topic, ())):
-                self._send(p, Rpc(prune=[topic]))
+                self._send(p, Rpc(prune=[self._prune_entry(topic, exclude=p)]))
             self.mesh.pop(topic, None)
             for p in self.peers:
                 self._send(p, Rpc(subs=[(False, topic)]))
@@ -355,9 +390,16 @@ class Gossipsub:
                     self._mesh_remove(topic, peer_id)
             for topic in rpc.graft:
                 self._on_graft(peer_id, topic)
-            for topic in rpc.prune:
+            for entry in rpc.prune:
+                topic, px = entry if isinstance(entry, tuple) else (entry, [])
                 self._mesh_remove(topic, peer_id)
                 self.backoff[(peer_id, topic)] = time.monotonic() + PRUNE_BACKOFF
+                if (
+                    px
+                    and self.px_handler is not None
+                    and self.peer_score.score(peer_id) >= 0
+                ):
+                    self.px_handler(topic, px)
             reply = Rpc()
             # peers below the gossip threshold get no IHAVE/IWANT service
             gossip_ok = self.peer_score.score(peer_id) >= self.thresholds.gossip_threshold
@@ -383,18 +425,34 @@ class Gossipsub:
         for topic, data in rpc.msgs:
             self._on_message(peer_id, topic, data)
 
+    def _prune_entry(self, topic: str, exclude: str):
+        """PRUNE payload for `topic`: up to PX_PEERS mesh members (with
+        dialable addresses) the pruned peer can connect to instead."""
+        if self.addr_provider is None:
+            return topic
+        px = []
+        for pid in self.mesh.get(topic, ()):
+            if len(px) >= PX_PEERS:
+                break
+            if pid == exclude:
+                continue
+            addr = self.addr_provider(pid)
+            if addr is not None:
+                px.append((pid, addr[0], addr[1]))
+        return (topic, px)
+
     def _on_graft(self, peer_id: str, topic: str) -> None:
         if topic not in self.subscriptions:
-            self._send(peer_id, Rpc(prune=[topic]))
+            self._send(peer_id, Rpc(prune=[self._prune_entry(topic, exclude=peer_id)]))
             return
         until = self.backoff.get((peer_id, topic), 0)
         if time.monotonic() < until:
             # grafting while backoffed is a protocol violation (P7)
             self.peer_score.add_penalty(peer_id)
-            self._send(peer_id, Rpc(prune=[topic]))
+            self._send(peer_id, Rpc(prune=[self._prune_entry(topic, exclude=peer_id)]))
             return
         if self.peer_score.score(peer_id) < 0:
-            self._send(peer_id, Rpc(prune=[topic]))
+            self._send(peer_id, Rpc(prune=[self._prune_entry(topic, exclude=peer_id)]))
             return
         self._mesh_add(topic, peer_id)
 
@@ -502,7 +560,7 @@ class Gossipsub:
                 for p in [p for p in mesh if self.peer_score.score(p) < 0]:
                     self._mesh_remove(topic, p)
                     self.backoff[(p, topic)] = now + PRUNE_BACKOFF
-                    self._send(p, Rpc(prune=[topic]))
+                    self._send(p, Rpc(prune=[self._prune_entry(topic, exclude=p)]))
                 if len(mesh) < D_LOW:
                     candidates = [
                         p
@@ -520,7 +578,7 @@ class Gossipsub:
                     excess = self.rng.sample(sorted(mesh), len(mesh) - D)
                     for p in excess:
                         self._mesh_remove(topic, p)
-                        self._send(p, Rpc(prune=[topic]))
+                        self._send(p, Rpc(prune=[self._prune_entry(topic, exclude=p)]))
                 # IHAVE gossip to non-mesh subscribers
                 ids = self.mcache.gossip_ids(topic)
                 if ids:

@@ -45,13 +45,23 @@ class NetworkNode:
         self.peer_manager = PeerManager()
         self.rpc = RpcHandler(chain, fork_digest)
         self.sync = SyncManager(chain)
-        self.gossipsub = Gossipsub(node_id, self._gossip_send, self.peer_manager)
+        self.gossipsub = Gossipsub(
+            node_id,
+            self._gossip_send,
+            self.peer_manager,
+            addr_provider=self._peer_dial_addr,
+            px_handler=self._on_px,
+        )
         self.host = TcpHost(self, node_id, port=port)
         self.heartbeat_interval = heartbeat_interval
         self._hb_stop = threading.Event()
         self._hb_thread = threading.Thread(target=self._heartbeat_loop, daemon=True)
         self._hb_thread.start()
         self._lock = threading.Lock()  # serializes chain mutation from gossip
+        # PX dial rate limiting (see _on_px)
+        self._px_lock = threading.Lock()
+        self._px_dialing = False
+        self._px_seen: dict[tuple[str, int], float] = {}
         # Local reprocess queue (ReprocessQueue analog): sidecars whose
         # parent block hasn't arrived yet, keyed by the missing parent root.
         # Gossip redelivery is NOT guaranteed (mesh peers forward once), so
@@ -130,6 +140,61 @@ class NetworkNode:
     def connect(self, other: "NetworkNode") -> None:
         host, port = other.host.listen_addr
         self.host.dial(host, port)
+
+    # ------------------------------------------------------ peer exchange
+
+    MAX_PX_DIALS = 4
+    PX_ADDR_COOLDOWN = 60.0     # never re-dial a PX address within this
+
+    def _peer_dial_addr(self, peer_id: str):
+        """addr_provider for gossipsub PX: the peer's advertised listen
+        address learned in the transport HELLO."""
+        conn = self.host.connections.get(peer_id)
+        return None if conn is None else conn.peer_dial_addr
+
+    def _on_px(self, topic: str, px) -> None:
+        """A PRUNE carried peer-exchange candidates: dial a few unknown
+        ones on ONE helper thread (dials block; the gossip reader must
+        not). Rate-limited: at most one dial batch in flight and a per-
+        address cooldown — PX from peers is attacker-influencable, so it
+        must not become a thread bomb or traffic amplifier."""
+        import time as _t
+
+        now = _t.monotonic()
+        with self._px_lock:
+            if self._px_dialing:
+                return
+            fresh = []
+            for pid, host, port in px:
+                if pid == self.node_id or pid in self.host.connections:
+                    continue
+                if now - self._px_seen.get((host, port), -1e9) < self.PX_ADDR_COOLDOWN:
+                    continue
+                self._px_seen[(host, port)] = now
+                fresh.append((host, port))
+                if len(fresh) >= self.MAX_PX_DIALS:
+                    break
+            if len(self._px_seen) > 1024:           # bound the dedup table
+                cutoff = now - self.PX_ADDR_COOLDOWN
+                self._px_seen = {
+                    k: t for k, t in self._px_seen.items() if t >= cutoff
+                }
+            if not fresh:
+                return
+            self._px_dialing = True
+
+        def dial_all():
+            try:
+                for host, port in fresh:
+                    try:
+                        self.host.dial(host, port)
+                    except Exception:
+                        continue
+            finally:
+                with self._px_lock:
+                    self._px_dialing = False
+
+        threading.Thread(target=dial_all, name="px-dial", daemon=True).start()
 
     # ------------------------------------------------------------ discovery
 

@@ -68,6 +68,10 @@ class Connection:
         self.node = node
         self.local_id = local_id
         self.peer_id: str | None = None
+        # the peer's DIALABLE address: its socket IP + the listen port it
+        # advertises in HELLO (the ephemeral source port is useless for
+        # dialing back) — feeds gossipsub PX peer exchange
+        self.peer_dial_addr: tuple[str, int] | None = None
         self._send_lock = threading.Lock()
         self._streams: dict[int, queue.Queue] = {}
         self._next_stream = 1
@@ -81,7 +85,16 @@ class Connection:
             write_frame(self.sock, ftype, payload)
 
     def send_hello(self) -> None:
-        self._send(HELLO, self.local_id.encode())
+        ident = self.local_id.encode()
+        listen_port = 0
+        host = getattr(self.node, "host", None)
+        if host is not None:
+            try:
+                listen_port = host.listen_addr[1]
+            except Exception:
+                listen_port = 0
+        self._send(HELLO, struct.pack(">H", len(ident)) + ident
+                   + struct.pack(">H", listen_port))
 
     def send_gossip(self, rpc_bytes: bytes) -> None:
         try:
@@ -127,7 +140,23 @@ class Connection:
             while self.alive:
                 ftype, payload = read_frame(self.sock)
                 if ftype == HELLO:
-                    self.peer_id = payload.decode()
+                    # [u16 id_len][peer_id][u16 listen_port]
+                    try:
+                        id_len = struct.unpack(">H", payload[:2])[0]
+                        self.peer_id = payload[2 : 2 + id_len].decode()
+                        port = struct.unpack(
+                            ">H", payload[2 + id_len : 4 + id_len]
+                        )[0]
+                    except (struct.error, UnicodeDecodeError) as e:
+                        # malformed handshake: close via the reader's clean
+                        # error path, not an unhandled thread traceback
+                        raise TransportError(f"malformed HELLO: {e}") from e
+                    if port:
+                        try:
+                            ip = self.sock.getpeername()[0]
+                            self.peer_dial_addr = (ip, port)
+                        except OSError:
+                            pass
                     self.node._register_connection(self)
                 elif ftype == REQ:
                     sid, plen = struct.unpack(">QH", payload[:10])

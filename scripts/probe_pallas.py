@@ -157,5 +157,16 @@ ok = ok and stage("s1 mont_mul", s1)
 ok = ok and stage("s2 miller fused", s2)
 ok = ok and stage("s3 hard part fused", s3)
 ok = ok and stage("s4 all-stage verify fused", s4)
+
+# Record the verdict for other entry points (__graft_entry__, operators):
+# "ok" means Mosaic compiled + bit-validated every fused kernel on THIS
+# platform; anything else keeps auto-mode consumers on the XLA path.
+import json
+
+import pathlib
+
+with open(pathlib.Path(__file__).resolve().parent.parent / "PALLAS_STATUS.json", "w") as f:
+    json.dump({"ok": bool(ok), "platform": str(jax.devices())}, f)
+
 print("PALLAS PROBE:", "ALL OK" if ok else "FAILED", flush=True)
 sys.exit(0 if ok else 1)

@@ -31,7 +31,7 @@ def test_rpc_encoding_roundtrip():
     assert got.ihave == rpc.ihave
     assert got.iwant == rpc.iwant
     assert got.graft == rpc.graft
-    assert got.prune == rpc.prune
+    assert got.prune == [("t2", []), ("t3", [])]  # decode normalizes to tuples
 
 
 class Net:
@@ -261,3 +261,62 @@ def test_discovery_driven_dial():
             n.discovery.close()
             n.close()
         boot.close()
+
+
+def test_gossipsub_px_peer_exchange():
+    """v1.1 PX: a PRUNE carries dialable mesh members (addresses learned in
+    the transport HELLO), and the pruned node dials one it doesn't know."""
+    import time
+
+    from lighthouse_tpu.chain.beacon_chain import BeaconChain
+    from lighthouse_tpu.network import gossip as gtop
+    from lighthouse_tpu.network import gossipsub as gs
+    from lighthouse_tpu.network.node import NetworkNode
+    from lighthouse_tpu.testing.harness import StateHarness, clone_state
+
+    bls.set_backend("fake")
+    spec = minimal_spec()
+    h = StateHarness.new(spec, 16)
+    nodes = []
+    try:
+        for i in range(3):
+            chain = BeaconChain(spec, clone_state(h.state, spec))
+            nodes.append(NetworkNode(chain, f"px{i}", subnets=1))
+        a, b, c = nodes
+        # a knows both; b and c don't know each other
+        b.connect(a)
+        c.connect(a)
+        deadline = time.monotonic() + 5
+        while len(a.host.connections) < 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert len(a.host.connections) == 2
+        # HELLO advertised dialable addresses for PX
+        assert a._peer_dial_addr(b.node_id) is not None
+        assert a._peer_dial_addr(c.node_id) is not None
+
+        topic = gtop.topic_name(a.fork_digest, "beacon_block")
+        # a's mesh contains both; prune b with PX pointing at c
+        a.gossipsub.mesh[topic].update({b.node_id, c.node_id})
+        entry = a.gossipsub._prune_entry(topic, exclude=b.node_id)
+        assert isinstance(entry, tuple) and entry[1], "no PX records attached"
+        assert entry[1][0][0] == c.node_id
+        a.gossipsub._send(b.node_id, gs.Rpc(prune=[entry]))
+
+        deadline = time.monotonic() + 5
+        while c.node_id not in b.host.connections and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert c.node_id in b.host.connections, "pruned node never dialed PX peer"
+    finally:
+        for n in nodes:
+            n.close()
+
+
+def test_gossipsub_rpc_px_roundtrip():
+    from lighthouse_tpu.network.gossipsub import Rpc, decode_rpc, encode_rpc
+
+    rpc = Rpc(prune=["plain-topic", ("px-topic", [("peerA", "10.0.0.1", 9000),
+                                                 ("peerB", "example.org", 12345)])])
+    out = decode_rpc(encode_rpc(rpc))
+    assert out.prune[0] == ("plain-topic", [])
+    assert out.prune[1] == ("px-topic", [("peerA", "10.0.0.1", 9000),
+                                         ("peerB", "example.org", 12345)])
