@@ -17,6 +17,22 @@ _DEFAULT_CACHE_DIR = os.environ.get(
 _initialized = False
 
 
+def _cpu_fingerprint() -> str:
+    """Short hash of the host CPU's feature flags (stable per machine)."""
+    import hashlib
+
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    return hashlib.md5(line.encode()).hexdigest()[:8]
+    except OSError:
+        pass
+    import platform as _p
+
+    return hashlib.md5(_p.processor().encode()).hexdigest()[:8]
+
+
 def setup_compilation_cache(cache_dir: str | None = None) -> None:
     global _initialized
     if _initialized:
@@ -28,8 +44,25 @@ def setup_compilation_cache(cache_dir: str | None = None) -> None:
     # with mismatched machine features (observed: cpu_aot_loader warnings
     # followed by a segfault inside the cache writer).
     platform = str(jax.config.jax_platforms or "default").split(",")[0]
+    if platform in ("cpu", "default"):
+        # XLA:CPU cache keys do NOT include host CPU features: entries
+        # compiled on a different machine (avx512-full) load here with
+        # "could lead to SIGILL" warnings and waste the load attempt.
+        # Fingerprint the host's feature set into the directory name.
+        platform = f"{platform}-{_cpu_fingerprint()}"
     path = cache_dir or os.path.join(_DEFAULT_CACHE_DIR, platform)
-    os.makedirs(path, exist_ok=True)
+    if not os.path.isdir(path):
+        os.makedirs(path, exist_ok=True)
+        # one-time migration: adopt entries from the pre-fingerprint dir
+        # (locally-compiled ones are valid; foreign ones were already being
+        # rejected at load time)
+        legacy = os.path.join(_DEFAULT_CACHE_DIR, platform.split("-")[0])
+        if legacy != path and os.path.isdir(legacy):
+            for name in os.listdir(legacy):
+                try:
+                    os.link(os.path.join(legacy, name), os.path.join(path, name))
+                except OSError:
+                    pass
     jax.config.update("jax_compilation_cache_dir", path)
     # Cache everything, including small/fast compiles.
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
