@@ -331,9 +331,11 @@ class JaxBackend:
             pk_x[i, : len(keys)] = xs
             pk_y[i, : len(keys)] = ys
             pk_mask[i, : len(keys)] = 1
-        from ...parallel import put_sets
+        from ...parallel import put_pk_grid
 
-        dx, dy, dm = put_sets(pk_x), put_sets(pk_y), put_sets(pk_mask)
+        # (n, m, ...) pubkey arrays: set axis sharded; on a 2-D mesh the
+        # pubkey axis is sharded too (within-set aggregation parallelism)
+        dx, dy, dm = put_pk_grid(pk_x), put_pk_grid(pk_y), put_pk_grid(pk_mask)
         # keep strong refs to the key objects so ids stay valid while cached
         keepalive = (fp, [pk for s in sets for pk in s.signing_keys])
         self._pk_cache[fp] = (dx, dy, dm, keepalive)
@@ -352,7 +354,9 @@ class JaxBackend:
         # device mesh (multi-chip: sets are data-parallel over the mesh,
         # the cross-set reductions become collectives — parallel/mesh.py)
         n = pad_sets(max(MIN_SETS, _next_pow2(n_real)))
-        m = max(MIN_PKS, _next_pow2(max(len(s.signing_keys) for s in sets)))
+        from ...parallel import pad_pks
+
+        m = pad_pks(max(MIN_PKS, _next_pow2(max(len(s.signing_keys) for s in sets))))
 
         pk_x, pk_y, pk_mask = self._marshal_pubkeys(sets, n, m)
 

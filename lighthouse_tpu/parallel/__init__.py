@@ -1,6 +1,8 @@
 """Multi-chip parallelism: the device mesh + sharding layout of the
 verification pipeline (see mesh.py)."""
 
-from .mesh import get_mesh, pad_sets, put_sets, reset_mesh_cache, sets_sharding
+from .mesh import (get_mesh, pad_pks, pad_sets, put_pk_grid, put_sets,
+                   reset_mesh_cache, sets_sharding)
 
-__all__ = ["get_mesh", "pad_sets", "put_sets", "reset_mesh_cache", "sets_sharding"]
+__all__ = ["get_mesh", "pad_pks", "pad_sets", "put_pk_grid", "put_sets",
+           "reset_mesh_cache", "sets_sharding"]

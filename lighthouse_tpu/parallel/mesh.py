@@ -17,14 +17,23 @@ from __future__ import annotations
 import os
 
 SET_AXIS = "sets"
+PK_AXIS = "pks"
 
 _cached: list = []  # [mesh_or_None] once resolved
 
 
 def get_mesh():
-    """The process-wide 1-D device mesh over the `sets` axis, or None when
-    only one device is attached (or LIGHTHOUSE_TPU_MESH=0). Resolved once —
-    device topology does not change within a process."""
+    """The process-wide device mesh, or None when only one device is
+    attached (or LIGHTHOUSE_TPU_MESH=0). Resolved once — device topology
+    does not change within a process.
+
+    Default shape: 1-D over the `sets` axis (signature sets are
+    data-parallel). LIGHTHOUSE_TPU_PK_SHARDS=k > 1 folds the devices into a
+    2-D (sets, pks) mesh: the PUBKEY axis of each set is also sharded, so a
+    single huge aggregation (the 512-pubkey sync-committee case — the
+    within-set Pippenger-style parallelism SURVEY §5 calls for) spreads its
+    point tree across chips, with the tree reduction lowering to
+    collectives over the pks axis."""
     if _cached:
         return _cached[0]
     mesh = None
@@ -36,7 +45,32 @@ def get_mesh():
             import numpy as np
             from jax.sharding import Mesh
 
-            mesh = Mesh(np.array(devices), (SET_AXIS,))
+            raw = os.environ.get("LIGHTHOUSE_TPU_PK_SHARDS", "1")
+            try:
+                pk_shards = int(raw)
+            except ValueError:
+                pk_shards = 1
+            # the kernels' tree reductions are pow2-structured: only accept
+            # a pow2 shard count that divides the device count (anything
+            # else falls back to the 1-D mesh, loudly)
+            valid = (
+                pk_shards > 1
+                and pk_shards & (pk_shards - 1) == 0
+                and len(devices) % pk_shards == 0
+            )
+            if pk_shards > 1 and not valid:
+                from ..utils.logging import get_logger
+
+                get_logger("mesh").warn(
+                    "ignoring LIGHTHOUSE_TPU_PK_SHARDS (must be a power of "
+                    "two dividing the device count)",
+                    value=raw, devices=len(devices),
+                )
+            if valid:
+                grid = np.array(devices).reshape(-1, pk_shards)
+                mesh = Mesh(grid, (SET_AXIS, PK_AXIS))
+            else:
+                mesh = Mesh(np.array(devices), (SET_AXIS,))
     _cached.append(mesh)
     return mesh
 
@@ -55,6 +89,16 @@ def sets_sharding(mesh, ndim: int):
     return NamedSharding(mesh, PartitionSpec(SET_AXIS, *([None] * (ndim - 1))))
 
 
+def pks_sharding(mesh, ndim: int):
+    """NamedSharding partitioning (set, pubkey) leading axes — for the
+    (n, m, ...) pubkey coordinate arrays on a 2-D mesh."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(
+        mesh, PartitionSpec(SET_AXIS, PK_AXIS, *([None] * (ndim - 2)))
+    )
+
+
 def put_sets(a, mesh=None):
     """Place an array with its leading axis sharded over the mesh; plain
     device_put when no mesh. The leading dimension must divide the mesh
@@ -70,11 +114,53 @@ def put_sets(a, mesh=None):
     return jax.device_put(a, sets_sharding(mesh, np.ndim(a)))
 
 
+def put_pk_grid(a, mesh=None):
+    """Place an (n_sets, n_pks, ...) pubkey array: set axis sharded always;
+    pubkey axis additionally sharded on a 2-D mesh."""
+    import jax
+
+    if mesh is None:
+        mesh = get_mesh()
+    if mesh is None:
+        return jax.device_put(a)
+    import numpy as np
+
+    if PK_AXIS in mesh.axis_names:
+        return jax.device_put(a, pks_sharding(mesh, np.ndim(a)))
+    return jax.device_put(a, sets_sharding(mesh, np.ndim(a)))
+
+
+def _axis_size(mesh, axis: str) -> int:
+    return mesh.shape[axis] if mesh is not None and axis in mesh.axis_names else 1
+
+
+def _pad_pow2_multiple(n: int, size: int) -> int:
+    """Smallest power of two >= n that is also a multiple of `size` — the
+    kernels' tree reductions are pow2-structured AND sharded axes must
+    divide the mesh axis, so both constraints apply together."""
+    p = 1
+    while p < max(n, 1):
+        p *= 2
+    while p % size:
+        p *= 2
+    return p
+
+
 def pad_sets(n: int, mesh=None) -> int:
-    """Round a set count up so it divides evenly across the mesh."""
+    """Round a set count up so it divides evenly across the mesh (and stays
+    a power of two for the signature tree-sum)."""
     if mesh is None:
         mesh = get_mesh()
     if mesh is None:
         return n
-    size = mesh.devices.size
-    return ((n + size - 1) // size) * size
+    return _pad_pow2_multiple(n, _axis_size(mesh, SET_AXIS))
+
+
+def pad_pks(m: int, mesh=None) -> int:
+    """Round a per-set pubkey count up to a pow2 multiple of the pks axis
+    (the pubkey aggregation is a pow2 halving tree)."""
+    if mesh is None:
+        mesh = get_mesh()
+    if mesh is None:
+        return m
+    return _pad_pow2_multiple(m, _axis_size(mesh, PK_AXIS))

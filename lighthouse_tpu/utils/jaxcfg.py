@@ -21,16 +21,23 @@ def _cpu_fingerprint() -> str:
     """Short hash of the host CPU's feature flags (stable per machine)."""
     import hashlib
 
+    stable = []
     try:
         with open("/proc/cpuinfo") as f:
             for line in f:
-                if line.startswith("flags"):
-                    return hashlib.md5(line.encode()).hexdigest()[:8]
+                # stable identity lines only (frequency etc. change per
+                # boot); "Features" is the aarch64 spelling of "flags"
+                if line.startswith(("flags", "Features", "model name", "cpu model")):
+                    stable.append(line)
+                if len(stable) >= 4:
+                    break
     except OSError:
         pass
-    import platform as _p
+    if not stable:
+        import platform as _p
 
-    return hashlib.md5(_p.processor().encode()).hexdigest()[:8]
+        stable = [_p.processor() or _p.machine()]
+    return hashlib.md5("".join(stable).encode()).hexdigest()[:8]
 
 
 def setup_compilation_cache(cache_dir: str | None = None) -> None:
@@ -51,11 +58,12 @@ def setup_compilation_cache(cache_dir: str | None = None) -> None:
         # Fingerprint the host's feature set into the directory name.
         platform = f"{platform}-{_cpu_fingerprint()}"
     path = cache_dir or os.path.join(_DEFAULT_CACHE_DIR, platform)
-    if not os.path.isdir(path):
+    if cache_dir is None and not os.path.isdir(path):
         os.makedirs(path, exist_ok=True)
-        # one-time migration: adopt entries from the pre-fingerprint dir
-        # (locally-compiled ones are valid; foreign ones were already being
-        # rejected at load time)
+        # one-time best-effort migration from the pre-fingerprint dir:
+        # locally-compiled entries stay valid; any foreign ones keep being
+        # rejected at load (a one-time carry-over cost — new foreign
+        # entries can no longer mix in)
         legacy = os.path.join(_DEFAULT_CACHE_DIR, platform.split("-")[0])
         if legacy != path and os.path.isdir(legacy):
             for name in os.listdir(legacy):
@@ -63,6 +71,7 @@ def setup_compilation_cache(cache_dir: str | None = None) -> None:
                     os.link(os.path.join(legacy, name), os.path.join(path, name))
                 except OSError:
                     pass
+    os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
     # Cache everything, including small/fast compiles.
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
