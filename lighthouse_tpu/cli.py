@@ -206,6 +206,8 @@ def cmd_bn(args):
             fork_digest=digest,
             port=args.p2p_port,
             op_pool=op_pool,
+            encrypt=not args.disable_p2p_encryption,
+            require_encryption=args.require_p2p_encryption,
         )
         log.info("p2p listening", addr=str(net.host.listen_addr),
                  fork_digest=digest.hex())
@@ -744,6 +746,10 @@ def build_parser() -> argparse.ArgumentParser:
     bn.add_argument("--static-peers", default=None,
                     help="comma list of peers to dial directly (host:tcp_port)")
     bn.add_argument("--target-peers", type=int, default=16)
+    bn.add_argument("--disable-p2p-encryption", action="store_true",
+                    help="plaintext transport (EHELLO/AES-GCM is the default)")
+    bn.add_argument("--require-p2p-encryption", action="store_true",
+                    help="reject peers that refuse transport encryption")
     bn.add_argument("--graffiti", default=None,
                     help="default block graffiti (<=32 bytes utf-8)")
     bn.add_argument("--genesis-state", default=None,

@@ -36,6 +36,8 @@ class NetworkNode:
         heartbeat_interval: float = 0.3,
         subnets: int | None = None,
         op_pool=None,
+        encrypt: bool = True,
+        require_encryption: bool = False,
     ):
         self.chain = chain
         chain._network_node = self          # identity/peers API surface
@@ -52,7 +54,10 @@ class NetworkNode:
             addr_provider=self._peer_dial_addr,
             px_handler=self._on_px,
         )
-        self.host = TcpHost(self, node_id, port=port)
+        # transport consults this: when True, plaintext-HELLO peers are
+        # rejected instead of served unencrypted
+        self.require_encryption = require_encryption
+        self.host = TcpHost(self, node_id, port=port, encrypt=encrypt)
         self.heartbeat_interval = heartbeat_interval
         self._hb_stop = threading.Event()
         self._hb_thread = threading.Thread(target=self._heartbeat_loop, daemon=True)

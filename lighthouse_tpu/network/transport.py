@@ -6,15 +6,28 @@ deliberately small equivalent: length-prefixed frames over TCP, one
 connection per peer pair, with RPC streams multiplexed by id and gossip
 pushed as fire-and-forget frames
 (/root/reference/beacon_node/lighthouse_network/src/service/mod.rs is the
-structural model; encryption/mplex are not consensus-relevant and stay out).
+structural model; mplex stays out, encryption is the EHELLO/ENC layer below).
 
 Frame format (big-endian): [u8 type][u32 length][payload]
-  HELLO      0: peer_id utf-8 (each side sends one on connect)
+  HELLO      0: [u16 id_len][peer_id][u16 listen_port] (plaintext peer)
   REQ        1: [u64 stream][u16 proto_len][protocol][request bytes]
   RESP_CHUNK 2: [u64 stream][chunk bytes]
   RESP_END   3: [u64 stream]
   GOSSIP     4: gossipsub RPC (see gossipsub.encode_rpc)
   CLOSE      5: goodbye
+  EHELLO     6: HELLO payload plus a 32-byte X25519 ephemeral pubkey; when
+                BOTH sides send EHELLO every later frame travels inside ENC
+  ENC        7: AES-256-GCM(nonce = dir counter, inner frame bytes)
+
+Encryption (the libp2p-noise role in the reference's tcp+noise stack):
+each side sends an ephemeral X25519 key in EHELLO; the shared secret
+expands through HKDF-SHA256 into two directional AES-GCM keys with counter
+nonces, so all post-handshake traffic - gossip, Req/Resp, goodbye - is
+encrypted and integrity-protected. Ephemeral-only DH gives
+confidentiality against passive observers but NO peer authentication (no
+node identity keys yet); an active MITM is documented out of scope. A peer
+that sends plain HELLO gets plaintext service (interop fallback) unless
+the host requires encryption.
 
 Threading model: a reader thread per connection; outbound requests block on
 a per-stream queue (the synchronous `handle()` surface SyncManager already
@@ -29,9 +42,13 @@ import struct
 import threading
 import time
 
-HELLO, REQ, RESP_CHUNK, RESP_END, GOSSIP, CLOSE = range(6)
+HELLO, REQ, RESP_CHUNK, RESP_END, GOSSIP, CLOSE, EHELLO, ENC = range(8)
 
 MAX_FRAME = 16 * 1024 * 1024
+# ENC wraps an inner frame in 1 type byte + 16-byte GCM tag: the receiver
+# allows that overhead so a MAX_FRAME payload is sendable on both transport
+# modes
+MAX_WIRE_FRAME = MAX_FRAME + 64
 
 
 class TransportError(Exception):
@@ -51,7 +68,7 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 def read_frame(sock: socket.socket) -> tuple[int, bytes]:
     hdr = _recv_exact(sock, 5)
     ftype, ln = hdr[0], struct.unpack(">I", hdr[1:])[0]
-    if ln > MAX_FRAME:
+    if ln > MAX_WIRE_FRAME:
         raise TransportError("frame too large")
     return ftype, _recv_exact(sock, ln)
 
@@ -63,7 +80,8 @@ def write_frame(sock: socket.socket, ftype: int, payload: bytes) -> None:
 class Connection:
     """One live peer connection (either direction)."""
 
-    def __init__(self, sock: socket.socket, local_id: str, node):
+    def __init__(self, sock: socket.socket, local_id: str, node,
+                 encrypt: bool = True, dialer: bool = False):
         self.sock = sock
         self.node = node
         self.local_id = local_id
@@ -77,14 +95,52 @@ class Connection:
         self._next_stream = 1
         self._stream_lock = threading.Lock()
         self.alive = True
+        # encryption state (see module docstring): keys exist only after
+        # both EHELLOs; the dialer role fixes key directionality
+        self.encrypt = encrypt
+        self.dialer = dialer
+        self._eph_priv = None
+        self._tx = None            # (AESGCM, counter) for sending
+        self._rx = None            # (AESGCM, counter) for receiving
+
+    # --------------------------------------------------------- encryption
+
+    def _derive_keys(self, peer_pub_bytes: bytes) -> None:
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.asymmetric.x25519 import (
+            X25519PublicKey,
+        )
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+        from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
+        shared = self._eph_priv.exchange(X25519PublicKey.from_public_bytes(peer_pub_bytes))
+        okm = HKDF(
+            algorithm=hashes.SHA256(), length=64, salt=None,
+            info=b"lighthouse-tpu/p2p/1",
+        ).derive(shared)
+        k_dial, k_listen = AESGCM(okm[:32]), AESGCM(okm[32:])
+        if self.dialer:
+            self._tx, self._rx = [k_dial, 0], [k_listen, 0]
+        else:
+            self._tx, self._rx = [k_listen, 0], [k_dial, 0]
+
+    @staticmethod
+    def _nonce(counter: int) -> bytes:
+        return counter.to_bytes(12, "big")
 
     # ------------------------------------------------------------- sending
 
     def _send(self, ftype: int, payload: bytes) -> None:
         with self._send_lock:
-            write_frame(self.sock, ftype, payload)
+            if self._tx is not None:
+                key, ctr = self._tx
+                self._tx[1] = ctr + 1
+                inner = bytes([ftype]) + payload
+                write_frame(self.sock, ENC, key.encrypt(self._nonce(ctr), inner, b""))
+            else:
+                write_frame(self.sock, ftype, payload)
 
-    def send_hello(self) -> None:
+    def _hello_payload(self) -> bytes:
         ident = self.local_id.encode()
         listen_port = 0
         host = getattr(self.node, "host", None)
@@ -93,8 +149,24 @@ class Connection:
                 listen_port = host.listen_addr[1]
             except Exception:
                 listen_port = 0
-        self._send(HELLO, struct.pack(">H", len(ident)) + ident
-                   + struct.pack(">H", listen_port))
+        return struct.pack(">H", len(ident)) + ident + struct.pack(">H", listen_port)
+
+    def send_hello(self) -> None:
+        if self.encrypt:
+            from cryptography.hazmat.primitives.asymmetric.x25519 import (
+                X25519PrivateKey,
+            )
+            from cryptography.hazmat.primitives.serialization import (
+                Encoding, PublicFormat,
+            )
+
+            self._eph_priv = X25519PrivateKey.generate()
+            pub = self._eph_priv.public_key().public_bytes(
+                Encoding.Raw, PublicFormat.Raw
+            )
+            self._send(EHELLO, self._hello_payload() + pub)
+        else:
+            self._send(HELLO, self._hello_payload())
 
     def send_gossip(self, rpc_bytes: bytes) -> None:
         try:
@@ -139,24 +211,61 @@ class Connection:
         try:
             while self.alive:
                 ftype, payload = read_frame(self.sock)
-                if ftype == HELLO:
-                    # [u16 id_len][peer_id][u16 listen_port]
+                if ftype == ENC:
+                    if self._rx is None:
+                        raise TransportError("ENC frame before handshake")
+                    key, ctr = self._rx
+                    self._rx[1] = ctr + 1
+                    try:
+                        inner = key.decrypt(self._nonce(ctr), payload, b"")
+                    except Exception as e:
+                        raise TransportError(f"decryption failed: {e}") from e
+                    if not inner:
+                        raise TransportError("empty ENC frame")
+                    ftype, payload = inner[0], inner[1:]
+                if ftype in (HELLO, EHELLO):
+                    # [u16 id_len][peer_id][u16 listen_port] (+ EHELLO:
+                    # [32B X25519 pubkey])
                     try:
                         id_len = struct.unpack(">H", payload[:2])[0]
-                        self.peer_id = payload[2 : 2 + id_len].decode()
+                        pid = payload[2 : 2 + id_len].decode()
                         port = struct.unpack(
                             ">H", payload[2 + id_len : 4 + id_len]
                         )[0]
-                    except (struct.error, UnicodeDecodeError) as e:
-                        # malformed handshake: close via the reader's clean
-                        # error path, not an unhandled thread traceback
+                        if ftype == EHELLO:
+                            pub = payload[4 + id_len : 36 + id_len]
+                            if len(pub) != 32:
+                                raise TransportError("bad EHELLO pubkey")
+                            if self._eph_priv is not None:
+                                # derive BEFORE exposing peer_id: dial()
+                                # unblocks on peer_id, and its caller's
+                                # first frame must already encrypt
+                                self._derive_keys(pub)
+                            # plaintext-configured host: serve the peer in
+                            # plaintext (it accepts both until our HELLO)
+                    except TransportError:
+                        raise
+                    except (struct.error, UnicodeDecodeError, ValueError) as e:
+                        # malformed handshake (incl. low-order X25519
+                        # points rejected by the key exchange): close via
+                        # the reader's clean error path, not an unhandled
+                        # thread traceback
                         raise TransportError(f"malformed HELLO: {e}") from e
+                    if ftype == HELLO and self.encrypt and getattr(
+                        self.node, "require_encryption", False
+                    ):
+                        raise TransportError("peer refused encryption")
+                    if ftype == HELLO:
+                        # peer is plaintext: drop our pending key material
+                        self._eph_priv = None
+                        self._tx = self._rx = None
                     if port:
                         try:
                             ip = self.sock.getpeername()[0]
                             self.peer_dial_addr = (ip, port)
                         except OSError:
                             pass
+                    self.peer_id = pid
                     self.node._register_connection(self)
                 elif ftype == REQ:
                     sid, plen = struct.unpack(">QH", payload[:10])
@@ -233,9 +342,11 @@ class TcpHost:
       _register_connection(conn) / _unregister_connection(conn)
     """
 
-    def __init__(self, node, local_id: str, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, node, local_id: str, host: str = "127.0.0.1", port: int = 0,
+                 encrypt: bool = True):
         self.node = node
         self.local_id = local_id
+        self.encrypt = encrypt
         self.server = socket.create_server((host, port))
         self.host, self.port = self.server.getsockname()
         self.connections: dict[str, Connection] = {}
@@ -260,8 +371,9 @@ class TcpHost:
                 # health probe) must not kill the accept thread
                 continue
 
-    def _spawn(self, sock: socket.socket) -> Connection:
-        conn = Connection(sock, self.local_id, self.node)
+    def _spawn(self, sock: socket.socket, dialer: bool = False) -> Connection:
+        conn = Connection(sock, self.local_id, self.node,
+                          encrypt=self.encrypt, dialer=dialer)
         # HELLO must hit the wire BEFORE the reader starts: processing the
         # remote HELLO triggers registration, whose subscription announce
         # would otherwise overtake our own HELLO — the remote then drops
@@ -274,7 +386,7 @@ class TcpHost:
     def dial(self, host: str, port: int, timeout: float = 5.0) -> Connection:
         sock = socket.create_connection((host, port), timeout=timeout)
         sock.settimeout(None)
-        conn = self._spawn(sock)
+        conn = self._spawn(sock, dialer=True)
         # wait until HELLO exchanged and registered
         deadline = time.monotonic() + timeout
         while conn.peer_id is None:
