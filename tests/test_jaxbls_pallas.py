@@ -175,3 +175,28 @@ def test_fused_product_check_accepts_and_rejects():
     p2 = pc.g1_neg(pc.g1_mul(pc.G1_GEN, a * 8 % R))    # wrong scalar
     dp, dq, mask = _device_pairs([(p1, q1), (p2, pc.G2_GEN)], 4)
     assert not bool(check(dp, dq, mask))
+
+
+def test_fused_miller_odd_pair_count():
+    """Odd pair counts exercise the line-combine tree's odd-padding and
+    fq12_product_any's carry lane — masked and unmasked."""
+    a = rng.randrange(1, R)
+    b = rng.randrange(1, R)
+    pairs = [
+        (pc.g1_mul(pc.G1_GEN, a), pc.g2_mul(pc.G2_GEN, b)),
+        (pc.g1_neg(pc.g1_mul(pc.G1_GEN, a * b % R)), pc.G2_GEN),
+        (pc.g1_mul(pc.G1_GEN, 7), pc.g2_mul(pc.G2_GEN, 9)),
+    ]
+    xp = tw.fq_batch_to_device([p[0] for p, _ in pairs])
+    yp = tw.fq_batch_to_device([p[1] for p, _ in pairs])
+    xq = tw.fq2_batch_to_device([q[0] for _, q in pairs])
+    yq = tw.fq2_batch_to_device([q[1] for _, q in pairs])
+    for mask in ([True, True, False], [True, True, True]):
+        m = jnp.asarray(np.array(mask))
+        want = np.asarray(jax.jit(po.miller_loop_product)((xp, yp), (xq, yq), m))
+        got = np.asarray(
+            jax.jit(
+                lambda p, q, mm: plo.miller_loop_product_fused(p, q, mm, interpret=True)
+            )((xp, yp), (xq, yq), m)
+        )
+        assert (want == got).all(), f"odd-pair mismatch mask={mask}"
