@@ -415,6 +415,101 @@ def cmd_state_root(args):
     return 0
 
 
+def cmd_indexed_attestations(args):
+    """Resolve every attestation in a block to its IndexedAttestation
+    (lcli indexed-attestations analog: committee lookup against a state)."""
+    from .state_transition import accessors as acc
+    from .state_transition.slot import types_for_slot
+    from .types.spec import ForkName
+
+    spec = _load_spec(args)
+    raw_state = open(args.state, "rb").read()
+    # fork-correct schemas: state slot at the stable SSZ prefix (offset 40),
+    # block slot right after the SignedBeaconBlock header (the message
+    # offset points at BeaconBlock, which begins with its slot)
+    state_slot = int.from_bytes(raw_state[40:48], "little")
+    types = types_for_slot(spec, state_slot)
+    state = types.BeaconState.deserialize(raw_state)
+    raw_block = open(args.block, "rb").read()
+    msg_off = int.from_bytes(raw_block[0:4], "little")
+    block_slot = int.from_bytes(raw_block[msg_off : msg_off + 8], "little")
+    btypes = types_for_slot(spec, block_slot)
+    block = btypes.SignedBeaconBlock.deserialize(raw_block).message
+
+    fork = spec.fork_name_at_slot(int(block.slot))
+    caches: dict[int, object] = {}
+    out = []
+    for att in block.body.attestations:
+        epoch = int(att.data.target.epoch)
+        cc = caches.get(epoch)
+        if cc is None:
+            cc = acc.build_committee_cache(state, spec, epoch)
+            caches[epoch] = cc
+        if fork >= ForkName.electra:
+            indices = acc.get_attesting_indices_electra(state, spec, att, cc)
+        else:
+            committee = cc.committee(att.data.slot, att.data.index)
+            if len(att.aggregation_bits) != len(committee):
+                print(
+                    f"error: attestation at slot {int(att.data.slot)} has "
+                    f"{len(att.aggregation_bits)} bits for a "
+                    f"{len(committee)}-member committee (state/block mismatch?)",
+                    file=sys.stderr,
+                )
+                return 1
+            indices = [i for i, bit in zip(committee, att.aggregation_bits) if bit]
+        out.append(
+            {
+                "slot": int(att.data.slot),
+                "index": int(att.data.index),
+                "beacon_block_root": "0x" + bytes(att.data.beacon_block_root).hex(),
+                "attesting_indices": sorted(int(i) for i in indices),
+            }
+        )
+    print(json.dumps(out, indent=1))
+    return 0
+
+
+def cmd_check_deposit_data(args):
+    """Validate a deposit's signature + withdrawal credentials shape (lcli
+    check-deposit-data analog). Input: JSON with pubkey /
+    withdrawal_credentials / amount / signature (0x-hex fields)."""
+    from .state_transition.block import is_valid_deposit_signature
+    from .state_transition.slot import types_for_slot
+
+    spec = _load_spec(args)
+    types = types_for_slot(spec, 0)
+    with open(args.deposit) as f:
+        d = json.load(f)
+    pubkey = bytes.fromhex(d["pubkey"].removeprefix("0x"))
+    wc = bytes.fromhex(d["withdrawal_credentials"].removeprefix("0x"))
+    amount = int(d["amount"])
+    sig = bytes.fromhex(d["signature"].removeprefix("0x"))
+
+    problems = []
+    if len(pubkey) != 48:
+        problems.append("pubkey must be 48 bytes")
+    if len(wc) != 32:
+        problems.append("withdrawal_credentials must be 32 bytes")
+    elif wc[0] not in (0x00, 0x01, 0x02):
+        problems.append(f"unknown withdrawal prefix 0x{wc[0]:02x}")
+    if amount < spec.min_deposit_amount:
+        problems.append(
+            f"amount below the network deposit minimum ({spec.min_deposit_amount})"
+        )
+    if not problems and not is_valid_deposit_signature(
+        spec, types, pubkey, wc, amount, sig
+    ):
+        problems.append("invalid deposit signature")
+
+    if problems:
+        for p in problems:
+            print(f"INVALID: {p}")
+        return 1
+    print("deposit data valid")
+    return 0
+
+
 def cmd_interop_genesis(args):
     from .crypto import bls
     from .state_transition.genesis import interop_genesis_state
@@ -791,6 +886,23 @@ def build_parser() -> argparse.ArgumentParser:
     _add_spec_arg(sr)
     sr.add_argument("--state", required=True)
     sr.set_defaults(fn=cmd_state_root)
+
+    ia = sub.add_parser(
+        "indexed-attestations",
+        help="resolve a block's attestations to attesting indices",
+    )
+    _add_spec_arg(ia)
+    ia.add_argument("--state", required=True)
+    ia.add_argument("--block", required=True)
+    ia.set_defaults(fn=cmd_indexed_attestations)
+
+    cdd = sub.add_parser(
+        "check-deposit-data", help="validate a deposit's signature and shape"
+    )
+    _add_spec_arg(cdd)
+    cdd.add_argument("--deposit", required=True,
+                     help="JSON file with pubkey/withdrawal_credentials/amount/signature")
+    cdd.set_defaults(fn=cmd_check_deposit_data)
 
     ig = sub.add_parser("interop-genesis", help="write an interop genesis state")
     _add_spec_arg(ig)

@@ -53,3 +53,63 @@ def test_db_inspect(tmp_path):
     s.close()
     r = run(["db", "--db", str(db)], tmp_path)
     assert r.returncode == 0 and "block: 1" in r.stdout
+
+
+def test_indexed_attestations_and_check_deposit_data(tmp_path):
+    """lcli-style tools: indexed-attestations resolves committee members;
+    check-deposit-data accepts a valid deposit and rejects a tampered one."""
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.state_transition.slot import types_for_slot
+    from lighthouse_tpu.testing.harness import StateHarness, clone_state
+    from lighthouse_tpu.types import helpers as th
+    from lighthouse_tpu.types.spec import DOMAIN_DEPOSIT, minimal_spec
+
+    bls.set_backend("fake")
+    spec = minimal_spec()
+    h = StateHarness.new(spec, 16)
+    pending = []
+    signed = None
+    for _ in range(2):
+        slot = h.state.slot + 1
+        signed, _post = h.produce_block(slot, attestations=pending, full_sync=False)
+        h.apply_block(signed)
+        types = types_for_slot(spec, slot)
+        head = types.BeaconBlock.hash_tree_root(signed.message)
+        pending = h.build_attestations(clone_state(h.state, spec), slot, head)
+    types = types_for_slot(spec, int(h.state.slot))
+    st = tmp_path / "s.ssz"
+    bk = tmp_path / "b.ssz"
+    st.write_bytes(types.BeaconState.serialize(h.state))
+    bk.write_bytes(types.SignedBeaconBlock.serialize(signed))
+
+    r = run(["indexed-attestations", "--spec", "minimal",
+             "--state", str(st), "--block", str(bk)], tmp_path)
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout)
+    assert out and out[0]["attesting_indices"], out
+
+    bls.set_backend("python")
+    sk = bls.SecretKey(4242)
+    pk = sk.public_key()
+    wc = b"\x00" + b"\x11" * 31
+    amount = 32 * 10**9
+    dm = types.DepositMessage.make(
+        pubkey=pk.serialize(), withdrawal_credentials=wc, amount=amount
+    )
+    domain = th.compute_domain(DOMAIN_DEPOSIT, spec.genesis_fork_version, b"\x00" * 32)
+    sig = bls.sign(sk, th.compute_signing_root(types.DepositMessage, dm, domain))
+    good = {
+        "pubkey": "0x" + pk.serialize().hex(),
+        "withdrawal_credentials": "0x" + wc.hex(),
+        "amount": str(amount),
+        "signature": "0x" + sig.serialize().hex(),
+    }
+    gp = tmp_path / "good.json"
+    gp.write_text(json.dumps(good))
+    bp = tmp_path / "bad.json"
+    bp.write_text(json.dumps(dict(good, amount=str(amount + 1))))
+
+    r = run(["check-deposit-data", "--spec", "minimal", "--deposit", str(gp)], tmp_path)
+    assert r.returncode == 0 and "valid" in r.stdout, (r.returncode, r.stdout, r.stderr)
+    r = run(["check-deposit-data", "--spec", "minimal", "--deposit", str(bp)], tmp_path)
+    assert r.returncode == 1 and "INVALID" in r.stdout
