@@ -319,7 +319,10 @@ def cmd_vc(args):
             store.add_validator(kp.sk, index=i)
     duties = DutiesService(spec, store, nodes)
     atts = AttestationService(spec, store, duties, nodes)
-    blocks = BlockService(spec, store, duties, nodes)
+    vc_graffiti = (
+        args.graffiti.encode()[:32].ljust(32, b"\x00") if args.graffiti else None
+    )
+    blocks = BlockService(spec, store, duties, nodes, graffiti=vc_graffiti)
     genesis = clients[0].genesis()
     genesis_time = int(genesis["genesis_time"])
     from .utils.slot_clock import SystemTimeSlotClock
@@ -860,6 +863,8 @@ def build_parser() -> argparse.ArgumentParser:
     vc.add_argument("--beacon-nodes", default="http://127.0.0.1:5052")
     vc.add_argument("--slashing-db", default=None)
     vc.add_argument("--interop-validators", type=int, default=None)
+    vc.add_argument("--graffiti", default=None,
+                    help="graffiti for blocks this VC proposes (<=32 bytes)")
     vc.set_defaults(fn=cmd_vc)
 
     ss = sub.add_parser("skip-slots", help="advance a state N slots")

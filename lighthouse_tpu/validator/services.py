@@ -287,6 +287,7 @@ class BlockService:
     duties: DutiesService
     nodes: BeaconNodeFallback
     produce_block_fn: object = None   # (slot, randao_reveal) -> unsigned block
+    graffiti: bytes | None = None     # per-VC graffiti (--graffiti)
     published: int = 0
 
     def propose(self, slot: int) -> int:
@@ -299,7 +300,9 @@ class BlockService:
             if self.produce_block_fn is not None:
                 block = self.produce_block_fn(slot, randao)
             else:
-                block = self.nodes.first_success("produce_block", slot, randao, types)
+                block = self.nodes.first_success(
+                    "produce_block", slot, randao, types, self.graffiti
+                )
             try:
                 sig = self.store.sign_block(d.pubkey, block, types)
             except (SlashingProtectionError, DoppelgangerProtected):
