@@ -140,7 +140,11 @@ def cmd_bn(args):
         execution_layer=execution_layer, anchor_block=anchor_block,
     )
     if args.graffiti:
-        chain.graffiti = args.graffiti.encode()[:32].ljust(32, b"\x00")
+        g = args.graffiti.encode()
+        if len(g) > 32:
+            print("error: --graffiti exceeds 32 bytes utf-8", file=sys.stderr)
+            return 1
+        chain.graffiti = g.ljust(32, b"\x00")
     if getattr(args, "monitor_validators", None):
         if args.monitor_validators.strip().lower() == "auto":
             chain.monitor.auto_register = True
@@ -319,9 +323,13 @@ def cmd_vc(args):
             store.add_validator(kp.sk, index=i)
     duties = DutiesService(spec, store, nodes)
     atts = AttestationService(spec, store, duties, nodes)
-    vc_graffiti = (
-        args.graffiti.encode()[:32].ljust(32, b"\x00") if args.graffiti else None
-    )
+    vc_graffiti = None
+    if args.graffiti:
+        g = args.graffiti.encode()
+        if len(g) > 32:
+            print("error: --graffiti exceeds 32 bytes utf-8", file=sys.stderr)
+            return 1
+        vc_graffiti = g.ljust(32, b"\x00")
     blocks = BlockService(spec, store, duties, nodes, graffiti=vc_graffiti)
     genesis = clients[0].genesis()
     genesis_time = int(genesis["genesis_time"])

@@ -1,9 +1,12 @@
 #!/bin/bash
 # One clean TPU session: probe the axon tunnel until it initializes, then
-# warm the production kernel stages into the persistent cache and run
-# bench.py ONCE. Exactly one TPU-touching process runs at any time, and no
-# in-flight compile is ever interrupted (the round-2 wedge was caused by
-# killed remote compiles — docs/PERF_NOTES.md:56-59).
+# land a benchmark number FIRST (bench.py carries its own Pallas->XLA
+# fallback), and only then spend time on the Pallas probe and bucket
+# warming. Tunnel windows have proven short (r2-r4 outages): the ordering
+# maximizes the chance that a window yields a nonzero measurement.
+# Exactly one TPU-touching process runs at any time, and no in-flight
+# compile is ever interrupted (the round-2 wedge was caused by killed
+# remote compiles — docs/PERF_NOTES.md runbook).
 #
 # Usage: bash scripts/tpu_session.sh [logfile]
 set -u
@@ -32,47 +35,53 @@ print(f"tiny jit ok in {time.time()-t0:.1f}s", flush=True)
 EOF
 }
 
+run_bench() {
+  log "running bench.py (headline first; do not interrupt)"
+  python bench.py > /tmp/bench_result.json 2>> "$LOG"
+  rc=$?
+  if [ $rc -ne 0 ]; then
+    log "bench FAILED rc=$rc"
+    return 1
+  fi
+  # bench exits 0 with a ZERO measurement when the tunnel drops
+  # mid-session — that is an outage record, not a result
+  if python - <<'PY'
+import json, sys
+rec = json.load(open("/tmp/bench_result.json"))
+sys.exit(0 if rec.get("value", 0) > 0 else 1)
+PY
+  then
+    log "bench complete: $(cat /tmp/bench_result.json)"
+    return 0
+  fi
+  log "bench returned a zero measurement (tunnel flap)"
+  return 1
+}
+
 log "tpu session watcher started"
 ATTEMPT=0
 while true; do
   ATTEMPT=$((ATTEMPT + 1))
   log "probe attempt $ATTEMPT"
   if probe; then
-    log "tunnel is UP — probing Pallas/Mosaic support (do not interrupt)"
-    # 90 min hard stop: only as a last resort against a wedged tunnel —
-    # the probe itself exits promptly on backend-init failure.
-    if timeout 5400 python scripts/probe_pallas.py >> "$LOG" 2>&1; then
-      log "pallas probe OK — fused kernels enabled"
-      # clear any stale off-export from a failed probe in a previous loop
-      # iteration, or the OK above would be a lie for warm+bench below
-      export LIGHTHOUSE_TPU_PALLAS=auto
-    else
-      log "pallas probe FAILED rc=$? — disabling fused kernels for this session"
-      export LIGHTHOUSE_TPU_PALLAS=off
-    fi
-    log "warming kernels (do not interrupt)"
-    if python scripts/warm_kernels.py --buckets 4x128,4x512,256x512 >> "$LOG" 2>&1; then
-      log "warm complete — running bench.py"
-      if python bench.py > /tmp/bench_result.json 2>> "$LOG"; then
-        # bench exits 0 with a ZERO measurement when the tunnel drops
-        # mid-session — that is an outage record, not a result: keep
-        # retrying until a real (value > 0) measurement lands
-        if python - <<'PY'
-import json, sys
-rec = json.load(open("/tmp/bench_result.json"))
-sys.exit(0 if rec.get("value", 0) > 0 else 1)
-PY
-        then
-          log "bench complete: $(cat /tmp/bench_result.json)"
-          exit 0
-        else
-          log "bench returned a zero measurement (tunnel flap) — retrying"
-        fi
+    log "tunnel is UP"
+    if run_bench; then
+      # number banked: now the slower quality passes — Mosaic validation
+      # (records PALLAS_STATUS.json) and bucket warming for future runs
+      log "benching done — probing Pallas/Mosaic support (do not interrupt)"
+      if timeout 5400 python scripts/probe_pallas.py >> "$LOG" 2>&1; then
+        log "pallas probe OK"
+        export LIGHTHOUSE_TPU_PALLAS=auto
       else
-        log "bench FAILED rc=$? — retrying after cooldown"
+        log "pallas probe FAILED rc=$? — warming the XLA path only"
+        # never re-run broken Mosaic compiles in the warm step (a wedged
+        # remote compile queue is the round-2 failure mode)
+        export LIGHTHOUSE_TPU_PALLAS=off
       fi
-    else
-      log "warm FAILED rc=$? — retrying after cooldown"
+      log "warming bench-matrix buckets (do not interrupt)"
+      python scripts/warm_kernels.py --buckets 4x128,4x512,256x512 >> "$LOG" 2>&1 \
+        && log "warm complete" || log "warm FAILED rc=$?"
+      exit 0
     fi
   else
     log "tunnel still down"
