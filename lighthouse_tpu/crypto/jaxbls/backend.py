@@ -132,18 +132,12 @@ def _stage_prepare(pk_x, pk_y, pk_mask, sig_x, sig_y, z_digits, set_mask):
     sig_x = _to_mont_dev(sig_x)
     sig_y = _to_mont_dev(sig_y)
 
-    # aggregate pubkeys per set: (n, m) -> (n,)
+    # aggregate pubkeys per set: (n, m) -> (n,) — fixed-shape tree_sum
+    # compiles ONE add instance for all log2(m) rounds (m=128 in the
+    # firehose bucket; the unrolled form was the compile whale here)
     pk_jac = co.affine_to_jac(co.FQ_OPS, (pk_x, pk_y), inf_mask=jnp.logical_not(pk_mask))
     pk_jac_t = tuple(jnp.moveaxis(c, 1, 0) for c in pk_jac)
-    m = pk_x.shape[1]
-    agg = pk_jac_t
-    while m > 1:
-        half = m // 2
-        a = tuple(c[:half] for c in agg)
-        b = tuple(c[half:m] for c in agg)
-        agg = co.jac_add(a, b, co.FQ_OPS)
-        m = half
-    aggpk = tuple(c[0] for c in agg)                       # (n,) jacobian G1
+    aggpk = co.tree_sum(pk_jac_t, co.FQ_OPS)               # (n,) jacobian G1
     aggpk_inf = co.FQ_OPS.is_zero(aggpk[2])
     bad_aggpk = jnp.any(jnp.logical_and(aggpk_inf, set_mask))
 
@@ -264,6 +258,51 @@ def _get_kernel():
         setup_compilation_cache()
         _kernel_cache["k"] = jax.jit(_verify_kernel)
     return _kernel_cache["k"]
+
+
+def warm_stages(n_sets: int, n_pks: int) -> None:
+    """Pre-compile the prepare and hash-to-G2 stages for one bucket shape,
+    CONCURRENTLY. Their input layouts are fully determined by the marshal
+    (leading set axis sharded over the mesh), so dummy zero inputs placed
+    the same way hit the same jit-cache entries the real dispatch will use,
+    and compiling both in threads makes the wall cost ~max of the two
+    largest programs instead of their sum (the r4 multichip dryrun timed
+    out in sequential XLA:CPU stage compiles — ~3 min for prepare alone).
+    Stages 3/4 take stage OUTPUTS as inputs (shardings chosen by XLA), so
+    they still compile on first real dispatch."""
+    import threading
+
+    import jax
+
+    from ...parallel import pad_pks, pad_sets, put_pk_grid, put_sets
+
+    prepare, h2c_stage, _, _ = _get_stages()
+    n = pad_sets(max(MIN_SETS, _next_pow2(n_sets)))
+    m = pad_pks(max(MIN_PKS, _next_pow2(n_pks)))
+
+    pk_x = put_pk_grid(np.zeros((n, m, lb.NL), np.uint32))
+    pk_y = put_pk_grid(np.zeros((n, m, lb.NL), np.uint32))
+    pk_mask = put_pk_grid(np.ones((n, m), np.uint32))
+    sig_x = put_sets(np.zeros((n, 2, lb.NL), np.uint32))
+    sig_y = put_sets(np.zeros((n, 2, lb.NL), np.uint32))
+    z_digits = put_sets(np.ones((n, Z_DIGITS), np.uint32))
+    set_mask = put_sets(np.ones((n,), np.uint32))
+    us = put_sets(np.zeros((n, 2, 2, lb.NL), np.uint32))
+
+    def _warm(fn, *args):
+        jax.block_until_ready(fn(*args))
+
+    threads = [
+        threading.Thread(
+            target=_warm,
+            args=(prepare, pk_x, pk_y, pk_mask, sig_x, sig_y, z_digits, set_mask),
+        ),
+        threading.Thread(target=_warm, args=(h2c_stage, us)),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
 
 
 class VerifyHandle:
@@ -474,6 +513,28 @@ class JaxBackend:
         if bool(np.asarray(inf)):
             return None
         return (lb.unpack(np.asarray(x)), lb.unpack(np.asarray(y)))
+
+    def g1_msm_fixed(self, points, scalars):
+        """Fixed-base MSM with per-point-set comb tables cached on device
+        (msm.py): the KZG commitment/proof path reuses the SAME Lagrange
+        points every call, so the one-time table build amortizes to a ~16x
+        sequential-depth cut per MSM (the TPU-shaped Pippenger — SURVEY
+        §7.1; c-kzg's precomputed-table analog)."""
+        cache = self.__dict__.setdefault("_fixed_msm_cache", {})
+        order = self.__dict__.setdefault("_fixed_msm_order", [])
+        fp = id(points)
+        hit = cache.get(fp)
+        if hit is None or hit[1] is not points:
+            from .msm import FixedBaseMSM
+
+            hit = (FixedBaseMSM(points), points)   # points ref keeps id valid
+            cache[fp] = hit
+            if fp in order:          # id reuse after GC: don't double-track
+                order.remove(fp)
+            order.append(fp)
+            if len(order) > 4:
+                cache.pop(order.pop(0), None)
+        return hit[0].msm(scalars)
 
     def pairing_product_is_one(self, pairs) -> bool:
         """prod e(P_i, Q_i) == 1 for host affine pairs, on the SAME jitted

@@ -386,16 +386,50 @@ def scalars_to_bits(zs, nbits: int) -> np.ndarray:
 def tree_sum(p_jac, ops):
     """Sum points along the FIRST batch axis by halving tree reduction.
 
-    Input axis length must be a power of two (pad with identity)."""
+    Input axis length must be a power of two (pad with identity).
+
+    Two lowerings, bit-identical results:
+      * fori_loop (default): a FIXED-SHAPE body — round r adds the lane
+        half-a-stride away (dynamic roll) and keeps the sum in the low
+        lanes via select. One jac_add instance compiles for all log2(n)
+        rounds; the unrolled form instantiated log2(n) separate adds,
+        which dominated the prepare-stage XLA compile (the r4 multichip
+        gate timed out in exactly that compile). Runtime trades n-1 adds
+        for n*log2(n) lanes of batched adds — noise next to the 64-bit
+        scalar-mul scans.
+      * unrolled halving: kept for Pallas kernel bodies (Mosaic has no
+        dynamic roll) and for tiny n where the loop machinery outweighs
+        two adds."""
     n = jax.tree_util.tree_leaves(p_jac)[0].shape[0]
     assert n & (n - 1) == 0, "tree_sum needs power-of-two length"
-    while n > 1:
-        half = n // 2
-        a = jax.tree_util.tree_map(lambda x: x[:half], p_jac)
-        b = jax.tree_util.tree_map(lambda x: x[half:n], p_jac)
-        p_jac = jac_add(a, b, ops)
-        n = half
-    return jax.tree_util.tree_map(lambda x: x[0], p_jac)
+    if lb._pallas_tracing() or n <= 4:
+        while n > 1:
+            half = n // 2
+            a = jax.tree_util.tree_map(lambda x: x[:half], p_jac)
+            b = jax.tree_util.tree_map(lambda x: x[half:n], p_jac)
+            p_jac = jac_add(a, b, ops)
+            n = half
+        return jax.tree_util.tree_map(lambda x: x[0], p_jac)
+
+    rounds = n.bit_length() - 1
+    # select conds index ALL batch dims (everything but the field-element
+    # dims): shape the lane index over the full batch, not just axis 0
+    batch = np.shape(ops.is_zero(p_jac[2]))
+    lane = jnp.arange(n).reshape((n,) + (1,) * (len(batch) - 1))
+
+    def body(r, acc):
+        half = jnp.int32(n) >> (r + 1)
+        shifted = jax.tree_util.tree_map(
+            lambda x: jnp.roll(x, -half, axis=0), acc
+        )
+        added = jac_add(acc, shifted, ops)
+        # lanes >= half hold garbage sums; keep previous values there (only
+        # lanes < the next round's stride are ever read again)
+        keep = jnp.broadcast_to(lane < half, batch)
+        return pt_select(ops, keep, added, acc)
+
+    acc = jax.lax.fori_loop(0, rounds, body, p_jac)
+    return jax.tree_util.tree_map(lambda x: x[0], acc)
 
 
 def masked_tree_sum(p_jac, mask, ops):

@@ -169,6 +169,45 @@ def test_backend_dispatch_uses_mesh(jax_backend):
     assert h.result() is True
 
 
+def test_backend_2d_mesh_wide_aggregation(jax_backend, monkeypatch):
+    """2-D (sets, pks) mesh: WITHIN-SET parallelism — the pubkey axis of a
+    wide aggregation (the 512-pk sync-committee shape, scaled down) is
+    sharded too, so the per-set point tree spreads across chips and its
+    reduction lowers to collectives over the pks axis (SURVEY §5's
+    bucket-parallel-within-a-set requirement). This lane owns the 2-D
+    coverage: the driver's dryrun_multichip gate runs the 1-D production
+    path only (the 2-D re-trace doubled cold-compile wall and timed out
+    the r4 gate)."""
+    from lighthouse_tpu import parallel
+
+    monkeypatch.setenv("LIGHTHOUSE_TPU_PK_SHARDS", "2")
+    parallel.reset_mesh_cache()
+    try:
+        mesh2 = parallel.get_mesh()
+        assert mesh2 is not None and parallel.mesh.PK_AXIS in mesh2.axis_names
+        assert dict(mesh2.shape) == {"sets": N_DEV // 2, "pks": 2}
+
+        rng = random.Random(0x2D)
+        big_sks = [rng.randrange(1, R) for _ in range(8)]
+        big_pks = [bls.PublicKey(cv.g1_mul(cv.G1_GEN, sk)) for sk in big_sks]
+        msg = b"\x2d" * 32
+        h = bls_api.hash_to_g2_point(msg)
+        big_sig = bls.Signature(cv.g2_mul(h, sum(big_sks) % R))
+        small_sets, rands = _build_sets(3, 2, seed=0x57)
+        big_sets = [bls.SignatureSet(big_sig, big_pks, msg)] + small_sets
+        big_rands = [1] + rands
+        assert jax_backend.verify_signature_sets(big_sets, big_rands) is True
+        # a tampered wide set must reject through the same 2-D path
+        wrong = bls.Signature(cv.g2_mul(h, (sum(big_sks) + 1) % R))
+        bad_sets = [bls.SignatureSet(wrong, big_pks, msg)] + small_sets
+        assert jax_backend.verify_signature_sets(bad_sets, big_rands) is False
+        py = bls_api._BACKENDS["python"]
+        assert py.verify_signature_sets(big_sets, big_rands) is True
+        assert py.verify_signature_sets(bad_sets, big_rands) is False
+    finally:
+        parallel.reset_mesh_cache()
+
+
 def test_backend_mesh_agrees_with_single_device(jax_backend, monkeypatch):
     from lighthouse_tpu import parallel
 
