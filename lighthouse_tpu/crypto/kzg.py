@@ -177,12 +177,21 @@ def _compute_quotient_eval_form(poly, z: int, y: int, setup: TrustedSetup) -> li
     return q
 
 
-def _g1_lincomb(points, scalars) -> object:
+def _g1_lincomb(points, scalars, fixed_base: bool = False) -> object:
     """MSM sum(scalars[i] * points[i]); dispatches to the active BLS backend
-    if it exposes an accelerated MSM, else host-side."""
+    if it exposes an accelerated MSM, else host-side.
+
+    fixed_base=True marks a STABLE point set (the setup's Lagrange basis —
+    identical list object every call): the backend may then build and cache
+    per-point comb tables (jaxbls/msm.py). Never set it for per-call
+    varying points — the one-time table build would be paid every call."""
     from .bls import api as bls_api
 
     backend = bls_api.get_backend()
+    if fixed_base and len(points) >= 256:
+        msm_fixed = getattr(backend, "g1_msm_fixed", None)
+        if msm_fixed is not None:
+            return msm_fixed(points, scalars)
     msm = getattr(backend, "g1_msm", None)
     if msm is not None:
         return msm(points, scalars)
@@ -212,7 +221,7 @@ def _pairing_product_is_one(pairs) -> bool:
 
 def blob_to_kzg_commitment(blob: bytes, setup: TrustedSetup):
     poly = blob_to_polynomial(blob, setup)
-    return _g1_lincomb(setup.g1_lagrange, poly)
+    return _g1_lincomb(setup.g1_lagrange, poly, fixed_base=True)
 
 
 def _hash_to_bls_field(data: bytes) -> int:
@@ -232,7 +241,7 @@ def compute_kzg_proof(blob: bytes, z: int, setup: TrustedSetup):
     poly = blob_to_polynomial(blob, setup)
     y = _evaluate_polynomial_in_evaluation_form(poly, z, setup)
     q = _compute_quotient_eval_form(poly, z, y, setup)
-    return _g1_lincomb(setup.g1_lagrange, q), y
+    return _g1_lincomb(setup.g1_lagrange, q, fixed_base=True), y
 
 
 def compute_blob_kzg_proof(blob: bytes, commitment_bytes: bytes, setup: TrustedSetup):

@@ -1,0 +1,104 @@
+"""Fixed-base comb MSM differential tests (crypto/jaxbls/msm.py) vs the
+pure-Python ground truth, plus dispatch/caching seams."""
+
+import random
+
+import pytest
+
+from lighthouse_tpu.crypto.bls381 import curve as cv
+from lighthouse_tpu.crypto.bls381.constants import R
+
+
+def _host_msm(points, scalars):
+    acc = None
+    for p, s in zip(points, scalars):
+        if p is None or s % R == 0:
+            continue
+        acc = cv.g1_add(acc, cv.g1_mul(p, s % R))
+    return acc
+
+
+@pytest.fixture(scope="module")
+def points():
+    rng = random.Random(0x115)
+    pts = [cv.g1_mul(cv.G1_GEN, rng.randrange(1, R)) for _ in range(5)]
+    pts.insert(2, None)   # identity lane must be handled
+    return pts
+
+
+def test_fixed_base_msm_matches_host(points):
+    from lighthouse_tpu.crypto.jaxbls.msm import FixedBaseMSM
+
+    rng = random.Random(0x116)
+    msm = FixedBaseMSM(points)
+    for trial in range(3):
+        scalars = [rng.randrange(0, R) for _ in range(len(points))]
+        assert msm.msm(scalars) == _host_msm(points, scalars), f"trial {trial}"
+
+
+def test_fixed_base_msm_edge_scalars(points):
+    from lighthouse_tpu.crypto.jaxbls.msm import FixedBaseMSM
+
+    msm = FixedBaseMSM(points)
+    n = len(points)
+    # all zero -> identity
+    assert msm.msm([0] * n) is None
+    # one-hot recovers the bare point
+    sel = [0] * n
+    sel[0] = 1
+    assert msm.msm(sel) == points[0]
+    # scalar == R behaves as 0; R-1 as negation
+    sel[0] = R
+    assert msm.msm(sel) is None
+    sel[0] = R - 1
+    assert msm.msm(sel) == cv.g1_neg(points[0])
+
+
+def test_fixed_base_agrees_with_variable_base_kernel(points):
+    from lighthouse_tpu.crypto.bls import api as bls_api
+
+    rng = random.Random(0x117)
+    backend = bls_api.set_backend("jax")
+    scalars = [rng.randrange(0, R) for _ in range(len(points))]
+    assert backend.g1_msm_fixed(points, scalars) == backend.g1_msm(points, scalars)
+
+
+def test_fixed_base_tables_cached_by_point_set_identity(points):
+    from lighthouse_tpu.crypto.bls import api as bls_api
+
+    backend = bls_api.set_backend("jax")
+    backend.__dict__.pop("_fixed_msm_cache", None)
+    backend.__dict__.pop("_fixed_msm_order", None)
+    backend.g1_msm_fixed(points, [1] * len(points))
+    backend.g1_msm_fixed(points, [2] * len(points))
+    assert len(backend._fixed_msm_cache) == 1   # same list -> same tables
+    other = list(points)
+    backend.g1_msm_fixed(other, [1] * len(points))
+    assert len(backend._fixed_msm_cache) == 2
+
+
+def test_kzg_lincomb_prefers_fixed_base_for_large_sets():
+    from lighthouse_tpu.crypto import kzg
+    from lighthouse_tpu.crypto.bls import api as bls_api
+
+    calls = []
+
+    class FakeBackend:
+        def g1_msm_fixed(self, points, scalars):
+            calls.append(("fixed", len(points)))
+            return cv.G1_GEN
+
+        def g1_msm(self, points, scalars):
+            calls.append(("var", len(points)))
+            return cv.G1_GEN
+
+    prev = bls_api.get_backend()
+    try:
+        bls_api._active_backend = FakeBackend()
+        big = [cv.G1_GEN] * 256
+        kzg._g1_lincomb(big, [1] * 256)
+        small = [cv.G1_GEN] * 4
+        kzg._g1_lincomb(small, [1] * 4)
+    finally:
+        bls_api._active_backend = prev
+    assert calls == [("fixed", 256), ("var", 4)]
