@@ -110,14 +110,28 @@ def _load_jax_backend():
     return backend
 
 
+def _load_hybrid_backend():
+    """Host/device routing policy (crypto/bls/hybrid.py): urgent or tiny
+    verifies ride the host path while the device is cold, absent, or over
+    its latency budget — the serving story for a node started during a
+    tunnel outage (SURVEY §7 hard part (d))."""
+    from .hybrid import HybridBackend
+
+    backend = HybridBackend()
+    register_backend("hybrid", backend)
+    return backend
+
+
 def available_backends() -> list[str]:
-    return sorted(set(_BACKENDS) | {"jax"})
+    return sorted(set(_BACKENDS) | {"jax", "hybrid"})
 
 
 def set_backend(name: str):
     global _active_backend
     if name == "jax" and "jax" not in _BACKENDS:
         _load_jax_backend()
+    if name == "hybrid" and "hybrid" not in _BACKENDS:
+        _load_hybrid_backend()
     if name not in _BACKENDS:
         raise ValueError(f"unknown BLS backend {name!r}; have {available_backends()}")
     _active_backend = _BACKENDS[name]

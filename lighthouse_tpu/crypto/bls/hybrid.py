@@ -1,0 +1,337 @@
+"""Hybrid host/device BLS verification policy — the urgent-path escape hatch.
+
+SURVEY §7 hard part (d): the chain sometimes needs a SINGLE urgent
+verification (a gossip block's proposer signature, a lone attestation on a
+quiet subnet) with low p99, while the device pipeline is optimized for big
+batches and can be cold (first compile takes minutes through a remote
+tunnel) or entirely unavailable (tunnel outage). The reference's analog is
+the per-set CPU fallback after a failed blst batch
+(/root/reference/beacon_node/beacon_chain/src/attestation_verification/batch.rs:116-120);
+here the escape hatch also covers a cold or absent device, so a beacon node
+started during a tunnel outage still serves verification.
+
+Routing policy (each decision counted in Prometheus metrics):
+  - device state "down"/"probing"  -> host, always. The device probe runs
+    in a daemon thread with a bounded startup wait (a dead axon tunnel has
+    been observed blocking backend init for 20+ minutes — the node must
+    not) and keeps retrying, so a tunnel that comes back mid-flight
+    upgrades the node to the device path without a restart.
+  - small batch + cold bucket      -> host now, warm the device bucket in
+    the background with the same sets (the next verify at this shape rides
+    the warmed device path).
+  - large batch                    -> device (batches are throughput work,
+    not urgent; they pay the compile once).
+  - small batch + device p99 over budget (rolling window) -> host.
+  - device dispatch raises         -> host answers; repeated failures mark
+    the device down until the next probe succeeds.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Sequence
+
+from ...utils.logging import get_logger
+from ...utils.metrics import REGISTRY
+
+_HOST_VERIFIES = REGISTRY.counter(
+    "bls_hybrid_host_verifies_total",
+    "multi-set verifications served by the host (python) path",
+)
+_DEVICE_VERIFIES = REGISTRY.counter(
+    "bls_hybrid_device_verifies_total",
+    "multi-set verifications served by the device (jax) path",
+)
+_REASONS = {
+    reason: REGISTRY.counter(
+        f"bls_hybrid_host_reason_{reason}_total",
+        f"host-path verifications because: {reason.replace('_', ' ')}",
+    )
+    for reason in (
+        "device_down", "device_probing", "device_cold", "latency_budget",
+        "device_error",
+    )
+}
+_DEVICE_LATENCY = REGISTRY.histogram(
+    "bls_hybrid_device_verify_seconds", "device multi-set verify wall time"
+)
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class HybridBackend:
+    """Registered as "hybrid" in the backend registry (api.set_backend)."""
+
+    name = "hybrid"
+
+    def __init__(
+        self,
+        *,
+        urgent_max_sets: int | None = None,
+        p99_budget_ms: float | None = None,
+        probe_startup_wait_secs: float | None = None,
+        probe_retry_secs: float | None = None,
+    ):
+        self.urgent_max_sets = int(
+            urgent_max_sets
+            if urgent_max_sets is not None
+            else _env_float("LIGHTHOUSE_TPU_URGENT_MAX_SETS", 4)
+        )
+        self.p99_budget_ms = (
+            p99_budget_ms
+            if p99_budget_ms is not None
+            else _env_float("LIGHTHOUSE_TPU_DEVICE_P99_BUDGET_MS", 500.0)
+        )
+        self._probe_startup_wait = (
+            probe_startup_wait_secs
+            if probe_startup_wait_secs is not None
+            else _env_float("LIGHTHOUSE_TPU_DEVICE_PROBE_WAIT_SECS", 20.0)
+        )
+        self._probe_retry = (
+            probe_retry_secs
+            if probe_retry_secs is not None
+            else _env_float("LIGHTHOUSE_TPU_DEVICE_PROBE_RETRY_SECS", 600.0)
+        )
+        self._log = get_logger("bls.hybrid")
+        self._lock = threading.Lock()
+        self._state = "probing"            # probing | up | down
+        self._device = None                # JaxBackend once probed up
+        self._device_failures = 0
+        self._warm_buckets: set = set()
+        self._warming: set = set()
+        self._lats: deque = deque(maxlen=128)
+        self._probe_started = threading.Event()
+        self._probe_done = threading.Event()
+
+    # ------------------------------------------------------------- probing
+
+    def _ensure_probe(self):
+        if self._probe_started.is_set():
+            return
+        with self._lock:
+            if self._probe_started.is_set():
+                return
+            self._probe_started.set()
+            t = threading.Thread(target=self._probe_loop, daemon=True,
+                                 name="bls-hybrid-device-probe")
+            t.start()
+
+    def _probe_loop(self):
+        while True:
+            try:
+                from ..jaxbls.backend import JaxBackend
+                import jax
+
+                devices = jax.devices()   # may block on a dead tunnel
+                with self._lock:
+                    self._device = self._device or JaxBackend()
+                    self._state = "up"
+                    self._device_failures = 0
+                self._log.info("device backend up", devices=str(devices))
+                self._probe_done.set()
+                return
+            except Exception as e:
+                with self._lock:
+                    self._state = "down"
+                self._log.warn(
+                    "device backend unavailable; serving from host",
+                    error=f"{type(e).__name__}: {e}",
+                    retry_secs=self._probe_retry,
+                )
+                self._probe_done.set()
+            time.sleep(self._probe_retry)
+
+    def _device_state(self) -> str:
+        self._ensure_probe()
+        # bounded startup grace: give a live tunnel a chance to init so the
+        # very first verifies ride the device, but never block on a dead one
+        if self._state == "probing":
+            self._probe_done.wait(self._probe_startup_wait)
+        with self._lock:
+            return self._state
+
+    # ------------------------------------------------------------- routing
+
+    def _bucket(self, sets) -> tuple:
+        from ..jaxbls import backend as jb
+        from ...parallel import pad_pks, pad_sets
+
+        n = pad_sets(max(jb.MIN_SETS, jb._next_pow2(len(sets))))
+        m = pad_pks(
+            max(jb.MIN_PKS, jb._next_pow2(max(len(s.signing_keys) for s in sets)))
+        )
+        return (n, m)
+
+    def _p99_ms(self) -> float | None:
+        with self._lock:
+            if len(self._lats) < 8:
+                return None
+            xs = sorted(self._lats)
+        return xs[min(len(xs) - 1, int(len(xs) * 0.99))] * 1e3
+
+    def _route(self, sets) -> tuple[str, str]:
+        state = self._device_state()
+        if state != "up":
+            return "host", f"device_{state}"
+        small = len(sets) <= self.urgent_max_sets
+        bucket = self._bucket(sets)
+        with self._lock:
+            cold = bucket not in self._warm_buckets
+        if cold:
+            if small:
+                self._spawn_warm(bucket, sets)
+                return "host", "device_cold"
+            return "device", ""      # batch work pays its own compile
+        if small:
+            p99 = self._p99_ms()
+            if p99 is not None and p99 > self.p99_budget_ms:
+                return "host", "latency_budget"
+        return "device", ""
+
+    def _spawn_warm(self, bucket, sets):
+        with self._lock:
+            if bucket in self._warming or bucket in self._warm_buckets:
+                return
+            self._warming.add(bucket)
+        snapshot = list(sets)
+
+        def warm():
+            try:
+                t0 = time.time()
+                self._device.verify_signature_sets(snapshot, [1] * len(snapshot))
+                with self._lock:
+                    self._warm_buckets.add(bucket)
+                self._log.info(
+                    "device bucket warmed", bucket=str(bucket),
+                    secs=round(time.time() - t0, 1),
+                )
+            except Exception as e:
+                self._log.warn(
+                    "device bucket warm failed", bucket=str(bucket),
+                    error=f"{type(e).__name__}: {e}",
+                )
+            finally:
+                with self._lock:
+                    self._warming.discard(bucket)
+
+        threading.Thread(target=warm, daemon=True,
+                         name=f"bls-hybrid-warm-{bucket}").start()
+
+    def _host(self):
+        from . import api
+
+        return api._BACKENDS["python"]
+
+    def _record_device_ok(self, bucket, dt):
+        _DEVICE_LATENCY.observe(dt)
+        with self._lock:
+            self._lats.append(dt)
+            self._warm_buckets.add(bucket)
+            self._device_failures = 0
+
+    def _record_device_error(self, e):
+        self._log.warn("device verify failed; host served",
+                       error=f"{type(e).__name__}: {e}")
+        with self._lock:
+            self._device_failures += 1
+            if self._device_failures >= 3:
+                self._state = "down"
+                self._probe_done.clear()
+                self._probe_started.clear()  # re-arm the probe loop
+
+    # ------------------------------------------------------------- surface
+
+    def verify_signature_sets(self, sets, rands) -> bool:
+        path, reason = self._route(sets)
+        if path == "host":
+            _HOST_VERIFIES.inc()
+            _REASONS[reason].inc()
+            return self._host().verify_signature_sets(sets, rands)
+        bucket = self._bucket(sets)
+        try:
+            t0 = time.time()
+            ok = self._device.verify_signature_sets(sets, rands)
+            self._record_device_ok(bucket, time.time() - t0)
+            _DEVICE_VERIFIES.inc()
+            return ok
+        except Exception as e:
+            self._record_device_error(e)
+            _HOST_VERIFIES.inc()
+            _REASONS["device_error"].inc()
+            return self._host().verify_signature_sets(sets, rands)
+
+    def verify_signature_sets_async(self, sets, rands):
+        from . import api
+
+        path, reason = self._route(sets)
+        if path == "host":
+            _HOST_VERIFIES.inc()
+            _REASONS[reason].inc()
+            return api._ReadyHandle(
+                self._host().verify_signature_sets(sets, rands)
+            )
+        bucket = self._bucket(sets)
+        outer = self
+
+        class _Handle:
+            __slots__ = ("_inner", "_t0")
+
+            def __init__(self, inner, t0):
+                self._inner = inner
+                self._t0 = t0
+
+            def result(self) -> bool:
+                try:
+                    r = self._inner.result()
+                    outer._record_device_ok(bucket, time.time() - self._t0)
+                    _DEVICE_VERIFIES.inc()
+                    return r
+                except Exception as e:
+                    outer._record_device_error(e)
+                    _HOST_VERIFIES.inc()
+                    _REASONS["device_error"].inc()
+                    return outer._host().verify_signature_sets(sets, rands)
+
+        try:
+            t0 = time.time()
+            return _Handle(self._device.verify_signature_sets_async(sets, rands), t0)
+        except Exception as e:
+            self._record_device_error(e)
+            _HOST_VERIFIES.inc()
+            _REASONS["device_error"].inc()
+            return api._ReadyHandle(self._host().verify_signature_sets(sets, rands))
+
+    def __getattr__(self, name):
+        # accelerated primitives (device MSM / pairing product for KZG)
+        # exist as attributes ONLY while the device is up — consumers probe
+        # with getattr(..., None) and fall back to their host paths
+        # (crypto/kzg.py), so a tunnel outage degrades instead of crashing
+        if name in ("g1_msm", "g1_msm_fixed", "pairing_product_is_one"):
+            if self._device_state() == "up" and self._device is not None:
+                return getattr(self._device, name)
+        raise AttributeError(name)
+
+    def verify_single(self, pk, message: bytes, sig) -> bool:
+        if sig.is_infinity():
+            return False
+        from .signature_set import SignatureSet
+
+        return self.verify_signature_sets([SignatureSet(sig, (pk,), message)], [1])
+
+    def aggregate_verify(self, pks, messages, sig) -> bool:
+        state = self._device_state()
+        if state == "up":
+            try:
+                return self._device.aggregate_verify(pks, messages, sig)
+            except Exception as e:
+                self._record_device_error(e)
+        _REASONS[f"device_{state}" if state != "up" else "device_error"].inc()
+        return self._host().aggregate_verify(pks, messages, sig)
