@@ -5,28 +5,35 @@ Headline (stdout, ONE JSON line): BASELINE.md config 5, the "mainnet gossip
 firehose" — batches of 64 attestation-style signature sets, each an
 aggregate over 128 pubkeys with a distinct 32-byte message, verified by the
 TPU backend (pipelined through the async submission API, every result
-checked). vs_baseline compares against an estimated single-host blst
+checked). vs_baseline compares against an ESTIMATED single-host blst
 throughput for the same workload (~700 sets/s; the reference publishes no
-absolute numbers — SURVEY.md §6, BASELINE.md).
+absolute numbers and blst is not present in this image — SURVEY.md §6,
+BASELINE.md). Every vs_* ratio in this file divides by an estimate, never
+a measurement; the JSON labels say so.
+
+Tunnel-window design (VERDICT r4: three rounds died before measuring):
+  - ALL fixtures are persisted in bench_fixtures.npz (committed, built
+    offline by scripts/gen_bench_fixtures.py) — zero fixture kernels
+    compile before the verify pipeline warms;
+  - the headline updates incrementally: after the warm batch (rate incl.
+    compile), after one synchronous timed batch, then the pipelined
+    measurement — a watchdog or tunnel drop mid-run still reports the
+    latest landed number instead of zero;
+  - a negative control (tampered signature on the warmed bucket) guards
+    against measuring a vacuous accept.
 
 The rest of the matrix (BASELINE.md configs 1-4 + the p99 per-block verify
 latency probe) is measured after the headline and written to
 BENCH_MATRIX.json / stderr:
   1. fast_aggregate_verify, single 128-pubkey attestation (urgent-path
      latency: p50/p99 over repeated single-set verifies, depth 1)
-  2. full-block multi-set: 1 proposal + 1 RANDAO + 128 attestations(128 pk)
-     + 1 sync aggregate(512 pk) in ONE batch; p50/p99 block verify latency
+  2. full-block multi-set: 1 proposal + 1 RANDAO + 128 DISTINCT
+     attestations(128 pk) + 1 sync aggregate(512 pk) in ONE batch;
+     p50/p99 block verify latency
   3. Altair sync-committee aggregate: 1 set x 512 pubkeys
   4. Deneb KZG batch blob-proof verify (6 blobs, 4096-element setup) on the
      shared device pairing kernel + device MSM
   5. the headline above
-
-Each config carries its own rough single-host blst/c-kzg baseline estimate
-(EST_* constants below, derivations in comments) — estimates, not measured:
-blst is not present in this image (BASELINE.md notes the same).
-
-A time budget guards the matrix: configs are skipped (recorded as such)
-when the watchdog deadline approaches, so the headline number always lands.
 """
 
 import json
@@ -34,18 +41,14 @@ import os
 import sys
 import time
 
-# LIGHTHOUSE_BENCH_SMOKE=1 shrinks every config to trivial shapes: a CPU
-# dry-run of all code paths (fixture builders, matrix, JSON plumbing) so a
-# real tunnel window is never spent discovering a Python-level bug.
+# LIGHTHOUSE_BENCH_SMOKE=1 loads the tiny fixture variant and shrinks every
+# config: a CPU dry-run of all code paths (fixture loader, matrix, JSON
+# plumbing) so a real tunnel window is never spent discovering a
+# Python-level bug.
 _SMOKE = os.environ.get("LIGHTHOUSE_BENCH_SMOKE") == "1"
 
-N_SETS = 4 if _SMOKE else 64
-N_PKS = 4 if _SMOKE else 128
 BATCHES = 2 if _SMOKE else 8   # timed batches (headline)
 DEPTH = 2 if _SMOKE else 4     # max batches in flight
-SYNC_PKS = 8 if _SMOKE else 512
-KZG_N = 8 if _SMOKE else 4096
-KZG_BLOBS = 2 if _SMOKE else 6
 FULL_BLOCK_REPS = 2 if _SMOKE else 8
 LAT_REPS = 4 if _SMOKE else 30
 
@@ -67,8 +70,9 @@ EST_CKZG_BLOBS_PER_SEC = 400.0
 
 WATCHDOG_SECS = 40 * 60
 _T0 = time.time()
-_HEADLINE = {"value": 0.0, "note": "not reached"}
+_HEADLINE = {"value": 0.0, "note": "not reached", "shape": (64, 128)}
 _MATRIX: dict = {}
+_ROOT = os.path.dirname(os.path.abspath(__file__))
 
 
 def log(msg):
@@ -85,11 +89,13 @@ def _remaining():
 
 def _headline_json():
     v = _HEADLINE["value"]
+    n_sets, n_pks = _HEADLINE["shape"]
     metric = (
-        f"BLS signature-sets verified/sec ({N_SETS} sets x {N_PKS} pubkeys, "
-        f"TPU backend, pipelined depth {DEPTH})"
+        f"BLS signature-sets verified/sec ({n_sets} sets x {n_pks} pubkeys, "
+        f"TPU backend, pipelined depth {DEPTH}; baseline is an ESTIMATED "
+        f"blst throughput)"
     )
-    if not v:
+    if _HEADLINE["note"]:
         metric += f" [{_HEADLINE['note']}]"
     return json.dumps(
         {
@@ -101,25 +107,38 @@ def _headline_json():
     )
 
 
+def _set_headline(value, note):
+    _HEADLINE["value"] = value
+    _HEADLINE["note"] = note
+    log(f"  headline -> {value:.1f} sets/s ({note or 'final'})")
+
+
 def _write_matrix():
     try:
         _MATRIX["elapsed_secs"] = round(_elapsed(), 1)
-        with open(os.path.join(os.path.dirname(__file__) or ".", "BENCH_MATRIX.json"), "w") as f:
+        _MATRIX["baseline_note"] = (
+            "all vs_est_* ratios divide by ESTIMATED single-core blst/c-kzg "
+            "throughputs (EST_* constants in bench.py) — not measurements"
+        )
+        with open(os.path.join(_ROOT, "BENCH_MATRIX.json"), "w") as f:
             json.dump(_MATRIX, f, indent=1)
     except Exception as e:  # pragma: no cover - best effort
         log(f"matrix write failed: {e}")
 
 
 def _arm_watchdog():
-    """If the remote-TPU tunnel wedges, fail loudly with the headline JSON
-    (zero if never measured) instead of hanging the driver forever. The
-    SIGALRM handler only ever runs between Python bytecodes, so it cannot
-    interrupt an in-flight remote compile (the wedge-inducing kill)."""
+    """If the remote-TPU tunnel wedges, fail loudly with the LATEST landed
+    headline (warm-batch rate if that's all we got) instead of hanging the
+    driver forever. The SIGALRM handler only ever runs between Python
+    bytecodes, so it cannot interrupt an in-flight remote compile (the
+    wedge-inducing kill)."""
     import signal
 
     def on_alarm(_sig, _frm):
         if not _HEADLINE["value"]:
             _HEADLINE["note"] = "watchdog fired before measurement"
+        else:
+            _HEADLINE["note"] = (_HEADLINE["note"] or "") + "; watchdog fired"
         _write_matrix()
         print(_headline_json(), flush=True)
         os._exit(3)
@@ -138,117 +157,54 @@ def _tunnel_down(reason: str):
 # ----------------------------------------------------------------- fixtures
 
 
-def _batched_gen_mul(gen_jac_single, bits, ops):
-    import jax
-    import jax.numpy as jnp
-    from lighthouse_tpu.crypto.jaxbls import curve_ops as co
-
-    base = jax.tree_util.tree_map(
-        lambda c: jnp.broadcast_to(c, (bits.shape[0],) + c.shape), gen_jac_single
-    )
-    acc = co.scalar_mul_bits(base, bits, ops)
-    return co.jac_to_affine(acc, ops)
-
-
-_gen_cache: dict = {}
-
-
-def _g1_base_muls(scalars):
-    """scalars -> list of affine G1 int pairs, computed on device in fixed
-    512-wide chunks (one compile)."""
-    import jax
-    import jax.numpy as jnp
+def _load_fixtures():
+    """Rebuild SignatureSets (+ the KZG fixture) from the committed npz —
+    no device work, no compiles, ~a second of host int conversion."""
     import numpy as np
-    from lighthouse_tpu.crypto.bls381 import curve as cv
-    from lighthouse_tpu.crypto.jaxbls import curve_ops as co, limbs as lb
 
-    if "g1" not in _gen_cache:
-        _gen_cache["g1"] = jax.jit(
-            lambda d: (lambda r: (lb.from_mont(r[0]), lb.from_mont(r[1])))(
-                _batched_gen_mul(co.g1_to_device(cv.G1_GEN), d, co.FQ_OPS)
-            )
-        )
-    CHUNK = 512
-    xs, ys = [], []
-    for i in range(0, len(scalars), CHUNK):
-        chunk = scalars[i : i + CHUNK]
-        pad = CHUNK - len(chunk)
-        digs = jnp.asarray(co.scalars_to_bits(list(chunk) + [1] * pad, 256))
-        cx, cy = _gen_cache["g1"](digs)
-        xs.extend(lb.unpack_batch(np.asarray(cx))[: len(chunk)])
-        ys.extend(lb.unpack_batch(np.asarray(cy))[: len(chunk)])
-    return list(zip(xs, ys))
-
-
-def _g2_scalar_muls(points, scalars, width=64):
-    """sig_i = scalars[i] * points[i] on device, padded to `width` lanes."""
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-    from lighthouse_tpu.crypto.jaxbls import curve_ops as co, limbs as lb
-
-    key = ("g2", width)
-    if key not in _gen_cache:
-        _gen_cache[key] = jax.jit(
-            lambda h, d: (lambda r: (lb.from_mont(r[0]), lb.from_mont(r[1])))(
-                (lambda acc: co.jac_to_affine(acc, co.FQ2_OPS))(
-                    co.scalar_mul_bits(h, d, co.FQ2_OPS)
-                )
-            )
-        )
-    n = len(points)
-    pad = width - n
-    hd = co.g2_batch_to_device(list(points) + [points[0]] * pad)
-    # scalar_mul_bits wants the jacobian point pytree
-    sdigs = jnp.asarray(co.scalars_to_bits(list(scalars) + [1] * pad, 256))
-    sx, sy = _gen_cache[key](hd, sdigs)
-    sx = np.asarray(sx)[:n]
-    sy = np.asarray(sy)[:n]
-
-    def fq2_of(arr):
-        return (lb.unpack(arr[0]), lb.unpack(arr[1]))
-
-    return [(fq2_of(sx[i]), fq2_of(sy[i])) for i in range(n)]
-
-
-def build_sets(rng, groups):
-    """groups: list of (n_pks, message). Returns SignatureSets with valid
-    aggregate signatures, all scalar muls on device."""
     from lighthouse_tpu.crypto import bls
-    from lighthouse_tpu.crypto.bls381 import hash_to_curve as ph2c
-    from lighthouse_tpu.crypto.bls381.constants import DST_POP, R
 
-    n_keys = sum(g[0] for g in groups)
-    sks = [rng.randrange(1, R) for _ in range(n_keys)]
+    name = "bench_fixtures_smoke.npz" if _SMOKE else "bench_fixtures.npz"
+    path = os.path.join(_ROOT, name)
+    z = np.load(path)
+    meta = json.loads(bytes(z["meta"]))
+
+    def fq(a) -> int:
+        return int.from_bytes(bytes(a), "big")
+
+    def g1(a):
+        return (fq(a[0]), fq(a[1]))
+
+    def g2(a):
+        return ((fq(a[0, 0]), fq(a[0, 1])), (fq(a[1, 0]), fq(a[1, 1])))
+
+    def group(keys, sig, msg):
+        return bls.SignatureSet(
+            bls.Signature(g2(sig)),
+            [bls.PublicKey(g1(k)) for k in keys],
+            bytes(msg),
+        )
+
     t0 = time.time()
-    pts = _g1_base_muls(sks)
-    pks = [bls.PublicKey(p) for p in pts]
-    log(f"  pubkey gen x{n_keys} (device): {time.time()-t0:.1f}s")
-
-    t0 = time.time()
-    agg_sks, hs = [], []
-    off = 0
-    for n_pks, msg in groups:
-        agg_sks.append(sum(sks[off : off + n_pks]) % R)
-        hs.append(ph2c.hash_to_g2(msg, DST_POP))
-        off += n_pks
-    log(f"  hash-to-g2 x{len(groups)} (host): {time.time()-t0:.1f}s")
-
-    t0 = time.time()
-    width = 64 if len(groups) <= 64 else 256
-    sig_pts = _g2_scalar_muls(hs, agg_sks, width=width)
-    log(f"  signature gen (device): {time.time()-t0:.1f}s")
-
-    sets = []
-    off = 0
-    for (n_pks, msg), sp in zip(groups, sig_pts):
-        sets.append(bls.SignatureSet(bls.Signature(sp), pks[off : off + n_pks], msg))
-        off += n_pks
-    return sets
-
-
-def _msg(i, tag=0):
-    return bytes([tag]) + i.to_bytes(31, "big")
+    att = [
+        group(z["att_keys"][i], z["att_sigs"][i], z["att_msgs"][i])
+        for i in range(meta["n_att"])
+    ]
+    small = [
+        group(z["small_keys"][i], z["small_sigs"][i], z["small_msgs"][i])
+        for i in range(2)
+    ]
+    sync = [group(z["sync_keys"], z["sync_sigs"][0], z["sync_msgs"][0])]
+    kzg = {
+        "g1_lagrange": [g1(p) for p in z["kzg_setup_g1"]],
+        "g2_monomial": [g2(p) for p in z["kzg_g2_monomial"]],
+        "blobs": [bytes(b) for b in z["kzg_blobs"]],
+        "commitments": [bytes(c) for c in z["kzg_commitments"]],
+        "proofs": [bytes(p) for p in z["kzg_proofs"]],
+    }
+    log(f"fixtures loaded from {name} in {time.time()-t0:.1f}s "
+        f"({meta['n_att']} att sets x {meta['n_pks']} pks)")
+    return {"att": att, "small": small, "sync": sync, "kzg": kzg, "meta": meta}
 
 
 def _rands(rng, n):
@@ -257,12 +213,17 @@ def _rands(rng, n):
 
 def _pallas_guard(backend, sets, rands):
     """First verify attempt; if the fused Pallas path fails to compile on
-    this platform, fall back to the XLA pairing and retry once."""
+    this platform, fall back to the XLA pairing and retry once. Returns the
+    warm-batch wall time."""
     try:
         t0 = time.time()
         ok = backend.verify_signature_sets(sets, rands)
-        log(f"  warmup/compile: {time.time()-t0:.1f}s ok={ok}")
-        return ok
+        dt = time.time() - t0
+        log(f"  warmup/compile: {dt:.1f}s ok={ok}")
+        assert ok, "warm batch failed to verify"
+        return dt
+    except AssertionError:
+        raise
     except Exception as e:
         log(f"  pallas path failed ({type(e).__name__}: {e}); retrying with XLA pairing")
         os.environ["LIGHTHOUSE_TPU_PALLAS"] = "off"
@@ -271,9 +232,11 @@ def _pallas_guard(backend, sets, rands):
         jb._kernel_cache.clear()
         t0 = time.time()
         ok = backend.verify_signature_sets(sets, rands)
-        log(f"  warmup/compile (XLA): {time.time()-t0:.1f}s ok={ok}")
+        dt = time.time() - t0
+        log(f"  warmup/compile (XLA): {dt:.1f}s ok={ok}")
+        assert ok, "warm batch failed to verify (XLA path)"
         _MATRIX["pallas"] = "fallback-to-xla"
-        return ok
+        return dt
 
 
 def _latency_stats(samples):
@@ -290,12 +253,36 @@ def _latency_stats(samples):
 # ----------------------------------------------------------------- configs
 
 
-def run_headline(backend, rng):
-    log(f"[config 5] gossip firehose {N_SETS}x{N_PKS}")
-    sets = build_sets(rng, [(N_PKS, _msg(i)) for i in range(N_SETS)])
-    rands = _rands(rng, N_SETS)
-    assert _pallas_guard(backend, sets, rands), "headline batch failed to verify"
+def run_headline(backend, fx, rng):
+    from lighthouse_tpu.crypto import bls
 
+    n_att, n_pks = fx["meta"]["n_att"], fx["meta"]["n_pks"]
+    n_sets = n_att // 2
+    _HEADLINE["shape"] = (n_sets, n_pks)
+    log(f"[config 5] gossip firehose {n_sets}x{n_pks}")
+    sets = fx["att"][:n_sets]
+    rands = _rands(rng, n_sets)
+
+    warm_dt = _pallas_guard(backend, sets, rands)
+    # first landed number: pessimistic (includes the compile) but nonzero —
+    # a tunnel drop after this point no longer reports 0.0
+    _set_headline(n_sets / warm_dt, "warm batch only, incl. compile")
+
+    # negative control on the warmed bucket: swapped signature must reject
+    bad = list(sets)
+    bad[1] = bls.SignatureSet(sets[0].signature, sets[1].signing_keys, sets[1].message)
+    assert not backend.verify_signature_sets(bad, rands), (
+        "negative control FAILED: tampered batch verified"
+    )
+    log("  negative control: tampered batch rejected")
+
+    # one synchronous timed batch -> provisional steady-state rate
+    t0 = time.time()
+    assert backend.verify_signature_sets(sets, rands)
+    dt1 = time.time() - t0
+    _set_headline(n_sets / dt1, "single steady-state batch")
+
+    # the real measurement: pipelined batches, every result checked
     t0 = time.time()
     inflight = []
     for i in range(BATCHES):
@@ -305,20 +292,23 @@ def run_headline(backend, rng):
     while inflight:
         assert inflight.pop(0).result()
     dt = time.time() - t0
-    sets_per_sec = N_SETS * BATCHES / dt
+    sets_per_sec = n_sets * BATCHES / dt
     log(f"  {BATCHES} batches in {dt:.2f}s (depth {DEPTH}) -> {sets_per_sec:.1f} sets/s")
-    _HEADLINE["value"] = sets_per_sec
+    _set_headline(sets_per_sec, "")
     _MATRIX["config5_firehose"] = {
         "sets_per_sec": round(sets_per_sec, 2),
+        "single_batch_sets_per_sec": round(n_sets / dt1, 2),
+        "warm_batch_secs": round(warm_dt, 1),
         "vs_est_blst": round(sets_per_sec / EST_BLST_SETS_PER_SEC, 3),
     }
     return sets, rands
 
 
-def run_single_fav(backend, sets, rng):
+def run_single_fav(backend, fx, rng):
     """Config 1 + urgent-path latency: one 128-pk set, depth 1."""
-    log(f"[config 1] single fast_aggregate_verify ({N_PKS} pks), urgent path")
-    one = [sets[0]]
+    n_pks = fx["meta"]["n_pks"]
+    log(f"[config 1] single fast_aggregate_verify ({n_pks} pks), urgent path")
+    one = [fx["att"][0]]
     rands = [1]
     assert backend.verify_signature_sets(one, rands)  # compile bucket
     samples = []
@@ -336,9 +326,10 @@ def run_single_fav(backend, sets, rng):
     }
 
 
-def run_sync_aggregate(backend, rng):
-    log("[config 3] sync-committee aggregate")
-    sets = build_sets(rng, [(SYNC_PKS, _msg(0, tag=3))])
+def run_sync_aggregate(backend, fx, rng):
+    log("[config 3] sync-committee aggregate "
+        f"({fx['meta']['sync_pks']} pks)")
+    sets = fx["sync"]
     rands = [1]
     assert backend.verify_signature_sets(sets, rands)
     samples = []
@@ -354,15 +345,15 @@ def run_sync_aggregate(backend, rng):
         "verifies_per_sec": round(per_sec, 2),
         "vs_est_blst": round(per_sec / EST_BLST_SYNC_AGG_PER_SEC, 3),
     }
-    return sets
 
 
-def run_full_block(backend, att_sets, sync_sets, rng):
+def run_full_block(backend, fx, rng):
     """Config 2 + p99 per-block verify latency: proposer + RANDAO + 128
-    attestations + sync aggregate as ONE multi-set batch."""
+    DISTINCT attestations + sync aggregate as ONE multi-set batch (the r4
+    fixture double-counted 64 sets twice; these are 128 independent key
+    groups with distinct messages — scripts/gen_bench_fixtures.py)."""
     log("[config 2] full-block multi-set + p99 block latency")
-    small = build_sets(rng, [(1, _msg(0, tag=1)), (1, _msg(1, tag=1))])
-    block_sets = small + att_sets + att_sets_alt(att_sets) + sync_sets
+    block_sets = fx["small"] + fx["att"] + fx["sync"]
     rands = _rands(rng, len(block_sets))
     assert backend.verify_signature_sets(block_sets, rands)
     samples = []
@@ -381,62 +372,43 @@ def run_full_block(backend, att_sets, sync_sets, rng):
     }
 
 
-def att_sets_alt(att_sets):
-    """Second half of the block's 128 attestations: reuse the 64 firehose
-    sets (same keys+messages, verified independently under fresh random
-    coefficients — throughput-equivalent to distinct attestations)."""
-    return list(att_sets)
-
-
-def run_kzg(rng):
+def run_kzg(fx):
     log("[config 4] KZG batch blob-proof verify")
     from lighthouse_tpu.crypto import kzg
-    from lighthouse_tpu.crypto.bls381 import curve as cv, serde
-    from lighthouse_tpu.crypto.bls381.constants import R
 
-    t0 = time.time()
-    n = KZG_N
-    lis, tau = kzg.TrustedSetup.dev_setup_scalars(n)
-    g1 = _g1_base_muls(lis)
+    k = fx["kzg"]
+    n = len(k["g1_lagrange"])
     setup = kzg.TrustedSetup(
-        g1_lagrange=g1,
-        g2_monomial=[cv.G2_GEN, cv.g2_mul(cv.G2_GEN, tau)],
+        g1_lagrange=k["g1_lagrange"],
+        g2_monomial=k["g2_monomial"],
         roots=kzg._fr_roots_of_unity(n),
     )
-    log(f"  setup build: {time.time()-t0:.1f}s")
-
-    t0 = time.time()
-    blobs, cbs, pbs = [], [], []
-    for _ in range(KZG_BLOBS):
-        blob = b"".join(rng.randrange(R).to_bytes(32, "big") for _ in range(n))
-        c = kzg.blob_to_kzg_commitment(blob, setup)
-        cb = serde.g1_compress(c)
-        p = kzg.compute_blob_kzg_proof(blob, cb, setup)
-        blobs.append(blob)
-        cbs.append(cb)
-        pbs.append(serde.g1_compress(p))
-    log(f"  blob/proof fixture (device MSM): {time.time()-t0:.1f}s")
+    blobs, cbs, pbs = k["blobs"], k["commitments"], k["proofs"]
+    n_blobs = len(blobs)
 
     assert kzg.verify_blob_kzg_proof_batch(blobs, cbs, pbs, setup)
+    # negative control: a bit-flipped blob must reject
+    bad = [bytes([blobs[0][0] ^ 1]) + blobs[0][1:]] + list(blobs[1:])
+    assert not kzg.verify_blob_kzg_proof_batch(bad, cbs, pbs, setup), (
+        "KZG negative control FAILED"
+    )
     samples = []
     for _ in range(3 if _SMOKE else 5):
         t0 = time.time()
         assert kzg.verify_blob_kzg_proof_batch(blobs, cbs, pbs, setup)
         samples.append(time.time() - t0)
     st = _latency_stats(samples)
-    blobs_per_sec = float(KZG_BLOBS) / (st["mean_ms"] / 1e3)
+    blobs_per_sec = float(n_blobs) / (st["mean_ms"] / 1e3)
     log(f"  {st} -> {blobs_per_sec:.1f} blobs/s")
     _MATRIX["config4_kzg_batch_verify"] = {
         **st,
-        "blobs": KZG_BLOBS,
+        "blobs": n_blobs,
         "blobs_per_sec": round(blobs_per_sec, 2),
         "vs_est_ckzg": round(blobs_per_sec / EST_CKZG_BLOBS_PER_SEC, 3),
     }
 
 
 def main():
-    from lighthouse_tpu.utils.jaxcfg import setup_compilation_cache
-
     _arm_watchdog()
     if _SMOKE:
         # smoke mode dry-runs the whole bench on CPU — never touches the
@@ -445,6 +417,8 @@ def main():
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    from lighthouse_tpu.utils.jaxcfg import setup_compilation_cache
+
     setup_compilation_cache()
     import random
 
@@ -465,31 +439,46 @@ def main():
     backend = bls_api.set_backend("jax")
     rng = random.Random(0xBE7C)
 
-    att_sets, _ = run_headline(backend, rng)
-
-    def attempt(name, need_secs, fn):
-        """Best-effort matrix config under the watchdog budget."""
-        if _remaining() < need_secs:
-            log(f"[{name}] skipped: {int(_remaining())}s left < {need_secs}s budget")
-            _MATRIX[f"{name}_skipped"] = "time budget"
-            return None
+    try:
         try:
-            return fn()
+            fx = _load_fixtures()   # host-only, but any failure must still
+                                    # emit the headline JSON (finally below)
         except Exception as e:
-            log(f"[{name}] FAILED: {type(e).__name__}: {e}")
-            _MATRIX[f"{name}_error"] = f"{type(e).__name__}: {e}"
-            return None
+            _HEADLINE["note"] = f"fixture load FAILED: {type(e).__name__}: {e}"
+            log(_HEADLINE["note"])
+            return
+        try:
+            run_headline(backend, fx, rng)
+        except Exception as e:
+            # keep whatever headline already landed (warm batch / single
+            # batch) — a tunnel drop mid-measurement is an outage note, not
+            # a zero
+            _HEADLINE["note"] = (
+                (_HEADLINE["note"] or "")
+                + f"; died mid-run: {type(e).__name__}: {e}"
+            ).lstrip("; ")
+            log(f"[headline] FAILED: {type(e).__name__}: {e}")
+            _MATRIX["config5_error"] = f"{type(e).__name__}: {e}"
 
-    attempt("config1", 300, lambda: run_single_fav(backend, att_sets, rng))
-    sync_sets = attempt("config3", 420, lambda: run_sync_aggregate(backend, rng))
-    if sync_sets is not None:
-        attempt("config2", 600, lambda: run_full_block(backend, att_sets, sync_sets, rng))
-    else:
-        _MATRIX["config2_skipped"] = "needs config3 fixture"
-    attempt("config4", 600, lambda: run_kzg(rng))
+        def attempt(name, need_secs, fn):
+            """Best-effort matrix config under the watchdog budget."""
+            if _remaining() < need_secs:
+                log(f"[{name}] skipped: {int(_remaining())}s left < {need_secs}s budget")
+                _MATRIX[f"{name}_skipped"] = "time budget"
+                return
+            try:
+                fn()
+            except Exception as e:
+                log(f"[{name}] FAILED: {type(e).__name__}: {e}")
+                _MATRIX[f"{name}_error"] = f"{type(e).__name__}: {e}"
 
-    _write_matrix()
-    print(_headline_json(), flush=True)
+        attempt("config1", 300, lambda: run_single_fav(backend, fx, rng))
+        attempt("config3", 420, lambda: run_sync_aggregate(backend, fx, rng))
+        attempt("config2", 600, lambda: run_full_block(backend, fx, rng))
+        attempt("config4", 600, lambda: run_kzg(fx))
+    finally:
+        _write_matrix()
+        print(_headline_json(), flush=True)
 
 
 if __name__ == "__main__":

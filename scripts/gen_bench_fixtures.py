@@ -1,0 +1,306 @@
+#!/usr/bin/env python
+"""Generate persisted bench fixtures: bench_fixtures.npz (+ _smoke variant).
+
+Run OFFLINE, once, on any platform (local CPU is fine) — bench.py only
+LOADS the npz at measurement time. Round 4's only tunnel window died inside
+fixture generation (device pubkey gen + signature-gen compile) before the
+verify pipeline ever warmed; persisting the fixtures means zero fixture
+kernels compile inside a tunnel window and the measured region starts
+minutes earlier (VERDICT r4 weak #4).
+
+Contents (all big-endian 48-byte field elements, uint8 arrays):
+  att:   128 DISTINCT attestation-style sets, 128 pubkeys each, distinct
+         messages (fixes the r4 att_sets_alt double-count — same-keys+
+         same-messages sets let the pubkey marshal cache and repeated
+         hash-to-field inputs make config 2 easier than a real block)
+  small: 2 single-pubkey sets (the proposal + RANDAO roles in config 2)
+  sync:  1 set x 512 pubkeys (config 3, the Altair sync aggregate)
+  kzg:   4096-entry insecure dev setup, 6 blobs + commitments + proofs
+         (config 4) — reference workload /root/reference/crypto/kzg/src/lib.rs:81
+
+Validation at gen time: every BLS set verifies through the device backend,
+and a sample re-verifies through the pure-Python backend (independent of
+the jax kernels); one tampered set must reject.
+"""
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+SEED = 0xF1C7
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _be48(x: int) -> bytes:
+    return int(x).to_bytes(48, "big")
+
+
+def _g1_arr(points) -> np.ndarray:
+    """[(x, y)] -> (n, 2, 48) uint8."""
+    return np.frombuffer(
+        b"".join(_be48(p[0]) + _be48(p[1]) for p in points), np.uint8
+    ).reshape(len(points), 2, 48)
+
+
+def _g2_arr(points) -> np.ndarray:
+    """[((x0,x1),(y0,y1))] -> (n, 2, 2, 48) uint8."""
+    return np.frombuffer(
+        b"".join(
+            _be48(p[0][0]) + _be48(p[0][1]) + _be48(p[1][0]) + _be48(p[1][1])
+            for p in points
+        ),
+        np.uint8,
+    ).reshape(len(points), 2, 2, 48)
+
+
+# ---------------------------------------------------------- device builders
+# (moved here from bench.py — generation-time only)
+
+
+def _batched_gen_mul(gen_jac_single, bits, ops):
+    import jax
+    import jax.numpy as jnp
+    from lighthouse_tpu.crypto.jaxbls import curve_ops as co
+
+    base = jax.tree_util.tree_map(
+        lambda c: jnp.broadcast_to(c, (bits.shape[0],) + c.shape), gen_jac_single
+    )
+    acc = co.scalar_mul_bits(base, bits, ops)
+    return co.jac_to_affine(acc, ops)
+
+
+_gen_cache: dict = {}
+
+
+def _g1_base_muls(scalars):
+    """scalars -> list of affine G1 int pairs, computed on device in fixed
+    512-wide chunks (one compile)."""
+    import jax
+    import jax.numpy as jnp
+    from lighthouse_tpu.crypto.bls381 import curve as cv
+    from lighthouse_tpu.crypto.jaxbls import curve_ops as co, limbs as lb
+
+    if "g1" not in _gen_cache:
+        _gen_cache["g1"] = jax.jit(
+            lambda d: (lambda r: (lb.from_mont(r[0]), lb.from_mont(r[1])))(
+                _batched_gen_mul(co.g1_to_device(cv.G1_GEN), d, co.FQ_OPS)
+            )
+        )
+    CHUNK = 512
+    xs, ys = [], []
+    for i in range(0, len(scalars), CHUNK):
+        chunk = scalars[i : i + CHUNK]
+        pad = CHUNK - len(chunk)
+        digs = jnp.asarray(co.scalars_to_bits(list(chunk) + [1] * pad, 256))
+        cx, cy = _gen_cache["g1"](digs)
+        xs.extend(lb.unpack_batch(np.asarray(cx))[: len(chunk)])
+        ys.extend(lb.unpack_batch(np.asarray(cy))[: len(chunk)])
+    return list(zip(xs, ys))
+
+
+def _g2_scalar_muls(points, scalars, width=64):
+    """sig_i = scalars[i] * points[i] on device, padded to `width` lanes."""
+    import jax
+    import jax.numpy as jnp
+    from lighthouse_tpu.crypto.jaxbls import curve_ops as co, limbs as lb
+
+    key = ("g2", width)
+    if key not in _gen_cache:
+        _gen_cache[key] = jax.jit(
+            lambda h, d: (lambda r: (lb.from_mont(r[0]), lb.from_mont(r[1])))(
+                (lambda acc: co.jac_to_affine(acc, co.FQ2_OPS))(
+                    co.scalar_mul_bits(h, d, co.FQ2_OPS)
+                )
+            )
+        )
+    n = len(points)
+    pad = width - n
+    hd = co.g2_batch_to_device(list(points) + [points[0]] * pad)
+    sdigs = jnp.asarray(co.scalars_to_bits(list(scalars) + [1] * pad, 256))
+    sx, sy = _gen_cache[key](hd, sdigs)
+    sx = np.asarray(sx)[:n]
+    sy = np.asarray(sy)[:n]
+    from lighthouse_tpu.crypto.jaxbls import limbs as lb
+
+    def fq2_of(arr):
+        return (lb.unpack(arr[0]), lb.unpack(arr[1]))
+
+    return [(fq2_of(sx[i]), fq2_of(sy[i])) for i in range(n)]
+
+
+def _msg(i, tag=0):
+    return bytes([tag]) + i.to_bytes(31, "big")
+
+
+def build_groups(rng, groups):
+    """groups: [(n_pks, message)] -> (keys_per_group, sig_points, messages).
+
+    Valid aggregate signatures over distinct keys; all scalar muls on device.
+    """
+    from lighthouse_tpu.crypto.bls381 import hash_to_curve as ph2c
+    from lighthouse_tpu.crypto.bls381.constants import DST_POP, R
+
+    n_keys = sum(g[0] for g in groups)
+    sks = [rng.randrange(1, R) for _ in range(n_keys)]
+    t0 = time.time()
+    pts = _g1_base_muls(sks)
+    log(f"  pubkey gen x{n_keys} (device): {time.time()-t0:.1f}s")
+
+    t0 = time.time()
+    agg_sks, hs = [], []
+    off = 0
+    for n_pks, msg in groups:
+        agg_sks.append(sum(sks[off : off + n_pks]) % R)
+        hs.append(ph2c.hash_to_g2(msg, DST_POP))
+        off += n_pks
+    log(f"  hash-to-g2 x{len(groups)} (host): {time.time()-t0:.1f}s")
+
+    t0 = time.time()
+    width = 64
+    while width < len(groups):
+        width *= 2
+    sig_pts = _g2_scalar_muls(hs, agg_sks, width=width)
+    log(f"  signature gen (device): {time.time()-t0:.1f}s")
+
+    keys, off = [], 0
+    for n_pks, _msg_ in groups:
+        keys.append(pts[off : off + n_pks])
+        off += n_pks
+    return keys, sig_pts, [g[1] for g in groups]
+
+
+def gen_kzg(rng, n, n_blobs):
+    from lighthouse_tpu.crypto import kzg
+    from lighthouse_tpu.crypto.bls381 import curve as cv, serde
+    from lighthouse_tpu.crypto.bls381.constants import R
+
+    t0 = time.time()
+    lis, tau = kzg.TrustedSetup.dev_setup_scalars(n)
+    g1 = _g1_base_muls(lis)
+    g2m = [cv.G2_GEN, cv.g2_mul(cv.G2_GEN, tau)]
+    setup = kzg.TrustedSetup(
+        g1_lagrange=g1, g2_monomial=g2m, roots=kzg._fr_roots_of_unity(n)
+    )
+    log(f"  kzg setup build (n={n}): {time.time()-t0:.1f}s")
+
+    t0 = time.time()
+    blobs, cbs, pbs = [], [], []
+    for _ in range(n_blobs):
+        blob = b"".join(rng.randrange(R).to_bytes(32, "big") for _ in range(n))
+        c = kzg.blob_to_kzg_commitment(blob, setup)
+        cb = serde.g1_compress(c)
+        p = kzg.compute_blob_kzg_proof(blob, cb, setup)
+        blobs.append(blob)
+        cbs.append(cb)
+        pbs.append(serde.g1_compress(p))
+    log(f"  kzg blob/proof fixture x{n_blobs}: {time.time()-t0:.1f}s")
+    assert kzg.verify_blob_kzg_proof_batch(blobs, cbs, pbs, setup), (
+        "kzg fixture failed to verify"
+    )
+    return g1, g2m, blobs, cbs, pbs
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true", help="tiny shapes variant")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    # generation always runs on local CPU: the tunnel is for measurement
+    # windows only (sitecustomize pins the axon platform; env vars alone
+    # can't override it, so set jax.config before any backend initializes)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from lighthouse_tpu.utils.jaxcfg import setup_compilation_cache
+
+    setup_compilation_cache()
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.crypto.bls import api as bls_api
+
+    if args.smoke:
+        n_att, n_pks, sync_pks, kzg_n, kzg_blobs = 4, 4, 8, 8, 2
+        out = args.out or "bench_fixtures_smoke.npz"
+    else:
+        n_att, n_pks, sync_pks, kzg_n, kzg_blobs = 128, 128, 512, 4096, 6
+        out = args.out or "bench_fixtures.npz"
+
+    rng = random.Random(SEED)
+    bls_api.set_backend("jax")   # device path for the generation kernels
+
+    groups = (
+        [(n_pks, _msg(i)) for i in range(n_att)]
+        + [(1, _msg(0, tag=1)), (1, _msg(1, tag=1))]
+        + [(sync_pks, _msg(0, tag=3))]
+    )
+    log(f"building {len(groups)} signature groups "
+        f"({sum(g[0] for g in groups)} keys)")
+    keys, sigs, msgs = build_groups(rng, groups)
+
+    # EVERY set verifies through the pure-Python backend — independent of
+    # all jax kernels (bench.py re-asserts on-device verification, with a
+    # negative control, at measurement time); a tampered set must reject
+    sets = [
+        bls.SignatureSet(bls.Signature(sp), [bls.PublicKey(p) for p in ks], m)
+        for ks, sp, m in zip(keys, sigs, msgs)
+    ]
+    py = bls_api.set_backend("python")
+    t0 = time.time()
+    rands = [1] + [rng.getrandbits(64) | 1 for _ in sets[1:]]
+    assert py.verify_signature_sets(sets, rands), "python backend disagrees"
+    bad = bls.SignatureSet(sets[1].signature, sets[0].signing_keys, sets[0].message)
+    assert not py.verify_signature_sets([bad], [1]), "tampered set accepted"
+    log(f"  python-backend verification of ALL {len(sets)} sets: "
+        f"{time.time()-t0:.1f}s")
+    bls_api.set_backend("jax")
+
+    kzg_g1, kzg_g2m, blobs, cbs, pbs = gen_kzg(rng, kzg_n, kzg_blobs)
+
+    arrays = {
+        "att_keys": np.stack([_g1_arr(k) for k in keys[:n_att]]),
+        "att_sigs": _g2_arr(sigs[:n_att]),
+        "att_msgs": np.frombuffer(b"".join(msgs[:n_att]), np.uint8).reshape(-1, 32),
+        "small_keys": np.stack([_g1_arr(k) for k in keys[n_att : n_att + 2]]),
+        "small_sigs": _g2_arr(sigs[n_att : n_att + 2]),
+        "small_msgs": np.frombuffer(
+            b"".join(msgs[n_att : n_att + 2]), np.uint8
+        ).reshape(-1, 32),
+        "sync_keys": _g1_arr(keys[n_att + 2]),
+        "sync_sigs": _g2_arr([sigs[n_att + 2]]),
+        "sync_msgs": np.frombuffer(msgs[n_att + 2], np.uint8).reshape(1, 32),
+        "kzg_setup_g1": _g1_arr(kzg_g1),
+        "kzg_g2_monomial": _g2_arr(kzg_g2m),
+        "kzg_blobs": np.frombuffer(b"".join(blobs), np.uint8).reshape(kzg_blobs, -1),
+        "kzg_commitments": np.frombuffer(b"".join(cbs), np.uint8).reshape(-1, 48),
+        "kzg_proofs": np.frombuffer(b"".join(pbs), np.uint8).reshape(-1, 48),
+        "meta": np.frombuffer(
+            json.dumps(
+                {
+                    "seed": SEED,
+                    "n_att": n_att,
+                    "n_pks": n_pks,
+                    "sync_pks": sync_pks,
+                    "kzg_n": kzg_n,
+                    "kzg_blobs": kzg_blobs,
+                }
+            ).encode(),
+            np.uint8,
+        ),
+    }
+    path = os.path.join(os.path.dirname(__file__), "..", out)
+    np.savez_compressed(path, **arrays)
+    log(f"wrote {os.path.abspath(path)} ({os.path.getsize(path) / 1e6:.1f} MB)")
+
+
+if __name__ == "__main__":
+    main()

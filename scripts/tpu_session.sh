@@ -59,6 +59,14 @@ PY
 }
 
 log "tpu session watcher started"
+# bench.py only LOADS fixtures (tunnel windows are for measuring, not
+# fixture generation); build them on CPU first if absent
+if [ ! -f bench_fixtures.npz ]; then
+  log "bench_fixtures.npz missing — generating on CPU (one-time)"
+  python scripts/gen_bench_fixtures.py >> "$LOG" 2>&1 \
+    && log "fixture generation complete" \
+    || log "fixture generation FAILED rc=$? (bench will report the gap)"
+fi
 ATTEMPT=0
 while true; do
   ATTEMPT=$((ATTEMPT + 1))
