@@ -1,0 +1,357 @@
+"""Production epoch transition vs the spec-literal slow oracle (slow_epoch.py).
+
+The production path (state_transition/epoch.py) shares registry scans and
+cached totals; the oracle recomputes everything multi-pass from raw fields.
+Running both over every epoch boundary of a harness-built chain gives the
+state transition an expected value that was NOT produced by the code under
+test (VERDICT r4 missing #4 — the self-generated EF lane can't catch a bug
+that's in both the generator and the runner).
+
+Boundary coverage on the minimal preset across 8 epochs:
+  epoch 1..8   justification/finalization, rewards, inactivity
+  epoch 3, 7   eth1-data reset (EPOCHS_PER_ETH1_VOTING_PERIOD = 4)
+  epoch 7      sync-committee rotation (period = 8) + historical summaries
+               (SLOTS_PER_HISTORICAL_ROOT/SLOTS_PER_EPOCH = 8)
+plus a synthetic scenario exercising slashing penalties, ejection, the
+activation queue, and effective-balance hysteresis, and a no-attestation
+chain that enters the inactivity leak.
+
+Sabotage drills at the bottom prove injected production bugs are CAUGHT by
+the oracle comparison.
+"""
+
+import pytest
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.state_transition import epoch as prod_epoch
+from lighthouse_tpu.state_transition.slot import process_slot, types_for_slot
+from lighthouse_tpu.testing.compare_fields import compare_fields
+from lighthouse_tpu.testing.harness import StateHarness, clone_state
+from lighthouse_tpu.types.spec import ForkName, minimal_spec
+
+from tests import slow_epoch
+
+VALIDATORS = 64
+
+
+def _compare_epoch_transition(state, spec, label: str):
+    """state must sit at slot k*SLOTS_PER_EPOCH - 1 (post-block). Runs the
+    production epoch transition and the slow oracle on independent clones
+    and diffs every field."""
+    fork = spec.fork_name_at_slot(state.slot)
+    types = types_for_slot(spec, state.slot)
+    a = clone_state(state, spec)
+    b = clone_state(state, spec)
+    # the slot-root caching part of per_slot_processing (shared plumbing,
+    # pinned by the slow-SSZ oracle) must run before epoch processing
+    process_slot(a, spec)
+    process_slot(b, spec)
+    prod_epoch.process_epoch(a, spec, types, fork)
+    slow_epoch.slow_process_epoch(b, spec, types, fork.name)
+    diffs = compare_fields(a, b, path=label)
+    assert not diffs, f"oracle mismatch at {label}: {diffs[:8]}"
+    return a
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return minimal_spec()
+
+
+@pytest.fixture(scope="module")
+def harness(spec):
+    bls.set_backend("fake")
+    return StateHarness.new(spec, VALIDATORS)
+
+
+def _walk_epochs(h, spec, n_epochs: int, attest: bool):
+    """Extend the chain epoch by epoch, comparing production vs oracle at
+    EVERY boundary."""
+    spe = spec.preset.SLOTS_PER_EPOCH
+    compared = 0
+    while compared < n_epochs:
+        # advance to one slot before the next epoch boundary
+        to_go = (spe - 1) - (h.state.slot % spe)
+        if to_go:
+            h.extend_chain(to_go, attest=attest)
+        _compare_epoch_transition(
+            h.state, spec, label=f"epoch{h.state.slot // spe}"
+        )
+        # let the real chain cross the boundary (production path)
+        h.extend_chain(1, attest=attest)
+        compared += 1
+    return h
+
+
+def test_oracle_agrees_across_eight_epochs_full_participation(spec, harness):
+    h = StateHarness(
+        spec=spec, keypairs=harness.keypairs,
+        state=clone_state(harness.state, spec),
+    )
+    assert spec.fork_name_at_slot(0) == ForkName.deneb
+    _walk_epochs(h, spec, n_epochs=8, attest=True)
+    # the chain must actually have finalized (the boundaries were
+    # non-trivial) and rotated its sync committee at epoch 7
+    assert h.state.finalized_checkpoint.epoch >= 4
+
+
+def test_oracle_agrees_in_inactivity_leak(spec, harness):
+    h = StateHarness(
+        spec=spec, keypairs=harness.keypairs,
+        state=clone_state(harness.state, spec),
+    )
+    _walk_epochs(h, spec, n_epochs=7, attest=False)
+    assert slow_epoch.is_in_inactivity_leak(h.state, spec)
+    assert any(s > 0 for s in h.state.inactivity_scores)
+
+
+def test_oracle_agrees_on_slashings_ejections_activations(spec, harness):
+    """Synthetic boundary state exercising the registry/slashing paths that
+    a healthy full-participation chain never hits. The chain is NOT
+    extended past the mutated boundary (the mutations change the active
+    set, which would invalidate in-flight harness attestations) — the
+    comparison itself is the point."""
+    h = StateHarness(
+        spec=spec, keypairs=harness.keypairs,
+        state=clone_state(harness.state, spec),
+    )
+    spe = spec.preset.SLOTS_PER_EPOCH
+    # build up finalization first so the activation-eligibility branch is
+    # live, then inject the scenario right before a boundary
+    h.extend_chain(spe * 5 - 1, attest=True)
+    state = h.state
+    assert (state.slot + 1) % spe == 0
+    assert state.finalized_checkpoint.epoch >= 1
+    cur = state.slot // spe
+    pre_bal_3 = state.balances[3]
+    pre_eff_8 = state.validators[8].effective_balance
+    # slashing penalty fires when withdrawable == epoch + vector/2
+    state.validators[3] = state.validators[3].copy_with(
+        slashed=True,
+        withdrawable_epoch=cur + spec.preset.EPOCHS_PER_SLASHINGS_VECTOR // 2,
+    )
+    state.slashings[cur % spec.preset.EPOCHS_PER_SLASHINGS_VECTOR] = (
+        state.validators[3].effective_balance
+    )
+    # ejection: active with balance at the floor
+    state.validators[5] = state.validators[5].copy_with(
+        effective_balance=spec.ejection_balance
+    )
+    # activation-queue entry: fresh validator shape
+    state.validators[6] = state.validators[6].copy_with(
+        activation_eligibility_epoch=slow_epoch.FAR_FUTURE_EPOCH,
+        activation_epoch=slow_epoch.FAR_FUTURE_EPOCH,
+        effective_balance=spec.max_effective_balance,
+    )
+    # pending activation already eligible (finalized >= 1 by now)
+    state.validators[7] = state.validators[7].copy_with(
+        activation_eligibility_epoch=1,
+        activation_epoch=slow_epoch.FAR_FUTURE_EPOCH,
+    )
+    # hysteresis: balance far below effective balance
+    state.balances[8] = 5 * 10**9
+
+    post = _compare_epoch_transition(state, spec, label="synthetic-scenario")
+    # the scenario actually fired: 3 penalized, 5 exiting, 6 queued,
+    # 7 activated, 8 downgraded
+    assert post.balances[3] < pre_bal_3
+    assert post.validators[5].exit_epoch != slow_epoch.FAR_FUTURE_EPOCH
+    assert post.validators[6].activation_eligibility_epoch != slow_epoch.FAR_FUTURE_EPOCH
+    assert post.validators[7].activation_epoch != slow_epoch.FAR_FUTURE_EPOCH
+    assert post.validators[8].effective_balance < pre_eff_8
+
+
+# ------------------------------------------------------------ sabotage drills
+# An oracle that cannot catch an injected bug is decoration. Each drill
+# perturbs ONE production computation the way a plausible optimization bug
+# would, and asserts the comparison FAILS loudly.
+
+
+def _boundary_state(spec, harness):
+    h = StateHarness(
+        spec=spec, keypairs=harness.keypairs,
+        state=clone_state(harness.state, spec),
+    )
+    spe = spec.preset.SLOTS_PER_EPOCH
+    h.extend_chain(spe * 2 - 1, attest=True)
+    assert (h.state.slot + 1) % spe == 0
+    return h.state
+
+
+def test_drill_reward_accounting_bug_is_caught(spec, harness, monkeypatch):
+    state = _boundary_state(spec, harness)
+    real = prod_epoch.get_flag_index_deltas
+
+    def buggy(state_, spec_, flag_index, fork, eligible=None):
+        rewards, penalties = real(state_, spec_, flag_index, fork, eligible=eligible)
+        # single-pass accounting off-by-one on one validator's reward
+        if flag_index == 1 and any(rewards):
+            i = next(i for i, r in enumerate(rewards) if r)
+            rewards[i] += 1
+        return rewards, penalties
+
+    monkeypatch.setattr(prod_epoch, "get_flag_index_deltas", buggy)
+    with pytest.raises(AssertionError, match="oracle mismatch"):
+        _compare_epoch_transition(state, spec, label="drill-rewards")
+
+
+def test_drill_slashing_multiplier_bug_is_caught(spec, harness, monkeypatch):
+    state = _boundary_state(spec, harness)
+    spe = spec.preset.SLOTS_PER_EPOCH
+    cur = state.slot // spe
+    state.validators[3] = state.validators[3].copy_with(
+        slashed=True,
+        withdrawable_epoch=cur + spec.preset.EPOCHS_PER_SLASHINGS_VECTOR // 2,
+    )
+    # a pool large enough that the multiplier difference survives the
+    # penalty's integer divisions
+    state.slashings[cur % spec.preset.EPOCHS_PER_SLASHINGS_VECTOR] = (
+        10 * state.validators[3].effective_balance
+    )
+    real = prod_epoch.process_slashings
+
+    def buggy(state_, spec_, fork):
+        # wrong fork constant: altair multiplier on a bellatrix+ fork
+        return real(state_, spec_, ForkName.altair)
+
+    monkeypatch.setattr(prod_epoch, "process_slashings", buggy)
+    with pytest.raises(AssertionError, match="oracle mismatch"):
+        _compare_epoch_transition(state, spec, label="drill-slashings")
+
+
+# ------------------------------------------------------------------- electra
+
+
+@pytest.fixture(scope="module")
+def electra_spec():
+    return minimal_spec(electra_fork_epoch=0)
+
+
+@pytest.fixture(scope="module")
+def electra_harness(electra_spec):
+    bls.set_backend("fake")
+    return StateHarness.new(electra_spec, VALIDATORS)
+
+
+def _compare_electra(state, spec, label: str):
+    types = types_for_slot(spec, state.slot)
+    a = clone_state(state, spec)
+    b = clone_state(state, spec)
+    process_slot(a, spec)
+    process_slot(b, spec)
+    prod_epoch.process_epoch(a, spec, types, ForkName.electra)
+    slow_epoch.slow_process_epoch_electra(b, spec, types)
+    diffs = compare_fields(a, b, path=label)
+    assert not diffs, f"electra oracle mismatch at {label}: {diffs[:8]}"
+    return a
+
+
+def test_electra_oracle_agrees_across_epochs(electra_spec, electra_harness):
+    spec = electra_spec
+    h = StateHarness(
+        spec=spec, keypairs=electra_harness.keypairs,
+        state=clone_state(electra_harness.state, spec),
+    )
+    spe = spec.preset.SLOTS_PER_EPOCH
+    for _epoch in range(4):
+        h.extend_chain(spe - 1 - (h.state.slot % spe), attest=True)
+        _compare_electra(h.state, spec, label=f"electra-epoch{h.state.slot // spe}")
+        h.extend_chain(1, attest=True)
+
+
+def test_electra_oracle_pending_deposits_and_consolidations(
+    electra_spec, electra_harness
+):
+    """Synthetic electra boundary: a top-up deposit, a NEW validator deposit
+    (real signature), a garbage-signature deposit (skipped in both), an
+    exited-validator deposit (postponed), and a ripe consolidation."""
+    from lighthouse_tpu.crypto.bls import api as bls_api
+    from lighthouse_tpu.types import helpers as th
+    from tests.slow_epoch import DOMAIN_DEPOSIT, FAR_FUTURE_EPOCH
+
+    spec = electra_spec
+    h = StateHarness(
+        spec=spec, keypairs=electra_harness.keypairs,
+        state=clone_state(electra_harness.state, spec),
+    )
+    spe = spec.preset.SLOTS_PER_EPOCH
+    h.extend_chain(spe * 5 - 1, attest=True)
+    state = h.state
+    assert state.finalized_checkpoint.epoch >= 1
+    types = types_for_slot(spec, state.slot)
+
+    # deposit signatures must actually be CHECKED (fake accepts everything)
+    prev_backend = bls_api.get_backend()
+    bls_api.set_backend("python")
+    try:
+        def deposit(pubkey, wc, amount, signature):
+            return types.PendingDeposit.make(
+                pubkey=pubkey, withdrawal_credentials=wc, amount=amount,
+                signature=signature, slot=0,
+            )
+
+        # 1) top-up of an existing validator (no signature check)
+        state.pending_deposits.append(deposit(
+            state.validators[2].pubkey,
+            state.validators[2].withdrawal_credentials,
+            10**9, b"\x00" * 96,
+        ))
+        # 2) a brand-new validator with a REAL proof of possession
+        new_kp = bls.Keypair.from_secret(bls.SecretKey(0xDEC0DE))
+        wc = b"\x01" + b"\x00" * 11 + b"\xaa" * 20
+        msg = types.DepositMessage.make(
+            pubkey=new_kp.pk.serialize(), withdrawal_credentials=wc,
+            amount=32 * 10**9,
+        )
+        domain = th.compute_domain(
+            DOMAIN_DEPOSIT, spec.genesis_fork_version, b"\x00" * 32
+        )
+        root = th.compute_signing_root(types.DepositMessage, msg, domain)
+        sig = bls_api.sign(new_kp.sk, root)
+        state.pending_deposits.append(deposit(
+            new_kp.pk.serialize(), wc, 32 * 10**9, sig.serialize()
+        ))
+        # 3) garbage signature: skipped by BOTH implementations
+        other_kp = bls.Keypair.from_secret(bls.SecretKey(0xBAD5EED))
+        state.pending_deposits.append(deposit(
+            other_kp.pk.serialize(), wc, 32 * 10**9, b"\x11" * 96
+        ))
+        # 4) deposit to an EXITED validator: postponed
+        cur = state.slot // spe
+        state.validators[4] = state.validators[4].copy_with(
+            exit_epoch=cur, withdrawable_epoch=cur + 100
+        )
+        state.pending_deposits.append(deposit(
+            state.validators[4].pubkey,
+            state.validators[4].withdrawal_credentials,
+            10**9, b"\x00" * 96,
+        ))
+        # 5) ripe consolidation: source withdrawable now, target compounding
+        state.validators[5] = state.validators[5].copy_with(
+            exit_epoch=cur, withdrawable_epoch=cur
+        )
+        state.pending_consolidations.append(
+            types.PendingConsolidation.make(source_index=5, target_index=6)
+        )
+
+        n_before = len(state.validators)
+        bal2_before = state.balances[2]
+        post = _compare_electra(state, spec, label="electra-pendings")
+        # effects actually fired, in both implementations identically:
+        assert len(post.validators) == n_before + 1            # new validator
+        assert bytes(post.validators[-1].pubkey) == new_kp.pk.serialize()
+        # top-up applied (rewards also land in the same transition, so
+        # compare against the epoch's reward delta on a peer validator)
+        assert post.balances[2] - state.balances[2] >= 10**9
+        assert not any(
+            bytes(d.pubkey) == bytes(state.validators[2].pubkey)
+            for d in post.pending_deposits
+        )
+        assert len(post.pending_consolidations) == 0            # consumed
+        # the postponed deposit is still queued
+        assert any(
+            bytes(d.pubkey) == bytes(state.validators[4].pubkey)
+            for d in post.pending_deposits
+        )
+    finally:
+        bls_api._active_backend = prev_backend
