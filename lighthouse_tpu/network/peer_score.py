@@ -65,6 +65,9 @@ class PeerScoreThresholds:
     gossip_threshold: float = -40.0       # below: no IHAVE/IWANT exchange
     publish_threshold: float = -80.0      # below: don't flood-publish to it
     graylist_threshold: float = -160.0    # below: drop its RPCs entirely
+    # median mesh score below this triggers opportunistic grafting of
+    # better-scored peers (behaviour.rs opportunistic_graft_threshold)
+    opportunistic_graft_threshold: float = 2.0
 
 
 @dataclass

@@ -262,3 +262,185 @@ def test_deficit_peer_pruned_from_mesh():
     a.heartbeat()
     assert "lazy" not in a.mesh["t"]
     assert "chatty" in a.mesh["t"]
+
+
+# ------------------------------------------- v1.1 mesh-management repertoire
+
+
+def test_iwant_promise_broken_is_penalized():
+    """A peer that advertises IHAVE, gets our IWANT, and never delivers
+    eats behaviour penalties (gossip_promises.rs)."""
+    from lighthouse_tpu.network.gossipsub import Gossipsub, Rpc, encode_rpc
+
+    g = Gossipsub("me", lambda p, b: None)
+    g.subscribe("t", lambda m: True)
+    g.add_peer("adv")
+    ids = [bytes([i]) * 20 for i in range(4)]
+    g.on_rpc("adv", encode_rpc(Rpc(ihave=[("t", ids)])))
+    assert len(g._promises) == 4
+    # deadline passes with no delivery
+    for owers in g._promises.values():
+        for p in owers:
+            owers[p] = 0.0
+    g.heartbeat()
+    assert not g._promises
+    # 4 broken promises > behaviour_penalty_threshold -> negative score
+    assert g.scores["adv"] < 0
+
+
+def test_iwant_promise_fulfilled_no_penalty():
+    from lighthouse_tpu.network import snappy
+    from lighthouse_tpu.network.gossipsub import (
+        Gossipsub, Rpc, encode_rpc, message_id,
+    )
+
+    g = Gossipsub("me", lambda p, b: None)
+    g.subscribe("t", lambda m: True)
+    g.add_peer("adv")
+    data = snappy.compress(b"the goods")
+    mid = message_id("t", data)
+    g.on_rpc("adv", encode_rpc(Rpc(ihave=[("t", [mid])])))
+    assert mid in g._promises
+    g.on_rpc("adv", encode_rpc(Rpc(msgs=[("t", data)])))
+    assert mid not in g._promises      # delivery cleared the promise
+    g.heartbeat()
+    assert g.scores["adv"] >= 0
+
+
+def test_flood_publish_reaches_all_subscribers_beyond_mesh():
+    """Own messages go to every positive-score subscriber, not just the
+    mesh (v1.1 flood_publish — eclipse resistance for origination)."""
+    from lighthouse_tpu.network.gossipsub import D_HIGH, Gossipsub, Rpc, encode_rpc
+
+    sent = []
+    g = Gossipsub("me", lambda p, b: sent.append(p))
+    g.subscribe("t", lambda m: True)
+    n_peers = D_HIGH + 4
+    for i in range(n_peers):
+        p = f"p{i}"
+        g.add_peer(p)
+        g.on_rpc(p, encode_rpc(Rpc(subs=[(True, "t")])))
+    g.heartbeat()
+    assert len(g.mesh["t"]) < n_peers
+    sent.clear()
+    assert g.publish("t", b"mine") == n_peers
+    assert len(set(sent)) == n_peers
+    # with flood publish off, only the mesh is targeted
+    g.flood_publish = False
+    sent.clear()
+    assert g.publish("t", b"mine again") == len(g.mesh["t"])
+
+
+def test_opportunistic_grafting_rescues_mediocre_mesh():
+    """When the mesh's median score decays below the threshold, strictly
+    better-scored outsiders are grafted in (behaviour.rs)."""
+    from lighthouse_tpu.network import gossipsub as gs_mod
+    from lighthouse_tpu.network.gossipsub import Gossipsub, Rpc, encode_rpc
+
+    g = Gossipsub("me", lambda p, b: None)
+    g.subscribe("t", lambda m: True)
+    for i in range(6):
+        p = f"meh{i}"
+        g.add_peer(p)
+        g.on_rpc(p, encode_rpc(Rpc(subs=[(True, "t")])))
+    g.add_peer("star")
+    g.on_rpc("star", encode_rpc(Rpc(subs=[(True, "t")])))
+    g.heartbeat()
+    # force the mesh to the mediocre peers only
+    g.mesh["t"] = {f"meh{i}" for i in range(6)}
+    scores = {"star": 5.0}
+    g.peer_score.score = lambda p: scores.get(p, 0.0)   # median 0 < 2.0
+    g._heartbeats = gs_mod.OPPORTUNISTIC_GRAFT_TICKS - 1
+    g.heartbeat()
+    assert "star" in g.mesh["t"]
+
+
+def test_px_candidates_bounded_against_eclipse():
+    """A malicious PRUNE carrying a horde of PX records surfaces at most
+    PX_PEERS candidates (eclipse-by-PX bound)."""
+    from lighthouse_tpu.network import gossipsub as gs_mod
+    from lighthouse_tpu.network.gossipsub import Gossipsub, Rpc, encode_rpc
+
+    got = []
+    g = Gossipsub("me", lambda p, b: None, px_handler=lambda t, px: got.extend(px))
+    g.subscribe("t", lambda m: True)
+    g.add_peer("pruner")
+    horde = [(f"evil{i}", "10.0.0.%d" % (i % 250), 9000 + i) for i in range(100)]
+    g.on_rpc("pruner", encode_rpc(Rpc(prune=[("t", horde)])))
+    assert len(got) <= gs_mod.PX_PEERS
+
+
+def test_gossip_factor_scales_ihave_fanout():
+    """IHAVE emission covers GOSSIP_FACTOR of eligible peers when that
+    beats the D_LAZY floor."""
+    from lighthouse_tpu.network import snappy
+    from lighthouse_tpu.network.gossipsub import (
+        D_LAZY, GOSSIP_FACTOR, Gossipsub, Rpc, encode_rpc,
+    )
+
+    ihave_targets = []
+
+    def send(p, b):
+        from lighthouse_tpu.network.gossipsub import decode_rpc
+
+        if decode_rpc(b).ihave:
+            ihave_targets.append(p)
+
+    g = Gossipsub("me", send)
+    g.subscribe("t", lambda m: True)
+    n_peers = 60
+    for i in range(n_peers):
+        p = f"p{i}"
+        g.add_peer(p)
+        g.on_rpc(p, encode_rpc(Rpc(subs=[(True, "t")])))
+    g.heartbeat()                       # mesh forms
+    g.on_rpc("p0", encode_rpc(Rpc(msgs=[("t", snappy.compress(b"x"))])))
+    ihave_targets.clear()
+    g.heartbeat()                       # gossip emission round
+    eligible = n_peers - len(g.mesh["t"])
+    expected = max(D_LAZY, int(GOSSIP_FACTOR * eligible))
+    assert len(ihave_targets) == expected
+
+
+def test_pending_validation_deferred_resolution():
+    """PENDING handler outcome: no propagation until
+    report_validation_result; True forwards to the mesh and credits the
+    sender, False penalizes every sender of the message."""
+    from lighthouse_tpu.network import snappy
+    from lighthouse_tpu.network.gossipsub import (
+        PENDING, Gossipsub, Rpc, encode_rpc, message_id,
+    )
+
+    forwarded = []
+
+    def send(p, b):
+        from lighthouse_tpu.network.gossipsub import decode_rpc
+
+        if decode_rpc(b).msgs:
+            forwarded.append(p)
+
+    g = Gossipsub("me", send)
+    g.subscribe("t", lambda m: PENDING)
+    for p in ("src", "relay", "other"):
+        g.add_peer(p)
+        g.on_rpc(p, encode_rpc(Rpc(subs=[(True, "t")])))
+    g.heartbeat()
+    data = snappy.compress(b"deferred")
+    mid = message_id("t", data)
+    g.on_rpc("src", encode_rpc(Rpc(msgs=[("t", data)])))
+    assert mid in g._pending_validation
+    assert not forwarded                     # nothing propagated yet
+    g.report_validation_result(mid, True)
+    assert mid not in g._pending_validation
+    assert set(forwarded) == g.mesh["t"] - {"src"}
+    assert g.delivered == 1
+    # second message, rejected asynchronously: sender penalized
+    data2 = snappy.compress(b"bad deferred")
+    mid2 = message_id("t", data2)
+    g.on_rpc("src", encode_rpc(Rpc(msgs=[("t", data2)])))
+    g.report_validation_result(mid2, False)
+    assert g.rejected == 1
+    assert g.scores["src"] < 0
+    # duplicate of the rejected message penalizes the replayer too
+    g.on_rpc("relay", encode_rpc(Rpc(msgs=[("t", data2)])))
+    assert g.scores["relay"] < 0
