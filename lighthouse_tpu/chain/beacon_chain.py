@@ -1089,6 +1089,11 @@ class BeaconChain:
         spec = self.spec
         get_pubkey = self.pubkey_cache.pubkey_getter()
         prepared = []
+        # batch-LOCAL dedup: observed_attesters is only updated at
+        # completion, so without this a validator equivocating twice within
+        # one coalescing window would get both attestations verified and
+        # forwarded (the sequential path dropped the second)
+        seen_in_batch: set = set()
         for att in attestations:
             data = att.data
             epoch = data.target.epoch
@@ -1109,6 +1114,9 @@ class BeaconChain:
                 continue  # unaggregated = exactly one bit
             if (epoch, attesting[0]) in self.observed_attesters:
                 continue
+            if (epoch, attesting[0]) in seen_in_batch:
+                continue
+            seen_in_batch.add((epoch, attesting[0]))
             state = self._attestation_state(data)
             types = types_for_slot(spec, data.slot)
             indexed = types.IndexedAttestation.make(
@@ -1163,12 +1171,20 @@ class BeaconChain:
         ok = bls.verify_signature_sets([s for _, _, s in prepared])
         return self.complete_attestation_batch(prepared, ok)
 
-    def submit_attestation_batch(self, attestations, on_done=None):
+    def submit_attestation_batch(self, attestations, on_done=None,
+                                 on_prepared=None):
         """Pipelined form: prepare on host, submit async to the device, and
         return (handle, continuation). The continuation — run when the
         processor resolves the handle — completes verification and applies
-        fork-choice votes. Returns None if nothing verifiable."""
+        fork-choice votes. Returns None if nothing verifiable.
+
+        on_prepared([att, ...]) fires after the host phase with the
+        attestations that made it into the device batch — callers tracking
+        per-message outcomes (the gossip deferred-validation path) learn
+        which inputs were dropped at prepare (duplicates/unverifiable)."""
         prepared = self.prepare_unaggregated_attestations(attestations)
+        if on_prepared is not None:
+            on_prepared([att for att, _indices, _s in prepared])
         if not prepared:
             if on_done is not None:
                 on_done([])

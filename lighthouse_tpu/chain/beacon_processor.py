@@ -196,9 +196,23 @@ class BeaconProcessor:
             if not self._inflight:
                 return False
             handle, cont = self._inflight.popleft()
-        res = handle.result()          # device wait: outside the exec lock
-        with self._exec_lock:
-            cont(res)                  # chain mutation: serialized
+        # a device failure mid-batch (tunnel drop) must never kill the pump
+        # worker: the batch is lost (its deferred gossip validations expire
+        # as ignores) but the node keeps verifying
+        try:
+            res = handle.result()      # device wait: outside the exec lock
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+            return True
+        try:
+            with self._exec_lock:
+                cont(res)              # chain mutation: serialized
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
         return True
 
     def drain_inflight(self) -> int:

@@ -38,11 +38,25 @@ class NetworkNode:
         op_pool=None,
         encrypt: bool = True,
         require_encryption: bool = False,
+        batch_gossip: bool = True,
+        processor_config=None,
     ):
         self.chain = chain
         chain._network_node = self          # identity/peers API surface
         self.node_id = node_id
         self.fork_digest = fork_digest
+        # Gossip attestations/aggregates route through the beacon
+        # processor's priority queues so they coalesce into device-sized
+        # batches (the reference's Work::GossipAttestationBatch feeder,
+        # beacon_processor/src/lib.rs:970-1087 — THE upstream of the TPU
+        # backend). batch_gossip=False falls back to inline per-message
+        # verification (deterministic single-threaded tests).
+        from ..chain.beacon_processor import BeaconProcessor
+
+        self.batch_gossip = batch_gossip
+        self.processor = BeaconProcessor(processor_config)
+        if batch_gossip:
+            self.processor.start()
         self.op_pool = op_pool
         self.peer_manager = PeerManager()
         self.rpc = RpcHandler(chain, fork_digest)
@@ -247,6 +261,8 @@ class NetworkNode:
 
     def close(self) -> None:
         self._hb_stop.set()
+        if self.batch_gossip:
+            self.processor.stop()
         self.host.close()
 
     # ------------------------------------------------------------ handlers
@@ -380,6 +396,17 @@ class NetworkNode:
                 att = types.Attestation.deserialize(msg.decompressed)
             except Exception:
                 return False
+            if self.batch_gossip:
+                from ..chain.beacon_processor import WorkItem, WorkKind
+                from .gossipsub import PENDING
+
+                accepted = self.processor.submit(WorkItem(
+                    kind=WorkKind.gossip_attestation,
+                    payload=(att, msg.message_id),
+                    run_batch=self._run_attestation_batch,
+                ))
+                # queue full -> dropped under load: ignore, don't penalize
+                return PENDING if accepted else None
             with self._lock:
                 try:
                     results = self.chain.verify_unaggregated_attestations([att])
@@ -396,13 +423,71 @@ class NetworkNode:
 
         return handler
 
-    def _on_aggregate(self, msg) -> bool:
+    def _run_attestation_batch(self, payloads):
+        """Coalesced batch runner (pump thread): delegates the whole
+        prepare -> ONE async device submission -> complete/fork-choice
+        pipeline to chain.submit_attestation_batch, adding only the gossip
+        deferred-validation bookkeeping (gossip_methods.rs
+        process_gossip_attestation_batch analog)."""
+        types = types_for_slot(self.chain.spec, self.chain.current_slot)
+        atts = [a for a, _mid in payloads]
+        prepared_ids: set = set()
+
+        def on_prepared(prepared_atts):
+            prepared_ids.update(id(a) for a in prepared_atts)
+            # dropped at prepare = duplicate/unverifiable: terminal ignore
+            for a, mid in payloads:
+                if id(a) not in prepared_ids:
+                    self.gossipsub.report_validation_result(mid, None)
+
+        def on_done(results):
+            valid_ids = {id(a) for a, _indices in results}
+            for a, indices in results:
+                if self.op_pool is not None:
+                    self.op_pool.insert_attestation(a, indices, types)
+            for a, mid in payloads:
+                if id(a) in prepared_ids:
+                    self.gossipsub.report_validation_result(
+                        mid, id(a) in valid_ids
+                    )
+
+        with self._lock:
+            try:
+                pair = self.chain.submit_attestation_batch(
+                    atts, on_done=on_done, on_prepared=on_prepared
+                )
+            except (AttestationError, BlockError):
+                for _a, mid in payloads:
+                    self.gossipsub.report_validation_result(mid, None)
+                return None
+        if pair is None:
+            return None
+        handle, cont = pair
+
+        def wrapped(ok: bool):
+            # chain mutation under the same lock the inline handlers use
+            with self._lock:
+                return cont(ok)
+
+        return handle, wrapped
+
+    def _on_aggregate(self, msg):
         spec = self.chain.spec
         types = types_for_slot(spec, self.chain.current_slot)
         try:
             signed = types.SignedAggregateAndProof.deserialize(msg.decompressed)
         except Exception:
             return False
+        if self.batch_gossip:
+            from ..chain.beacon_processor import WorkItem, WorkKind
+            from .gossipsub import PENDING
+
+            accepted = self.processor.submit(WorkItem(
+                kind=WorkKind.gossip_aggregate,
+                payload=(signed, msg.message_id),
+                run_batch=self._run_aggregate_batch,
+            ))
+            return PENDING if accepted else None
         with self._lock:
             try:
                 results = self.chain.verify_aggregated_attestations([signed])
@@ -416,6 +501,33 @@ class NetworkNode:
             # IGNORE, never a penalty (same mesh-decay hazard as the
             # unaggregated handler)
             return True if results else None
+
+    def _run_aggregate_batch(self, payloads):
+        """Coalesced aggregate runner: one multi-set device verification for
+        the whole batch (3 sets per aggregate), then per-message gossip
+        resolution (process_gossip_aggregate_batch analog)."""
+        types = types_for_slot(self.chain.spec, self.chain.current_slot)
+        signeds = [s for s, _mid in payloads]
+        with self._lock:
+            try:
+                results = self.chain.verify_aggregated_attestations(signeds)
+            except (AttestationError, BlockError):
+                results = []
+            valid_atts = set()
+            for att, indices in results:
+                valid_atts.add(id(att))
+                self.chain.apply_attestation_to_fork_choice(att, indices)
+                if self.op_pool is not None:
+                    self.op_pool.insert_attestation(att, indices, types)
+        # verify_aggregated_attestations returns the verified (aggregate,
+        # indices); map back to the submitted containers by identity of the
+        # embedded aggregate
+        for signed, mid in payloads:
+            self.gossipsub.report_validation_result(
+                mid,
+                True if id(signed.message.aggregate) in valid_atts else None,
+            )
+        return None
 
     def _on_blob(self, msg):
         spec = self.chain.spec

@@ -142,6 +142,10 @@ class SyncManager:
     def add_peer(self, peer_id: str, rpc_peer) -> None:
         """Handshake: exchange Status and record the peer's view."""
         chunks = rpc_peer.handle(peer_id, Protocol.status, encode_chunk(b""))
+        if not chunks:
+            # peer hung up mid-handshake (or rate-limited us to nothing):
+            # not a peer we can sync from
+            return
         code, payload = decode_response_chunk(chunks[0])
         if code != RESP_SUCCESS:
             return
