@@ -64,9 +64,15 @@ N0P = (-pow(P, -1, 1 << LB)) % (1 << LB)   # -P^-1 mod 2^16
 
 N_HOST = pack(P)
 N_EXT_HOST = np.concatenate([N_HOST, np.zeros(1, np.uint32)])
-R2 = jnp.asarray(pack(R2_INT))
-ZERO = jnp.zeros((NL,), U32)
-ONE_MONT = jnp.asarray(pack(R_MONT))
+# HOST (numpy) constants on purpose: a module-level jnp array would
+# initialize the default JAX backend at IMPORT time — and the chain's
+# pubkey cache imports this module, so a beacon node booting while the
+# remote-TPU tunnel is wedged would hang before serving anything (observed:
+# axon backend init blocking 20+ min). jnp ops convert numpy operands at
+# trace time, so consumers are unaffected.
+R2 = pack(R2_INT)
+ZERO = np.zeros((NL,), np.uint32)
+ONE_MONT = pack(R_MONT)
 
 
 # --------------------------------------------------------------------------

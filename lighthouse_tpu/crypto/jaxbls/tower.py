@@ -31,8 +31,8 @@ def _mont_const(x: int) -> np.ndarray:
     return lb.pack(x * lb.R_MONT % P)
 
 
-FQ_ZERO = jnp.zeros((NL,), jnp.uint32)
-FQ_ONE = jnp.asarray(_mont_const(1))
+FQ_ZERO = np.zeros((NL,), np.uint32)
+FQ_ONE = np.asarray(_mont_const(1))
 
 _FQ2_ONE_NP = np.stack([_mont_const(1), np.zeros(NL, np.uint32)])
 _FQ6_ONE_NP = np.stack(
@@ -40,11 +40,13 @@ _FQ6_ONE_NP = np.stack(
 )
 _FQ12_ONE_NP = np.stack([_FQ6_ONE_NP, np.zeros((3, 2, NL), np.uint32)])
 
-FQ2_ZERO = jnp.zeros((2, NL), jnp.uint32)
-FQ2_ONE = jnp.asarray(_FQ2_ONE_NP)
-FQ6_ZERO = jnp.zeros((3, 2, NL), jnp.uint32)
-FQ6_ONE = jnp.asarray(_FQ6_ONE_NP)
-FQ12_ONE = jnp.asarray(_FQ12_ONE_NP)
+FQ2_ZERO = np.zeros((2, NL), np.uint32)
+FQ2_ONE = np.asarray(_FQ2_ONE_NP)
+FQ6_ZERO = np.zeros((3, 2, NL), np.uint32)
+FQ6_ONE = np.asarray(_FQ6_ONE_NP)
+# numpy, not jnp: module-level device arrays initialize the backend at
+# import (see limbs.py constants note)
+FQ12_ONE = np.asarray(_FQ12_ONE_NP)
 
 
 def fq2_one():
