@@ -90,6 +90,18 @@ class BeaconNodeHttpClient:
     def header(self, block_id: str = "head") -> dict:
         return self._get(f"/eth/v1/beacon/headers/{block_id}")["data"]
 
+    def debug_state_ssz(self, state_id: str = "finalized") -> bytes:
+        """Full SSZ BeaconState (checkpoint-sync download)."""
+        return bytes.fromhex(
+            self._get(f"/eth/v2/debug/beacon/states/{state_id}")["data"][2:]
+        )
+
+    def block_ssz(self, block_id: str = "finalized") -> bytes:
+        """Full SSZ SignedBeaconBlock (checkpoint-sync companion)."""
+        return bytes.fromhex(
+            self._get(f"/lighthouse_tpu/blocks/{block_id}/ssz")["data"][2:]
+        )
+
     # ------------------------------------------------------------ duties
 
     def attester_duties(self, epoch: int, indices: list[int]) -> list[AttesterDuty]:

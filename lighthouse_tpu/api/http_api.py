@@ -671,6 +671,27 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
             }
         )
 
+    def get_block_ssz(self, block_id):
+        """Full SSZ of a signed block (hex-wrapped) — the checkpoint-sync
+        companion to get_debug_state: `bn --checkpoint-sync-url` fetches
+        the finalized state + block pair from here (the reference fetches
+        the same pair from a remote BN, client/src/builder.rs:366-390)."""
+        root = self._block_root_by_id(block_id)
+        chain = self.chain
+        slot = chain.block_slots.get(root)
+        if slot is None:
+            raise ApiError(404, "block not found")
+        types = types_for_slot(chain.spec, slot)
+        blk = chain.store.get_block(root, types)
+        if blk is None:
+            raise ApiError(404, "block not found")
+        self._json(
+            {
+                "version": chain.spec.fork_name_at_slot(slot).value,
+                "data": _hex(types.SignedBeaconBlock.serialize(blk)),
+            }
+        )
+
     def get_lh_database_info(self):
         """/lighthouse_tpu/database/info (ops endpoint family analog)."""
         chain = self.chain
@@ -1354,6 +1375,7 @@ _ROUTES = [
     (r"/eth/v1/validator/beacon_committee_subscriptions", "POST", BeaconApiHandler.post_subscriptions),
     (r"/eth/v1/validator/sync_committee_subscriptions", "POST", BeaconApiHandler.post_subscriptions),
     (r"/eth/v2/debug/beacon/states/([^/]+)", "GET", BeaconApiHandler.get_debug_state),
+    (r"/lighthouse_tpu/blocks/([^/]+)/ssz", "GET", BeaconApiHandler.get_block_ssz),
     (r"/eth/v1/beacon/pool/bls_to_execution_changes", "GET", BeaconApiHandler.get_pool_bls_changes),
     (r"/eth/v1/beacon/pool/bls_to_execution_changes", "POST", BeaconApiHandler.post_pool_bls_changes),
     (r"/eth/v1/beacon/pool/attester_slashings", "GET", BeaconApiHandler.get_pool_attester_slashings),

@@ -56,6 +56,20 @@ def cmd_bn(args):
     from .utils.slot_clock import SystemTimeSlotClock
 
     spec = _load_spec(args)
+    import os as _os_env
+
+    # hybrid-backend routing knobs ride env vars so the policy object can
+    # be constructed lazily inside the registry (crypto/bls/hybrid.py)
+    if getattr(args, "urgent_max_sets", None) is not None:
+        _os_env.environ["LIGHTHOUSE_TPU_URGENT_MAX_SETS"] = str(args.urgent_max_sets)
+    if getattr(args, "device_p99_budget_ms", None) is not None:
+        _os_env.environ["LIGHTHOUSE_TPU_DEVICE_P99_BUDGET_MS"] = str(
+            args.device_p99_budget_ms
+        )
+    if getattr(args, "device_probe_wait", None) is not None:
+        _os_env.environ["LIGHTHOUSE_TPU_DEVICE_PROBE_WAIT_SECS"] = str(
+            args.device_probe_wait
+        )
     bls.set_backend(args.bls_backend)
 
     anchor_block = None
@@ -87,10 +101,44 @@ def cmd_bn(args):
         anchor_block = types.SignedBeaconBlock.deserialize(
             open(args.checkpoint_block, "rb").read()
         )
+    elif getattr(args, "checkpoint_sync_url", None):
+        # weak-subjectivity start over HTTP: download the finalized
+        # state+block pair from a trusted BN (client/src/builder.rs:366-390;
+        # server side is get_debug_state + get_block_ssz)
+        from .api.client import BeaconNodeHttpClient
+        from .state_transition.slot import types_for_slot as _tfs
+
+        remote = BeaconNodeHttpClient(args.checkpoint_sync_url, timeout=60.0)
+        log.info("checkpoint sync: downloading finalized state",
+                 url=args.checkpoint_sync_url)
+        # the state and block are fetched in two requests; finalization can
+        # advance between them, so the pair must be VERIFIED consistent
+        # (block commits to the state) and refetched on a boundary race
+        for attempt in range(3):
+            raw = remote.debug_state_ssz("finalized")
+            slot = int.from_bytes(raw[40:48], "little")
+            types = _tfs(spec, slot)
+            state = types.BeaconState.deserialize(raw)
+            anchor_block = types.SignedBeaconBlock.deserialize(
+                remote.block_ssz("finalized")
+            )
+            if bytes(anchor_block.message.state_root) == (
+                types.BeaconState.hash_tree_root(state)
+            ):
+                break
+            log.warn("checkpoint sync: state/block pair inconsistent "
+                     "(finalization advanced mid-download); refetching",
+                     attempt=attempt)
+        else:
+            print("error: checkpoint-sync pair never converged",
+                  file=sys.stderr)
+            return 1
+        log.info("checkpoint sync: anchor downloaded", slot=slot)
     else:
         print(
-            "error: provide --interop-validators N, --genesis-state FILE, or "
-            "--checkpoint-state FILE --checkpoint-block FILE",
+            "error: provide --interop-validators N, --genesis-state FILE, "
+            "--checkpoint-state FILE --checkpoint-block FILE, or "
+            "--checkpoint-sync-url URL",
             file=sys.stderr,
         )
         return 1
@@ -107,10 +155,16 @@ def cmd_bn(args):
         # datadir is how operators get slashed
         lock = Lockfile(f"{args.datadir}/beacon.lock")
         lock.acquire()
+        from .store.hot_cold import StoreConfig
+
         store = HotColdDB(
             spec,
             hot=NativeKVStore(f"{args.datadir}/hot.db"),
             cold=NativeKVStore(f"{args.datadir}/cold.db"),
+            config=StoreConfig(
+                slots_per_restore_point=args.slots_per_restore_point,
+                compact_on_migration=not args.no_compact_on_migration,
+            ),
         )
     execution_layer = None
     if args.engine:
@@ -125,7 +179,9 @@ def cmd_bn(args):
                 return 1
             with open(args.jwt_secret) as f:
                 secret = bytes.fromhex(f.read().strip().removeprefix("0x"))
-            engine = EngineApiClient(args.engine, secret)
+            engine = EngineApiClient(
+                args.engine, secret, timeout=args.execution_timeout
+            )
         fee = (
             bytes.fromhex(args.fee_recipient[2:])
             if args.fee_recipient
@@ -134,13 +190,24 @@ def cmd_bn(args):
         execution_layer = ExecutionLayer(engine, spec, default_fee_recipient=fee)
         log.info("execution engine connected", url=args.engine)
 
+    from .chain.beacon_chain import ChainConfig
+
     clock = SystemTimeSlotClock(state.genesis_time, spec.seconds_per_slot)
     chain = BeaconChain(
         spec, state, store=store, slot_clock=clock,
         execution_layer=execution_layer, anchor_block=anchor_block,
+        config=ChainConfig(
+            reorg_threshold_percent=args.reorg_threshold,
+            import_max_skip_slots=args.max_skip_slots,
+        ),
     )
-    if args.graffiti:
-        g = args.graffiti.encode()
+    chain.shuffling_cache.capacity = args.shuffling_cache_size
+    graffiti_text = args.graffiti
+    if graffiti_text is None and getattr(args, "graffiti_file", None):
+        with open(args.graffiti_file) as f:
+            graffiti_text = f.readline().rstrip("\n")
+    if graffiti_text:
+        g = graffiti_text.encode()
         if len(g) > 32:
             print("error: --graffiti exceeds 32 bytes utf-8", file=sys.stderr)
             return 1
@@ -203,15 +270,30 @@ def cmd_bn(args):
         )
         import os as _os
 
+        from .chain.beacon_processor import BeaconProcessorConfig
+
+        proc_cfg = BeaconProcessorConfig()
+        if args.max_attestation_batch is not None:
+            proc_cfg.max_attestation_batch = args.max_attestation_batch
+        if args.max_aggregate_batch is not None:
+            proc_cfg.max_aggregate_batch = args.max_aggregate_batch
+        if args.max_inflight_batches is not None:
+            proc_cfg.max_inflight = args.max_inflight_batches
+        if args.processor_workers is not None:
+            proc_cfg.num_workers = args.processor_workers
         net = NetworkNode(
             chain,
             # unique even when --p2p-port 0 picks a random bound port
             node_id=f"bn-{chain.genesis_block_root.hex()[:8]}-{_os.urandom(3).hex()}",
             fork_digest=digest,
             port=args.p2p_port,
+            heartbeat_interval=args.gossip_heartbeat_interval,
+            subnets=args.subnets,
             op_pool=op_pool,
             encrypt=not args.disable_p2p_encryption,
             require_encryption=args.require_p2p_encryption,
+            batch_gossip=not args.disable_gossip_batching,
+            processor_config=proc_cfg,
         )
         log.info("p2p listening", addr=str(net.host.listen_addr),
                  fork_digest=digest.hex())
@@ -239,10 +321,14 @@ def cmd_bn(args):
 
         dial_static()
 
-    server, _t, port = serve(chain, op_pool=op_pool, port=args.http_port)
-    log.info("HTTP API started", port=port)
-    mserver, mport = metrics_http_server(port=args.metrics_port)
-    log.info("metrics server started", port=mport)
+    server, _t, port = serve(
+        chain, op_pool=op_pool, host=args.http_address, port=args.http_port
+    )
+    log.info("HTTP API started", addr=args.http_address, port=port)
+    mserver, mport = metrics_http_server(
+        host=args.metrics_address, port=args.metrics_port
+    )
+    log.info("metrics server started", addr=args.metrics_address, port=mport)
 
     executor = TaskExecutor(name="bn", log=lambda m: log.info(m))
 
@@ -818,7 +904,14 @@ def build_parser() -> argparse.ArgumentParser:
     bn.add_argument("--datadir", default=None)
     bn.add_argument("--interop-validators", type=int, default=None)
     bn.add_argument("--genesis-time", type=int, default=None)
-    bn.add_argument("--bls-backend", default="python", choices=["python", "jax", "fake"])
+    bn.add_argument(
+        "--bls-backend", default="python",
+        choices=["python", "jax", "fake", "hybrid"],
+        help="BLS verification backend; 'hybrid' routes urgent/small "
+             "verifies to the host while the device is cold, absent, or "
+             "over its latency budget (the recommended production setting "
+             "for a TPU-attached node)",
+    )
     bn.add_argument("--slasher", action="store_true", help="enable the slasher")
     bn.add_argument(
         "--engine", default=None,
@@ -864,6 +957,67 @@ def build_parser() -> argparse.ArgumentParser:
                     help="SSZ finalized BeaconState for checkpoint start")
     bn.add_argument("--checkpoint-block", default=None,
                     help="SSZ SignedBeaconBlock matching --checkpoint-state")
+    bn.add_argument("--checkpoint-sync-url", default=None,
+                    help="beacon-node URL to download the finalized "
+                         "state+block pair from (weak-subjectivity start "
+                         "over HTTP instead of local files)")
+    # -- addresses / servers
+    bn.add_argument("--http-address", default="127.0.0.1",
+                    help="bind address for the Beacon API server")
+    bn.add_argument("--metrics-address", default="127.0.0.1",
+                    help="bind address for the Prometheus /metrics server")
+    # -- store
+    bn.add_argument("--slots-per-restore-point", type=int, default=2048,
+                    help="freezer restore-point cadence (storage/replay "
+                         "trade-off)")
+    bn.add_argument("--no-compact-on-migration", action="store_true",
+                    help="skip store compaction during finalization "
+                         "migration")
+    # -- chain
+    bn.add_argument("--reorg-threshold", type=int, default=20,
+                    help="proposer re-org weight threshold (percent of "
+                         "committee weight)")
+    bn.add_argument("--max-skip-slots", type=int, default=None,
+                    help="reject blocks skipping more than this many slots "
+                         "from their parent (DoS guard; default unlimited)")
+    bn.add_argument("--shuffling-cache-size", type=int, default=16,
+                    help="committee shuffling cache entries")
+    # -- execution
+    bn.add_argument("--execution-timeout", type=float, default=8.0,
+                    help="engine-API HTTP timeout seconds")
+    # -- gossip / processor
+    bn.add_argument("--gossip-heartbeat-interval", type=float, default=0.3,
+                    help="gossipsub mesh-maintenance heartbeat seconds")
+    bn.add_argument("--subnets", type=int, default=None,
+                    help="attestation subnet count to subscribe (default: "
+                         "spec value)")
+    bn.add_argument("--disable-gossip-batching", action="store_true",
+                    help="verify gossip attestations inline instead of "
+                         "coalescing device-sized batches in the beacon "
+                         "processor")
+    bn.add_argument("--max-attestation-batch", type=int, default=None,
+                    help="max gossip attestations coalesced per device "
+                         "batch")
+    bn.add_argument("--max-aggregate-batch", type=int, default=None,
+                    help="max gossip aggregates coalesced per device batch")
+    bn.add_argument("--max-inflight-batches", type=int, default=None,
+                    help="device verification batches in flight before the "
+                         "processor blocks on the oldest")
+    bn.add_argument("--processor-workers", type=int, default=None,
+                    help="beacon-processor worker threads")
+    # -- hybrid BLS routing (crypto/bls/hybrid.py)
+    bn.add_argument("--urgent-max-sets", type=int, default=None,
+                    help="batches at or under this size may take the host "
+                         "urgent path (hybrid backend)")
+    bn.add_argument("--device-p99-budget-ms", type=float, default=None,
+                    help="device verify p99 budget before small batches "
+                         "reroute to the host (hybrid backend)")
+    bn.add_argument("--device-probe-wait", type=float, default=None,
+                    help="seconds to wait for the device probe at startup "
+                         "before serving from the host (hybrid backend)")
+    bn.add_argument("--graffiti-file", default=None,
+                    help="file whose first line is the block graffiti "
+                         "(alternative to --graffiti)")
     bn.set_defaults(fn=cmd_bn)
 
     vc = sub.add_parser("vc", help="run a validator client")

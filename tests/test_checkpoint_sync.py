@@ -117,3 +117,32 @@ def test_persist_and_resume(chain_a):
         ch.per_slot_task()
         ch.process_block(signed)
     assert c.head_root == a.head_root
+
+
+def test_checkpoint_sync_over_http(chain_a):
+    """`bn --checkpoint-sync-url` path: the finalized state+block pair
+    downloads over the Beacon API (get_debug_state + the /lighthouse_tpu
+    SSZ block route) and reconstructs a chain anchored at the checkpoint
+    (client/src/builder.rs:366-390 analog, over HTTP instead of files)."""
+    from lighthouse_tpu.api.client import BeaconNodeHttpClient
+    from lighthouse_tpu.api.http_api import serve
+
+    _harness, chain = chain_a
+    server, _t, port = serve(chain)
+    try:
+        remote = BeaconNodeHttpClient(f"http://127.0.0.1:{port}", timeout=10.0)
+        raw_state = remote.debug_state_ssz("finalized")
+        raw_block = remote.block_ssz("finalized")
+        slot = int.from_bytes(raw_state[40:48], "little")
+        types = types_for_slot(chain.spec, slot)
+        state = types.BeaconState.deserialize(raw_state)
+        anchor = types.SignedBeaconBlock.deserialize(raw_block)
+        assert state.slot == slot
+        # the pair is consistent: block commits to the state
+        assert bytes(anchor.message.state_root) == types.BeaconState.hash_tree_root(state)
+        # and it boots a node
+        node = BeaconChain(chain.spec, state, anchor_block=anchor)
+        fin_epoch, fin_root = chain.fork_choice.store.finalized_checkpoint
+        assert node.genesis_block_root == fin_root
+    finally:
+        server.shutdown()

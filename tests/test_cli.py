@@ -113,3 +113,26 @@ def test_indexed_attestations_and_check_deposit_data(tmp_path):
     assert r.returncode == 0 and "valid" in r.stdout, (r.returncode, r.stdout, r.stderr)
     r = run(["check-deposit-data", "--spec", "minimal", "--deposit", str(bp)], tmp_path)
     assert r.returncode == 1 and "INVALID" in r.stdout
+
+
+def test_bn_vc_help_snapshots(monkeypatch):
+    """Snapshot-tested operator help (the reference snapshot-tests its CLI
+    help into the book, Makefile:209-213): flag surface changes must be
+    deliberate — regenerate docs/help_*.txt (COLUMNS=100) when they are."""
+    import pathlib
+
+    from lighthouse_tpu.cli import build_parser
+
+    # argparse wraps help to the terminal width; pin it so the snapshot is
+    # environment-independent (must match the generator's width)
+    monkeypatch.setenv("COLUMNS", "100")
+    p = build_parser()
+    (sub,) = [a for a in p._subparsers._group_actions]
+    docs = pathlib.Path(__file__).parent.parent / "docs"
+    for name in ("bn", "vc"):
+        want = (docs / f"help_{name}.txt").read_text()
+        got = sub.choices[name].format_help()
+        assert got == want, (
+            f"`lighthouse-tpu {name}` help drifted from docs/help_{name}.txt"
+            " — if intentional, regenerate the snapshot"
+        )
