@@ -18,6 +18,7 @@ byte strings.
 from __future__ import annotations
 
 import json
+import os
 import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -86,6 +87,34 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
     chain = None           # injected by serve()
     op_pool = None
     event_bus = None
+    # Backpressure for the HEAVY publish paths (block/attestation/sync-
+    # committee import runs verification inline in the handler thread):
+    # bounded gates — work beyond the limit gets 503 immediately, like the
+    # reference sheds API work when the beacon-processor queues are full
+    # (Work::ApiRequestP0/P1 bounded queues). Two deliberate properties:
+    #   * permits are acquired AFTER the request body is read/parsed, so a
+    #     slow client holds only its own handler thread, never a permit
+    #     (and the 503 is written with the body already drained — no RST
+    #     racing the response on big block bodies);
+    #   * block publishes (the proposal path — P0 in the reference) have
+    #     their OWN gate, so a burst of attestation/sync-committee posts
+    #     can never 503 a proposer's block.
+    _block_publish_gate = threading.BoundedSemaphore(
+        int(os.environ.get("LIGHTHOUSE_TPU_MAX_CONCURRENT_BLOCK_PUBLISHES", "2"))
+    )
+    _bulk_publish_gate = threading.BoundedSemaphore(
+        int(os.environ.get("LIGHTHOUSE_TPU_MAX_CONCURRENT_PUBLISHES", "8"))
+    )
+
+    @contextmanager
+    def _publish_permit(self, gate):
+        """Call only AFTER the body is fully read (see class comment)."""
+        if not gate.acquire(blocking=False):
+            raise ApiError(503, "publish pipeline overloaded; retry")
+        try:
+            yield
+        finally:
+            gate.release()
 
     def log_message(self, *args):  # silence default stderr logging
         pass
@@ -409,11 +438,12 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
                     signature=bytes.fromhex(a["signature"][2:]),
                 )
                 atts.append(att)
-        verified = chain.verify_unaggregated_attestations(atts)
-        for att, indices in verified:
-            chain.apply_attestation_to_fork_choice(att, indices)
-            if self.op_pool is not None:
-                self.op_pool.insert_attestation(att, indices, types)
+        with self._publish_permit(self._bulk_publish_gate):
+            verified = chain.verify_unaggregated_attestations(atts)
+            for att, indices in verified:
+                chain.apply_attestation_to_fork_choice(att, indices)
+                if self.op_pool is not None:
+                    self.op_pool.insert_attestation(att, indices, types)
         if len(verified) != len(atts):
             raise ApiError(400, f"{len(atts)-len(verified)} attestations failed")
         self._json({})
@@ -431,7 +461,8 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
             signed = types.SignedBeaconBlock.deserialize(raw)
         except Exception as e:  # noqa: BLE001
             raise ApiError(400, f"undecodable block SSZ: {e}") from e
-        self._import_published_block(signed)
+        with self._publish_permit(self._block_publish_gate):
+            self._import_published_block(signed)
 
     def _import_published_block(self, signed):
         """Shared import path for full + blinded publishes
@@ -1020,7 +1051,8 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
         signed = types.SignedBeaconBlock.make(
             message=block, signature=bytes.fromhex(sig[2:])
         )
-        self._import_published_block(signed)
+        with self._publish_permit(self._block_publish_gate):
+            self._import_published_block(signed)
 
     # ------------------------------------------------- deposit snapshot
 
@@ -1264,7 +1296,8 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
                 )
                 for m in body
             ]
-        accepted = self.chain.process_sync_committee_messages(msgs)
+        with self._publish_permit(self._bulk_publish_gate):
+            accepted = self.chain.process_sync_committee_messages(msgs)
         if accepted != len(msgs):
             raise ApiError(400, f"{len(msgs) - accepted} messages failed")
         self._json({})

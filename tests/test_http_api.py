@@ -548,3 +548,67 @@ def test_error_paths_state_block_validator_ids(api):
 
     # unknown route -> 404
     assert _http_error(lambda: _get(client, "/eth/v1/nonsense")) == 404
+
+
+def test_publish_backpressure_503(api):
+    """The heavy publish paths shed load when their gate is saturated
+    (reference: bounded ApiRequestP0/P1 queues -> 503), instead of
+    stacking handler threads behind inline verification. Block publishes
+    have their OWN gate: saturating the bulk gate must NOT 503 a block."""
+    import json as _json
+    import urllib.request
+
+    from lighthouse_tpu.api.http_api import BeaconApiHandler
+
+    _harness, _chain, client = api
+    port = int(client.base_url.rsplit(":", 1)[1])
+
+    def post(path, payload):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=_json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            urllib.request.urlopen(req, timeout=5)
+            return 200
+        except urllib.error.HTTPError as e:
+            e.read()
+            return e.code
+
+    def saturate(gate):
+        held = 0
+        while gate.acquire(blocking=False):
+            held += 1
+        return held
+
+    bulk = BeaconApiHandler._bulk_publish_gate
+    block = BeaconApiHandler._block_publish_gate
+    held = saturate(bulk)
+    try:
+        assert post("/eth/v1/beacon/pool/attestations", [{"bad": 1}]) in (400, 503)
+        # a well-formed-enough body reaches the gate and sheds
+        assert post("/eth/v1/beacon/pool/sync_committees", []) == 503
+        # block publishes ride the OTHER gate: still served (400 for the
+        # undecodable body — the handler ran)
+        assert post("/eth/v2/beacon/blocks", {"ssz": "0x00"}) == 400
+    finally:
+        for _ in range(held):
+            bulk.release()
+    # a DECODABLE block is needed to get past parsing to the gate
+    from lighthouse_tpu.state_transition.slot import types_for_slot
+
+    types = types_for_slot(_chain.spec, _chain.current_slot)
+    gblock = _chain.store.get_block(_chain.genesis_block_root, types)
+    gblock_hex = "0x" + types.SignedBeaconBlock.serialize(gblock).hex()
+    held = saturate(block)
+    try:
+        assert post("/eth/v2/beacon/blocks", {"ssz": gblock_hex}) == 503
+    finally:
+        for _ in range(held):
+            block.release()
+    # gates released: handlers reachable again (replayed genesis block is a
+    # 400 BlockError — the handler ran)
+    assert post("/eth/v2/beacon/blocks", {"ssz": gblock_hex}) == 400
+    assert post("/eth/v1/beacon/pool/sync_committees", []) == 200
