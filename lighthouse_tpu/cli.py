@@ -38,6 +38,15 @@ def _load_spec(args):
     return get_network_config(args.spec)
 
 
+def _read_jwt_secret(path: str) -> bytes:
+    """Hex JWT secret file (0x prefix tolerated) -> 32 raw bytes."""
+    with open(path) as f:
+        secret = bytes.fromhex(f.read().strip().removeprefix("0x"))
+    if len(secret) != 32:
+        raise ValueError(f"JWT secret must be 32 bytes, got {len(secret)}")
+    return secret
+
+
 # ------------------------------------------------------------------ bn
 
 
@@ -177,8 +186,7 @@ def cmd_bn(args):
             if not args.jwt_secret:
                 print("error: --engine requires --jwt-secret", file=sys.stderr)
                 return 1
-            with open(args.jwt_secret) as f:
-                secret = bytes.fromhex(f.read().strip().removeprefix("0x"))
+            secret = _read_jwt_secret(args.jwt_secret)
             engine = EngineApiClient(
                 args.engine, secret, timeout=args.execution_timeout
             )
@@ -824,6 +832,36 @@ def cmd_wallet(args):
     return 1
 
 
+def cmd_mock_el(args):
+    """Standalone mock execution engine over HTTP (lcli mock-el analog):
+    speaks engine_newPayloadV3/forkchoiceUpdatedV3/getPayloadV3 with real
+    JWT auth, for driving `bn --engine http://...` without a real EL."""
+    import json
+    import os
+    import time as _time
+
+    from .execution.engine_api import mock_el_server
+
+    if args.jwt_secret and os.path.exists(args.jwt_secret):
+        secret = _read_jwt_secret(args.jwt_secret)
+    else:
+        secret = os.urandom(32)
+        path = args.jwt_secret or "mock-el-jwt.hex"
+        with open(path, "w") as f:
+            f.write(secret.hex())
+        print(f"wrote fresh JWT secret to {path}", file=sys.stderr)
+    _server, _t, port, _mock = mock_el_server(
+        port=args.port, jwt_secret=secret, host=args.host
+    )
+    print(json.dumps({"engine_url": f"http://{args.host}:{port}"}), flush=True)
+    try:
+        while True:
+            _time.sleep(60)
+    except KeyboardInterrupt:
+        _server.shutdown()
+    return 0
+
+
 def cmd_boot_node(args):
     """Standalone discovery bootstrap node (boot_node/src analog)."""
     import json
@@ -1126,6 +1164,18 @@ def build_parser() -> argparse.ArgumentParser:
     wv.add_argument("--output-dir", required=True)
     for p_ in (wc, wr, wv):
         p_.set_defaults(fn=cmd_wallet)
+
+    mel = sub.add_parser(
+        "mock-el",
+        help="run a standalone mock execution engine (engine API over HTTP)",
+    )
+    mel.add_argument("--host", default="127.0.0.1")
+    mel.add_argument("--port", type=int, default=8551)
+    mel.add_argument(
+        "--jwt-secret", default=None,
+        help="hex JWT secret file (created with a fresh secret if absent)",
+    )
+    mel.set_defaults(fn=cmd_mock_el)
 
     boot = sub.add_parser("boot-node", help="run a standalone discovery boot node")
     boot.add_argument("--host", default="0.0.0.0")

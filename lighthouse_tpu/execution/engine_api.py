@@ -243,3 +243,94 @@ class MockExecutionLayer:
                 "proofs": [p for _, _, p in triples],
             }
         return out
+
+
+# ------------------------------------------------------------ mock EL server
+
+
+def mock_el_server(port: int = 0, jwt_secret: bytes | None = None,
+                   host: str = "127.0.0.1"):
+    """Standalone engine-API JSON-RPC server over a MockExecutionLayer —
+    the out-of-process EL double (`lighthouse-tpu mock-el`, the lcli
+    `mock-el` analog: /root/reference/lcli/src/main.rs mock-el +
+    execution_layer/src/test_utils' RPC handler). Speaks exactly the
+    surface EngineApiClient calls (newPayloadV3 / forkchoiceUpdatedV3 /
+    getPayloadV3) with real JWT verification, so `bn --engine
+    http://host:port --jwt-secret FILE` exercises the true HTTP path.
+
+    Returns (server, thread, port, mock). Caller owns shutdown."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    mock = MockExecutionLayer()
+    mock_lock = threading.Lock()   # MockExecutionLayer is not thread-safe
+    secret = jwt_secret if jwt_secret is not None else b"\x11" * 32
+
+    class Handler(BaseHTTPRequestHandler):
+        timeout = 30               # a stalled connection must not pin a thread
+
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            auth = self.headers.get("Authorization", "")
+            token = auth.removeprefix("Bearer ").strip()
+            if not token or not verify_jwt(secret, token):
+                self.send_response(401)
+                self.end_headers()
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(length))
+                method = req.get("method", "")
+                params = req.get("params", [])
+                if method == "engine_newPayloadV3":
+                    payload, hashes, root = params
+                    with mock_lock:
+                        result = mock.new_payload(
+                            payload,
+                            [bytes.fromhex(x[2:]) for x in hashes],
+                            bytes.fromhex(root[2:]),
+                        )
+                elif method == "engine_forkchoiceUpdatedV3":
+                    state, attrs = params
+                    with mock_lock:
+                        result = mock.forkchoice_updated(
+                            bytes.fromhex(state["headBlockHash"][2:]),
+                            bytes.fromhex(state["safeBlockHash"][2:]),
+                            bytes.fromhex(state["finalizedBlockHash"][2:]),
+                            attrs,
+                        )
+                elif method == "engine_getPayloadV3":
+                    with mock_lock:
+                        result = mock.get_payload(params[0])
+                else:
+                    body = json.dumps({
+                        "jsonrpc": "2.0", "id": req.get("id"),
+                        "error": {"code": -32601,
+                                  "message": f"unknown method {method}"},
+                    }).encode()
+                    self._reply(body)
+                    return
+                body = json.dumps(
+                    {"jsonrpc": "2.0", "id": req.get("id"), "result": result}
+                ).encode()
+            except Exception as e:  # noqa: BLE001 - surfaced as RPC error
+                body = json.dumps({
+                    "jsonrpc": "2.0", "id": None,
+                    "error": {"code": -32000,
+                              "message": f"{type(e).__name__}: {e}"},
+                }).encode()
+            self._reply(body)
+
+        def _reply(self, body: bytes):
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server, thread, server.server_address[1], mock

@@ -146,3 +146,53 @@ def test_payload_block_hash_roundtrip():
         good.copy_with(gas_used=22_000), root
     )
     assert not verify_payload_block_hash(good, b"\x0c" * 32)
+
+
+def test_mock_el_http_server_roundtrip():
+    """The standalone mock EL serves the true HTTP engine-API path: JWT
+    enforced, fcU-with-attrs mints a payload id, getPayload returns the
+    payload, newPayload extends the tree (lcli mock-el analog)."""
+    import urllib.error
+    import urllib.request
+
+    from lighthouse_tpu.execution.engine_api import (
+        EngineApiClient, mock_el_server,
+    )
+
+    secret = b"\x42" * 32
+    server, _t, port, mock = mock_el_server(port=0, jwt_secret=secret)
+    try:
+        client = EngineApiClient(f"http://127.0.0.1:{port}", secret)
+        genesis = b"\x00" * 32
+        r = client.forkchoice_updated(
+            genesis, genesis, genesis,
+            attrs={"timestamp": "0x10", "prevRandao": "0x" + "00" * 32,
+                   "suggestedFeeRecipient": "0x" + "00" * 20,
+                   "withdrawals": []},
+        )
+        assert r["payloadStatus"]["status"] == "VALID"
+        pid = r["payloadId"]
+        assert pid
+        got = client.get_payload(pid)
+        payload = got["executionPayload"]
+        assert payload["parentHash"] == "0x" + genesis.hex()
+        r2 = client.new_payload(payload, [], b"\x00" * 32)
+        assert r2["status"] == "VALID"
+        # the tree actually extended
+        assert bytes.fromhex(payload["blockHash"][2:]) in mock.blocks
+
+        # wrong JWT -> 401 before any dispatch
+        bad = EngineApiClient(f"http://127.0.0.1:{port}", b"\x43" * 32)
+        try:
+            bad.forkchoice_updated(genesis, genesis, genesis)
+            raise AssertionError("expected auth failure")
+        except (RuntimeError, urllib.error.HTTPError):
+            pass
+        # unknown method -> JSON-RPC error surfaced
+        try:
+            client.call("engine_bogusV9", [])
+            raise AssertionError("expected unknown-method error")
+        except RuntimeError as e:
+            assert "unknown method" in str(e)
+    finally:
+        server.shutdown()
