@@ -18,9 +18,10 @@ Contents (all big-endian 48-byte field elements, uint8 arrays):
   kzg:   4096-entry insecure dev setup, 6 blobs + commitments + proofs
          (config 4) — reference workload /root/reference/crypto/kzg/src/lib.rs:81
 
-Validation at gen time: every BLS set verifies through the device backend,
-and a sample re-verifies through the pure-Python backend (independent of
-the jax kernels); one tampered set must reject.
+Validation at gen time: every BLS set and the KZG batch verify through the
+pure-Python backend — fully independent of the jax kernels (which bench.py
+re-asserts on-device at measurement time, with negative controls); one
+tampered set must reject.
 """
 
 import argparse
@@ -63,79 +64,54 @@ def _g2_arr(points) -> np.ndarray:
     ).reshape(len(points), 2, 2, 48)
 
 
-# ---------------------------------------------------------- device builders
-# (moved here from bench.py — generation-time only)
+# ------------------------------------------------------- host fast builders
+# Generation-time only. The single-core build box makes device batch
+# kernels the SLOW path for one-off generation (each 4096-point device MSM
+# costs ~30-40 min of XLA:CPU runtime); host math with a fixed-base window
+# table for G generates the SAME group elements in minutes. None of this
+# affects what the bench measures — verification kernels are data-
+# independent (constant shapes, constant-time limb math), so how the
+# fixture points were produced cannot change their verification cost.
 
 
-def _batched_gen_mul(gen_jac_single, bits, ops):
-    import jax
-    import jax.numpy as jnp
-    from lighthouse_tpu.crypto.jaxbls import curve_ops as co
-
-    base = jax.tree_util.tree_map(
-        lambda c: jnp.broadcast_to(c, (bits.shape[0],) + c.shape), gen_jac_single
-    )
-    acc = co.scalar_mul_bits(base, bits, ops)
-    return co.jac_to_affine(acc, ops)
-
-
-_gen_cache: dict = {}
-
-
-def _g1_base_muls(scalars):
-    """scalars -> list of affine G1 int pairs, computed on device in fixed
-    512-wide chunks (one compile)."""
-    import jax
-    import jax.numpy as jnp
+def _g1_gen_tables(window: int = 8):
+    """tables[j][v] = (v << (window*j)) * G as host affine points: any
+    256-bit fixed-base mul becomes <= 32 point additions."""
     from lighthouse_tpu.crypto.bls381 import curve as cv
-    from lighthouse_tpu.crypto.jaxbls import curve_ops as co, limbs as lb
 
-    if "g1" not in _gen_cache:
-        _gen_cache["g1"] = jax.jit(
-            lambda d: (lambda r: (lb.from_mont(r[0]), lb.from_mont(r[1])))(
-                _batched_gen_mul(co.g1_to_device(cv.G1_GEN), d, co.FQ_OPS)
-            )
-        )
-    CHUNK = 512
-    xs, ys = [], []
-    for i in range(0, len(scalars), CHUNK):
-        chunk = scalars[i : i + CHUNK]
-        pad = CHUNK - len(chunk)
-        digs = jnp.asarray(co.scalars_to_bits(list(chunk) + [1] * pad, 256))
-        cx, cy = _gen_cache["g1"](digs)
-        xs.extend(lb.unpack_batch(np.asarray(cx))[: len(chunk)])
-        ys.extend(lb.unpack_batch(np.asarray(cy))[: len(chunk)])
-    return list(zip(xs, ys))
+    tables = []
+    base = cv.G1_GEN
+    for _j in range(256 // window):
+        row = [None] * (1 << window)
+        acc = None
+        for v in range(1, 1 << window):
+            acc = cv.g1_add(acc, base)
+            row[v] = acc
+        tables.append(row)
+        base = cv.g1_mul(base, 1 << window)
+    return tables
 
 
-def _g2_scalar_muls(points, scalars, width=64):
-    """sig_i = scalars[i] * points[i] on device, padded to `width` lanes."""
-    import jax
-    import jax.numpy as jnp
-    from lighthouse_tpu.crypto.jaxbls import curve_ops as co, limbs as lb
+def _g1_fixed_mul(tables, k: int, window: int = 8):
+    from lighthouse_tpu.crypto.bls381 import curve as cv
+    from lighthouse_tpu.crypto.bls381.constants import R
 
-    key = ("g2", width)
-    if key not in _gen_cache:
-        _gen_cache[key] = jax.jit(
-            lambda h, d: (lambda r: (lb.from_mont(r[0]), lb.from_mont(r[1])))(
-                (lambda acc: co.jac_to_affine(acc, co.FQ2_OPS))(
-                    co.scalar_mul_bits(h, d, co.FQ2_OPS)
-                )
-            )
-        )
-    n = len(points)
-    pad = width - n
-    hd = co.g2_batch_to_device(list(points) + [points[0]] * pad)
-    sdigs = jnp.asarray(co.scalars_to_bits(list(scalars) + [1] * pad, 256))
-    sx, sy = _gen_cache[key](hd, sdigs)
-    sx = np.asarray(sx)[:n]
-    sy = np.asarray(sy)[:n]
-    from lighthouse_tpu.crypto.jaxbls import limbs as lb
+    k %= R
+    acc = None
+    j = 0
+    while k:
+        v = k & ((1 << window) - 1)
+        if v:
+            acc = cv.g1_add(acc, tables[j][v])
+        k >>= window
+        j += 1
+    return acc
 
-    def fq2_of(arr):
-        return (lb.unpack(arr[0]), lb.unpack(arr[1]))
 
-    return [(fq2_of(sx[i]), fq2_of(sy[i])) for i in range(n)]
+def host_base_muls(scalars):
+    """scalars -> affine G1 points via the window table (~2 ms each)."""
+    tables = _g1_gen_tables()
+    return [_g1_fixed_mul(tables, s) for s in scalars]
 
 
 def _msg(i, tag=0):
@@ -145,16 +121,18 @@ def _msg(i, tag=0):
 def build_groups(rng, groups):
     """groups: [(n_pks, message)] -> (keys_per_group, sig_points, messages).
 
-    Valid aggregate signatures over distinct keys; all scalar muls on device.
-    """
+    Valid aggregate signatures over distinct keys, generated host-side via
+    the fixed-base window table (see the note above — the point VALUES
+    don't influence the verification kernels' cost)."""
+    from lighthouse_tpu.crypto.bls381 import curve as cv
     from lighthouse_tpu.crypto.bls381 import hash_to_curve as ph2c
     from lighthouse_tpu.crypto.bls381.constants import DST_POP, R
 
     n_keys = sum(g[0] for g in groups)
     sks = [rng.randrange(1, R) for _ in range(n_keys)]
     t0 = time.time()
-    pts = _g1_base_muls(sks)
-    log(f"  pubkey gen x{n_keys} (device): {time.time()-t0:.1f}s")
+    pts = host_base_muls(sks)
+    log(f"  pubkey gen x{n_keys} (host window table): {time.time()-t0:.1f}s")
 
     t0 = time.time()
     agg_sks, hs = [], []
@@ -166,11 +144,8 @@ def build_groups(rng, groups):
     log(f"  hash-to-g2 x{len(groups)} (host): {time.time()-t0:.1f}s")
 
     t0 = time.time()
-    width = 64
-    while width < len(groups):
-        width *= 2
-    sig_pts = _g2_scalar_muls(hs, agg_sks, width=width)
-    log(f"  signature gen (device): {time.time()-t0:.1f}s")
+    sig_pts = [cv.g2_mul(h_pt, sk) for h_pt, sk in zip(hs, agg_sks)]
+    log(f"  signature gen x{len(groups)} (host): {time.time()-t0:.1f}s")
 
     keys, off = [], 0
     for n_pks, _msg_ in groups:
@@ -180,30 +155,45 @@ def build_groups(rng, groups):
 
 
 def gen_kzg(rng, n, n_blobs):
+    """KZG fixture via the dev setup's KNOWN tau: commit(p) = p(tau)*G and
+    proof(q) = q(tau)*G are single fixed-base muls producing EXACTLY the
+    group elements the Lagrange-basis MSM would (commitment math is linear
+    in the basis) — generation drops from hours of single-core MSM runtime
+    to seconds, and the batch verifier (real pairing + challenge math)
+    still checks the result below. NEVER valid for production (tau secret);
+    the dev setup is already marked insecure for the same reason."""
     from lighthouse_tpu.crypto import kzg
     from lighthouse_tpu.crypto.bls381 import curve as cv, serde
     from lighthouse_tpu.crypto.bls381.constants import R
 
     t0 = time.time()
     lis, tau = kzg.TrustedSetup.dev_setup_scalars(n)
-    g1 = _g1_base_muls(lis)
+    g1 = host_base_muls(lis)
     g2m = [cv.G2_GEN, cv.g2_mul(cv.G2_GEN, tau)]
     setup = kzg.TrustedSetup(
         g1_lagrange=g1, g2_monomial=g2m, roots=kzg._fr_roots_of_unity(n)
     )
-    log(f"  kzg setup build (n={n}): {time.time()-t0:.1f}s")
+    log(f"  kzg setup build (n={n}, host): {time.time()-t0:.1f}s")
 
     t0 = time.time()
+    tables = _g1_gen_tables()
     blobs, cbs, pbs = [], [], []
     for _ in range(n_blobs):
         blob = b"".join(rng.randrange(R).to_bytes(32, "big") for _ in range(n))
-        c = kzg.blob_to_kzg_commitment(blob, setup)
+        poly = kzg.blob_to_polynomial(blob, setup)
+        p_tau = kzg._evaluate_polynomial_in_evaluation_form(poly, tau, setup)
+        c = _g1_fixed_mul(tables, p_tau)
         cb = serde.g1_compress(c)
-        p = kzg.compute_blob_kzg_proof(blob, cb, setup)
+        # the blob proof's challenge point, then q(tau) = (p(tau)-y)/(tau-z)
+        z = kzg.compute_challenge(blob, cb, setup)
+        y = kzg._evaluate_polynomial_in_evaluation_form(poly, z, setup)
+        q_tau = (p_tau - y) * pow((tau - z) % R, R - 2, R) % R
+        proof = _g1_fixed_mul(tables, q_tau)
         blobs.append(blob)
         cbs.append(cb)
-        pbs.append(serde.g1_compress(p))
-    log(f"  kzg blob/proof fixture x{n_blobs}: {time.time()-t0:.1f}s")
+        pbs.append(serde.g1_compress(proof))
+    log(f"  kzg blob/proof fixture x{n_blobs} (host, tau form): "
+        f"{time.time()-t0:.1f}s")
     assert kzg.verify_blob_kzg_proof_batch(blobs, cbs, pbs, setup), (
         "kzg fixture failed to verify"
     )
@@ -216,15 +206,6 @@ def main():
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
-    # generation always runs on local CPU: the tunnel is for measurement
-    # windows only (sitecustomize pins the axon platform; env vars alone
-    # can't override it, so set jax.config before any backend initializes)
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-    from lighthouse_tpu.utils.jaxcfg import setup_compilation_cache
-
-    setup_compilation_cache()
     from lighthouse_tpu.crypto import bls
     from lighthouse_tpu.crypto.bls import api as bls_api
 
@@ -236,7 +217,9 @@ def main():
         out = args.out or "bench_fixtures.npz"
 
     rng = random.Random(SEED)
-    bls_api.set_backend("jax")   # device path for the generation kernels
+    # generation AND validation are host-side: the pure-Python backend is
+    # independent of every jax kernel and fast at these sizes
+    bls_api.set_backend("python")
 
     groups = (
         [(n_pks, _msg(i)) for i in range(n_att)]
@@ -262,7 +245,6 @@ def main():
     assert not py.verify_signature_sets([bad], [1]), "tampered set accepted"
     log(f"  python-backend verification of ALL {len(sets)} sets: "
         f"{time.time()-t0:.1f}s")
-    bls_api.set_backend("jax")
 
     kzg_g1, kzg_g2m, blobs, cbs, pbs = gen_kzg(rng, kzg_n, kzg_blobs)
 
