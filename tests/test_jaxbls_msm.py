@@ -96,9 +96,12 @@ def test_kzg_lincomb_prefers_fixed_base_for_large_sets():
     try:
         bls_api._active_backend = FakeBackend()
         big = [cv.G1_GEN] * 256
-        kzg._g1_lincomb(big, [1] * 256)
+        # only a caller-declared STABLE base takes the comb path (the
+        # one-time table build must never be paid for per-call points)
+        kzg._g1_lincomb(big, [1] * 256, fixed_base=True)
+        kzg._g1_lincomb(big, [1] * 256)                   # undeclared -> var
         small = [cv.G1_GEN] * 4
-        kzg._g1_lincomb(small, [1] * 4)
+        kzg._g1_lincomb(small, [1] * 4, fixed_base=True)  # too small -> var
     finally:
         bls_api._active_backend = prev
-    assert calls == [("fixed", 256), ("var", 4)]
+    assert calls == [("fixed", 256), ("var", 256), ("var", 4)]
