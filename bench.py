@@ -227,9 +227,16 @@ def _pallas_guard(backend, sets, rands):
     except Exception as e:
         log(f"  pallas path failed ({type(e).__name__}: {e}); retrying with XLA pairing")
         os.environ["LIGHTHOUSE_TPU_PALLAS"] = "off"
+        import jax
         import lighthouse_tpu.crypto.jaxbls.backend as jb
 
         jb._kernel_cache.clear()
+        # the pallas decision is baked into the traced jaxpr, and jax's
+        # trace cache is GLOBAL (keyed by the underlying function) — a
+        # fresh jax.jit over the same stage fn replays the poisoned trace
+        # unless the global caches go too (observed on-chip r5: the retry
+        # re-raised the Mosaic scatter-add error from the cached jaxpr)
+        jax.clear_caches()
         t0 = time.time()
         ok = backend.verify_signature_sets(sets, rands)
         dt = time.time() - t0
@@ -432,7 +439,14 @@ def main():
 
     log(f"devices: {devices}")
     _MATRIX["devices"] = str(devices)
-    _MATRIX["pallas"] = os.environ.get("LIGHTHOUSE_TPU_PALLAS", "auto")
+    # fused Pallas kernels stay OFF in auto mode until scripts/probe_pallas.py
+    # has recorded a validated Mosaic lowering for THIS platform — the gate
+    # lives in pallas_ops.mode()/_probed_ok() so every entry point shares it
+    # (observed r5 on-chip: Mosaic rejects scatter-add/dynamic_slice, and an
+    # unproven kernel costs minutes of tunnel window in doomed lowering)
+    from lighthouse_tpu.crypto.jaxbls import pallas_ops as _plo
+
+    _MATRIX["pallas"] = _plo.mode() or "off"
 
     from lighthouse_tpu.crypto.bls import api as bls_api
 

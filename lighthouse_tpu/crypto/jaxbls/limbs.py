@@ -330,6 +330,14 @@ def _cond_sub_n(t):
     return out[..., :NL]
 
 
+def _shift_up_one(v):
+    """v shifted one lane toward the high end (lane 0 becomes zero, the top
+    lane drops): the carry-column shift in the poly products. A pad+slice —
+    NOT `.at[1:].add`, whose scatter-add Mosaic cannot lower."""
+    pad = [(0, 0)] * (v.ndim - 1) + [(1, 0)]
+    return jnp.pad(v, pad)[..., :-1]
+
+
 def _poly_mul_shift(a, b, ncols: int):
     """Shift-accumulate schoolbook limb product (FAST form, Pallas bodies):
     na statically-shifted scaled copies of b, summed as straight-line value
@@ -349,7 +357,7 @@ def _poly_mul_shift(a, b, ncols: int):
         c_lo = c_lo + a_lo[..., j : j + 1] * bj
         c_hi = c_hi + a_hi[..., j : j + 1] * bj
     col = c_lo + ((c_hi & 0xFF) << 8)
-    col = col.at[..., 1:].add(c_hi[..., :-1] >> 8)
+    col = col + _shift_up_one(c_hi >> 8)
     return col                                          # each < 2^31
 
 
@@ -408,7 +416,7 @@ def _poly_mul(a, b, ncols: int):
     c_lo = z_lo @ M                                          # columns < 2^29
     c_hi = z_hi @ M
     col = c_lo + ((c_hi & 0xFF) << 8)
-    col = col.at[..., 1:].add(c_hi[..., :-1] >> 8)
+    col = col + _shift_up_one(c_hi >> 8)
     return col                                               # each < 2^30
 
 
@@ -494,7 +502,7 @@ def mul_small(a, k: int):
     lo = p & MASK
     hi = p >> LB
     acc = jnp.concatenate([lo, jnp.zeros(lo.shape[:-1] + (1,), U32)], axis=-1)
-    acc = acc.at[..., 1 : NL + 1].add(hi)
+    acc = acc + jnp.pad(hi, [(0, 0)] * (hi.ndim - 1) + [(1, 0)])
     acc, _ = carry_normalize(acc)                      # value < k*P, NL+1 limbs
     for _ in range(k - 1):
         acc = _cond_sub_n_ext(acc)

@@ -64,6 +64,30 @@ from . import pairing_ops as po
 _X_BITS_ARR = np.array([int(b) for b in bin(X_ABS)[3:]], np.int32)
 
 
+_STATUS_MEMO: list = []
+
+
+def _probed_ok() -> bool:
+    """The PALLAS_STATUS.json gate, shared by every auto-mode consumer:
+    fused kernels only after scripts/probe_pallas.py has validated Mosaic
+    lowering on THIS platform (the record carries str(jax.devices()) so a
+    stale file from a different chip keeps auto on the XLA path)."""
+    if not _STATUS_MEMO:
+        ok = False
+        try:
+            import json
+
+            root = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "..", "..")
+            with open(os.path.join(root, "PALLAS_STATUS.json")) as f:
+                st = json.load(f)
+            ok = bool(st.get("ok")) and st.get("platform") == str(jax.devices())
+        except Exception:
+            ok = False
+        _STATUS_MEMO.append(ok)
+    return _STATUS_MEMO[0]
+
+
 def mode() -> str | None:
     """Resolve the Pallas routing mode. Returns "compile", "interpret" or
     None (use the plain XLA path)."""
@@ -74,9 +98,11 @@ def mode() -> str | None:
         return "interpret"
     if env in ("on", "1", "yes", "force"):
         return "compile"
-    # auto: only on a real accelerator, and only when the set axis is not
+    # auto: only on a real accelerator, only when the set axis is not
     # sharded over a multi-device mesh (mesh mode keeps the XLA collectives
-    # path — parallel/mesh.py).
+    # path — parallel/mesh.py), and only once the on-chip probe has
+    # validated Mosaic lowering here (an unproven kernel costs minutes of
+    # doomed client-side lowering before any fallback can engage).
     try:
         if jax.default_backend() == "cpu":
             return None
@@ -84,7 +110,7 @@ def mode() -> str | None:
 
         if get_mesh() is not None:
             return None
-        return "compile"
+        return "compile" if _probed_ok() else None
     except Exception:
         return None
 

@@ -1,0 +1,286 @@
+"""On-chip integer-arithmetic differential diagnostic.
+
+Round-5 on-chip finding: the plain-XLA verify path returns False for KNOWN
+VALID signature sets on the real TPU (bench configs 1/3), while every CPU
+lane is green. All jaxbls arithmetic is exact u32 limb math, so a divergence
+on the accelerator means some integer primitive is lowered inexactly there
+(prime suspect: the anti-diagonal u32 dot_general in limbs._poly_mul — a TPU
+MXU f32 matmul can only represent integers exactly below 2^24, our columns
+reach 2^30) or miscompiled by the experimental axon backend.
+
+This script runs the limb/curve/pairing primitives bottom-up on the default
+device and diffs each against exact host-integer arithmetic, stopping at the
+first divergence, so one short tunnel window localizes the broken primitive.
+Tiny shapes only — every jit here compiles in seconds.
+
+Usage:  python scripts/diag_tpu.py            # default device (axon TPU)
+        JAX_PLATFORMS=cpu ... (control run)
+"""
+
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("LIGHTHOUSE_TPU_PALLAS", "off")
+
+from lighthouse_tpu.utils.jaxcfg import setup_compilation_cache
+
+setup_compilation_cache()
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto.jaxbls import limbs as lb
+from lighthouse_tpu.crypto.jaxbls import tower as tw
+from lighthouse_tpu.crypto.jaxbls import curve_ops as co
+from lighthouse_tpu.crypto.jaxbls import h2c_ops as h2
+from lighthouse_tpu.crypto.jaxbls import pairing_ops as po
+from lighthouse_tpu.crypto.bls381.constants import P, R
+from lighthouse_tpu.crypto.bls381 import curve as pc
+from lighthouse_tpu.crypto.bls381 import pairing as pp
+from lighthouse_tpu.crypto.bls381 import hash_to_curve as ph2c
+
+rng = random.Random(0xD1A6)
+FAILS = []
+
+
+def check(name, fn):
+    t0 = time.time()
+    try:
+        msg = fn()
+        dt = time.time() - t0
+        if msg is None:
+            print(f"PASS {name} ({dt:.1f}s)", flush=True)
+        else:
+            print(f"FAIL {name} ({dt:.1f}s): {msg}", flush=True)
+            FAILS.append(name)
+    except Exception as e:  # noqa: BLE001
+        print(f"ERROR {name} ({time.time()-t0:.1f}s): {type(e).__name__}: {e}",
+              flush=True)
+        FAILS.append(name)
+
+
+def rand_fq(n):
+    return [rng.randrange(P) for _ in range(n)]
+
+
+# ---------------------------------------------------------------- level 0
+
+
+def t_u32_mul():
+    a = np.array([0xFFFF, 0x1234, 65535, 40000], np.uint32)
+    b = np.array([0xFFFF, 0x9876, 65535, 50000], np.uint32)
+    got = np.asarray(jax.jit(lambda x, y: x * y)(a, b))
+    want = (a.astype(np.uint64) * b) & 0xFFFFFFFF
+    if not (got == want).all():
+        return f"u32 elementwise mul wrong: {got} vs {want}"
+
+
+def t_antidiag_dot():
+    """The exact suspect: u32 dot_general with values up to 2^24 against the
+    0/1 anti-diagonal matrix, column sums up to ~2^29."""
+    na = nb = lb.NL
+    ncols = 2 * lb.NL + 1
+    M = np.asarray(lb._antidiag(na, nb, ncols))
+    z = np.array([rng.randrange(1 << 24) for _ in range(na * nb)],
+                 np.uint32).reshape(1, na * nb)
+    got = np.asarray(jax.jit(lambda zz, mm: zz @ mm)(z, jnp.asarray(M)))
+    want = (z.astype(object) @ M.astype(object)) % (1 << 32)
+    if not (got.astype(object) == want).all():
+        bad = np.nonzero(got.astype(object) != want)[1][:4]
+        return (f"u32 dot_general INEXACT on this backend at cols {bad}: "
+                f"got {got[0, bad]} want {[int(want[0, c]) for c in bad]}")
+
+
+def t_poly_mul(shift: bool):
+    """_poly_mul returns REDUNDANT columns (the 8-bit-split carry rides one
+    column up), so compare the 2^16-weighted VALUE, not per-column sums."""
+    a = [rng.randrange(1 << 16) for _ in range(lb.NL)]
+    b = [rng.randrange(1 << 16) for _ in range(lb.NL)]
+    ncols = 2 * lb.NL + 1
+    aa = np.array(a, np.uint32)[None]
+    bb = np.array(b, np.uint32)[None]
+    prev = lb._POLY_SHIFT
+    lb._POLY_SHIFT = shift
+    try:
+        got = np.asarray(
+            jax.jit(lambda x, y: lb._poly_mul(x, y, ncols))(aa, bb)
+        )[0]
+    finally:
+        lb._POLY_SHIFT = prev
+    got_val = sum(int(v) << (lb.LB * i) for i, v in enumerate(got))
+    av = sum(x << (lb.LB * i) for i, x in enumerate(a))
+    bv = sum(y << (lb.LB * i) for i, y in enumerate(b))
+    if got_val != av * bv:
+        return f"weighted value got {got_val} want {av * bv}"
+
+
+def t_carry_normalize(fast: bool):
+    t = np.array([rng.randrange(1 << 31) for _ in range(lb.NL)],
+                 np.uint32)[None]
+    fn = lb.carry_normalize_fast if fast else lb._carry_normalize_scan
+    got, carry = jax.jit(fn)(t)
+    got, carry = np.asarray(got)[0], int(np.asarray(carry)[0])
+    val = sum(int(v) << (lb.LB * i) for i, v in enumerate(t[0]))
+    norm = sum(int(v) << (lb.LB * i) for i, v in enumerate(got))
+    norm += carry << (lb.LB * lb.NL)
+    if val != norm:
+        return f"value {val} -> {norm} (limbs {got[:6]}..., carry {carry})"
+
+
+def t_mont_mul():
+    xs, ys = rand_fq(4), rand_fq(4)
+    ax, ay = lb.pack_batch(xs), lb.pack_batch(ys)
+    f = jax.jit(lambda a, b: lb.from_mont(lb.mont_mul(lb.to_mont(a), lb.to_mont(b))))
+    got = lb.unpack_batch(np.asarray(f(ax, ay)))
+    want = [(x * y) % P for x, y in zip(xs, ys)]
+    if got != want:
+        return f"lane diffs at {[i for i in range(4) if got[i] != want[i]]}"
+
+
+def t_sub_borrow():
+    xs, ys = rand_fq(4), rand_fq(4)
+    ax, ay = lb.pack_batch(xs), lb.pack_batch(ys)
+    diff, borrow = jax.jit(lb._sub_with_borrow)(ax, ay)
+    diff = lb.unpack_batch(np.asarray(diff))
+    borrow = list(np.asarray(borrow))
+    for i, (x, y) in enumerate(zip(xs, ys)):
+        want = (x - y) % (1 << (lb.NL * lb.LB))
+        wb = 1 if x < y else 0
+        if diff[i] != want or int(borrow[i]) != wb:
+            return f"lane {i}: got ({diff[i]}, {borrow[i]}) want ({want}, {wb})"
+
+
+# ---------------------------------------------------------------- level 1
+
+
+def t_g1_scalar_mul():
+    ks = [rng.randrange(1, R) for _ in range(4)]
+    pts = [pc.g1_mul(pc.G1_GEN, rng.randrange(1, R)) for _ in range(4)]
+    px = lb.pack_batch([p[0] for p in pts])
+    py = lb.pack_batch([p[1] for p in pts])
+    bits = co.scalars_to_bits(ks, 256)
+
+    def run(pxa, pya, b):
+        jac = co.affine_to_jac(co.FQ_OPS, (lb.to_mont(pxa), lb.to_mont(pya)))
+        return co.jac_to_affine(co.scalar_mul_bits(jac, b, co.FQ_OPS), co.FQ_OPS)
+
+    x, y, inf = jax.jit(run)(px, py, jnp.asarray(bits))
+    gx = lb.unpack_batch(np.asarray(jax.jit(lb.from_mont)(x)))
+    gy = lb.unpack_batch(np.asarray(jax.jit(lb.from_mont)(y)))
+    for i in range(4):
+        want = pc.g1_mul(pts[i], ks[i])
+        if (gx[i], gy[i]) != want:
+            return f"lane {i} scalar-mul mismatch"
+
+
+def t_tree_sum(n=8):
+    """n=8 exercises the fori/roll branch; n=4 the unrolled branch — the
+    small verify buckets (bench configs 1/3, n=MIN_SETS=4) ride the latter."""
+    pts = [pc.g1_mul(pc.G1_GEN, rng.randrange(1, R)) for _ in range(n)]
+    px = lb.pack_batch([p[0] for p in pts])
+    py = lb.pack_batch([p[1] for p in pts])
+
+    def run(pxa, pya):
+        jac = co.affine_to_jac(co.FQ_OPS, (lb.to_mont(pxa), lb.to_mont(pya)))
+        acc = co.tree_sum(jac, co.FQ_OPS)
+        return co.jac_to_affine(acc, co.FQ_OPS)
+
+    x, y, inf = jax.jit(run)(px, py)
+    gx = lb.unpack(np.asarray(jax.jit(lb.from_mont)(x)))
+    gy = lb.unpack(np.asarray(jax.jit(lb.from_mont)(y)))
+    want = None
+    for p in pts:
+        want = pc.g1_add(want, p) if want else p
+    if (gx, gy) != want:
+        return "8-point tree sum mismatch"
+
+
+def t_hash_to_g2():
+    msg = b"\xab" * 32
+    dst = b"BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
+    us = h2.hash_to_field_batch([msg], dst)
+    jacfn = jax.jit(h2.hash_to_g2_jacobian)
+    xs, ys, inf = jax.jit(
+        lambda u: co.jac_to_affine(jacfn(u), co.FQ2_OPS)
+    )(jnp.asarray(us))
+    got_x = [lb.unpack(np.asarray(jax.jit(lb.from_mont)(xs[0, i]))) for i in range(2)]
+    got_y = [lb.unpack(np.asarray(jax.jit(lb.from_mont)(ys[0, i]))) for i in range(2)]
+    want = ph2c.hash_to_g2(msg, dst)
+    if (tuple(got_x), tuple(got_y)) != (want[0], want[1]):
+        return "hash_to_g2 mismatch vs host"
+
+
+def t_pairing_product():
+    """e(a*G1, b*G2) * e(-ab*G1, G2) == 1 — exercises Miller + final exp."""
+    a = rng.randrange(1, R)
+    b = rng.randrange(1, R)
+    p1 = pc.g1_mul(pc.G1_GEN, a)
+    q1 = pc.g2_mul(pc.G2_GEN, b)
+    p2 = pc.g1_neg(pc.g1_mul(pc.G1_GEN, (a * b) % R))
+    q2 = pc.G2_GEN
+    px = lb.pack_batch([p1[0], p2[0]])
+    py = lb.pack_batch([p1[1], p2[1]])
+    qx = np.stack([
+        np.stack([lb.pack(q1[0][0]), lb.pack(q1[0][1])]),
+        np.stack([lb.pack(q2[0][0]), lb.pack(q2[0][1])]),
+    ])
+    qy = np.stack([
+        np.stack([lb.pack(q1[1][0]), lb.pack(q1[1][1])]),
+        np.stack([lb.pack(q2[1][0]), lb.pack(q2[1][1])]),
+    ])
+    mask = np.ones((2,), np.uint32)
+    ok = np.asarray(
+        jax.jit(po.pairing_product_is_one)((px, py), (qx, qy), mask)
+    )
+    if not bool(ok):
+        return "valid pairing product != 1 on device"
+
+
+def t_end_to_end():
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.crypto.bls import api as bls_api
+
+    backend = bls_api.set_backend("jax")
+    sks = [bls.SecretKey(1000 + i) for i in range(4)]
+    pks = [sk.public_key() for sk in sks]
+    m = b"\x3c" * 32
+    agg = bls.AggregateSignature.aggregate([bls.sign(sk, m) for sk in sks])
+    s = bls.SignatureSet(agg, pks, m)
+    if not backend.verify_signature_sets([s], [1]):
+        return "valid 4-pk set rejected on device"
+    bad = bls.SignatureSet(agg, pks, b"\x3d" * 32)
+    if backend.verify_signature_sets([bad], [1]):
+        return "tampered set accepted on device"
+
+
+def main():
+    quick = "--quick" in sys.argv
+    print(f"devices: {jax.devices()}  default: {jax.default_backend()}",
+          flush=True)
+    check("u32_mul", t_u32_mul)
+    check("antidiag_dot", t_antidiag_dot)
+    check("poly_mul_banded", lambda: t_poly_mul(False))
+    check("poly_mul_shift", lambda: t_poly_mul(True))
+    check("carry_normalize_fast", lambda: t_carry_normalize(True))
+    check("carry_normalize_scan", lambda: t_carry_normalize(False))
+    check("sub_with_borrow", t_sub_borrow)
+    check("mont_mul", t_mont_mul)
+    if not quick:
+        check("g1_scalar_mul", t_g1_scalar_mul)
+        check("tree_sum_fori_n8", lambda: t_tree_sum(8))
+        check("tree_sum_unrolled_n4", lambda: t_tree_sum(4))
+        check("hash_to_g2", t_hash_to_g2)
+        check("pairing_product", t_pairing_product)
+        check("end_to_end_verify", t_end_to_end)
+    print(("DIAG RESULT: all clean" if not FAILS else
+           f"DIAG RESULT: FAILURES {FAILS}"), flush=True)
+    return 1 if FAILS else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -136,6 +136,7 @@ def s4():
     import lighthouse_tpu.crypto.jaxbls.backend as jb
 
     jb._kernel_cache.clear()
+    jax.clear_caches()  # the mode decision is baked into cached traces
     backend = bls.set_backend("jax")
     sks = [bls.SecretKey(77 + i) for i in range(4)]
     pks = [sk.public_key() for sk in sks]
