@@ -242,7 +242,9 @@ def _pallas_guard(backend, sets, rands):
         dt = time.time() - t0
         log(f"  warmup/compile (XLA): {dt:.1f}s ok={ok}")
         assert ok, "warm batch failed to verify (XLA path)"
-        _MATRIX["pallas"] = "fallback-to-xla"
+        # keep the per-kernel dict schema (main() wrote it); just record
+        # that the run fell back mid-flight
+        _MATRIX["pallas_fallback"] = "fallback-to-xla"
         return dt
 
 
@@ -446,7 +448,10 @@ def main():
     # unproven kernel costs minutes of tunnel window in doomed lowering)
     from lighthouse_tpu.crypto.jaxbls import pallas_ops as _plo
 
-    _MATRIX["pallas"] = _plo.mode() or "off"
+    _MATRIX["pallas"] = {
+        k: (_plo.mode(k) or "off")
+        for k in ("prepare", "h2c", "pairs", "pairing")
+    }
 
     from lighthouse_tpu.crypto.bls import api as bls_api
 

@@ -119,7 +119,7 @@ def _stage_prepare(pk_x, pk_y, pk_mask, sig_x, sig_y, z_digits, set_mask):
     Runs as a fused Pallas kernel on a single accelerator; XLA elsewhere."""
     from . import pallas_ops
 
-    m = pallas_ops.mode()
+    m = pallas_ops.mode("prepare")
     if m is not None:
         return pallas_ops.stage_prepare_fused(
             pk_x, pk_y, pk_mask, sig_x, sig_y, z_digits, set_mask,
@@ -164,7 +164,7 @@ def _stage_pairs(z_pk, h_jac, sig_acc, set_mask):
     Runs as a fused Pallas kernel on a single accelerator; XLA elsewhere."""
     from . import pallas_ops
 
-    m = pallas_ops.mode()
+    m = pallas_ops.mode("pairs")
     if m is not None:
         return pallas_ops.stage_pairs_fused(
             z_pk, h_jac, sig_acc, set_mask, interpret=(m == "interpret")

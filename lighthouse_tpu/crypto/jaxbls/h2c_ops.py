@@ -168,7 +168,7 @@ def fq2_sgn0(a):
     s0 = std[..., 0, 0] & 1
     z0 = jnp.all(std[..., 0, :] == 0, axis=-1)
     s1 = std[..., 1, 0] & 1
-    return s0 | (jnp.asarray(z0, jnp.uint32) & s1)
+    return s0 | (lb.b2u(z0) & s1)
 
 
 def _pow_e(a):
@@ -318,7 +318,7 @@ def hash_to_g2_jacobian(us):
     (pallas_ops.hash_to_g2_fused); plain XLA elsewhere."""
     from . import pallas_ops
 
-    m = pallas_ops.mode()
+    m = pallas_ops.mode("h2c")
     if m is not None:
         return pallas_ops.hash_to_g2_fused(us, interpret=(m == "interpret"))
     us = lb.to_mont(us)

@@ -204,6 +204,15 @@ def _shiftd(x, d: int, fill=0):
     return jnp.concatenate([pad, x[..., :-d]], axis=-1)
 
 
+def b2u(b):
+    """bool -> u32 {0,1} via SELECT, never a cast: the TPU backend refuses
+    to bitcast i1 vregs to i32 (`tpu.bitcast_vreg ... Invalid vector
+    register cast`, observed compiling mont_mul on a v5e), while select on
+    an i1 predicate is native. Use this for every bool->int conversion
+    reachable from a Pallas kernel body."""
+    return jnp.where(b, jnp.uint32(1), jnp.uint32(0))
+
+
 def _prefix_carry(g, p):
     """Carry-lookahead over generate/propagate bit arrays, closed form.
 
@@ -242,8 +251,8 @@ def _prefix_carry_ks(g, p):
     (see `pallas_mode`). Composition law per round with doubling span d:
       g'[k] = g[k] | (p[k] & g[k-d]) ;  p'[k] = p[k] & p[k-d]
     with out-of-range lanes contributing no generate and no propagate."""
-    g = jnp.asarray(g, U32)
-    p = jnp.asarray(p, U32)
+    g = b2u(g)
+    p = b2u(p)
     n = g.shape[-1]
     d = 1
     while d < n:
@@ -266,9 +275,14 @@ def carry_normalize_fast(t):
     g = s >> LB                                      # in {0, 1}
     p = (s & MASK) == MASK                           # g and p never both set
     G = _prefix_carry(g != 0, p)
-    carry_in = _shiftd(G, 1, False)
-    out = (s + jnp.asarray(carry_in, U32)) & MASK
-    final = jnp.asarray(G[..., -1], U32) + hi[..., -1]
+    Gu = b2u(G)
+    carry_in = _shiftd(Gu, 1)
+    out = (s + carry_in) & MASK
+    # positive last-lane index: a NEGATIVE int index lowers via
+    # lax.dynamic_slice, which Mosaic rejects (and convert-then-index keeps
+    # the squeezed lane 32-bit — bool lanes can't be squeezed to scalars)
+    last = t.shape[-1] - 1
+    final = Gu[..., last] + hi[..., last]
     return out, final
 
 
@@ -294,10 +308,10 @@ def carry_normalize(t):
 def _sub_with_borrow_fast(a, b):
     g = a < b
     p = a == b
-    B = _prefix_carry(g, p)
-    borrow_in = jnp.asarray(_shiftd(B, 1, False), U32)
+    Bu = b2u(_prefix_carry(g, p))
+    borrow_in = _shiftd(Bu, 1)
     diff = (a - b - borrow_in) & MASK                # u32 wraparound is mod 2^16
-    return diff, jnp.asarray(B[..., -1], U32)
+    return diff, Bu[..., Bu.shape[-1] - 1]           # nonneg index: static slice
 
 
 def _sub_with_borrow(a, b):
@@ -334,8 +348,7 @@ def _shift_up_one(v):
     """v shifted one lane toward the high end (lane 0 becomes zero, the top
     lane drops): the carry-column shift in the poly products. A pad+slice —
     NOT `.at[1:].add`, whose scatter-add Mosaic cannot lower."""
-    pad = [(0, 0)] * (v.ndim - 1) + [(1, 0)]
-    return jnp.pad(v, pad)[..., :-1]
+    return _shiftd(v, 1)
 
 
 def _poly_mul_shift(a, b, ncols: int):

@@ -306,7 +306,7 @@ def pairing_product_is_one(p_aff, q_aff, valid_mask):
     reference (and the mesh-sharded multi-chip path)."""
     from . import pallas_ops
 
-    m = pallas_ops.mode()
+    m = pallas_ops.mode("pairing")
     if m is not None:
         return pallas_ops.pairing_product_is_one_fused(
             p_aff, q_aff, valid_mask, interpret=(m == "interpret")

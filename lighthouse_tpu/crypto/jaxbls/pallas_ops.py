@@ -67,30 +67,39 @@ _X_BITS_ARR = np.array([int(b) for b in bin(X_ABS)[3:]], np.int32)
 _STATUS_MEMO: list = []
 
 
-def _probed_ok() -> bool:
+def _probed_ok(kernel: str | None = None) -> bool:
     """The PALLAS_STATUS.json gate, shared by every auto-mode consumer:
     fused kernels only after scripts/probe_pallas.py has validated Mosaic
     lowering on THIS platform (the record carries str(jax.devices()) so a
-    stale file from a different chip keeps auto on the XLA path)."""
+    stale file from a different chip keeps auto on the XLA path).
+
+    With a kernel family name ("prepare"/"h2c"/"pairs"/"pairing") the
+    per-family verdict applies, so e.g. the SMEM-bits Miller/final-exp pair
+    can run fused while a scan-built stage stays on XLA."""
     if not _STATUS_MEMO:
-        ok = False
+        st = None
         try:
             import json
 
             root = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "..", "..", "..")
             with open(os.path.join(root, "PALLAS_STATUS.json")) as f:
-                st = json.load(f)
-            ok = bool(st.get("ok")) and st.get("platform") == str(jax.devices())
+                cand = json.load(f)
+            if cand.get("platform") == str(jax.devices()):
+                st = cand
         except Exception:
-            ok = False
-        _STATUS_MEMO.append(ok)
-    return _STATUS_MEMO[0]
+            st = None
+        _STATUS_MEMO.append(st or {})
+    st = _STATUS_MEMO[0]
+    if kernel is not None and isinstance(st.get("kernels"), dict):
+        return bool(st["kernels"].get(kernel))
+    return bool(st.get("ok"))
 
 
-def mode() -> str | None:
+def mode(kernel: str | None = None) -> str | None:
     """Resolve the Pallas routing mode. Returns "compile", "interpret" or
-    None (use the plain XLA path)."""
+    None (use the plain XLA path). `kernel` names the fused-kernel family
+    asking (see _probed_ok) — auto mode enables each independently."""
     env = os.environ.get("LIGHTHOUSE_TPU_PALLAS", "auto").lower()
     if env in ("off", "0", "no"):
         return None
@@ -110,7 +119,7 @@ def mode() -> str | None:
 
         if get_mesh() is not None:
             return None
-        return "compile" if _probed_ok() else None
+        return "compile" if _probed_ok(kernel) else None
     except Exception:
         return None
 
@@ -410,7 +419,28 @@ def _prepare_kernel(pbits_ref, *refs):
             co.FQ2_OPS, (sig_x, sig_y), inf_mask=jnp.logical_not(set_mask)
         )
 
-        # ONE fused double-and-add loop for both scalings (z is 64 bits)
+        # ONE fused double-and-add loop for both scalings (z is 64 bits).
+        # The bit stream rides a SHIFT REGISTER carried through the loop:
+        # Mosaic cannot lower a dynamic lane index into the loaded zd value
+        # (dynamic_slice — the first on-chip lowering failure), but static
+        # slices, shifts and the pad-based lane bump are all fine. Pack the
+        # 64 MSB-first bits into 4 16-bit limbs (little-endian limb order,
+        # bit 0 of the stream at the MSB of the top limb), then each round
+        # reads the top bit and shifts left by one.
+        nbits = zd.shape[1]
+        nwz = (nbits + lb.LB - 1) // lb.LB
+        reg = None
+        for j in range(nwz):                       # static unrolled pack
+            base = nbits - (j + 1) * lb.LB
+            limb = jnp.zeros(zd.shape[:1], jnp.uint32)
+            for t in range(lb.LB):
+                k = base + t
+                if 0 <= k < nbits:
+                    limb = limb + (zd[:, k] << (lb.LB - 1 - t))
+            limb = limb[:, None]
+            reg = limb if reg is None else jnp.concatenate([reg, limb], axis=1)
+        # reg: (n, nwz), limb nwz-1 holds the first bits to consume
+
         acc_pk = jax.tree_util.tree_map(
             lambda c, x: jnp.broadcast_to(c, x.shape), co.identity(co.FQ_OPS), aggpk
         )
@@ -418,9 +448,10 @@ def _prepare_kernel(pbits_ref, *refs):
             lambda c, x: jnp.broadcast_to(c, x.shape), co.identity(co.FQ2_OPS), sig_jac
         )
 
-        def step(i, accs):
-            acc_pk, acc_sig = accs
-            bit = zd[:, i] == 1
+        def step(_i, carry):
+            reg, acc_pk, acc_sig = carry
+            bit = (reg[:, nwz - 1] >> (lb.LB - 1)) == 1
+            reg = ((reg << 1) & lb.MASK) + lb._shift_up_one(reg >> (lb.LB - 1))
             acc_pk = co.jac_double(acc_pk, co.FQ_OPS)
             acc_pk = co.pt_select(
                 co.FQ_OPS, bit, co.jac_add(acc_pk, aggpk, co.FQ_OPS), acc_pk
@@ -429,9 +460,11 @@ def _prepare_kernel(pbits_ref, *refs):
             acc_sig = co.pt_select(
                 co.FQ2_OPS, bit, co.jac_add(acc_sig, sig_jac, co.FQ2_OPS), acc_sig
             )
-            return acc_pk, acc_sig
+            return reg, acc_pk, acc_sig
 
-        z_pk, z_sig = lax.fori_loop(0, zd.shape[1], step, (acc_pk, acc_sig))
+        _reg, z_pk, z_sig = lax.fori_loop(
+            0, nbits, step, (reg, acc_pk, acc_sig)
+        )
 
         z_sig = co.pt_select(
             co.FQ2_OPS,
@@ -446,7 +479,7 @@ def _prepare_kernel(pbits_ref, *refs):
 
         zx_ref[...], zy_ref[...], zz_ref[...] = z_pk
         sx_ref[...], sy_ref[...], sz_ref[...] = sig_acc
-        bad_ref[...] = jnp.asarray(bad, jnp.uint32).reshape(1, 1)
+        bad_ref[...] = lb.b2u(bad).reshape(1, 1)
 
 
 def stage_prepare_fused(pk_x, pk_y, pk_mask, sig_x, sig_y, z_digits, set_mask,
@@ -514,7 +547,7 @@ def _pairs_kernel(pbits_ref, *refs):
         py_ref[...] = py
         qx_ref[...] = qxx
         qy_ref[...] = qyy
-        pm_ref[...] = jnp.asarray(pair_mask, jnp.uint32)[:, None]
+        pm_ref[...] = lb.b2u(pair_mask)[:, None]
 
 
 def stage_pairs_fused(z_pk, h_jac, sig_acc, set_mask, *, interpret=False):
@@ -544,7 +577,7 @@ def stage_pairs_fused(z_pk, h_jac, sig_acc, set_mask, *, interpret=False):
     return px, py, qxx, qyy, pm[:, 0] != 0
 
 
-def _h2c_kernel(ebits_ref, xbits_ref, *refs):
+def _h2c_kernel(ebits_ref, xbits_ref, pbits_ref, *refs):
     """Fused hash-to-G2: Montgomery conversion, SSWU (incl. the 758-bit
     sqrt_ratio exponentiation), 3-isogeny, point add and psi cofactor
     clearing — one kernel launch for the whole batch."""
@@ -556,6 +589,9 @@ def _h2c_kernel(ebits_ref, xbits_ref, *refs):
     impls = {
         "POW_E": lambda a: _fq2_pow_ref(a, ebits_ref),
         ("scalar_mul_static", X_ABS): lambda p, ops: _scalar_mul_ref(p, ops, xbits_ref),
+        # any inversion inside the map (mont_inv rides Fermat) must use the
+        # SMEM-bits loop — the windowed fallback's table gather cannot lower
+        "POW_PM2": lambda a: _mont_pow_ref(a, pbits_ref),
     }
     with lb.pallas_mode(tab, impls):
         us = lb.to_mont(us_ref[...])
@@ -574,7 +610,7 @@ def hash_to_g2_fused(us, *, interpret=False):
     return pl.pallas_call(
         _h2c_kernel,
         out_shape=(out, out, out),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] * 2
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] * 3
         + _const_specs(pl, pltpu)
         + [pl.BlockSpec(memory_space=pltpu.VMEM)],
         out_specs=(
@@ -586,6 +622,7 @@ def hash_to_g2_fused(us, *, interpret=False):
     )(
         jnp.asarray(_e_bits_full()),
         jnp.asarray(_XABS_BITS_FULL),
+        jnp.asarray(_PM2_BITS),
         *_const_inputs(),
         jnp.asarray(us),
     )

@@ -234,9 +234,15 @@ def t_pairing_product():
         np.stack([lb.pack(q2[1][0]), lb.pack(q2[1][1])]),
     ])
     mask = np.ones((2,), np.uint32)
-    ok = np.asarray(
-        jax.jit(po.pairing_product_is_one)((px, py), (qx, qy), mask)
-    )
+
+    def run(a, b, c, d, m):
+        # pairing_product_is_one consumes MONTGOMERY-form affine coords
+        # (what _stage_pairs emits)
+        return po.pairing_product_is_one(
+            (lb.to_mont(a), lb.to_mont(b)), (lb.to_mont(c), lb.to_mont(d)), m
+        )
+
+    ok = np.asarray(jax.jit(run)(px, py, qx, qy, mask))
     if not bool(ok):
         return "valid pairing product != 1 on device"
 

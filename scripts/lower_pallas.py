@@ -1,0 +1,83 @@
+"""Client-side Mosaic lowering check for every fused Pallas kernel.
+
+`jax.jit(...).lower()` runs the full Mosaic pass locally WITHOUT queuing a
+remote compile, so unsupported-primitive errors (scatter-add, dynamic_slice,
+...) surface in seconds-to-minutes with no tunnel time spent and no risk of
+wedging the remote compile queue. Use this loop to iterate on kernel-body
+rewrites; scripts/probe_pallas.py then proves compile+execution on-chip.
+
+Usage: python scripts/lower_pallas.py [prepare|h2c|pairs|miller|hard|all]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ["LIGHTHOUSE_TPU_PALLAS"] = "on"
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto.jaxbls import limbs as lb, tower as tw
+from lighthouse_tpu.crypto.jaxbls import pallas_ops as plo
+
+n, m = 4, 4
+
+
+def args_prepare():
+    return (
+        np.zeros((n, m, lb.NL), np.uint32), np.zeros((n, m, lb.NL), np.uint32),
+        np.zeros((n, m), np.uint32), np.zeros((n, 2, lb.NL), np.uint32),
+        np.zeros((n, 2, lb.NL), np.uint32), np.zeros((n, 64), np.uint32),
+        np.zeros((n,), np.uint32),
+    )
+
+
+def args_pairs():
+    fq = np.zeros((n, lb.NL), np.uint32)
+    fq2 = np.zeros((n, 2, lb.NL), np.uint32)
+    one2 = np.zeros((2, lb.NL), np.uint32)
+    return ((fq, fq, fq), (fq2, fq2, fq2), (one2, one2, one2),
+            np.zeros((n,), np.uint32))
+
+
+CASES = {
+    "prepare": lambda: jax.jit(plo.stage_prepare_fused).lower(*args_prepare()),
+    "h2c": lambda: jax.jit(plo.hash_to_g2_fused).lower(
+        np.zeros((n, 2, 2, lb.NL), np.uint32)
+    ),
+    "pairs": lambda: jax.jit(plo.stage_pairs_fused).lower(*args_pairs()),
+    "miller": lambda: jax.jit(plo.miller_loop_product_fused).lower(
+        (np.zeros((2, lb.NL), np.uint32), np.zeros((2, lb.NL), np.uint32)),
+        (np.zeros((2, 2, lb.NL), np.uint32), np.zeros((2, 2, lb.NL), np.uint32)),
+        np.ones((2,), bool),
+    ),
+    "hard": lambda: jax.jit(plo.final_exp_hard_part_fused).lower(
+        np.zeros(tw.FQ12_ONE.shape, np.uint32)
+    ),
+}
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    names = list(CASES) if which == "all" else [which]
+    bad = []
+    for name in names:
+        t0 = time.time()
+        try:
+            CASES[name]()
+            print(f"LOWER OK   {name} ({time.time()-t0:.1f}s)", flush=True)
+        except Exception as e:  # noqa: BLE001
+            msg = str(e).split("\n")[0][:300]
+            print(f"LOWER FAIL {name} ({time.time()-t0:.1f}s): "
+                  f"{type(e).__name__}: {msg}", flush=True)
+            bad.append(name)
+    print("RESULT:", "all lower" if not bad else f"failing: {bad}", flush=True)
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -153,21 +153,111 @@ def s4():
     assert not backend.verify_signature_sets(bad, rands), "tampered batch accepted"
 
 
-ok = stage("s0 trivial", s0)
-ok = ok and stage("s1 mont_mul", s1)
-ok = ok and stage("s2 miller fused", s2)
-ok = ok and stage("s3 hard part fused", s3)
-ok = ok and stage("s4 all-stage verify fused", s4)
+def _example_prepare_args():
+    from __graft_entry__ import _example_inputs
+
+    pk_x, pk_y, pk_mask, sig_x, sig_y, us, z_digits, set_mask = _example_inputs(
+        n_sets=4, n_pks=2
+    )
+    return (pk_x, pk_y, pk_mask, sig_x, sig_y, z_digits, set_mask), us
+
+
+def _xla_ref(fn, *args):
+    """Trace+run fn with the XLA (non-pallas) path, restoring the env."""
+    import os
+
+    prev = os.environ.get("LIGHTHOUSE_TPU_PALLAS")
+    os.environ["LIGHTHOUSE_TPU_PALLAS"] = "off"
+    try:
+        jax.clear_caches()
+        return jax.jit(fn)(*args)
+    finally:
+        if prev is None:
+            os.environ.pop("LIGHTHOUSE_TPU_PALLAS", None)
+        else:
+            os.environ["LIGHTHOUSE_TPU_PALLAS"] = prev
+        jax.clear_caches()
+
+
+_XLA_REFS: dict = {}
+
+
+def _stage_refs():
+    """Compute the XLA reference outputs ONCE and share them across s_prep /
+    s_h2c / s_pairs — each _xla_ref call clears the trace caches and the
+    prepare/h2c compiles are the expensive ones; tunnel windows are scarce."""
+    if not _XLA_REFS:
+        import lighthouse_tpu.crypto.jaxbls.backend as jb
+        from lighthouse_tpu.crypto.jaxbls import h2c_ops as h2
+
+        jb._init_consts()
+        args, us = _example_prepare_args()
+        prep = _xla_ref(jb._stage_prepare, *args)
+        h_jac = _xla_ref(h2.hash_to_g2_jacobian, us)
+        z_pk, sig_acc, _bad = prep
+        pairs = _xla_ref(jb._stage_pairs, z_pk, h_jac, sig_acc, args[-1])
+        _XLA_REFS.update(args=args, us=us, prep=prep, h_jac=h_jac, pairs=pairs)
+    return _XLA_REFS
+
+
+def _assert_trees_equal(want, got, what):
+    for w, g in zip(jax.tree_util.tree_leaves(want), jax.tree_util.tree_leaves(got)):
+        assert (np.asarray(w) == np.asarray(g)).all(), f"{what} mismatch"
+
+
+def s_prep():
+    refs = _stage_refs()
+    got = plo.stage_prepare_fused(*refs["args"])
+    _assert_trees_equal(refs["prep"], got, "prepare")
+
+
+def s_h2c():
+    refs = _stage_refs()
+    got = plo.hash_to_g2_fused(jnp.asarray(refs["us"]))
+    _assert_trees_equal(refs["h_jac"], got, "h2c")
+
+
+def s_pairs():
+    refs = _stage_refs()
+    z_pk, sig_acc, _bad = refs["prep"]
+    got = plo.stage_pairs_fused(z_pk, refs["h_jac"], sig_acc, refs["args"][-1])
+    _assert_trees_equal(refs["pairs"], got, "pairs")
+
+
+kernels = {}
+base = stage("s0 trivial", s0)
+base = base and stage("s1 mont_mul", s1)
+if base:
+    # per-kernel verdicts: auto mode enables each fused kernel family
+    # independently (pallas_ops.mode(kernel=...)). The Miller/final-exp
+    # pair carries most of the FLOPs, and its SMEM-bits loops lower where
+    # the scan-built prepare/h2c/pairs bodies may not.
+    kernels["prepare"] = stage("s_prep prepare fused", s_prep)
+    kernels["h2c"] = stage("s_h2c hash-to-g2 fused", s_h2c)
+    kernels["pairs"] = stage("s_pairs pair-assembly fused", s_pairs)
+    kernels["pairing"] = stage("s2 miller fused", s2) and stage(
+        "s3 hard part fused", s3
+    )
+else:
+    kernels = {k: False for k in ("prepare", "h2c", "pairs", "pairing")}
+ok = base and all(kernels.values()) and stage("s4 all-stage verify fused", s4)
 
 # Record the verdict for other entry points (__graft_entry__, operators):
-# "ok" means Mosaic compiled + bit-validated every fused kernel on THIS
-# platform; anything else keeps auto-mode consumers on the XLA path.
+# "ok" means Mosaic compiled + bit-validated EVERY fused kernel on THIS
+# platform; "kernels" carries the per-family verdicts for partial enable.
 import json
 
 import pathlib
 
 with open(pathlib.Path(__file__).resolve().parent.parent / "PALLAS_STATUS.json", "w") as f:
-    json.dump({"ok": bool(ok), "platform": str(jax.devices())}, f)
+    json.dump(
+        {"ok": bool(ok), "kernels": {k: bool(v) for k, v in kernels.items()},
+         # verdicts come from toy shapes; production shapes compile their own
+         # specialization and _pallas_guard remains the runtime belt
+         "probed_shape": {"n_sets": 4, "n_pks": 2},
+         "platform": str(jax.devices())},
+        f,
+    )
 
-print("PALLAS PROBE:", "ALL OK" if ok else "FAILED", flush=True)
+print("PALLAS PROBE:", "ALL OK" if ok else f"PARTIAL/FAILED {kernels}", flush=True)
 sys.exit(0 if ok else 1)
