@@ -428,15 +428,17 @@ def _prepare_kernel(pbits_ref, *refs):
         # bit 0 of the stream at the MSB of the top limb), then each round
         # reads the top bit and shifts left by one.
         nbits = zd.shape[1]
-        nwz = (nbits + lb.LB - 1) // lb.LB
+        assert nbits % lb.LB == 0, (
+            "shift-register packer needs LB-aligned bit counts (a partial "
+            "top limb would be consumed as leading zero padding)"
+        )
+        nwz = nbits // lb.LB
         reg = None
         for j in range(nwz):                       # static unrolled pack
             base = nbits - (j + 1) * lb.LB
             limb = jnp.zeros(zd.shape[:1], jnp.uint32)
             for t in range(lb.LB):
-                k = base + t
-                if 0 <= k < nbits:
-                    limb = limb + (zd[:, k] << (lb.LB - 1 - t))
+                limb = limb + (zd[:, base + t] << (lb.LB - 1 - t))
             limb = limb[:, None]
             reg = limb if reg is None else jnp.concatenate([reg, limb], axis=1)
         # reg: (n, nwz), limb nwz-1 holds the first bits to consume

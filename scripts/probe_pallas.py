@@ -201,8 +201,12 @@ def _stage_refs():
 
 
 def _assert_trees_equal(want, got, what):
-    for w, g in zip(jax.tree_util.tree_leaves(want), jax.tree_util.tree_leaves(got)):
-        assert (np.asarray(w) == np.asarray(g)).all(), f"{what} mismatch"
+    wl = jax.tree_util.tree_leaves(want)
+    gl = jax.tree_util.tree_leaves(got)
+    assert len(wl) == len(gl), f"{what}: leaf count {len(gl)} != {len(wl)}"
+    for w, g in zip(wl, gl):
+        # array_equal: shape-strict (a broadcasting == could pass wrong shapes)
+        assert np.array_equal(np.asarray(w), np.asarray(g)), f"{what} mismatch"
 
 
 def s_prep():
