@@ -51,7 +51,8 @@ class _Ops:
 
 
 def _fq_select(cond, a, b):
-    return jnp.where(cond[..., None], a, b)
+    # 32-bit reshape, then compare (i1 minor-dim inserts don't lower)
+    return jnp.where(lb.b2u(cond)[..., None] == 1, a, b)
 
 
 FQ_OPS = _Ops(

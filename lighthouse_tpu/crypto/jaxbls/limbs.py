@@ -339,8 +339,11 @@ def _cond_sub_n(t):
     n_ext = kernel_const("NEXT", N_EXT_HOST)
     n_b = jnp.broadcast_to(n_ext, t.shape)
     diff, borrow = _sub_with_borrow(t, n_b)
-    keep = (borrow == 1)
-    out = jnp.where(keep[..., None], t, diff)
+    # reshape the u32 borrow, then compare: reshaping a BOOL (i1) vector
+    # with a new unit minor dim is rejected by the chip compiler
+    # ("Insertion of minor dim that is not a no-op only supported for
+    # 32-bit types"), while the compare emits the i1 in its final layout
+    out = jnp.where(borrow[..., None] == 1, t, diff)
     return out[..., :NL]
 
 
@@ -482,7 +485,7 @@ def sub_mod(a, b):
     fixed = jnp.concatenate([fixed, jnp.zeros(fixed.shape[:-1] + (1,), U32)], axis=-1)
     fixed, _ = carry_normalize(fixed)
     fixed = fixed[..., :NL]
-    return jnp.where((borrow == 1)[..., None], fixed, diff)
+    return jnp.where(borrow[..., None] == 1, fixed, diff)  # u32 reshape, then i1
 
 
 def neg_mod(a):
@@ -505,7 +508,7 @@ def _cond_sub_n_ext(t):
     """One conditional subtract of N on an (NL+1)-limb value; keeps NL+1 limbs."""
     n_ext = jnp.broadcast_to(kernel_const("NEXT", N_EXT_HOST), t.shape)
     diff, borrow = _sub_with_borrow(t, n_ext)
-    return jnp.where((borrow == 1)[..., None], t, diff)
+    return jnp.where(borrow[..., None] == 1, t, diff)  # u32 reshape, then i1
 
 
 def mul_small(a, k: int):

@@ -119,15 +119,20 @@ def fq2_inv(a):
 
 
 def fq2_is_zero(a):
-    return jnp.all(a == 0, axis=(-1, -2))
+    # chained single-axis reductions: Mosaic's vector.multi_reduction over
+    # BOTH trailing dims is unimplemented unless the result keeps a unit
+    # trailing axis (observed compiling the fused h2c kernel on a v5e)
+    return jnp.all(jnp.all(a == 0, axis=-1), axis=-1)
 
 
 def fq2_eq(a, b):
-    return jnp.all(a == b, axis=(-1, -2))
+    return jnp.all(jnp.all(a == b, axis=-1), axis=-1)
 
 
 def fq2_select(cond, a, b):
-    return jnp.where(cond[..., None, None], a, b)
+    # reshape the condition in 32-bit, compare last (i1 minor-dim inserts
+    # are rejected by the chip compiler)
+    return jnp.where(lb.b2u(cond)[..., None, None] == 1, a, b)
 
 
 # ----------------------------------------------------------------- Fq6
@@ -312,7 +317,10 @@ def fq12_inv(a):
 
 def fq12_eq_one(a):
     one = jnp.broadcast_to(FQ12_ONE, a.shape)
-    return jnp.all(a == one, axis=(-1, -2, -3, -4))
+    eqs = a == one
+    for _ in range(4):                       # chained single-axis alls
+        eqs = jnp.all(eqs, axis=-1)
+    return eqs
 
 
 def fq12_select(cond, a, b):

@@ -26,7 +26,6 @@ setup_compilation_cache()
 import numpy as np
 import jax
 
-sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 os.chdir(os.path.join(os.path.dirname(__file__), ".."))
 
 from bench import _load_fixtures
@@ -65,8 +64,15 @@ def main():
         print("NO REPRODUCTION — device agrees with host", flush=True)
         return 0
 
-    # ---- stage bisect at the exact (4, 128) bucket ----
-    n, m = 4, max(be.MIN_PKS, be._next_pow2(len(s.signing_keys)))
+    # ---- stage bisect at the same bucket the real path uses ----
+    # pad_sets/pad_pks make this match verify_signature_sets' bucket math;
+    # NOTE on a multi-device VM the real path additionally mesh-shards its
+    # inputs (parallel.put_sets) — this bisect runs unsharded, so a
+    # mesh-layout-specific divergence can reproduce verbatim but not here.
+    from lighthouse_tpu.parallel import pad_pks, pad_sets
+
+    n = pad_sets(max(be.MIN_SETS, be._next_pow2(1)))
+    m = pad_pks(max(be.MIN_PKS, be._next_pow2(len(s.signing_keys))))
     print(f"bisecting at bucket n={n} m={m}", flush=True)
     pk_x, pk_y, pk_mask = backend._marshal_pubkeys([s], n, m)
     sig_x = np.zeros((n, 2, lb.NL), np.uint32)
@@ -109,16 +115,16 @@ def main():
           flush=True)
 
     # final pair: (-G1gen, sig_acc) with sig_acc == 1 * sig
-    got_p4 = aff_int(px[4], py[4])
+    got_p4 = aff_int(px[n], py[n])
     ng = pc.g1_neg(pc.G1_GEN)
-    print(f"pair4 G1 is -G1gen: {got_p4 == ng}", flush=True)
-    got_q4x = (lb.unpack(np.asarray(jax.jit(lb.from_mont)(qxx[4, 0]))),
-               lb.unpack(np.asarray(jax.jit(lb.from_mont)(qxx[4, 1]))))
-    got_q4y = (lb.unpack(np.asarray(jax.jit(lb.from_mont)(qyy[4, 0]))),
-               lb.unpack(np.asarray(jax.jit(lb.from_mont)(qyy[4, 1]))))
-    print(f"pair4 G2 is the signature: {(got_q4x, got_q4y) == (sp[0], sp[1])}",
+    print(f"sig-pair G1 is -G1gen: {got_p4 == ng}", flush=True)
+    got_q4x = (lb.unpack(np.asarray(jax.jit(lb.from_mont)(qxx[n, 0]))),
+               lb.unpack(np.asarray(jax.jit(lb.from_mont)(qxx[n, 1]))))
+    got_q4y = (lb.unpack(np.asarray(jax.jit(lb.from_mont)(qyy[n, 0]))),
+               lb.unpack(np.asarray(jax.jit(lb.from_mont)(qyy[n, 1]))))
+    print(f"sig-pair G2 is the signature: {(got_q4x, got_q4y) == (sp[0], sp[1])}",
           flush=True)
-    want_mask = [True, False, False, False, True]
+    want_mask = [True] + [False] * (n - 1) + [True]
     print(f"pair_mask expected {want_mask} got {list(np.asarray(pair_mask) != 0)}",
           flush=True)
     return 1
