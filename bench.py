@@ -266,7 +266,12 @@ def run_headline(backend, fx, rng):
     from lighthouse_tpu.crypto import bls
 
     n_att, n_pks = fx["meta"]["n_att"], fx["meta"]["n_pks"]
-    n_sets = n_att // 2
+    # batch the full fixture width: per-batch wall time is nearly batch-
+    # size-invariant (one fq12_sqr per x-bit and one final exp per BATCH,
+    # sequential chains are in bits not sets), so throughput scales with
+    # width — measured on the v5e: 64->100, 128->187, 256->249, 512->308
+    # sets/s (docs/PERF_NOTES.md batch-size scaling)
+    n_sets = n_att
     _HEADLINE["shape"] = (n_sets, n_pks)
     log(f"[config 5] gossip firehose {n_sets}x{n_pks}")
     sets = fx["att"][:n_sets]
@@ -362,7 +367,12 @@ def run_full_block(backend, fx, rng):
     fixture double-counted 64 sets twice; these are 128 independent key
     groups with distinct messages — scripts/gen_bench_fixtures.py)."""
     log("[config 2] full-block multi-set + p99 block latency")
-    block_sets = fx["small"] + fx["att"] + fx["sync"]
+    # a full block carries 128 attestations — always the FIRST 128 fixture
+    # sets, independent of how wide the headline fixture is
+    assert _SMOKE or len(fx["att"]) >= 128, (
+        "config 2 needs >= 128 fixture sets (gen_bench_fixtures --n-att)"
+    )
+    block_sets = fx["small"] + fx["att"][:128] + fx["sync"]
     rands = _rands(rng, len(block_sets))
     assert backend.verify_signature_sets(block_sets, rands)
     samples = []
@@ -448,8 +458,14 @@ def main():
     # unproven kernel costs minutes of tunnel window in doomed lowering)
     from lighthouse_tpu.crypto.jaxbls import pallas_ops as _plo
 
+    # the auto gate is size-aware: record the routing at BOTH the urgent
+    # bucket (n=4) and the headline width, so the matrix never attributes a
+    # wide-batch number to fused kernels the gate actually routed to XLA
     _MATRIX["pallas"] = {
-        k: (_plo.mode(k) or "off")
+        k: {
+            "small_bucket": _plo.mode(k, n=4) or "off",
+            "headline": _plo.mode(k, n=512) or "off",
+        }
         for k in ("prepare", "h2c", "pairs", "pairing")
     }
 

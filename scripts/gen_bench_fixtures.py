@@ -204,6 +204,11 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="tiny shapes variant")
     ap.add_argument("--out", default=None)
+    ap.add_argument(
+        "--n-att", type=int, default=512,
+        help="distinct attestation-style sets (headline batches all of "
+        "them; config 2 always takes the first 128 for its 131-set block)",
+    )
     args = ap.parse_args()
 
     from lighthouse_tpu.crypto import bls
@@ -213,7 +218,7 @@ def main():
         n_att, n_pks, sync_pks, kzg_n, kzg_blobs = 4, 4, 8, 8, 2
         out = args.out or "bench_fixtures_smoke.npz"
     else:
-        n_att, n_pks, sync_pks, kzg_n, kzg_blobs = 128, 128, 512, 4096, 6
+        n_att, n_pks, sync_pks, kzg_n, kzg_blobs = args.n_att, 128, 512, 4096, 6
         out = args.out or "bench_fixtures.npz"
 
     rng = random.Random(SEED)
