@@ -89,16 +89,31 @@ def _batched_affine(z_pk, h_jac, sig_acc):
     n = Zp.shape[0]
 
     def embed(fq):             # (n, NL) -> (n, 2, NL)
-        return jnp.stack([fq, jnp.zeros_like(fq)], axis=-2)
+        return lb.kstack([fq, jnp.zeros_like(fq)], axis=-2)
 
-    zs = jnp.concatenate([embed(Zp), Zh, Zs[None]], axis=0)     # (2n+1, 2, NL)
-    zinv = tw.fq2_inv(zs)
-    zinv2 = tw.fq2_sqr(zinv)
-    zinv3 = tw.fq2_mul(zinv2, zinv)
+    if lb._pallas_tracing():
+        # equal-extent 3-stack (3, n, 2, NL): the ragged (2n+1) concat would
+        # unroll one select per slab in the kernel body; the sig Z broadcast
+        # to n lanes wastes n-1 inversion lanes but keeps the Fermat chain
+        # single and the assembly three selects
+        zs = lb.kstack(
+            [embed(Zp), Zh, jnp.broadcast_to(Zs[None], Zh.shape)], axis=0
+        )
+        zinv = tw.fq2_inv(zs)
+        zinv2 = tw.fq2_sqr(zinv)
+        zinv3 = tw.fq2_mul(zinv2, zinv)
+        pk_i2, pk_i3 = zinv2[0, :, 0, :], zinv3[0, :, 0, :]     # Fq lanes
+        h_i2, h_i3 = zinv2[1], zinv3[1]
+        s_i2, s_i3 = zinv2[2, 0], zinv3[2, 0]
+    else:
+        zs = jnp.concatenate([embed(Zp), Zh, Zs[None]], axis=0)  # (2n+1, 2, NL)
+        zinv = tw.fq2_inv(zs)
+        zinv2 = tw.fq2_sqr(zinv)
+        zinv3 = tw.fq2_mul(zinv2, zinv)
 
-    pk_i2, pk_i3 = zinv2[:n, 0, :], zinv3[:n, 0, :]             # Fq lanes
-    h_i2, h_i3 = zinv2[n : 2 * n], zinv3[n : 2 * n]
-    s_i2, s_i3 = zinv2[2 * n], zinv3[2 * n]
+        pk_i2, pk_i3 = zinv2[:n, 0, :], zinv3[:n, 0, :]         # Fq lanes
+        h_i2, h_i3 = zinv2[n : 2 * n], zinv3[n : 2 * n]
+        s_i2, s_i3 = zinv2[2 * n], zinv3[2 * n]
 
     px = lb.mont_mul(Xp, pk_i2)
     py = lb.mont_mul(Yp, pk_i3)
@@ -119,7 +134,7 @@ def _stage_prepare(pk_x, pk_y, pk_mask, sig_x, sig_y, z_digits, set_mask):
     Runs as a fused Pallas kernel on a single accelerator; XLA elsewhere."""
     from . import pallas_ops
 
-    m = pallas_ops.mode("prepare")
+    m = pallas_ops.mode("prepare", n=pk_x.shape[0])
     if m is not None:
         return pallas_ops.stage_prepare_fused(
             pk_x, pk_y, pk_mask, sig_x, sig_y, z_digits, set_mask,
@@ -164,7 +179,7 @@ def _stage_pairs(z_pk, h_jac, sig_acc, set_mask):
     Runs as a fused Pallas kernel on a single accelerator; XLA elsewhere."""
     from . import pallas_ops
 
-    m = pallas_ops.mode("pairs")
+    m = pallas_ops.mode("pairs", n=z_pk[0].shape[0])
     if m is not None:
         return pallas_ops.stage_pairs_fused(
             z_pk, h_jac, sig_acc, set_mask, interpret=(m == "interpret")

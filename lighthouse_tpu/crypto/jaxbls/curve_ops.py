@@ -93,7 +93,7 @@ def _stk(ops, *els):
     uses for fq6/fq12)."""
     axis = -1 if ops is FQ_OPS else -2
     axis -= 1
-    return jnp.stack(els, axis=axis)
+    return lb.kstack(els, axis=axis)
 
 
 def _lanes(ops, stacked, k):

@@ -202,14 +202,17 @@ def fq2_sqrt_ratio(u, v):
     is_qr = jnp.any(ok_qr, axis=-1)
 
     # first matching candidate via 8 unrolled masked selects (argmax +
-    # take_along_axis lowered to a gather, which Mosaic rejects in kernels)
-    ok = jnp.concatenate([ok_qr, ok_nqr], axis=-1)            # (..., 8)
+    # take_along_axis lowered to a gather, which Mosaic rejects in kernels);
+    # the candidate flags concat as u32 — an i1 vector concat is a vreg
+    # re-layout the chip compiler refuses
+    ok = lb.kconcat([lb.b2u(ok_qr), lb.b2u(ok_nqr)], axis=-1)  # (..., 8)
     y = jnp.zeros_like(u)
     found = jnp.zeros(ok.shape[:-1], bool)
     for i in range(8):
-        sel = jnp.logical_and(ok[..., i], jnp.logical_not(found))
+        ok_i = ok[..., i] == 1
+        sel = jnp.logical_and(ok_i, jnp.logical_not(found))
         y = tw.fq2_select(sel, ys[..., i, :, :], y)
-        found = jnp.logical_or(found, ok[..., i])
+        found = jnp.logical_or(found, ok_i)
     return is_qr, y
 
 
@@ -254,7 +257,7 @@ def iso_map_jacobian(xn, xd, y):
     the shared monomial vector [xd^3, xn*xd^2, xn^2*xd, xn^3]."""
     xd2 = tw.fq2_sqr(xd)
     xn2 = tw.fq2_sqr(xn)
-    m = jnp.stack(
+    m = lb.kstack(
         [
             tw.fq2_mul(xd2, xd),
             tw.fq2_mul(xn, xd2),
@@ -283,7 +286,7 @@ def iso_map_jacobian(xn, xd, y):
 def map_to_g2(u0, u1):
     """Device: two field elements per message -> Jacobian point in G2
     (SSWU + isogeny on both, add, clear cofactor). u0/u1: (..., 2, NL)."""
-    us = jnp.stack([u0, u1], axis=0)          # map both in one batched pass
+    us = lb.kstack([u0, u1], axis=0)          # map both in one batched pass
     xn, xd, y = sswu_projective(us)
     q = iso_map_jacobian(xn, xd, y)
     q0 = jax.tree_util.tree_map(lambda c: c[0], q)
@@ -318,7 +321,7 @@ def hash_to_g2_jacobian(us):
     (pallas_ops.hash_to_g2_fused); plain XLA elsewhere."""
     from . import pallas_ops
 
-    m = pallas_ops.mode("h2c")
+    m = pallas_ops.mode("h2c", n=us.shape[0])
     if m is not None:
         return pallas_ops.hash_to_g2_fused(us, interpret=(m == "interpret"))
     us = lb.to_mont(us)

@@ -201,7 +201,7 @@ def _scan_last(f, init, xs):
 def _shiftd(x, d: int, fill=0):
     """Shift limbs toward higher indices by d positions along the last axis."""
     pad = jnp.full(x.shape[:-1] + (d,), fill, x.dtype)
-    return jnp.concatenate([pad, x[..., :-d]], axis=-1)
+    return _concat_last([pad, x[..., :-d]])
 
 
 def b2u(b):
@@ -211,6 +211,98 @@ def b2u(b):
     an i1 predicate is native. Use this for every bool->int conversion
     reachable from a Pallas kernel body."""
     return jnp.where(b, jnp.uint32(1), jnp.uint32(0))
+
+
+def _canon(x):
+    """Force an offset-{0,0} vreg layout (Pallas kernel bodies only).
+
+    tpu.concatenate requires operand layouts to AGREE on non-concat
+    dimensions, and upstream component slices (a[..., 1, :], shift slices)
+    leave residual sublane/lane offsets — every carry-column append then
+    dies with "offset mismatch on non-concat dimension" (observed on a
+    v5e for add_mod/_shiftd inside the fused kernels while the same code
+    compiled standalone). An always-true iota-predicate select is one the
+    compiler keeps, and its result inherits the iota's zero-offset layout;
+    verified on-chip: the canonicalized form compiles and runs bit-exact
+    where the raw concat is rejected (scripts/repro in docs/PERF_NOTES.md
+    round-5 notes)."""
+    if not _pallas_tracing():
+        return x
+    idx = lax.broadcasted_iota(jnp.uint32, x.shape, x.ndim - 1)
+    return jnp.where(idx < jnp.uint32(x.shape[-1]), x, jnp.zeros_like(x))
+
+
+def _concat_last(pieces):
+    """Minor-axis concatenate with canonicalized operand layouts. Bool
+    pieces concat as u32 (an i1 vector concat is a vreg re-layout the chip
+    compiler refuses) and convert back."""
+    if not _pallas_tracing():
+        return jnp.concatenate(pieces, axis=-1)
+    isbool = pieces[0].dtype == jnp.bool_
+    if isbool:
+        pieces = [b2u(p) for p in pieces]
+    out = jnp.concatenate([_canon(p) for p in pieces], axis=-1)
+    return out != 0 if isbool else out
+
+
+def _select_assemble(units, ax: int):
+    """Assemble unit-extent slabs along axis `ax` via broadcast + iota-
+    compare selects. units: arrays all of extent 1 along ax, identical
+    elsewhere. Every op here (expand of a unit dim on u32, broadcast,
+    iota, select) has a clean Mosaic lowering — unlike tpu.concatenate,
+    which rejects operands whose vreg offsets differ on non-concat
+    dimensions (observed on a v5e: the tower's minor-dim component stacks,
+    vector<1x4x1x24xi32> x7 -> vector<1x4x7x24xi32>, "result/input offset
+    mismatch on non-concat dimension")."""
+    k = len(units)
+    u0 = units[0]
+    out_shape = u0.shape[:ax] + (k,) + u0.shape[ax + 1 :]
+    isbool = u0.dtype == jnp.bool_
+    if isbool:
+        units = [b2u(u) for u in units]
+    idx = lax.broadcasted_iota(jnp.uint32, out_shape, ax)
+    acc = jnp.broadcast_to(units[0], out_shape)
+    for i in range(1, k):
+        acc = jnp.where(idx == jnp.uint32(i), units[i], acc)
+    return acc != 0 if isbool else acc
+
+
+def kstack(arrays, axis=0):
+    """jnp.stack that also lowers inside Pallas kernel bodies.
+
+    Outside pallas tracing this IS jnp.stack. Inside, non-minor-axis
+    stacks become select assemblies (see _select_assemble); minor-axis
+    (lane-dim) concatenation lowers fine and keeps the jnp form."""
+    arrays = [jnp.asarray(a) for a in arrays]
+    if not _pallas_tracing():
+        return jnp.stack(arrays, axis=axis)
+    nd = arrays[0].ndim + 1
+    ax = axis % nd
+    units = [jnp.expand_dims(a, ax) for a in arrays]
+    if ax == nd - 1:
+        return _concat_last(units)
+    return _select_assemble(units, ax)
+
+
+def kconcat(arrays, axis=0):
+    """jnp.concatenate that also lowers inside Pallas kernel bodies.
+
+    Non-minor-axis concats are decomposed into unit-extent static slices
+    and select-assembled. Callers keep pieces small along the concat axis
+    (the verify kernels concat 2-9 components); a wide piece would unroll
+    one select per slab."""
+    arrays = [jnp.asarray(a) for a in arrays]
+    nd = arrays[0].ndim
+    ax = axis % nd
+    if not _pallas_tracing():
+        return jnp.concatenate(arrays, axis=axis)
+    if ax == nd - 1:
+        return _concat_last(arrays)
+    units = []
+    for a in arrays:
+        for i in range(a.shape[ax]):
+            units.append(lax.slice_in_dim(a, i, i + 1, axis=ax))
+    return _select_assemble(units, ax)
 
 
 def _prefix_carry(g, p):
@@ -361,6 +453,7 @@ def _poly_mul_shift(a, b, ncols: int):
     Mosaic. 8-bit split of `a` keeps every partial sum < 2^31."""
     na = a.shape[-1]
     nb = b.shape[-1]
+    b = _canon(b)            # pad slices below concat against fresh zeros
     a_lo = a & 0xFF
     a_hi = a >> 8
     zero = jnp.zeros(jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1]) + (ncols,), U32)
@@ -473,7 +566,7 @@ def mont_sqr(a):
 
 def add_mod(a, b):
     s = a + b                                          # ≤ 2^17 per limb
-    s = jnp.concatenate([s, jnp.zeros(s.shape[:-1] + (1,), U32)], axis=-1)
+    s = _concat_last([s, jnp.zeros(s.shape[:-1] + (1,), U32)])
     s, _ = carry_normalize(s)
     return _cond_sub_n(s)
 
@@ -482,7 +575,7 @@ def sub_mod(a, b):
     diff, borrow = _sub_with_borrow(a, b)
     n_arr = jnp.broadcast_to(kernel_const("N", N_HOST), diff.shape)
     fixed = diff + n_arr                               # ≤ 2^17 per limb
-    fixed = jnp.concatenate([fixed, jnp.zeros(fixed.shape[:-1] + (1,), U32)], axis=-1)
+    fixed = _concat_last([fixed, jnp.zeros(fixed.shape[:-1] + (1,), U32)])
     fixed, _ = carry_normalize(fixed)
     fixed = fixed[..., :NL]
     return jnp.where(borrow[..., None] == 1, fixed, diff)  # u32 reshape, then i1
@@ -517,8 +610,8 @@ def mul_small(a, k: int):
     p = a * np.uint32(k)                               # ≤ 2^31
     lo = p & MASK
     hi = p >> LB
-    acc = jnp.concatenate([lo, jnp.zeros(lo.shape[:-1] + (1,), U32)], axis=-1)
-    acc = acc + jnp.pad(hi, [(0, 0)] * (hi.ndim - 1) + [(1, 0)])
+    acc = _concat_last([lo, jnp.zeros(lo.shape[:-1] + (1,), U32)])
+    acc = acc + _concat_last([jnp.zeros(hi.shape[:-1] + (1,), U32), hi])
     acc, _ = carry_normalize(acc)                      # value < k*P, NL+1 limbs
     for _ in range(k - 1):
         acc = _cond_sub_n_ext(acc)

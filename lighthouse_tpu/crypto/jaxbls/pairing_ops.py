@@ -93,9 +93,9 @@ def _add_step(r, q_aff, xp, yp):
 def _line_to_fq12(line):
     l0, l1, l2 = line
     z = jnp.zeros_like(l0)
-    c0 = jnp.stack([l0, l1, z], axis=-3)
-    c1 = jnp.stack([z, l2, z], axis=-3)
-    return jnp.stack([c0, c1], axis=-4)
+    c0 = lb.kstack([l0, l1, z], axis=-3)
+    c1 = lb.kstack([z, l2, z], axis=-3)
+    return lb.kstack([c0, c1], axis=-4)
 
 
 def _mul_by_line(f, line):
@@ -110,11 +110,11 @@ def _line_mul_line(la, lb_):
     6 Fq2 products (one batched fq2_mul) via Karatsuba cross terms."""
     l0, l1, l2 = la
     m0, m1, m2 = lb_
-    A = jnp.stack(
+    A = lb.kstack(
         [l0, l1, l2, tw.fq2_add(l0, l1), tw.fq2_add(l0, l2), tw.fq2_add(l1, l2)],
         axis=-3,
     )
-    B = jnp.stack(
+    B = lb.kstack(
         [m0, m1, m2, tw.fq2_add(m0, m1), tw.fq2_add(m0, m2), tw.fq2_add(m1, m2)],
         axis=-3,
     )
@@ -127,23 +127,34 @@ def _line_mul_line(la, lb_):
     c10 = jnp.zeros_like(p00)
     c11 = tw.fq2_sub(tw.fq2_sub(s02, p00), p22)
     c12 = tw.fq2_sub(tw.fq2_sub(s12, p11), p22)
-    lo = jnp.stack([c00, c01, c02], axis=-3)
-    hi = jnp.stack([c10, c11, c12], axis=-3)
-    return jnp.stack([lo, hi], axis=-4)
+    lo = lb.kstack([c00, c01, c02], axis=-3)
+    hi = lb.kstack([c10, c11, c12], axis=-3)
+    return lb.kstack([lo, hi], axis=-4)
+
+
+def _set_lane0(fs, folded):
+    """fs with lane 0 replaced by `folded` (unit leading axis).
+
+    Keeps tree reductions concat-free: instead of carrying an odd leftover
+    lane to the next level (a leading-axis concatenate Mosaic cannot
+    re-layout), the straggler is multiplied into lane 0 and planted via an
+    iota select. Field products are exact mod P, so the association change
+    is bit-invisible."""
+    idx = lax.broadcasted_iota(jnp.uint32, fs.shape, 0)
+    return jnp.where(idx == 0, folded, fs)
 
 
 def fq12_product_any(fs):
-    """Tree product over the first axis, any length >= 1 (odd leftovers are
-    carried to the next level)."""
+    """Tree product over the first axis, any length >= 1 (odd stragglers are
+    folded into lane 0 — no shape-changing concat)."""
     n = fs.shape[0]
     while n > 1:
         half = n // 2
         prod = tw.fq12_mul(fs[:half], fs[half : 2 * half])
         if n % 2:
-            fs = jnp.concatenate([prod, fs[2 * half : n]], axis=0)
-        else:
-            fs = prod
-        n = (n + 1) // 2
+            prod = _set_lane0(prod, tw.fq12_mul(prod[0:1], fs[2 * half : n]))
+        fs = prod
+        n = half
     return fs[0]
 
 
@@ -167,17 +178,18 @@ def _combine_lines(line, valid_mask):
     n = l0.shape[0]
     if n == 1:
         return _line_to_fq12((l0, l1, l2))[0]
-    if n % 2:
-        one = jnp.broadcast_to(tw.fq2_one(), (1,) + l0.shape[1:])
-        zero = jnp.zeros((1,) + l0.shape[1:], l0.dtype)
-        l0 = jnp.concatenate([l0, one])
-        l1 = jnp.concatenate([l1, zero])
-        l2 = jnp.concatenate([l2, zero])
-        n += 1
     half = n // 2
     fs = _line_mul_line(
-        (l0[:half], l1[:half], l2[:half]), (l0[half:], l1[half:], l2[half:])
+        (l0[:half], l1[:half], l2[:half]),
+        (l0[half : 2 * half], l1[half : 2 * half], l2[half : 2 * half]),
     )
+    if n % 2:
+        # odd straggler: sparse-fold its line into lane 0 (cheaper than the
+        # old identity-line pad, and concat-free for Mosaic)
+        folded = tw.fq12_mul_by_014(
+            fs[0:1], l0[n - 1 : n], l1[n - 1 : n], l2[n - 1 : n]
+        )
+        fs = _set_lane0(fs, folded)
     return fq12_product_any(fs)
 
 
@@ -306,7 +318,11 @@ def pairing_product_is_one(p_aff, q_aff, valid_mask):
     reference (and the mesh-sharded multi-chip path)."""
     from . import pallas_ops
 
-    m = pallas_ops.mode("pairing")
+    # size-gate on the SET count: the backend appends one generator row to
+    # the pair axis, so shape[0] is n_sets + 1 — without the -1 a 64-set
+    # batch (the largest bucket the gate keeps fused) would gate this, the
+    # dominant stage, while every other stage ran fused
+    m = pallas_ops.mode("pairing", n=max(1, p_aff[0].shape[0] - 1))
     if m is not None:
         return pallas_ops.pairing_product_is_one_fused(
             p_aff, q_aff, valid_mask, interpret=(m == "interpret")

@@ -96,10 +96,19 @@ def _probed_ok(kernel: str | None = None) -> bool:
     return bool(st.get("ok"))
 
 
-def mode(kernel: str | None = None) -> str | None:
+def mode(kernel: str | None = None, n: int | None = None) -> str | None:
     """Resolve the Pallas routing mode. Returns "compile", "interpret" or
     None (use the plain XLA path). `kernel` names the fused-kernel family
-    asking (see _probed_ok) — auto mode enables each independently."""
+    asking (see _probed_ok) — auto mode enables each independently.
+
+    `n` is the caller's batch extent (sets / pairs): auto mode keeps the
+    fused kernels on the SMALL buckets — the urgent/latency-bound path,
+    where one kernel launch replaces dozens of dispatch round trips — and
+    leaves wide firehose buckets on the proven XLA path, whose per-op
+    dispatch overhead already amortizes over huge vectors and whose
+    compile cost is far lower (Mosaic compile of the fused stages grows
+    steeply with lane width; the v5e probe measured minutes per stage at
+    toy shapes). Explicit "on"/"interpret" bypass the size gate."""
     env = os.environ.get("LIGHTHOUSE_TPU_PALLAS", "auto").lower()
     if env in ("off", "0", "no"):
         return None
@@ -113,6 +122,10 @@ def mode(kernel: str | None = None) -> str | None:
     # validated Mosaic lowering here (an unproven kernel costs minutes of
     # doomed client-side lowering before any fallback can engage).
     try:
+        if n is not None and n > int(
+            os.environ.get("LIGHTHOUSE_TPU_PALLAS_AUTO_MAX", "64")
+        ):
+            return None
         if jax.default_backend() == "cpu":
             return None
         from ...parallel.mesh import get_mesh
@@ -391,6 +404,9 @@ def _prepare_kernel(pbits_ref, *refs):
     tab = _const_tab(consts)
     impls = {"POW_PM2": lambda a: _mont_pow_ref(a, pbits_ref)}
     with lb.pallas_mode(tab, impls):
+        # pk arrays arrive PRE-TRANSPOSED (m, n, NL) from the wrapper — the
+        # (n, m) -> (m, n) moveaxis is a tiled-dim transpose Mosaic would
+        # have to re-layout; XLA does it outside the kernel for free
         pk_x = lb.to_mont(pkx_ref[...])
         pk_y = lb.to_mont(pky_ref[...])
         sig_x = lb.to_mont(sigx_ref[...])
@@ -399,11 +415,10 @@ def _prepare_kernel(pbits_ref, *refs):
         set_mask = sm_ref[...][:, 0]
         zd = zd_ref[...]
 
-        pk_jac = co.affine_to_jac(
+        pk_jac_t = co.affine_to_jac(
             co.FQ_OPS, (pk_x, pk_y), inf_mask=jnp.logical_not(pk_mask)
         )
-        pk_jac_t = tuple(jnp.moveaxis(c, 1, 0) for c in pk_jac)
-        m = pk_x.shape[1]
+        m = pk_x.shape[0]
         agg = pk_jac_t
         while m > 1:
             half = m // 2
@@ -440,7 +455,7 @@ def _prepare_kernel(pbits_ref, *refs):
             for t in range(lb.LB):
                 limb = limb + (zd[:, base + t] << (lb.LB - 1 - t))
             limb = limb[:, None]
-            reg = limb if reg is None else jnp.concatenate([reg, limb], axis=1)
+            reg = limb if reg is None else lb.kconcat([reg, limb], axis=1)
         # reg: (n, nwz), limb nwz-1 holds the first bits to consume
 
         acc_pk = jax.tree_util.tree_map(
@@ -504,9 +519,9 @@ def stage_prepare_fused(pk_x, pk_y, pk_mask, sig_x, sig_y, z_digits, set_mask,
     )(
         jnp.asarray(_PM2_BITS),
         *_const_inputs(),
-        jnp.asarray(pk_x),
-        jnp.asarray(pk_y),
-        jnp.asarray(pk_mask, jnp.uint32),
+        jnp.moveaxis(jnp.asarray(pk_x), 1, 0),      # (m, n, NL): see kernel
+        jnp.moveaxis(jnp.asarray(pk_y), 1, 0),
+        jnp.moveaxis(jnp.asarray(pk_mask, jnp.uint32), 1, 0),
         jnp.asarray(sig_x),
         jnp.asarray(sig_y),
         jnp.asarray(z_digits, jnp.uint32),
@@ -517,12 +532,15 @@ def stage_prepare_fused(pk_x, pk_y, pk_mask, sig_x, sig_y, z_digits, set_mask,
 
 def _pairs_kernel(pbits_ref, *refs):
     """Fused stage 3: ONE batched Fermat inversion for every
-    Jacobian->affine conversion + pair-array assembly."""
+    Jacobian->affine conversion. The generator/signature row appends happen
+    in the WRAPPER (plain XLA): a ragged leading-axis concatenate is a vreg
+    re-layout Mosaic rejects, and the appends are pure data movement."""
     from . import backend as be
 
     consts = refs[: _n_consts()]
     (zx_ref, zy_ref, zz_ref, hx_ref, hy_ref, hz_ref, sx_ref, sy_ref, sz_ref,
-     sm_ref, px_ref, py_ref, qx_ref, qy_ref, pm_ref) = refs[_n_consts():]
+     sm_ref, px_ref, py_ref, qx_ref, qy_ref, pm_ref, sxo_ref, syo_ref,
+     sinf_ref) = refs[_n_consts():]
     tab = _const_tab(consts)
     impls = {"POW_PM2": lambda a: _mont_pow_ref(a, pbits_ref)}
     with lb.pallas_mode(tab, impls):
@@ -534,39 +552,43 @@ def _pairs_kernel(pbits_ref, *refs):
         (p1x, p1y, p1inf), (qx, qy, qinf), (sx, sy, sinf) = be._batched_affine(
             z_pk, h_jac, sig_acc
         )
-        neg_g1x = tab["NEG_G1X"][None]
-        neg_g1y = tab["NEG_G1Y"][None]
-        px = jnp.concatenate([p1x, neg_g1x])
-        py = jnp.concatenate([p1y, neg_g1y])
-        qxx = jnp.concatenate([qx, sx[None]])
-        qyy = jnp.concatenate([qy, sy[None]])
-        true1 = jnp.ones((1,), bool)
-        pair_mask = jnp.concatenate([set_mask != 0, true1])
-        side_inf = jnp.concatenate([jnp.logical_or(p1inf, qinf), sinf[None]])
-        pair_mask = jnp.logical_and(pair_mask, jnp.logical_not(side_inf))
-
-        px_ref[...] = px
-        py_ref[...] = py
-        qx_ref[...] = qxx
-        qy_ref[...] = qyy
+        pair_mask = jnp.logical_and(
+            set_mask != 0, jnp.logical_not(jnp.logical_or(p1inf, qinf))
+        )
+        px_ref[...] = p1x
+        py_ref[...] = p1y
+        qx_ref[...] = qx
+        qy_ref[...] = qy
         pm_ref[...] = lb.b2u(pair_mask)[:, None]
+        sxo_ref[...] = sx
+        syo_ref[...] = sy
+        sinf_ref[...] = lb.b2u(sinf).reshape(1, 1)
+
+
+def _const_np(name: str):
+    for n, a in _consts():
+        if n == name:
+            return a
+    raise KeyError(name)
 
 
 def stage_pairs_fused(z_pk, h_jac, sig_acc, set_mask, *, interpret=False):
     """Drop-in for backend._stage_pairs via the fused kernel."""
     pl, pltpu = _pl()
     n = z_pk[0].shape[0]
-    fq1 = jax.ShapeDtypeStruct((n + 1, lb.NL), jnp.uint32)
-    fq2 = jax.ShapeDtypeStruct((n + 1, 2, lb.NL), jnp.uint32)
-    msk = jax.ShapeDtypeStruct((n + 1, 1), jnp.uint32)
+    fq1 = jax.ShapeDtypeStruct((n, lb.NL), jnp.uint32)
+    fq2 = jax.ShapeDtypeStruct((n, 2, lb.NL), jnp.uint32)
+    msk = jax.ShapeDtypeStruct((n, 1), jnp.uint32)
+    sfq2 = jax.ShapeDtypeStruct((2, lb.NL), jnp.uint32)
+    one = jax.ShapeDtypeStruct((1, 1), jnp.uint32)
     vm = pl.BlockSpec(memory_space=pltpu.VMEM)
-    px, py, qxx, qyy, pm = pl.pallas_call(
+    p1x, p1y, qx, qy, pm, sx, sy, sinf = pl.pallas_call(
         _pairs_kernel,
-        out_shape=(fq1, fq1, fq2, fq2, msk),
+        out_shape=(fq1, fq1, fq2, fq2, msk, sfq2, sfq2, one),
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
         + _const_specs(pl, pltpu)
         + [vm] * 10,
-        out_specs=(vm,) * 5,
+        out_specs=(vm,) * 8,
         interpret=interpret,
     )(
         jnp.asarray(_PM2_BITS),
@@ -576,7 +598,13 @@ def stage_pairs_fused(z_pk, h_jac, sig_acc, set_mask, *, interpret=False):
         *sig_acc,
         jnp.asarray(set_mask, jnp.uint32).reshape(-1, 1),
     )
-    return px, py, qxx, qyy, pm[:, 0] != 0
+    # row appends in XLA land (outside the kernel)
+    px = jnp.concatenate([p1x, jnp.asarray(_const_np("NEG_G1X"))[None]])
+    py = jnp.concatenate([p1y, jnp.asarray(_const_np("NEG_G1Y"))[None]])
+    qxx = jnp.concatenate([qx, sx[None]])
+    qyy = jnp.concatenate([qy, sy[None]])
+    pair_mask = jnp.concatenate([pm[:, 0] != 0, sinf[0] == 0])
+    return px, py, qxx, qyy, pair_mask
 
 
 def _h2c_kernel(ebits_ref, xbits_ref, pbits_ref, *refs):
