@@ -458,16 +458,24 @@ def main():
     # unproven kernel costs minutes of tunnel window in doomed lowering)
     from lighthouse_tpu.crypto.jaxbls import pallas_ops as _plo
 
-    # the auto gate is size-aware: record the routing at BOTH the urgent
-    # bucket (n=4) and the headline width, so the matrix never attributes a
-    # wide-batch number to fused kernels the gate actually routed to XLA
-    _MATRIX["pallas"] = {
-        k: {
-            "small_bucket": _plo.mode(k, n=4) or "off",
-            "headline": _plo.mode(k, n=512) or "off",
+    def _record_pallas_routing(n_pks):
+        # the auto gate is size-aware: record the routing at BOTH the
+        # urgent bucket (n=4) and the headline width, at the fixture's real
+        # pk width, so the matrix never attributes a measurement to fused
+        # kernels the gate actually routed to XLA
+        _MATRIX["pallas"] = {
+            k: {
+                "small_bucket": _plo.mode(
+                    k, n=4, pk_width=n_pks if k == "prepare" else None
+                )
+                or "off",
+                "headline": _plo.mode(
+                    k, n=512, pk_width=n_pks if k == "prepare" else None
+                )
+                or "off",
+            }
+            for k in ("prepare", "h2c", "pairs", "pairing")
         }
-        for k in ("prepare", "h2c", "pairs", "pairing")
-    }
 
     from lighthouse_tpu.crypto.bls import api as bls_api
 
@@ -477,6 +485,7 @@ def main():
     try:
         try:
             fx = _load_fixtures()   # host-only, but any failure must still
+            _record_pallas_routing(fx["meta"]["n_pks"])
                                     # emit the headline JSON (finally below)
         except Exception as e:
             _HEADLINE["note"] = f"fixture load FAILED: {type(e).__name__}: {e}"

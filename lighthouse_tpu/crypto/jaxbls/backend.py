@@ -134,7 +134,7 @@ def _stage_prepare(pk_x, pk_y, pk_mask, sig_x, sig_y, z_digits, set_mask):
     Runs as a fused Pallas kernel on a single accelerator; XLA elsewhere."""
     from . import pallas_ops
 
-    m = pallas_ops.mode("prepare", n=pk_x.shape[0])
+    m = pallas_ops.mode("prepare", n=pk_x.shape[0], pk_width=pk_x.shape[1])
     if m is not None:
         return pallas_ops.stage_prepare_fused(
             pk_x, pk_y, pk_mask, sig_x, sig_y, z_digits, set_mask,

@@ -96,7 +96,11 @@ def _probed_ok(kernel: str | None = None) -> bool:
     return bool(st.get("ok"))
 
 
-def mode(kernel: str | None = None, n: int | None = None) -> str | None:
+def mode(
+    kernel: str | None = None,
+    n: int | None = None,
+    pk_width: int | None = None,
+) -> str | None:
     """Resolve the Pallas routing mode. Returns "compile", "interpret" or
     None (use the plain XLA path). `kernel` names the fused-kernel family
     asking (see _probed_ok) — auto mode enables each independently.
@@ -121,11 +125,19 @@ def mode(kernel: str | None = None, n: int | None = None) -> str | None:
     # path — parallel/mesh.py), and only once the on-chip probe has
     # validated Mosaic lowering here (an unproven kernel costs minutes of
     # doomed client-side lowering before any fallback can engage).
+    # Knob parses live OUTSIDE the try: a malformed value must raise, not
+    # silently disable every fused kernel via the probe catch-all.
+    max_n = int(os.environ.get("LIGHTHOUSE_TPU_PALLAS_AUTO_MAX", "64"))
+    max_pks = int(os.environ.get("LIGHTHOUSE_TPU_PALLAS_AUTO_MAX_PKS", "8"))
+    if n is not None and n > max_n:
+        return None
+    # the prepare kernel's body grows with the pubkey axis (log2(m)
+    # unrolled jac_add tree levels): Mosaic compile at m=128 ran well
+    # over an hour on the v5e vs 340 s at the probe's m=2 — auto mode
+    # keeps fused prepare to narrow buckets only
+    if pk_width is not None and pk_width > max_pks:
+        return None
     try:
-        if n is not None and n > int(
-            os.environ.get("LIGHTHOUSE_TPU_PALLAS_AUTO_MAX", "64")
-        ):
-            return None
         if jax.default_backend() == "cpu":
             return None
         from ...parallel.mesh import get_mesh
@@ -631,22 +643,37 @@ def _h2c_kernel(ebits_ref, xbits_ref, pbits_ref, *refs):
         z_ref[...] = Z
 
 
+_H2C_BLOCK = 4          # sets per grid step (every bucket size is a
+                        # multiple: MIN_SETS == 4, buckets are pow2)
+
+
 def hash_to_g2_fused(us, *, interpret=False):
     """Drop-in for h2c_ops.hash_to_g2_jacobian via the fused kernel.
-    us: (n, 2, 2, NL) standard-form u-values."""
+    us: (n, 2, 2, NL) standard-form u-values.
+
+    Gridded over the set axis in _H2C_BLOCK chunks with a raised VMEM
+    budget: the fused map's scoped-stack peak was measured at 31.8 MB for
+    4 sets on a v5e against the 16 MB default limit (the 758-bit
+    sqrt_ratio chain keeps many live Fq2 temporaries), so one big block
+    would both OOM the stack and scale with n."""
+    import math
+
     pl, pltpu = _pl()
     n = us.shape[0]
+    blk = math.gcd(n, _H2C_BLOCK)   # any n works; pow2 buckets get 4
     out = jax.ShapeDtypeStruct((n, 2, lb.NL), jnp.uint32)
+    out_spec = pl.BlockSpec((blk, 2, lb.NL), lambda i: (i, 0, 0))
     return pl.pallas_call(
         _h2c_kernel,
+        grid=(n // blk,),
         out_shape=(out, out, out),
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] * 3
         + _const_specs(pl, pltpu)
-        + [pl.BlockSpec(memory_space=pltpu.VMEM)],
-        out_specs=(
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
+        + [pl.BlockSpec((blk, 2, 2, lb.NL), lambda i: (i, 0, 0, 0))],
+        out_specs=(out_spec, out_spec, out_spec),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+            vmem_limit_bytes=100 * 1024 * 1024,
         ),
         interpret=interpret,
     )(

@@ -1,0 +1,163 @@
+"""Unit tests for the Mosaic-safe stack/concat helpers (limbs.kstack /
+kconcat / _canon / _concat_last).
+
+These are the round-5 primitives that cleared the tpu.concatenate blocker
+on the v5e (docs/PERF_NOTES.md "on-chip session 2"): inside Pallas kernel
+bodies, component-axis stacks become broadcast + iota-compare selects and
+minor-axis concats canonicalize operand layouts. Outside pallas tracing
+they must be bit-identical passthroughs to jnp.stack/concatenate. The
+interpret-mode lanes here pin the SELECT-ASSEMBLY semantics (the form the
+chip executes); the passthrough lanes pin XLA-path neutrality.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.experimental import pallas as pl
+
+from lighthouse_tpu.crypto.jaxbls import limbs as lb
+
+RNG = np.random.default_rng(42)
+
+
+def _r(shape):
+    return RNG.integers(0, 1 << 16, shape, dtype=np.uint32)
+
+
+# --------------------------------------------------------- passthrough
+
+
+def test_kstack_passthrough_matches_jnp():
+    a, b, c = _r((3, 5, 24)), _r((3, 5, 24)), _r((3, 5, 24))
+    for axis in (0, 1, -1, -2, -3):
+        got = np.asarray(lb.kstack([a, b, c], axis=axis))
+        want = np.stack([a, b, c], axis=axis)
+        assert np.array_equal(got, want), f"axis={axis}"
+
+
+def test_kconcat_passthrough_matches_jnp():
+    a, b = _r((3, 5, 24)), _r((3, 2, 24))
+    got = np.asarray(lb.kconcat([a, b], axis=1))
+    assert np.array_equal(got, np.concatenate([a, b], axis=1))
+    a, b = _r((3, 5, 24)), _r((3, 5, 8))
+    got = np.asarray(lb.kconcat([a, b], axis=-1))
+    assert np.array_equal(got, np.concatenate([a, b], axis=-1))
+
+
+# -------------------------------------------- select-assembly (interpret)
+
+
+def _in_kernel(fn, out_shape, *arrays):
+    """Run fn on loaded refs inside an interpret-mode kernel with
+    pallas_mode active, so kstack/kconcat take their select routes."""
+
+    def k(*refs):
+        *in_refs, o_ref = refs
+        with lb.pallas_mode():
+            o_ref[...] = fn(*(r[...] for r in in_refs))
+
+    return np.asarray(
+        pl.pallas_call(
+            k,
+            out_shape=jax.ShapeDtypeStruct(out_shape, jnp.uint32),
+            interpret=True,
+        )(*arrays)
+    )
+
+
+def test_kstack_select_assembly_axes():
+    a, b, c = _r((3, 5, 24)), _r((3, 5, 24)), _r((3, 5, 24))
+    for axis in (0, 1, -2, -3):
+        want = np.stack([a, b, c], axis=axis)
+        got = _in_kernel(
+            lambda x, y, z, _ax=axis: lb.kstack([x, y, z], axis=_ax),
+            want.shape, a, b, c,
+        )
+        assert np.array_equal(got, want), f"axis={axis}"
+
+
+def test_kstack_minor_axis_in_kernel():
+    a, b = _r((4, 24)), _r((4, 24))
+    want = np.stack([a, b], axis=-1)            # (4, 24, 2)
+    got = _in_kernel(lambda x, y: lb.kstack([x, y], axis=-1), want.shape, a, b)
+    assert np.array_equal(got, want)
+
+
+def test_kconcat_select_assembly_multi_extent():
+    a, b, c = _r((3, 5, 24)), _r((3, 2, 24)), _r((3, 1, 24))
+    want = np.concatenate([a, b, c], axis=1)
+    got = _in_kernel(
+        lambda x, y, z: lb.kconcat([x, y, z], axis=1), want.shape, a, b, c
+    )
+    assert np.array_equal(got, want)
+
+
+def test_kconcat_minor_axis_canonicalized():
+    a, b = _r((3, 5, 24)), _r((3, 5, 1))
+    want = np.concatenate([a, b], axis=-1)
+    got = _in_kernel(lambda x, y: lb.kconcat([x, y], axis=-1), want.shape, a, b)
+    assert np.array_equal(got, want)
+
+
+def test_kstack_bool_roundtrip():
+    a = (_r((4, 8)) & 1).astype(bool)
+    b = (_r((4, 8)) & 1).astype(bool)
+    want = np.stack([a, b], axis=0).astype(np.uint32)
+    got = _in_kernel(
+        lambda x, y: lb.b2u(lb.kstack([x != 0, y != 0], axis=0)),
+        want.shape, a.astype(np.uint32), b.astype(np.uint32),
+    )
+    assert np.array_equal(got, want)
+
+
+def test_concat_last_bool_converts():
+    a = (_r((4, 4)) & 1).astype(np.uint32)
+    b = (_r((4, 4)) & 1).astype(np.uint32)
+    want = np.concatenate([a, b], axis=-1)
+    got = _in_kernel(
+        lambda x, y: lb.b2u(lb._concat_last([x != 0, y != 0])),
+        want.shape, a, b,
+    )
+    assert np.array_equal(got, want)
+
+
+def test_canon_is_identity():
+    a = _r((3, 7, 24))
+    got = _in_kernel(lambda x: lb._canon(x[..., 1, :]), (3, 24), a)
+    assert np.array_equal(got, a[:, 1, :])
+
+
+def test_limb_ops_in_pallas_mode_match_plain():
+    """add/sub/mul_small route through _concat_last + Kogge-Stone inside
+    pallas_mode; results must equal the plain XLA forms bit-exactly."""
+    from lighthouse_tpu.crypto.bls381.constants import P
+    import random
+
+    rng = random.Random(9)
+    xs = [rng.randrange(P) for _ in range(4)]
+    ys = [rng.randrange(P) for _ in range(4)]
+    a = np.asarray(lb.pack_batch(xs))
+    b = np.asarray(lb.pack_batch(ys))
+
+    want_add = np.asarray(lb.add_mod_jit(a, b))
+    want_sub = np.asarray(lb.sub_mod_jit(a, b))
+    want_small = np.asarray(lb.mul_small_jit(a, 8))
+
+    from lighthouse_tpu.crypto.jaxbls import pallas_ops as plo
+
+    def k(*refs):
+        tab = plo._const_tab(refs[: plo._n_consts()])
+        a_ref, b_ref, o1, o2, o3 = refs[plo._n_consts():]
+        with lb.pallas_mode(tab):
+            o1[...] = lb.add_mod(a_ref[...], b_ref[...])
+            o2[...] = lb.sub_mod(a_ref[...], b_ref[...])
+            o3[...] = lb.mul_small(a_ref[...], 8)
+
+    sd = jax.ShapeDtypeStruct(a.shape, jnp.uint32)
+    got_add, got_sub, got_small = pl.pallas_call(
+        k, out_shape=(sd, sd, sd), interpret=True
+    )(*plo._const_inputs(), a, b)
+    assert np.array_equal(np.asarray(got_add), want_add)
+    assert np.array_equal(np.asarray(got_sub), want_sub)
+    assert np.array_equal(np.asarray(got_small), want_small)
