@@ -87,6 +87,12 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
     chain = None           # injected by serve()
     op_pool = None
     event_bus = None
+    allow_origin = None    # --http-allow-origin: CORS on every response
+
+    def end_headers(self):
+        if self.allow_origin:
+            self.send_header("Access-Control-Allow-Origin", self.allow_origin)
+        super().end_headers()
     # Backpressure for the HEAVY publish paths (block/attestation/sync-
     # committee import runs verification inline in the handler thread):
     # bounded gates — work beyond the limit gets 503 immediately, like the
@@ -1454,12 +1460,13 @@ class EventBus:
                 q.append((topic, payload))
 
 
-def serve(chain, op_pool=None, host="127.0.0.1", port=0):
+def serve(chain, op_pool=None, host="127.0.0.1", port=0, allow_origin=None):
     """Start the API server; returns (server, thread, actual_port)."""
     handler = type(
         "BoundHandler",
         (BeaconApiHandler,),
-        {"chain": chain, "op_pool": op_pool, "event_bus": EventBus()},
+        {"chain": chain, "op_pool": op_pool, "event_bus": EventBus(),
+         "allow_origin": allow_origin},
     )
     server = ThreadingHTTPServer((host, port), handler)
     thread = threading.Thread(target=server.serve_forever, daemon=True)

@@ -52,6 +52,12 @@ class AttestationError(Exception):
 class ChainConfig:
     reorg_threshold_percent: int = 20
     import_max_skip_slots: int | None = None
+    # background-migrator cadence: advance the hot/cold split once
+    # finalization has moved this many epochs past it (migrate.rs /
+    # --epochs-per-migration); 0 disables live migration
+    epochs_per_migration: int = 1
+    # slasher retention horizon in epochs (--slasher-history-length)
+    slasher_history_epochs: int = 4096
 
 
 class ShufflingCache:
@@ -410,10 +416,61 @@ class BeaconChain:
             and fin_epoch > getattr(self, "_slasher_pruned_at", 0)
         ):
             self._slasher_pruned_at = fin_epoch
-            self.slasher.prune(fin_epoch, self.spec.preset.SLOTS_PER_EPOCH)
+            self.slasher.prune(
+                fin_epoch,
+                self.spec.preset.SLOTS_PER_EPOCH,
+                history_epochs=self.config.slasher_history_epochs,
+            )
         # pending DA joins at/below finalization can never import
         self.data_availability.prune_finalized(
             fin_epoch * self.spec.preset.SLOTS_PER_EPOCH
+        )
+        self._maybe_migrate_finalized(fin_epoch)
+
+    def _maybe_migrate_finalized(self, fin_epoch: int) -> None:
+        """Background-migrator analog (beacon_chain/src/migrate.rs): once
+        finalization has advanced `epochs_per_migration` past the store's
+        hot/cold split, walk the newly finalized canonical segment (by
+        parent links from the finalized block) and move it across the
+        split — states drop from the hot DB, roots land in the freezer's
+        chunked vectors, restore points keep full copies."""
+        if self.store is None or self.config.epochs_per_migration <= 0:
+            return
+        spe = self.spec.preset.SLOTS_PER_EPOCH
+        fin_slot = fin_epoch * spe
+        split = self.store.split_slot
+        if fin_slot - split < self.config.epochs_per_migration * spe:
+            return
+        from ..state_transition.slot import types_for_slot
+
+        fin_root = self.fork_choice.store.finalized_checkpoint[1]
+        seg: list[tuple[int, bytes, bytes]] = []
+        root = fin_root
+        # walk finalized -> split by parent links; the finalized block
+        # itself stays hot (fork revert loads the finalized state)
+        while root is not None:
+            slot = self.block_slots.get(root)
+            if slot is None or slot < split:
+                break
+            blk = self.store.get_block(root, types_for_slot(self.spec, slot))
+            if blk is None:
+                break
+            # the finalized block's own state must STAY hot even when a
+            # skipped epoch-boundary slot puts its slot below fin_slot:
+            # fork revert loads exactly that state (revert_to_fork_boundary)
+            if slot < fin_slot and root != fin_root:
+                seg.append((int(slot), root, bytes(blk.message.state_root)))
+            if slot == 0:
+                break
+            root = bytes(blk.message.parent_root)
+        if not seg:
+            # empty segment still advances the split so the check above
+            # does not re-walk every slot
+            self.store.migrate_to_freezer(fin_slot, [], types_for_slot(self.spec, 0))
+            return
+        seg.reverse()
+        self.store.migrate_to_freezer(
+            fin_slot, seg, types_for_slot(self.spec, seg[0][0])
         )
 
     # ---------------------------------------------------------------- head

@@ -81,6 +81,11 @@ def cmd_bn(args):
         )
     bls.set_backend(args.bls_backend)
 
+    if args.zero_ports:
+        args.http_port = 0
+        args.metrics_port = 0
+        args.p2p_port = 0
+
     anchor_block = None
     if args.interop_validators:
         keypairs = bls.interop_keypairs(args.interop_validators)
@@ -164,6 +169,15 @@ def cmd_bn(args):
         # datadir is how operators get slashed
         lock = Lockfile(f"{args.datadir}/beacon.lock")
         lock.acquire()
+        if args.purge_db:
+            import glob as _glob
+
+            purged = 0
+            for pat in ("hot.db*", "cold.db*"):
+                for f in _glob.glob(os.path.join(args.datadir, pat)):
+                    os.remove(f)
+                    purged += 1
+            log.info("database purged", files=purged)
         from .store.hot_cold import StoreConfig
 
         store = HotColdDB(
@@ -175,6 +189,10 @@ def cmd_bn(args):
                 compact_on_migration=not args.no_compact_on_migration,
             ),
         )
+        if args.compact_db:
+            store.hot.compact()
+            store.cold.compact()
+            log.info("databases compacted")
     execution_layer = None
     if args.engine:
         from .chain.execution_layer import ExecutionLayer
@@ -198,6 +216,46 @@ def cmd_bn(args):
         execution_layer = ExecutionLayer(engine, spec, default_fee_recipient=fee)
         log.info("execution engine connected", url=args.engine)
 
+    if args.wss_checkpoint:
+        # weak-subjectivity pin: the start anchor must BE the operator's
+        # checkpoint (checkpoint.rs wss verification role)
+        try:
+            root_hex, _, epoch_s = args.wss_checkpoint.partition(":")
+            wss_root = bytes.fromhex(root_hex.removeprefix("0x"))
+            wss_epoch = int(epoch_s)
+        except ValueError:
+            print("error: --wss-checkpoint must be 0xROOT:EPOCH",
+                  file=sys.stderr)
+            return 1
+        if anchor_block is None:
+            # a genesis/interop start builds history itself; enforcing a
+            # wss pin requires an anchor to compare against — refuse to
+            # silently drop a SECURITY flag
+            print(
+                "error: --wss-checkpoint requires a checkpoint start "
+                "(--checkpoint-state/--checkpoint-sync-url); genesis "
+                "starts have no anchor to verify against",
+                file=sys.stderr,
+            )
+            return 1
+        anchor_root = type(anchor_block.message).hash_tree_root(
+            anchor_block.message
+        )
+        # checkpoint providers hand out (root of the last block before the
+        # boundary, checkpoint epoch): with a skipped boundary slot the
+        # block's slot sits in the PREVIOUS epoch, so compare against the
+        # ceiling epoch; root equality is the binding check
+        spe = spec.preset.SLOTS_PER_EPOCH
+        anchor_epoch = (int(anchor_block.message.slot) + spe - 1) // spe
+        if anchor_root != wss_root or anchor_epoch != wss_epoch:
+            print(
+                f"error: anchor {anchor_root.hex()}:{anchor_epoch} does not "
+                f"match --wss-checkpoint {wss_root.hex()}:{wss_epoch}",
+                file=sys.stderr,
+            )
+            return 1
+        log.info("weak-subjectivity checkpoint verified", epoch=wss_epoch)
+
     from .chain.beacon_chain import ChainConfig
 
     clock = SystemTimeSlotClock(state.genesis_time, spec.seconds_per_slot)
@@ -207,9 +265,12 @@ def cmd_bn(args):
         config=ChainConfig(
             reorg_threshold_percent=args.reorg_threshold,
             import_max_skip_slots=args.max_skip_slots,
+            epochs_per_migration=args.epochs_per_migration,
+            slasher_history_epochs=args.slasher_history_length,
         ),
     )
     chain.shuffling_cache.capacity = args.shuffling_cache_size
+    chain.state_cache.capacity = args.state_cache_size
     graffiti_text = args.graffiti
     if graffiti_text is None and getattr(args, "graffiti_file", None):
         with open(args.graffiti_file) as f:
@@ -230,6 +291,13 @@ def cmd_bn(args):
                     chain.monitor.register(int(tok))
             log.info("validator monitor enabled",
                      watched=len(chain.monitor.watched))
+    if getattr(args, "validator_monitor_file", None):
+        with open(args.validator_monitor_file) as f:
+            for tok in f.read().replace(",", "\n").split():
+                if tok.strip():
+                    chain.monitor.register(int(tok))
+        log.info("validator monitor file loaded",
+                 watched=len(chain.monitor.watched))
 
     eth1_service = None
     if args.eth1:
@@ -244,7 +312,11 @@ def cmd_bn(args):
             # plain JSON-RPC (no JWT) — reuse the HTTP transport with an
             # empty secret; eth1 nodes ignore the Authorization header
             eth1_rpc = EngineApiClient(args.eth1, b"\x00" * 32)
-        eth1_service = Eth1Service(eth1_rpc, spec, _tfs(spec, 0))
+        eth1_service = Eth1Service(
+            eth1_rpc, spec, _tfs(spec, 0),
+            follow_distance=args.eth1_cache_follow_distance,
+            batch_blocks=args.eth1_blocks_per_log_query,
+        )
         chain.eth1_cache = eth1_service.cache
         log.info("eth1 endpoint connected", url=args.eth1)
 
@@ -295,6 +367,7 @@ def cmd_bn(args):
             node_id=f"bn-{chain.genesis_block_root.hex()[:8]}-{_os.urandom(3).hex()}",
             fork_digest=digest,
             port=args.p2p_port,
+            listen_host=args.listen_address,
             heartbeat_interval=args.gossip_heartbeat_interval,
             subnets=args.subnets,
             op_pool=op_pool,
@@ -309,32 +382,43 @@ def cmd_bn(args):
             net.enable_discovery(boot_nodes=args.boot_nodes.split(","))
             dialed = net.discover_and_dial(max_peers=args.target_peers)
             log.info("discovery bootstrap", dialed=dialed)
-        static_peers = []
-        for addr in (args.static_peers or "").split(","):
-            if not addr:
-                continue
-            host_s, _, port_s = addr.partition(":")
-            if not port_s.isdigit():
-                log.warn("ignoring malformed static peer", peer=addr)
-                continue
-            static_peers.append((host_s, int(port_s)))
+        def parse_hostports(raw, label):
+            out = []
+            for addr in (raw or "").split(","):
+                if not addr:
+                    continue
+                host_s, _, port_s = addr.partition(":")
+                if not port_s.isdigit():
+                    log.warn(f"ignoring malformed {label}", peer=addr)
+                    continue
+                out.append((host_s, int(port_s)))
+            return out
+
+        static_peers = parse_hostports(args.static_peers, "static peer")
+        # trust itself is enforced by the NETWORK layer, keyed on the
+        # dialable address (NetworkNode trusted_addrs) — marking survives
+        # failed startup dials, inbound connects, and rediscovery
+        trusted_peers = parse_hostports(args.trusted_peers, "trusted peer")
+        net.trusted_addrs.update(trusted_peers)
 
         def dial_static():
-            for host_s, port_i in static_peers:
+            for host_s, port_i in static_peers + trusted_peers:
                 try:
                     net.host.dial(host_s, port_i)
                 except Exception as e:
-                    log.warn("static peer dial failed",
+                    log.warn("peer dial failed",
                              peer=f"{host_s}:{port_i}", error=str(e))
 
         dial_static()
 
     server, _t, port = serve(
-        chain, op_pool=op_pool, host=args.http_address, port=args.http_port
+        chain, op_pool=op_pool, host=args.http_address, port=args.http_port,
+        allow_origin=args.http_allow_origin,
     )
     log.info("HTTP API started", addr=args.http_address, port=port)
     mserver, mport = metrics_http_server(
-        host=args.metrics_address, port=args.metrics_port
+        host=args.metrics_address, port=args.metrics_port,
+        allow_origin=args.metrics_allow_origin,
     )
     log.info("metrics server started", addr=args.metrics_address, port=mport)
 
@@ -343,9 +427,19 @@ def cmd_bn(args):
     def slot_timer(exit_signal):
         while not exit_signal.wait(clock.duration_to_next_slot()):
             chain.per_slot_task()
-            HEAD_SLOT.set(chain.head_state().slot)
+            head_slot = chain.head_state().slot
+            HEAD_SLOT.set(head_slot)
             log.info("slot", slot=clock.now(), head=chain.head_root.hex()[:8])
             now = clock.now() or 0
+            if (
+                args.shutdown_after_sync
+                and chain.oldest_block_slot == 0
+                and head_slot + 1 >= now
+            ):
+                log.info("synced (backfill complete, head current); "
+                         "shutting down per --shutdown-after-sync")
+                executor.shutdown("synced")
+                return
             if slasher_svc is not None and now % spec.preset.SLOTS_PER_EPOCH == 0:
                 found = slasher_svc.process()
                 if found:
@@ -1053,6 +1147,41 @@ def build_parser() -> argparse.ArgumentParser:
     bn.add_argument("--device-probe-wait", type=float, default=None,
                     help="seconds to wait for the device probe at startup "
                          "before serving from the host (hybrid backend)")
+    bn.add_argument("--listen-address", default="127.0.0.1",
+                    help="bind address for the p2p listener")
+    bn.add_argument("--zero-ports", action="store_true",
+                    help="bind HTTP/metrics/p2p to ephemeral ports (testing)")
+    bn.add_argument("--purge-db", action="store_true",
+                    help="wipe the beacon database in --datadir before start")
+    bn.add_argument("--compact-db", action="store_true",
+                    help="compact the hot and cold databases at startup")
+    bn.add_argument("--http-allow-origin", default=None,
+                    help="Access-Control-Allow-Origin header for the API")
+    bn.add_argument("--metrics-allow-origin", default=None,
+                    help="Access-Control-Allow-Origin header for /metrics")
+    bn.add_argument("--trusted-peers", default=None,
+                    help="comma list host:port — always dialed, never "
+                    "scored down or banned")
+    bn.add_argument("--eth1-blocks-per-log-query", type=int, default=1000,
+                    help="eth1 deposit-log scan batch size")
+    bn.add_argument("--eth1-cache-follow-distance", type=int, default=0,
+                    help="eth1 blocks to lag behind head when caching")
+    bn.add_argument("--slasher-history-length", type=int, default=4096,
+                    help="slasher retention horizon in epochs")
+    bn.add_argument("--epochs-per-migration", type=int, default=1,
+                    help="finalized epochs between hot->cold migrations "
+                    "(0 disables the background migrator)")
+    bn.add_argument("--state-cache-size", type=int, default=32,
+                    help="hot beacon-state LRU capacity")
+    bn.add_argument("--validator-monitor-file", default=None,
+                    help="file of validator indices (comma/newline) to "
+                    "register with the validator monitor")
+    bn.add_argument("--wss-checkpoint", default=None,
+                    help="0xBLOCK_ROOT:EPOCH weak-subjectivity checkpoint "
+                    "the start anchor must match")
+    bn.add_argument("--shutdown-after-sync", action="store_true",
+                    help="exit once backfill is complete and the head is "
+                    "at the wall clock")
     bn.add_argument("--graffiti-file", default=None,
                     help="file whose first line is the block graffiti "
                          "(alternative to --graffiti)")

@@ -33,6 +33,8 @@ class NetworkNode:
         node_id: str,
         fork_digest: bytes = b"\x00" * 4,
         port: int = 0,
+        listen_host: str = "127.0.0.1",
+        trusted_addrs: set | None = None,
         heartbeat_interval: float = 0.3,
         subnets: int | None = None,
         op_pool=None,
@@ -44,6 +46,7 @@ class NetworkNode:
         self.chain = chain
         chain._network_node = self          # identity/peers API surface
         self.node_id = node_id
+        self.trusted_addrs = trusted_addrs or set()
         self.fork_digest = fork_digest
         # Gossip attestations/aggregates route through the beacon
         # processor's priority queues so they coalesce into device-sized
@@ -71,7 +74,8 @@ class NetworkNode:
         # transport consults this: when True, plaintext-HELLO peers are
         # rejected instead of served unencrypted
         self.require_encryption = require_encryption
-        self.host = TcpHost(self, node_id, port=port, encrypt=encrypt)
+        self.host = TcpHost(self, node_id, host=listen_host, port=port,
+                            encrypt=encrypt)
         self.heartbeat_interval = heartbeat_interval
         self._hb_stop = threading.Event()
         self._hb_thread = threading.Thread(target=self._heartbeat_loop, daemon=True)
@@ -138,6 +142,12 @@ class NetworkNode:
     def _register_connection(self, conn) -> None:
         self.host.connections[conn.peer_id] = conn
         self.peer_manager.connect(conn.peer_id)
+        # trust is keyed on the configured DIALABLE address (socket IP +
+        # HELLO-advertised listen port), so a trusted peer is exempt from
+        # scoring however the connection arises — inbound, discovery, or a
+        # re-dial long after a failed startup attempt
+        if conn.peer_dial_addr and conn.peer_dial_addr in self.trusted_addrs:
+            self.peer_manager._peer(conn.peer_id).trusted = True
         self.gossipsub.add_peer(conn.peer_id)
         # the Status handshake is a blocking round trip and we are ON this
         # connection's reader thread — hand it to a helper thread or the

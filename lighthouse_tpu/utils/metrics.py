@@ -168,7 +168,8 @@ BLOCK_OBSERVED_TO_HEAD = REGISTRY.histogram(
 )
 
 
-def metrics_http_server(host="127.0.0.1", port=0, registry=REGISTRY):
+def metrics_http_server(host="127.0.0.1", port=0, registry=REGISTRY,
+                        allow_origin=None):
     """/metrics scrape endpoint (http_metrics analog)."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
     import threading as _t
@@ -176,6 +177,11 @@ def metrics_http_server(host="127.0.0.1", port=0, registry=REGISTRY):
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):
             pass
+
+        def end_headers(self):
+            if allow_origin:
+                self.send_header("Access-Control-Allow-Origin", allow_origin)
+            super().end_headers()
 
         def do_GET(self):
             if self.path != "/metrics":

@@ -87,7 +87,8 @@ while true; do
         export LIGHTHOUSE_TPU_PALLAS=off
       fi
       log "warming bench-matrix buckets (do not interrupt)"
-      python scripts/warm_kernels.py --buckets 4x128,4x512,256x512 >> "$LOG" 2>&1 \
+      python scripts/warm_kernels.py --sets 512 --pks 128 \
+        --buckets 64x128,4x128,4x512,256x512 >> "$LOG" 2>&1 \
         && log "warm complete" || log "warm FAILED rc=$?"
       exit 0
     fi

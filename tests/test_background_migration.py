@@ -1,0 +1,100 @@
+"""The live background migrator (beacon_chain/src/migrate.rs analog):
+per_slot_task advances the store's hot/cold split as finalization moves,
+drops finalized states from the hot DB, lands roots in the freezer's
+chunked vectors, and keeps restore points — without breaking block
+serving or the finalized anchor (fork revert loads the finalized state).
+"""
+
+import pytest
+
+from lighthouse_tpu.chain.beacon_chain import BeaconChain, ChainConfig
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.state_transition.slot import types_for_slot
+from lighthouse_tpu.testing.harness import StateHarness, clone_state
+from lighthouse_tpu.types.spec import minimal_spec
+
+VALIDATORS = 64
+
+
+def _extend_to_finality(chain, harness, epochs=4):
+    pending = []
+    spec = harness.spec
+    for _ in range(epochs * spec.preset.SLOTS_PER_EPOCH):
+        slot = harness.state.slot + 1
+        signed, _post = harness.produce_block(
+            slot, attestations=pending, full_sync=False
+        )
+        harness.apply_block(signed)
+        chain.slot_clock.set_slot(slot)
+        chain.per_slot_task()
+        root = chain.verify_block_for_gossip(signed)
+        chain.process_block(signed, block_root=root,
+                            proposal_already_verified=True)
+        types = types_for_slot(spec, slot)
+        head_root = types.BeaconBlock.hash_tree_root(signed.message)
+        pending = harness.build_attestations(
+            clone_state(harness.state, spec), slot, head_root
+        )
+    # finalization lands on the LAST block import; the migrator runs on the
+    # next slot tick (as in the live node)
+    chain.slot_clock.set_slot(harness.state.slot + 1)
+    chain.per_slot_task()
+
+
+def test_migration_advances_split_and_drops_hot_states():
+    bls.set_backend("fake")
+    spec = minimal_spec()
+    harness = StateHarness.new(spec, VALIDATORS)
+    chain = BeaconChain(
+        spec, clone_state(harness.state, spec),
+        config=ChainConfig(epochs_per_migration=1),
+    )
+    _extend_to_finality(chain, harness)
+
+    fin_epoch, fin_root = chain.fork_choice.store.finalized_checkpoint
+    assert fin_epoch >= 2
+    fin_slot = fin_epoch * spec.preset.SLOTS_PER_EPOCH
+
+    # the split advanced to finalization
+    assert chain.store.split_slot == fin_slot
+
+    # finalized-segment states are gone from the hot DB; the finalized
+    # anchor's own state stays (fork revert loads it)
+    dropped = kept = 0
+    for root, slot in chain.block_slots.items():
+        sroot = chain.state_root_by_block.get(root)
+        if sroot is None:
+            continue
+        if slot < fin_slot:
+            if chain.store.state_exists(sroot):
+                kept += 1
+            else:
+                dropped += 1
+    assert dropped > 0, "no finalized states were migrated"
+    fin_state_root = chain.state_root_by_block[fin_root]
+    assert chain.store.state_exists(fin_state_root)
+
+    # freezer chunked vectors serve the canonical roots below the split
+    got = dict(chain.store.forwards_block_roots_iterator(0, fin_slot - 1))
+    assert got, "freezer has no block roots"
+    for slot, root in got.items():
+        assert chain.block_slots.get(root) is not None
+
+    # blocks below the split still serve by root (they stay hot until
+    # pruned separately)
+    some_old = [r for r, s in chain.block_slots.items() if 0 < s < fin_slot]
+    t = types_for_slot(spec, 1)
+    assert chain.store.get_block(some_old[0], t) is not None
+
+
+def test_migration_disabled_keeps_split():
+    bls.set_backend("fake")
+    spec = minimal_spec()
+    harness = StateHarness.new(spec, VALIDATORS)
+    chain = BeaconChain(
+        spec, clone_state(harness.state, spec),
+        config=ChainConfig(epochs_per_migration=0),
+    )
+    _extend_to_finality(chain, harness)
+    assert chain.fork_choice.store.finalized_checkpoint[0] >= 2
+    assert chain.store.split_slot == 0

@@ -382,3 +382,42 @@ def test_transport_encryption_and_plaintext_interop():
     finally:
         for n in nodes:
             n.close()
+
+
+def test_trusted_peer_exempt_from_banning():
+    """--trusted-peers role: trust keys on the configured dialable address
+    at the NETWORK layer, so it applies however the connection arises, and
+    report() never drops a trusted peer's score."""
+    from lighthouse_tpu.chain.beacon_chain import BeaconChain
+    from lighthouse_tpu.network.node import NetworkNode
+    from lighthouse_tpu.network.peer_manager import PeerAction
+    from lighthouse_tpu.testing.harness import StateHarness, clone_state
+
+    bls.set_backend("fake")
+    spec = minimal_spec()
+    h = StateHarness.new(spec, 16)
+    nodes = []
+    try:
+        chain_a = BeaconChain(spec, clone_state(h.state, spec))
+        chain_b = BeaconChain(spec, clone_state(h.state, spec))
+        a = NetworkNode(chain_a, "trust-a", subnets=1)
+        b = NetworkNode(chain_b, "trust-b", subnets=1)
+        nodes = [a, b]
+        # configure trust by b's dialable address BEFORE any connection
+        a.trusted_addrs.add(("127.0.0.1", b.host.listen_addr[1]))
+
+        # INBOUND arrival at a (b dials a): trust must still apply
+        b.connect(a)
+        deadline = time.monotonic() + 5
+        while b.node_id not in a.host.connections and time.monotonic() < deadline:
+            time.sleep(0.02)
+        info = a.peer_manager._peer(b.node_id)
+        assert info.trusted, "inbound trusted peer not marked"
+
+        for _ in range(100):
+            a.peer_manager.report(b.node_id, PeerAction.fatal)
+        assert not a.peer_manager.is_banned(b.node_id)
+        assert a.peer_manager.score(b.node_id) >= 0
+    finally:
+        for n in nodes:
+            n.close()
