@@ -444,10 +444,17 @@ class BeaconChain:
         from ..state_transition.slot import types_for_slot
 
         fin_root = self.fork_choice.store.finalized_checkpoint[1]
+        # the split advances only to the finalized BLOCK's slot (not the
+        # epoch boundary): the finalized block's own state must stay hot
+        # (fork revert loads exactly it), and with a skipped boundary slot
+        # that block sits below the boundary — advancing the split past an
+        # unmigrated block would strand it outside every future walk and
+        # punch a hole in the freezer's chunked root vectors
+        fin_block_slot = self.block_slots.get(fin_root)
+        if fin_block_slot is None or fin_block_slot <= split:
+            return
         seg: list[tuple[int, bytes, bytes]] = []
         root = fin_root
-        # walk finalized -> split by parent links; the finalized block
-        # itself stays hot (fork revert loads the finalized state)
         while root is not None:
             slot = self.block_slots.get(root)
             if slot is None or slot < split:
@@ -455,10 +462,7 @@ class BeaconChain:
             blk = self.store.get_block(root, types_for_slot(self.spec, slot))
             if blk is None:
                 break
-            # the finalized block's own state must STAY hot even when a
-            # skipped epoch-boundary slot puts its slot below fin_slot:
-            # fork revert loads exactly that state (revert_to_fork_boundary)
-            if slot < fin_slot and root != fin_root:
+            if slot < fin_block_slot:
                 seg.append((int(slot), root, bytes(blk.message.state_root)))
             if slot == 0:
                 break
@@ -466,11 +470,13 @@ class BeaconChain:
         if not seg:
             # empty segment still advances the split so the check above
             # does not re-walk every slot
-            self.store.migrate_to_freezer(fin_slot, [], types_for_slot(self.spec, 0))
+            self.store.migrate_to_freezer(
+                fin_block_slot, [], types_for_slot(self.spec, 0)
+            )
             return
         seg.reverse()
         self.store.migrate_to_freezer(
-            fin_slot, seg, types_for_slot(self.spec, seg[0][0])
+            fin_block_slot, seg, types_for_slot(self.spec, seg[0][0])
         )
 
     # ---------------------------------------------------------------- head

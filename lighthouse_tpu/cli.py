@@ -281,21 +281,31 @@ def cmd_bn(args):
             print("error: --graffiti exceeds 32 bytes utf-8", file=sys.stderr)
             return 1
         chain.graffiti = g.ljust(32, b"\x00")
+    def register_monitor_tokens(raw, source):
+        for tok in raw.replace(",", " ").split():
+            try:
+                chain.monitor.register(int(tok))
+            except ValueError:
+                print(f"error: {source}: invalid validator index {tok!r}",
+                      file=sys.stderr)
+                return False
+        return True
+
     if getattr(args, "monitor_validators", None):
         if args.monitor_validators.strip().lower() == "auto":
             chain.monitor.auto_register = True
             log.info("validator monitor: tracking ALL validators")
         else:
-            for tok in args.monitor_validators.split(","):
-                if tok.strip():
-                    chain.monitor.register(int(tok))
+            if not register_monitor_tokens(args.monitor_validators,
+                                           "--monitor-validators"):
+                return 1
             log.info("validator monitor enabled",
                      watched=len(chain.monitor.watched))
     if getattr(args, "validator_monitor_file", None):
         with open(args.validator_monitor_file) as f:
-            for tok in f.read().replace(",", "\n").split():
-                if tok.strip():
-                    chain.monitor.register(int(tok))
+            if not register_monitor_tokens(f.read(),
+                                           "--validator-monitor-file"):
+                return 1
         log.info("validator monitor file loaded",
                  watched=len(chain.monitor.watched))
 
@@ -361,6 +371,38 @@ def cmd_bn(args):
             proc_cfg.max_inflight = args.max_inflight_batches
         if args.processor_workers is not None:
             proc_cfg.num_workers = args.processor_workers
+
+        def parse_hostports(raw, label, resolve=False):
+            out = []
+            for addr in (raw or "").split(","):
+                if not addr:
+                    continue
+                host_s, _, port_s = addr.partition(":")
+                if not port_s.isdigit():
+                    log.warn(f"ignoring malformed {label}", peer=addr)
+                    continue
+                if resolve:
+                    # trust matching compares against the socket's NUMERIC
+                    # peer IP (transport peer_dial_addr) — a hostname
+                    # would silently never match
+                    import socket as _socket
+
+                    try:
+                        host_s = _socket.gethostbyname(host_s)
+                    except OSError as e:
+                        log.warn(f"cannot resolve {label}", peer=addr,
+                                 error=str(e))
+                        continue
+                out.append((host_s, int(port_s)))
+            return out
+
+        static_peers = parse_hostports(args.static_peers, "static peer")
+        # trust is enforced by the NETWORK layer, keyed on the dialable
+        # address (NetworkNode trusted_addrs) — so it must be configured
+        # BEFORE the listener accepts or discovery dials anyone
+        trusted_peers = parse_hostports(
+            args.trusted_peers, "trusted peer", resolve=True
+        )
         net = NetworkNode(
             chain,
             # unique even when --p2p-port 0 picks a random bound port
@@ -368,6 +410,7 @@ def cmd_bn(args):
             fork_digest=digest,
             port=args.p2p_port,
             listen_host=args.listen_address,
+            trusted_addrs=set(trusted_peers),
             heartbeat_interval=args.gossip_heartbeat_interval,
             subnets=args.subnets,
             op_pool=op_pool,
@@ -382,24 +425,6 @@ def cmd_bn(args):
             net.enable_discovery(boot_nodes=args.boot_nodes.split(","))
             dialed = net.discover_and_dial(max_peers=args.target_peers)
             log.info("discovery bootstrap", dialed=dialed)
-        def parse_hostports(raw, label):
-            out = []
-            for addr in (raw or "").split(","):
-                if not addr:
-                    continue
-                host_s, _, port_s = addr.partition(":")
-                if not port_s.isdigit():
-                    log.warn(f"ignoring malformed {label}", peer=addr)
-                    continue
-                out.append((host_s, int(port_s)))
-            return out
-
-        static_peers = parse_hostports(args.static_peers, "static peer")
-        # trust itself is enforced by the NETWORK layer, keyed on the
-        # dialable address (NetworkNode trusted_addrs) — marking survives
-        # failed startup dials, inbound connects, and rediscovery
-        trusted_peers = parse_hostports(args.trusted_peers, "trusted peer")
-        net.trusted_addrs.update(trusted_peers)
 
         def dial_static():
             for host_s, port_i in static_peers + trusted_peers:

@@ -136,3 +136,40 @@ def test_bn_vc_help_snapshots(monkeypatch):
             f"`lighthouse-tpu {name}` help drifted from docs/help_{name}.txt"
             " — if intentional, regenerate the snapshot"
         )
+
+
+def test_bn_wss_checkpoint_guards(tmp_path):
+    """--wss-checkpoint is a SECURITY flag: malformed values and genesis
+    starts (no anchor to verify against) must refuse to start, never
+    silently no-op."""
+    r = run(["bn", "--spec", "minimal", "--interop-validators", "4",
+             "--bls-backend", "fake", "--disable-p2p", "--zero-ports",
+             "--wss-checkpoint", "not-a-checkpoint"], tmp_path)
+    assert r.returncode == 1
+    assert "0xROOT:EPOCH" in r.stderr
+
+    r = run(["bn", "--spec", "minimal", "--interop-validators", "4",
+             "--bls-backend", "fake", "--disable-p2p", "--zero-ports",
+             "--wss-checkpoint", "0x" + "11" * 32 + ":3"], tmp_path)
+    assert r.returncode == 1
+    assert "requires a checkpoint start" in r.stderr
+
+
+def test_bn_purge_db_and_shutdown_after_sync(tmp_path):
+    """--purge-db wipes planted database files before the store opens, and
+    --shutdown-after-sync exits 0 once the head is at the wall clock (a
+    fresh interop chain is 'synced' at its first slot tick). --zero-ports
+    rides along so parallel test runs never collide."""
+    d = tmp_path / "data"
+    d.mkdir()
+    marker = b"\x00garbage that is not a valid kv store"
+    (d / "hot.db").write_bytes(marker)
+    r = run(["bn", "--spec", "minimal", "--interop-validators", "4",
+             "--bls-backend", "fake", "--disable-p2p", "--zero-ports",
+             "--datadir", str(d), "--purge-db", "--shutdown-after-sync"],
+            tmp_path)
+    assert "database purged" in (r.stdout + r.stderr)
+    assert "shutdown: synced" in (r.stdout + r.stderr)
+    assert r.returncode == 0, r.stderr[-2000:]
+    # the planted bytes are gone: the store rebuilt the file from scratch
+    assert (d / "hot.db").read_bytes() != marker
