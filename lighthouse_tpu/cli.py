@@ -372,7 +372,7 @@ def cmd_bn(args):
         if args.processor_workers is not None:
             proc_cfg.num_workers = args.processor_workers
 
-        def parse_hostports(raw, label, resolve=False):
+        def parse_hostports(raw, label):
             out = []
             for addr in (raw or "").split(","):
                 if not addr:
@@ -381,28 +381,28 @@ def cmd_bn(args):
                 if not port_s.isdigit():
                     log.warn(f"ignoring malformed {label}", peer=addr)
                     continue
-                if resolve:
-                    # trust matching compares against the socket's NUMERIC
-                    # peer IP (transport peer_dial_addr) — a hostname
-                    # would silently never match
-                    import socket as _socket
-
-                    try:
-                        host_s = _socket.gethostbyname(host_s)
-                    except OSError as e:
-                        log.warn(f"cannot resolve {label}", peer=addr,
-                                 error=str(e))
-                        continue
                 out.append((host_s, int(port_s)))
             return out
 
         static_peers = parse_hostports(args.static_peers, "static peer")
         # trust is enforced by the NETWORK layer, keyed on the dialable
         # address (NetworkNode trusted_addrs) — so it must be configured
-        # BEFORE the listener accepts or discovery dials anyone
-        trusted_peers = parse_hostports(
-            args.trusted_peers, "trusted peer", resolve=True
-        )
+        # BEFORE the listener accepts or discovery dials anyone. Trust
+        # matching compares against the socket's NUMERIC peer IP, so
+        # hostnames resolve here; a peer that fails to resolve is still
+        # DIALED (the OS resolves at connect time) — it just cannot be
+        # trust-matched until its name resolves
+        trusted_peers = parse_hostports(args.trusted_peers, "trusted peer")
+        trusted_resolved = set()
+        for host_s, port_i in trusted_peers:
+            import socket as _socket
+
+            try:
+                trusted_resolved.add((_socket.gethostbyname(host_s), port_i))
+            except OSError as e:
+                log.warn("trusted peer does not resolve (dialing anyway, "
+                         "trust exemption inactive)",
+                         peer=f"{host_s}:{port_i}", error=str(e))
         net = NetworkNode(
             chain,
             # unique even when --p2p-port 0 picks a random bound port
@@ -410,7 +410,7 @@ def cmd_bn(args):
             fork_digest=digest,
             port=args.p2p_port,
             listen_host=args.listen_address,
-            trusted_addrs=set(trusted_peers),
+            trusted_addrs=trusted_resolved,
             heartbeat_interval=args.gossip_heartbeat_interval,
             subnets=args.subnets,
             op_pool=op_pool,

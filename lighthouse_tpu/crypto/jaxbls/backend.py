@@ -31,6 +31,8 @@ the key objects, mirroring the reference's decompressed ValidatorPubkeyCache
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from ..bls381.constants import P, R, DST_POP
@@ -521,10 +523,10 @@ class JaxBackend:
         px[:n_real] = pack_ints_vec([p[0] if p else 0 for p in pts])
         py[:n_real] = pack_ints_vec([p[1] if p else 0 for p in pts])
         mask[:n_real] = [0 if p is None else 1 for p in pts]
-        bits = np.zeros((n, 256), np.uint32)
-        bits[:n_real] = co.scalars_to_bits([s % R for s in scs], 256)
+        digits = np.zeros((n, 64), np.uint32)
+        digits[:n_real] = co.scalars_to_digits([s % R for s in scs], 256)
 
-        x, y, inf = _get_msm_kernel()(px, py, mask, bits)
+        x, y, inf = _get_msm_kernel()(px, py, mask, digits)
         if bool(np.asarray(inf)):
             return None
         return (lb.unpack(np.asarray(x)), lb.unpack(np.asarray(y)))
@@ -572,18 +574,41 @@ class JaxBackend:
         return bool(np.asarray(ok))
 
 
-def _msm_g1_kernel(px, py, mask, bits):
-    """G1 multi-scalar multiplication: batched double-and-add over all
-    points at once + masked tree reduction (the device path for KZG
-    commitments and proof combination — reference
-    /root/reference/crypto/kzg/src/lib.rs:47-81 via c-kzg's MSM)."""
+def _msm_windowed() -> bool:
+    """Varying-base MSM form selection. WINDOWED (w=4) runs 64 digit steps
+    of (4 doublings + one table add) instead of 256 (double + cond-add) —
+    ~2.4x less sequential depth for the latency-bound small MSMs of the
+    batch blob verifier — but its runtime table build + one-hot gather
+    compiles ~4x slower, so XLA:CPU (the test platform, ~400 HLO ops/s)
+    keeps the bit form. LIGHTHOUSE_TPU_MSM_WINDOWED=0/1 overrides."""
+    env = os.environ.get("LIGHTHOUSE_TPU_MSM_WINDOWED", "").strip().lower()
+    if env:
+        return env not in ("0", "no", "off", "false")
+    import jax
+
+    return jax.default_backend() != "cpu"
+
+
+def _msm_g1_kernel(px, py, mask, digits):
+    """G1 multi-scalar multiplication: batched per-point scalar mults +
+    masked tree reduction (the device path for KZG commitments and proof
+    combination — reference /root/reference/crypto/kzg/src/lib.rs:47-81
+    via c-kzg's MSM). digits: (n, 64) base-16 MSB-first."""
     import jax.numpy as jnp
 
     pxm = _to_mont_dev(px)
     pym = _to_mont_dev(py)
     valid = jnp.asarray(mask, bool)
     jac = co.affine_to_jac(co.FQ_OPS, (pxm, pym), inf_mask=jnp.logical_not(valid))
-    prod = co.scalar_mul_bits(jac, bits, co.FQ_OPS)
+    if _msm_windowed():
+        prod = co.scalar_mul_windowed(jac, digits, co.FQ_OPS)
+    else:
+        # digits -> bits inside the kernel (cheap, data-parallel): keeps
+        # ONE host-side calling convention for both forms
+        weights = jnp.asarray(np.array([8, 4, 2, 1], np.uint32))
+        bits = (digits[..., :, None] // weights[None, None, :]) % 2
+        bits = bits.reshape(digits.shape[0], -1)
+        prod = co.scalar_mul_bits(jac, bits, co.FQ_OPS)
     acc = co.masked_tree_sum(prod, mask, co.FQ_OPS)
     x, y, inf = co.jac_to_affine(acc, co.FQ_OPS)
     return lb.from_mont(x), lb.from_mont(y), inf
@@ -593,12 +618,15 @@ def _get_msm_kernel():
     import jax
 
     _init_consts()
-    if "msm" not in _kernel_cache:
+    # cache per FORM: the windowed/bit branch is baked into the trace, and
+    # tests flip LIGHTHOUSE_TPU_MSM_WINDOWED within one process
+    key = f"msm_w{int(_msm_windowed())}"
+    if key not in _kernel_cache:
         from ...utils.jaxcfg import setup_compilation_cache
 
         setup_compilation_cache()
-        _kernel_cache["msm"] = jax.jit(_msm_g1_kernel)
-    return _kernel_cache["msm"]
+        _kernel_cache[key] = jax.jit(_msm_g1_kernel)
+    return _kernel_cache[key]
 
 
 def _aggregate_kernel(pk_x, pk_y, mask, sig_xy, h_jac):

@@ -105,3 +105,23 @@ def test_kzg_lincomb_prefers_fixed_base_for_large_sets():
     finally:
         bls_api._active_backend = prev
     assert calls == [("fixed", 256), ("var", 256), ("var", 4)]
+
+
+def test_windowed_variable_base_matches_bit_form(points, monkeypatch):
+    """The accelerator's windowed (w=4) varying-base MSM form must agree
+    bit-exactly with the CPU bit form and the host ground truth (the form
+    is selected per platform — backend._msm_windowed)."""
+    from lighthouse_tpu.crypto.bls import api as bls_api
+
+    bls_api.set_backend("jax")
+    backend = bls_api.get_backend()
+    rng = random.Random(0x7711)
+    scalars = [rng.randrange(R) for _ in points]
+    want = _host_msm(points, scalars)
+
+    monkeypatch.setenv("LIGHTHOUSE_TPU_MSM_WINDOWED", "1")
+    got_win = backend.g1_msm(points, scalars)
+    monkeypatch.setenv("LIGHTHOUSE_TPU_MSM_WINDOWED", "0")
+    got_bits = backend.g1_msm(points, scalars)
+    assert got_win == want
+    assert got_bits == want
