@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Measure verify throughput vs batch width on the attached accelerator.
+
+The shared-accumulator pairing pays one fq12_sqr per x-bit and one final
+exponentiation per BATCH, and the h2c/z-scan chains are sequential in
+bits, not sets — so per-batch wall time is nearly batch-size-invariant
+until the VPU lanes saturate and throughput scales with width (the
+measured v5e curve lives in docs/PERF_NOTES.md: 64->100, 128->187,
+256->249, 512->308 sets/s). This script reproduces that curve from the
+committed fixtures (distinct sets up to the fixture width; each result
+is checked, with a negative control on the widest batch).
+
+Usage: python scripts/bench_batch_scaling.py [--widths 64,128,256,512]
+                                             [--batches 4]
+Run to completion — never interrupt a remote compile.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from lighthouse_tpu.utils.jaxcfg import setup_compilation_cache
+
+setup_compilation_cache()
+
+import numpy as np
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--widths", default="64,128,256,512")
+    ap.add_argument("--batches", type=int, default=4)
+    args = ap.parse_args()
+    widths = [int(w) for w in args.widths.split(",")]
+
+    import jax
+
+    log(f"devices: {jax.devices()}")
+
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.crypto.bls import api as bls_api
+
+    z = np.load(os.path.join(os.path.dirname(__file__), "..",
+                             "bench_fixtures.npz"))
+    meta = json.loads(bytes(z["meta"]))
+    n_att = meta["n_att"]
+
+    def fq(a):
+        return int.from_bytes(bytes(a), "big")
+
+    sets = []
+    for i in range(n_att):
+        keys = [bls.PublicKey((fq(k[0]), fq(k[1]))) for k in z["att_keys"][i]]
+        sig = bls.Signature((
+            (fq(z["att_sigs"][i][0, 0]), fq(z["att_sigs"][i][0, 1])),
+            (fq(z["att_sigs"][i][1, 0]), fq(z["att_sigs"][i][1, 1])),
+        ))
+        sets.append(bls.SignatureSet(sig, keys, bytes(z["att_msgs"][i])))
+    log(f"{len(sets)} distinct fixture sets loaded")
+
+    backend = bls_api.set_backend("jax")
+    import random
+
+    rng = random.Random(0xCAFE)
+    results = {}
+    for w in widths:
+        if w > len(sets):
+            log(f"[{w}] skipped: fixture has only {len(sets)} distinct sets")
+            continue
+        batch = sets[:w]
+        rands = [1] + [rng.getrandbits(64) | 1 for _ in range(w - 1)]
+        t0 = time.time()
+        assert backend.verify_signature_sets(batch, rands), f"warm {w} failed"
+        log(f"[{w}] warm (incl. compile): {time.time()-t0:.1f}s")
+        t0 = time.time()
+        for _ in range(args.batches):
+            assert backend.verify_signature_sets(batch, rands)
+        dt = time.time() - t0
+        rate = w * args.batches / dt
+        results[w] = round(rate, 2)
+        log(f"[{w}] {args.batches} batches in {dt:.2f}s -> {rate:.1f} sets/s")
+
+    # negative control on the widest measured batch
+    if results:
+        w = max(results)
+        batch = list(sets[:w])
+        batch[1] = bls.SignatureSet(
+            sets[0].signature, sets[1].signing_keys, sets[1].message
+        )
+        rands = [1] + [rng.getrandbits(64) | 1 for _ in range(w - 1)]
+        assert not backend.verify_signature_sets(batch, rands), (
+            "negative control FAILED"
+        )
+        log(f"[{w}] negative control: tampered batch rejected")
+
+    print(json.dumps({"sets_per_sec_by_width": results}))
+
+
+if __name__ == "__main__":
+    main()
