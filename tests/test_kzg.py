@@ -78,7 +78,7 @@ def test_jax_backend_device_kzg(setup):
         blob = mk_blob()
         commitment = kzg.blob_to_kzg_commitment(blob, setup)
         # the device MSM kernel must have been jitted and used
-        assert "msm" in jb._kernel_cache
+        assert any(k.startswith("msm_w") for k in jb._kernel_cache)
         # cross-check against the host-side ground truth MSM
         poly = kzg.blob_to_polynomial(blob, setup)
         want = None
