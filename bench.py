@@ -120,7 +120,9 @@ def _write_matrix():
             "all vs_est_* ratios divide by ESTIMATED single-core blst/c-kzg "
             "throughputs (EST_* constants in bench.py) — not measurements"
         )
-        with open(os.path.join(_ROOT, "BENCH_MATRIX.json"), "w") as f:
+        # smoke/dry runs must never clobber the on-chip artifact of record
+        name = "BENCH_MATRIX_SMOKE.json" if _SMOKE else "BENCH_MATRIX.json"
+        with open(os.path.join(_ROOT, name), "w") as f:
             json.dump(_MATRIX, f, indent=1)
     except Exception as e:  # pragma: no cover - best effort
         log(f"matrix write failed: {e}")
