@@ -128,6 +128,41 @@ def _write_matrix():
         log(f"matrix write failed: {e}")
 
 
+_DEVICE_KEY: dict = {}  # captured eagerly once jax.devices() succeeds
+
+
+def _write_autotune_profile():
+    """Every dispatch above already landed in the autotune profiler (the
+    jaxbls VerifyHandle hook), so the bench doubles as a calibration run:
+    snapshot the per-bucket timings in device-profile format. Smoke runs
+    write the gitignored *_SMOKE variant — same rule as the matrix — and
+    never the per-device canonical path (an on-chip profile must not be
+    overwritten by a CPU dry-run).
+
+    Uses only the EAGERLY-captured device key (main() fills _DEVICE_KEY
+    right after jax.devices() succeeds): this also runs from the SIGALRM
+    watchdog, where calling back into jax could block on the very wedged
+    tunnel the watchdog exists to escape — no key yet means skip."""
+    if not _DEVICE_KEY:
+        return
+    try:
+        from lighthouse_tpu.autotune import profile as ap
+        from lighthouse_tpu.autotune import profiler as apf
+
+        prof = apf.build_profile(
+            _DEVICE_KEY,
+            source="bench-smoke" if _SMOKE else "bench",
+        )
+        if not prof.buckets:
+            return
+        name = "AUTOTUNE_PROFILE_SMOKE.json" if _SMOKE else "AUTOTUNE_PROFILE.json"
+        path = ap.save(prof, os.path.join(_ROOT, name))
+        _MATRIX["autotune_profile"] = name
+        log(f"autotune profile ({len(prof.buckets)} buckets) -> {path}")
+    except Exception as e:  # pragma: no cover - best effort
+        log(f"autotune profile write failed: {e}")
+
+
 def _arm_watchdog():
     """If the remote-TPU tunnel wedges, fail loudly with the LATEST landed
     headline (warm-batch rate if that's all we got) instead of hanging the
@@ -141,6 +176,7 @@ def _arm_watchdog():
             _HEADLINE["note"] = "watchdog fired before measurement"
         else:
             _HEADLINE["note"] = (_HEADLINE["note"] or "") + "; watchdog fired"
+        _write_autotune_profile()
         _write_matrix()
         print(_headline_json(), flush=True)
         os._exit(3)
@@ -161,52 +197,21 @@ def _tunnel_down(reason: str):
 
 def _load_fixtures():
     """Rebuild SignatureSets (+ the KZG fixture) from the committed npz —
-    no device work, no compiles, ~a second of host int conversion."""
-    import numpy as np
-
-    from lighthouse_tpu.crypto import bls
+    no device work, no compiles, ~a second of host int conversion. The
+    npz wire-format decoders are shared with the autotune calibrator
+    (lighthouse_tpu/autotune/calibrate.py), the other consumer of these
+    fixture files."""
+    from lighthouse_tpu.autotune.calibrate import load_fixture_groups
 
     name = "bench_fixtures_smoke.npz" if _SMOKE else "bench_fixtures.npz"
     path = os.path.join(_ROOT, name)
-    z = np.load(path)
-    meta = json.loads(bytes(z["meta"]))
-
-    def fq(a) -> int:
-        return int.from_bytes(bytes(a), "big")
-
-    def g1(a):
-        return (fq(a[0]), fq(a[1]))
-
-    def g2(a):
-        return ((fq(a[0, 0]), fq(a[0, 1])), (fq(a[1, 0]), fq(a[1, 1])))
-
-    def group(keys, sig, msg):
-        return bls.SignatureSet(
-            bls.Signature(g2(sig)),
-            [bls.PublicKey(g1(k)) for k in keys],
-            bytes(msg),
-        )
 
     t0 = time.time()
-    att = [
-        group(z["att_keys"][i], z["att_sigs"][i], z["att_msgs"][i])
-        for i in range(meta["n_att"])
-    ]
-    small = [
-        group(z["small_keys"][i], z["small_sigs"][i], z["small_msgs"][i])
-        for i in range(2)
-    ]
-    sync = [group(z["sync_keys"], z["sync_sigs"][0], z["sync_msgs"][0])]
-    kzg = {
-        "g1_lagrange": [g1(p) for p in z["kzg_setup_g1"]],
-        "g2_monomial": [g2(p) for p in z["kzg_g2_monomial"]],
-        "blobs": [bytes(b) for b in z["kzg_blobs"]],
-        "commitments": [bytes(c) for c in z["kzg_commitments"]],
-        "proofs": [bytes(p) for p in z["kzg_proofs"]],
-    }
+    fx = load_fixture_groups(path, include_small=True, include_kzg=True)
+    meta = fx["meta"]
     log(f"fixtures loaded from {name} in {time.time()-t0:.1f}s "
         f"({meta['n_att']} att sets x {meta['n_pks']} pks)")
-    return {"att": att, "small": small, "sync": sync, "kzg": kzg, "meta": meta}
+    return fx
 
 
 def _rands(rng, n):
@@ -453,6 +458,12 @@ def main():
 
     log(f"devices: {devices}")
     _MATRIX["devices"] = str(devices)
+    try:
+        from lighthouse_tpu.autotune.profile import current_device_key
+
+        _DEVICE_KEY.update(current_device_key())
+    except Exception as e:
+        log(f"autotune device key capture failed: {e}")
     # fused Pallas kernels stay OFF in auto mode until scripts/probe_pallas.py
     # has recorded a validated Mosaic lowering for THIS platform — the gate
     # lives in pallas_ops.mode()/_probed_ok() so every entry point shares it
@@ -523,6 +534,7 @@ def main():
         attempt("config2", 600, lambda: run_full_block(backend, fx, rng))
         attempt("config4", 600, lambda: run_kzg(fx))
     finally:
+        _write_autotune_profile()
         _write_matrix()
         print(_headline_json(), flush=True)
 

@@ -1,0 +1,303 @@
+"""Offline calibration sweep: measure the padding buckets, write a profile.
+
+Entry points: `scripts/autotune_calibrate.py` and the `autotune calibrate`
+CLI subcommand, both thin wrappers over `run_from_args`.
+
+Two modes:
+
+  - device calibration (default): the jaxbls backend verifies fixture
+    workloads at a sweep of padding buckets; its built-in profiler hooks
+    record compile time (first dispatch per bucket) and steady-state
+    latency. Run this once per device inside a TPU session; the profile
+    lands at its canonical per-device path (profile.default_path) where
+    the node autoloads it at bring-up.
+  - `--smoke`: a CPU dry-run of the whole measure -> profile -> plan
+    pipeline using the committed tiny fixtures (bench_fixtures_smoke.npz)
+    and the pure-python BLS backend. The python backend is deliberate: a
+    cold XLA:CPU compile of the verify pipeline takes MINUTES per bucket
+    on this image (tests/README.md), far outside tier-1 time limits, while
+    the host path measures the same plumbing in seconds. Smoke output goes
+    to a gitignored path — the bb83860 lesson: a CPU dry-run must never
+    clobber the on-chip artifact of record.
+
+Fixture workloads (from scripts/gen_bench_fixtures.py npz files):
+single urgent set, attestation batches at power-of-two slices, and the
+sync-committee aggregate (the wide-pubkey bucket). Every measurement is
+also a correctness check — a calibration verify returning False aborts
+the sweep.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import time
+
+from ..utils.logging import get_logger
+
+
+class CalibrationError(RuntimeError):
+    pass
+
+
+def _log(msg, **kw):
+    get_logger("autotune.calibrate").info(msg, **kw)
+
+
+# ----------------------------------------------------------------- fixtures
+
+
+def fq_int(a) -> int:
+    """big-endian fixture bytes -> field element int (npz wire format of
+    scripts/gen_bench_fixtures.py; bench.py shares these decoders)."""
+    return int.from_bytes(bytes(a), "big")
+
+
+def g1_point(a):
+    return (fq_int(a[0]), fq_int(a[1]))
+
+
+def g2_point(a):
+    return (
+        (fq_int(a[0, 0]), fq_int(a[0, 1])),
+        (fq_int(a[1, 0]), fq_int(a[1, 1])),
+    )
+
+
+def signature_set(keys, sig, msg):
+    from ..crypto import bls
+
+    return bls.SignatureSet(
+        bls.Signature(g2_point(sig)),
+        [bls.PublicKey(g1_point(k)) for k in keys],
+        bytes(msg),
+    )
+
+
+def load_fixture_groups(path: str, include_small: bool = False,
+                        include_kzg: bool = False) -> dict:
+    """SignatureSet groups from a bench fixtures npz (attestation sets,
+    the sync aggregate; optionally the 2 small sets and the KZG fixture).
+    Host-only int conversion, no device work, no compiles. One archive
+    open serves both this calibrator and bench.py."""
+    import numpy as np
+
+    z = np.load(path)
+    meta = json.loads(bytes(z["meta"]))
+    att = [
+        signature_set(z["att_keys"][i], z["att_sigs"][i], z["att_msgs"][i])
+        for i in range(meta["n_att"])
+    ]
+    sync = [signature_set(z["sync_keys"], z["sync_sigs"][0], z["sync_msgs"][0])]
+    out = {"att": att, "sync": sync, "meta": meta}
+    if include_small:
+        out["small"] = [
+            signature_set(z["small_keys"][i], z["small_sigs"][i], z["small_msgs"][i])
+            for i in range(2)
+        ]
+    if include_kzg:
+        out["kzg"] = {
+            "g1_lagrange": [g1_point(p) for p in z["kzg_setup_g1"]],
+            "g2_monomial": [g2_point(p) for p in z["kzg_g2_monomial"]],
+            "blobs": [bytes(b) for b in z["kzg_blobs"]],
+            "commitments": [bytes(c) for c in z["kzg_commitments"]],
+            "proofs": [bytes(p) for p in z["kzg_proofs"]],
+        }
+    return out
+
+
+def bucket_of(sets) -> tuple:
+    """The (n_sets, n_pks) padding bucket the jaxbls backend would compile
+    for this workload (the dispatch path's own rounding rule)."""
+    from ..crypto.jaxbls.backend import padding_bucket
+
+    return padding_bucket(
+        len(sets), max(len(s.signing_keys) for s in sets)
+    )
+
+
+def _rands(rng, n):
+    return [1] + [rng.getrandbits(64) | 1 for _ in range(n - 1)]
+
+
+def sweep_workloads(groups: dict, smoke: bool) -> list:
+    """Ordered (label, sets) workloads; deduped by padding bucket so each
+    bucket is measured once per sweep."""
+    att = groups["att"]
+    slices = [1, len(att)] if smoke else [1, 4, 16, 64, len(att)]
+    out, seen = [], set()
+    for k in slices:
+        k = max(1, min(k, len(att)))
+        sets = att[:k]
+        b = bucket_of(sets)
+        if b not in seen:
+            seen.add(b)
+            out.append((f"att[{k}]", sets))
+    b = bucket_of(groups["sync"])
+    if b not in seen:
+        out.append(("sync_aggregate", groups["sync"]))
+    return out
+
+
+# --------------------------------------------------------------- measuring
+
+
+def measure_backend(backend, workloads, reps: int, rng=None) -> None:
+    """Time `reps + 1` verifies per workload into the profiler (the first
+    pays compile/setup and is classified as such). The jaxbls backend
+    self-records through its dispatch hooks (autotune_self_recording);
+    anything else is timed here."""
+    from . import profiler
+
+    rng = rng or random.Random(0xA07)
+    self_recording = getattr(backend, "autotune_self_recording", False)
+    for label, sets in workloads:
+        bucket = bucket_of(sets)
+        rands = _rands(rng, len(sets))
+        for rep in range(reps + 1):
+            t0 = time.perf_counter()
+            ok = backend.verify_signature_sets(sets, rands)
+            dt = time.perf_counter() - t0
+            if not ok:
+                raise CalibrationError(
+                    f"calibration workload {label} failed to verify "
+                    f"(bucket {bucket}, rep {rep})"
+                )
+            if not self_recording:
+                profiler.observe_dispatch(*bucket, dt, len(sets))
+            _log("measured", workload=label, bucket=str(bucket), rep=rep,
+                 secs=round(dt, 3))
+
+
+def measure_host_reference(sets, reps: int) -> dict:
+    """Host (pure python) single-set verify time — the planner's reference
+    for the urgent-set threshold."""
+    from ..crypto.bls import api as bls_api
+
+    host = bls_api._BACKENDS["python"]
+    one = sets[:1]
+    samples = []
+    for _ in range(max(1, reps)):
+        t0 = time.perf_counter()
+        if not host.verify_signature_sets(one, [1]):
+            raise CalibrationError("host reference verify failed")
+        samples.append(time.perf_counter() - t0)
+    return {"single_set_ms": round(sum(samples) / len(samples) * 1e3, 3)}
+
+
+# --------------------------------------------------------------------- run
+
+
+def add_calibrate_args(p) -> None:
+    """Shared flags for scripts/autotune_calibrate.py and `autotune
+    calibrate`."""
+    p.add_argument("--smoke", action="store_true",
+                   help="CPU dry-run: tiny fixtures, pure-python backend, "
+                        "gitignored output (never the on-device profile)")
+    p.add_argument("--fixtures", default=None,
+                   help="bench fixtures npz (default: bench_fixtures.npz, "
+                        "or the smoke variant with --smoke)")
+    p.add_argument("--backend", default=None, choices=["jax", "python"],
+                   help="measured backend (default: jax; --smoke: python)")
+    p.add_argument("--reps", type=int, default=None,
+                   help="timed reps per bucket after the compile rep "
+                        "(default: 6; --smoke: 2)")
+    p.add_argument("--out", default=None,
+                   help="profile output path (default: the canonical "
+                        "per-device path; --smoke: "
+                        "./autotune_profile_smoke.json)")
+
+
+def run_from_args(args) -> tuple:
+    """Execute a calibration described by an argparse namespace with the
+    `add_calibrate_args` attributes. Returns (DeviceProfile, path)."""
+    from . import planner, profile, profiler
+
+    smoke = bool(getattr(args, "smoke", False))
+    backend_name = args.backend or ("python" if smoke else "jax")
+    reps = args.reps if args.reps is not None else (2 if smoke else 6)
+
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    fixtures = args.fixtures or os.path.join(
+        repo_root,
+        "bench_fixtures_smoke.npz" if smoke else "bench_fixtures.npz",
+    )
+
+    if smoke:
+        # pin the CPU platform BEFORE any backend initializes, like
+        # bench.py's smoke mode: a smoke run must never touch a tunnel
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    from ..utils.jaxcfg import setup_compilation_cache
+
+    setup_compilation_cache()
+
+    _log("calibration starting", smoke=smoke, backend=backend_name,
+         fixtures=fixtures, reps=reps)
+    groups = load_fixture_groups(fixtures)
+
+    from ..crypto.bls import api as bls_api
+
+    backend = bls_api.set_backend(backend_name)
+    workloads = sweep_workloads(groups, smoke)
+    t0 = time.time()
+    measure_backend(backend, workloads, reps)
+    host = measure_host_reference(groups["att"], 1 if smoke else 3)
+
+    try:
+        key = profile.current_device_key(bls_backend=backend_name)
+    except Exception as e:  # no jax device at all: still a valid profile
+        key = {
+            "platform": "unknown", "device_kind": "unknown",
+            "num_devices": 0, "jax_version": "unknown",
+            "backend_revision": profile.BACKEND_REVISION,
+            "bls_backend": backend_name,
+        }
+        _log("device key detection failed", error=f"{type(e).__name__}: {e}")
+
+    prof = profiler.build_profile(
+        key, source="calibrate-smoke" if smoke else "calibrate", host=host
+    )
+    if not prof.buckets:
+        raise CalibrationError("sweep recorded no buckets")
+
+    out = args.out or (
+        os.path.join(repo_root, "autotune_profile_smoke.json")
+        if smoke
+        else profile.default_path(key)
+    )
+    path = profile.save(prof, out)
+    plan = planner.plan_from_profile(prof)
+    _log("calibration complete", secs=round(time.time() - t0, 1),
+         buckets=len(prof.buckets), path=path)
+    _log("derived plan", max_attestation_batch=plan.max_attestation_batch,
+         max_aggregate_batch=plan.max_aggregate_batch,
+         p99_budget_ms=plan.p99_budget_ms,
+         urgent_max_sets=plan.urgent_max_sets,
+         warmup_buckets=str(list(plan.warmup_buckets)))
+    return prof, path
+
+
+def cli_main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="autotune_calibrate",
+        description="measure the BLS verification padding buckets on this "
+                    "device and write an autotune profile",
+    )
+    add_calibrate_args(p)
+    args = p.parse_args(argv)
+    _prof, path = run_from_args(args)
+    from ..utils.metrics import REGISTRY
+
+    series = sum(
+        1 for line in REGISTRY.expose_text().splitlines()
+        if line.startswith("autotune_")
+    )
+    print(json.dumps({"profile": path, "autotune_metric_series": series}))
+    return 0

@@ -1,0 +1,157 @@
+"""Planner: pure, deterministic derivation of serving knobs from a profile.
+
+Given a DeviceProfile, `plan_from_profile` derives:
+
+  - the beacon processor's batch caps (replacing the guessed
+    DEFAULT_MAX_*_BATCH constants when a profile is installed),
+  - the hybrid router's p99 budget and urgent-set threshold (env vars and
+    constructor args stay explicit overrides — see crypto/bls/hybrid.py
+    knob precedence),
+  - the startup warmup plan: the ordered buckets worth precompiling via
+    jaxbls `warm_stages` before traffic arrives.
+
+The function is pure (no IO, no clocks, no randomness): the same profile
+JSON always yields the identical Plan, which is what makes a persisted
+profile equivalent to re-measuring. Derivation rules, in order:
+
+  batch caps   The measured bucket with the best sets/sec marks peak
+               throughput; the cap is the SMALLEST bucket achieving >= 90%
+               of it (the throughput knee — beyond it, wider batches only
+               add latency). A knee sitting at the sweep's LARGEST bucket
+               means throughput was still rising when measurement stopped,
+               so the cap never drops below the default on that evidence.
+               Aggregate cap is half the attestation cap (aggregates carry
+               ~2x the pubkey work per set).
+  p99 budget   2x the p99 of the smallest measured bucket (the urgent
+               path's bucket): the router reroutes small batches to the
+               host only when the device is doing twice as badly as it
+               did when calibrated. Clamped to [50 ms, 5 s].
+  urgent sets  The largest measured bucket size n where n sequential
+               host verifies still beat the bucket's device p50 — below
+               that, the host path wins on latency. Needs the profile's
+               host reference measurement; defaults to 4 without one.
+  warmup plan  Measured buckets ordered by achieved sets/sec (descending;
+               ties: smaller first, so cheap compiles land early), capped
+               at 4 buckets. With no measured buckets the node warms the
+               two highest-traffic default shapes: the subnet-attestation
+               firehose (1024 x 1, the fast compile) then the aggregate
+               bucket (512 x 128).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .profile import DeviceProfile
+
+# Mirrors chain/beacon_processor.py DEFAULT_MAX_*_BATCH and the hybrid
+# router's built-in defaults — duplicated here (not imported) so the
+# planner stays import-cycle-free; test_autotune pins them equal.
+DEFAULT_MAX_ATTESTATION_BATCH = 1024
+DEFAULT_MAX_AGGREGATE_BATCH = 512
+DEFAULT_P99_BUDGET_MS = 500.0
+DEFAULT_URGENT_MAX_SETS = 4
+
+# (n_sets, n_pks) shapes warmed when no profile exists: gossip subnet
+# attestations (single-signer sets, m=1 — compiles fastest, carries the
+# most traffic) then coalesced aggregates (committee-wide pubkey sets).
+DEFAULT_WARMUP_BUCKETS = (
+    (DEFAULT_MAX_ATTESTATION_BATCH, 1),
+    (DEFAULT_MAX_AGGREGATE_BATCH, 128),
+)
+
+KNEE_FRACTION = 0.9          # "within 10% of peak sets/sec" knee rule
+MAX_BATCH_CAP = 4096         # sanity ceiling on derived caps
+MIN_BATCH_CAP = 4            # jaxbls MIN_SETS floor
+P99_BUDGET_FACTOR = 2.0
+P99_BUDGET_CLAMP_MS = (50.0, 5000.0)
+MAX_WARMUP_BUCKETS = 4
+
+
+@dataclass(frozen=True)
+class Plan:
+    """Deterministic serving knobs derived from one device profile."""
+
+    max_attestation_batch: int = DEFAULT_MAX_ATTESTATION_BATCH
+    max_aggregate_batch: int = DEFAULT_MAX_AGGREGATE_BATCH
+    p99_budget_ms: float = DEFAULT_P99_BUDGET_MS
+    urgent_max_sets: int = DEFAULT_URGENT_MAX_SETS
+    warmup_buckets: tuple = DEFAULT_WARMUP_BUCKETS
+    source: str = "defaults"
+
+
+DEFAULT_PLAN = Plan()
+
+
+def _clamp(v, lo, hi):
+    return max(lo, min(hi, v))
+
+
+def plan_from_profile(profile: DeviceProfile) -> Plan:
+    """Pure Plan derivation; see the module docstring for the rules."""
+    measured = sorted(
+        (b for b in profile.buckets.values()
+         if b.sets_per_sec is not None and b.samples > 0),
+        key=lambda b: (b.n_sets, b.n_pks),
+    )
+    source = f"profile:{profile.key_string()}"
+
+    # ---- batch caps: smallest bucket within KNEE_FRACTION of peak rate.
+    # If that knee IS the largest measured bucket, throughput was still
+    # rising when the sweep ended — the data shows nothing about wider
+    # batches, so only a knee OBSERVED inside the sweep may lower the cap
+    # below the default (a profile changes a knob only when measurement
+    # supports the change).
+    att_cap = DEFAULT_MAX_ATTESTATION_BATCH
+    if measured:
+        peak = max(b.sets_per_sec for b in measured)
+        knee = min(
+            (b.n_sets for b in measured
+             if b.sets_per_sec >= KNEE_FRACTION * peak),
+        )
+        if knee == max(b.n_sets for b in measured):
+            knee = max(knee, DEFAULT_MAX_ATTESTATION_BATCH)
+        att_cap = int(_clamp(knee, MIN_BATCH_CAP, MAX_BATCH_CAP))
+    agg_cap = max(MIN_BATCH_CAP, att_cap // 2)
+
+    # ---- p99 budget from the smallest (urgent) measured bucket
+    p99_budget = DEFAULT_P99_BUDGET_MS
+    smallest = next((b for b in measured if b.p99_ms is not None), None)
+    if smallest is not None:
+        p99_budget = _clamp(
+            P99_BUDGET_FACTOR * smallest.p99_ms, *P99_BUDGET_CLAMP_MS
+        )
+
+    # ---- urgent threshold: host wins while n * host_ms <= device p50
+    urgent = DEFAULT_URGENT_MAX_SETS
+    host_ms = None
+    if profile.host:
+        host_ms = profile.host.get("single_set_ms")
+    if host_ms:
+        candidates = [
+            b.n_sets
+            for b in measured
+            if b.p50_ms is not None and b.n_sets * host_ms <= b.p50_ms
+        ]
+        urgent = max(candidates) if candidates else 1
+
+    # ---- warmup: best-throughput buckets first; cheap shapes break ties
+    if measured:
+        ordered = sorted(
+            measured,
+            key=lambda b: (-b.sets_per_sec, b.n_sets, b.n_pks),
+        )
+        warmup = tuple(
+            (b.n_sets, b.n_pks) for b in ordered[:MAX_WARMUP_BUCKETS]
+        )
+    else:
+        warmup = DEFAULT_WARMUP_BUCKETS
+
+    return Plan(
+        max_attestation_batch=att_cap,
+        max_aggregate_batch=agg_cap,
+        p99_budget_ms=round(float(p99_budget), 3),
+        urgent_max_sets=int(urgent),
+        warmup_buckets=warmup,
+        source=source,
+    )

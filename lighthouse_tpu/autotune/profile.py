@@ -1,0 +1,218 @@
+"""The device profile: what autotune learned about one device, on disk.
+
+A profile is a versioned JSON document keyed by the device identity
+(platform + device kind + device count), the jax version, and the jaxbls
+backend revision — any of those changing invalidates the learned numbers
+the same way it invalidates the persistent jit cache, so profiles live in
+a sibling directory of that cache (utils/jaxcfg.py) and a restarted node
+on the same device skips re-learning.
+
+Schema (version 1):
+
+    {
+      "schema_version": 1,
+      "key": {"platform": "tpu", "device_kind": "TPU v5e",
+              "num_devices": 1, "jax_version": "0.9.0",
+              "backend_revision": "r5", "bls_backend": "jax"},
+      "source": "calibrate" | "calibrate-smoke" | "bench" | "runtime",
+      "created_unix": 1700000000.0,
+      "host": {"single_set_ms": 577.0},            # optional host reference
+      "buckets": [
+        {"n_sets": 64, "n_pks": 128, "samples": 8,
+         "compile_secs": 616.2,                     # null when unmeasured
+         "p50_ms": 640.0, "p99_ms": 700.0, "sets_per_sec": 99.85}
+      ]
+    }
+
+Everything here is stdlib-only and jax-free except `current_device_key`,
+which callers invoke only from contexts where initializing the jax backend
+is acceptable (the calibrator, the warmup thread) — never from node hot
+paths, where a dead device tunnel must not block.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from dataclasses import dataclass, field
+
+SCHEMA_VERSION = 1
+
+# Bump when the jaxbls kernel structure changes enough that measured
+# compile/dispatch numbers stop transferring (mirrors the implicit
+# invalidation of the persistent jit cache).
+BACKEND_REVISION = "r5"
+
+
+@dataclass
+class BucketProfile:
+    """Measured behavior of one (n_sets, n_pks) padding bucket."""
+
+    n_sets: int
+    n_pks: int
+    samples: int = 0
+    compile_secs: float | None = None
+    p50_ms: float | None = None
+    p99_ms: float | None = None
+    sets_per_sec: float | None = None
+
+    def to_json(self) -> dict:
+        return {
+            "n_sets": int(self.n_sets),
+            "n_pks": int(self.n_pks),
+            "samples": int(self.samples),
+            "compile_secs": self.compile_secs,
+            "p50_ms": self.p50_ms,
+            "p99_ms": self.p99_ms,
+            "sets_per_sec": self.sets_per_sec,
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "BucketProfile":
+        return cls(
+            n_sets=int(d["n_sets"]),
+            n_pks=int(d["n_pks"]),
+            samples=int(d.get("samples", 0)),
+            compile_secs=_opt_float(d.get("compile_secs")),
+            p50_ms=_opt_float(d.get("p50_ms")),
+            p99_ms=_opt_float(d.get("p99_ms")),
+            sets_per_sec=_opt_float(d.get("sets_per_sec")),
+        )
+
+
+@dataclass
+class DeviceProfile:
+    key: dict
+    buckets: dict = field(default_factory=dict)  # (n_sets, n_pks) -> BucketProfile
+    host: dict | None = None
+    source: str = "unknown"
+    created_unix: float | None = None
+
+    def key_string(self) -> str:
+        """Stable, filesystem-safe identity string for file naming. The
+        measured bls backend is part of the identity: a pure-python
+        calibration must never land on (and clobber) the jax device
+        profile the node autoloads."""
+        parts = [
+            str(self.key.get("platform", "unknown")),
+            str(self.key.get("device_kind", "unknown")),
+            f"x{self.key.get('num_devices', 1)}",
+            f"jax{self.key.get('jax_version', 'unknown')}",
+            str(self.key.get("backend_revision", BACKEND_REVISION)),
+            str(self.key.get("bls_backend", "jax")),
+        ]
+        return re.sub(r"[^A-Za-z0-9_.-]+", "-", "_".join(parts))
+
+    def to_json(self) -> dict:
+        return {
+            "schema_version": SCHEMA_VERSION,
+            "key": dict(self.key),
+            "source": self.source,
+            "created_unix": self.created_unix,
+            "host": dict(self.host) if self.host else None,
+            "buckets": [
+                self.buckets[k].to_json() for k in sorted(self.buckets)
+            ],
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "DeviceProfile":
+        version = d.get("schema_version")
+        if version != SCHEMA_VERSION:
+            raise ValueError(
+                f"unsupported autotune profile schema_version {version!r} "
+                f"(this build reads {SCHEMA_VERSION})"
+            )
+        key = d.get("key")
+        if not isinstance(key, dict):
+            raise ValueError("autotune profile missing 'key' object")
+        buckets = {}
+        for b in d.get("buckets", []):
+            try:
+                bp = BucketProfile.from_json(b)
+            except (KeyError, TypeError, ValueError, AttributeError) as e:
+                raise ValueError(
+                    f"malformed autotune profile bucket entry {b!r}: "
+                    f"{type(e).__name__}: {e}"
+                ) from e
+            buckets[(bp.n_sets, bp.n_pks)] = bp
+        host = d.get("host")
+        if host is not None and not isinstance(host, dict):
+            raise ValueError("autotune profile 'host' must be an object")
+        return cls(
+            key=dict(key),
+            buckets=buckets,
+            host=dict(host) if host else None,
+            source=str(d.get("source", "unknown")),
+            created_unix=_opt_float(d.get("created_unix")),
+        )
+
+
+def _opt_float(v):
+    return None if v is None else float(v)
+
+
+# ------------------------------------------------------------- persistence
+
+
+def profile_dir() -> str:
+    """Directory the per-device profiles live in — a sibling of the
+    persistent jit cache's per-platform directories, overridable for tests
+    via LIGHTHOUSE_TPU_AUTOTUNE_DIR."""
+    env = os.environ.get("LIGHTHOUSE_TPU_AUTOTUNE_DIR")
+    if env:
+        return env
+    from ..utils.jaxcfg import cache_base_dir
+
+    return os.path.join(cache_base_dir(), "autotune")
+
+
+def default_path(profile_or_key) -> str:
+    """Canonical on-disk location for a profile (or a key dict)."""
+    if isinstance(profile_or_key, DeviceProfile):
+        key_string = profile_or_key.key_string()
+    else:
+        key_string = DeviceProfile(key=dict(profile_or_key)).key_string()
+    return os.path.join(profile_dir(), f"{key_string}.json")
+
+
+def save(profile: DeviceProfile, path: str | None = None) -> str:
+    if profile.created_unix is None:
+        profile.created_unix = time.time()
+    path = path or default_path(profile)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(profile.to_json(), f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)  # atomic: a concurrent reader never sees a torn file
+    return path
+
+
+def load(path: str) -> DeviceProfile:
+    with open(path) as f:
+        return DeviceProfile.from_json(json.load(f))
+
+
+# ------------------------------------------------------------- device key
+
+
+def current_device_key(bls_backend: str = "jax") -> dict:
+    """Identity of the attached device(s). Initializes the jax backend —
+    only call where that is acceptable (calibrator / warmup thread), never
+    from a node hot path that must not block on a dead tunnel."""
+    import jax
+
+    devices = jax.devices()
+    return {
+        "platform": devices[0].platform if devices else "none",
+        "device_kind": devices[0].device_kind if devices else "none",
+        "num_devices": len(devices),
+        "jax_version": jax.__version__,
+        "backend_revision": BACKEND_REVISION,
+        "bls_backend": bls_backend,
+    }

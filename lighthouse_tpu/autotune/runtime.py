@@ -1,0 +1,218 @@
+"""Process-global autotune state: the installed profile/plan + warmup.
+
+`install_profile` makes a profile (and its derived Plan) the process-wide
+source of serving knobs; `active_plan` is what the consumers —
+BeaconProcessorConfig's default caps and HybridBackend's knob resolution —
+consult. With nothing installed both fall back to their historical
+hard-coded defaults, byte-identical to the pre-autotune behavior.
+
+`autoload` restores a persisted profile for the current device at node
+bring-up. Device identity requires `jax.devices()`, which can block for
+minutes on a dead remote-TPU tunnel (the exact failure hybrid.py's probe
+exists for), so detection runs in a daemon thread with a bounded wait —
+a node started during a tunnel outage just serves on defaults.
+
+`start_warmup` is the node-side consumer of the plan's warmup buckets: a
+daemon thread that precompiles each planned (n_sets, n_pks) shape through
+jaxbls `warm_stages` so the first real batches skip the multi-minute cold
+compile. Before this existed `warm_stages` was dead code from the node's
+perspective (only bench/tests called it).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from ..utils.logging import get_logger
+from .planner import DEFAULT_WARMUP_BUCKETS, Plan, plan_from_profile
+from .profile import DeviceProfile
+
+_lock = threading.Lock()
+_state: dict = {"profile": None, "plan": None}
+
+
+def install_profile(profile: DeviceProfile, path: str | None = None) -> Plan:
+    """Make `profile` the process-wide knob source; returns its Plan."""
+    plan = plan_from_profile(profile)
+    measured_backend = profile.key.get("bls_backend")
+    if measured_backend not in (None, "jax"):
+        # e.g. a gitignored CPU smoke profile pinned via --autotune-profile:
+        # install it (the operator asked), but say loudly that its numbers
+        # were not measured on the device path the node will serve with
+        get_logger("autotune").warn(
+            "installed profile was measured on a non-device backend; its "
+            "derived knobs may not fit the jax serving path",
+            measured_backend=measured_backend,
+        )
+    with _lock:
+        _state["profile"] = profile
+        _state["plan"] = plan
+    get_logger("autotune").info(
+        "autotune profile installed",
+        source=plan.source,
+        path=path or "",
+        max_attestation_batch=plan.max_attestation_batch,
+        max_aggregate_batch=plan.max_aggregate_batch,
+        p99_budget_ms=plan.p99_budget_ms,
+        urgent_max_sets=plan.urgent_max_sets,
+        warmup_buckets=str(list(plan.warmup_buckets)),
+    )
+    return plan
+
+
+def active_plan() -> Plan | None:
+    with _lock:
+        return _state["plan"]
+
+
+def active_profile() -> DeviceProfile | None:
+    with _lock:
+        return _state["profile"]
+
+
+def clear() -> None:
+    """Uninstall (tests): consumers return to the hard-coded defaults."""
+    with _lock:
+        _state["profile"] = None
+        _state["plan"] = None
+
+
+# ---------------------------------------------------------------- autoload
+
+
+def detect_device_key(wait_secs: float = 5.0) -> dict | None:
+    """Resolve the current device key in a daemon thread bounded by
+    `wait_secs` (jax.devices() can block for minutes on a dead remote-TPU
+    tunnel). Returns None on timeout or any detection failure."""
+    from . import profile as prof
+
+    result: list = []
+    done = threading.Event()
+
+    def detect():
+        try:
+            result.append(prof.current_device_key())
+        except Exception as e:  # no device / import failure
+            result.append(e)
+        done.set()
+
+    threading.Thread(target=detect, daemon=True,
+                     name="autotune-device-detect").start()
+    if not done.wait(wait_secs):
+        return None
+    if not result or isinstance(result[0], Exception):
+        return None
+    return result[0]
+
+
+def autoload(wait_secs: float | None = None,
+             path: str | None = None) -> Plan | None:
+    """Load + install a persisted profile for the current device, if any.
+
+    Resolution order: LIGHTHOUSE_TPU_AUTOTUNE=0 disables everything; an
+    explicit `path` (or LIGHTHOUSE_TPU_AUTOTUNE_PROFILE) is loaded without
+    device detection; otherwise the device key is resolved in a daemon
+    thread bounded by `wait_secs` (LIGHTHOUSE_TPU_AUTOTUNE_WAIT_SECS,
+    default 5 s) and the canonical per-device file is tried. Returns the
+    installed Plan, or None (no profile / disabled / detection timeout) —
+    never raises, never blocks unboundedly."""
+    log = get_logger("autotune")
+    if os.environ.get("LIGHTHOUSE_TPU_AUTOTUNE", "1") in ("0", "off", "no"):
+        return None
+    from . import profile as prof
+
+    path = path or os.environ.get("LIGHTHOUSE_TPU_AUTOTUNE_PROFILE")
+    if path:
+        try:
+            return install_profile(prof.load(path), path=path)
+        except Exception as e:
+            log.warn("autotune profile load failed; serving on defaults",
+                     path=path, error=f"{type(e).__name__}: {e}")
+            return None
+
+    if wait_secs is None:
+        try:
+            wait_secs = float(
+                os.environ.get("LIGHTHOUSE_TPU_AUTOTUNE_WAIT_SECS", 5.0)
+            )
+        except ValueError:
+            wait_secs = 5.0
+
+    key = detect_device_key(wait_secs)
+    if key is None:
+        log.warn("autotune device detection failed or timed out; serving "
+                 "on defaults", wait_secs=wait_secs)
+        return None
+    candidate = prof.default_path(key)
+    if not os.path.isfile(candidate):
+        log.info("no autotune profile for this device; serving on defaults",
+                 expected_path=candidate)
+        return None
+    try:
+        return install_profile(prof.load(candidate), path=candidate)
+    except Exception as e:
+        log.warn("autotune profile load failed; serving on defaults",
+                 path=candidate, error=f"{type(e).__name__}: {e}")
+        return None
+
+
+# ----------------------------------------------------------------- warmup
+
+
+def warmup_buckets() -> tuple:
+    """The active plan's warmup buckets, or the default pair."""
+    plan = active_plan()
+    return plan.warmup_buckets if plan is not None else DEFAULT_WARMUP_BUCKETS
+
+
+def start_warmup(buckets=None, warm_fn=None) -> threading.Thread:
+    """Precompile the warmup buckets in a background daemon thread.
+
+    Called from node bring-up (cli.cmd_bn) when the device-backed BLS
+    backends are selected. On the hybrid backend the buckets warm through
+    `HybridBackend.warm_bucket` — a full-pipeline dummy verify that also
+    marks the bucket warm for ROUTING (its own probe bounds the device
+    wait); on the plain jax backend they warm through jaxbls
+    `warm_stages` after confirming a device is reachable (jax.devices()
+    — safe to block HERE, it is a daemon thread). Compile times land in
+    the profiler either way. Any failure degrades to cold-compile-on-
+    first-dispatch, never to a crashed node."""
+    log = get_logger("autotune")
+    plan_buckets = tuple(buckets) if buckets is not None else warmup_buckets()
+
+    def run():
+        try:
+            if warm_fn is not None:
+                fn = warm_fn
+            else:
+                from ..crypto.bls import api as bls_api
+
+                backend = bls_api.get_backend()
+                if hasattr(backend, "warm_bucket"):
+                    fn = backend.warm_bucket
+                else:
+                    import jax
+
+                    jax.devices()  # may block on a dead tunnel: daemon thread
+                    from ..crypto.jaxbls.backend import warm_stages as fn
+            import time as _time
+
+            for n_sets, n_pks in plan_buckets:
+                t0 = _time.time()
+                ok = fn(n_sets, n_pks)
+                if ok is False:  # warm_bucket: device down/failed (None =
+                    log.warn(    # warm_stages, which raises on failure)
+                        "warmup bucket skipped (device unavailable or "
+                        "warm failed)", n_sets=n_sets, n_pks=n_pks,
+                    )
+                else:
+                    log.info("warmup bucket done", n_sets=n_sets,
+                             n_pks=n_pks, secs=round(_time.time() - t0, 1))
+        except Exception as e:
+            log.warn("startup warmup abandoned (first dispatches will "
+                     "pay the compile)", error=f"{type(e).__name__}: {e}")
+
+    t = threading.Thread(target=run, daemon=True, name="autotune-warmup")
+    t.start()
+    return t

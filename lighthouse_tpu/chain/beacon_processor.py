@@ -50,6 +50,21 @@ class WorkKind(IntEnum):
 
 DEFAULT_MAX_ATTESTATION_BATCH = 1024   # reference default 64; sized for TPU
 DEFAULT_MAX_AGGREGATE_BATCH = 512
+
+
+def _planned(attr: str, default: int) -> int:
+    """Batch cap from the installed autotune plan, else the hard-coded
+    default — with no profile installed the config is byte-identical to
+    the pre-autotune constants (lighthouse_tpu/autotune/planner.py)."""
+    try:
+        from ..autotune import runtime
+
+        plan = runtime.active_plan()
+        if plan is not None:
+            return int(getattr(plan, attr))
+    except Exception:
+        pass
+    return default
 DEFAULT_QUEUE_LENGTHS = {
     WorkKind.gossip_attestation: 16384,
     WorkKind.gossip_aggregate: 4096,
@@ -72,8 +87,19 @@ class WorkItem:
 
 @dataclass
 class BeaconProcessorConfig:
-    max_attestation_batch: int = DEFAULT_MAX_ATTESTATION_BATCH
-    max_aggregate_batch: int = DEFAULT_MAX_AGGREGATE_BATCH
+    # default caps consult the installed autotune plan (device-measured
+    # throughput knee) and fall back to the guessed constants; an explicit
+    # value (CLI --max-*-batch) always wins over both
+    max_attestation_batch: int = field(
+        default_factory=lambda: _planned(
+            "max_attestation_batch", DEFAULT_MAX_ATTESTATION_BATCH
+        )
+    )
+    max_aggregate_batch: int = field(
+        default_factory=lambda: _planned(
+            "max_aggregate_batch", DEFAULT_MAX_AGGREGATE_BATCH
+        )
+    )
     # cores-wide like the reference's pool (beacon_processor/src/lib.rs:732
     # sizes by num_cpus); capped — beyond a few workers the Python-side
     # share of each task stops scaling under the GIL

@@ -79,7 +79,36 @@ def cmd_bn(args):
         _os_env.environ["LIGHTHOUSE_TPU_DEVICE_PROBE_WAIT_SECS"] = str(
             args.device_probe_wait
         )
+
+    # autotune: install this device's persisted profile BEFORE the backend
+    # and processor construct, so the hybrid router's knobs and the batch
+    # caps derive from measured numbers (lighthouse_tpu/autotune). Explicit
+    # flags/env stay the stronger layer (knob precedence: profile < env <
+    # constructor/CLI). Gated to device-backed backends unless the operator
+    # pins a profile path explicitly — a python/fake node must not spend a
+    # device-detection wait at startup.
+    device_backed = args.bls_backend in ("jax", "hybrid")
+    autotune_on = not args.no_autotune and (
+        device_backed or args.autotune_profile is not None
+    )
+    if autotune_on:
+        from .autotune import runtime as _at_runtime
+
+        _at_runtime.autoload(path=args.autotune_profile)
+
     bls.set_backend(args.bls_backend)
+
+    if autotune_on and device_backed:
+        # precompile the plan's warmup buckets in the background (daemon
+        # thread; a dead tunnel degrades to cold-compile-on-first-dispatch,
+        # never a blocked node). Without a profile this warms the two
+        # highest-traffic default buckets — the first node-path caller of
+        # jaxbls warm_stages.
+        from .autotune import runtime as _at_runtime
+
+        _at_runtime.start_warmup()
+        log.info("autotune warmup started",
+                 buckets=str(list(_at_runtime.warmup_buckets())))
 
     if args.zero_ports:
         args.http_port = 0
@@ -749,6 +778,58 @@ def cmd_interop_genesis(args):
     return 0
 
 
+# ------------------------------------------------------------------ autotune
+
+
+def cmd_autotune(args):
+    """`autotune calibrate` — measure this device's padding buckets and
+    write its profile; `autotune show` — print a profile + derived plan
+    (lighthouse_tpu/autotune)."""
+    import dataclasses
+
+    from .autotune import calibrate as _cal
+    from .autotune import planner as _planner
+    from .autotune import profile as _prof
+
+    if args.autotune_command == "calibrate":
+        _profile, path = _cal.run_from_args(args)
+        print(json.dumps({"profile": path}))
+        return 0
+    if args.autotune_command == "show":
+        path = args.profile
+        if path is None:
+            # bounded detection: jax.devices() must not hang this command
+            # on a dead remote-TPU tunnel (same guard as node autoload)
+            from .autotune import runtime as _at_runtime
+
+            key = _at_runtime.detect_device_key(wait_secs=10.0)
+            if key is None:
+                print("device detection failed or timed out; pass "
+                      "--profile PATH explicitly", file=sys.stderr)
+                return 1
+            path = _prof.default_path(key)
+        try:
+            p = _prof.load(path)
+        except FileNotFoundError:
+            print(f"no autotune profile at {path} "
+                  f"(run `autotune calibrate` on the device)",
+                  file=sys.stderr)
+            return 1
+        except (ValueError, json.JSONDecodeError) as e:
+            print(f"unreadable autotune profile at {path}: {e}",
+                  file=sys.stderr)
+            return 1
+        plan = _planner.plan_from_profile(p)
+        print(json.dumps(
+            {"path": path, "plan": dataclasses.asdict(plan),
+             "profile": p.to_json()},
+            indent=1,
+        ))
+        return 0
+    print("unknown autotune command", file=sys.stderr)
+    return 1
+
+
 # ------------------------------------------------------------------ accounts
 
 
@@ -1172,6 +1253,15 @@ def build_parser() -> argparse.ArgumentParser:
     bn.add_argument("--device-probe-wait", type=float, default=None,
                     help="seconds to wait for the device probe at startup "
                          "before serving from the host (hybrid backend)")
+    # -- autotune (lighthouse_tpu/autotune)
+    bn.add_argument("--no-autotune", action="store_true",
+                    help="skip loading the device autotune profile and the "
+                         "startup bucket warmup (serve on built-in "
+                         "defaults)")
+    bn.add_argument("--autotune-profile", default=None,
+                    help="explicit autotune profile JSON to install "
+                         "(default: the canonical per-device path under "
+                         "the jit cache directory)")
     bn.add_argument("--listen-address", default="127.0.0.1",
                     help="bind address for the p2p listener")
     bn.add_argument("--zero-ports", action="store_true",
@@ -1340,6 +1430,29 @@ def build_parser() -> argparse.ArgumentParser:
              "when binding 0.0.0.0 — the bind address is not dialable)",
     )
     boot.set_defaults(fn=cmd_boot_node)
+
+    at = sub.add_parser(
+        "autotune",
+        help="device autotuner: calibrate or inspect the BLS pipeline "
+             "profile (lighthouse_tpu/autotune)",
+    )
+    atsub = at.add_subparsers(dest="autotune_command", required=True)
+    atc = atsub.add_parser(
+        "calibrate",
+        help="measure the padding buckets on this device and write its "
+             "profile (use --smoke for a CPU dry-run)",
+    )
+    from .autotune.calibrate import add_calibrate_args
+
+    add_calibrate_args(atc)
+    ats = atsub.add_parser(
+        "show", help="print a device profile and the plan derived from it"
+    )
+    ats.add_argument("--profile", default=None,
+                     help="profile path (default: this device's canonical "
+                          "path under the jit cache directory)")
+    for p_ in (atc, ats):
+        p_.set_defaults(fn=cmd_autotune)
 
     db = sub.add_parser("db", help="inspect/compact/prune/migrate a native store")
     db.add_argument("--db", required=True)

@@ -60,11 +60,59 @@ _DEVICE_LATENCY = REGISTRY.histogram(
 )
 
 
-def _env_float(name: str, default: float) -> float:
+def _resolve_knob(ctor_val, env_name: str, profile_val, default: float):
+    """One routing knob with explicit precedence:
+
+        explicit constructor arg > env var > profile-derived > default
+
+    (the autotune contract, docs/PERF_NOTES.md "Autotune": a persisted
+    device profile supplies learned values, but an operator's env var or
+    an explicit argument always wins). Returns (value, source) where
+    source names the layer that decided, for the one-time startup log."""
+    if ctor_val is not None:
+        return float(ctor_val), "constructor"
+    raw = os.environ.get(env_name)
+    if raw is not None:
+        try:
+            return float(raw), "env"
+        except ValueError:
+            # malformed env falls through to the NEXT layer (profile, then
+            # default). Pre-autotune code fell straight to the default —
+            # same outcome when no profile is installed; with one, the
+            # learned value wins and the startup log shows source=profile.
+            pass
+    if profile_val is not None:
+        return float(profile_val), "profile"
+    return float(default), "default"
+
+
+def _dummy_sets(n_sets: int, n_pks: int):
+    """Shape-exact placeholder sets (generator points, distinct messages)
+    for precompiling a padding bucket: a device verify over them executes
+    the full four-stage pipeline — the result is False, the compile is
+    real."""
+    from ..bls381 import curve as cv
+    from .keys import PublicKey
+    from .signature import Signature
+    from .signature_set import SignatureSet
+
+    pk = PublicKey(cv.G1_GEN)
+    sig = Signature(cv.G2_GEN)
+    return [
+        SignatureSet(sig, [pk] * max(1, n_pks), i.to_bytes(4, "little") * 8)
+        for i in range(max(1, n_sets))
+    ]
+
+
+def _autotune_plan():
+    """The installed autotune plan, or None — never raises (the hybrid
+    backend must construct even if the autotune subsystem is broken)."""
     try:
-        return float(os.environ.get(name, default))
-    except ValueError:
-        return default
+        from ...autotune import runtime
+
+        return runtime.active_plan()
+    except Exception:
+        return None
 
 
 class HybridBackend:
@@ -80,27 +128,35 @@ class HybridBackend:
         probe_startup_wait_secs: float | None = None,
         probe_retry_secs: float | None = None,
     ):
-        self.urgent_max_sets = int(
-            urgent_max_sets
-            if urgent_max_sets is not None
-            else _env_float("LIGHTHOUSE_TPU_URGENT_MAX_SETS", 4)
+        plan = _autotune_plan()
+        urgent, urgent_src = _resolve_knob(
+            urgent_max_sets, "LIGHTHOUSE_TPU_URGENT_MAX_SETS",
+            plan.urgent_max_sets if plan else None, 4,
         )
-        self.p99_budget_ms = (
-            p99_budget_ms
-            if p99_budget_ms is not None
-            else _env_float("LIGHTHOUSE_TPU_DEVICE_P99_BUDGET_MS", 500.0)
+        self.urgent_max_sets = int(urgent)
+        self.p99_budget_ms, p99_src = _resolve_knob(
+            p99_budget_ms, "LIGHTHOUSE_TPU_DEVICE_P99_BUDGET_MS",
+            plan.p99_budget_ms if plan else None, 500.0,
         )
-        self._probe_startup_wait = (
-            probe_startup_wait_secs
-            if probe_startup_wait_secs is not None
-            else _env_float("LIGHTHOUSE_TPU_DEVICE_PROBE_WAIT_SECS", 20.0)
+        self._probe_startup_wait, _ = _resolve_knob(
+            probe_startup_wait_secs, "LIGHTHOUSE_TPU_DEVICE_PROBE_WAIT_SECS",
+            None, 20.0,
         )
-        self._probe_retry = (
-            probe_retry_secs
-            if probe_retry_secs is not None
-            else _env_float("LIGHTHOUSE_TPU_DEVICE_PROBE_RETRY_SECS", 600.0)
+        self._probe_retry, _ = _resolve_knob(
+            probe_retry_secs, "LIGHTHOUSE_TPU_DEVICE_PROBE_RETRY_SECS",
+            None, 600.0,
         )
+        self.knob_sources = {
+            "urgent_max_sets": urgent_src, "p99_budget_ms": p99_src,
+        }
         self._log = get_logger("bls.hybrid")
+        self._log.info(
+            "routing knobs resolved",
+            urgent_max_sets=self.urgent_max_sets,
+            urgent_max_sets_source=urgent_src,
+            p99_budget_ms=self.p99_budget_ms,
+            p99_budget_ms_source=p99_src,
+        )
         self._lock = threading.Lock()
         self._state = "probing"            # probing | up | down
         self._device = None                # JaxBackend once probed up
@@ -161,14 +217,11 @@ class HybridBackend:
     # ------------------------------------------------------------- routing
 
     def _bucket(self, sets) -> tuple:
-        from ..jaxbls import backend as jb
-        from ...parallel import pad_pks, pad_sets
+        from ..jaxbls.backend import padding_bucket
 
-        n = pad_sets(max(jb.MIN_SETS, jb._next_pow2(len(sets))))
-        m = pad_pks(
-            max(jb.MIN_PKS, jb._next_pow2(max(len(s.signing_keys) for s in sets)))
+        return padding_bucket(
+            len(sets), max(len(s.signing_keys) for s in sets)
         )
-        return (n, m)
 
     def _p99_ms(self) -> float | None:
         with self._lock:
@@ -224,6 +277,52 @@ class HybridBackend:
 
         threading.Thread(target=warm, daemon=True,
                          name=f"bls-hybrid-warm-{bucket}").start()
+
+    def warm_bucket(self, n_sets: int, n_pks: int) -> bool:
+        """Full-pipeline precompile of one padding bucket through the
+        device, marking it warm for ROUTING too — the autotune startup
+        warmup calls this (autotune/runtime.start_warmup) so the first
+        real batch at a planned shape skips both the cold compile and the
+        host detour. A bare jaxbls `warm_stages` would not be enough here:
+        stages 3/4 only compile on a real dispatch, and this router keeps
+        urgent sets on the host until a bucket has completed one
+        (_warm_buckets). Returns False (never raises) when the device is
+        down/probing or the verify fails — warmup degrades, the node
+        keeps serving."""
+        if self._device_state() != "up":
+            return False
+        from ..jaxbls.backend import padding_bucket
+
+        # bucket resolved BEFORE materializing the (up to 65k-object)
+        # dummy sets, and claimed in _warming so a concurrent
+        # _spawn_warm / warm_bucket at the same shape never launches a
+        # second multi-minute compile of the identical program
+        bucket = padding_bucket(max(1, n_sets), max(1, n_pks))
+        with self._lock:
+            if bucket in self._warm_buckets:
+                return True
+            if bucket in self._warming:
+                return False  # another warm of this shape is in flight
+            self._warming.add(bucket)
+        try:
+            sets = _dummy_sets(n_sets, n_pks)
+            t0 = time.time()
+            # dummy sets verify False; the compile is the point. NOT
+            # recorded via _record_device_ok: the compile-inclusive wall
+            # time must not enter the p99 window the budget router reads
+            self._device.verify_signature_sets(sets, [1] * len(sets))
+            with self._lock:
+                self._warm_buckets.add(bucket)
+            self._log.info("bucket warmed (startup plan)", bucket=str(bucket),
+                           secs=round(time.time() - t0, 1))
+            return True
+        except Exception as e:
+            self._log.warn("bucket warmup failed", bucket=str(bucket),
+                           error=f"{type(e).__name__}: {e}")
+            return False
+        finally:
+            with self._lock:
+                self._warming.discard(bucket)
 
     def _host(self):
         from . import api

@@ -56,6 +56,19 @@ def _next_pow2(n: int) -> int:
     return p
 
 
+def padding_bucket(n_sets: int, n_pks: int) -> tuple:
+    """THE (n, m) compile-bucket rounding rule of the dispatch path, for a
+    workload of n_sets sets whose widest set has n_pks pubkeys. Single
+    owner — the hybrid router's bucket tracking and the autotune
+    calibrator classify by calling this, so their keys can never desync
+    from what actually compiles."""
+    from ...parallel import pad_pks, pad_sets
+
+    n = pad_sets(max(MIN_SETS, _next_pow2(n_sets)))
+    m = pad_pks(max(MIN_PKS, _next_pow2(n_pks)))
+    return n, m
+
+
 # ------------------------------------------------------------ host marshalling
 
 
@@ -286,16 +299,23 @@ def warm_stages(n_sets: int, n_pks: int) -> None:
     largest programs instead of their sum (the r4 multichip dryrun timed
     out in sequential XLA:CPU stage compiles — ~3 min for prepare alone).
     Stages 3/4 take stage OUTPUTS as inputs (shardings chosen by XLA), so
-    they still compile on first real dispatch."""
+    they still compile on first real dispatch.
+
+    Callers: the node's startup warmup thread walks the autotune plan's
+    bucket list through here (autotune/runtime.start_warmup); tests and
+    bench warm ad-hoc shapes. The wall time is recorded as the bucket's
+    compile cost in the autotune profiler."""
     import threading
+    import time
 
     import jax
 
-    from ...parallel import pad_pks, pad_sets, put_pk_grid, put_sets
+    from ...autotune import profiler
+    from ...parallel import put_pk_grid, put_sets
 
     prepare, h2c_stage, _, _ = _get_stages()
-    n = pad_sets(max(MIN_SETS, _next_pow2(n_sets)))
-    m = pad_pks(max(MIN_PKS, _next_pow2(n_pks)))
+    n, m = padding_bucket(n_sets, n_pks)
+    t0 = time.time()
 
     pk_x = put_pk_grid(np.zeros((n, m, lb.NL), np.uint32))
     pk_y = put_pk_grid(np.zeros((n, m, lb.NL), np.uint32))
@@ -320,6 +340,7 @@ def warm_stages(n_sets: int, n_pks: int) -> None:
         t.start()
     for t in threads:
         t.join()
+    profiler.observe_compile(n, m, time.time() - t0)
 
 
 class VerifyHandle:
@@ -327,25 +348,43 @@ class VerifyHandle:
 
     Keeps references to the dispatched device values so the work proceeds
     asynchronously; result() blocks on the device and applies the host-side
-    semantic (bad aggregate pubkey => False)."""
+    semantic (bad aggregate pubkey => False). Dispatch-timed handles carry
+    their padding bucket and submit time so resolving feeds the autotune
+    profiler (first resolve only — result() is idempotent)."""
 
-    __slots__ = ("_ok", "_bad", "_hostfail")
+    __slots__ = ("_ok", "_bad", "_hostfail", "_bucket", "_t0", "_n_real")
 
-    def __init__(self, ok=None, bad=None, hostfail=False):
+    def __init__(self, ok=None, bad=None, hostfail=False,
+                 bucket=None, t0=None, n_real=0):
         self._ok = ok
         self._bad = bad
         self._hostfail = hostfail
+        self._bucket = bucket
+        self._t0 = t0
+        self._n_real = n_real
 
     def result(self) -> bool:
         if self._hostfail:
             return False
-        return bool(np.asarray(self._ok)) and not bool(np.asarray(self._bad))
+        r = bool(np.asarray(self._ok)) and not bool(np.asarray(self._bad))
+        if self._t0 is not None and self._bucket is not None:
+            import time
+
+            from ...autotune import profiler
+
+            dt, self._t0 = time.perf_counter() - self._t0, None
+            profiler.observe_dispatch(*self._bucket, dt, self._n_real)
+        return r
 
 
 class JaxBackend:
     """Batched TPU verification backend (registered as "jax" in bls.api)."""
 
     name = "jax"
+    # dispatches feed the autotune profiler from inside VerifyHandle, so
+    # external measurement loops (autotune/calibrate.py) must not record
+    # the same verify a second time
+    autotune_self_recording = True
 
     def __init__(self, dst: bytes = DST_POP):
         self.dst = dst
@@ -402,17 +441,14 @@ class JaxBackend:
         return dx, dy, dm
 
     def verify_signature_sets_async(self, sets, rands) -> VerifyHandle:
-        from ...parallel import pad_sets, put_sets
+        from ...parallel import put_sets
 
         prepare, h2c_stage, pairs_stage, pairing_stage = _get_stages()
         n_real = len(sets)
         # pad the set axis to the compile bucket AND to a multiple of the
         # device mesh (multi-chip: sets are data-parallel over the mesh,
         # the cross-set reductions become collectives — parallel/mesh.py)
-        n = pad_sets(max(MIN_SETS, _next_pow2(n_real)))
-        from ...parallel import pad_pks
-
-        m = pad_pks(max(MIN_PKS, _next_pow2(max(len(s.signing_keys) for s in sets))))
+        n, m = padding_bucket(n_real, max(len(s.signing_keys) for s in sets))
 
         pk_x, pk_y, pk_mask = self._marshal_pubkeys(sets, n, m)
 
@@ -448,13 +484,16 @@ class JaxBackend:
             put_sets(sig_x), put_sets(sig_y), put_sets(z_digits),
             put_sets(set_mask), put_sets(us),
         )
+        import time
+
+        t0 = time.perf_counter()
         z_pk, sig_acc, bad = prepare(
             pk_x, pk_y, pk_mask, sig_x, sig_y, z_digits, set_mask
         )
         h_jac = h2c_stage(us)
         px, py, qxx, qyy, pair_mask = pairs_stage(z_pk, h_jac, sig_acc, set_mask)
         ok = pairing_stage(px, py, qxx, qyy, pair_mask)
-        return VerifyHandle(ok, bad)
+        return VerifyHandle(ok, bad, bucket=(n, m), t0=t0, n_real=n_real)
 
     def verify_signature_sets(self, sets, rands) -> bool:
         return self.verify_signature_sets_async(sets, rands).result()

@@ -17,6 +17,13 @@ _DEFAULT_CACHE_DIR = os.environ.get(
 _initialized = False
 
 
+def cache_base_dir() -> str:
+    """Root of the persistent per-platform compilation caches. Sibling
+    artifacts that share the cache's lifecycle (the autotune device
+    profiles) live under this directory too."""
+    return _DEFAULT_CACHE_DIR
+
+
 def _cpu_fingerprint() -> str:
     """Short hash of the host CPU's feature flags (stable per machine)."""
     import hashlib

@@ -1,0 +1,396 @@
+"""Autotune subsystem (lighthouse_tpu/autotune): profile JSON round-trip,
+planner determinism, the knob-precedence contract (profile < env var <
+explicit arg), the consumers (BeaconProcessor caps, HybridBackend budget,
+warmup plan), and the CPU smoke calibration end-to-end.
+
+Everything here is host-side: the hybrid backend is constructed with the
+probe short-circuited and the smoke calibration measures through the
+pure-python BLS backend (a cold XLA:CPU compile of the verify pipeline
+takes minutes — tests/README.md — so the device path stays the jaxbls
+suites' job)."""
+
+import json
+
+import pytest
+
+from lighthouse_tpu.autotune import calibrate, planner, profile, profiler, runtime
+from lighthouse_tpu.utils.metrics import REGISTRY
+
+
+@pytest.fixture(autouse=True)
+def _clean_autotune_state():
+    runtime.clear()
+    profiler.reset()
+    yield
+    runtime.clear()
+    profiler.reset()
+
+
+def synthetic_profile() -> profile.DeviceProfile:
+    """A fixed v5e-shaped profile; the pinned plan assertions below encode
+    the planner's derivation rules against these numbers."""
+    p = profile.DeviceProfile(
+        key={
+            "platform": "tpu", "device_kind": "TPU v5e", "num_devices": 1,
+            "jax_version": "0.9.0", "backend_revision": "r5",
+            "bls_backend": "jax",
+        },
+        source="calibrate",
+    )
+    rows = [
+        # n_sets, n_pks, sets/s, p50_ms, p99_ms, compile_s
+        (4, 128, 7.5, 529.0, 560.0, 60.0),
+        (64, 128, 100.0, 640.0, 700.0, 616.0),
+        (256, 128, 240.0, 1060.0, 1100.0, 900.0),
+        (512, 128, 250.0, 2050.0, 2100.0, 1200.0),
+    ]
+    for n, m, rate, p50, p99, comp in rows:
+        p.buckets[(n, m)] = profile.BucketProfile(
+            n_sets=n, n_pks=m, samples=8, compile_secs=comp,
+            p50_ms=p50, p99_ms=p99, sets_per_sec=rate,
+        )
+    p.host = {"single_set_ms": 577.0}
+    return p
+
+
+# ------------------------------------------------------------------ schema
+
+
+def test_profile_json_round_trip_yields_identical_plan(tmp_path):
+    p = synthetic_profile()
+    path = profile.save(p, str(tmp_path / "prof.json"))
+    loaded = profile.load(path)
+    assert loaded.key == p.key
+    assert set(loaded.buckets) == set(p.buckets)
+    assert planner.plan_from_profile(loaded) == planner.plan_from_profile(p)
+    # and a second serialize is byte-stable (sorted keys, sorted buckets)
+    path2 = profile.save(loaded, str(tmp_path / "prof2.json"))
+    a, b = open(path).read(), open(path2).read()
+    assert json.loads(a)["buckets"] == json.loads(b)["buckets"]
+
+
+def test_profile_rejects_unknown_schema_version():
+    doc = synthetic_profile().to_json()
+    doc["schema_version"] = 999
+    with pytest.raises(ValueError, match="schema_version"):
+        profile.DeviceProfile.from_json(doc)
+
+
+# ----------------------------------------------------------------- planner
+
+
+def test_planner_is_deterministic_and_pinned():
+    p = synthetic_profile()
+    plan1 = planner.plan_from_profile(p)
+    plan2 = planner.plan_from_profile(synthetic_profile())
+    assert plan1 == plan2
+    # knee rule: peak 250 sets/s at n=512; smallest bucket within 10% is 256
+    assert plan1.max_attestation_batch == 256
+    assert plan1.max_aggregate_batch == 128
+    # budget: 2x the smallest bucket's p99 (560 ms)
+    assert plan1.p99_budget_ms == 1120.0
+    # host single set (577 ms) never beats the device p50 at any bucket
+    assert plan1.urgent_max_sets == 1
+    # warmup: best throughput first
+    assert plan1.warmup_buckets == ((512, 128), (256, 128), (64, 128), (4, 128))
+    assert plan1.source.startswith("profile:")
+
+
+def test_planner_defaults_match_hardcoded_constants():
+    """An empty profile derives exactly the historical constants — the
+    no-profile node and the empty-profile node behave identically."""
+    from lighthouse_tpu.chain import beacon_processor as bp
+
+    empty = profile.DeviceProfile(key={"platform": "cpu"})
+    plan = planner.plan_from_profile(empty)
+    assert plan.max_attestation_batch == bp.DEFAULT_MAX_ATTESTATION_BATCH
+    assert plan.max_aggregate_batch == bp.DEFAULT_MAX_AGGREGATE_BATCH
+    assert plan.p99_budget_ms == 500.0
+    assert plan.urgent_max_sets == 4
+    assert plan.warmup_buckets == planner.DEFAULT_WARMUP_BUCKETS
+
+
+def test_planner_never_lowers_cap_on_a_rising_sweep():
+    """A knee sitting at the sweep's largest bucket means throughput was
+    still rising when measurement stopped — the cap must not drop below
+    the default on that (absent) evidence."""
+    p = profile.DeviceProfile(key={"platform": "tpu"})
+    for n, rate in [(64, 100.0), (256, 249.0), (512, 308.0)]:  # r5 numbers
+        p.buckets[(n, 128)] = profile.BucketProfile(
+            n_sets=n, n_pks=128, samples=8, p50_ms=1000.0, p99_ms=1100.0,
+            sets_per_sec=rate,
+        )
+    plan = planner.plan_from_profile(p)
+    assert plan.max_attestation_batch == planner.DEFAULT_MAX_ATTESTATION_BATCH
+    assert plan.max_aggregate_batch == planner.DEFAULT_MAX_AGGREGATE_BATCH
+
+
+def test_profile_rejects_malformed_bucket_entry():
+    doc = synthetic_profile().to_json()
+    del doc["buckets"][0]["n_sets"]
+    with pytest.raises(ValueError, match="malformed autotune profile bucket"):
+        profile.DeviceProfile.from_json(doc)
+
+
+def test_planner_urgent_threshold_uses_host_reference():
+    p = synthetic_profile()
+    # a 100x faster host: sequential host verifies beat the device p50 up
+    # to the 64-set bucket (64 * 5.77 = 369 ms <= 640 ms) but not 256
+    p.host = {"single_set_ms": 5.77}
+    assert planner.plan_from_profile(p).urgent_max_sets == 64
+
+
+# --------------------------------------------------------------- consumers
+
+
+def test_beacon_processor_caps_follow_installed_profile():
+    from lighthouse_tpu.chain.beacon_processor import (
+        DEFAULT_MAX_AGGREGATE_BATCH,
+        DEFAULT_MAX_ATTESTATION_BATCH,
+        BeaconProcessorConfig,
+    )
+
+    cfg = BeaconProcessorConfig()
+    assert cfg.max_attestation_batch == DEFAULT_MAX_ATTESTATION_BATCH
+    assert cfg.max_aggregate_batch == DEFAULT_MAX_AGGREGATE_BATCH
+
+    runtime.install_profile(synthetic_profile())
+    tuned = BeaconProcessorConfig()
+    assert tuned.max_attestation_batch == 256
+    assert tuned.max_aggregate_batch == 128
+    # explicit values (CLI flags) still win over the plan
+    explicit = BeaconProcessorConfig(max_attestation_batch=7)
+    assert explicit.max_attestation_batch == 7
+
+    runtime.clear()
+    again = BeaconProcessorConfig()
+    assert again.max_attestation_batch == DEFAULT_MAX_ATTESTATION_BATCH
+
+
+def _make_hybrid(**kw):
+    from lighthouse_tpu.crypto.bls.hybrid import HybridBackend
+
+    return HybridBackend(
+        probe_startup_wait_secs=0.1, probe_retry_secs=3600, **kw
+    )
+
+
+def test_hybrid_defaults_without_profile(monkeypatch):
+    monkeypatch.delenv("LIGHTHOUSE_TPU_URGENT_MAX_SETS", raising=False)
+    monkeypatch.delenv("LIGHTHOUSE_TPU_DEVICE_P99_BUDGET_MS", raising=False)
+    b = _make_hybrid()
+    assert (b.urgent_max_sets, b.p99_budget_ms) == (4, 500.0)
+    assert b.knob_sources == {
+        "urgent_max_sets": "default", "p99_budget_ms": "default",
+    }
+
+
+def test_hybrid_knob_precedence(monkeypatch):
+    """profile-derived < env var < explicit constructor arg."""
+    monkeypatch.delenv("LIGHTHOUSE_TPU_URGENT_MAX_SETS", raising=False)
+    monkeypatch.delenv("LIGHTHOUSE_TPU_DEVICE_P99_BUDGET_MS", raising=False)
+    runtime.install_profile(synthetic_profile())
+
+    b = _make_hybrid()
+    assert (b.urgent_max_sets, b.p99_budget_ms) == (1, 1120.0)
+    assert b.knob_sources["p99_budget_ms"] == "profile"
+
+    monkeypatch.setenv("LIGHTHOUSE_TPU_DEVICE_P99_BUDGET_MS", "123")
+    b = _make_hybrid()
+    assert b.p99_budget_ms == 123.0
+    assert b.knob_sources == {
+        "urgent_max_sets": "profile", "p99_budget_ms": "env",
+    }
+
+    b = _make_hybrid(p99_budget_ms=42.0, urgent_max_sets=9)
+    assert (b.urgent_max_sets, b.p99_budget_ms) == (9, 42.0)
+    assert b.knob_sources == {
+        "urgent_max_sets": "constructor", "p99_budget_ms": "constructor",
+    }
+
+    # malformed env falls through to the profile layer, not to a crash
+    monkeypatch.setenv("LIGHTHOUSE_TPU_DEVICE_P99_BUDGET_MS", "not-a-float")
+    b = _make_hybrid()
+    assert b.p99_budget_ms == 1120.0
+    assert b.knob_sources["p99_budget_ms"] == "profile"
+
+
+# ---------------------------------------------------------------- profiler
+
+
+def test_profiler_records_and_exposes_per_bucket_metrics():
+    # first dispatch at a cold bucket is classified as its compile
+    profiler.observe_dispatch(8, 4, 30.0, 8)
+    profiler.observe_dispatch(8, 4, 0.5, 8)
+    profiler.observe_dispatch(8, 4, 0.3, 6)
+    profiler.observe_compile(16, 4, 12.0)
+
+    buckets = profiler.snapshot_buckets()
+    b = buckets[(8, 4)]
+    assert b.compile_secs == 30.0
+    assert b.samples == 2
+    assert b.sets_per_sec == pytest.approx(14 / 0.8, rel=1e-6)
+    assert buckets[(16, 4)].compile_secs == 12.0
+
+    text = REGISTRY.expose_text()
+    assert 'autotune_dispatch_seconds_n8_m4_bucket{le="0.5"}' in text
+    assert "autotune_sets_per_sec_n8_m4" in text
+    assert "autotune_compile_seconds_n16_m4" in text
+    assert "autotune_dispatches_total" in text
+
+
+def test_profiler_first_dispatch_after_warm_still_counts_as_compile():
+    """warm_stages only covers stages 1-2, so the first real dispatch at a
+    warmed bucket still pays the stage-3/4 compile — it must fold into the
+    compile record (max), never into the latency window."""
+    profiler.observe_compile(4, 1, 99.0)
+    profiler.observe_dispatch(4, 1, 120.0, 4)  # residual stage-3/4 compile
+    profiler.observe_dispatch(4, 1, 0.25, 4)   # first real sample
+    b = profiler.snapshot_buckets()[(4, 1)]
+    assert b.compile_secs == 120.0
+    assert b.samples == 1
+    assert b.p50_ms == 250.0
+
+
+def test_hybrid_warm_bucket_marks_routing_warm():
+    """The startup warmup path: warm_bucket runs a full dummy verify on
+    the device AND marks the bucket warm for routing, so the next small
+    verify at that shape rides the device instead of the cold-bucket host
+    detour."""
+    from lighthouse_tpu.crypto.bls.hybrid import _dummy_sets
+
+    class Stub:
+        def __init__(self):
+            self.calls = 0
+
+        def verify_signature_sets(self, sets, rands):
+            self.calls += 1
+            return True
+
+    dev = Stub()
+    b = _make_hybrid()
+    b._probe_started.set()
+    b._probe_done.set()
+    b._state = "up"
+    b._device = dev
+
+    assert b.warm_bucket(1, 1) is True
+    assert dev.calls == 1
+    assert b._warm_buckets, "bucket not marked warm for routing"
+    assert not b._lats, "warmup compile time must not enter the p99 window"
+
+    sets = _dummy_sets(1, 1)
+    assert b.verify_signature_sets(sets, [1]) is True
+    assert dev.calls == 2  # device path — no device_cold host detour
+
+    # an in-flight warm of the same shape is not duplicated
+    b._warm_buckets.clear()
+    b._warming.add(b._bucket(sets))
+    assert b.warm_bucket(1, 1) is False
+    assert dev.calls == 2  # no second compile launched
+
+    down = _make_hybrid()
+    down._probe_started.set()
+    down._probe_done.set()
+    down._state = "down"
+    assert down.warm_bucket(1, 1) is False  # degrades, never raises
+
+
+# ----------------------------------------------------------------- runtime
+
+
+def test_warmup_plan_fallback_and_ordering():
+    assert runtime.warmup_buckets() == planner.DEFAULT_WARMUP_BUCKETS
+    runtime.install_profile(synthetic_profile())
+    assert runtime.warmup_buckets() == (
+        (512, 128), (256, 128), (64, 128), (4, 128)
+    )
+
+    warmed = []
+    t = runtime.start_warmup(warm_fn=lambda n, m: warmed.append((n, m)))
+    t.join(timeout=10)
+    assert warmed == [(512, 128), (256, 128), (64, 128), (4, 128)]
+
+
+def test_warmup_failure_never_propagates():
+    def boom(n, m):
+        raise RuntimeError("tunnel died")
+
+    t = runtime.start_warmup(buckets=((4, 1),), warm_fn=boom)
+    t.join(timeout=10)  # the thread swallows the failure and exits
+
+
+def test_autoload_explicit_path_and_kill_switch(tmp_path, monkeypatch):
+    path = profile.save(synthetic_profile(), str(tmp_path / "p.json"))
+    monkeypatch.setenv("LIGHTHOUSE_TPU_AUTOTUNE_PROFILE", path)
+    plan = runtime.autoload()
+    assert plan is not None and plan.max_attestation_batch == 256
+    assert runtime.active_plan() == plan
+
+    runtime.clear()
+    monkeypatch.setenv("LIGHTHOUSE_TPU_AUTOTUNE", "0")
+    assert runtime.autoload() is None
+    assert runtime.active_plan() is None
+
+
+def test_autoload_resolves_current_device_profile(tmp_path, monkeypatch):
+    """With no explicit path, autoload detects the device key and loads
+    the canonical per-device file (CPU platform: detection is instant)."""
+    monkeypatch.setenv("LIGHTHOUSE_TPU_AUTOTUNE_DIR", str(tmp_path))
+    monkeypatch.delenv("LIGHTHOUSE_TPU_AUTOTUNE_PROFILE", raising=False)
+    key = profile.current_device_key()
+    p = synthetic_profile()
+    p.key = key
+    profile.save(p)  # lands at default_path(key) under tmp_path
+    plan = runtime.autoload(wait_secs=30.0)
+    assert plan is not None and plan.max_attestation_batch == 256
+
+
+def test_autoload_corrupt_profile_degrades_to_defaults(tmp_path, monkeypatch):
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    monkeypatch.setenv("LIGHTHOUSE_TPU_AUTOTUNE_PROFILE", str(bad))
+    assert runtime.autoload() is None
+    assert runtime.active_plan() is None
+
+
+# ------------------------------------------------- smoke calibration (e2e)
+
+
+def test_smoke_calibration_end_to_end(tmp_path, capsys):
+    """scripts/autotune_calibrate.py --smoke on CPU: tiny fixtures, python
+    measurement backend, valid profile JSON out, autotune series in the
+    Prometheus exposition — the acceptance-criteria path."""
+    out = tmp_path / "smoke_profile.json"
+    rc = calibrate.cli_main(["--smoke", "--out", str(out)])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["profile"] == str(out)
+    assert summary["autotune_metric_series"] > 0
+
+    prof = profile.load(str(out))
+    assert prof.source == "calibrate-smoke"
+    assert prof.buckets, "smoke sweep measured no buckets"
+    assert prof.host and prof.host["single_set_ms"] > 0
+    for b in prof.buckets.values():
+        assert b.samples >= 1 and b.sets_per_sec > 0
+
+    # the profile round-trips into a usable plan and installs
+    plan = runtime.install_profile(prof)
+    assert plan.max_attestation_batch >= 4
+    assert plan.warmup_buckets
+
+    text = REGISTRY.expose_text()
+    n, m = next(iter(prof.buckets))
+    assert f"autotune_dispatch_seconds_n{n}_m{m}" in text
+
+
+def test_cli_autotune_show(tmp_path, capsys):
+    from lighthouse_tpu.cli import main as cli_main
+
+    path = profile.save(synthetic_profile(), str(tmp_path / "p.json"))
+    rc = cli_main(["autotune", "show", "--profile", path])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["plan"]["max_attestation_batch"] == 256
+    assert doc["profile"]["schema_version"] == profile.SCHEMA_VERSION
