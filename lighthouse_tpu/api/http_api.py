@@ -22,12 +22,24 @@ import os
 import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from time import perf_counter
 
 from ..state_transition import accessors as acc
 from ..state_transition.slot import types_for_slot
 from ..types import helpers as h
+from ..utils.metrics import REGISTRY
 
 VERSION = "lighthouse-tpu/0.1.0"
+
+# request latency by route family (handler name, stable across path params
+# — `get_validators` not `/eth/v1/.../states/head/validators`) and method:
+# the http_api/src/metrics.rs HTTP_API_PATHS_TOTAL idiom with a histogram
+_REQUEST_SECONDS = REGISTRY.histogram_vec(
+    "http_api_request_seconds",
+    "Beacon API request latency, by route family and method",
+    ("route", "method"),
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 5.0, 30.0),
+)
 
 
 def _hex(b: bytes) -> str:
@@ -200,7 +212,13 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
             for pattern, meth, fn in _ROUTES:
                 m = re.fullmatch(pattern, path)
                 if m and meth == method:
-                    return fn(self, *m.groups())
+                    t0 = perf_counter()
+                    try:
+                        return fn(self, *m.groups())
+                    finally:
+                        _REQUEST_SECONDS.labels(fn.__name__, method).observe(
+                            perf_counter() - t0
+                        )
             self._error(404, f"unknown route {path}")
         except ApiError as e:
             self._error(e.code, e.message)
@@ -783,6 +801,16 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
                 }
             }
         )
+
+    def get_lh_pipeline(self):
+        """/lighthouse_tpu/pipeline: stage-timing snapshot of the
+        verification dataflow — aggregate per-stage/per-kind timings, live
+        scheduler queue state, and the most recent completed traces
+        (lighthouse_tpu/observability). The scrape-time analog of a
+        `--trace-out` Perfetto export."""
+        from ..observability import snapshot
+
+        self._json({"data": snapshot()})
 
     def get_lh_peers_scores(self):
         net = getattr(self.chain, "_network_node", None)
@@ -1430,6 +1458,7 @@ _ROUTES = [
     (r"/lighthouse_tpu/peers/scores", "GET", BeaconApiHandler.get_lh_peers_scores),
     (r"/lighthouse_tpu/ui/validator-metrics", "POST", BeaconApiHandler.post_lh_validator_metrics),
     (r"/lighthouse_tpu/logs", "GET", BeaconApiHandler.get_lh_logs),
+    (r"/lighthouse_tpu/pipeline", "GET", BeaconApiHandler.get_lh_pipeline),
     (r"/eth/v1/validator/attestation_data", "GET", BeaconApiHandler.get_attestation_data),
     (r"/eth/v3/validator/blocks/(\d+)", "GET", BeaconApiHandler.get_produce_block),
     (r"/eth/v1/beacon/light_client/bootstrap/(0x[0-9a-f]+)", "GET", BeaconApiHandler.get_lc_bootstrap),

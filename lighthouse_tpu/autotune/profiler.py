@@ -4,10 +4,13 @@ The jaxbls backend calls `observe_dispatch` when an async verify handle
 resolves and `observe_compile` when `warm_stages` precompiles a bucket
 (crypto/jaxbls/backend.py). Each observation lands twice:
 
-  - in the process metrics registry (utils/metrics.py), as per-bucket
-    Prometheus series — `autotune_dispatch_seconds_n{n}_m{m}` histograms
-    plus `autotune_sets_per_sec_n{n}_m{m}` / `autotune_compile_seconds_*`
-    gauges — so a scrape shows what every bucket is actually doing;
+  - in the process metrics registry (utils/metrics.py), as LABELED
+    per-bucket Prometheus series — `autotune_dispatch_seconds{n_sets=,
+    n_pks=}` histograms plus `autotune_sets_per_sec{...}` /
+    `autotune_compile_seconds{...}` gauges — so a scrape shows what every
+    bucket is doing and dashboards aggregate across buckets without
+    name-pattern games (the pre-observability name-mangled
+    `autotune_*_n{n}_m{m}` series are gone);
   - in an in-memory per-bucket recorder, from which `build_profile`
     snapshots a DeviceProfile (the calibrator and bench.py both write
     their measurements through this module so script-measured and
@@ -44,6 +47,23 @@ _DISPATCHES_TOTAL = REGISTRY.counter(
     "autotune_dispatches_total",
     "multi-set verify dispatches observed by the autotune profiler",
 )
+_BUCKET_LABELS = ("n_sets", "n_pks")
+_DISPATCH_SECONDS = REGISTRY.histogram_vec(
+    "autotune_dispatch_seconds",
+    "device dispatch wall time, by padding bucket",
+    _BUCKET_LABELS,
+    buckets=DISPATCH_BUCKETS,
+)
+_SETS_PER_SEC = REGISTRY.gauge_vec(
+    "autotune_sets_per_sec",
+    "achieved signature sets/sec, by padding bucket",
+    _BUCKET_LABELS,
+)
+_COMPILE_SECONDS = REGISTRY.gauge_vec(
+    "autotune_compile_seconds",
+    "compile/first-dispatch wall time, by padding bucket",
+    _BUCKET_LABELS,
+)
 
 
 class _BucketRecorder:
@@ -60,20 +80,9 @@ class _BucketRecorder:
         self.total_sets = 0
         self.total_secs = 0.0
         self.seen_first = False
-        suffix = f"n{n_sets}_m{n_pks}"
-        self.hist = REGISTRY.histogram(
-            f"autotune_dispatch_seconds_{suffix}",
-            f"device dispatch wall time, padding bucket {n_sets}x{n_pks}",
-            buckets=DISPATCH_BUCKETS,
-        )
-        self.rate_gauge = REGISTRY.gauge(
-            f"autotune_sets_per_sec_{suffix}",
-            f"achieved signature sets/sec, padding bucket {n_sets}x{n_pks}",
-        )
-        self.compile_gauge = REGISTRY.gauge(
-            f"autotune_compile_seconds_{suffix}",
-            f"compile/first-dispatch wall time, bucket {n_sets}x{n_pks}",
-        )
+        self.hist = _DISPATCH_SECONDS.labels(n_sets, n_pks)
+        self.rate_gauge = _SETS_PER_SEC.labels(n_sets, n_pks)
+        self.compile_gauge = _COMPILE_SECONDS.labels(n_sets, n_pks)
 
     def stats(self):
         # may run WITHOUT the module lock (snapshot_buckets is signal-

@@ -15,17 +15,31 @@ GIL makes many workers pointless, but the heavy work (device batches,
 native store IO, sha256) all releases the GIL or runs on device, so a few
 workers suffice. Determinism-first: `run_until_idle` drains synchronously
 for tests (manual time), `start`/`stop` run the pump in threads.
+
+Observability: every queue is a labeled Prometheus series (the reference's
+beacon_processor_*_queue_total idiom) and every executed work unit carries
+a Trace through the pipeline stages — enqueue (submit -> pop), coalesce
+(batch formation), marshal (runner execution, which for device batches is
+host marshal + async dispatch), device (handle wait), continuation (chain
+mutation). See lighthouse_tpu/observability. The per-batch overhead is a
+few dict lookups + histogram observes; nothing here blocks on a scrape.
 """
 
 from __future__ import annotations
 
 import os
-import queue
 import threading
 from collections import deque
 from dataclasses import dataclass, field
 from enum import IntEnum
+from time import perf_counter
 from typing import Callable
+
+from ..observability import trace as obs
+from ..utils.logging import get_logger
+from ..utils.metrics import REGISTRY
+
+log = get_logger("beacon_processor")
 
 
 class WorkKind(IntEnum):
@@ -50,6 +64,50 @@ class WorkKind(IntEnum):
 
 DEFAULT_MAX_ATTESTATION_BATCH = 1024   # reference default 64; sized for TPU
 DEFAULT_MAX_AGGREGATE_BATCH = 512
+
+# ------------------------------------------------------------------ metrics
+# labeled per-kind families (beacon_processor/src/metrics.rs analog: the
+# reference exports one gauge per queue; here one family with a kind label)
+
+_QUEUE_DEPTH = REGISTRY.gauge_vec(
+    "beacon_processor_queue_depth",
+    "work items currently queued, by work kind",
+    ("kind",),
+)
+_DROPPED = REGISTRY.counter_vec(
+    "beacon_processor_dropped_total",
+    "work items dropped because their queue was full, by work kind",
+    ("kind",),
+)
+_PROCESSED = REGISTRY.counter_vec(
+    "beacon_processor_processed_total",
+    "work items executed, by work kind",
+    ("kind",),
+)
+_QUEUE_WAIT = REGISTRY.histogram_vec(
+    "beacon_processor_queue_wait_seconds",
+    "submit-to-pop latency of the oldest item in each executed work unit",
+    ("kind",),
+    buckets=(0.0001, 0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 5.0, 30.0),
+)
+_EXEC_LOCK_WAIT = REGISTRY.histogram(
+    "beacon_processor_exec_lock_wait_seconds",
+    "time spent waiting for the chain-mutation exec lock",
+    buckets=(0.00001, 0.0001, 0.001, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0),
+)
+_INFLIGHT = REGISTRY.gauge(
+    "beacon_processor_inflight_batches",
+    "device verification batches currently in flight",
+)
+_BATCHES_FORMED = REGISTRY.counter(
+    "beacon_processor_batches_formed_total",
+    "coalesced multi-item batches formed by the scheduler",
+)
+_ERRORS = REGISTRY.counter_vec(
+    "beacon_processor_errors_total",
+    "work unit failures swallowed by the pump, by pipeline stage",
+    ("stage",),
+)
 
 
 def _planned(attr: str, default: int) -> int:
@@ -83,6 +141,8 @@ class WorkItem:
     # batchable items carry a payload + a batch runner instead
     payload: object = None
     run_batch: Callable[[list], None] | None = None
+    # stamped by submit(): feeds the queue-wait histogram + enqueue span
+    t_enq: float = 0.0
 
 
 @dataclass
@@ -125,7 +185,13 @@ class BeaconProcessor:
         self.processed: dict[WorkKind, int] = {k: 0 for k in WorkKind}
         self.batches_formed = 0
         self.pipelined_batches = 0
-        # in-flight device submissions: (handle, continuation) FIFO
+        # per-kind metric children resolved ONCE: the hot path pays a plain
+        # dict lookup per event, never a family lock
+        self._m_depth = {k: _QUEUE_DEPTH.labels(k.name) for k in WorkKind}
+        self._m_dropped = {k: _DROPPED.labels(k.name) for k in WorkKind}
+        self._m_processed = {k: _PROCESSED.labels(k.name) for k in WorkKind}
+        self._m_wait = {k: _QUEUE_WAIT.labels(k.name) for k in WorkKind}
+        # in-flight device submissions: (handle, continuation, trace) FIFO
         self._inflight: deque = deque()
         self._lock = threading.Lock()
         # Serializes chain-mutating execution (runners + continuations)
@@ -138,30 +204,39 @@ class BeaconProcessor:
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        from ..observability import register_processor
+
+        register_processor(self)
 
     # ------------------------------------------------------------- submit
 
     def submit(self, item: WorkItem) -> bool:
         """Enqueue; returns False if the queue for this kind is full (the
         item is dropped, like the reference's bounded queues)."""
+        item.t_enq = perf_counter()
         with self._lock:
             q = self.queues[item.kind]
             if len(q) >= self.max_lengths[item.kind]:
                 self.dropped[item.kind] += 1
+                self._m_dropped[item.kind].inc()
                 return False
             q.append(item)
+            self._m_depth[item.kind].set(len(q))
         self._wake.set()
         return True
 
     # ------------------------------------------------------------- drain
 
     def _next_work(self):
-        """Pop the highest-priority work; coalesce batchable kinds."""
+        """Pop the highest-priority work; coalesce batchable kinds.
+        Returns (single, batch, trace) — the trace carries the enqueue and
+        coalesce spans of whatever was popped."""
         with self._lock:
             for kind in WorkKind:
                 q = self.queues[kind]
                 if not q:
                     continue
+                t_pop = perf_counter()
                 if kind in self.BATCHABLE:
                     cap = (
                         self.config.max_attestation_batch
@@ -171,34 +246,62 @@ class BeaconProcessor:
                     items = []
                     while q and len(items) < cap:
                         items.append(q.popleft())
+                    self._m_depth[kind].set(len(q))
+                    trace = self._begin_trace(kind, items[0], len(items), t_pop)
                     if len(items) == 1:
-                        return items[0], None
+                        return items[0], None, trace
                     self.batches_formed += 1
-                    return None, items
-                return q.popleft(), None
-        return None, None
+                    _BATCHES_FORMED.inc()
+                    return None, items, trace
+                item = q.popleft()
+                self._m_depth[kind].set(len(q))
+                trace = self._begin_trace(kind, item, 1, t_pop)
+                return item, None, trace
+        return None, None, None
 
-    def _execute(self, single, batch) -> None:
-        if batch is not None:
-            kind = batch[0].kind
-            runner = batch[0].run_batch
-            payloads = [it.payload for it in batch]
-            with self._exec_lock:
+    def _begin_trace(self, kind, oldest: WorkItem, n: int, t_pop: float):
+        """Trace for one popped work unit: the enqueue span covers the
+        OLDEST item's queue residency (== the max wait in the unit), the
+        coalesce span the pop/batch-form step itself."""
+        self._m_wait[kind].observe(t_pop - oldest.t_enq)
+        trace = obs.TRACER.begin(kind.name, n)
+        trace.add_span("enqueue", oldest.t_enq, t_pop)
+        trace.add_span("coalesce", t_pop, perf_counter(), items=n)
+        return trace
+
+    def _execute(self, single, batch, trace=None) -> None:
+        t_wait = perf_counter()
+        self._exec_lock.acquire()
+        _EXEC_LOCK_WAIT.observe(perf_counter() - t_wait)
+        obs.set_current_trace(trace)
+        t_marshal = perf_counter()
+        try:
+            if batch is not None:
+                kind = batch[0].kind
+                runner = batch[0].run_batch
+                payloads = [it.payload for it in batch]
                 result = runner(payloads)
-            self._handle_result(result)
-            self.processed[kind] += len(batch)
-        elif single is not None:
-            if single.run is not None:
-                with self._exec_lock:
+            elif single is not None:
+                kind = single.kind
+                if single.run is not None:
                     result = single.run()
-                self._handle_result(result)
-            elif single.run_batch is not None:
-                with self._exec_lock:
+                elif single.run_batch is not None:
                     result = single.run_batch([single.payload])
-                self._handle_result(result)
-            self.processed[single.kind] += 1
+                else:
+                    result = None
+            else:
+                return
+        finally:
+            obs.set_current_trace(None)
+            self._exec_lock.release()
+        if trace is not None:
+            trace.add_span("marshal", t_marshal, perf_counter())
+        n = len(batch) if batch is not None else 1
+        self.processed[kind] += n
+        self._m_processed[kind].inc(n)
+        self._handle_result(result, trace)
 
-    def _handle_result(self, result) -> None:
+    def _handle_result(self, result, trace=None) -> None:
         """A runner may return (handle, continuation): the device batch is
         in flight and the continuation runs when it resolves. The pump keeps
         pulling (and marshalling) new work while up to max_inflight device
@@ -211,34 +314,51 @@ class BeaconProcessor:
             and callable(result[1])
         ):
             with self._lock:
-                self._inflight.append(result)
+                self._inflight.append((result[0], result[1], trace))
                 self.pipelined_batches += 1
+                _INFLIGHT.set(len(self._inflight))
                 over = len(self._inflight) > self.config.max_inflight
             if over:
                 self._resolve_oldest()
+        else:
+            # no device leg: the work completed inside the marshal span
+            obs.TRACER.finish(trace)
 
     def _resolve_oldest(self) -> bool:
         with self._lock:
             if not self._inflight:
                 return False
-            handle, cont = self._inflight.popleft()
+            handle, cont, trace = self._inflight.popleft()
+            _INFLIGHT.set(len(self._inflight))
         # a device failure mid-batch (tunnel drop) must never kill the pump
         # worker: the batch is lost (its deferred gossip validations expire
         # as ignores) but the node keeps verifying
+        t_dev = perf_counter()
         try:
             res = handle.result()      # device wait: outside the exec lock
-        except Exception:
-            import traceback
-
-            traceback.print_exc()
+        except Exception as e:
+            _ERRORS.labels("device").inc()
+            log.error(
+                "device batch failed; batch dropped",
+                error=f"{type(e).__name__}: {e}",
+            )
+            obs.TRACER.finish(trace)
             return True
+        if trace is not None:
+            trace.add_span("device", t_dev, perf_counter())
+        t_cont = perf_counter()
         try:
             with self._exec_lock:
                 cont(res)              # chain mutation: serialized
-        except Exception:
-            import traceback
-
-            traceback.print_exc()
+        except Exception as e:
+            _ERRORS.labels("continuation").inc()
+            log.error(
+                "batch continuation failed",
+                error=f"{type(e).__name__}: {e}",
+            )
+        if trace is not None:
+            trace.add_span("continuation", t_cont, perf_counter())
+        obs.TRACER.finish(trace)
         return True
 
     def drain_inflight(self) -> int:
@@ -251,18 +371,38 @@ class BeaconProcessor:
         """Synchronously drain everything (test/deterministic mode)."""
         n = 0
         while True:
-            single, batch = self._next_work()
+            single, batch, trace = self._next_work()
             if single is None and batch is None:
                 n += self.drain_inflight()
                 if self.queues_empty():
                     return n
                 continue
-            self._execute(single, batch)
+            self._execute(single, batch, trace)
             n += 1
 
     def queues_empty(self) -> bool:
         with self._lock:
             return all(not q for q in self.queues.values()) and not self._inflight
+
+    def stats(self) -> dict:
+        """Live scheduler state for /lighthouse_tpu/pipeline snapshots."""
+        with self._lock:
+            queued = {
+                k.name: len(q) for k, q in self.queues.items() if q
+            }
+            inflight = len(self._inflight)
+        return {
+            "queued": queued,
+            "inflight_batches": inflight,
+            "max_inflight": self.config.max_inflight,
+            "batches_formed": self.batches_formed,
+            "pipelined_batches": self.pipelined_batches,
+            "processed": {
+                k.name: v for k, v in self.processed.items() if v
+            },
+            "dropped": {k.name: v for k, v in self.dropped.items() if v},
+            "workers": len(self._threads),
+        }
 
     # ------------------------------------------------------------- threads
 
@@ -275,7 +415,7 @@ class BeaconProcessor:
 
     def _worker(self) -> None:
         while not self._stop.is_set():
-            single, batch = self._next_work()
+            single, batch, trace = self._next_work()
             if single is None and batch is None:
                 if self._resolve_oldest():
                     continue
@@ -283,11 +423,18 @@ class BeaconProcessor:
                 self._wake.clear()
                 continue
             try:
-                self._execute(single, batch)
-            except Exception:  # worker never dies on bad work
-                import traceback
-
-                traceback.print_exc()
+                self._execute(single, batch, trace)
+            except Exception as e:  # worker never dies on bad work
+                _ERRORS.labels("execute").inc()
+                log.error(
+                    "work unit failed; pump continues",
+                    kind=(single or batch[0]).kind.name,
+                    error=f"{type(e).__name__}: {e}",
+                )
+                # the failed unit's enqueue/coalesce spans still belong in
+                # the ring — failing work is exactly what an operator pulls
+                # a trace for
+                obs.TRACER.finish(trace)
 
     def stop(self) -> None:
         self._stop.set()

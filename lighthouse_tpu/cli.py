@@ -476,6 +476,20 @@ def cmd_bn(args):
     )
     log.info("metrics server started", addr=args.metrics_address, port=mport)
 
+    tracer = None
+    if getattr(args, "trace_out", None):
+        # pipeline tracing is always on (bounded ring); --trace-out adds a
+        # Chrome trace-event export at shutdown. The startup probe pushes a
+        # synthetic batch through a real BeaconProcessor so even a node
+        # with no gossip traffic exports spans for every pipeline stage.
+        from .observability import TRACER, pipeline as obs_pipeline
+
+        tracer = TRACER
+        tracer.out_path = args.trace_out
+        executed = obs_pipeline.run_probe()
+        log.info("pipeline trace probe complete", work_units=executed,
+                 trace_out=args.trace_out)
+
     executor = TaskExecutor(name="bn", log=lambda m: log.info(m))
 
     def slot_timer(exit_signal):
@@ -534,6 +548,13 @@ def cmd_bn(args):
         mserver.shutdown()
         if net is not None:
             net.close()
+        if tracer is not None:
+            try:
+                n_events = tracer.write_chrome_trace(tracer.out_path)
+                log.info("pipeline trace written", path=tracer.out_path,
+                         events=n_events)
+            except OSError as e:
+                log.warn("pipeline trace write failed", error=str(e))
         if store is not None:
             op_pool.persist(store, _tfs_pool(spec, 0))
         if lock is not None:
@@ -1300,6 +1321,12 @@ def build_parser() -> argparse.ArgumentParser:
     bn.add_argument("--graffiti-file", default=None,
                     help="file whose first line is the block graffiti "
                          "(alternative to --graffiti)")
+    bn.add_argument("--trace-out", default=None,
+                    help="write the verification pipeline's span traces as "
+                         "Chrome trace-event JSON (load in Perfetto) to "
+                         "this path at shutdown; also runs a synthetic "
+                         "pipeline probe at startup so a quiet node still "
+                         "traces every stage")
     bn.set_defaults(fn=cmd_bn)
 
     vc = sub.add_parser("vc", help="run a validator client")

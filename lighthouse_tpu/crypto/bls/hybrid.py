@@ -37,24 +37,24 @@ from typing import Sequence
 from ...utils.logging import get_logger
 from ...utils.metrics import REGISTRY
 
-_HOST_VERIFIES = REGISTRY.counter(
-    "bls_hybrid_host_verifies_total",
-    "multi-set verifications served by the host (python) path",
-)
-_DEVICE_VERIFIES = REGISTRY.counter(
-    "bls_hybrid_device_verifies_total",
-    "multi-set verifications served by the device (jax) path",
+# one labeled family instead of a name-mangled counter per reason: a scrape
+# can sum over paths or break a path down by reason without regexes. Each
+# verification is counted ONCE, by the path that finally served it — a
+# device dispatch that fails and reroutes shows as {path="host",
+# reason="device_error"}, never as two decisions
+_ROUTE_DECISIONS = REGISTRY.counter_vec(
+    "bls_hybrid_route_total",
+    "verifications by the path that served them and the routing reason",
+    ("path", "reason"),
 )
 _REASONS = {
-    reason: REGISTRY.counter(
-        f"bls_hybrid_host_reason_{reason}_total",
-        f"host-path verifications because: {reason.replace('_', ' ')}",
-    )
+    reason: _ROUTE_DECISIONS.labels("host", reason)
     for reason in (
         "device_down", "device_probing", "device_cold", "latency_budget",
         "device_error",
     )
 }
+_DEVICE_ROUTED = _ROUTE_DECISIONS.labels("device", "ok")
 _DEVICE_LATENCY = REGISTRY.histogram(
     "bls_hybrid_device_verify_seconds", "device multi-set verify wall time"
 )
@@ -351,7 +351,6 @@ class HybridBackend:
     def verify_signature_sets(self, sets, rands) -> bool:
         path, reason = self._route(sets)
         if path == "host":
-            _HOST_VERIFIES.inc()
             _REASONS[reason].inc()
             return self._host().verify_signature_sets(sets, rands)
         bucket = self._bucket(sets)
@@ -359,11 +358,10 @@ class HybridBackend:
             t0 = time.time()
             ok = self._device.verify_signature_sets(sets, rands)
             self._record_device_ok(bucket, time.time() - t0)
-            _DEVICE_VERIFIES.inc()
+            _DEVICE_ROUTED.inc()
             return ok
         except Exception as e:
             self._record_device_error(e)
-            _HOST_VERIFIES.inc()
             _REASONS["device_error"].inc()
             return self._host().verify_signature_sets(sets, rands)
 
@@ -372,7 +370,6 @@ class HybridBackend:
 
         path, reason = self._route(sets)
         if path == "host":
-            _HOST_VERIFIES.inc()
             _REASONS[reason].inc()
             return api._ReadyHandle(
                 self._host().verify_signature_sets(sets, rands)
@@ -391,11 +388,10 @@ class HybridBackend:
                 try:
                     r = self._inner.result()
                     outer._record_device_ok(bucket, time.time() - self._t0)
-                    _DEVICE_VERIFIES.inc()
+                    _DEVICE_ROUTED.inc()
                     return r
                 except Exception as e:
                     outer._record_device_error(e)
-                    _HOST_VERIFIES.inc()
                     _REASONS["device_error"].inc()
                     return outer._host().verify_signature_sets(sets, rands)
 
@@ -404,7 +400,6 @@ class HybridBackend:
             return _Handle(self._device.verify_signature_sets_async(sets, rands), t0)
         except Exception as e:
             self._record_device_error(e)
-            _HOST_VERIFIES.inc()
             _REASONS["device_error"].inc()
             return api._ReadyHandle(self._host().verify_signature_sets(sets, rands))
 

@@ -35,6 +35,8 @@ import os
 
 import numpy as np
 
+from ...observability import trace as _obs
+from ...utils.metrics import REGISTRY
 from ..bls381.constants import P, R, DST_POP
 from ..bls381 import curve as pc
 from . import limbs as lb
@@ -42,6 +44,41 @@ from . import tower as tw
 from . import curve_ops as co
 from . import h2c_ops as h2
 from . import pairing_ops as po
+
+# ------------------------------------------------------------------ metrics
+# the dispatch pipeline's own breakdown: host marshal cost, async-enqueue
+# cost (the jit-call returns once the work is queued), and the blocking
+# device wait split compile-vs-execute (first resolve at a padding bucket
+# pays XLA compilation; the autotune profiler folds that into compile_secs,
+# this family makes the split visible on a plain scrape)
+_MARSHAL_SECONDS = REGISTRY.histogram(
+    "jaxbls_marshal_seconds",
+    "host-side batch marshalling time (packing + device placement)",
+    buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0),
+)
+_DISPATCH_ENQUEUE_SECONDS = REGISTRY.histogram(
+    "jaxbls_dispatch_enqueue_seconds",
+    "async submission time of the staged device program (host blocked)",
+    buckets=(0.0001, 0.001, 0.01, 0.1, 0.5, 1.0, 5.0, 30.0, 120.0, 600.0),
+)
+_DEVICE_WAIT_SECONDS = REGISTRY.histogram_vec(
+    "jaxbls_device_wait_seconds",
+    "blocking wait for a dispatched batch, by phase (compile = first "
+    "resolve at a padding bucket, execute = steady state)",
+    ("phase",),
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0, 120.0, 600.0),
+)
+_MARSHALLED_BYTES = REGISTRY.counter_vec(
+    "jaxbls_marshalled_bytes_total",
+    "bytes packed for device upload, by array family",
+    ("array",),
+)
+_PK_CACHE = REGISTRY.counter_vec(
+    "jaxbls_pubkey_cache_total",
+    "device-resident pubkey marshalling cache outcomes",
+    ("result",),
+)
+_seen_exec_buckets: set = set()  # buckets that have resolved at least once
 
 MIN_SETS = 4          # smallest bucket (pairs axis = sets + 1 rounded up)
 MIN_PKS = 1
@@ -366,14 +403,21 @@ class VerifyHandle:
     def result(self) -> bool:
         if self._hostfail:
             return False
+        import time
+
+        t_wait = time.perf_counter()
         r = bool(np.asarray(self._ok)) and not bool(np.asarray(self._bad))
         if self._t0 is not None and self._bucket is not None:
-            import time
-
             from ...autotune import profiler
 
-            dt, self._t0 = time.perf_counter() - self._t0, None
+            now = time.perf_counter()
+            dt, self._t0 = now - self._t0, None
             profiler.observe_dispatch(*self._bucket, dt, self._n_real)
+            # compile-vs-execute split: the first resolve at a bucket paid
+            # XLA compilation for whatever stages were still cold
+            phase = "execute" if self._bucket in _seen_exec_buckets else "compile"
+            _seen_exec_buckets.add(self._bucket)
+            _DEVICE_WAIT_SECONDS.labels(phase).observe(now - t_wait)
         return r
 
 
@@ -414,7 +458,9 @@ class JaxBackend:
         )
         hit = self._pk_cache.get(fp)
         if hit is not None:
+            _PK_CACHE.labels("hit").inc()
             return hit[0], hit[1], hit[2]
+        _PK_CACHE.labels("miss").inc()
 
         pk_x = np.zeros((n, m, lb.NL), np.uint32)
         pk_y = np.zeros((n, m, lb.NL), np.uint32)
@@ -428,6 +474,9 @@ class JaxBackend:
             pk_mask[i, : len(keys)] = 1
         from ...parallel import put_pk_grid
 
+        _MARSHALLED_BYTES.labels("pubkeys").inc(
+            pk_x.nbytes + pk_y.nbytes + pk_mask.nbytes
+        )
         # (n, m, ...) pubkey arrays: set axis sharded; on a 2-D mesh the
         # pubkey axis is sharded too (within-set aggregation parallelism)
         dx, dy, dm = put_pk_grid(pk_x), put_pk_grid(pk_y), put_pk_grid(pk_mask)
@@ -441,8 +490,11 @@ class JaxBackend:
         return dx, dy, dm
 
     def verify_signature_sets_async(self, sets, rands) -> VerifyHandle:
+        import time
+
         from ...parallel import put_sets
 
+        t_marshal = time.perf_counter()
         prepare, h2c_stage, pairs_stage, pairing_stage = _get_stages()
         n_real = len(sets)
         # pad the set axis to the compile bucket AND to a multiple of the
@@ -477,6 +529,10 @@ class JaxBackend:
         us = np.zeros((n, 2, 2, lb.NL), np.uint32)
         us[:n_real] = h2.hash_to_field_batch([s.message for s in sets], self.dst)
 
+        _MARSHALLED_BYTES.labels("sets").inc(
+            sig_x.nbytes + sig_y.nbytes + z_digits.nbytes
+            + set_mask.nbytes + us.nbytes
+        )
         # staged dispatch: intermediates stay on device between jit calls,
         # inputs placed with the set axis sharded over the mesh (no-op on
         # one device)
@@ -484,15 +540,18 @@ class JaxBackend:
             put_sets(sig_x), put_sets(sig_y), put_sets(z_digits),
             put_sets(set_mask), put_sets(us),
         )
-        import time
-
         t0 = time.perf_counter()
+        _MARSHAL_SECONDS.observe(t0 - t_marshal)
+        tr = _obs.current_trace()
+        if tr is not None:
+            tr.annotate(bucket=f"{n}x{m}", real_sets=n_real)
         z_pk, sig_acc, bad = prepare(
             pk_x, pk_y, pk_mask, sig_x, sig_y, z_digits, set_mask
         )
         h_jac = h2c_stage(us)
         px, py, qxx, qyy, pair_mask = pairs_stage(z_pk, h_jac, sig_acc, set_mask)
         ok = pairing_stage(px, py, qxx, qyy, pair_mask)
+        _DISPATCH_ENQUEUE_SECONDS.observe(time.perf_counter() - t0)
         return VerifyHandle(ok, bad, bucket=(n, m), t0=t0, n_real=n_real)
 
     def verify_signature_sets(self, sets, rands) -> bool:

@@ -1,0 +1,28 @@
+"""Observability: pipeline span tracing + stage-timing snapshots.
+
+The verification dataflow (gossip -> BeaconProcessor queues -> coalesced
+batch -> host marshal -> device dispatch -> device wait -> continuation) is
+the system's hot path; this package makes it legible from the outside:
+
+  - `trace`: a lightweight span tracer. Every executed work unit carries a
+    Trace through the pipeline stages; completed traces land in a bounded
+    ring and feed per-stage Prometheus histograms, and the ring exports as
+    Chrome trace-event (Perfetto) JSON (`bn --trace-out trace.json`).
+  - `pipeline`: the stage-timing snapshot behind the
+    `/lighthouse_tpu/pipeline` ops endpoint.
+
+Always-on by design: recording a trace is appending a few floats to a
+deque, so there is no enabled/disabled bifurcation to test — `--trace-out`
+only controls whether the ring is written to disk at shutdown.
+"""
+
+from .trace import (  # noqa: F401
+    PIPELINE_STAGES,
+    TRACER,
+    Trace,
+    Tracer,
+    chrome_trace_events,
+    current_trace,
+    set_current_trace,
+)
+from .pipeline import register_processor, snapshot  # noqa: F401

@@ -1,0 +1,182 @@
+"""Span tracer for the verification dataflow.
+
+Model: a `Trace` is one work unit's journey through the pipeline — a
+coalesced gossip batch, a single work item, a device dispatch. Stages are
+recorded as closed spans (name, t0, t1, args); the processor owns the
+canonical stage names (PIPELINE_STAGES) but producers may add sub-spans
+(the jaxbls backend annotates marshalled bytes and its dispatch split).
+
+Every finished span feeds the `pipeline_stage_seconds{stage,kind}`
+histogram family; the finished trace lands in a bounded ring. The ring
+serves two consumers:
+
+  - `/lighthouse_tpu/pipeline` (observability/pipeline.py): recent-trace
+    summaries next to the aggregate stage timings;
+  - Chrome trace-event export (`bn --trace-out`): `chrome_trace_events`
+    renders the ring in the trace-event JSON schema Perfetto/chrome://
+    tracing load directly — one "thread" row per pipeline lane, complete
+    ("ph": "X") events with microsecond timestamps.
+
+Cost model: the hot path pays one Trace alloc + a span tuple append per
+stage per BATCH (not per attestation), and one histogram observe per span
+— dict lookups and float math, no syscalls, no locks beyond the metric's.
+Timestamps are time.perf_counter() (monotonic); the export rebases them so
+t=0 is the oldest event in the ring.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from collections import deque
+from time import perf_counter
+
+from ..utils.metrics import REGISTRY
+
+#: canonical stage order of the verification dataflow; the acceptance
+#: surface for exports (docs/OBSERVABILITY.md "Trace stages")
+PIPELINE_STAGES = ("enqueue", "coalesce", "marshal", "device", "continuation")
+
+# spans range from sub-ms queue pops to multi-minute cold compiles
+_STAGE_BUCKETS = (
+    0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+    5.0, 10.0, 30.0, 60.0, 300.0,
+)
+
+STAGE_SECONDS = REGISTRY.histogram_vec(
+    "pipeline_stage_seconds",
+    "verification dataflow stage wall time, by stage and work kind",
+    ("stage", "kind"),
+    buckets=_STAGE_BUCKETS,
+)
+
+TRACES_TOTAL = REGISTRY.counter_vec(
+    "pipeline_traces_total",
+    "completed pipeline traces, by work kind",
+    ("kind",),
+)
+
+
+class Trace:
+    """One work unit's spans. Append-only; finished via Tracer.finish."""
+
+    __slots__ = ("kind", "n_items", "t0", "spans", "meta")
+
+    def __init__(self, kind: str, n_items: int = 1):
+        self.kind = kind
+        self.n_items = n_items
+        self.t0 = perf_counter()
+        self.spans: list = []        # (name, t0, t1, args|None)
+        self.meta: dict = {}
+
+    def add_span(self, name: str, t0: float, t1: float, **args) -> None:
+        self.spans.append((name, t0, t1, args or None))
+
+    def annotate(self, **kv) -> None:
+        """Attach key/values to the whole trace (bucket, bytes, ...)."""
+        self.meta.update(kv)
+
+    def duration(self) -> float:
+        if not self.spans:
+            return 0.0
+        return max(t1 for _, _, t1, _ in self.spans) - min(
+            t0 for _, t0, _, _ in self.spans
+        )
+
+
+class Tracer:
+    """Bounded ring of completed traces + per-stage histogram feed."""
+
+    def __init__(self, ring_size: int = 256):
+        self.ring: deque = deque(maxlen=ring_size)
+        self._lock = threading.Lock()
+        self.completed = 0
+        self.out_path: str | None = None  # bn --trace-out destination
+
+    def begin(self, kind: str, n_items: int = 1) -> Trace:
+        return Trace(kind, n_items)
+
+    def finish(self, trace: Trace | None) -> None:
+        if trace is None:
+            return
+        for name, t0, t1, _args in trace.spans:
+            STAGE_SECONDS.labels(name, trace.kind).observe(t1 - t0)
+        TRACES_TOTAL.labels(trace.kind).inc()
+        with self._lock:
+            self.ring.append(trace)
+            self.completed += 1
+
+    def snapshot_ring(self) -> list[Trace]:
+        with self._lock:
+            return list(self.ring)
+
+    def reset(self) -> None:
+        with self._lock:
+            self.ring.clear()
+            self.completed = 0
+
+    # ------------------------------------------------------------- export
+
+    def write_chrome_trace(self, path: str) -> int:
+        """Write the ring as Chrome trace-event JSON; returns event count."""
+        events = chrome_trace_events(self.snapshot_ring())
+        doc = {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {"producer": "lighthouse-tpu pipeline tracer"},
+        }
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        return len(events)
+
+
+def chrome_trace_events(traces: list[Trace]) -> list[dict]:
+    """Trace-event ("X" complete events, µs) rows for a list of traces.
+
+    Each trace gets its own tid so overlapping pipeline lanes (up to
+    max_inflight device batches) render as parallel rows; tids recycle
+    mod 32 to keep the track count readable. Timestamps are rebased so
+    the oldest span in the export is t=0."""
+    if not traces:
+        return []
+    base = min(t0 for tr in traces for _, t0, _, _ in tr.spans or [("", tr.t0, tr.t0, None)])
+    pid = os.getpid()
+    events = []
+    for i, tr in enumerate(traces):
+        tid = i % 32
+        for name, t0, t1, args in tr.spans:
+            ev = {
+                "name": name,
+                "cat": tr.kind,
+                "ph": "X",
+                "ts": (t0 - base) * 1e6,
+                "dur": max(0.0, (t1 - t0) * 1e6),
+                "pid": pid,
+                "tid": tid,
+            }
+            merged = dict(tr.meta)
+            if args:
+                merged.update(args)
+            if merged:
+                ev["args"] = {k: str(v) for k, v in merged.items()}
+            events.append(ev)
+    return events
+
+
+TRACER = Tracer()
+
+# ------------------------------------------------------- context propagation
+
+_tls = threading.local()
+
+
+def set_current_trace(trace: Trace | None) -> None:
+    """Bind the in-progress trace to this thread so layers below the
+    processor (jaxbls marshal/dispatch) can add sub-spans without plumbing
+    a trace argument through every call signature."""
+    _tls.trace = trace
+
+
+def current_trace() -> Trace | None:
+    return getattr(_tls, "trace", None)

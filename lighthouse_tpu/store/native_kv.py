@@ -3,16 +3,28 @@
 Builds lib on first use with g++ (cached beside the source); exposes the
 KeyValueStore interface so HotColdDB can run on either MemoryStore (tests)
 or NativeKVStore (production), mirroring how the reference picks
-LevelDB vs MemoryStore behind its KeyValueStore trait."""
+LevelDB vs MemoryStore behind its KeyValueStore trait.
+
+Graceful degradation: when the shared library cannot be built OR loaded
+(no g++ in the image, a libstdc++ older than the library's GLIBCXX
+requirement, ...), `NativeKVStore(path)` transparently constructs a
+PurePythonKVStore instead — a pure-Python replay of the SAME on-disk
+format (CRC32-framed append-only record log, see kv_store.cc), so a
+database written by either engine opens under the other. The swap is
+announced with a single structured warn per process; everything else about
+the node keeps working, just with Python-speed store IO."""
 
 from __future__ import annotations
 
 import ctypes
 import os
+import struct
 import subprocess
 import threading
+import zlib
 from pathlib import Path
 
+from ..utils.logging import get_logger
 from .kv import Column, KeyValueOp, KeyValueStore
 
 _SRC = Path(__file__).parent / "native" / "kv_store.cc"
@@ -20,16 +32,37 @@ _LIB = Path(__file__).parent / "native" / "libltkv.so"
 _build_lock = threading.Lock()
 
 
+def _cache_lib() -> Path:
+    """Per-user rebuild target: the tracked .so must never be overwritten
+    at runtime (a host-toolchain binary would dirty every checkout and
+    could land in a commit)."""
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    )
+    d = Path(base) / "lighthouse_tpu_native"
+    d.mkdir(parents=True, exist_ok=True)
+    return d / "libltkv.so"
+
+
+def _build(dst: Path) -> Path:
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+        str(_SRC), "-o", str(dst),
+    ]
+    subprocess.run(cmd, check=True, capture_output=True)
+    return dst
+
+
 def _ensure_built() -> Path:
     with _build_lock:
         if _LIB.exists() and _LIB.stat().st_mtime >= _SRC.stat().st_mtime:
             return _LIB
-        cmd = [
-            "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-            str(_SRC), "-o", str(_LIB),
-        ]
-        subprocess.run(cmd, check=True, capture_output=True)
-        return _LIB
+        # tracked lib absent or stale vs source: build into the cache, not
+        # over the tracked artifact
+        cached = _cache_lib()
+        if cached.exists() and cached.stat().st_mtime >= _SRC.stat().st_mtime:
+            return cached
+        return _build(cached)
 
 
 _lib = None
@@ -40,7 +73,16 @@ def _load():
     if _lib is not None:
         return _lib
     path = _ensure_built()
-    lib = ctypes.CDLL(str(path))
+    try:
+        lib = ctypes.CDLL(str(path))
+    except OSError:
+        # the prebuilt .so can be unloadable on THIS host (e.g. it requires
+        # a GLIBCXX newer than the system libstdc++): recompiling from
+        # source links against the local toolchain, so try that once before
+        # the caller degrades to the pure-Python engine
+        with _build_lock:
+            path = _build(_cache_lib())
+        lib = ctypes.CDLL(str(path))
     lib.kvs_open.restype = ctypes.c_void_p
     lib.kvs_open.argtypes = [ctypes.c_char_p]
     lib.kvs_close.argtypes = [ctypes.c_void_p]
@@ -75,8 +117,166 @@ def _ckey(column: Column, key: bytes) -> bytes:
     return column.value.encode() + b":" + key
 
 
+_fallback_warned = False
+
+
+def _native_unavailable(err: Exception) -> None:
+    """One structured warn per process when the C++ engine is unusable."""
+    global _fallback_warned
+    if not _fallback_warned:
+        _fallback_warned = True
+        get_logger("store").warn(
+            "native kv store unavailable; falling back to the pure-Python "
+            "log store (same on-disk format, slower IO)",
+            error=f"{type(err).__name__}: {err}",
+        )
+
+
+class PurePythonKVStore(KeyValueStore):
+    """Pure-Python engine over the native store's record-log format.
+
+    Format (kv_store.cc): records of [u32 crc][u32 len][payload], payload a
+    run of ops [u8 op][u32 klen][u32 vlen][key][value] with op 1=put 2=del;
+    all integers little-endian, crc = CRC-32 (zlib) over the payload.
+    Replay stops at the first truncated or CRC-failing record — the
+    crash-consistent prefix wins, exactly like the C++ loader."""
+
+    def __init__(self, path: str | os.PathLike):
+        path = os.fspath(path)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._path = path
+        self._lock = threading.Lock()
+        self._index: dict[bytes, bytes] = {}
+        valid_end = self._replay()
+        # drop the corrupt/truncated tail BEFORE appending: a new record
+        # written after garbage would be unreachable on the next replay
+        # (the scanner stops at the bad record), silently losing every
+        # post-recovery write
+        if valid_end is not None:
+            with open(path, "r+b") as f:
+                f.truncate(valid_end)
+        self._log = open(path, "ab")
+
+    # ------------------------------------------------------------ log IO
+
+    def _replay(self) -> int | None:
+        """Replay the log; returns the byte offset of the end of the last
+        valid record (None when the file does not exist yet)."""
+        try:
+            f = open(self._path, "rb")
+        except FileNotFoundError:
+            return None  # fresh store
+        with f:
+            valid_end = 0
+            while True:
+                header = f.read(8)
+                if len(header) < 8:
+                    break
+                crc, length = struct.unpack("<II", header)
+                payload = f.read(length)
+                if len(payload) < length:
+                    break  # truncated tail
+                if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                    break  # corrupt tail: crash-consistent prefix wins
+                self._apply(payload)
+                valid_end = f.tell()
+            return valid_end
+
+    def _apply(self, payload: bytes) -> None:
+        pos = 0
+        n = len(payload)
+        while pos + 9 <= n:
+            op = payload[pos]
+            klen, vlen = struct.unpack_from("<II", payload, pos + 1)
+            pos += 9
+            if pos + klen + vlen > n:
+                return  # truncated op run
+            key = payload[pos : pos + klen]
+            pos += klen
+            val = payload[pos : pos + vlen]
+            pos += vlen
+            if op == 1:
+                self._index[key] = val
+            elif op == 2:
+                self._index.pop(key, None)
+
+    @staticmethod
+    def _encode_ops(ops: list[KeyValueOp]) -> bytes:
+        payload = bytearray()
+        for op in ops:
+            k = _ckey(op.column, op.key)
+            v = op.value if (op.kind == "put" and op.value) else b""
+            payload.append(1 if op.kind == "put" else 2)
+            payload += struct.pack("<II", len(k), len(v))
+            payload += k
+            payload += v
+        return bytes(payload)
+
+    def _write_record(self, fh, payload: bytes) -> None:
+        fh.write(struct.pack("<II", zlib.crc32(payload) & 0xFFFFFFFF,
+                             len(payload)))
+        fh.write(payload)
+        fh.flush()
+
+    # ------------------------------------------------------------ interface
+
+    def get(self, column: Column, key: bytes) -> bytes | None:
+        with self._lock:
+            return self._index.get(_ckey(column, key))
+
+    def do_atomically(self, ops: list[KeyValueOp]) -> None:
+        payload = self._encode_ops(ops)
+        with self._lock:
+            self._write_record(self._log, payload)
+            self._apply(payload)
+
+    def iter_column(self, column: Column):
+        prefix = column.value.encode() + b":"
+        with self._lock:
+            items = sorted(
+                (k[len(prefix):], v)
+                for k, v in self._index.items()
+                if k.startswith(prefix)
+            )
+        return iter(items)
+
+    def compact(self) -> None:
+        """Rewrite the log with only live records (stop-the-world)."""
+        tmp_path = self._path + ".compact"
+        with self._lock:
+            with open(tmp_path, "wb") as tmp:
+                for k, v in self._index.items():
+                    payload = bytes(bytearray([1])
+                                    + struct.pack("<II", len(k), len(v))
+                                    + k + v)
+                    self._write_record(tmp, payload)
+            self._log.close()
+            os.replace(tmp_path, self._path)
+            self._log = open(self._path, "ab")
+
+    def __len__(self):
+        with self._lock:
+            return len(self._index)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._log is not None:
+                self._log.close()
+                self._log = None
+
+
 class NativeKVStore(KeyValueStore):
-    """Production store on the C++ backend."""
+    """Production store on the C++ backend (pure-Python fallback when the
+    native library cannot be built/loaded — see module docstring)."""
+
+    def __new__(cls, path: str | os.PathLike):
+        if cls is NativeKVStore:
+            try:
+                _load()
+            except Exception as e:  # noqa: BLE001 — any load failure degrades
+                _native_unavailable(e)
+                return PurePythonKVStore(path)
+        return super().__new__(cls)
 
     def __init__(self, path: str | os.PathLike):
         lib = _load()

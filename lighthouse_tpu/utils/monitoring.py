@@ -14,7 +14,17 @@ import threading
 import time
 import urllib.request
 
+from .metrics import REGISTRY
+
 VERSION = "lighthouse-tpu/0.2.0"
+
+# outcome-labeled delivery counter: a scrape shows whether the remote
+# monitoring endpoint is reachable without grepping logs
+_POSTS = REGISTRY.counter_vec(
+    "monitoring_posts_total",
+    "remote monitoring POST attempts, by outcome",
+    ("result",),
+)
 
 
 def system_health() -> dict:
@@ -65,11 +75,22 @@ class MonitoringService:
         self.chain = chain
         self.vc_store = vc_store
         self.period = period
-        self.sent = 0
-        self.errors = 0
+        self._sent = 0
+        self._errors = 0
         self._post = post_fn or self._http_post
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+
+    # sent/errors are read-only per-INSTANCE views (two services must not
+    # read each other's counts); tick() additionally feeds the process-
+    # global `monitoring_posts_total{result}` family for scrapes
+    @property
+    def sent(self) -> int:
+        return self._sent
+
+    @property
+    def errors(self) -> int:
+        return self._errors
 
     def _http_post(self, payload: list) -> None:
         req = urllib.request.Request(
@@ -101,7 +122,8 @@ class MonitoringService:
                     "client_name": VERSION,
                     "sync_beacon_head_slot": int(self.chain.head_state().slot),
                     "sync_eth2_synced": True,
-                    "slasher_active": False,
+                    "slasher_active": getattr(self.chain, "slasher", None)
+                    is not None,
                     "justified_epoch": fc.justified_checkpoint[0],
                     "finalized_epoch": fc.finalized_checkpoint[0],
                 }
@@ -126,10 +148,12 @@ class MonitoringService:
     def tick(self) -> bool:
         try:
             self._post(self.collect())
-            self.sent += 1
+            self._sent += 1
+            _POSTS.labels("ok").inc()
             return True
         except Exception:  # noqa: BLE001 — monitoring must never kill the node
-            self.errors += 1
+            self._errors += 1
+            _POSTS.labels("error").inc()
             return False
 
     def start(self) -> None:

@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Prometheus naming lint over the process-global metrics registry.
+
+Imports every module that registers metrics (so the registry is fully
+populated), then walks it and fails on naming-convention violations:
+
+  - metric names must match the Prometheus identifier grammar
+  - counters must end in `_total`; non-counters must NOT
+  - base names must not collide with the exposition's reserved histogram
+    suffixes (`_bucket`/`_sum`/`_count`)
+  - labeled families need valid label names (`le` is rejected at
+    registration time; `__`-prefixed names are reserved by Prometheus)
+  - every metric carries HELP text (scrapes without it are unreadable)
+  - no base-name collisions between a plain series and a family's
+    generated series (e.g. a gauge `x_sum` next to a histogram `x`)
+
+Duplicate registration with a different kind/shape raises inside
+Registry._register itself; the lint additionally catches cross-metric
+collisions the registry cannot see. Run standalone
+(`python scripts/lint_metrics.py`) or from the tier-1 gate
+(tests/test_metrics.py::test_lint_global_registry).
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import re
+import sys
+
+# standalone invocation from anywhere: the repo root is the import root
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: every module that registers series on the global REGISTRY at import time
+METRIC_MODULES = (
+    "lighthouse_tpu.utils.metrics",
+    "lighthouse_tpu.utils.monitoring",
+    "lighthouse_tpu.chain.beacon_processor",
+    "lighthouse_tpu.chain.validator_monitor",
+    "lighthouse_tpu.crypto.bls.hybrid",
+    "lighthouse_tpu.autotune.profiler",
+    "lighthouse_tpu.observability",
+    "lighthouse_tpu.api.http_api",
+)
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+_RESERVED_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def populate_registry():
+    for mod in METRIC_MODULES:
+        importlib.import_module(mod)
+    from lighthouse_tpu.utils.metrics import REGISTRY
+
+    return REGISTRY
+
+
+def lint_registry(registry=None) -> list[str]:
+    """Return a list of violations (empty = clean)."""
+    if registry is None:
+        registry = populate_registry()
+    errors: list[str] = []
+    metrics = registry.all_metrics()
+    names = {m.name for m in metrics}
+    for m in metrics:
+        where = f"{m.kind} {m.name!r}"
+        if not _NAME_RE.match(m.name):
+            errors.append(f"{where}: invalid metric name")
+        if m.kind == "counter" and not m.name.endswith("_total"):
+            errors.append(f"{where}: counter names must end in _total")
+        if m.kind != "counter" and m.name.endswith("_total"):
+            errors.append(f"{where}: only counters may end in _total")
+        for suf in _RESERVED_SUFFIXES:
+            if m.name.endswith(suf):
+                errors.append(
+                    f"{where}: base name ends in reserved suffix {suf}"
+                )
+        if not m.help:
+            errors.append(f"{where}: missing HELP text")
+        for ln in getattr(m, "labelnames", ()):
+            if not _LABEL_RE.match(ln) or ln.startswith("__"):
+                errors.append(f"{where}: invalid label name {ln!r}")
+        if m.kind == "histogram":
+            # a histogram's exposition series must not shadow other metrics
+            for suf in _RESERVED_SUFFIXES:
+                if m.name + suf in names:
+                    errors.append(
+                        f"{where}: exposition series {m.name + suf!r} "
+                        "collides with another registered metric"
+                    )
+    return errors
+
+
+def main() -> int:
+    errors = lint_registry()
+    registry = populate_registry()
+    n = len(registry.all_metrics())
+    if errors:
+        for e in errors:
+            print(f"LINT: {e}", file=sys.stderr)
+        print(f"{len(errors)} violation(s) across {n} metrics", file=sys.stderr)
+        return 1
+    print(f"{n} metrics/families clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

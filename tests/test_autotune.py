@@ -233,9 +233,12 @@ def test_profiler_records_and_exposes_per_bucket_metrics():
     assert buckets[(16, 4)].compile_secs == 12.0
 
     text = REGISTRY.expose_text()
-    assert 'autotune_dispatch_seconds_n8_m4_bucket{le="0.5"}' in text
-    assert "autotune_sets_per_sec_n8_m4" in text
-    assert "autotune_compile_seconds_n16_m4" in text
+    # labeled per-bucket families (the name-mangled autotune_*_n{n}_m{m}
+    # series were migrated to labels in the observability PR)
+    assert ('autotune_dispatch_seconds_bucket'
+            '{n_sets="8",n_pks="4",le="0.5"}') in text
+    assert 'autotune_sets_per_sec{n_sets="8",n_pks="4"}' in text
+    assert 'autotune_compile_seconds{n_sets="16",n_pks="4"}' in text
     assert "autotune_dispatches_total" in text
 
 
@@ -382,7 +385,7 @@ def test_smoke_calibration_end_to_end(tmp_path, capsys):
 
     text = REGISTRY.expose_text()
     n, m = next(iter(prof.buckets))
-    assert f"autotune_dispatch_seconds_n{n}_m{m}" in text
+    assert f'autotune_dispatch_seconds_count{{n_sets="{n}",n_pks="{m}"}}' in text
 
 
 def test_cli_autotune_show(tmp_path, capsys):

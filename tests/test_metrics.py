@@ -52,6 +52,128 @@ def test_same_name_returns_same_metric():
     assert a is b
 
 
+def test_same_name_different_kind_rejected():
+    import pytest
+
+    reg = Registry()
+    reg.counter("x_total")
+    with pytest.raises(ValueError, match="different kind"):
+        reg.gauge("x_total")
+    reg.counter_vec("y_total", "h", ("a",))
+    with pytest.raises(ValueError, match="different kind"):
+        reg.counter_vec("y_total", "h", ("b",))  # label-shape clash
+    with pytest.raises(ValueError, match="different kind"):
+        reg.counter("y_total")                   # plain vs family clash
+
+
+def test_labeled_counter_and_gauge_exposition():
+    """Labeled families: one TYPE block per family, children grouped under
+    it, label sets rendered in registration-label order."""
+    reg = Registry()
+    c = reg.counter_vec("req_total", "requests", ("route", "method"))
+    c.labels("a", "GET").inc()
+    c.labels("a", "GET").inc(2)
+    c.labels(route="b", method="POST").inc()
+    g = reg.gauge_vec("depth", "queue depth", ("kind",))
+    g.labels("att").set(7)
+    text = reg.expose_text()
+    assert 'req_total{route="a",method="GET"} 3' in text
+    assert 'req_total{route="b",method="POST"} 1' in text
+    assert 'depth{kind="att"} 7' in text
+    # family grouping: exactly ONE TYPE line for the family, before its
+    # children, with no interleaved foreign series
+    lines = text.splitlines()
+    type_idx = [i for i, l in enumerate(lines) if l == "# TYPE req_total counter"]
+    assert len(type_idx) == 1
+    i = type_idx[0]
+    assert lines[i + 1].startswith("req_total{") and lines[i + 2].startswith("req_total{")
+
+
+def test_labeled_histogram_exposition():
+    reg = Registry()
+    h = reg.histogram_vec("lat_seconds", "latency", ("stage",), buckets=(0.1, 1.0))
+    h.labels("marshal").observe(0.05)
+    h.labels("marshal").observe(0.5)
+    h.labels("device").observe(2.0)
+    text = reg.expose_text()
+    # `le` goes LAST, after the family labels
+    assert 'lat_seconds_bucket{stage="marshal",le="0.1"} 1' in text
+    assert 'lat_seconds_bucket{stage="marshal",le="+Inf"} 2' in text
+    assert 'lat_seconds_sum{stage="marshal"} 0.55' in text
+    assert 'lat_seconds_count{stage="device"} 1' in text
+    assert text.count("# TYPE lat_seconds histogram") == 1
+
+
+def test_label_value_escaping():
+    """Prometheus 0.0.4: backslash, double-quote, newline escaped in label
+    values; arbitrary values round-trip through the exposition."""
+    reg = Registry()
+    c = reg.counter_vec("odd_total", "odd labels", ("v",))
+    c.labels('say "hi"\n\\path').inc()
+    text = reg.expose_text()
+    assert r'odd_total{v="say \"hi\"\n\\path"} 1' in text
+    # a clean value is untouched
+    c.labels("plain").inc()
+    assert 'odd_total{v="plain"} 1' in reg.expose_text()
+
+
+def test_labels_api_shapes():
+    import pytest
+
+    reg = Registry()
+    c = reg.counter_vec("s_total", "h", ("a", "b"))
+    assert c.labels("1", "2") is c.labels(a="1", b="2")  # same child
+    assert c.labels(1, 2) is c.labels("1", "2")          # values stringified
+    with pytest.raises(ValueError):
+        c.labels("1")                                    # arity mismatch
+    with pytest.raises(ValueError):
+        c.labels(a="1")                                  # missing label
+    with pytest.raises(ValueError):
+        reg.histogram_vec("h_seconds", "h", ("le",))     # reserved label
+    # an empty family stays silent in the exposition (no TYPE orphan)
+    reg.gauge_vec("quiet", "never used", ("x",))
+    assert "quiet" not in reg.expose_text()
+
+
+def test_large_integral_counters_expose_exact():
+    """Byte-scale counters must not quantize: %g's 6 significant digits
+    would make sub-100-byte increments invisible past ~1e6, so integral
+    values print exact while float samples keep the compact form."""
+    reg = Registry()
+    c = reg.counter("bytes_total", "upload volume")
+    c.inc(34_176_612)
+    c.inc(100)
+    text = reg.expose_text()
+    assert "bytes_total 34176712" in text
+    g = reg.gauge("ratio", "fractional gauge")
+    g.set(0.25)
+    assert "ratio 0.25" in reg.expose_text()
+
+
+def test_lint_global_registry():
+    """tier-1 gate for scripts/lint_metrics.py: every metric registered by
+    the framework follows the Prometheus naming conventions."""
+    import importlib.util
+    import pathlib
+
+    spec = importlib.util.spec_from_file_location(
+        "lint_metrics",
+        pathlib.Path(__file__).parent.parent / "scripts" / "lint_metrics.py",
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    errors = mod.lint_registry()
+    assert errors == [], "\n".join(errors)
+
+    # and the lint actually bites: plant violations in a scratch registry
+    bad = Registry()
+    bad.counter("not_a_counter_name", "c")     # counter without _total
+    bad.gauge("g_total", "g")                  # gauge WITH _total
+    bad.histogram("h_bucket")                  # reserved suffix + no help
+    found = mod.lint_registry(bad)
+    assert len(found) >= 4
+
+
 def test_structured_logging():
     """Structured logger: level filtering, component scoping, kv fields,
     JSON mode, and the RECENT ring feeding the ops API."""

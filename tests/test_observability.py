@@ -1,0 +1,315 @@
+"""Observability subsystem: tracer span lifecycle, Perfetto export schema,
+processor pipeline instrumentation, /metrics + /lighthouse_tpu/pipeline
+end-to-end scrapes, and the bn --trace-out export."""
+
+import json
+import subprocess
+import sys
+import urllib.request
+from types import SimpleNamespace
+
+from lighthouse_tpu.observability import (
+    PIPELINE_STAGES,
+    TRACER,
+    Tracer,
+    chrome_trace_events,
+    snapshot,
+)
+from lighthouse_tpu.observability.trace import Trace
+
+
+# ---------------------------------------------------------------- tracer
+
+
+def test_trace_span_lifecycle():
+    tracer = Tracer(ring_size=4)
+    tr = tracer.begin("gossip_attestation", n_items=32)
+    tr.add_span("enqueue", 1.0, 1.5)
+    tr.add_span("marshal", 1.5, 1.75, bytes=4096)
+    tr.annotate(bucket="64x1")
+    tracer.finish(tr)
+    assert tracer.completed == 1
+    (got,) = tracer.snapshot_ring()
+    assert got.kind == "gossip_attestation" and got.n_items == 32
+    assert got.duration() == 0.75
+    assert got.meta == {"bucket": "64x1"}
+    # finishing None (no trace carried) is a no-op, not a crash
+    tracer.finish(None)
+    assert tracer.completed == 1
+
+
+def test_trace_ring_is_bounded():
+    tracer = Tracer(ring_size=3)
+    for i in range(10):
+        tr = tracer.begin("k")
+        tr.add_span("enqueue", float(i), float(i) + 0.1)
+        tracer.finish(tr)
+    assert tracer.completed == 10
+    ring = tracer.snapshot_ring()
+    assert len(ring) == 3
+    assert ring[-1].spans[0][1] == 9.0  # newest kept, oldest evicted
+
+
+def test_chrome_trace_event_schema():
+    """Export rows follow the Chrome trace-event JSON schema Perfetto
+    loads: complete events ("ph": "X"), µs timestamps rebased to the
+    oldest span, pid/tid ints, args stringified."""
+    t1 = Trace("gossip_attestation", 8)
+    t1.add_span("enqueue", 10.0, 10.5)
+    t1.add_span("device", 10.5, 11.0, bucket="64x1")
+    t2 = Trace("gossip_aggregate", 2)
+    t2.add_span("marshal", 10.2, 10.3)
+    events = chrome_trace_events([t1, t2])
+    assert len(events) == 3
+    for ev in events:
+        assert ev["ph"] == "X"
+        assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
+        assert ev["ts"] >= 0 and ev["dur"] >= 0
+        assert ev["cat"] in ("gossip_attestation", "gossip_aggregate")
+    # rebased: the oldest span sits at ts=0; a span 0.2s later at 2e5 µs
+    assert min(ev["ts"] for ev in events) == 0
+    marshal = next(ev for ev in events if ev["name"] == "marshal")
+    assert abs(marshal["ts"] - 2e5) < 1
+    device = next(ev for ev in events if ev["name"] == "device")
+    assert device["args"]["bucket"] == "64x1"
+    json.dumps(events)  # schema must be JSON-serializable as-is
+    assert chrome_trace_events([]) == []
+
+
+def test_tracer_write_chrome_trace(tmp_path):
+    tracer = Tracer()
+    tr = tracer.begin("k")
+    tr.add_span("enqueue", 0.0, 1.0)
+    tracer.finish(tr)
+    out = tmp_path / "trace.json"
+    assert tracer.write_chrome_trace(str(out)) == 1
+    doc = json.loads(out.read_text())
+    assert doc["traceEvents"][0]["name"] == "enqueue"
+    assert doc["displayTimeUnit"] == "ms"
+
+
+# ------------------------------------------------------------- processor
+
+
+def _drain_probe():
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.observability import pipeline
+
+    bls.set_backend("fake")
+    return pipeline.run_probe(n_items=8)
+
+
+def test_processor_traces_every_stage():
+    """A batch through a real BeaconProcessor produces one trace holding
+    every canonical pipeline stage, and feeds the labeled stage family."""
+    from lighthouse_tpu.observability.trace import STAGE_SECONDS
+
+    before = TRACER.completed
+    _drain_probe()
+    assert TRACER.completed > before
+    tr = TRACER.snapshot_ring()[-1]
+    assert tr.kind == "gossip_attestation" and tr.n_items == 8
+    stages = [s[0] for s in tr.spans]
+    assert stages == list(PIPELINE_STAGES)
+    for stage in PIPELINE_STAGES:
+        child = STAGE_SECONDS.labels(stage, "gossip_attestation")
+        assert child.n > 0, f"stage {stage} never observed"
+
+
+def test_processor_queue_metrics_and_snapshot():
+    from lighthouse_tpu.chain.beacon_processor import (
+        _DROPPED,
+        _PROCESSED,
+        BeaconProcessor,
+        WorkItem,
+        WorkKind,
+    )
+
+    proc = BeaconProcessor()
+    proc.max_lengths[WorkKind.gossip_block] = 1
+    dropped0 = _DROPPED.labels("gossip_block").value
+    processed0 = _PROCESSED.labels("gossip_block").value
+    assert proc.submit(WorkItem(WorkKind.gossip_block, run=lambda: None))
+    assert not proc.submit(WorkItem(WorkKind.gossip_block, run=lambda: None))
+    assert _DROPPED.labels("gossip_block").value == dropped0 + 1
+    assert proc.stats()["queued"] == {"gossip_block": 1}
+    proc.run_until_idle()
+    assert _PROCESSED.labels("gossip_block").value == processed0 + 1
+    st = proc.stats()
+    assert st["queued"] == {} and st["processed"]["gossip_block"] == 1
+    assert st["dropped"]["gossip_block"] == 1
+
+    # the registered processor appears in the pipeline snapshot
+    snap = snapshot()
+    assert any(
+        p.get("dropped", {}).get("gossip_block") == 1 for p in snap["processors"]
+    )
+
+
+def test_processor_device_failure_counted_and_logged():
+    """A handle.result() raising must not kill the pump; it increments the
+    labeled error counter and emits a structured log record instead of a
+    bare traceback."""
+    from lighthouse_tpu.chain.beacon_processor import (
+        _ERRORS,
+        BeaconProcessor,
+        WorkItem,
+        WorkKind,
+    )
+    from lighthouse_tpu.utils.logging import RECENT
+
+    class BoomHandle:
+        def result(self):
+            raise RuntimeError("tunnel dropped")
+
+    proc = BeaconProcessor()
+    errors0 = _ERRORS.labels("device").value
+    proc.submit(
+        WorkItem(
+            kind=WorkKind.gossip_attestation, payload=0,
+            run_batch=lambda p: (BoomHandle(), lambda ok: None),
+        )
+    )
+    proc.run_until_idle()
+    assert _ERRORS.labels("device").value == errors0 + 1
+    rec = [r for r in RECENT if r[2] == "beacon_processor"][-1]
+    assert rec[1] == "ERROR" and "device batch failed" in rec[3]
+    assert "tunnel dropped" in rec[4]["error"]
+
+    # continuation failures are tracked under their own stage label
+    cont0 = _ERRORS.labels("continuation").value
+    proc.submit(
+        WorkItem(
+            kind=WorkKind.gossip_attestation, payload=0,
+            run_batch=lambda p: (
+                SimpleNamespace(result=lambda: True),
+                lambda ok: (_ for _ in ()).throw(ValueError("bad cont")),
+            ),
+        )
+    )
+    proc.run_until_idle()
+    assert _ERRORS.labels("continuation").value == cont0 + 1
+
+
+# ------------------------------------------------------------ monitoring
+
+
+def test_monitoring_reports_real_slasher_state():
+    from lighthouse_tpu.utils.monitoring import MonitoringService
+
+    def mk_chain(slasher):
+        return SimpleNamespace(
+            fork_choice=SimpleNamespace(
+                store=SimpleNamespace(
+                    justified_checkpoint=(3, b"\x00"),
+                    finalized_checkpoint=(2, b"\x00"),
+                )
+            ),
+            head_state=lambda: SimpleNamespace(slot=7),
+            slasher=slasher,
+        )
+
+    posted = []
+    svc = MonitoringService("http://unused.invalid", chain=mk_chain(None),
+                            post_fn=posted.append)
+    assert svc.tick()
+    bn = next(p for p in posted[0] if p["process"] == "beaconnode")
+    assert bn["slasher_active"] is False
+
+    svc2 = MonitoringService("http://unused.invalid",
+                             chain=mk_chain(object()), post_fn=posted.append)
+    svc2.tick()
+    bn2 = next(p for p in posted[-1] if p["process"] == "beaconnode")
+    assert bn2["slasher_active"] is True
+
+    # sent/errors are read-only views over the registry-backed counts
+    assert svc.sent == 1 and svc.errors == 0
+    from lighthouse_tpu.utils.metrics import REGISTRY
+
+    assert 'monitoring_posts_total{result="ok"}' in REGISTRY.expose_text()
+
+
+# ---------------------------------------------------------------- scrapes
+
+
+def test_metrics_and_pipeline_scrape_over_running_node():
+    """End to end over HTTP: a served chain + the Prometheus endpoint.
+    After pipeline traffic, /metrics exposes the labeled per-kind queue /
+    drop / wait series and /lighthouse_tpu/pipeline returns the
+    stage-timing snapshot."""
+    from lighthouse_tpu.api.http_api import serve
+    from lighthouse_tpu.chain.beacon_chain import BeaconChain
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.testing.harness import StateHarness, clone_state
+    from lighthouse_tpu.types.spec import minimal_spec
+    from lighthouse_tpu.utils.metrics import metrics_http_server
+
+    bls.set_backend("fake")
+    spec = minimal_spec()
+    harness = StateHarness.new(spec, 16)
+    chain = BeaconChain(spec, clone_state(harness.state, spec))
+    _drain_probe()  # pipeline traffic: enqueue->...->continuation
+
+    server, _t, port = serve(chain)
+    mserver, mport = metrics_http_server()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{mport}/metrics", timeout=5
+        ) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode()
+        # labeled per-kind processor series
+        assert 'beacon_processor_processed_total{kind="gossip_attestation"}' in text
+        assert 'beacon_processor_queue_depth{kind="gossip_attestation"}' in text
+        assert ('beacon_processor_queue_wait_seconds_count'
+                '{kind="gossip_attestation"}') in text
+        assert 'beacon_processor_dropped_total{kind="gossip_block"}' in text
+        # per-stage pipeline series + exactly one TYPE block per family
+        assert 'pipeline_stage_seconds_bucket{stage="device"' in text
+        assert text.count("# TYPE beacon_processor_processed_total counter") == 1
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/lighthouse_tpu/pipeline", timeout=5
+        ) as r:
+            doc = json.loads(r.read().decode())["data"]
+        assert set(PIPELINE_STAGES) <= set(doc["stage_timings"])
+        assert doc["traces_completed"] >= 1
+        assert doc["recent_traces"][-1]["spans"][0]["stage"] == "enqueue"
+        # the request itself lands in the route-family latency series (the
+        # handler's observe runs just after the response flushes: retry)
+        import time
+
+        want = ('http_api_request_seconds_count'
+                '{route="get_lh_pipeline",method="GET"}')
+        for _ in range(50):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{mport}/metrics", timeout=5
+            ) as r:
+                text2 = r.read().decode()
+            if want in text2:
+                break
+            time.sleep(0.05)
+        assert want in text2
+    finally:
+        server.shutdown()
+        mserver.shutdown()
+
+
+def test_bn_trace_out_end_to_end(tmp_path):
+    """Acceptance path: a node run with --trace-out writes valid Chrome
+    trace-event JSON containing spans for every pipeline stage."""
+    out = tmp_path / "trace.json"
+    r = subprocess.run(
+        [sys.executable, "-m", "lighthouse_tpu", "bn", "--spec", "minimal",
+         "--interop-validators", "4", "--bls-backend", "fake",
+         "--disable-p2p", "--zero-ports", "--shutdown-after-sync",
+         "--trace-out", str(out)],
+        capture_output=True, text=True, timeout=300, cwd="/root/repo",
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "pipeline trace probe complete" in (r.stdout + r.stderr)
+    doc = json.loads(out.read_text())
+    events = doc["traceEvents"]
+    assert {ev["name"] for ev in events} >= set(PIPELINE_STAGES)
+    for ev in events:
+        assert ev["ph"] == "X" and ev["ts"] >= 0 and ev["dur"] >= 0

@@ -71,6 +71,105 @@ def test_native_store_truncated_tail(tmp_path):
     store2.close()
 
 
+def test_pure_python_store_semantics(tmp_path):
+    """The fallback engine passes the same KV semantics, reopen
+    persistence, compaction, and crash-consistent truncated-tail replay
+    as the native one."""
+    from lighthouse_tpu.store.native_kv import PurePythonKVStore
+
+    path = tmp_path / "db" / "kv.log"
+    store = PurePythonKVStore(path)
+    kv_roundtrip(store)
+    store.close()
+    store2 = PurePythonKVStore(path)
+    assert store2.get(Column.block, b"k2") == b"v2"
+    assert store2.get(Column.block, b"k1") is None
+    store2.compact()
+    assert store2.get(Column.state, b"s1") == b"x"
+    store2.close()
+    store3 = PurePythonKVStore(path)
+    assert store3.get(Column.block, b"k2") == b"v2"
+    store3.put(Column.block, b"c", b"3")
+    store3.close()
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(size - 3)  # crash mid-record
+    store4 = PurePythonKVStore(path)
+    assert store4.get(Column.block, b"k2") == b"v2"
+    assert store4.get(Column.block, b"c") is None  # truncated record dropped
+    # writes AFTER recovery must be durable: the corrupt tail is truncated
+    # before appending, so the next replay reaches the new record
+    store4.put(Column.block, b"d", b"4")
+    store4.close()
+    store5 = PurePythonKVStore(path)
+    assert store5.get(Column.block, b"d") == b"4"
+    assert store5.get(Column.block, b"k2") == b"v2"
+    store5.close()
+
+
+def test_native_load_failure_falls_back_to_python(tmp_path, monkeypatch):
+    """When the shared library cannot be built/loaded (no g++, GLIBCXX
+    mismatch), NativeKVStore(path) transparently constructs the
+    pure-Python engine and warns ONCE."""
+    from lighthouse_tpu.store import native_kv
+    from lighthouse_tpu.utils.logging import RECENT
+
+    def boom():
+        raise OSError("GLIBCXX_9.9.99 not found (simulated)")
+
+    monkeypatch.setattr(native_kv, "_load", boom)
+    monkeypatch.setattr(native_kv, "_fallback_warned", False)
+    s = native_kv.NativeKVStore(tmp_path / "kv.log")
+    assert isinstance(s, native_kv.PurePythonKVStore)
+    s.put(Column.block, b"k", b"v")
+    assert s.get(Column.block, b"k") == b"v"
+    s.close()
+    warns = [r for r in RECENT
+             if r[2] == "store" and "falling back" in r[3]]
+    assert warns and "GLIBCXX_9.9.99" in warns[-1][4]["error"]
+    # second open: degraded again, but no second warn
+    n = len(warns)
+    native_kv.NativeKVStore(tmp_path / "kv2.log").close()
+    assert len([r for r in RECENT
+                if r[2] == "store" and "falling back" in r[3]]) == n
+
+
+def test_native_and_python_engines_share_format(tmp_path):
+    """A database written by one engine opens under the other (same
+    CRC32-framed record log). Skipped where the native lib is unusable —
+    the fallback test above covers that world."""
+    import pytest
+
+    from lighthouse_tpu.store import native_kv
+
+    try:
+        native_kv._load()
+    except Exception as e:  # noqa: BLE001
+        pytest.skip(f"native engine unavailable: {e}")
+    path = tmp_path / "kv.log"
+    nat = native_kv.NativeKVStore(path)
+    assert isinstance(nat, native_kv.NativeKVStore)
+    nat.put(Column.block, b"k1", b"v1")
+    nat.put(Column.state, b"s1", b"x" * 100)
+    nat.delete(Column.block, b"k1")
+    nat.put(Column.block, b"k2", b"v2")
+    nat.close()
+
+    py = native_kv.PurePythonKVStore(path)
+    assert py.get(Column.block, b"k2") == b"v2"
+    assert py.get(Column.block, b"k1") is None
+    assert py.get(Column.state, b"s1") == b"x" * 100
+    py.put(Column.block, b"k3", b"v3")
+    py.compact()
+    py.close()
+
+    nat2 = native_kv.NativeKVStore(path)
+    assert nat2.get(Column.block, b"k3") == b"v3"
+    assert nat2.get(Column.state, b"s1") == b"x" * 100
+    assert len(nat2) == 3
+    nat2.close()
+
+
 def test_hot_cold_block_state_roundtrip():
     spec = minimal_spec()
     types = spec_types(MINIMAL_PRESET, ForkName.deneb)
