@@ -100,6 +100,14 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
     op_pool = None
     event_bus = None
     allow_origin = None    # --http-allow-origin: CORS on every response
+    # QoS token bucket over the whole API (lighthouse_tpu/qos/ratelimit.py,
+    # scope "http_api"): requests over quota are answered 429 with a
+    # Retry-After header instead of queuing work behind an overloaded
+    # chain. None (the default) disables limiting; `bn --http-rate-limit`
+    # wires it. /eth/v1/node/health is exempt — liveness probes must answer
+    # precisely when the node is busiest.
+    rate_limiter = None
+    RATE_LIMIT_EXEMPT = ("/eth/v1/node/health",)
 
     def end_headers(self):
         if self.allow_origin:
@@ -206,8 +214,26 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
     def do_POST(self):
         self._dispatch("POST")
 
+    def _rate_limited(self):
+        retry = self.rate_limiter.retry_after_secs("http_api")
+        body = json.dumps(
+            {"code": 429, "message": "rate limit exceeded; retry later"}
+        ).encode()
+        self.send_response(429)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Retry-After", str(retry))
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def _dispatch(self, method):
         path = self.path.split("?")[0].rstrip("/")
+        if (
+            self.rate_limiter is not None
+            and path not in self.RATE_LIMIT_EXEMPT
+            and not self.rate_limiter.allow("http_api")
+        ):
+            return self._rate_limited()
         try:
             for pattern, meth, fn in _ROUTES:
                 m = re.fullmatch(pattern, path)
@@ -1489,13 +1515,23 @@ class EventBus:
                 q.append((topic, payload))
 
 
-def serve(chain, op_pool=None, host="127.0.0.1", port=0, allow_origin=None):
-    """Start the API server; returns (server, thread, actual_port)."""
+def serve(chain, op_pool=None, host="127.0.0.1", port=0, allow_origin=None,
+          rate_limit=None):
+    """Start the API server; returns (server, thread, actual_port).
+    `rate_limit` (requests/second, burst 2x) enables the QoS token bucket —
+    over-quota requests get 429 + Retry-After instead of queued work."""
+    limiter = None
+    if rate_limit is not None:
+        from ..qos.ratelimit import RateLimiter
+
+        limiter = RateLimiter().configure(
+            "http_api", float(rate_limit), burst=2 * float(rate_limit)
+        )
     handler = type(
         "BoundHandler",
         (BeaconApiHandler,),
         {"chain": chain, "op_pool": op_pool, "event_bus": EventBus(),
-         "allow_origin": allow_origin},
+         "allow_origin": allow_origin, "rate_limiter": limiter},
     )
     server = ThreadingHTTPServer((host, port), handler)
     thread = threading.Thread(target=server.serve_forever, daemon=True)

@@ -36,6 +36,7 @@ from time import perf_counter
 from typing import Callable
 
 from ..observability import trace as obs
+from ..qos.admission import count_shed
 from ..utils.logging import get_logger
 from ..utils.metrics import REGISTRY
 
@@ -143,6 +144,13 @@ class WorkItem:
     run_batch: Callable[[list], None] | None = None
     # stamped by submit(): feeds the queue-wait histogram + enqueue span
     t_enq: float = 0.0
+    # QoS (lighthouse_tpu/qos): last slot at which this work still matters;
+    # checked at pop time against the admission controller's slot clock
+    deadline_slot: int | None = None
+    # called with the shed reason ("queue_full" / "expired" / "admission")
+    # when the item is lost — the gossip layer resolves its deferred
+    # validation slot here so shed work never strands a PENDING entry
+    on_shed: Callable[[str], None] | None = None
 
 
 @dataclass
@@ -175,13 +183,20 @@ class BeaconProcessorConfig:
 class BeaconProcessor:
     BATCHABLE = (WorkKind.gossip_attestation, WorkKind.gossip_aggregate)
 
-    def __init__(self, config: BeaconProcessorConfig | None = None):
+    def __init__(self, config: BeaconProcessorConfig | None = None,
+                 admission=None):
         self.config = config or BeaconProcessorConfig()
+        # QoS admission controller (lighthouse_tpu/qos/admission.py) — when
+        # None, submit/pop behave exactly like the pre-QoS processor except
+        # for the oldest-first shed on full batchable queues
+        self.admission = admission
         self.queues: dict[WorkKind, deque] = {k: deque() for k in WorkKind}
         self.max_lengths = {
             k: DEFAULT_QUEUE_LENGTHS.get(k, DEFAULT_QUEUE_LEN) for k in WorkKind
         }
         self.dropped: dict[WorkKind, int] = {k: 0 for k in WorkKind}
+        self.expired: dict[WorkKind, int] = {k: 0 for k in WorkKind}
+        self.shed_admission: dict[WorkKind, int] = {k: 0 for k in WorkKind}
         self.processed: dict[WorkKind, int] = {k: 0 for k in WorkKind}
         self.batches_formed = 0
         self.pipelined_batches = 0
@@ -211,52 +226,128 @@ class BeaconProcessor:
     # ------------------------------------------------------------- submit
 
     def submit(self, item: WorkItem) -> bool:
-        """Enqueue; returns False if the queue for this kind is full (the
-        item is dropped, like the reference's bounded queues)."""
+        """Enqueue; returns False if the item was refused (already past its
+        slot deadline, admission class over its watermark, or a full
+        non-batchable queue). A full BATCHABLE
+        queue sheds its OLDEST entry instead and admits the incoming item —
+        the reference's LIFO-queue semantics for gossip attestations
+        (beacon_processor/src/lib.rs:301-372): under flood, fresher work has
+        strictly more propagation value than work already going stale. The
+        `dropped` counter stays accurate either way: one item is lost per
+        over-full submit, it is just not always the incoming one."""
         item.t_enq = perf_counter()
+        kind = item.kind
+        shed = None           # (item, reason) resolved outside the lock
+        accepted = False
         with self._lock:
-            q = self.queues[item.kind]
-            if len(q) >= self.max_lengths[item.kind]:
-                self.dropped[item.kind] += 1
-                self._m_dropped[item.kind].inc()
-                return False
-            q.append(item)
-            self._m_depth[item.kind].set(len(q))
-        self._wake.set()
-        return True
+            q = self.queues[kind]
+            cap = self.max_lengths[kind]
+            if self.admission is not None and self.admission.is_expired(item):
+                # dead on arrival (stale replay past its window): shed the
+                # INCOMING item as expired — it must never take a queue
+                # slot, and above all never displace live work via the
+                # oldest-first branch below
+                self.expired[kind] += 1
+                shed = (item, "expired")
+            elif self.admission is not None and not self.admission.admit(
+                kind, len(q), cap
+            ):
+                self.shed_admission[kind] += 1
+                shed = (item, "admission")
+            elif len(q) >= cap:
+                self.dropped[kind] += 1
+                self._m_dropped[kind].inc()
+                if kind in self.BATCHABLE and q:
+                    shed = (q.popleft(), "queue_full")
+                    q.append(item)
+                    accepted = True
+                else:
+                    shed = (item, "queue_full")
+            else:
+                q.append(item)
+                accepted = True
+            self._m_depth[kind].set(len(q))
+        # shed bookkeeping outside self._lock: on_shed re-enters the gossip
+        # layer (report_validation_result takes the gossipsub lock)
+        if shed is not None:
+            self._notify_shed(shed[0], shed[1])
+        if accepted:
+            self._wake.set()
+        return accepted
+
+    def _notify_shed(self, item: WorkItem, reason: str) -> None:
+        count_shed(item.kind.name, reason)
+        if item.on_shed is not None:
+            try:
+                item.on_shed(reason)
+            except Exception as e:  # shed callbacks must never kill a caller
+                _ERRORS.labels("shed_callback").inc()
+                log.error("on_shed callback failed", kind=item.kind.name,
+                          error=f"{type(e).__name__}: {e}")
 
     # ------------------------------------------------------------- drain
 
     def _next_work(self):
         """Pop the highest-priority work; coalesce batchable kinds.
         Returns (single, batch, trace) — the trace carries the enqueue and
-        coalesce spans of whatever was popped."""
-        with self._lock:
-            for kind in WorkKind:
-                q = self.queues[kind]
-                if not q:
-                    continue
-                t_pop = perf_counter()
-                if kind in self.BATCHABLE:
-                    cap = (
-                        self.config.max_attestation_batch
-                        if kind == WorkKind.gossip_attestation
-                        else self.config.max_aggregate_batch
-                    )
-                    items = []
-                    while q and len(items) < cap:
-                        items.append(q.popleft())
-                    self._m_depth[kind].set(len(q))
-                    trace = self._begin_trace(kind, items[0], len(items), t_pop)
-                    if len(items) == 1:
-                        return items[0], None, trace
-                    self.batches_formed += 1
-                    _BATCHES_FORMED.inc()
-                    return None, items, trace
-                item = q.popleft()
+        coalesce spans of whatever was popped. Items whose slot deadline
+        has passed are shed HERE, counted `expired` (they already paid
+        their queue residency; running them now would burn a device batch
+        slot on unactionable work)."""
+        expired: list[WorkItem] = []
+        try:
+            with self._lock:
+                return self._pop_locked(expired)
+        finally:
+            # self.expired was bumped under the lock (workers race here);
+            # only the metric + callback run outside it
+            for it in expired:
+                self._notify_shed(it, "expired")
+
+    def _pop_locked(self, expired: list):
+        adm = self.admission
+        for kind in WorkKind:
+            q = self.queues[kind]
+            if not q:
+                continue
+            t_pop = perf_counter()
+            if kind in self.BATCHABLE:
+                cap = (
+                    self.config.max_attestation_batch
+                    if kind == WorkKind.gossip_attestation
+                    else self.config.max_aggregate_batch
+                )
+                items = []
+                while q and len(items) < cap:
+                    it = q.popleft()
+                    if adm is not None and adm.is_expired(it):
+                        self.expired[kind] += 1
+                        expired.append(it)
+                        continue
+                    items.append(it)
                 self._m_depth[kind].set(len(q))
-                trace = self._begin_trace(kind, item, 1, t_pop)
-                return item, None, trace
+                if not items:
+                    continue   # whole queue had expired; try the next kind
+                trace = self._begin_trace(kind, items[0], len(items), t_pop)
+                if len(items) == 1:
+                    return items[0], None, trace
+                self.batches_formed += 1
+                _BATCHES_FORMED.inc()
+                return None, items, trace
+            item = None
+            while q:
+                it = q.popleft()
+                if adm is not None and adm.is_expired(it):
+                    self.expired[kind] += 1
+                    expired.append(it)
+                    continue
+                item = it
+                break
+            self._m_depth[kind].set(len(q))
+            if item is None:
+                continue       # whole queue had expired; try the next kind
+            trace = self._begin_trace(kind, item, 1, t_pop)
+            return item, None, trace
         return None, None, None
 
     def _begin_trace(self, kind, oldest: WorkItem, n: int, t_pop: float):
@@ -401,7 +492,24 @@ class BeaconProcessor:
                 k.name: v for k, v in self.processed.items() if v
             },
             "dropped": {k.name: v for k, v in self.dropped.items() if v},
+            "expired": {k.name: v for k, v in self.expired.items() if v},
+            "shed_admission": {
+                k.name: v for k, v in self.shed_admission.items() if v
+            },
             "workers": len(self._threads),
+        }
+
+    def qos_totals(self) -> dict:
+        """Aggregate loss counts for remote monitoring (utils/monitoring.py
+        puts these in its POST body). "shed" matches the Prometheus
+        `qos_shed_total` family's total — EVERY lost item across all
+        reasons (queue_full + admission + expired) — so a dashboard can
+        cross-check the two; "expired" is the deadline subset of it."""
+        expired = sum(self.expired.values())
+        return {
+            "shed": sum(self.dropped.values())
+            + sum(self.shed_admission.values()) + expired,
+            "expired": expired,
         }
 
     # ------------------------------------------------------------- threads

@@ -447,6 +447,7 @@ def cmd_bn(args):
             require_encryption=args.require_p2p_encryption,
             batch_gossip=not args.disable_gossip_batching,
             processor_config=proc_cfg,
+            ingest_rate=args.gossip_ingest_rate,
         )
         log.info("p2p listening", addr=str(net.host.listen_addr),
                  fork_digest=digest.hex())
@@ -468,6 +469,7 @@ def cmd_bn(args):
     server, _t, port = serve(
         chain, op_pool=op_pool, host=args.http_address, port=args.http_port,
         allow_origin=args.http_allow_origin,
+        rate_limit=args.http_rate_limit,
     )
     log.info("HTTP API started", addr=args.http_address, port=port)
     mserver, mport = metrics_http_server(
@@ -797,6 +799,21 @@ def cmd_interop_genesis(args):
         f.write(types.BeaconState.serialize(state))
     print(f"wrote genesis state with {args.count} validators to {args.output}")
     return 0
+
+
+# ------------------------------------------------------------------ loadtest
+
+
+def cmd_loadtest(args):
+    """`bn loadtest`: run a lighthouse_tpu/loadgen scenario against the
+    QoS-protected serving path and write a machine-readable report
+    (CPU-only, deterministic from the seed). The whole driver — scenario
+    resolution, report-path defaulting, summary line — is shared with
+    scripts/loadgen.py (loadgen/driver.py); only the argparse declarations
+    live here, so `bn --help` works without importing the package."""
+    from .loadgen.driver import drive_from_args
+
+    return drive_from_args(args)
 
 
 # ------------------------------------------------------------------ autotune
@@ -1321,6 +1338,16 @@ def build_parser() -> argparse.ArgumentParser:
     bn.add_argument("--graffiti-file", default=None,
                     help="file whose first line is the block graffiti "
                          "(alternative to --graffiti)")
+    # -- QoS (lighthouse_tpu/qos)
+    bn.add_argument("--http-rate-limit", type=float, default=None,
+                    help="HTTP API token-bucket rate (requests/sec, burst "
+                         "2x); over-quota requests get 429 + Retry-After "
+                         "instead of queued work (default: unlimited)")
+    bn.add_argument("--gossip-ingest-rate", type=float, default=None,
+                    help="gossip ingest token-bucket rate per batchable "
+                         "kind (messages/sec, burst 2x); over-quota "
+                         "messages become gossip IGNOREs before touching "
+                         "the queues (default: unlimited)")
     bn.add_argument("--trace-out", default=None,
                     help="write the verification pipeline's span traces as "
                          "Chrome trace-event JSON (load in Perfetto) to "
@@ -1328,6 +1355,24 @@ def build_parser() -> argparse.ArgumentParser:
                          "pipeline probe at startup so a quiet node still "
                          "traces every stage")
     bn.set_defaults(fn=cmd_bn)
+
+    # `bn loadtest`: the QoS load/chaos driver (lighthouse_tpu/loadgen).
+    # Optional sub-subcommand — plain `bn` still runs the node.
+    bnsub = bn.add_subparsers(dest="bn_command", required=False,
+                              metavar="{loadtest}")
+    bnlt = bnsub.add_parser(
+        "loadtest",
+        help="run a deterministic loadgen scenario (mainnet-shaped gossip "
+             "mix + fault injection) against the QoS-protected pipeline "
+             "and write a machine-readable report",
+    )
+    # flags shared with scripts/loadgen.py — loadgen.driver is a leaf
+    # module (the runner only imports inside drive()), so this stays cheap
+    # on every `bn --help`
+    from .loadgen.driver import add_loadtest_args
+
+    add_loadtest_args(bnlt)
+    bnlt.set_defaults(fn=cmd_loadtest)
 
     vc = sub.add_parser("vc", help="run a validator client")
     _add_spec_arg(vc)

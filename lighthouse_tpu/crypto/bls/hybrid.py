@@ -24,6 +24,14 @@ Routing policy (each decision counted in Prometheus metrics):
   - small batch + device p99 over budget (rolling window) -> host.
   - device dispatch raises         -> host answers; repeated failures mark
     the device down until the next probe succeeds.
+  - circuit breaker OPEN           -> host, O(1) refusal. The breaker
+    (lighthouse_tpu/qos/breaker.py) trips after consecutive failures —
+    raised dispatches OR verifies slower than the stall budget (4x the p99
+    budget) — so a stalled-but-not-dead device degrades to the host path
+    within one budget window instead of per-call timeouts. Recovery is
+    probe-driven: after the cooldown one half-open probe rides the device
+    and its outcome closes or re-opens the circuit. State is exported as
+    `bls_device_circuit_state` (0=closed, 1=open, 2=half_open).
 """
 
 from __future__ import annotations
@@ -51,12 +59,19 @@ _REASONS = {
     reason: _ROUTE_DECISIONS.labels("host", reason)
     for reason in (
         "device_down", "device_probing", "device_cold", "latency_budget",
-        "device_error",
+        "device_error", "circuit_open",
     )
 }
 _DEVICE_ROUTED = _ROUTE_DECISIONS.labels("device", "ok")
 _DEVICE_LATENCY = REGISTRY.histogram(
     "bls_hybrid_device_verify_seconds", "device multi-set verify wall time"
+)
+# QoS circuit breaker state (lighthouse_tpu/qos/breaker.py): 0=closed,
+# 1=open, 2=half_open. Module-level so every HybridBackend instance (tests
+# construct several) reports through the same series; the live node has one.
+_CIRCUIT_STATE = REGISTRY.gauge(
+    "bls_device_circuit_state",
+    "device-path circuit breaker state (0=closed, 1=open, 2=half_open)",
 )
 
 
@@ -127,6 +142,8 @@ class HybridBackend:
         p99_budget_ms: float | None = None,
         probe_startup_wait_secs: float | None = None,
         probe_retry_secs: float | None = None,
+        breaker_reset_secs: float | None = None,
+        stall_budget_ms: float | None = None,
     ):
         plan = _autotune_plan()
         urgent, urgent_src = _resolve_knob(
@@ -145,6 +162,24 @@ class HybridBackend:
         self._probe_retry, _ = _resolve_knob(
             probe_retry_secs, "LIGHTHOUSE_TPU_DEVICE_PROBE_RETRY_SECS",
             None, 600.0,
+        )
+        breaker_reset, _ = _resolve_knob(
+            breaker_reset_secs, "LIGHTHOUSE_TPU_BREAKER_RESET_SECS",
+            None, 10.0,
+        )
+        # a verify slower than this is a STALL (breaker failure signal):
+        # well past anything the p99 budget router would tolerate, so legit
+        # heavy batches never trip it, a wedged tunnel does
+        self._stall_budget_secs, _ = _resolve_knob(
+            stall_budget_ms, "LIGHTHOUSE_TPU_DEVICE_STALL_BUDGET_MS",
+            None, self.p99_budget_ms * 4.0,
+        )
+        self._stall_budget_secs /= 1e3
+        from ...qos.breaker import CircuitBreaker
+
+        self._breaker = CircuitBreaker(
+            "bls_device", failure_threshold=3,
+            reset_timeout=breaker_reset, state_gauge=_CIRCUIT_STATE,
         )
         self.knob_sources = {
             "urgent_max_sets": urgent_src, "p99_budget_ms": p99_src,
@@ -238,15 +273,21 @@ class HybridBackend:
         bucket = self._bucket(sets)
         with self._lock:
             cold = bucket not in self._warm_buckets
-        if cold:
-            if small:
-                self._spawn_warm(bucket, sets)
-                return "host", "device_cold"
-            return "device", ""      # batch work pays its own compile
-        if small:
+        if cold and small:
+            self._spawn_warm(bucket, sets)
+            return "host", "device_cold"
+        if not cold and small:
             p99 = self._p99_ms()
             if p99 is not None and p99 > self.p99_budget_ms:
                 return "host", "latency_budget"
+        # breaker consulted LAST, exactly when the device path is otherwise
+        # chosen: open = O(1) refusal; allow() in half-open admits exactly
+        # one probe verify whose recorded outcome (via _record_device_ok /
+        # _record_device_error) closes or re-opens the circuit. Consulting
+        # it earlier could claim the probe slot for a verify that then
+        # routes to the host and never reports back.
+        if not self._breaker.allow():
+            return "host", "circuit_open"
         return "device", ""
 
     def _spawn_warm(self, bucket, sets):
@@ -335,10 +376,20 @@ class HybridBackend:
             self._lats.append(dt)
             self._warm_buckets.add(bucket)
             self._device_failures = 0
+        # a verify that completed but blew the stall budget is a breaker
+        # failure: the device answered, too late to be useful
+        if dt > self._stall_budget_secs:
+            self._log.warn("device verify stalled past budget",
+                           secs=round(dt, 2),
+                           budget_secs=self._stall_budget_secs)
+            self._breaker.record_failure()
+        else:
+            self._breaker.record_success()
 
     def _record_device_error(self, e):
         self._log.warn("device verify failed; host served",
                        error=f"{type(e).__name__}: {e}")
+        self._breaker.record_failure()
         with self._lock:
             self._device_failures += 1
             if self._device_failures >= 3:
@@ -422,10 +473,25 @@ class HybridBackend:
 
     def aggregate_verify(self, pks, messages, sig) -> bool:
         state = self._device_state()
-        if state == "up":
+        if state != "up":
+            reason = f"device_{state}"
+        elif not self._breaker.allow():
+            reason = "circuit_open"
+        else:
             try:
-                return self._device.aggregate_verify(pks, messages, sig)
+                t0 = time.time()
+                ok = self._device.aggregate_verify(pks, messages, sig)
+                # same stall-budget rule as _record_device_ok: a verify
+                # that completes too late to be useful is a breaker
+                # failure, or mixed single+batch traffic on a stalled
+                # device would never accumulate 3 consecutive failures
+                if time.time() - t0 > self._stall_budget_secs:
+                    self._breaker.record_failure()
+                else:
+                    self._breaker.record_success()
+                return ok
             except Exception as e:
                 self._record_device_error(e)
-        _REASONS[f"device_{state}" if state != "up" else "device_error"].inc()
+                reason = "device_error"
+        _REASONS[reason].inc()
         return self._host().aggregate_verify(pks, messages, sig)

@@ -1,0 +1,44 @@
+"""Loadgen: deterministic mainnet-shaped traffic + fault injection.
+
+The proving ground for the QoS subsystem (lighthouse_tpu/qos): a seedable
+open-loop generator synthesizes per-slot gossip mixes shaped like mainnet
+(attestation/aggregate/block ratios at a configurable validator count) and
+publishes them through the existing `InProcessGossipRouter`, driving a real
+`BeaconProcessor` behind a real `AdmissionController` — the same serving
+path gossip takes in a live node, minus TCP. A fault injector stalls the
+(simulated) device backend, slows host verification, or floods queues at a
+multiple of their bounds, and the runner emits a machine-readable report of
+what the QoS layer did about it: processed / shed / expired counts, circuit
+breaker transitions, whether blocks still landed in their slot.
+
+Entry points: `bn loadtest [--smoke]` and `scripts/loadgen.py --smoke`
+(CPU-only, ~seconds, gitignored JSON report). Everything is driven by a
+`ManualSlotClock`, so the same seed reproduces the same report bit for bit.
+"""
+
+# Lazy re-exports (PEP 562): the CLI parser imports `loadgen.driver` for
+# its shared flag declarations on EVERY invocation, and that must not drag
+# the runner's chain/network import graph into `bn --help`.
+_EXPORTS = {
+    "DeviceStallError": ".faults",
+    "FaultInjector": ".faults",
+    "StallingBackend": ".faults",
+    "run_scenario": ".runner",
+    "SCENARIOS": ".scenarios",
+    "Scenario": ".scenarios",
+    "get_scenario": ".scenarios",
+    "traffic_schedule": ".scenarios",
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    value = getattr(importlib.import_module(mod, __name__), name)
+    globals()[name] = value
+    return value

@@ -1,0 +1,100 @@
+"""Shared loadtest driver behind `bn loadtest` and scripts/loadgen.py.
+
+One implementation of the flag set, scenario resolution, report-path
+defaulting and the one-line stdout summary, so the two entry points cannot
+drift. Default report paths resolve against the repository root (where
+.gitignore covers LOADGEN_SMOKE.json / loadgen_report.json), not the
+caller's cwd.
+
+This module is a LEAF import: the CLI parser loads it on every invocation
+for `add_loadtest_args`, so the runner (and its chain/network import
+graph) is only imported inside `drive()`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+# lighthouse_tpu/loadgen/driver.py -> repo root
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def default_report_path(smoke: bool) -> str:
+    name = "LOADGEN_SMOKE.json" if smoke else "loadgen_report.json"
+    return os.path.join(_ROOT, name)
+
+
+def drive(*, scenario=None, smoke=False, slots=None, validators=None,
+          seed=None, flood_factor=None, out=None, quiet=False,
+          stdout=None, stderr=None) -> int:
+    """Run one scenario and print the one-line JSON summary. Returns a
+    process exit code. `--smoke` IS the smoke scenario — combining it with
+    a different --scenario is a contradiction, not a filename choice."""
+    from .runner import run_scenario
+    from .scenarios import get_scenario
+
+    stdout = stdout or sys.stdout
+    stderr = stderr or sys.stderr
+    if smoke and scenario not in (None, "smoke"):
+        print(f"error: --smoke runs the 'smoke' scenario; drop --smoke or "
+              f"--scenario {scenario}", file=stderr)
+        return 2
+    name = "smoke" if smoke else (scenario or "smoke")
+    try:
+        sc = get_scenario(name, slots=slots, n_validators=validators,
+                          seed=seed, flood_factor=flood_factor)
+    except KeyError as e:
+        print(f"error: {e.args[0]}", file=stderr)
+        return 1
+    out = out or default_report_path(sc.name == "smoke")
+    report = run_scenario(
+        sc, out_path=out,
+        log_fn=None if quiet else (
+            lambda m: print(m, file=stderr, flush=True)
+        ),
+    )
+    print(json.dumps({
+        "scenario": report["scenario"],
+        "report": out,
+        "published": report["published"],
+        "qos_totals": report["qos_totals"],
+        "breaker_transitions": report["breaker_transitions"],
+        "blocks_processed_in_slot": report["blocks_processed_in_slot"],
+        "elapsed_secs": report["elapsed_secs"],
+    }), file=stdout)
+    return 0
+
+
+def add_loadtest_args(parser) -> None:
+    """The flag set shared by both entry points."""
+    parser.add_argument("--scenario", default=None,
+                        help="named scenario: smoke, steady, flood, "
+                             "device_stall, slow_host (default: smoke)")
+    parser.add_argument("--smoke", action="store_true",
+                        help="run the ~5s CPU-only smoke scenario; report "
+                             "lands in the gitignored LOADGEN_SMOKE.json "
+                             "(contradicts a different --scenario)")
+    parser.add_argument("--slots", type=int, default=None,
+                        help="override the scenario's slot count")
+    parser.add_argument("--validators", type=int, default=None,
+                        help="override the scenario's validator count")
+    parser.add_argument("--seed", type=int, default=None,
+                        help="override the scenario's RNG seed")
+    parser.add_argument("--flood-factor", type=float, default=None,
+                        help="override the open-loop traffic multiplier")
+    parser.add_argument("--out", default=None,
+                        help="report path (default: LOADGEN_SMOKE.json for "
+                             "smoke, loadgen_report.json otherwise, under "
+                             "the repo root)")
+    parser.add_argument("--quiet", action="store_true",
+                        help="suppress per-slot progress on stderr")
+
+
+def drive_from_args(args) -> int:
+    return drive(
+        scenario=args.scenario, smoke=args.smoke, slots=args.slots,
+        validators=args.validators, seed=args.seed,
+        flood_factor=args.flood_factor, out=args.out, quiet=args.quiet,
+    )

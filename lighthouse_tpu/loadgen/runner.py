@@ -1,0 +1,251 @@
+"""Scenario runner: synthetic gossip through the real QoS-protected path.
+
+One `LoadgenNode` is the serving path of a beacon node with the chain
+swapped for counters: an `InProcessGossipRouter` delivers synthetic
+attestation/aggregate/block messages (snappy-compressed, deduped by real
+message ids) into topic handlers that submit `WorkItem`s to a real
+`BeaconProcessor` guarded by a real `AdmissionController` — the exact
+submit/coalesce/shed/expire machinery gossip exercises in production. The
+verification leg is a `StallingBackend` device behind a `CircuitBreaker`
+with an instant host fallback, so device-stall scenarios drive the
+closed→open→half_open cycle exactly as the hybrid BLS router would.
+
+Time is a `ManualSlotClock` advanced slot by slot; the breaker reads the
+same logical clock. Within a slot the generator is open-loop (everything
+publishes whether or not the pipeline keeps up), then the pump drains, so
+every count in the report is a pure function of (scenario, seed).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import time
+
+from ..chain.beacon_processor import (
+    BeaconProcessor,
+    BeaconProcessorConfig,
+    WorkItem,
+    WorkKind,
+)
+from ..network import gossip as gs
+from ..network import snappy
+from ..qos.admission import AdmissionController
+from ..qos.breaker import CircuitBreaker
+from ..utils.slot_clock import ManualSlotClock
+from .faults import DeviceStallError, FaultInjector, SlowHostVerify, StallingBackend
+from .scenarios import Scenario, traffic_schedule
+
+# stale gossip is stamped this many slots in the past: past the propagation
+# window (32), so its deadline has already expired on arrival
+STALE_AGE_SLOTS = 40
+
+_FORK_DIGEST = b"\x00" * 4
+
+
+class LoadgenNode:
+    """Router topics -> QoS-guarded BeaconProcessor -> counting verifiers."""
+
+    def __init__(self, sc: Scenario, clock: ManualSlotClock):
+        self.scenario = sc
+        self.clock = clock
+        self.admission = AdmissionController(clock)
+        self.processor = BeaconProcessor(
+            BeaconProcessorConfig(), admission=self.admission
+        )
+        if sc.att_queue_cap is not None:
+            self.processor.max_lengths[WorkKind.gossip_attestation] = (
+                sc.att_queue_cap
+            )
+        if sc.agg_queue_cap is not None:
+            self.processor.max_lengths[WorkKind.gossip_aggregate] = (
+                sc.agg_queue_cap
+            )
+        self.device = StallingBackend()
+        # breaker on the scenario's logical clock: one-slot cooldown, so
+        # recovery is observable within the run
+        self.breaker = CircuitBreaker(
+            "loadgen_device", failure_threshold=3,
+            reset_timeout=float(sc.seconds_per_slot), time_fn=clock._time,
+        )
+        self.slow_host = (
+            SlowHostVerify() if "slow_host" in sc.faults else None
+        )
+        self.router = gs.InProcessGossipRouter()
+        self.att_topic = gs.attestation_subnet_topic(_FORK_DIGEST, 0)
+        self.agg_topic = gs.topic_name(_FORK_DIGEST, "beacon_aggregate_and_proof")
+        self.block_topic = gs.topic_name(_FORK_DIGEST, "beacon_block")
+        self.router.subscribe("node", self.att_topic, self._on_att)
+        self.router.subscribe("node", self.agg_topic, self._on_agg)
+        self.router.subscribe("node", self.block_topic, self._on_block)
+        self._seq = 0
+        self.published = {"attestations": 0, "aggregates": 0, "blocks": 0,
+                          "stale_attestations": 0}
+        self.verified_sets = 0
+        self.batches = {"device": 0, "host": 0, "device_stalls": 0,
+                        "circuit_refusals": 0}
+        self.block_slot_lag: list[int] = []
+        self.shed_callbacks = 0
+
+    # --------------------------------------------------------- payloads
+
+    def _payload(self, slot: int, rng: random.Random) -> bytes:
+        """Unique synthetic message: stamped slot + sequence + seeded noise
+        (the router dedups by real message id; every payload must differ)."""
+        self._seq += 1
+        return (
+            # signed: stale stamps near genesis go negative (slot - 40)
+            int(slot).to_bytes(8, "little", signed=True)
+            + self._seq.to_bytes(8, "little")
+            + rng.getrandbits(128).to_bytes(16, "little")
+        )
+
+    @staticmethod
+    def _stamped_slot(msg) -> int:
+        return int.from_bytes(
+            snappy.decompress(msg.payload)[:8], "little", signed=True
+        )
+
+    # --------------------------------------------------------- handlers
+
+    def _on_shed(self, _reason: str) -> None:
+        self.shed_callbacks += 1
+
+    def _on_att(self, msg) -> bool:
+        slot = self._stamped_slot(msg)
+        return self.processor.submit(WorkItem(
+            kind=WorkKind.gossip_attestation,
+            payload=slot,
+            run_batch=self._run_verify_batch,
+            deadline_slot=self.admission.attestation_deadline_slot(slot),
+            on_shed=self._on_shed,
+        ))
+
+    def _on_agg(self, msg) -> bool:
+        slot = self._stamped_slot(msg)
+        return self.processor.submit(WorkItem(
+            kind=WorkKind.gossip_aggregate,
+            payload=slot,
+            run_batch=self._run_verify_batch,
+            deadline_slot=self.admission.attestation_deadline_slot(slot),
+            on_shed=self._on_shed,
+        ))
+
+    def _on_block(self, msg) -> bool:
+        slot = self._stamped_slot(msg)
+
+        def run():
+            # blocks verify on the host path unconditionally (the hybrid
+            # urgent path); what matters here is WHEN they run
+            now = self.clock.now() or 0
+            self.block_slot_lag.append(now - slot)
+
+        return self.processor.submit(
+            WorkItem(kind=WorkKind.gossip_block, run=run)
+        )
+
+    def _run_verify_batch(self, payloads) -> None:
+        """Coalesced batch verifier: device behind the breaker, host
+        fallback — the hybrid router's routing shape with counters for
+        crypto (fake semantics; loadgen measures QoS, not pairings)."""
+        n = len(payloads)
+        self.verified_sets += n
+        if self.breaker.allow():
+            try:
+                self.device.verify_signature_sets([None] * n, [1] * n)
+                self.breaker.record_success()
+                self.batches["device"] += 1
+                return None
+            except DeviceStallError:
+                self.breaker.record_failure()
+                self.batches["device_stalls"] += 1
+        else:
+            self.batches["circuit_refusals"] += 1
+        if self.slow_host is not None:
+            self.slow_host(n)
+        self.batches["host"] += 1
+        return None
+
+    # --------------------------------------------------------- publishing
+
+    def publish_slot(self, slot: int, traffic, rng: random.Random) -> None:
+        for _ in range(traffic.attestations):
+            self.router.publish(
+                "loadgen", self.att_topic, self._payload(slot, rng)
+            )
+        self.published["attestations"] += traffic.attestations
+        stale_slot = slot - STALE_AGE_SLOTS
+        for _ in range(traffic.stale_attestations):
+            self.router.publish(
+                "loadgen", self.att_topic, self._payload(stale_slot, rng)
+            )
+        self.published["stale_attestations"] += traffic.stale_attestations
+        for _ in range(traffic.aggregates):
+            self.router.publish(
+                "loadgen", self.agg_topic, self._payload(slot, rng)
+            )
+        self.published["aggregates"] += traffic.aggregates
+        for _ in range(traffic.blocks):
+            self.router.publish(
+                "loadgen", self.block_topic, self._payload(slot, rng)
+            )
+        self.published["blocks"] += traffic.blocks
+
+
+def run_scenario(sc: Scenario, out_path: str | None = None,
+                 log_fn=None) -> dict:
+    """Run one scenario to completion; returns (and optionally writes) the
+    machine-readable report."""
+    t_wall = time.time()
+    clock = ManualSlotClock(0, max(1, int(sc.seconds_per_slot)))
+    node = LoadgenNode(sc, clock)
+    injector = FaultInjector()
+    if "device_stall" in sc.faults:
+        start, end = sc.stall_slots
+        injector.at(start, node.device.stall)
+        injector.at(end, node.device.release)
+    schedule = traffic_schedule(sc)
+    rng = random.Random(sc.seed ^ 0x10AD6E4)
+    for slot, traffic in enumerate(schedule):
+        clock.set_slot(slot)
+        injector.on_slot(slot)
+        node.publish_slot(slot, traffic, rng)
+        node.processor.run_until_idle()
+        if log_fn is not None:
+            log_fn(f"slot {slot}: published "
+                   f"{traffic.attestations + traffic.stale_attestations} att "
+                   f"/ {traffic.aggregates} agg / {traffic.blocks} block; "
+                   f"breaker={node.breaker.state()}")
+    # epilogue slot: release any still-armed faults, drain what remains
+    clock.set_slot(sc.slots)
+    injector.on_slot(sc.slots + max(0, sc.stall_slots[1] - sc.slots))
+    node.device.release()
+    node.processor.run_until_idle()
+    proc = node.processor
+    report = {
+        "scenario": sc.name,
+        "seed": sc.seed,
+        "slots": sc.slots,
+        "n_validators": sc.n_validators,
+        "flood_factor": sc.flood_factor,
+        "faults": list(sc.faults),
+        "published": dict(node.published),
+        "processed": {k.name: v for k, v in proc.processed.items() if v},
+        "dropped": {k.name: v for k, v in proc.dropped.items() if v},
+        "expired": {k.name: v for k, v in proc.expired.items() if v},
+        "shed_admission": {
+            k.name: v for k, v in proc.shed_admission.items() if v
+        },
+        "qos_totals": proc.qos_totals(),
+        "shed_callbacks": node.shed_callbacks,
+        "verified_sets": node.verified_sets,
+        "batches": dict(node.batches),
+        "breaker_transitions": list(node.breaker.transitions),
+        "blocks_processed_in_slot": bool(node.block_slot_lag)
+        and max(node.block_slot_lag) == 0,
+        "elapsed_secs": round(time.time() - t_wall, 3),
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=1)
+    return report

@@ -1,0 +1,130 @@
+"""Scenario definitions: mainnet-shaped traffic mixes, seeded + deterministic.
+
+Mainnet shape (the ratios, not the absolute scale): every active validator
+attests exactly once per epoch, so a subscribed-to-everything node sees
+roughly `n_validators / 32` single-bit attestations per slot; each of the
+up-to-64 committees elects ~16 aggregators, so aggregates arrive at
+`committees * 16` per slot; and there is one block per slot. The generator
+jitters each count ±10% from the scenario seed so queues see realistic
+unevenness while staying bit-reproducible.
+
+`stale_fraction` mixes in attestations stamped with a slot older than the
+propagation window — replayed/late gossip whose deadline has already
+passed, which MUST be shed `expired` at pop, never verified.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, replace
+
+SLOTS_PER_EPOCH = 32          # mainnet shape
+AGGREGATORS_PER_COMMITTEE = 16
+MAX_COMMITTEES_PER_SLOT = 64
+
+
+@dataclass(frozen=True)
+class SlotTraffic:
+    attestations: int
+    aggregates: int
+    blocks: int
+    stale_attestations: int = 0
+
+
+@dataclass
+class Scenario:
+    name: str
+    n_validators: int = 16384
+    slots: int = 8
+    seed: int = 0xC0FFEE
+    # open-loop multiplier over the mainnet-shaped per-slot counts
+    flood_factor: float = 1.0
+    # fraction of attestations stamped past the propagation window
+    stale_fraction: float = 0.0
+    # fault injections: "device_stall" stalls the device backend over
+    # stall_slots; "slow_host" adds per-batch host latency
+    faults: tuple = ()
+    stall_slots: tuple = (2, 4)      # [start, end) in scenario slots
+    # queue bounds for the attestation/aggregate queues (None = processor
+    # defaults); flood scenarios shrink them so shedding is observable in
+    # a few seconds instead of at mainnet scale
+    att_queue_cap: int | None = None
+    agg_queue_cap: int | None = None
+    seconds_per_slot: float = 1.0    # logical (manual-clock) seconds
+
+
+def mainnet_mix(n_validators: int, rng: random.Random) -> SlotTraffic:
+    atts = max(1, n_validators // SLOTS_PER_EPOCH)
+    committees = max(1, min(MAX_COMMITTEES_PER_SLOT, atts // 128))
+    aggs = committees * AGGREGATORS_PER_COMMITTEE
+
+    def jitter(n: int) -> int:
+        return max(1, int(n * (0.9 + 0.2 * rng.random())))
+
+    return SlotTraffic(jitter(atts), jitter(aggs), 1)
+
+
+def traffic_schedule(sc: Scenario) -> list[SlotTraffic]:
+    """Per-slot traffic for the whole scenario — pure function of the
+    scenario (seeded RNG), so a report is reproducible from (name, seed)."""
+    rng = random.Random(sc.seed)
+    out = []
+    for _slot in range(sc.slots):
+        base = mainnet_mix(sc.n_validators, rng)
+        atts = int(base.attestations * sc.flood_factor)
+        stale = int(atts * sc.stale_fraction)
+        out.append(
+            SlotTraffic(
+                attestations=atts - stale,
+                aggregates=int(base.aggregates * sc.flood_factor),
+                blocks=base.blocks,
+                stale_attestations=stale,
+            )
+        )
+    return out
+
+
+SCENARIOS: dict[str, Scenario] = {
+    # ~5 s CPU-only sanity pass: modest traffic, every code path exercised
+    # (flood over the shrunk queue caps -> oldest-first sheds; stale mix ->
+    # expiry; device stall mid-run -> full breaker cycle)
+    "smoke": Scenario(
+        name="smoke", n_validators=4096, slots=6, flood_factor=3.0,
+        stale_fraction=0.1, faults=("device_stall",), stall_slots=(2, 4),
+        att_queue_cap=256, agg_queue_cap=64,
+    ),
+    # steady mainnet-shaped mix, no faults — the control run
+    "steady": Scenario(
+        name="steady", n_validators=16384, slots=8,
+    ),
+    # 4x open-loop flood over deliberately small queues: oldest-first
+    # shedding + admission refusals under pressure
+    "flood": Scenario(
+        name="flood", n_validators=16384, slots=8, flood_factor=4.0,
+        stale_fraction=0.05, att_queue_cap=512, agg_queue_cap=128,
+    ),
+    # device stalls mid-run while the flood continues: the circuit breaker
+    # must open, the host path serve, and the breaker close after recovery
+    "device_stall": Scenario(
+        name="device_stall", n_validators=16384, slots=10, flood_factor=2.0,
+        faults=("device_stall",), stall_slots=(3, 6),
+        att_queue_cap=1024, agg_queue_cap=256,
+    ),
+    # slow host verification under flood: queues stay hot, deadlines bite
+    "slow_host": Scenario(
+        name="slow_host", n_validators=8192, slots=8, flood_factor=2.0,
+        faults=("slow_host",), stale_fraction=0.1,
+        att_queue_cap=512, agg_queue_cap=128,
+    ),
+}
+
+
+def get_scenario(name: str, **overrides) -> Scenario:
+    """A named scenario, optionally with field overrides (CLI flags)."""
+    base = SCENARIOS.get(name)
+    if base is None:
+        raise KeyError(
+            f"unknown scenario {name!r} (have: {', '.join(sorted(SCENARIOS))})"
+        )
+    overrides = {k: v for k, v in overrides.items() if v is not None}
+    return replace(base, **overrides) if overrides else replace(base)

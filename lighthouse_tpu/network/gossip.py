@@ -88,18 +88,37 @@ class GossipMessage:
     source_peer: str
 
 
+def ingest_scope(topic: str) -> str:
+    """QoS rate-limit scope for a topic (matches the scopes NetworkNode
+    configures: per batchable gossip kind, everything else unlimited)."""
+    if "beacon_attestation_" in topic:
+        return "gossip_attestation"
+    if "beacon_aggregate_and_proof" in topic:
+        return "gossip_aggregate"
+    return "gossip_other"
+
+
 class InProcessGossipRouter:
     """Pub/sub bus connecting in-process nodes (simulator network).
 
     Handlers return True to propagate (ACCEPT) and False to drop (REJECT/
     IGNORE) — the gossip validation outcome the reference signals back to
-    gossipsub."""
+    gossipsub.
 
-    def __init__(self):
+    `ingest_limiter` (lighthouse_tpu/qos/ratelimit.RateLimiter, optional)
+    sheds over-quota messages at the bus edge — after dedup (duplicates
+    were always free no-ops and must not drain tokens), before delivery —
+    the in-process analog of the TCP node's `--gossip-ingest-rate`. Scopes
+    follow `ingest_scope`; shed messages count in `rate_limited` and stay
+    un-seen, so a later re-publish can retry."""
+
+    def __init__(self, ingest_limiter=None):
         self.subscriptions: dict[str, list] = defaultdict(list)   # topic -> [(peer_id, handler)]
         self.seen: set[bytes] = set()
         self.delivered = 0
         self.dropped = 0
+        self.rate_limited = 0
+        self.ingest_limiter = ingest_limiter
 
     def subscribe(self, peer_id: str, topic: str, handler) -> None:
         self.subscriptions[topic].append((peer_id, handler))
@@ -115,6 +134,13 @@ class InProcessGossipRouter:
             raise ValueError("gossip message too large")
         mid = message_id(topic, compressed)
         if mid in self.seen:
+            return 0
+        # rate limit AFTER dedup: a duplicate publish was always a free
+        # no-op and must not drain tokens meant for fresh messages
+        if self.ingest_limiter is not None and not self.ingest_limiter.allow(
+            ingest_scope(topic)
+        ):
+            self.rate_limited += 1
             return 0
         self.seen.add(mid)
         msg = GossipMessage(topic, compressed, mid, source_peer)

@@ -42,6 +42,7 @@ class NetworkNode:
         require_encryption: bool = False,
         batch_gossip: bool = True,
         processor_config=None,
+        ingest_rate: float | None = None,
     ):
         self.chain = chain
         chain._network_node = self          # identity/peers API surface
@@ -55,9 +56,27 @@ class NetworkNode:
         # backend). batch_gossip=False falls back to inline per-message
         # verification (deterministic single-threaded tests).
         from ..chain.beacon_processor import BeaconProcessor
+        from ..qos.admission import AdmissionController
 
         self.batch_gossip = batch_gossip
-        self.processor = BeaconProcessor(processor_config)
+        # QoS: the admission controller reads slot time from the chain's
+        # clock (manual under test -> deterministic deadlines); the
+        # processor consults it on submit and sheds expired work at pop
+        self.admission = AdmissionController(chain.slot_clock)
+        self.processor = BeaconProcessor(processor_config,
+                                         admission=self.admission)
+        # optional gossip ingest token buckets (msgs/sec per batchable
+        # kind; over-quota messages become gossip IGNOREs before touching
+        # the queues). None = unlimited, the default.
+        self.ingest_limiter = None
+        if ingest_rate is not None:
+            from ..qos.ratelimit import RateLimiter
+
+            self.ingest_limiter = RateLimiter()
+            for scope in ("gossip_attestation", "gossip_aggregate"):
+                self.ingest_limiter.configure(
+                    scope, float(ingest_rate), burst=2 * float(ingest_rate)
+                )
         if batch_gossip:
             self.processor.start()
         self.op_pool = op_pool
@@ -410,12 +429,26 @@ class NetworkNode:
                 from ..chain.beacon_processor import WorkItem, WorkKind
                 from .gossipsub import PENDING
 
+                if (
+                    self.ingest_limiter is not None
+                    and not self.ingest_limiter.allow("gossip_attestation")
+                ):
+                    return None   # over ingest quota: ignore, no penalty
                 accepted = self.processor.submit(WorkItem(
                     kind=WorkKind.gossip_attestation,
                     payload=(att, msg.message_id),
                     run_batch=self._run_attestation_batch,
+                    # shed-at-pop deadline: past the propagation window the
+                    # verification result is unactionable
+                    deadline_slot=self.admission.attestation_deadline_slot(
+                        att.data.slot
+                    ),
+                    # a shed item must resolve its deferred validation or
+                    # the PENDING entry strands until PENDING_TTL
+                    on_shed=self._mk_shed_resolver(msg.message_id),
                 ))
-                # queue full -> dropped under load: ignore, don't penalize
+                # queue full -> oldest shed (its on_shed resolved the
+                # displaced PENDING entry); admission refusal -> ignore
                 return PENDING if accepted else None
             with self._lock:
                 try:
@@ -432,6 +465,15 @@ class NetworkNode:
                 return True if results else None
 
         return handler
+
+    def _mk_shed_resolver(self, mid):
+        """on_shed callback for a queued gossip work item: a shed/expired
+        message resolves its deferred validation as a terminal ignore (no
+        credit, no penalty, mid stays deduped)."""
+        def resolve(_reason):
+            self.gossipsub.report_validation_result(mid, None)
+
+        return resolve
 
     def _run_attestation_batch(self, payloads):
         """Coalesced batch runner (pump thread): delegates the whole
@@ -492,10 +534,19 @@ class NetworkNode:
             from ..chain.beacon_processor import WorkItem, WorkKind
             from .gossipsub import PENDING
 
+            if (
+                self.ingest_limiter is not None
+                and not self.ingest_limiter.allow("gossip_aggregate")
+            ):
+                return None
             accepted = self.processor.submit(WorkItem(
                 kind=WorkKind.gossip_aggregate,
                 payload=(signed, msg.message_id),
                 run_batch=self._run_aggregate_batch,
+                deadline_slot=self.admission.attestation_deadline_slot(
+                    signed.message.aggregate.data.slot
+                ),
+                on_shed=self._mk_shed_resolver(msg.message_id),
             ))
             return PENDING if accepted else None
         with self._lock:

@@ -114,20 +114,32 @@ class MonitoringService:
         ]
         if self.chain is not None:
             fc = self.chain.fork_choice.store
-            out.append(
-                {
-                    "version": 1,
-                    "timestamp": now_ms,
-                    "process": "beaconnode",
-                    "client_name": VERSION,
-                    "sync_beacon_head_slot": int(self.chain.head_state().slot),
-                    "sync_eth2_synced": True,
-                    "slasher_active": getattr(self.chain, "slasher", None)
-                    is not None,
-                    "justified_epoch": fc.justified_checkpoint[0],
-                    "finalized_epoch": fc.finalized_checkpoint[0],
-                }
+            rec = {
+                "version": 1,
+                "timestamp": now_ms,
+                "process": "beaconnode",
+                "client_name": VERSION,
+                "sync_beacon_head_slot": int(self.chain.head_state().slot),
+                "sync_eth2_synced": True,
+                "slasher_active": getattr(self.chain, "slasher", None)
+                is not None,
+                "justified_epoch": fc.justified_checkpoint[0],
+                "finalized_epoch": fc.finalized_checkpoint[0],
+            }
+            # QoS overload signals from this node's beacon processor:
+            # qos_shed_total = EVERY lost work item (same semantics as the
+            # Prometheus qos_shed_total family total, so the two cross-
+            # check), qos_expired_total = its deadline-expired subset —
+            # remote monitoring sees overload events without scraping
+            # /metrics (lighthouse_tpu/qos)
+            proc = getattr(
+                getattr(self.chain, "_network_node", None), "processor", None
             )
+            if proc is not None:
+                totals = proc.qos_totals()
+                rec["qos_shed_total"] = int(totals["shed"])
+                rec["qos_expired_total"] = int(totals["expired"])
+            out.append(rec)
         if self.vc_store is not None:
             out.append(
                 {

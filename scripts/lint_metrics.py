@@ -41,6 +41,7 @@ METRIC_MODULES = (
     "lighthouse_tpu.autotune.profiler",
     "lighthouse_tpu.observability",
     "lighthouse_tpu.api.http_api",
+    "lighthouse_tpu.qos",
 )
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
@@ -81,6 +82,15 @@ def lint_registry(registry=None) -> list[str]:
         for ln in getattr(m, "labelnames", ()):
             if not _LABEL_RE.match(ln) or ln.startswith("__"):
                 errors.append(f"{where}: invalid label name {ln!r}")
+        if m.name.startswith("qos_"):
+            # QoS accounting series are only useful broken down (shed by
+            # kind+reason, refusals by scope, transitions by breaker+state):
+            # an unlabeled qos_ aggregate cannot answer "what was lost and
+            # why", so the convention is enforced here
+            if not getattr(m, "labelnames", ()):
+                errors.append(
+                    f"{where}: qos_* metrics must be labeled families"
+                )
         if m.kind == "histogram":
             # a histogram's exposition series must not shadow other metrics
             for suf in _RESERVED_SUFFIXES:

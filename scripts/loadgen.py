@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""Loadgen driver: mainnet-shaped gossip floods + fault injection, CPU-only.
+
+Runs a named scenario from lighthouse_tpu/loadgen against the real QoS-
+protected serving path (InProcessGossipRouter -> AdmissionController ->
+BeaconProcessor -> circuit-broken device/host verify) and writes a
+machine-readable report. `--smoke` is the CI entry point: the "smoke"
+scenario completes in seconds on CPU and the report lands in the
+gitignored LOADGEN_SMOKE.json at the repo root.
+
+    python scripts/loadgen.py --smoke
+    python scripts/loadgen.py --scenario flood --slots 16 --out report.json
+
+The CLI equivalent is `python -m lighthouse_tpu bn loadtest [--smoke]`;
+both share the driver in lighthouse_tpu/loadgen/driver.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# standalone invocation from anywhere: the repo root is the import root
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    from lighthouse_tpu.loadgen.driver import add_loadtest_args, drive_from_args
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    add_loadtest_args(ap)
+    return drive_from_args(ap.parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,138 @@
+"""Loadgen: deterministic scenarios, fault injection, and the smoke
+entry points (`bn loadtest --smoke`, `scripts/loadgen.py --smoke`)."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from lighthouse_tpu.loadgen import (
+    SCENARIOS,
+    DeviceStallError,
+    FaultInjector,
+    StallingBackend,
+    get_scenario,
+    run_scenario,
+    traffic_schedule,
+)
+
+
+def test_traffic_schedule_deterministic_and_seed_sensitive():
+    sc = get_scenario("smoke")
+    a = traffic_schedule(sc)
+    b = traffic_schedule(sc)
+    assert a == b
+    assert len(a) == sc.slots
+    c = traffic_schedule(get_scenario("smoke", seed=sc.seed + 1))
+    assert a != c
+    # flood multiplies the shape
+    base = traffic_schedule(get_scenario("flood", flood_factor=1.0))
+    flood = traffic_schedule(get_scenario("flood", flood_factor=4.0))
+    assert sum(t.attestations + t.stale_attestations for t in flood) > (
+        3 * sum(t.attestations + t.stale_attestations for t in base)
+    )
+
+
+def test_get_scenario_overrides_and_unknown():
+    sc = get_scenario("steady", slots=3, seed=7)
+    assert sc.slots == 3 and sc.seed == 7
+    assert SCENARIOS["steady"].slots != 3      # base untouched
+    with pytest.raises(KeyError):
+        get_scenario("nope")
+
+
+def test_stalling_backend_and_injector():
+    dev = StallingBackend(wait_secs=0.01)
+    assert dev.verify_signature_sets([None], [1]) is True
+    dev.stall()
+    with pytest.raises(DeviceStallError):
+        dev.verify_signature_sets([None], [1])
+    handle = dev.verify_signature_sets_async([None], [1])
+    with pytest.raises(DeviceStallError):
+        handle.result()
+    dev.release()
+    assert dev.verify_signature_sets([None], [1]) is True
+    assert dev.stall_hits == 2
+
+    fired = []
+    inj = FaultInjector()
+    inj.at(2, lambda: fired.append("a")).at(4, lambda: fired.append("b"))
+    assert inj.on_slot(0) == 0
+    assert inj.on_slot(3) == 1 and fired == ["a"]
+    assert inj.on_slot(3) == 0                 # each action fires once
+    # registering after some actions fired must not remap what already ran
+    inj.at(1, lambda: fired.append("late"))
+    assert inj.on_slot(3) == 1 and fired == ["a", "late"]
+    assert inj.on_slot(10) == 1 and fired == ["a", "late", "b"]
+
+
+def test_smoke_scenario_exercises_every_qos_path():
+    report = run_scenario(get_scenario("smoke"))
+    # identical rerun: the report is a pure function of (scenario, seed)
+    report2 = run_scenario(get_scenario("smoke"))
+    for key in ("published", "processed", "dropped", "expired",
+                "verified_sets", "batches", "breaker_transitions"):
+        assert report[key] == report2[key], key
+
+    pub, proc = report["published"], report["processed"]
+    # conservation: every attestation is processed, shed, or expired
+    lost = report["dropped"].get("gossip_attestation", 0)
+    expired = report["expired"].get("gossip_attestation", 0)
+    assert (
+        pub["attestations"] + pub["stale_attestations"]
+        == proc["gossip_attestation"] + lost + expired
+    )
+    assert lost > 0, "smoke flood should shed oldest-first"
+    assert expired > 0, "stale replays should expire at pop"
+    assert proc["gossip_block"] == pub["blocks"]
+    assert report["blocks_processed_in_slot"]
+    # the device stall drove the full breaker cycle
+    tr = report["breaker_transitions"]
+    assert tr[0] == "closed" and "open" in tr and "half_open" in tr
+    assert tr[-1] == "closed"
+    assert report["batches"]["device_stalls"] > 0
+    assert report["batches"]["host"] > 0       # host served during the stall
+    # every shed/expired item resolved its gossip bookkeeping callback
+    assert report["shed_callbacks"] == lost + expired
+    json.dumps(report)                         # machine-readable end to end
+
+
+def test_steady_scenario_sheds_nothing():
+    report = run_scenario(get_scenario("steady", slots=4))
+    assert report["dropped"] == {} and report["expired"] == {}
+    assert report["breaker_transitions"] == ["closed"]
+    assert report["batches"]["host"] == 0      # healthy device took it all
+
+
+def _run_cli(args, timeout=300):
+    return subprocess.run(
+        [sys.executable, *args], capture_output=True, text=True,
+        timeout=timeout, cwd="/root/repo",
+    )
+
+
+def test_bn_loadtest_smoke_cli(tmp_path):
+    out = tmp_path / "report.json"
+    r = _run_cli(["-m", "lighthouse_tpu", "bn", "loadtest", "--smoke",
+                  "--quiet", "--out", str(out)])
+    assert r.returncode == 0, r.stderr
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    assert summary["scenario"] == "smoke"
+    assert summary["blocks_processed_in_slot"] is True
+    assert summary["breaker_transitions"][-1] == "closed"
+    report = json.loads(out.read_text())
+    assert report["qos_totals"]["shed"] > 0
+    assert report["elapsed_secs"] < 30
+
+
+def test_scripts_loadgen_smoke(tmp_path):
+    out = tmp_path / "report.json"
+    r = _run_cli(["scripts/loadgen.py", "--smoke", "--quiet",
+                  "--out", str(out)])
+    assert r.returncode == 0, r.stderr
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    assert summary["report"] == str(out)
+    report = json.loads(out.read_text())
+    assert report["scenario"] == "smoke"
+    assert report["qos_totals"]["expired"] > 0

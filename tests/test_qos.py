@@ -1,0 +1,533 @@
+"""QoS subsystem: admission classes, slot deadlines, oldest-first shedding,
+token buckets, circuit breaker, and the deterministic overload story —
+flood the processor past every queue bound under an injected device stall
+and the node must keep processing blocks, shed attestations oldest-first,
+count expired work, and neither deadlock nor leak inflight gauge counts."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from lighthouse_tpu.chain.beacon_processor import (
+    BeaconProcessor,
+    BeaconProcessorConfig,
+    WorkItem,
+    WorkKind,
+)
+from lighthouse_tpu.qos.admission import (
+    ATTESTATION_PROPAGATION_SLOT_RANGE,
+    AdmissionController,
+    PriorityClass,
+    SHED_TOTAL,
+)
+from lighthouse_tpu.qos.breaker import CLOSED, HALF_OPEN, OPEN, CircuitBreaker
+from lighthouse_tpu.qos.ratelimit import RateLimiter, TokenBucket
+from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+
+
+def _shed_counts():
+    """Snapshot of the global qos_shed_total family as {(kind, reason): n}."""
+    return {key: child.value for key, child in SHED_TOTAL.children()}
+
+
+def _shed_delta(before, kind, reason):
+    after = _shed_counts()
+    return after.get((kind, reason), 0) - before.get((kind, reason), 0)
+
+
+# --------------------------------------------------------------- primitives
+
+
+def test_token_bucket_deterministic():
+    now = [0.0]
+    b = TokenBucket(rate=2.0, burst=4.0, time_fn=lambda: now[0])
+    assert all(b.allow() for _ in range(4))   # burst drains
+    assert not b.allow()
+    assert b.retry_after() == pytest.approx(0.5)
+    now[0] += 1.0                              # 2 tokens refill
+    assert b.allow() and b.allow() and not b.allow()
+    # rate-0 buckets never refill: long hold, not a divide-by-zero
+    z = TokenBucket(rate=0.0, burst=0.0, time_fn=lambda: now[0])
+    assert not z.allow()
+    assert z.retry_after() >= 3600.0
+
+
+def test_rate_limiter_scopes():
+    now = [0.0]
+    lim = RateLimiter(time_fn=lambda: now[0]).configure("api", 1.0, burst=2.0)
+    assert lim.allow("unconfigured-scope")     # untouched scopes pass
+    assert lim.allow("api") and lim.allow("api")
+    assert not lim.allow("api")
+    assert lim.denied("api") == 1
+    assert lim.retry_after_secs("api") >= 1
+    now[0] += 1.0
+    assert lim.allow("api")
+
+
+def test_circuit_breaker_full_cycle():
+    now = [0.0]
+    b = CircuitBreaker("t", failure_threshold=3, reset_timeout=5.0,
+                       time_fn=lambda: now[0])
+    assert b.state() == CLOSED and b.allow()
+    b.record_failure(); b.record_failure()
+    assert b.state() == CLOSED                 # under threshold
+    b.record_failure()
+    assert b.state() == OPEN and not b.allow()
+    now[0] += 4.9
+    assert not b.allow()                       # still cooling down
+    now[0] += 0.2
+    assert b.allow()                           # the half-open probe
+    assert b.state() == HALF_OPEN
+    assert not b.allow()                       # one probe at a time
+    b.record_failure()                         # probe failed -> reopen
+    assert b.state() == OPEN
+    now[0] += 5.1
+    assert b.allow()
+    b.record_success()                         # probe passed -> closed
+    assert b.state() == CLOSED and b.allow()
+    assert list(b.transitions) == [
+        CLOSED, OPEN, HALF_OPEN, OPEN, HALF_OPEN, CLOSED
+    ]
+
+
+def test_circuit_breaker_ignores_stragglers_while_open():
+    """A pipelined success dispatched BEFORE the trip must not close an
+    open circuit — recovery is cooldown + half-open probe only."""
+    now = [0.0]
+    b = CircuitBreaker("strag", failure_threshold=3, reset_timeout=5.0,
+                       time_fn=lambda: now[0])
+    for _ in range(3):
+        b.record_failure()
+    assert b.state() == OPEN
+    b.record_success()                        # in-flight straggler lands
+    assert b.state() == OPEN and not b.allow()
+    now[0] += 5.1
+    assert b.allow()                          # half-open probe
+    b.record_success()
+    assert b.state() == CLOSED
+
+
+def test_admission_classes_and_watermarks():
+    clock = ManualSlotClock(0, 1)
+    adm = AdmissionController(clock)
+    assert adm.classify(WorkKind.gossip_block) == PriorityClass.CRITICAL
+    assert adm.classify(WorkKind.gossip_attestation) == PriorityClass.TIMELY
+    assert adm.classify(WorkKind.chain_segment) == PriorityClass.BULK
+    assert adm.classify(WorkKind.backfill_segment) == PriorityClass.BACKFILL
+    # critical/timely always admitted at submit (their queues protect)
+    assert adm.admit(WorkKind.gossip_block, 99, 100)
+    assert adm.admit(WorkKind.gossip_attestation, 99, 100)
+    # bulk yields at 75% of its own bound, backfill at 50%
+    assert adm.admit(WorkKind.chain_segment, 74, 100)
+    assert not adm.admit(WorkKind.chain_segment, 75, 100)
+    assert adm.admit(WorkKind.backfill_segment, 49, 100)
+    assert not adm.admit(WorkKind.backfill_segment, 50, 100)
+
+
+def test_deadline_expiry_rules():
+    clock = ManualSlotClock(0, 1)
+    clock.set_slot(10)
+    adm = AdmissionController(clock)
+    item = WorkItem(WorkKind.gossip_attestation, payload=0)
+    assert not adm.is_expired(item)            # no deadline -> never expires
+    item.deadline_slot = 10
+    assert not adm.is_expired(item)            # deadline slot still counts
+    item.deadline_slot = 9
+    assert adm.is_expired(item)
+    assert (
+        adm.attestation_deadline_slot(5)
+        == 5 + ATTESTATION_PROPAGATION_SLOT_RANGE
+    )
+    # no clock -> nothing ever expires
+    assert not AdmissionController(None).is_expired(item)
+
+
+# --------------------------------------------------------------- processor
+
+
+def test_oldest_first_shed_keeps_dropped_accurate():
+    proc = BeaconProcessor(BeaconProcessorConfig(max_attestation_batch=64))
+    proc.max_lengths[WorkKind.gossip_attestation] = 4
+    before = _shed_counts()
+    shed = []
+    for i in range(10):
+        accepted = proc.submit(WorkItem(
+            kind=WorkKind.gossip_attestation, payload=i,
+            run_batch=lambda xs: None,
+            on_shed=lambda reason, i=i: shed.append((i, reason)),
+        ))
+        assert accepted     # batchable submits are always accepted...
+    # ...but the 6 OLDEST items were displaced, in order
+    assert shed == [(i, "queue_full") for i in range(6)]
+    assert proc.dropped[WorkKind.gossip_attestation] == 6
+    assert [it.payload for it in proc.queues[WorkKind.gossip_attestation]] == [
+        6, 7, 8, 9
+    ]
+    assert _shed_delta(before, "gossip_attestation", "queue_full") == 6
+    # non-batchable kinds keep drop-incoming semantics
+    proc.max_lengths[WorkKind.gossip_block] = 1
+    assert proc.submit(WorkItem(WorkKind.gossip_block, run=lambda: None))
+    assert not proc.submit(WorkItem(WorkKind.gossip_block, run=lambda: None))
+    assert proc.dropped[WorkKind.gossip_block] == 1
+
+
+def test_expired_work_shed_at_pop_not_run():
+    """Items that age out WHILE QUEUED are shed at pop: valid at submit,
+    expired by the time the pump reaches them."""
+    clock = ManualSlotClock(0, 1)
+    clock.set_slot(50)
+    proc = BeaconProcessor(
+        BeaconProcessorConfig(max_attestation_batch=64),
+        admission=AdmissionController(clock),
+    )
+    before = _shed_counts()
+    ran, shed = [], []
+    for i in range(6):
+        assert proc.submit(WorkItem(
+            kind=WorkKind.gossip_attestation, payload=i,
+            run_batch=lambda xs: ran.extend(xs),
+            # everything is in-window at submit; items 0/2/4 age out when
+            # the clock crosses slot 50
+            deadline_slot=50 if i % 2 == 0 else 50 + 32,
+            on_shed=lambda reason, i=i: shed.append((i, reason)),
+        ))
+    clock.set_slot(51)
+    proc.run_until_idle()
+    assert sorted(ran) == [1, 3, 5]
+    assert shed == [(0, "expired"), (2, "expired"), (4, "expired")]
+    assert proc.expired[WorkKind.gossip_attestation] == 3
+    assert proc.dropped[WorkKind.gossip_attestation] == 0  # expired != dropped
+    assert _shed_delta(before, "gossip_attestation", "expired") == 3
+    assert proc.stats()["expired"] == {"gossip_attestation": 3}
+
+
+def test_admission_rejects_bulk_under_pressure():
+    proc = BeaconProcessor(admission=AdmissionController(None))
+    proc.max_lengths[WorkKind.backfill_segment] = 4
+    before = _shed_counts()
+    results = [
+        proc.submit(WorkItem(WorkKind.backfill_segment, run=lambda: None))
+        for _ in range(4)
+    ]
+    assert results == [True, True, False, False]   # refused at 50% watermark
+    assert proc.shed_admission[WorkKind.backfill_segment] == 2
+    assert proc.dropped[WorkKind.backfill_segment] == 0
+    assert _shed_delta(before, "backfill_segment", "admission") == 2
+    assert proc.qos_totals() == {"shed": 2, "expired": 0}
+
+
+# ------------------------------------------------- the overload acceptance
+
+
+def test_overload_flood_with_device_stall():
+    """Flood at 4x the attestation queue bound while the device backend is
+    stalled: blocks still process (priority + host path), attestations shed
+    oldest-first with every loss accounted in qos_shed_total, expired work
+    is counted as expired, and after the device recovers the pipeline
+    verifies again with the inflight gauge back at zero."""
+    from lighthouse_tpu.chain.beacon_processor import _INFLIGHT
+    from lighthouse_tpu.loadgen.faults import StallingBackend
+
+    clock = ManualSlotClock(0, 1)
+    clock.set_slot(10)
+    proc = BeaconProcessor(
+        BeaconProcessorConfig(max_attestation_batch=4, max_inflight=2),
+        admission=AdmissionController(clock),
+    )
+    CAP = 8
+    proc.max_lengths[WorkKind.gossip_attestation] = CAP
+    proc.max_lengths[WorkKind.gossip_block] = 4
+    device = StallingBackend(wait_secs=0.02)
+    device.stall()
+    before = _shed_counts()
+    verified, shed, blocks_done = [], [], []
+
+    def run_batch(payloads):
+        handle = device.verify_signature_sets_async(payloads, None)
+        return handle, lambda ok: verified.extend(payloads)
+
+    # flood: 4x the queue bound in one burst
+    for i in range(4 * CAP):
+        assert proc.submit(WorkItem(
+            kind=WorkKind.gossip_attestation, payload=i,
+            run_batch=run_batch,
+            deadline_slot=10 + ATTESTATION_PROPAGATION_SLOT_RANGE,
+            on_shed=lambda reason, i=i: shed.append((i, reason)),
+        ))
+    # gossip blocks arrive mid-flood and must still process
+    for b in range(4):
+        assert proc.submit(WorkItem(
+            kind=WorkKind.gossip_block,
+            run=lambda b=b: blocks_done.append(b),
+        ))
+    # oldest-first: the first 24 submits were displaced, in submit order
+    assert shed == [(i, "queue_full") for i in range(3 * CAP)]
+    assert proc.dropped[WorkKind.gossip_attestation] == 3 * CAP
+    assert _shed_delta(before, "gossip_attestation", "queue_full") == 3 * CAP
+
+    # stale replays (already past their window) are refused at submit as
+    # expired — they must NOT displace the live survivors via oldest-first
+    for i in range(2):
+        assert not proc.submit(WorkItem(
+            kind=WorkKind.gossip_attestation, payload=1000 + i,
+            run_batch=run_batch, deadline_slot=9,   # past at slot 10
+            on_shed=lambda reason, i=i: shed.append((1000 + i, reason)),
+        ))
+    assert proc.dropped[WorkKind.gossip_attestation] == 3 * CAP  # unchanged
+    assert len(proc.queues[WorkKind.gossip_attestation]) == CAP  # survivors
+
+    # drain with the device STALLED: every device batch fails fast (bounded
+    # wait, DeviceStallError) — the pump must not deadlock and blocks must
+    # complete regardless
+    proc.run_until_idle()
+    assert blocks_done == [0, 1, 2, 3]
+    assert verified == []                      # stalled batches were lost
+    assert proc.expired[WorkKind.gossip_attestation] == 2
+    assert ((1000, "expired") in shed) and ((1001, "expired") in shed)
+    assert _shed_delta(before, "gossip_attestation", "expired") == 2
+    assert proc.queues_empty()
+    assert _INFLIGHT.value == 0                # no inflight gauge leak
+
+    # device recovers: the same pipeline verifies again
+    device.release()
+    proc.submit(WorkItem(
+        kind=WorkKind.gossip_attestation, payload="recovered",
+        run_batch=run_batch,
+        deadline_slot=10 + ATTESTATION_PROPAGATION_SLOT_RANGE,
+    ))
+    proc.run_until_idle()
+    assert verified == ["recovered"]
+    assert _INFLIGHT.value == 0
+    # every lost item is accounted exactly once: 24 flood displacements
+    # (queue_full) + the 2 stale replays (expired at submit), 0 admission
+    assert proc.dropped[WorkKind.gossip_attestation] == 3 * CAP
+    # qos_totals "shed" mirrors the Prometheus family total: all reasons
+    assert proc.qos_totals() == {"shed": 3 * CAP + 2, "expired": 2}
+    assert _shed_delta(before, "gossip_attestation", "queue_full") == 3 * CAP
+
+
+def test_threaded_pump_survives_stall_without_deadlock():
+    """Same story under the real worker threads: flood + stall, then stop.
+    The pump must come back idle with nothing inflight."""
+    from lighthouse_tpu.loadgen.faults import StallingBackend
+
+    proc = BeaconProcessor(
+        BeaconProcessorConfig(max_attestation_batch=8, max_inflight=2,
+                              num_workers=2),
+    )
+    proc.max_lengths[WorkKind.gossip_attestation] = 16
+    device = StallingBackend(wait_secs=0.01)
+    device.stall()
+    done = threading.Event()
+    blocks = []
+
+    def run_batch(payloads):
+        handle = device.verify_signature_sets_async(payloads, None)
+        return handle, lambda ok: None
+
+    proc.start()
+    try:
+        for i in range(64):
+            proc.submit(WorkItem(kind=WorkKind.gossip_attestation,
+                                 payload=i, run_batch=run_batch))
+        proc.submit(WorkItem(WorkKind.gossip_block,
+                             run=lambda: (blocks.append(1), done.set())))
+        assert done.wait(timeout=5), "block starved under flood+stall"
+        device.release()
+        deadline = threading.Event()
+        for _ in range(200):
+            if proc.queues_empty():
+                break
+            deadline.wait(0.025)
+        assert proc.queues_empty(), "pump wedged after stall"
+    finally:
+        proc.stop()
+
+
+# ------------------------------------------------------ hybrid breaker e2e
+
+
+def test_hybrid_circuit_breaker_transitions():
+    """The hybrid router's breaker: consecutive stalled verifies open the
+    circuit (routes host with reason circuit_open, gauge=1), the cooldown
+    admits a half-open probe (gauge=2), and a healthy probe closes it
+    (gauge=0) — the closed→open→half_open→closed cycle of the acceptance
+    criteria, observable via bls_device_circuit_state."""
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.crypto.bls.hybrid import _CIRCUIT_STATE, HybridBackend
+    from lighthouse_tpu.crypto.bls381 import curve as cv
+
+    b = HybridBackend(probe_startup_wait_secs=0.1, probe_retry_secs=3600,
+                      p99_budget_ms=50.0, breaker_reset_secs=5.0)
+    b._probe_started.set()
+    b._probe_done.set()
+    b._state = "up"
+
+    class InstantDevice:
+        calls = 0
+
+        def verify_signature_sets(self, sets, rands):
+            self.calls += 1
+            return True
+
+    b._device = InstantDevice()
+    now = [0.0]
+    b._breaker._time = lambda: now[0]
+    sk = 0x55
+    pk = bls.PublicKey(cv.g1_mul(cv.G1_GEN, sk))
+    msg = b"\x09" * 32
+    from lighthouse_tpu.crypto.bls import api as bls_api
+
+    sig = bls.Signature(cv.g2_mul(bls_api.hash_to_g2_point(msg), sk))
+    sets = [bls.SignatureSet(sig, [pk], msg)]
+    bucket = b._bucket(sets)
+    with b._lock:
+        b._warm_buckets.add(bucket)
+
+    # three stalled (over stall-budget) verifies trip the breaker
+    for _ in range(3):
+        b._record_device_ok(bucket, dt=10.0)   # 10s >> 4x50ms stall budget
+    assert b._breaker.state() == OPEN
+    assert _CIRCUIT_STATE.value == 1
+    assert b._route(sets) == ("host", "circuit_open")
+    # verification still serves (host path) while the circuit is open
+    assert b.verify_signature_sets(sets, [1]) is True
+
+    # cooldown elapses: the next device-path verify is the half-open probe
+    now[0] += 5.1
+    calls_before = b._device.calls
+    assert b.verify_signature_sets(sets, [1]) is True
+    assert b._device.calls == calls_before + 1     # probe rode the device
+    assert b._breaker.state() == CLOSED            # healthy probe closed it
+    assert _CIRCUIT_STATE.value == 0
+    assert list(b._breaker.transitions) == [CLOSED, OPEN, HALF_OPEN, CLOSED]
+
+
+# --------------------------------------------------------- edges: api, net
+
+
+@pytest.fixture(scope="module")
+def mini_chain():
+    from lighthouse_tpu.chain.beacon_chain import BeaconChain
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.testing.harness import StateHarness, clone_state
+    from lighthouse_tpu.types.spec import minimal_spec
+
+    bls.set_backend("fake")
+    spec = minimal_spec()
+    harness = StateHarness.new(spec, 16)
+    return BeaconChain(spec, clone_state(harness.state, spec))
+
+
+def test_http_api_rate_limit_429(mini_chain):
+    from lighthouse_tpu.api.http_api import serve
+
+    server, _t, port = serve(mini_chain, rate_limit=1.0)  # burst 2
+    try:
+        url = f"http://127.0.0.1:{port}"
+        for _ in range(2):
+            with urllib.request.urlopen(f"{url}/eth/v1/node/version") as r:
+                assert r.status == 200
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{url}/eth/v1/node/version")
+        assert ei.value.code == 429
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        assert json.loads(ei.value.read())["code"] == 429
+        # liveness stays exempt even with the bucket drained
+        with urllib.request.urlopen(f"{url}/eth/v1/node/health") as r:
+            assert r.status == 200
+    finally:
+        server.shutdown()
+
+
+def test_gossip_ingest_rate_limit(mini_chain):
+    from types import SimpleNamespace
+
+    from lighthouse_tpu.network.node import NetworkNode
+    from lighthouse_tpu.state_transition.slot import types_for_slot
+
+    node = NetworkNode(mini_chain, "qos-rl-node", subnets=1,
+                       ingest_rate=0.0)   # zero-rate bucket: deny all
+    try:
+        types = types_for_slot(mini_chain.spec, 0)
+        att = types.Attestation.make(
+            aggregation_bits=[True],
+            data=types.AttestationData.make(
+                slot=0, index=0, beacon_block_root=b"\x00" * 32,
+                source=types.Checkpoint.make(epoch=0, root=b"\x00" * 32),
+                target=types.Checkpoint.make(epoch=0, root=b"\x00" * 32),
+            ),
+            signature=b"\x00" * 96,
+        )
+        msg = SimpleNamespace(
+            decompressed=types.Attestation.serialize(att),
+            message_id=b"q" * 20, source_peer="peer",
+        )
+        handler = node._mk_attestation_handler()
+        assert handler(msg) is None           # over quota: gossip IGNORE
+        assert node.ingest_limiter.denied("gossip_attestation") == 1
+        assert not node.processor.queues[WorkKind.gossip_attestation]
+    finally:
+        node.close()
+
+
+def test_inprocess_router_ingest_limiter():
+    from lighthouse_tpu.network.gossip import (
+        InProcessGossipRouter,
+        attestation_subnet_topic,
+        ingest_scope,
+        topic_name,
+    )
+
+    fd = b"\x00" * 4
+    att_topic = attestation_subnet_topic(fd, 3)
+    assert ingest_scope(att_topic) == "gossip_attestation"
+    assert ingest_scope(topic_name(fd, "beacon_block")) == "gossip_other"
+    now = [0.0]
+    lim = RateLimiter(time_fn=lambda: now[0]).configure(
+        "gossip_attestation", 1.0, burst=2.0
+    )
+    router = InProcessGossipRouter(ingest_limiter=lim)
+    got = []
+    router.subscribe("n1", att_topic, lambda msg: got.append(msg) or True)
+    assert router.publish("n0", att_topic, b"a" * 8) == 1
+    # duplicate publishes are dedup no-ops and must NOT drain tokens
+    assert router.publish("n0", att_topic, b"a" * 8) == 0
+    assert router.rate_limited == 0
+    assert router.publish("n0", att_topic, b"b" * 8) == 1
+    assert router.publish("n0", att_topic, b"c" * 8) == 0  # over quota
+    assert router.rate_limited == 1 and len(got) == 2
+    # a rate-limited message stays un-seen: it can retry once tokens refill
+    now[0] += 1.0
+    assert router.publish("n0", att_topic, b"c" * 8) == 1
+    # unconfigured scopes (blocks) pass even with the bucket drained
+    router.subscribe("n1", topic_name(fd, "beacon_block"),
+                     lambda msg: True)
+    assert router.publish("n0", topic_name(fd, "beacon_block"), b"d" * 8) == 1
+
+
+def test_monitoring_includes_qos_totals(mini_chain):
+    from lighthouse_tpu.utils.monitoring import MonitoringService
+
+    proc = BeaconProcessor()
+    proc.dropped[WorkKind.gossip_attestation] = 7
+    proc.shed_admission[WorkKind.backfill_segment] = 2
+    proc.expired[WorkKind.gossip_aggregate] = 3
+
+    class FakeNet:
+        processor = proc
+
+    mini_chain._network_node = FakeNet()
+    try:
+        posts = []
+        svc = MonitoringService("http://x", chain=mini_chain,
+                                post_fn=posts.append)
+        assert svc.tick()
+        bn_rec = next(r for r in posts[0] if r["process"] == "beaconnode")
+        # matches sum over the qos_shed_total family: all loss reasons
+        assert bn_rec["qos_shed_total"] == 12    # dropped+admission+expired
+        assert bn_rec["qos_expired_total"] == 3
+    finally:
+        mini_chain._network_node = None
