@@ -166,7 +166,8 @@ def warmup_buckets() -> tuple:
     return plan.warmup_buckets if plan is not None else DEFAULT_WARMUP_BUCKETS
 
 
-def start_warmup(buckets=None, warm_fn=None) -> threading.Thread:
+def start_warmup(buckets=None, warm_fn=None,
+                 supervisor=None) -> threading.Thread:
     """Precompile the warmup buckets in a background daemon thread.
 
     Called from node bring-up (cli.cmd_bn) when the device-backed BLS
@@ -181,34 +182,44 @@ def start_warmup(buckets=None, warm_fn=None) -> threading.Thread:
     log = get_logger("autotune")
     plan_buckets = tuple(buckets) if buckets is not None else warmup_buckets()
 
+    def attempt():
+        # raises on failure — the CALLER owns the retry policy (see below)
+        if warm_fn is not None:
+            fn = warm_fn
+        else:
+            from ..crypto.bls import api as bls_api
+
+            backend = bls_api.get_backend()
+            if hasattr(backend, "warm_bucket"):
+                fn = backend.warm_bucket
+            else:
+                import jax
+
+                jax.devices()  # may block on a dead tunnel: daemon thread
+                from ..crypto.jaxbls.backend import warm_stages as fn
+        import time as _time
+
+        for n_sets, n_pks in plan_buckets:
+            t0 = _time.time()
+            ok = fn(n_sets, n_pks)
+            if ok is False:  # warm_bucket: device down/failed (None =
+                log.warn(    # warm_stages, which raises on failure)
+                    "warmup bucket skipped (device unavailable or "
+                    "warm failed)", n_sets=n_sets, n_pks=n_pks,
+                )
+            else:
+                log.info("warmup bucket done", n_sets=n_sets,
+                         n_pks=n_pks, secs=round(_time.time() - t0, 1))
+
+    if supervisor is not None:
+        # node bring-up path: a warmup crash (tunnel hiccup mid-compile)
+        # retries with backoff instead of degrading straight to
+        # cold-compile-on-first-dispatch (utils/supervisor.py)
+        return supervisor.spawn(attempt, "autotune_warmup")
+
     def run():
         try:
-            if warm_fn is not None:
-                fn = warm_fn
-            else:
-                from ..crypto.bls import api as bls_api
-
-                backend = bls_api.get_backend()
-                if hasattr(backend, "warm_bucket"):
-                    fn = backend.warm_bucket
-                else:
-                    import jax
-
-                    jax.devices()  # may block on a dead tunnel: daemon thread
-                    from ..crypto.jaxbls.backend import warm_stages as fn
-            import time as _time
-
-            for n_sets, n_pks in plan_buckets:
-                t0 = _time.time()
-                ok = fn(n_sets, n_pks)
-                if ok is False:  # warm_bucket: device down/failed (None =
-                    log.warn(    # warm_stages, which raises on failure)
-                        "warmup bucket skipped (device unavailable or "
-                        "warm failed)", n_sets=n_sets, n_pks=n_pks,
-                    )
-                else:
-                    log.info("warmup bucket done", n_sets=n_sets,
-                             n_pks=n_pks, secs=round(_time.time() - t0, 1))
+            attempt()
         except Exception as e:
             log.warn("startup warmup abandoned (first dispatches will "
                      "pay the compile)", error=f"{type(e).__name__}: {e}")

@@ -285,6 +285,9 @@ class BeaconChain:
             "state_root_by_block": self.state_root_by_block,
         }
         self.store.put_chain_item(self.PERSIST_HEAD_KEY, pickle.dumps(payload))
+        # durability barrier: a persist that only reached the page cache is
+        # not a persist (store flush applies the engine's fsync policy)
+        self.store.flush()
 
     @classmethod
     def resume(cls, spec, store, **kw):
@@ -296,21 +299,26 @@ class BeaconChain:
         raw = store.get_chain_item(cls.PERSIST_HEAD_KEY)
         if raw is None:
             raise BlockError("no persisted chain in store")
-        meta = pickle.loads(raw)
+        try:
+            meta = pickle.loads(raw)
+        except Exception as e:  # noqa: BLE001 — torn/corrupt persist record
+            raise BlockError(f"persisted chain record unreadable: {e}") from e
         # anchor: highest stored block at/below finalization whose state we
         # still have — walk back from head via parents
         block_slots = meta["block_slots"]
         state_by_block = meta["state_root_by_block"]
-        head_root = meta["head_root"]
 
         # find the finalized anchor block+state
         fin_root = meta["finalized_root"]
         if fin_root == b"\x00" * 32 or fin_root not in block_slots:
             fin_root = meta["anchor_root"]
-        fin_slot = block_slots[fin_root]
+        fin_slot = block_slots.get(fin_root)
+        fin_state_root = state_by_block.get(fin_root)
+        if fin_slot is None or fin_state_root is None:
+            raise BlockError("persisted anchor unknown to the chain indices")
         types = types_for_slot(spec, fin_slot)
         anchor_block = store.get_block(fin_root, types)
-        anchor_state = store.get_state(state_by_block[fin_root], types)
+        anchor_state = store.get_state(fin_state_root, types)
         if anchor_state is None or anchor_block is None:
             raise BlockError("persisted anchor incomplete")
 
@@ -337,7 +345,38 @@ class BeaconChain:
             chain.state_cache[state_by_block[root]] = st
             chain.state_root_by_block[root] = state_by_block[root]
             chain.pubkey_cache.import_new_pubkeys(st)
+        chain._persisted_head = meta["head_root"]
         chain.recompute_head()
+        return chain
+
+    @classmethod
+    def from_store(cls, spec, store, **kw):
+        """Restart path over an existing datadir: `resume()` with corrupt-
+        head recovery made explicit. A persisted head whose block or state
+        the store no longer has (crash between fork-choice update and state
+        write) is simply absent from the replay, so fork choice lands on
+        the best surviving block — the fork_revert.rs outcome without a
+        separate revert pass. Raises BlockError when the persist record
+        itself is missing/unreadable or the finalized anchor is gone; the
+        caller (cli.cmd_bn) then falls back to its configured start anchor."""
+        from ..utils.logging import get_logger
+
+        log = get_logger("chain")
+        chain = cls.resume(spec, store, **kw)
+        persisted = getattr(chain, "_persisted_head", None)
+        if persisted is not None and chain.head_root != persisted:
+            log.warn(
+                "persisted head unavailable after crash; recovered to the "
+                "best surviving block",
+                persisted=persisted.hex()[:8],
+                recovered=chain.head_root.hex()[:8],
+            )
+        else:
+            log.info(
+                "chain resumed from persisted head",
+                head=chain.head_root.hex()[:8],
+                slot=chain.block_slots.get(chain.head_root),
+            )
         return chain
 
     def revert_to_fork_boundary(self, bad_root: bytes):

@@ -471,6 +471,34 @@ class BeaconProcessor:
             self._execute(single, batch, trace)
             n += 1
 
+    def drain(self, timeout: float = 5.0) -> bool:
+        """Graceful-shutdown drain: finish queued + in-flight work within
+        `timeout` seconds. With the worker pool running it waits for the
+        pump to empty the queues; without (synchronous/test mode) it pumps
+        inline. Returns True when everything drained — False means the
+        deadline hit with work still queued (the caller sheds it by
+        stopping; queued gossip items resolve via on_shed at GC, and the
+        deadline bounds how long SIGTERM can hang)."""
+        import time as _time
+
+        deadline = perf_counter() + max(0.0, timeout)
+        if self._threads:
+            self._wake.set()
+            while perf_counter() < deadline:
+                if self.queues_empty():
+                    return True
+                _time.sleep(0.005)
+            return self.queues_empty()
+        while perf_counter() < deadline:
+            single, batch, trace = self._next_work()
+            if single is None and batch is None:
+                self.drain_inflight()
+                if self.queues_empty():
+                    return True
+                continue
+            self._execute(single, batch, trace)
+        return self.queues_empty()
+
     def queues_empty(self) -> bool:
         with self._lock:
             return all(not q for q in self.queues.values()) and not self._inflight

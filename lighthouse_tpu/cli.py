@@ -105,9 +105,12 @@ def cmd_bn(args):
         # highest-traffic default buckets — the first node-path caller of
         # jaxbls warm_stages.
         from .autotune import runtime as _at_runtime
+        from .utils.supervisor import Supervisor as _Supervisor
 
-        _at_runtime.start_warmup()
-        log.info("autotune warmup started",
+        _at_runtime.start_warmup(
+            supervisor=_Supervisor(name="autotune", max_restarts=2)
+        )
+        log.info("autotune warmup started (supervised)",
                  buckets=str(list(_at_runtime.warmup_buckets())))
 
     if args.zero_ports:
@@ -115,8 +118,123 @@ def cmd_bn(args):
         args.metrics_port = 0
         args.p2p_port = 0
 
+    from .utils.task_executor import Lockfile, TaskExecutor
+
+    # store FIRST: a datadir holding a persisted chain can supply the whole
+    # start state (restart resume), making the genesis-source flags optional
+    store = None
+    lock = None
+    if args.datadir:
+        import os
+
+        os.makedirs(args.datadir, exist_ok=True)
+        # exclusive datadir ownership (common/lockfile): two nodes sharing a
+        # datadir is how operators get slashed
+        lock = Lockfile(f"{args.datadir}/beacon.lock")
+        lock.acquire()
+        if args.purge_db:
+            import glob as _glob
+
+            purged = 0
+            for pat in ("hot.db*", "cold.db*"):
+                for f in _glob.glob(os.path.join(args.datadir, pat)):
+                    os.remove(f)
+                    purged += 1
+            log.info("database purged", files=purged)
+        from .store.hot_cold import StoreConfig
+
+        store = HotColdDB(
+            spec,
+            hot=NativeKVStore(f"{args.datadir}/hot.db", fsync=args.fsync),
+            cold=NativeKVStore(f"{args.datadir}/cold.db", fsync=args.fsync),
+            config=StoreConfig(
+                slots_per_restore_point=args.slots_per_restore_point,
+                compact_on_migration=not args.no_compact_on_migration,
+            ),
+        )
+        if args.compact_db:
+            store.hot.compact()
+            store.cold.compact()
+            log.info("databases compacted")
+
+    def bail(code: int = 1) -> int:
+        # early-exit path between lock acquisition and the run loop: a
+        # validation error must not leave the datadir's beacon.lock held
+        # by a dead pid (or the store half-open)
+        if store is not None:
+            store.close()
+        if lock is not None:
+            lock.release()
+        return code
+
+    execution_layer = None
+    if args.engine:
+        from .chain.execution_layer import ExecutionLayer
+        from .execution.engine_api import EngineApiClient, MockExecutionLayer
+
+        if args.engine == "mock":
+            engine = MockExecutionLayer()
+        else:
+            if not args.jwt_secret:
+                print("error: --engine requires --jwt-secret", file=sys.stderr)
+                return bail()
+            secret = _read_jwt_secret(args.jwt_secret)
+            engine = EngineApiClient(
+                args.engine, secret, timeout=args.execution_timeout
+            )
+        fee = (
+            bytes.fromhex(args.fee_recipient[2:])
+            if args.fee_recipient
+            else b"\x00" * 20
+        )
+        execution_layer = ExecutionLayer(engine, spec, default_fee_recipient=fee)
+        log.info("execution engine connected", url=args.engine)
+
+    from .chain.beacon_chain import BlockError, ChainConfig
+
+    chain_cfg = ChainConfig(
+        reorg_threshold_percent=args.reorg_threshold,
+        import_max_skip_slots=args.max_skip_slots,
+        epochs_per_migration=args.epochs_per_migration,
+        slasher_history_epochs=args.slasher_history_length,
+    )
+
+    # restart resume: a datadir with a persisted head restarts from it
+    # (builder.rs resume path); a corrupt/incomplete persist record falls
+    # back to the configured start anchor below
+    chain = None
+    if store is not None and store.get_chain_item(
+        BeaconChain.PERSIST_HEAD_KEY
+    ) is not None:
+        try:
+            chain = BeaconChain.from_store(
+                spec, store, execution_layer=execution_layer, config=chain_cfg
+            )
+        except BlockError as e:
+            log.warn(
+                "persisted chain unusable; starting from the configured "
+                "anchor", error=str(e),
+            )
+    if chain is not None:
+        # resume built the chain on a manual clock (wall time was unknown
+        # until the anchor state supplied genesis_time): swap in the real
+        # clock and re-tick fork choice to the current slot
+        clock = SystemTimeSlotClock(
+            int(chain.head_state().genesis_time), spec.seconds_per_slot
+        )
+        chain.slot_clock = clock
+        chain.recompute_head()
+        log.info(
+            "restart resume complete",
+            head=chain.head_root.hex()[:8],
+            head_slot=chain.block_slots.get(chain.head_root),
+            wall_slot=clock.now(),
+        )
     anchor_block = None
-    if args.interop_validators:
+    state = None
+    if chain is not None:
+        pass          # resumed from the datadir; no start anchor needed
+    elif args.interop_validators:
         keypairs = bls.interop_keypairs(args.interop_validators)
         genesis_time = args.genesis_time or int(time.time())
         state = interop_genesis_state(keypairs, genesis_time, spec)
@@ -133,7 +251,7 @@ def cmd_bn(args):
         if not args.checkpoint_block:
             print("error: --checkpoint-state requires --checkpoint-block",
                   file=sys.stderr)
-            return 1
+            return bail()
         raw = open(args.checkpoint_state, "rb").read()
         # every fork's BeaconState starts genesis_time(8) ||
         # genesis_validators_root(32) || slot(8): read the slot to pick the
@@ -175,77 +293,22 @@ def cmd_bn(args):
         else:
             print("error: checkpoint-sync pair never converged",
                   file=sys.stderr)
-            return 1
+            return bail()
         log.info("checkpoint sync: anchor downloaded", slot=slot)
     else:
         print(
             "error: provide --interop-validators N, --genesis-state FILE, "
             "--checkpoint-state FILE --checkpoint-block FILE, or "
-            "--checkpoint-sync-url URL",
+            "--checkpoint-sync-url URL (or a --datadir holding a "
+            "persisted chain to resume)",
             file=sys.stderr,
         )
-        return 1
+        return bail()
 
-    from .utils.task_executor import Lockfile, TaskExecutor
-
-    store = None
-    lock = None
-    if args.datadir:
-        import os
-
-        os.makedirs(args.datadir, exist_ok=True)
-        # exclusive datadir ownership (common/lockfile): two nodes sharing a
-        # datadir is how operators get slashed
-        lock = Lockfile(f"{args.datadir}/beacon.lock")
-        lock.acquire()
-        if args.purge_db:
-            import glob as _glob
-
-            purged = 0
-            for pat in ("hot.db*", "cold.db*"):
-                for f in _glob.glob(os.path.join(args.datadir, pat)):
-                    os.remove(f)
-                    purged += 1
-            log.info("database purged", files=purged)
-        from .store.hot_cold import StoreConfig
-
-        store = HotColdDB(
-            spec,
-            hot=NativeKVStore(f"{args.datadir}/hot.db"),
-            cold=NativeKVStore(f"{args.datadir}/cold.db"),
-            config=StoreConfig(
-                slots_per_restore_point=args.slots_per_restore_point,
-                compact_on_migration=not args.no_compact_on_migration,
-            ),
-        )
-        if args.compact_db:
-            store.hot.compact()
-            store.cold.compact()
-            log.info("databases compacted")
-    execution_layer = None
-    if args.engine:
-        from .chain.execution_layer import ExecutionLayer
-        from .execution.engine_api import EngineApiClient, MockExecutionLayer
-
-        if args.engine == "mock":
-            engine = MockExecutionLayer()
-        else:
-            if not args.jwt_secret:
-                print("error: --engine requires --jwt-secret", file=sys.stderr)
-                return 1
-            secret = _read_jwt_secret(args.jwt_secret)
-            engine = EngineApiClient(
-                args.engine, secret, timeout=args.execution_timeout
-            )
-        fee = (
-            bytes.fromhex(args.fee_recipient[2:])
-            if args.fee_recipient
-            else b"\x00" * 20
-        )
-        execution_layer = ExecutionLayer(engine, spec, default_fee_recipient=fee)
-        log.info("execution engine connected", url=args.engine)
-
-    if args.wss_checkpoint:
+    if args.wss_checkpoint and chain is not None:
+        log.info("restart resume: --wss-checkpoint was verified when this "
+                 "datadir first synced; not re-checked")
+    elif args.wss_checkpoint:
         # weak-subjectivity pin: the start anchor must BE the operator's
         # checkpoint (checkpoint.rs wss verification role)
         try:
@@ -255,7 +318,7 @@ def cmd_bn(args):
         except ValueError:
             print("error: --wss-checkpoint must be 0xROOT:EPOCH",
                   file=sys.stderr)
-            return 1
+            return bail()
         if anchor_block is None:
             # a genesis/interop start builds history itself; enforcing a
             # wss pin requires an anchor to compare against — refuse to
@@ -266,7 +329,7 @@ def cmd_bn(args):
                 "starts have no anchor to verify against",
                 file=sys.stderr,
             )
-            return 1
+            return bail()
         anchor_root = type(anchor_block.message).hash_tree_root(
             anchor_block.message
         )
@@ -282,22 +345,16 @@ def cmd_bn(args):
                 f"match --wss-checkpoint {wss_root.hex()}:{wss_epoch}",
                 file=sys.stderr,
             )
-            return 1
+            return bail()
         log.info("weak-subjectivity checkpoint verified", epoch=wss_epoch)
 
-    from .chain.beacon_chain import ChainConfig
-
-    clock = SystemTimeSlotClock(state.genesis_time, spec.seconds_per_slot)
-    chain = BeaconChain(
-        spec, state, store=store, slot_clock=clock,
-        execution_layer=execution_layer, anchor_block=anchor_block,
-        config=ChainConfig(
-            reorg_threshold_percent=args.reorg_threshold,
-            import_max_skip_slots=args.max_skip_slots,
-            epochs_per_migration=args.epochs_per_migration,
-            slasher_history_epochs=args.slasher_history_length,
-        ),
-    )
+    if chain is None:
+        clock = SystemTimeSlotClock(state.genesis_time, spec.seconds_per_slot)
+        chain = BeaconChain(
+            spec, state, store=store, slot_clock=clock,
+            execution_layer=execution_layer, anchor_block=anchor_block,
+            config=chain_cfg,
+        )
     chain.shuffling_cache.capacity = args.shuffling_cache_size
     chain.state_cache.capacity = args.state_cache_size
     graffiti_text = args.graffiti
@@ -308,7 +365,7 @@ def cmd_bn(args):
         g = graffiti_text.encode()
         if len(g) > 32:
             print("error: --graffiti exceeds 32 bytes utf-8", file=sys.stderr)
-            return 1
+            return bail()
         chain.graffiti = g.ljust(32, b"\x00")
     def register_monitor_tokens(raw, source):
         for tok in raw.replace(",", " ").split():
@@ -327,14 +384,14 @@ def cmd_bn(args):
         else:
             if not register_monitor_tokens(args.monitor_validators,
                                            "--monitor-validators"):
-                return 1
+                return bail()
             log.info("validator monitor enabled",
                      watched=len(chain.monitor.watched))
     if getattr(args, "validator_monitor_file", None):
         with open(args.validator_monitor_file) as f:
             if not register_monitor_tokens(f.read(),
                                            "--validator-monitor-file"):
-                return 1
+                return bail()
         log.info("validator monitor file loaded",
                  watched=len(chain.monitor.watched))
 
@@ -494,9 +551,35 @@ def cmd_bn(args):
 
     executor = TaskExecutor(name="bn", log=lambda m: log.info(m))
 
+    # graceful termination: SIGTERM takes the same drain -> persist ->
+    # flush path as Ctrl-C (beacon_chain.rs persist-on-shutdown analog)
+    import signal as _signal
+
+    try:
+        _signal.signal(
+            _signal.SIGTERM, lambda _s, _f: executor.shutdown("SIGTERM")
+        )
+    except ValueError:
+        pass  # not the main thread (embedded/test use): signals stay default
+
+    # persist the chain head whenever finalization advances, so a hard
+    # crash loses at most the work since the last finalized checkpoint
+    last_persisted_fin = [chain.fork_choice.store.finalized_checkpoint[0]]
+
+    def persist_on_finalization():
+        if store is None:
+            return
+        fin_epoch = chain.fork_choice.store.finalized_checkpoint[0]
+        if fin_epoch > last_persisted_fin[0]:
+            last_persisted_fin[0] = fin_epoch
+            chain.persist()
+            log.info("chain persisted on finalization",
+                     finalized_epoch=fin_epoch)
+
     def slot_timer(exit_signal):
         while not exit_signal.wait(clock.duration_to_next_slot()):
             chain.per_slot_task()
+            persist_on_finalization()
             head_slot = chain.head_state().slot
             HEAD_SLOT.set(head_slot)
             log.info("slot", slot=clock.now(), head=chain.head_root.hex()[:8])
@@ -546,10 +629,13 @@ def cmd_bn(args):
     except KeyboardInterrupt:
         executor.shutdown("SIGINT")
     finally:
+        # graceful drain: stop taking new work, finish what's queued
+        # (bounded), THEN persist — so the persisted head reflects every
+        # import the drain completed (service.rs shutdown ordering)
         server.shutdown()
         mserver.shutdown()
         if net is not None:
-            net.close()
+            net.close(drain_timeout=args.drain_timeout)
         if tracer is not None:
             try:
                 n_events = tracer.write_chrome_trace(tracer.out_path)
@@ -558,7 +644,12 @@ def cmd_bn(args):
             except OSError as e:
                 log.warn("pipeline trace write failed", error=str(e))
         if store is not None:
+            chain.persist()
             op_pool.persist(store, _tfs_pool(spec, 0))
+            store.close()
+            log.info("chain persisted; store flushed and closed",
+                     head=chain.head_root.hex()[:8],
+                     head_slot=chain.block_slots.get(chain.head_root))
         if lock is not None:
             lock.release()
     return 1 if executor.panicked else 0
@@ -814,6 +905,22 @@ def cmd_loadtest(args):
     from .loadgen.driver import drive_from_args
 
     return drive_from_args(args)
+
+
+# ------------------------------------------------------------------ doctor
+
+
+def cmd_doctor(args):
+    """`bn doctor`: offline fsck of a beacon datadir — log CRC walk, torn
+    tails, stray compaction tmps, schema version, persisted-head anchor
+    completeness — with `--repair` for the mechanically fixable parts
+    (store/doctor.py). Never opens the DB through an engine, so a plain
+    check mutates nothing."""
+    from .store.doctor import fsck_datadir
+
+    report = fsck_datadir(args.datadir, repair=args.repair)
+    print(json.dumps(report, indent=1))
+    return 0 if report["ok"] else 1
 
 
 # ------------------------------------------------------------------ autotune
@@ -1246,6 +1353,17 @@ def build_parser() -> argparse.ArgumentParser:
     bn.add_argument("--slots-per-restore-point", type=int, default=2048,
                     help="freezer restore-point cadence (storage/replay "
                          "trade-off)")
+    bn.add_argument("--fsync", default="batch",
+                    choices=["always", "batch", "never"],
+                    help="store durability policy: fsync every record "
+                         "(always), every 64 records + at persist points "
+                         "(batch, the default), or leave writes to the OS "
+                         "page cache (never; crash-consistent but may "
+                         "lose acknowledged work on power loss)")
+    bn.add_argument("--drain-timeout", type=float, default=5.0,
+                    help="seconds to let the beacon processor finish "
+                         "queued work on shutdown before shedding it "
+                         "(graceful SIGTERM/SIGINT drain)")
     bn.add_argument("--no-compact-on-migration", action="store_true",
                     help="skip store compaction during finalization "
                          "migration")
@@ -1356,10 +1474,10 @@ def build_parser() -> argparse.ArgumentParser:
                          "traces every stage")
     bn.set_defaults(fn=cmd_bn)
 
-    # `bn loadtest`: the QoS load/chaos driver (lighthouse_tpu/loadgen).
-    # Optional sub-subcommand — plain `bn` still runs the node.
+    # `bn loadtest` / `bn doctor`: operator sub-subcommands (loadgen
+    # driver; datadir fsck). Optional — plain `bn` still runs the node.
     bnsub = bn.add_subparsers(dest="bn_command", required=False,
-                              metavar="{loadtest}")
+                              metavar="{loadtest,doctor}")
     bnlt = bnsub.add_parser(
         "loadtest",
         help="run a deterministic loadgen scenario (mainnet-shaped gossip "
@@ -1373,6 +1491,21 @@ def build_parser() -> argparse.ArgumentParser:
 
     add_loadtest_args(bnlt)
     bnlt.set_defaults(fn=cmd_loadtest)
+
+    bndoc = bnsub.add_parser(
+        "doctor",
+        help="fsck a beacon datadir: log integrity (CRC walk), torn tails, "
+             "stray compaction tmps, schema version, persisted-head "
+             "anchor completeness; --repair truncates corrupt tails and "
+             "sweeps tmps",
+    )
+    bndoc.add_argument("--datadir", required=True,
+                       help="beacon datadir to check (hot.db / cold.db)")
+    bndoc.add_argument("--repair", action="store_true",
+                       help="fix what is fixable: truncate the corrupt log "
+                            "tail back to the last valid record and delete "
+                            "stray compaction tmp files")
+    bndoc.set_defaults(fn=cmd_doctor)
 
     vc = sub.add_parser("vc", help="run a validator client")
     _add_spec_arg(vc)

@@ -11,9 +11,19 @@ multiple of their bounds, and the runner emits a machine-readable report of
 what the QoS layer did about it: processed / shed / expired counts, circuit
 breaker transitions, whether blocks still landed in their slot.
 
+Since PR 5 the fault board also covers STORAGE: `storefaults.FaultyKVStore`
+(torn writes at byte granularity, CRC flips, ENOSPC, crash points, slow IO
+over the real CRC-framed log format) and the `crash_restart` scenario,
+which kills the node mid-load at an injected torn write, restarts it from
+the same datadir, and asserts resume-from-persisted-head plus the extended
+conservation invariant published == processed + dropped + expired +
+lost_to_crash (docs/RECOVERY.md).
+
 Entry points: `bn loadtest [--smoke]` and `scripts/loadgen.py --smoke`
-(CPU-only, ~seconds, gitignored JSON report). Everything is driven by a
-`ManualSlotClock`, so the same seed reproduces the same report bit for bit.
+(CPU-only, ~seconds, gitignored JSON report); `--smoke` with an explicit
+`--scenario` runs that scenario shrunk to smoke scale. Everything is
+driven by a `ManualSlotClock`, so the same seed reproduces the same
+report bit for bit.
 """
 
 # Lazy re-exports (PEP 562): the CLI parser imports `loadgen.driver` for
@@ -23,10 +33,15 @@ _EXPORTS = {
     "DeviceStallError": ".faults",
     "FaultInjector": ".faults",
     "StallingBackend": ".faults",
+    "FaultPlan": ".storefaults",
+    "FaultyKVStore": ".storefaults",
+    "SimulatedCrash": ".storefaults",
+    "StoreCrashed": ".storefaults",
     "run_scenario": ".runner",
     "SCENARIOS": ".scenarios",
     "Scenario": ".scenarios",
     "get_scenario": ".scenarios",
+    "smoke_variant": ".scenarios",
     "traffic_schedule": ".scenarios",
 }
 

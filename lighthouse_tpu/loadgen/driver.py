@@ -28,34 +28,34 @@ def default_report_path(smoke: bool) -> str:
 
 def drive(*, scenario=None, smoke=False, slots=None, validators=None,
           seed=None, flood_factor=None, out=None, quiet=False,
-          stdout=None, stderr=None) -> int:
+          datadir=None, stdout=None, stderr=None) -> int:
     """Run one scenario and print the one-line JSON summary. Returns a
-    process exit code. `--smoke` IS the smoke scenario — combining it with
-    a different --scenario is a contradiction, not a filename choice."""
+    process exit code. `--smoke` alone runs the 'smoke' scenario; combined
+    with an explicit --scenario it is a SIZE modifier — the named scenario
+    shrunk to smoke scale (same faults and mix, clamped validators/slots),
+    e.g. `bn loadtest --scenario crash_restart --smoke`."""
     from .runner import run_scenario
-    from .scenarios import get_scenario
+    from .scenarios import get_scenario, smoke_variant
 
     stdout = stdout or sys.stdout
     stderr = stderr or sys.stderr
-    if smoke and scenario not in (None, "smoke"):
-        print(f"error: --smoke runs the 'smoke' scenario; drop --smoke or "
-              f"--scenario {scenario}", file=stderr)
-        return 2
-    name = "smoke" if smoke else (scenario or "smoke")
+    name = "smoke" if smoke and scenario is None else (scenario or "smoke")
     try:
         sc = get_scenario(name, slots=slots, n_validators=validators,
                           seed=seed, flood_factor=flood_factor)
     except KeyError as e:
         print(f"error: {e.args[0]}", file=stderr)
         return 1
-    out = out or default_report_path(sc.name == "smoke")
+    if smoke and sc.name != "smoke":
+        sc = smoke_variant(sc)
+    out = out or default_report_path(smoke or sc.name == "smoke")
     report = run_scenario(
-        sc, out_path=out,
+        sc, out_path=out, datadir=datadir,
         log_fn=None if quiet else (
             lambda m: print(m, file=stderr, flush=True)
         ),
     )
-    print(json.dumps({
+    summary = {
         "scenario": report["scenario"],
         "report": out,
         "published": report["published"],
@@ -63,7 +63,18 @@ def drive(*, scenario=None, smoke=False, slots=None, validators=None,
         "breaker_transitions": report["breaker_transitions"],
         "blocks_processed_in_slot": report["blocks_processed_in_slot"],
         "elapsed_secs": report["elapsed_secs"],
-    }), file=stdout)
+    }
+    if "crash" in report:
+        summary["crash"] = report["crash"]
+        summary["conservation"] = report["conservation"]
+    print(json.dumps(summary), file=stdout)
+    if "crash" in report and not (
+        report["crash"]["resumed_from_persisted_head"]
+        and report["conservation"]["ok"]
+    ):
+        print("error: crash-restart invariants violated (see report)",
+              file=stderr)
+        return 1
     return 0
 
 
@@ -71,11 +82,13 @@ def add_loadtest_args(parser) -> None:
     """The flag set shared by both entry points."""
     parser.add_argument("--scenario", default=None,
                         help="named scenario: smoke, steady, flood, "
-                             "device_stall, slow_host (default: smoke)")
+                             "device_stall, slow_host, crash_restart "
+                             "(default: smoke)")
     parser.add_argument("--smoke", action="store_true",
-                        help="run the ~5s CPU-only smoke scenario; report "
-                             "lands in the gitignored LOADGEN_SMOKE.json "
-                             "(contradicts a different --scenario)")
+                        help="alone: run the ~5s CPU-only smoke scenario; "
+                             "with --scenario: run that scenario shrunk to "
+                             "smoke scale. Report lands in the gitignored "
+                             "LOADGEN_SMOKE.json")
     parser.add_argument("--slots", type=int, default=None,
                         help="override the scenario's slot count")
     parser.add_argument("--validators", type=int, default=None,
@@ -90,6 +103,9 @@ def add_loadtest_args(parser) -> None:
                              "the repo root)")
     parser.add_argument("--quiet", action="store_true",
                         help="suppress per-slot progress on stderr")
+    parser.add_argument("--datadir", default=None,
+                        help="datadir for store-backed scenarios "
+                             "(crash_restart); default: a fresh tmp dir")
 
 
 def drive_from_args(args) -> int:
@@ -97,4 +113,5 @@ def drive_from_args(args) -> int:
         scenario=args.scenario, smoke=args.smoke, slots=args.slots,
         validators=args.validators, seed=args.seed,
         flood_factor=args.flood_factor, out=args.out, quiet=args.quiet,
+        datadir=args.datadir,
     )

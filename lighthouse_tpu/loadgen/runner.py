@@ -19,7 +19,9 @@ every count in the report is a pure function of (scenario, seed).
 from __future__ import annotations
 
 import json
+import os
 import random
+import tempfile
 import time
 
 from ..chain.beacon_processor import (
@@ -46,9 +48,14 @@ _FORK_DIGEST = b"\x00" * 4
 class LoadgenNode:
     """Router topics -> QoS-guarded BeaconProcessor -> counting verifiers."""
 
-    def __init__(self, sc: Scenario, clock: ManualSlotClock):
+    def __init__(self, sc: Scenario, clock: ManualSlotClock, store=None):
         self.scenario = sc
         self.clock = clock
+        # optional durable store: the block handler persists the head slot
+        # through it (a loadgen-scale BeaconChain.persist()), so storage
+        # faults injected there crash the node exactly where a real one
+        # would crash — inside its durable-write path
+        self.store = store
         self.admission = AdmissionController(clock)
         self.processor = BeaconProcessor(
             BeaconProcessorConfig(), admission=self.admission
@@ -139,6 +146,16 @@ class LoadgenNode:
             # urgent path); what matters here is WHEN they run
             now = self.clock.now() or 0
             self.block_slot_lag.append(now - slot)
+            if self.store is not None:
+                # the durable head record (BeaconChain.persist() at loadgen
+                # scale): one CRC-framed fsynced append per imported block —
+                # a SimulatedCrash raised here kills the whole node run
+                from ..store.kv import Column
+
+                self.store.put(
+                    Column.beacon_chain, b"head-slot",
+                    int(slot).to_bytes(8, "little", signed=True),
+                )
 
         return self.processor.submit(
             WorkItem(kind=WorkKind.gossip_block, run=run)
@@ -193,9 +210,12 @@ class LoadgenNode:
 
 
 def run_scenario(sc: Scenario, out_path: str | None = None,
-                 log_fn=None) -> dict:
+                 log_fn=None, datadir: str | None = None) -> dict:
     """Run one scenario to completion; returns (and optionally writes) the
     machine-readable report."""
+    if "storage_crash" in sc.faults:
+        return run_crash_restart(sc, out_path=out_path, log_fn=log_fn,
+                                 datadir=datadir)
     t_wall = time.time()
     clock = ManualSlotClock(0, max(1, int(sc.seconds_per_slot)))
     node = LoadgenNode(sc, clock)
@@ -243,6 +263,163 @@ def run_scenario(sc: Scenario, out_path: str | None = None,
         "breaker_transitions": list(node.breaker.transitions),
         "blocks_processed_in_slot": bool(node.block_slot_lag)
         and max(node.block_slot_lag) == 0,
+        "elapsed_secs": round(time.time() - t_wall, 3),
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=1)
+    return report
+
+
+def _merge_counts(a: dict, b: dict) -> dict:
+    out = dict(a)
+    for k, v in b.items():
+        out[k] = out.get(k, 0) + v
+    return out
+
+
+def run_crash_restart(sc: Scenario, out_path: str | None = None,
+                      log_fn=None, datadir: str | None = None) -> dict:
+    """The crash-recovery proof: mainnet-shaped load over a DURABLE store,
+    a torn head write at `crash_slot` that kills the node mid-slot, then a
+    restart over the same datadir that must resume from the last durably
+    persisted head and finish the run.
+
+    Phase 1 runs on a `FaultyKVStore` (fsync=always) whose fault plan
+    tears the crash slot's head record mid-write; the `SimulatedCrash`
+    propagates out of the processor pump — everything still queued at that
+    instant is `lost_to_crash`, exactly the work a real power loss eats.
+    Phase 2 reopens the path with the healthy pure-Python engine: replay
+    truncates the torn record (store-level crash recovery), the recovered
+    head MUST be crash_slot - 1, and the remaining slots run on a fresh
+    node. The report's conservation invariant extends to
+    published == processed + dropped + expired + lost_to_crash."""
+    from ..store.kv import Column
+    from ..store.native_kv import PurePythonKVStore
+    from .storefaults import FaultPlan, FaultyKVStore, SimulatedCrash
+
+    t_wall = time.time()
+    datadir = datadir or tempfile.mkdtemp(prefix="loadgen-crash-")
+    path = os.path.join(datadir, "hot.db")
+    crash_slot = sc.crash_slot if sc.crash_slot is not None else sc.slots // 2
+    # one head record per slot -> the crash slot's record is write #crash_slot+1;
+    # keep 9 bytes (header + 1 payload byte): a torn record the CRC must catch
+    store = FaultyKVStore(
+        path, plan=FaultPlan(tear_at=crash_slot + 1, tear_keep_bytes=9),
+        fsync="always",
+    )
+    clock = ManualSlotClock(0, max(1, int(sc.seconds_per_slot)))
+    node = LoadgenNode(sc, clock, store=store)
+    schedule = traffic_schedule(sc)
+    rng = random.Random(sc.seed ^ 0x10AD6E4)
+
+    crash_msg = None
+    resume_at = sc.slots
+    for slot, traffic in enumerate(schedule):
+        clock.set_slot(slot)
+        node.publish_slot(slot, traffic, rng)
+        try:
+            node.processor.run_until_idle()
+        except SimulatedCrash as e:
+            crash_msg = str(e)
+            resume_at = slot + 1   # the node is down for the rest of the slot
+            if log_fn is not None:
+                log_fn(f"slot {slot}: CRASH — {e}")
+            break
+        if log_fn is not None:
+            log_fn(f"slot {slot}: published "
+                   f"{traffic.attestations + traffic.stale_attestations} att "
+                   f"/ {traffic.aggregates} agg / {traffic.blocks} block")
+    proc1 = node.processor
+    # work lost with the process: the unit being executed when the store
+    # died (the block — its processed count never ticked) plus everything
+    # still queued. Loadgen batches resolve synchronously, so there are no
+    # in-flight device handles to account.
+    lost_to_crash = 0
+    if crash_msg is not None:
+        lost_to_crash = 1 + sum(len(q) for q in proc1.queues.values())
+
+    # ---- restart over the SAME datadir with the healthy engine: replay +
+    # tail truncation recover the crash-consistent prefix
+    store2 = PurePythonKVStore(path, fsync="always")
+    raw = store2.get(Column.beacon_chain, b"head-slot")
+    recovered_head = (
+        int.from_bytes(raw, "little", signed=True) if raw is not None else None
+    )
+    expected_head = crash_slot - 1 if crash_msg is not None else sc.slots - 1
+    node2 = LoadgenNode(sc, clock, store=store2)
+    for slot in range(resume_at, sc.slots):
+        clock.set_slot(slot)
+        node2.publish_slot(slot, schedule[slot], rng)
+        node2.processor.run_until_idle()
+        if log_fn is not None:
+            log_fn(f"slot {slot}: resumed node published "
+                   f"{schedule[slot].attestations} att")
+    clock.set_slot(sc.slots)
+    node2.processor.run_until_idle()
+    store2.close()
+    proc2 = node2.processor
+
+    published = _merge_counts(node.published, node2.published)
+    pub_total = sum(published.values())
+    processed = _merge_counts(
+        {k.name: v for k, v in proc1.processed.items() if v},
+        {k.name: v for k, v in proc2.processed.items() if v},
+    )
+    dropped = _merge_counts(
+        {k.name: v for k, v in proc1.dropped.items() if v},
+        {k.name: v for k, v in proc2.dropped.items() if v},
+    )
+    expired = _merge_counts(
+        {k.name: v for k, v in proc1.expired.items() if v},
+        {k.name: v for k, v in proc2.expired.items() if v},
+    )
+    conservation = {
+        "published": pub_total,
+        "processed": sum(processed.values()),
+        "dropped": sum(dropped.values()),
+        "expired": sum(expired.values()),
+        "lost_to_crash": lost_to_crash,
+    }
+    conservation["ok"] = conservation["published"] == (
+        conservation["processed"] + conservation["dropped"]
+        + conservation["expired"] + conservation["lost_to_crash"]
+    )
+    lag = node.block_slot_lag + node2.block_slot_lag
+    report = {
+        "scenario": sc.name,
+        "seed": sc.seed,
+        "slots": sc.slots,
+        "n_validators": sc.n_validators,
+        "flood_factor": sc.flood_factor,
+        "faults": list(sc.faults),
+        "crash": {
+            "slot": crash_slot if crash_msg is not None else None,
+            "fault": crash_msg,
+            "datadir": datadir,
+            "store_writes_at_crash": store.writes,
+            "lost_to_crash": lost_to_crash,
+            "recovered_head_slot": recovered_head,
+            "expected_head_slot": expected_head,
+            "resumed_from_persisted_head": recovered_head == expected_head,
+            "resumed_at_slot": resume_at,
+        },
+        "published": published,
+        "processed": processed,
+        "dropped": dropped,
+        "expired": expired,
+        "conservation": conservation,
+        "qos_totals": {
+            "shed": proc1.qos_totals()["shed"] + proc2.qos_totals()["shed"],
+            "expired": proc1.qos_totals()["expired"]
+            + proc2.qos_totals()["expired"],
+        },
+        "shed_callbacks": node.shed_callbacks + node2.shed_callbacks,
+        "verified_sets": node.verified_sets + node2.verified_sets,
+        "batches": _merge_counts(node.batches, node2.batches),
+        "breaker_transitions": list(node.breaker.transitions)
+        + list(node2.breaker.transitions),
+        "blocks_processed_in_slot": bool(lag) and max(lag) == 0,
         "elapsed_secs": round(time.time() - t_wall, 3),
     }
     if out_path:

@@ -42,9 +42,12 @@ class Scenario:
     # fraction of attestations stamped past the propagation window
     stale_fraction: float = 0.0
     # fault injections: "device_stall" stalls the device backend over
-    # stall_slots; "slow_host" adds per-batch host latency
+    # stall_slots; "slow_host" adds per-batch host latency; "storage_crash"
+    # tears the durable head write at crash_slot and kills the node, then
+    # the runner restarts it from the same datadir (crash_restart scenario)
     faults: tuple = ()
     stall_slots: tuple = (2, 4)      # [start, end) in scenario slots
+    crash_slot: int | None = None    # storage_crash: slot whose head write tears
     # queue bounds for the attestation/aggregate queues (None = processor
     # defaults); flood scenarios shrink them so shedding is observable in
     # a few seconds instead of at mainnet scale
@@ -116,7 +119,33 @@ SCENARIOS: dict[str, Scenario] = {
         faults=("slow_host",), stale_fraction=0.1,
         att_queue_cap=512, agg_queue_cap=128,
     ),
+    # crash recovery proof: mainnet-shaped load over a DURABLE store whose
+    # head write tears mid-record at crash_slot (the node "dies"); the
+    # runner restarts from the same datadir, asserts the recovered head is
+    # the last durably persisted one, and finishes the run — conservation
+    # extends to published == processed + dropped + expired + lost_to_crash
+    "crash_restart": Scenario(
+        name="crash_restart", n_validators=4096, slots=8, flood_factor=2.0,
+        stale_fraction=0.1, faults=("storage_crash",), crash_slot=4,
+        att_queue_cap=256, agg_queue_cap=64,
+    ),
 }
+
+
+def smoke_variant(sc: Scenario) -> Scenario:
+    """Any scenario shrunk to smoke scale (CPU-only, seconds) without
+    changing its SHAPE: same faults, same mix, clamped size. This is what
+    `--smoke` combined with an explicit `--scenario` runs."""
+    out = replace(
+        sc,
+        n_validators=min(sc.n_validators, 4096),
+        slots=min(sc.slots, 8),
+    )
+    if out.crash_slot is not None:
+        out.crash_slot = max(1, min(out.crash_slot, out.slots - 2))
+    s0, s1 = out.stall_slots
+    out.stall_slots = (min(s0, max(0, out.slots - 2)), min(s1, out.slots))
+    return out
 
 
 def get_scenario(name: str, **overrides) -> Scenario:

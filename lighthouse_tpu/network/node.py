@@ -18,12 +18,27 @@ from ..chain.data_availability import (
     BlobIgnoreError,
 )
 from ..state_transition.slot import types_for_slot
+from ..utils.logging import get_logger
+from ..utils.metrics import REGISTRY
+from ..utils.supervisor import Supervisor
 from . import gossip as gs
 from .gossipsub import IGNORE_RETRY, Gossipsub
 from .peer_manager import PeerManager
 from .rpc import Protocol, RpcHandler
 from .sync import SyncManager
 from .transport import RemotePeer, TcpHost
+
+log = get_logger("network")
+
+# Heartbeat stage failures survived in place (the loop continues; the
+# supervisor only sees a crash if the loop ITSELF dies). Swallowed
+# heartbeat errors are exactly the failures that used to vanish into
+# `except Exception: pass` — now each one is a counted, logged event.
+_HEARTBEAT_ERRORS = REGISTRY.counter_vec(
+    "node_heartbeat_errors_total",
+    "heartbeat-loop stage failures survived (loop continues), by stage",
+    ("stage",),
+)
 
 
 class NetworkNode:
@@ -97,8 +112,11 @@ class NetworkNode:
                             encrypt=encrypt)
         self.heartbeat_interval = heartbeat_interval
         self._hb_stop = threading.Event()
-        self._hb_thread = threading.Thread(target=self._heartbeat_loop, daemon=True)
-        self._hb_thread.start()
+        # the heartbeat runs supervised: a crash of the LOOP (not a caught
+        # per-stage failure) restarts it with backoff instead of silently
+        # stranding the mesh (utils/supervisor.py)
+        self.supervisor = Supervisor(name="node")
+        self._hb_thread = self.supervisor.spawn(self._heartbeat_loop, "heartbeat")
         self._lock = threading.Lock()  # serializes chain mutation from gossip
         # PX dial rate limiting (see _on_px)
         self._px_lock = threading.Lock()
@@ -281,17 +299,32 @@ class NetworkNode:
         while not self._hb_stop.wait(self.heartbeat_interval):
             try:
                 self.gossipsub.heartbeat()
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 — one bad tick must not
+                _HEARTBEAT_ERRORS.labels("gossip").inc()      # kill the loop
+                log.warn("gossip heartbeat tick failed; loop continues",
+                         node=self.node_id,
+                         error=f"{type(e).__name__}: {e}")
             try:
                 self._drain_early_sidecars()
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001
+                _HEARTBEAT_ERRORS.labels("sidecars").inc()
+                log.warn("early-sidecar drain failed; loop continues",
+                         node=self.node_id,
+                         error=f"{type(e).__name__}: {e}")
 
-    def close(self) -> None:
+    def close(self, drain_timeout: float | None = None) -> None:
+        """Shut the node down. With `drain_timeout`, queued processor work
+        is drained (bounded) BEFORE the pump stops — the graceful path, so
+        a SIGTERM mid-flood does not strand accepted gossip work."""
         self._hb_stop.set()
         if self.batch_gossip:
+            if drain_timeout is not None and not self.processor.drain(
+                drain_timeout
+            ):
+                log.warn("drain deadline hit; shedding remaining queued work",
+                         node=self.node_id, timeout_secs=drain_timeout)
             self.processor.stop()
+        self.supervisor.stop(timeout=1.0)
         self.host.close()
 
     # ------------------------------------------------------------ handlers

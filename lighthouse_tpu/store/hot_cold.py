@@ -63,6 +63,23 @@ class HotColdDB:
         # boundary: slots < split are in the freezer (persisted across opens)
         self.split_slot = split.slot if split is not None else 0
 
+    # ----------------------------------------------------------- lifecycle
+
+    def flush(self) -> None:
+        """Durability barrier across all underlying stores (persist points
+        and graceful shutdown call this after their last write)."""
+        self.hot.flush()
+        self.cold.flush()
+        if self.blobs_db is not self.hot:
+            self.blobs_db.flush()
+
+    def close(self) -> None:
+        self.flush()
+        self.hot.close()
+        self.cold.close()
+        if self.blobs_db is not self.hot:
+            self.blobs_db.close()
+
     # ----------------------------------------------------------- metadata
 
     def get_anchor_info(self) -> md.AnchorInfo | None:

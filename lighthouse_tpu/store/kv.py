@@ -75,6 +75,10 @@ class KeyValueStore:
     def compact(self) -> None:
         pass
 
+    def flush(self) -> None:
+        """Push buffered writes to durable storage (fsync where the engine
+        has a log to sync; no-op for memory backends)."""
+
     def close(self) -> None:
         pass
 

@@ -12,14 +12,19 @@
 // C ABI (ctypes-friendly): every function returns 0 on success or a
 // negative errno-style code. Buffers are length-prefixed; get() copies into
 // a malloc'd buffer the caller frees with kvs_free().
+//
+// Build (the tracked libltkv.so next to this file):
+//   g++ -std=c++17 -O2 -shared -fPIC -o libltkv.so kv_store.cc
 
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <fcntl.h>
 #include <map>
 #include <mutex>
 #include <string>
+#include <unistd.h>
 #include <unordered_map>
 #include <vector>
 
@@ -46,6 +51,14 @@ uint32_t crc32(const uint8_t* data, size_t len) {
   return c ^ 0xFFFFFFFFu;
 }
 
+// fsync policy on the append path (mirrors store/native_kv.py):
+// 0 = never (page cache only), 1 = batch (every kFsyncBatchEvery records
+// and on kvs_flush), 2 = always (every record).
+constexpr int kFsyncNever = 0;
+constexpr int kFsyncBatch = 1;
+constexpr int kFsyncAlways = 2;
+constexpr int kFsyncBatchEvery = 64;
+
 struct Store {
   std::mutex mu;
   std::string path;
@@ -54,11 +67,40 @@ struct Store {
   std::unordered_map<std::string, std::string> index;
   uint64_t dead_bytes = 0;
   uint64_t live_bytes = 0;
+  int fsync_mode = kFsyncBatch;
+  int unsynced = 0;
 
   ~Store() {
-    if (log) fclose(log);
+    if (log) {
+      fflush(log);
+      if (fsync_mode != kFsyncNever) fsync(fileno(log));
+      fclose(log);
+    }
   }
 };
+
+void fsync_dir_of(const std::string& path) {
+  // persist the directory entry after a rename/create; the file's own
+  // fsync does not cover it
+  size_t slash = path.find_last_of('/');
+  std::string dir = (slash == std::string::npos) ? "." : path.substr(0, slash);
+  int fd = open(dir.c_str(), O_RDONLY);
+  if (fd >= 0) {
+    fsync(fd);
+    close(fd);
+  }
+}
+
+void apply_fsync_policy(Store* s) {
+  if (s->fsync_mode == kFsyncAlways) {
+    fsync(fileno(s->log));
+  } else if (s->fsync_mode == kFsyncBatch) {
+    if (++s->unsynced >= kFsyncBatchEvery) {
+      fsync(fileno(s->log));
+      s->unsynced = 0;
+    }
+  }
+}
 
 // Record: [u32 crc over rest][u32 payload_len][payload]
 // payload: sequence of ops: [u8 op][u32 klen][u32 vlen][key][value]
@@ -114,17 +156,33 @@ void apply_payload(Store* s, const std::string& payload) {
 bool load_log(Store* s) {
   FILE* f = fopen(s->path.c_str(), "rb");
   if (!f) return true;  // fresh store
+  fseek(f, 0, SEEK_END);
+  long file_end = ftell(f);
+  fseek(f, 0, SEEK_SET);
   uint32_t header[2];
   std::string payload;
+  long valid_end = 0;
   while (fread(header, 4, 2, f) == 2) {
     uint32_t crc = header[0], len = header[1];
+    // bound the untrusted length by what the file can hold BEFORE the
+    // allocation: a torn header can claim a multi-GiB payload, and a
+    // bad_alloc cannot cross the C ABI
+    if ((long)len > file_end - valid_end - 8) break;  // truncated tail
     payload.resize(len);
     if (len && fread(payload.data(), 1, len, f) != len) break;  // truncated tail
     if (crc32(reinterpret_cast<const uint8_t*>(payload.data()), len) != crc)
       break;  // corrupt tail: stop replay (crash-consistent prefix wins)
     apply_payload(s, payload);
+    valid_end = ftell(f);
   }
   fclose(f);
+  // drop the corrupt/truncated tail BEFORE appending (parity with the
+  // pure-Python engine): a record appended after garbage would be
+  // unreachable on the next replay — the scanner stops at the bad record —
+  // silently losing every post-recovery write
+  if (file_end > valid_end) {
+    if (truncate(s->path.c_str(), valid_end) != 0) return false;
+  }
   return true;
 }
 
@@ -135,6 +193,8 @@ extern "C" {
 void* kvs_open(const char* path) {
   auto* s = new Store();
   s->path = path;
+  // a crash mid-compaction leaks its tmp; it was never the live DB
+  remove((s->path + ".compact").c_str());
   if (!load_log(s)) {
     delete s;
     return nullptr;
@@ -156,6 +216,7 @@ int kvs_put(void* h, const uint8_t* key, uint32_t klen, const uint8_t* val, uint
   append_op(&payload, kOpPut, std::string((const char*)key, klen),
             std::string((const char*)val, vlen));
   if (!write_record(s, payload)) return -5;
+  apply_fsync_policy(s);
   apply_payload(s, payload);
   return 0;
 }
@@ -166,6 +227,7 @@ int kvs_delete(void* h, const uint8_t* key, uint32_t klen) {
   std::string payload;
   append_op(&payload, kOpDel, std::string((const char*)key, klen), "");
   if (!write_record(s, payload)) return -5;
+  apply_fsync_policy(s);
   apply_payload(s, payload);
   return 0;
 }
@@ -176,7 +238,28 @@ int kvs_batch(void* h, const uint8_t* payload, uint32_t len) {
   std::lock_guard<std::mutex> g(s->mu);
   std::string p((const char*)payload, len);
   if (!write_record(s, p)) return -5;
+  apply_fsync_policy(s);
   apply_payload(s, p);
+  return 0;
+}
+
+// mode: 0 = never, 1 = batch (default), 2 = always.
+int kvs_set_fsync(void* h, int mode) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  if (mode < kFsyncNever || mode > kFsyncAlways) return -22;
+  s->fsync_mode = mode;
+  return 0;
+}
+
+// Durability barrier: everything written so far is on disk on return.
+int kvs_flush(void* h) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  if (!s->log) return -5;
+  if (fflush(s->log) != 0) return -5;
+  if (s->fsync_mode != kFsyncNever && fsync(fileno(s->log)) != 0) return -5;
+  s->unsynced = 0;
   return 0;
 }
 
@@ -220,7 +303,11 @@ int kvs_iter_prefix(void* h, const uint8_t* prefix, uint32_t plen, kvs_iter_cb c
   return 0;
 }
 
-// Rewrite the log with only live records (stop-the-world).
+// Rewrite the log with only live records (stop-the-world). Crash-safe:
+// the tmp is fsynced BEFORE the rename (a power loss after the rename
+// must find the new bytes, not a zero-length inode) and the directory
+// entry is fsynced after; a crash at any point leaves either the old log
+// or the complete new one (the stale tmp is swept at the next open).
 int kvs_compact(void* h) {
   auto* s = static_cast<Store*>(h);
   std::lock_guard<std::mutex> g(s->mu);
@@ -238,12 +325,15 @@ int kvs_compact(void* h) {
       break;
     }
   }
+  if (ok && s->fsync_mode != kFsyncNever && fsync(fileno(tmp)) != 0) ok = false;
   if (ok) {
     fclose(old);
     fclose(tmp);
     if (rename(tmp_path.c_str(), s->path.c_str()) != 0) ok = false;
+    if (ok && s->fsync_mode != kFsyncNever) fsync_dir_of(s->path);
     s->log = fopen(s->path.c_str(), "ab");
     s->dead_bytes = 0;
+    s->unsynced = 0;
   } else {
     s->log = old;
     fclose(tmp);

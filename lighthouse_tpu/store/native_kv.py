@@ -27,6 +27,105 @@ from pathlib import Path
 from ..utils.logging import get_logger
 from .kv import Column, KeyValueOp, KeyValueStore
 
+# Durability policy for the append path (both engines):
+#   always — fsync after every record (torn writes lose at most the record
+#            being written; survives power loss)
+#   batch  — fsync every FSYNC_BATCH_EVERY records and on flush()/close()
+#            (bounded loss window; the default)
+#   never  — OS page cache only (tests / throwaway datadirs)
+# The on-disk format is crash-consistent under ALL policies (CRC-framed
+# records, replay stops at the torn tail); the policy only bounds how much
+# acknowledged work a power loss can undo.
+FSYNC_POLICIES = ("always", "batch", "never")
+FSYNC_BATCH_EVERY = 64
+
+
+def _resolve_fsync(policy: str | None) -> str:
+    if policy is None:
+        policy = os.environ.get("LIGHTHOUSE_TPU_STORE_FSYNC", "batch")
+    if policy not in FSYNC_POLICIES:
+        raise ValueError(
+            f"unknown fsync policy {policy!r} (have: {', '.join(FSYNC_POLICIES)})"
+        )
+    return policy
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync the directory holding `path` so a rename/create survives power
+    loss (the file's own fsync does not persist its directory entry)."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return  # platform without directory open; best effort
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+# On-disk record framing, shared with the C++ engine (kv_store.cc):
+#   record:  [u32 crc over payload][u32 payload_len][payload]
+#   payload: sequence of ops [u8 op][u32 klen][u32 vlen][key][value]
+OP_PUT = 1
+OP_DEL = 2
+
+
+class LogWalk:
+    """Read-only CRC walk of a record log — the single Python owner of the
+    framed record format (engine replay, doctor's fsck and the fault-
+    injection helpers all read through it; the C++ loader mirrors it).
+    Iterate for (start, end, payload) of each valid record; after
+    iteration `valid_end`/`records`/`tail_error` say where and why the
+    walk stopped (tail_error: None = clean EOF, "truncated" = short
+    header/payload, "crc" = checksum mismatch)."""
+
+    def __init__(self, f):
+        self._f = f
+        self.valid_end = f.tell()
+        self.records = 0
+        self.tail_error = None
+
+    def __iter__(self):
+        f = self._f
+        while True:
+            start = self.valid_end
+            header = f.read(8)
+            if len(header) < 8:
+                if header:
+                    self.tail_error = "truncated"
+                return
+            crc, length = struct.unpack("<II", header)
+            payload = f.read(length)
+            if len(payload) < length:
+                self.tail_error = "truncated"
+                return
+            if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                self.tail_error = "crc"
+                return
+            self.records += 1
+            self.valid_end = f.tell()
+            yield start, self.valid_end, payload
+
+
+def iter_record_ops(payload: bytes):
+    """Yield (op, key, value) from one record payload; stops silently at a
+    truncated op run (only possible inside an already-CRC-valid record if
+    the writer was cut mid-encode, which the framing makes unreachable —
+    kept for defense in depth)."""
+    pos, n = 0, len(payload)
+    while pos + 9 <= n:
+        op = payload[pos]
+        klen, vlen = struct.unpack_from("<II", payload, pos + 1)
+        pos += 9
+        if pos + klen + vlen > n:
+            return
+        key = payload[pos : pos + klen]
+        pos += klen
+        val = payload[pos : pos + vlen]
+        pos += vlen
+        yield op, key, val
+
+
 _SRC = Path(__file__).parent / "native" / "kv_store.cc"
 _LIB = Path(__file__).parent / "native" / "libltkv.so"
 _build_lock = threading.Lock()
@@ -102,6 +201,21 @@ def _load():
     lib.kvs_count.argtypes = [ctypes.c_void_p]
     lib.kvs_compact.restype = ctypes.c_int
     lib.kvs_compact.argtypes = [ctypes.c_void_p]
+    # durability controls — absent from pre-fsync builds of the library
+    # (e.g. a stale tracked .so whose checkout mtime beat the source's);
+    # degrade to fflush-only rather than refusing to open the DB
+    try:
+        lib.kvs_set_fsync.restype = ctypes.c_int
+        lib.kvs_set_fsync.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.kvs_flush.restype = ctypes.c_int
+        lib.kvs_flush.argtypes = [ctypes.c_void_p]
+        lib._has_fsync = True
+    except AttributeError:
+        lib._has_fsync = False
+        get_logger("store").warn(
+            "native kv library predates fsync support; durability policy "
+            "degraded to OS page cache (rebuild with g++ to fix)"
+        )
     _ITER_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p,
                                 ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint32,
                                 ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint32)
@@ -141,12 +255,24 @@ class PurePythonKVStore(KeyValueStore):
     Replay stops at the first truncated or CRC-failing record — the
     crash-consistent prefix wins, exactly like the C++ loader."""
 
-    def __init__(self, path: str | os.PathLike):
+    def __init__(self, path: str | os.PathLike, fsync: str | None = None):
         path = os.fspath(path)
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._path = path
+        self._fsync = _resolve_fsync(fsync)
+        self._unsynced = 0
         self._lock = threading.Lock()
         self._index: dict[bytes, bytes] = {}
+        # a crash mid-compaction leaks its tmp file; left in place it would
+        # sit there forever (and a later compaction would happily reuse the
+        # name) — delete it before replay, it was never the live DB
+        tmp = path + ".compact"
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+            get_logger("store").warn(
+                "removed stale compaction tmp (crash mid-compaction)",
+                path=tmp,
+            )
         valid_end = self._replay()
         # drop the corrupt/truncated tail BEFORE appending: a new record
         # written after garbage would be unreachable on the next replay
@@ -167,37 +293,17 @@ class PurePythonKVStore(KeyValueStore):
         except FileNotFoundError:
             return None  # fresh store
         with f:
-            valid_end = 0
-            while True:
-                header = f.read(8)
-                if len(header) < 8:
-                    break
-                crc, length = struct.unpack("<II", header)
-                payload = f.read(length)
-                if len(payload) < length:
-                    break  # truncated tail
-                if zlib.crc32(payload) & 0xFFFFFFFF != crc:
-                    break  # corrupt tail: crash-consistent prefix wins
+            walk = LogWalk(f)
+            for _start, _end, payload in walk:
                 self._apply(payload)
-                valid_end = f.tell()
-            return valid_end
+            # a torn/corrupt tail ends the walk; the prefix wins
+            return walk.valid_end
 
     def _apply(self, payload: bytes) -> None:
-        pos = 0
-        n = len(payload)
-        while pos + 9 <= n:
-            op = payload[pos]
-            klen, vlen = struct.unpack_from("<II", payload, pos + 1)
-            pos += 9
-            if pos + klen + vlen > n:
-                return  # truncated op run
-            key = payload[pos : pos + klen]
-            pos += klen
-            val = payload[pos : pos + vlen]
-            pos += vlen
-            if op == 1:
+        for op, key, val in iter_record_ops(payload):
+            if op == OP_PUT:
                 self._index[key] = val
-            elif op == 2:
+            elif op == OP_DEL:
                 self._index.pop(key, None)
 
     @staticmethod
@@ -206,7 +312,7 @@ class PurePythonKVStore(KeyValueStore):
         for op in ops:
             k = _ckey(op.column, op.key)
             v = op.value if (op.kind == "put" and op.value) else b""
-            payload.append(1 if op.kind == "put" else 2)
+            payload.append(OP_PUT if op.kind == "put" else OP_DEL)
             payload += struct.pack("<II", len(k), len(v))
             payload += k
             payload += v
@@ -218,6 +324,17 @@ class PurePythonKVStore(KeyValueStore):
         fh.write(payload)
         fh.flush()
 
+    def _sync_policy(self) -> None:
+        """Apply the fsync policy after an append (caller holds the lock and
+        has already flushed Python buffers)."""
+        if self._fsync == "always":
+            os.fsync(self._log.fileno())
+        elif self._fsync == "batch":
+            self._unsynced += 1
+            if self._unsynced >= FSYNC_BATCH_EVERY:
+                os.fsync(self._log.fileno())
+                self._unsynced = 0
+
     # ------------------------------------------------------------ interface
 
     def get(self, column: Column, key: bytes) -> bytes | None:
@@ -228,6 +345,7 @@ class PurePythonKVStore(KeyValueStore):
         payload = self._encode_ops(ops)
         with self._lock:
             self._write_record(self._log, payload)
+            self._sync_policy()
             self._apply(payload)
 
     def iter_column(self, column: Column):
@@ -241,7 +359,14 @@ class PurePythonKVStore(KeyValueStore):
         return iter(items)
 
     def compact(self) -> None:
-        """Rewrite the log with only live records (stop-the-world)."""
+        """Rewrite the log with only live records (stop-the-world).
+
+        Crash-safe: the tmp file is fsynced BEFORE os.replace (a power loss
+        after the rename must find the new bytes on disk, not a zero-length
+        inode), and the directory entry is fsynced after, so the rename
+        itself survives. A crash at any point leaves either the old log or
+        the complete new one — never a mix (the stale tmp is swept at the
+        next open)."""
         tmp_path = self._path + ".compact"
         with self._lock:
             with open(tmp_path, "wb") as tmp:
@@ -250,17 +375,35 @@ class PurePythonKVStore(KeyValueStore):
                                     + struct.pack("<II", len(k), len(v))
                                     + k + v)
                     self._write_record(tmp, payload)
+                if self._fsync != "never":
+                    os.fsync(tmp.fileno())
             self._log.close()
             os.replace(tmp_path, self._path)
+            if self._fsync != "never":
+                _fsync_dir(self._path)
             self._log = open(self._path, "ab")
+            self._unsynced = 0
 
     def __len__(self):
         with self._lock:
             return len(self._index)
 
+    def flush(self) -> None:
+        """Durability barrier: everything written so far is on disk when
+        this returns (called at persist points and shutdown)."""
+        with self._lock:
+            if self._log is not None:
+                self._log.flush()
+                if self._fsync != "never":
+                    os.fsync(self._log.fileno())
+                self._unsynced = 0
+
     def close(self) -> None:
         with self._lock:
             if self._log is not None:
+                self._log.flush()
+                if self._fsync != "never":
+                    os.fsync(self._log.fileno())
                 self._log.close()
                 self._log = None
 
@@ -269,22 +412,27 @@ class NativeKVStore(KeyValueStore):
     """Production store on the C++ backend (pure-Python fallback when the
     native library cannot be built/loaded — see module docstring)."""
 
-    def __new__(cls, path: str | os.PathLike):
+    def __new__(cls, path: str | os.PathLike, fsync: str | None = None):
         if cls is NativeKVStore:
             try:
                 _load()
             except Exception as e:  # noqa: BLE001 — any load failure degrades
                 _native_unavailable(e)
-                return PurePythonKVStore(path)
+                return PurePythonKVStore(path, fsync=fsync)
         return super().__new__(cls)
 
-    def __init__(self, path: str | os.PathLike):
+    def __init__(self, path: str | os.PathLike, fsync: str | None = None):
         lib = _load()
         os.makedirs(os.path.dirname(os.fspath(path)) or ".", exist_ok=True)
         self._lib = lib
+        self._fsync = _resolve_fsync(fsync)
         self._h = lib.kvs_open(os.fspath(path).encode())
         if not self._h:
             raise OSError(f"cannot open native kv store at {path}")
+        if lib._has_fsync:
+            lib.kvs_set_fsync(
+                self._h, {"never": 0, "batch": 1, "always": 2}[self._fsync]
+            )
 
     def get(self, column: Column, key: bytes) -> bytes | None:
         k = _ckey(column, key)
@@ -305,7 +453,7 @@ class NativeKVStore(KeyValueStore):
         for op in ops:
             k = _ckey(op.column, op.key)
             v = op.value or b""
-            payload.append(1 if op.kind == "put" else 2)
+            payload.append(OP_PUT if op.kind == "put" else OP_DEL)
             payload += len(k).to_bytes(4, "little")
             payload += (len(v) if op.kind == "put" else 0).to_bytes(4, "little")
             payload += k
@@ -332,6 +480,12 @@ class NativeKVStore(KeyValueStore):
         rc = self._lib.kvs_compact(self._h)
         if rc != 0:
             raise OSError(f"kvs_compact failed: {rc}")
+
+    def flush(self) -> None:
+        if self._h and self._lib._has_fsync:
+            rc = self._lib.kvs_flush(self._h)
+            if rc != 0:
+                raise OSError(f"kvs_flush failed: {rc}")
 
     def __len__(self):
         return self._lib.kvs_count(self._h)

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import threading
 import time
 import urllib.request
@@ -19,7 +20,9 @@ from .metrics import REGISTRY
 VERSION = "lighthouse-tpu/0.2.0"
 
 # outcome-labeled delivery counter: a scrape shows whether the remote
-# monitoring endpoint is reachable without grepping logs
+# monitoring endpoint is reachable without grepping logs. result="retried"
+# counts attempts that failed but were retried within the same tick;
+# "ok"/"error" count each tick's FINAL outcome exactly once.
 _POSTS = REGISTRY.counter_vec(
     "monitoring_posts_total",
     "remote monitoring POST attempts, by outcome",
@@ -70,16 +73,26 @@ class MonitoringService:
     optional sources; either side can run standalone."""
 
     def __init__(self, endpoint: str, chain=None, vc_store=None,
-                 period: float = 60.0, post_fn=None):
+                 period: float = 60.0, post_fn=None,
+                 max_retries: int = 2, backoff_base: float = 0.25,
+                 sleep_fn=None, rng=None):
         self.endpoint = endpoint
         self.chain = chain
         self.vc_store = vc_store
         self.period = period
+        # bounded retry inside one tick: a transient endpoint blip must not
+        # drop the datapoint (exponential backoff + jitter, interruptible
+        # by stop() so shutdown never waits out a backoff)
+        self.max_retries = max_retries
+        self.backoff_base = backoff_base
         self._sent = 0
         self._errors = 0
         self._post = post_fn or self._http_post
         self._stop = threading.Event()
+        self._sleep = sleep_fn or self._stop.wait
+        self._rng = rng or random.Random()
         self._thread: threading.Thread | None = None
+        self._supervisor = None
 
     # sent/errors are read-only per-INSTANCE views (two services must not
     # read each other's counts); tick() additionally feeds the process-
@@ -159,22 +172,42 @@ class MonitoringService:
 
     def tick(self) -> bool:
         try:
-            self._post(self.collect())
-            self._sent += 1
-            _POSTS.labels("ok").inc()
-            return True
+            payload = self.collect()
         except Exception:  # noqa: BLE001 — monitoring must never kill the node
             self._errors += 1
             _POSTS.labels("error").inc()
             return False
+        for attempt in range(self.max_retries + 1):
+            try:
+                self._post(payload)
+                self._sent += 1
+                _POSTS.labels("ok").inc()
+                return True
+            except Exception:  # noqa: BLE001
+                if attempt >= self.max_retries or self._stop.is_set():
+                    break
+                _POSTS.labels("retried").inc()
+                delay = self.backoff_base * (2.0 ** attempt)
+                delay *= 1.0 + 0.25 * (2.0 * self._rng.random() - 1.0)
+                self._sleep(delay)
+        self._errors += 1
+        _POSTS.labels("error").inc()
+        return False
 
     def start(self) -> None:
         def loop():
             while not self._stop.wait(self.period):
                 self.tick()
 
-        self._thread = threading.Thread(target=loop, daemon=True)
-        self._thread.start()
+        # supervised: a crash of the LOOP (tick never raises; this guards
+        # the plumbing around it) restarts with backoff instead of silently
+        # ending remote monitoring (utils/supervisor.py)
+        from .supervisor import Supervisor
+
+        self._supervisor = Supervisor(name="monitoring")
+        self._thread = self._supervisor.spawn(loop, "monitoring_post_loop")
 
     def stop(self) -> None:
         self._stop.set()
+        if self._supervisor is not None:
+            self._supervisor.stop(timeout=1.0)

@@ -35,6 +35,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 METRIC_MODULES = (
     "lighthouse_tpu.utils.metrics",
     "lighthouse_tpu.utils.monitoring",
+    "lighthouse_tpu.utils.supervisor",
+    "lighthouse_tpu.network.node",
     "lighthouse_tpu.chain.beacon_processor",
     "lighthouse_tpu.chain.validator_monitor",
     "lighthouse_tpu.crypto.bls.hybrid",

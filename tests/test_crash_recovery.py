@@ -1,0 +1,538 @@
+"""Crash recovery: torn-write parity across KV engines, storage fault
+injection, datadir doctor, the supervisor, monitoring retry, heartbeat
+error accounting, and BeaconChain restart-resume from a persisted store."""
+
+import json
+import os
+import shutil
+from types import SimpleNamespace
+
+import pytest
+
+from lighthouse_tpu.loadgen.storefaults import (
+    FaultPlan,
+    FaultyKVStore,
+    SimulatedCrash,
+    StoreCrashed,
+    flip_bit,
+    last_record_span,
+)
+from lighthouse_tpu.store import doctor
+from lighthouse_tpu.store.kv import Column, MemoryStore
+from lighthouse_tpu.store.native_kv import PurePythonKVStore
+from lighthouse_tpu.utils.supervisor import SERVICE_RESTARTS, Supervisor
+
+
+# ------------------------------------------------- torn-write parity matrix
+
+
+def _mk_base_log(path):
+    """A log whose FINAL record is a multi-op batch (delete + put), so a
+    torn tail can corrupt interesting structure."""
+    s = PurePythonKVStore(path, fsync="never")
+    s.put(Column.block, b"a" * 32, b"alpha")
+    s.put(Column.block, b"b" * 32, b"beta")
+    s.put(Column.state, b"s" * 32, b"x" * 100)
+    from lighthouse_tpu.store.kv import KeyValueOp
+
+    s.do_atomically([
+        KeyValueOp.delete(Column.block, b"a" * 32),
+        KeyValueOp.put(Column.block, b"c" * 32, b"gamma"),
+    ])
+    s.close()
+
+
+def _snapshot(store) -> dict:
+    out = {}
+    for col in (Column.block, Column.state):
+        out[col.name] = list(store.iter_column(col))
+    return out
+
+
+def test_torn_tail_parity_every_offset(tmp_path):
+    """Truncate the log at EVERY byte offset of the final record: both
+    engines must recover the identical crash-consistent prefix (the first
+    three records), and both must truncate the torn bytes so post-recovery
+    appends stay reachable."""
+    from lighthouse_tpu.store import native_kv
+
+    base = tmp_path / "base.db"
+    _mk_base_log(base)
+    start, end = last_record_span(base)
+    assert end == os.path.getsize(base)
+
+    try:
+        native_kv._load()
+        have_native = True
+    except Exception:  # noqa: BLE001 — environment without a toolchain
+        have_native = False
+
+    # the expected prefix: the log truncated exactly at the last full
+    # record boundary
+    ref = tmp_path / "ref.db"
+    shutil.copy(base, ref)
+    with open(ref, "r+b") as f:
+        f.truncate(start)
+    ref_store = PurePythonKVStore(ref, fsync="never")
+    expected = _snapshot(ref_store)
+    ref_store.close()
+    assert (b"a" * 32, b"alpha") in expected["block"]   # delete not applied
+
+    for cut in range(start, end):
+        for engine, enabled in (
+            (PurePythonKVStore, True),
+            (native_kv.NativeKVStore, have_native),
+        ):
+            if not enabled:
+                continue
+            p = tmp_path / f"cut-{cut}-{engine.__name__}.db"
+            shutil.copy(base, p)
+            with open(p, "r+b") as f:
+                f.truncate(cut)
+            s = engine(p, fsync="never")
+            got = _snapshot(s)
+            assert got == expected, (cut, engine.__name__)
+            # the torn tail is GONE from disk (parity on truncation), so a
+            # post-recovery write is reachable by the next replay
+            s.put(Column.block, b"n" * 32, b"new")
+            s.close()
+            assert os.path.getsize(p) >= start
+            s2 = PurePythonKVStore(p, fsync="never")
+            assert s2.get(Column.block, b"n" * 32) == b"new"
+            s2.close()
+
+
+def test_crc_flip_recovers_prefix(tmp_path):
+    """A bit flip inside the final record's payload (closed-DB corruption)
+    drops exactly that record on both engines."""
+    from lighthouse_tpu.store import native_kv
+
+    base = tmp_path / "flip.db"
+    _mk_base_log(base)
+    start, _end = last_record_span(base)
+    flip_bit(base, start + 8 + 2)          # payload byte of the last record
+    engines = [PurePythonKVStore]
+    try:
+        native_kv._load()
+        engines.append(native_kv.NativeKVStore)
+    except Exception:  # noqa: BLE001
+        pass
+    for engine in engines:
+        p = base.parent / f"flip-{engine.__name__}.db"
+        shutil.copy(base, p)
+        s = engine(p, fsync="never")
+        assert s.get(Column.block, b"a" * 32) == b"alpha"
+        assert s.get(Column.block, b"c" * 32) is None
+        s.close()
+
+
+# ---------------------------------------------------------- FaultyKVStore
+
+
+def test_faulty_store_torn_write_then_restart(tmp_path):
+    p = tmp_path / "kv.db"
+    s = FaultyKVStore(p, plan=FaultPlan(tear_at=3, tear_keep_bytes=11))
+    s.put(Column.block, b"k1", b"v1")
+    s.put(Column.block, b"k2", b"v2")
+    with pytest.raises(SimulatedCrash, match="torn write"):
+        s.put(Column.block, b"k3", b"v3")
+    assert s.crashed
+    with pytest.raises(StoreCrashed):
+        s.put(Column.block, b"k4", b"v4")
+    # reads still serve the pre-crash index (k3 never applied)
+    assert s.get(Column.block, b"k2") == b"v2"
+    assert s.get(Column.block, b"k3") is None
+    # restart: the healthy engine recovers the durable prefix and the torn
+    # bytes are truncated
+    r = PurePythonKVStore(p, fsync="never")
+    assert r.get(Column.block, b"k1") == b"v1"
+    assert r.get(Column.block, b"k2") == b"v2"
+    assert r.get(Column.block, b"k3") is None
+    r.put(Column.block, b"k4", b"v4")
+    r.close()
+    r2 = PurePythonKVStore(p, fsync="never")
+    assert r2.get(Column.block, b"k4") == b"v4"
+    r2.close()
+
+
+def test_faulty_store_crash_point_enospc_and_crc(tmp_path):
+    # clean crash: nothing of the doomed record lands
+    p1 = tmp_path / "crash.db"
+    s = FaultyKVStore(p1, plan=FaultPlan(crash_at=2))
+    s.put(Column.block, b"k1", b"v1")
+    size_before = os.path.getsize(p1)
+    with pytest.raises(SimulatedCrash, match="crash point"):
+        s.put(Column.block, b"k2", b"v2")
+    assert os.path.getsize(p1) == size_before
+
+    # ENOSPC: surfaced as OSError, store NOT crashed (disk may free up)
+    p2 = tmp_path / "enospc.db"
+    s2 = FaultyKVStore(p2, plan=FaultPlan(enospc_at=2))
+    s2.put(Column.block, b"k1", b"v1")
+    with pytest.raises(OSError, match="[Nn]o space"):
+        s2.put(Column.block, b"k2", b"v2")
+    assert not s2.crashed
+
+    # CRC flip: the record lands whole but replay must drop it
+    p3 = tmp_path / "crc.db"
+    s3 = FaultyKVStore(p3, plan=FaultPlan(flip_crc_at=2))
+    s3.put(Column.block, b"k1", b"v1")
+    s3.put(Column.block, b"k2", b"v2")   # written with a bad CRC
+    s3.put(Column.block, b"k3", b"v3")   # unreachable behind the bad record
+    s3.close()
+    r = PurePythonKVStore(p3, fsync="never")
+    assert r.get(Column.block, b"k1") == b"v1"
+    assert r.get(Column.block, b"k2") is None
+    assert r.get(Column.block, b"k3") is None
+    r.close()
+
+
+# ------------------------------------------------------------------ doctor
+
+
+def test_doctor_detects_and_repairs(tmp_path):
+    datadir = tmp_path / "dd"
+    datadir.mkdir()
+    hot = datadir / "hot.db"
+    s = PurePythonKVStore(hot, fsync="never")
+    s.put(Column.metadata, bytes([0]) * 32, (2).to_bytes(8, "little"))
+    s.put(Column.block, b"\xaa" * 32, b"block")
+    s.close()
+
+    rep = doctor.fsck_datadir(datadir)
+    assert rep["ok"] and rep["problems"] == []
+    assert rep["logs"]["hot.db"]["records"] == 2
+    assert rep["schema"]["version"] == 2
+
+    # torn tail + stray compaction tmp
+    with open(hot, "ab") as f:
+        f.write(b"\xde\xad\xbe\xef half a record")
+    (datadir / "hot.db.compact").write_bytes(b"leak")
+    rep = doctor.fsck_datadir(datadir)
+    assert not rep["ok"]
+    assert any("tail" in p for p in rep["problems"])
+    assert any("compaction tmp" in p for p in rep["problems"])
+
+    rep = doctor.fsck_datadir(datadir, repair=True)
+    assert rep["ok"] and len(rep["repaired"]) == 2
+    assert not (datadir / "hot.db.compact").exists()
+    rep = doctor.fsck_datadir(datadir)
+    assert rep["ok"]
+    # the repair preserved the data
+    r = PurePythonKVStore(hot, fsync="never")
+    assert r.get(Column.block, b"\xaa" * 32) == b"block"
+    r.close()
+
+
+def test_doctor_anchor_and_future_schema(tmp_path):
+    import pickle
+
+    datadir = tmp_path / "dd"
+    datadir.mkdir()
+    s = PurePythonKVStore(datadir / "hot.db", fsync="never")
+    s.put(Column.metadata, bytes([0]) * 32, (2).to_bytes(8, "little"))
+    head = b"\x11" * 32
+    sroot = b"\x22" * 32
+    meta = {
+        "head_root": head, "finalized_root": head, "finalized_epoch": 0,
+        "anchor_root": head, "oldest_block_slot": 0,
+        "oldest_block_root": head, "block_slots": {head: 0},
+        "state_root_by_block": {head: sroot},
+    }
+    s.put(Column.beacon_chain, b"persisted-head", pickle.dumps(meta))
+    rep = doctor.fsck_datadir(datadir)
+    # persisted head references a block+state the store does not have
+    assert not rep["ok"]
+    assert any("anchor incomplete" in p for p in rep["problems"])
+    s.put(Column.block, head, b"blockbytes")
+    s.put(Column.state, sroot, b"statebytes")
+    rep = doctor.fsck_datadir(datadir)
+    assert rep["ok"] and rep["anchor"]["complete"]
+
+    # a DB from the future is refused, not repaired
+    s.put(Column.metadata, bytes([0]) * 32, (99).to_bytes(8, "little"))
+    s.close()
+    rep = doctor.fsck_datadir(datadir, repair=True)
+    assert not rep["ok"]
+    assert any("newer than" in p for p in rep["problems"])
+
+
+# -------------------------------------------------------------- supervisor
+
+
+def test_supervisor_restarts_with_backoff_then_abandons():
+    import random
+
+    sup = Supervisor(name="t", max_restarts=3, backoff_base=0.001,
+                     backoff_cap=0.004, rng=random.Random(7))
+    calls = []
+
+    def always_dies():
+        calls.append(1)
+        raise RuntimeError("boom")
+
+    before = SERVICE_RESTARTS.labels("doomed").value
+    t = sup.spawn(always_dies, "doomed")
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert len(calls) == 4                       # initial + 3 restarts
+    assert sup.restarts["doomed"] == 3
+    assert sup.abandoned == ["doomed"]
+    assert SERVICE_RESTARTS.labels("doomed").value - before == 3
+
+    # backoff grows exponentially and is capped + jittered
+    assert sup.backoff(0) < sup.backoff(5) <= 0.004 * 1.25
+
+
+def test_supervisor_budget_is_consecutive_not_lifetime():
+    """A service that ran healthy past the backoff cap before crashing
+    starts a fresh restart budget: the cap exists for hot-crash loops, not
+    a long-lived loop with one transient crash a day."""
+    fake_now = {"t": 0.0}
+
+    sup = Supervisor(name="t4", max_restarts=2, backoff_base=0.001,
+                     backoff_cap=0.004, clock=lambda: fake_now["t"])
+    calls = []
+
+    def healthy_then_crash():
+        calls.append(1)
+        fake_now["t"] += 10.0            # "ran" well past the 0.004s cap
+        raise OSError("transient")
+
+    t = sup.spawn(healthy_then_crash, "longlived")
+    # every crash follows a long healthy run, so the budget keeps
+    # resetting and the service is never abandoned — it restarts until
+    # stop() ends supervision
+    t.join(timeout=0.3)
+    assert t.is_alive()
+    assert sup.abandoned == []
+    assert len(calls) > sup.max_restarts + 1   # outlived the lifetime budget
+    sup.stop(timeout=2.0)
+    assert not t.is_alive()
+
+
+def test_supervisor_recovery_and_stop():
+    sup = Supervisor(name="t2", max_restarts=5, backoff_base=0.001)
+    state = {"n": 0}
+
+    def flaky():
+        state["n"] += 1
+        if state["n"] < 3:
+            raise OSError("transient")
+
+    t = sup.spawn(flaky, "flaky")
+    t.join(timeout=10)
+    assert state["n"] == 3 and sup.abandoned == []   # recovered, then done
+
+    # stop() aborts a pending backoff immediately
+    sup2 = Supervisor(name="t3", max_restarts=5, backoff_base=30.0)
+    t2 = sup2.spawn(lambda: (_ for _ in ()).throw(RuntimeError("x")), "slow")
+    import time
+
+    time.sleep(0.05)                 # let it crash into its 30s backoff
+    sup2.stop(timeout=2.0)
+    assert not t2.is_alive()
+
+
+# ------------------------------------------------------- monitoring retry
+
+
+def test_monitoring_retry_recovers_and_counts():
+    import random
+
+    from lighthouse_tpu.utils.metrics import REGISTRY
+    from lighthouse_tpu.utils.monitoring import MonitoringService, _POSTS
+
+    sleeps = []
+    attempts = {"n": 0}
+
+    def flaky_post(_payload):
+        attempts["n"] += 1
+        if attempts["n"] < 3:
+            raise OSError("endpoint blip")
+
+    svc = MonitoringService(
+        "http://unused.invalid", post_fn=flaky_post, max_retries=2,
+        backoff_base=0.01, sleep_fn=sleeps.append, rng=random.Random(3),
+    )
+    retried_before = _POSTS.labels("retried").value
+    assert svc.tick()
+    assert svc.sent == 1 and svc.errors == 0
+    assert attempts["n"] == 3
+    assert _POSTS.labels("retried").value - retried_before == 2
+    # exponential backoff with jitter: second delay ~2x the first
+    assert len(sleeps) == 2 and sleeps[0] < sleeps[1] < 4 * sleeps[0]
+    assert 'monitoring_posts_total{result="retried"}' in REGISTRY.expose_text()
+
+
+def test_monitoring_retry_exhaustion_counts_one_error():
+    from lighthouse_tpu.utils.monitoring import MonitoringService
+
+    def dead_post(_payload):
+        raise OSError("no route")
+
+    svc = MonitoringService("http://unused.invalid", post_fn=dead_post,
+                            max_retries=2, backoff_base=0.001)
+    assert not svc.tick()
+    assert svc.errors == 1                      # one tick, ONE error
+
+
+# --------------------------------------------------- heartbeat accounting
+
+
+def test_heartbeat_errors_counted_not_swallowed():
+    from lighthouse_tpu.network import node as node_mod
+    from lighthouse_tpu.utils.logging import RECENT
+
+    n = object.__new__(node_mod.NetworkNode)
+    n.node_id = "hb-test"
+    n.heartbeat_interval = 0.0
+    ticks = {"n": 0}
+    n._hb_stop = SimpleNamespace(
+        wait=lambda _t: (ticks.__setitem__("n", ticks["n"] + 1),
+                         ticks["n"] > 1)[1]
+    )
+
+    def bad_heartbeat():
+        raise RuntimeError("mesh exploded")
+
+    n.gossipsub = SimpleNamespace(heartbeat=bad_heartbeat)
+
+    def bad_drain():
+        raise ValueError("sidecar bug")
+
+    n._drain_early_sidecars = bad_drain
+
+    g0 = node_mod._HEARTBEAT_ERRORS.labels("gossip").value
+    s0 = node_mod._HEARTBEAT_ERRORS.labels("sidecars").value
+    n._heartbeat_loop()               # one full iteration, then stop
+    assert node_mod._HEARTBEAT_ERRORS.labels("gossip").value == g0 + 1
+    assert node_mod._HEARTBEAT_ERRORS.labels("sidecars").value == s0 + 1
+    warns = [r for r in RECENT if r[2] == "network"
+             and "loop continues" in r[3]]
+    assert any("mesh exploded" in r[4].get("error", "") for r in warns)
+    assert any("sidecar bug" in r[4].get("error", "") for r in warns)
+
+
+# ------------------------------------------------- chain restart-resume
+
+
+VALIDATORS = 64
+
+
+@pytest.fixture(scope="module")
+def persisted_chain(tmp_path_factory):
+    """A real minimal-spec chain imported over a file-backed store, then
+    persisted — the module's resume tests reopen it read-only-ish."""
+    from lighthouse_tpu.chain.beacon_chain import BeaconChain
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.store.hot_cold import HotColdDB
+    from lighthouse_tpu.testing.harness import StateHarness, clone_state
+    from lighthouse_tpu.types.spec import minimal_spec
+
+    bls.set_backend("python")
+    tmp = tmp_path_factory.mktemp("resume")
+    spec = minimal_spec()
+    harness = StateHarness.new(spec, VALIDATORS)
+    store = HotColdDB(
+        spec,
+        hot=PurePythonKVStore(tmp / "hot.db", fsync="never"),
+        cold=MemoryStore(),
+    )
+    chain = BeaconChain(spec, clone_state(harness.state, spec), store=store)
+    roots = []
+    for _ in range(4):
+        slot = harness.state.slot + 1
+        signed, _post = harness.produce_block(slot, attestations=[],
+                                              full_sync=False)
+        harness.apply_block(signed)
+        chain.slot_clock.set_slot(slot)
+        chain.per_slot_task()
+        root = chain.verify_block_for_gossip(signed)
+        chain.process_block(signed, block_root=root,
+                            proposal_already_verified=True)
+        roots.append(root)
+    chain.persist()
+    store.hot.close()
+    return spec, tmp, chain, roots
+
+
+def _reopen(spec, tmp):
+    from lighthouse_tpu.store.hot_cold import HotColdDB
+
+    return HotColdDB(
+        spec,
+        hot=PurePythonKVStore(tmp / "hot.db", fsync="never"),
+        cold=MemoryStore(),
+    )
+
+
+def test_from_store_restores_head_and_checkpoints(persisted_chain):
+    from lighthouse_tpu.chain.beacon_chain import BeaconChain
+
+    spec, tmp, chain, roots = persisted_chain
+    store2 = _reopen(spec, tmp)
+    chain2 = BeaconChain.from_store(spec, store2)
+    assert chain2.head_root == chain.head_root == roots[-1]
+    assert int(chain2.head_state().slot) == int(chain.head_state().slot)
+    assert (chain2.fork_choice.store.justified_checkpoint
+            == chain.fork_choice.store.justified_checkpoint)
+    assert (chain2.fork_choice.store.finalized_checkpoint
+            == chain.fork_choice.store.finalized_checkpoint)
+    # the resumed chain keeps working: it can keep serving its head state
+    assert chain2.head_state() is not None
+    store2.hot.close()
+
+
+def test_from_store_corrupt_head_recovers_to_parent(persisted_chain):
+    """The crash window between fork-choice update and state write: the
+    persisted head's STATE is missing from the store. from_store must come
+    back on the best surviving block (the parent), not crash."""
+    from lighthouse_tpu.chain.beacon_chain import BeaconChain
+
+    spec, tmp, chain, roots = persisted_chain
+    store2 = _reopen(spec, tmp)
+    head_state_root = chain.state_root_by_block[chain.head_root]
+    store2.hot.delete(Column.state, head_state_root)
+    store2.hot.delete(Column.state_summary, head_state_root)
+    chain2 = BeaconChain.from_store(spec, store2)
+    assert chain2.head_root == roots[-2]          # parent of the lost head
+    assert chain2.head_root != chain.head_root
+    store2.hot.close()
+
+
+def test_from_store_unreadable_record_raises(persisted_chain):
+    from lighthouse_tpu.chain.beacon_chain import BeaconChain, BlockError
+
+    spec, tmp, chain, _roots = persisted_chain
+    store2 = _reopen(spec, tmp)
+    store2.put_chain_item(BeaconChain.PERSIST_HEAD_KEY, b"\x00garbage")
+    with pytest.raises(BlockError, match="unreadable"):
+        BeaconChain.from_store(spec, store2)
+    store2.hot.close()
+
+
+# ------------------------------------------------- crash_restart scenario
+
+
+def test_crash_restart_scenario_invariants(tmp_path):
+    from lighthouse_tpu.loadgen import get_scenario, run_scenario
+
+    sc = get_scenario("crash_restart")
+    report = run_scenario(sc, datadir=str(tmp_path / "dd1"))
+    crash = report["crash"]
+    assert crash["slot"] == sc.crash_slot
+    assert "torn write" in crash["fault"]
+    assert crash["resumed_from_persisted_head"]
+    assert crash["recovered_head_slot"] == sc.crash_slot - 1
+    assert crash["lost_to_crash"] > 0
+    cons = report["conservation"]
+    assert cons["ok"]
+    assert cons["published"] == (cons["processed"] + cons["dropped"]
+                                 + cons["expired"] + cons["lost_to_crash"])
+    # deterministic: same scenario, fresh datadir, identical counts
+    report2 = run_scenario(sc, datadir=str(tmp_path / "dd2"))
+    for key in ("published", "processed", "dropped", "expired",
+                "conservation"):
+        assert report[key] == report2[key], key
+    json.dumps(report)

@@ -126,6 +126,43 @@ def test_bn_loadtest_smoke_cli(tmp_path):
     assert report["elapsed_secs"] < 30
 
 
+def test_bn_loadtest_crash_restart_smoke_cli(tmp_path):
+    """The acceptance path: `bn loadtest --scenario crash_restart --smoke`
+    crashes the node mid-load via an injected storage fault, restarts it
+    from the same datadir, resumes from the persisted head, and the
+    extended conservation invariant holds."""
+    out = tmp_path / "report.json"
+    r = _run_cli(["-m", "lighthouse_tpu", "bn", "loadtest",
+                  "--scenario", "crash_restart", "--smoke", "--quiet",
+                  "--out", str(out), "--datadir", str(tmp_path / "dd")])
+    assert r.returncode == 0, r.stderr
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    assert summary["scenario"] == "crash_restart"
+    assert summary["crash"]["resumed_from_persisted_head"] is True
+    assert summary["conservation"]["ok"] is True
+    assert summary["conservation"]["lost_to_crash"] > 0
+    report = json.loads(out.read_text())
+    assert "torn write" in report["crash"]["fault"]
+    assert report["crash"]["recovered_head_slot"] == (
+        report["crash"]["slot"] - 1
+    )
+    assert report["elapsed_secs"] < 30
+
+
+def test_smoke_modifier_shrinks_named_scenarios():
+    """--smoke + --scenario X runs X at smoke scale: same shape (faults,
+    mix), clamped size, faults still inside the run."""
+    from lighthouse_tpu.loadgen import smoke_variant
+
+    big = get_scenario("steady")
+    small = smoke_variant(big)
+    assert small.n_validators <= 4096 and small.slots <= 8
+    assert small.name == "steady" and small.faults == big.faults
+    crash = smoke_variant(get_scenario("crash_restart", slots=3))
+    assert crash.crash_slot is not None
+    assert 1 <= crash.crash_slot <= crash.slots - 2
+
+
 def test_scripts_loadgen_smoke(tmp_path):
     out = tmp_path / "report.json"
     r = _run_cli(["scripts/loadgen.py", "--smoke", "--quiet",

@@ -107,6 +107,41 @@ def test_pure_python_store_semantics(tmp_path):
     store5.close()
 
 
+def test_fsync_policies_and_stale_tmp_sweep(tmp_path):
+    """Durability knobs: the three fsync policies all keep the same
+    crash-consistent format; a stale .compact tmp (crash mid-compaction)
+    is swept at open; flush() is a durability barrier under every policy."""
+    import pytest
+
+    from lighthouse_tpu.store.native_kv import PurePythonKVStore
+
+    for policy in ("always", "batch", "never"):
+        p = tmp_path / f"kv-{policy}.log"
+        s = PurePythonKVStore(p, fsync=policy)
+        s.put(Column.block, b"k", policy.encode())
+        s.flush()
+        s.close()
+        r = PurePythonKVStore(p, fsync=policy)
+        assert r.get(Column.block, b"k") == policy.encode()
+        r.compact()
+        r.close()
+        assert not (tmp_path / f"kv-{policy}.log.compact").exists()
+    with pytest.raises(ValueError, match="unknown fsync policy"):
+        PurePythonKVStore(tmp_path / "bad.log", fsync="sometimes")
+
+    # stale compaction tmp from a crash mid-compaction: swept at open, the
+    # live log untouched
+    p = tmp_path / "kv-sweep.log"
+    s = PurePythonKVStore(p)
+    s.put(Column.block, b"k", b"v")
+    s.close()
+    (tmp_path / "kv-sweep.log.compact").write_bytes(b"half a compaction")
+    s2 = PurePythonKVStore(p)
+    assert s2.get(Column.block, b"k") == b"v"
+    assert not (tmp_path / "kv-sweep.log.compact").exists()
+    s2.close()
+
+
 def test_native_load_failure_falls_back_to_python(tmp_path, monkeypatch):
     """When the shared library cannot be built/loaded (no g++, GLIBCXX
     mismatch), NativeKVStore(path) transparently constructs the
