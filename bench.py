@@ -115,6 +115,18 @@ def _set_headline(value, note):
 
 def _write_matrix():
     try:
+        # compiled-program analytics captured during the run (flops /
+        # bytes accessed / HBM regions per jit stage per padding bucket —
+        # observability/perf.py); best-effort, absent when nothing
+        # compiled before a watchdog exit
+        from lighthouse_tpu.observability import perf as _obs_perf
+
+        programs = _obs_perf.program_snapshot()
+        if programs:
+            _MATRIX["xla_programs"] = programs
+    except Exception as e:  # pragma: no cover - best effort
+        log(f"program analytics snapshot failed: {e}")
+    try:
         _MATRIX["elapsed_secs"] = round(_elapsed(), 1)
         _MATRIX["baseline_note"] = (
             "all vs_est_* ratios divide by ESTIMATED single-core blst/c-kzg "
@@ -451,6 +463,33 @@ def run_full_block(backend, fx, rng):
     }
 
 
+def run_stage_attribution(backend, fx, rng):
+    """Per-stage device attribution on the warmed headline bucket: two
+    attributed verifies (first timed resolve per stage classifies as the
+    stage's residual compile, the second as steady state), written as
+    stage -> {mean_ms, compile_s, roofline} so "0.143x est blst"
+    decomposes into per-stage utilization (observability/device.py)."""
+    from lighthouse_tpu.observability import device as obs_dev
+
+    log("[stage attribution] per-stage device seconds on the warmed bucket")
+    # full fixture width: the SAME padding bucket the headline warmed —
+    # a narrower batch would cold-compile a second bucket
+    sets = fx["att"]
+    rands = _rands(rng, len(sets))
+    with obs_dev.attributed():
+        assert backend.verify_signature_sets(sets, rands)
+        assert backend.verify_signature_sets(sets, rands)
+    snap = obs_dev.snapshot_stages(
+        device_kind=_DEVICE_KEY.get("device_kind")
+    )
+    if snap:
+        _MATRIX["stage_attribution"] = snap
+        for bucket, stages in snap.items():
+            for stage, st in stages.items():
+                log(f"  {bucket} {stage}: {st.get('mean_ms', '—')} ms "
+                    f"(compile {st.get('compile_s', 0.0)}s)")
+
+
 def run_kzg(fx):
     log("[config 4] KZG batch blob-proof verify")
     from lighthouse_tpu.crypto import kzg
@@ -545,6 +584,12 @@ def main():
 
     from lighthouse_tpu.crypto.bls import api as bls_api
 
+    # capture compiled-program cost/memory analytics for every bucket the
+    # run compiles (rides the XLA compile cache: re-trace, never re-compile)
+    from lighthouse_tpu.observability import perf as _obs_perf
+
+    _obs_perf.set_analytics(True)
+
     backend = bls_api.set_backend("jax")
     rng = random.Random(0xBE7C)
 
@@ -582,6 +627,8 @@ def main():
                 log(f"[{name}] FAILED: {type(e).__name__}: {e}")
                 _MATRIX[f"{name}_error"] = f"{type(e).__name__}: {e}"
 
+        attempt("stage_attr", 240,
+                lambda: run_stage_attribution(backend, fx, rng))
         attempt("config1", 300, lambda: run_single_fav(backend, fx, rng))
         attempt("config3", 420, lambda: run_sync_aggregate(backend, fx, rng))
         attempt("config2", 600, lambda: run_full_block(backend, fx, rng))

@@ -20,7 +20,12 @@ Schema (version 1):
       "buckets": [
         {"n_sets": 64, "n_pks": 128, "samples": 8,
          "compile_secs": 616.2,                     # null when unmeasured
-         "p50_ms": 640.0, "p99_ms": 700.0, "sets_per_sec": 99.85}
+         "p50_ms": 640.0, "p99_ms": 700.0, "sets_per_sec": 99.85,
+         "programs": {                              # optional: per-stage
+           "prepare": {"flops": 1.2e9,              # compiled-program
+                       "bytes_accessed": 3.4e8,     # analytics
+                       "argument_bytes": 123,       # (observability/perf.py)
+                       "output_bytes": 456, "temp_bytes": 789}}}
       ]
     }
 
@@ -42,8 +47,10 @@ SCHEMA_VERSION = 1
 
 # Bump when the jaxbls kernel structure changes enough that measured
 # compile/dispatch numbers stop transferring (mirrors the implicit
-# invalidation of the persistent jit cache).
-BACKEND_REVISION = "r5"
+# invalidation of the persistent jit cache). r6: named scopes on the
+# fused-kernel variants + profiles now carry per-stage compiled-program
+# analytics next to the timings.
+BACKEND_REVISION = "r6"
 
 
 @dataclass
@@ -57,9 +64,13 @@ class BucketProfile:
     p50_ms: float | None = None
     p99_ms: float | None = None
     sets_per_sec: float | None = None
+    # per-stage compiled-program analytics (flops / bytes accessed / HBM
+    # regions) captured by observability/perf.py — optional, absent on
+    # profiles measured without analytics enabled
+    programs: dict | None = None
 
     def to_json(self) -> dict:
-        return {
+        out = {
             "n_sets": int(self.n_sets),
             "n_pks": int(self.n_pks),
             "samples": int(self.samples),
@@ -68,9 +79,18 @@ class BucketProfile:
             "p99_ms": self.p99_ms,
             "sets_per_sec": self.sets_per_sec,
         }
+        if self.programs:
+            out["programs"] = {
+                str(stage): dict(stats)
+                for stage, stats in self.programs.items()
+            }
+        return out
 
     @classmethod
     def from_json(cls, d: dict) -> "BucketProfile":
+        programs = d.get("programs")
+        if programs is not None and not isinstance(programs, dict):
+            raise ValueError("bucket 'programs' must be an object")
         return cls(
             n_sets=int(d["n_sets"]),
             n_pks=int(d["n_pks"]),
@@ -79,6 +99,7 @@ class BucketProfile:
             p50_ms=_opt_float(d.get("p50_ms")),
             p99_ms=_opt_float(d.get("p99_ms")),
             sets_per_sec=_opt_float(d.get("sets_per_sec")),
+            programs=dict(programs) if programs else None,
         )
 
 

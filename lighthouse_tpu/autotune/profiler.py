@@ -70,6 +70,7 @@ class _BucketRecorder:
     __slots__ = (
         "n_sets", "n_pks", "compile_secs", "lats", "total_sets",
         "total_secs", "hist", "rate_gauge", "compile_gauge", "seen_first",
+        "programs",
     )
 
     def __init__(self, n_sets: int, n_pks: int):
@@ -80,6 +81,8 @@ class _BucketRecorder:
         self.total_sets = 0
         self.total_secs = 0.0
         self.seen_first = False
+        # stage -> compiled-program analytics (observability/perf.py)
+        self.programs: dict = {}
         self.hist = _DISPATCH_SECONDS.labels(n_sets, n_pks)
         self.rate_gauge = _SETS_PER_SEC.labels(n_sets, n_pks)
         self.compile_gauge = _COMPILE_SECONDS.labels(n_sets, n_pks)
@@ -167,6 +170,18 @@ def observe_compile(n_sets: int, n_pks: int, secs: float) -> None:
         pass
 
 
+def observe_program(n_sets: int, n_pks: int, stage: str, stats: dict) -> None:
+    """Compiled-program analytics for one jit stage at one bucket
+    (flops / bytes accessed / HBM regions — observability/perf.py), so
+    the persisted profile carries the program shape next to the measured
+    timings."""
+    try:
+        with _lock:
+            _recorder(n_sets, n_pks).programs[str(stage)] = dict(stats)
+    except Exception:
+        pass  # never raise into the capture path
+
+
 def snapshot_buckets() -> dict:
     """(n_sets, n_pks) -> BucketProfile for every bucket observed so far.
 
@@ -185,6 +200,7 @@ def snapshot_buckets() -> dict:
             n_sets=rec.n_sets,
             n_pks=rec.n_pks,
             compile_secs=rec.compile_secs,
+            programs=dict(rec.programs) or None,
         )
         if st is not None:
             bp.samples = st["samples"]

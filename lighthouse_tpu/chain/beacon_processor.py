@@ -355,6 +355,13 @@ class BeaconProcessor:
         OLDEST item's queue residency (== the max wait in the unit), the
         coalesce span the pop/batch-form step itself."""
         self._m_wait[kind].observe(t_pop - oldest.t_enq)
+        # sample the per-kind queue-depth gauges into the tracer's counter
+        # ring: the Chrome trace export renders them as counter rows
+        # ("ph": "C") so backlog is visible next to the pipeline spans
+        obs.TRACER.sample_counters(
+            "queue_depth",
+            {k.name: g.value for k, g in self._m_depth.items()},
+        )
         trace = obs.TRACER.begin(kind.name, n)
         trace.add_span("enqueue", oldest.t_enq, t_pop)
         trace.add_span("coalesce", t_pop, perf_counter(), items=n)

@@ -535,6 +535,17 @@ def cmd_bn(args):
     )
     log.info("metrics server started", addr=args.metrics_address, port=mport)
 
+    if getattr(args, "device_trace", False):
+        # per-stage device attribution: every jaxbls dispatch is followed
+        # by event-timed per-stage resolves feeding jaxbls_stage_* series
+        # and device:<stage> lanes in the --trace-out export. Serializes
+        # the dispatch pipeline — a diagnostic mode, not a serving mode.
+        from .observability import device as _obs_device
+
+        _obs_device.set_enabled(True)
+        log.info("per-stage device attribution enabled (--device-trace); "
+                 "dispatch pipelining is serialized while active")
+
     tracer = None
     if getattr(args, "trace_out", None):
         # pipeline tracing is always on (bounded ring); --trace-out adds a
@@ -923,6 +934,25 @@ def cmd_doctor(args):
     return 0 if report["ok"] else 1
 
 
+# ------------------------------------------------------------------ perf
+
+
+def cmd_perf(args):
+    """`bn perf report`: per-config trend + regression verdict over the
+    checked-in BENCH_r*/MULTICHIP_r* round artifacts and the current
+    BENCH_MATRIX.json (observability/perf.py). Stdlib-only — runs on CPU
+    with no device attached; --check exits nonzero on a >threshold
+    fresh-to-fresh regression (the CI gate scripts/perf_trend.py shares)."""
+    from .observability import perf as obs_perf
+
+    return obs_perf.run_report(
+        root=args.root,
+        check_mode=args.check,
+        threshold=args.threshold,
+        as_json=args.json,
+    )
+
+
 # ------------------------------------------------------------------ autotune
 
 
@@ -1280,7 +1310,10 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="lighthouse-tpu", description=__doc__)
     sub = p.add_subparsers(dest="command", required=True)
 
-    bn = sub.add_parser("bn", help="run a beacon node")
+    # allow_abbrev=False: the outer parser's option scan must not
+    # prefix-match flags meant for sub-subcommands (e.g. `bn perf report
+    # --check` vs bn's --checkpoint-*)
+    bn = sub.add_parser("bn", help="run a beacon node", allow_abbrev=False)
     _add_spec_arg(bn)
     bn.add_argument("--http-port", type=int, default=5052)
     bn.add_argument("--metrics-port", type=int, default=5054)
@@ -1472,12 +1505,20 @@ def build_parser() -> argparse.ArgumentParser:
                          "this path at shutdown; also runs a synthetic "
                          "pipeline probe at startup so a quiet node still "
                          "traces every stage")
+    bn.add_argument("--device-trace", action="store_true",
+                    help="attribute device time per jit stage (prepare/"
+                         "h2c/pairs/pairing): event-timed resolves feed "
+                         "jaxbls_stage_device_seconds{stage,n_sets,n_pks} "
+                         "and add device:<stage> lanes to the --trace-out "
+                         "export; SERIALIZES the dispatch pipeline, so "
+                         "use for diagnosis, not serving")
     bn.set_defaults(fn=cmd_bn)
 
-    # `bn loadtest` / `bn doctor`: operator sub-subcommands (loadgen
-    # driver; datadir fsck). Optional — plain `bn` still runs the node.
+    # `bn loadtest` / `bn doctor` / `bn perf`: operator sub-subcommands
+    # (loadgen driver; datadir fsck; bench trend report). Optional —
+    # plain `bn` still runs the node.
     bnsub = bn.add_subparsers(dest="bn_command", required=False,
-                              metavar="{loadtest,doctor}")
+                              metavar="{loadtest,doctor,perf}")
     bnlt = bnsub.add_parser(
         "loadtest",
         help="run a deterministic loadgen scenario (mainnet-shaped gossip "
@@ -1506,6 +1547,31 @@ def build_parser() -> argparse.ArgumentParser:
                             "tail back to the last valid record and delete "
                             "stray compaction tmp files")
     bndoc.set_defaults(fn=cmd_doctor)
+
+    bnperf = bnsub.add_parser(
+        "perf",
+        help="bench trend tooling over the checked-in BENCH_r*/"
+             "MULTICHIP_r* artifacts (per-config deltas, carried-forward "
+             "rounds flagged, regression verdict); host-only, no device",
+    )
+    perfsub = bnperf.add_subparsers(dest="perf_command", required=True)
+    bnpr = perfsub.add_parser(
+        "report",
+        help="print the per-config trend + regression verdict "
+             "(--check exits nonzero on a >threshold regression)",
+    )
+    bnpr.add_argument("--root", default=None,
+                      help="directory holding the BENCH_r*/MULTICHIP_r* "
+                           "artifacts (default: the install's repo root)")
+    bnpr.add_argument("--check", action="store_true",
+                      help="exit nonzero when a fresh-to-fresh delta drops "
+                           "more than the threshold (CI gate)")
+    bnpr.add_argument("--threshold", type=float, default=0.10,
+                      help="regression threshold as a fraction "
+                           "(default 0.10 = 10%%)")
+    bnpr.add_argument("--json", action="store_true",
+                      help="emit the full report as JSON instead of text")
+    bnpr.set_defaults(fn=cmd_perf)
 
     vc = sub.add_parser("vc", help="run a validator client")
     _add_spec_arg(vc)

@@ -343,9 +343,12 @@ def final_exponentiation_fused(m, *, interpret=False):
 
 
 def pairing_product_is_one_fused(p_aff, q_aff, valid_mask, *, interpret=False):
-    f = miller_loop_product_fused(p_aff, q_aff, valid_mask, interpret=interpret)
-    f = final_exponentiation_fused(f, interpret=interpret)
-    return tw.fq12_eq_one(f)
+    with jax.named_scope("jaxbls/pairing_fused"):
+        f = miller_loop_product_fused(
+            p_aff, q_aff, valid_mask, interpret=interpret
+        )
+        f = final_exponentiation_fused(f, interpret=interpret)
+        return tw.fq12_eq_one(f)
 
 
 # ---------------------------------------------------------- hash-to-G2
@@ -514,6 +517,15 @@ def _prepare_kernel(pbits_ref, *refs):
 def stage_prepare_fused(pk_x, pk_y, pk_mask, sig_x, sig_y, z_digits, set_mask,
                         *, interpret=False):
     """Drop-in for backend._stage_prepare via the fused kernel."""
+    with jax.named_scope("jaxbls/prepare_fused"):
+        return _stage_prepare_fused(
+            pk_x, pk_y, pk_mask, sig_x, sig_y, z_digits, set_mask,
+            interpret=interpret,
+        )
+
+
+def _stage_prepare_fused(pk_x, pk_y, pk_mask, sig_x, sig_y, z_digits, set_mask,
+                         *, interpret=False):
     pl, pltpu = _pl()
     n = pk_x.shape[0]
     fq = jax.ShapeDtypeStruct((n, lb.NL), jnp.uint32)
@@ -586,6 +598,13 @@ def _const_np(name: str):
 
 def stage_pairs_fused(z_pk, h_jac, sig_acc, set_mask, *, interpret=False):
     """Drop-in for backend._stage_pairs via the fused kernel."""
+    with jax.named_scope("jaxbls/pairs_fused"):
+        return _stage_pairs_fused(
+            z_pk, h_jac, sig_acc, set_mask, interpret=interpret
+        )
+
+
+def _stage_pairs_fused(z_pk, h_jac, sig_acc, set_mask, *, interpret=False):
     pl, pltpu = _pl()
     n = z_pk[0].shape[0]
     fq1 = jax.ShapeDtypeStruct((n, lb.NL), jnp.uint32)
@@ -656,6 +675,11 @@ def hash_to_g2_fused(us, *, interpret=False):
     4 sets on a v5e against the 16 MB default limit (the 758-bit
     sqrt_ratio chain keeps many live Fq2 temporaries), so one big block
     would both OOM the stack and scale with n."""
+    with jax.named_scope("jaxbls/h2c_fused"):
+        return _hash_to_g2_fused(us, interpret=interpret)
+
+
+def _hash_to_g2_fused(us, *, interpret=False):
     import math
 
     pl, pltpu = _pl()

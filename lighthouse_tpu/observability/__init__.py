@@ -10,6 +10,13 @@ the system's hot path; this package makes it legible from the outside:
     Chrome trace-event (Perfetto) JSON (`bn --trace-out trace.json`).
   - `pipeline`: the stage-timing snapshot behind the
     `/lighthouse_tpu/pipeline` ops endpoint.
+  - `device`: per-stage device-time attribution for the jaxbls dispatch
+    (named annotation scopes always; event-timed per-stage resolves +
+    `device:<stage>` trace lanes under `bn --device-trace`).
+  - `perf`: compiled-program analytics (`xla_program_*` gauges from XLA
+    cost/memory analysis), roofline derivation, and the BENCH_r*/
+    MULTICHIP_r* trend + regression gate (`bn perf report`,
+    scripts/perf_trend.py).
 
 Always-on by design: recording a trace is appending a few floats to a
 deque, so there is no enabled/disabled bifurcation to test — `--trace-out`
@@ -26,3 +33,4 @@ from .trace import (  # noqa: F401
     set_current_trace,
 )
 from .pipeline import register_processor, snapshot  # noqa: F401
+from . import device, perf  # noqa: F401  (registers the device/xla families)

@@ -1,0 +1,618 @@
+"""Compiled-program analytics, roofline attribution, and the bench trend
+gate.
+
+Three jobs, one module, zero jax at import time:
+
+1. **Program analytics** — at stage compile time (warm_stages, or the
+   first attributed dispatch per bucket) capture the compiled XLA
+   program's `cost_analysis()` / `memory_analysis()`: flops, bytes
+   accessed, and the HBM footprint split (argument/output/temp/generated
+   code). Each capture lands as labeled `xla_program_*` gauges, in the
+   autotune profiler's per-bucket recorders (so the persisted device
+   profile carries the program shape next to the measured timings —
+   autotune/profile.py `programs`), and in the in-memory snapshot
+   bench.py writes into BENCH artifacts. The `.lower().compile()` pair
+   rides the persistent XLA compilation cache (utils/jaxcfg.py), so a
+   stage that already compiled via the normal call path re-traces but
+   never re-compiles.
+
+2. **Roofline** — `roofline(stats, secs, device_kind)` turns a program's
+   flops/bytes plus a measured stage time into achieved-FLOP/s and
+   achieved-bytes/s against an ESTIMATED peak for the device kind
+   (`PEAK_ESTIMATES`, overridable via LIGHTHOUSE_TPU_PEAK_FLOPS /
+   LIGHTHOUSE_TPU_PEAK_HBM_GBPS). The verdict decomposes "0.143x est
+   blst" into per-stage utilization: a stage at 2% of peak flops and 60%
+   of HBM bandwidth is memory-bound and wants layout work, not math.
+   Peaks are estimates — every roofline dict says so.
+
+3. **Bench trend** — `trend_report()` parses the checked-in
+   `BENCH_r*.json` / `MULTICHIP_r*.json` round series plus the current
+   `BENCH_MATRIX.json`, renders carried-forward rounds distinctly
+   (a round whose record is skipped — `"skipped": true`, a zero value,
+   or a tunnel-UNAVAILABLE marker — inherits the latest fresh value,
+   flagged, so a stale number is never read as a fresh measurement),
+   computes fresh-to-fresh deltas, and flags >threshold regressions.
+   `check()` is the gate: nonzero on regression. `bn perf report` and
+   `scripts/perf_trend.py` are thin CLIs over `run_report()`; the
+   aggregate also surfaces on `/lighthouse_tpu/pipeline` via
+   `trend_summary()`. All stdlib — runs on CPU with no device attached.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import threading
+
+from ..utils.metrics import REGISTRY
+
+# ------------------------------------------------------------------ metrics
+
+XLA_PROGRAM_FLOPS = REGISTRY.gauge_vec(
+    "xla_program_flops",
+    "XLA cost_analysis flop count of the compiled stage program, by jit "
+    "stage and padding bucket",
+    ("stage", "n_sets", "n_pks"),
+)
+XLA_PROGRAM_BYTES_ACCESSED = REGISTRY.gauge_vec(
+    "xla_program_bytes_accessed",
+    "XLA cost_analysis bytes-accessed estimate of the compiled stage "
+    "program, by jit stage and padding bucket",
+    ("stage", "n_sets", "n_pks"),
+)
+XLA_PROGRAM_HBM_BYTES = REGISTRY.gauge_vec(
+    "xla_program_hbm_bytes",
+    "compiled-program memory footprint from XLA memory_analysis, by jit "
+    "stage, padding bucket and region (argument/output/temp/generated_code)",
+    ("stage", "n_sets", "n_pks", "region"),
+)
+
+_lock = threading.Lock()
+_programs: dict = {}       # (stage, (n, m)) -> stats dict
+_analytics_override: bool | None = None
+
+#: rough peak (flops/s, HBM bytes/s) per device kind PREFIX — estimates
+#: for roofline context, not measurements (v5e: ~197 TFLOP/s bf16,
+#: ~819 GB/s HBM; v4: ~275/1228; v5p: ~459/2765; CPU numbers are a
+#: placeholder for dry runs). Longest matching prefix wins.
+PEAK_ESTIMATES = {
+    "TPU v5 lite": (197e12, 819e9),
+    "TPU v5e": (197e12, 819e9),
+    "TPU v5p": (459e12, 2765e9),
+    "TPU v4": (275e12, 1228e9),
+    "TPU v6": (918e12, 1640e9),
+    "cpu": (2e11, 8e10),
+}
+
+
+def set_analytics(on: bool | None) -> bool | None:
+    """Force program-analytics capture on/off; returns the previous
+    override so scoped callers can restore it."""
+    global _analytics_override
+    prev = _analytics_override
+    _analytics_override = None if on is None else bool(on)
+    return prev
+
+
+def analytics_enabled() -> bool:
+    if _analytics_override is not None:
+        return _analytics_override
+    env = os.environ.get("LIGHTHOUSE_TPU_PROGRAM_ANALYTICS", "").lower()
+    return env in ("1", "on", "yes", "true")
+
+
+def maybe_capture_program(stage: str, jitted_fn, args, bucket: tuple):
+    """capture_program once per (stage, bucket); later calls are free."""
+    key = (stage, (int(bucket[0]), int(bucket[1])))
+    with _lock:
+        if key in _programs:
+            return _programs[key]
+    return capture_program(stage, jitted_fn, args, bucket)
+
+
+def capture_program(stage: str, jitted_fn, args, bucket: tuple) -> dict | None:
+    """Lower+compile one jit stage at concrete args and record its cost/
+    memory analysis. Best-effort: any failure returns None and records
+    nothing (a node on an exotic backend must not lose the verify path
+    to a diagnostics call)."""
+    n, m = int(bucket[0]), int(bucket[1])
+    try:
+        compiled = jitted_fn.lower(*args).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        ca = ca or {}
+        stats = {
+            "flops": float(ca.get("flops", 0.0)),
+            "bytes_accessed": float(ca.get("bytes accessed", 0.0)),
+        }
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            stats.update(
+                argument_bytes=int(getattr(ma, "argument_size_in_bytes", 0)),
+                output_bytes=int(getattr(ma, "output_size_in_bytes", 0)),
+                temp_bytes=int(getattr(ma, "temp_size_in_bytes", 0)),
+                generated_code_bytes=int(
+                    getattr(ma, "generated_code_size_in_bytes", 0)
+                ),
+            )
+    except Exception:
+        return None
+    record_program(stage, bucket, stats)
+    return stats
+
+
+def record_program(stage: str, bucket: tuple, stats: dict) -> None:
+    """Publish one program's stats: gauges, snapshot, autotune recorder."""
+    n, m = int(bucket[0]), int(bucket[1])
+    XLA_PROGRAM_FLOPS.labels(stage, n, m).set(stats.get("flops", 0.0))
+    XLA_PROGRAM_BYTES_ACCESSED.labels(stage, n, m).set(
+        stats.get("bytes_accessed", 0.0)
+    )
+    for region in ("argument", "output", "temp", "generated_code"):
+        v = stats.get(f"{region}_bytes")
+        if v is not None:
+            XLA_PROGRAM_HBM_BYTES.labels(stage, n, m, region).set(v)
+    with _lock:
+        _programs[(stage, (n, m))] = dict(stats)
+    try:
+        from ..autotune import profiler
+
+        profiler.observe_program(n, m, stage, stats)
+    except Exception:
+        pass  # diagnostics must never raise into the dispatch path
+
+
+def program_stats(stage: str, bucket: tuple) -> dict | None:
+    with _lock:
+        st = _programs.get((stage, (int(bucket[0]), int(bucket[1]))))
+    return dict(st) if st else None
+
+
+def program_snapshot() -> dict:
+    """{"<n>x<m>": {stage: stats}} for everything captured so far."""
+    with _lock:
+        items = list(_programs.items())
+    out: dict = {}
+    for (stage, (n, m)), stats in items:
+        out.setdefault(f"{n}x{m}", {})[stage] = dict(stats)
+    return out
+
+
+def reset_programs() -> None:
+    """Drop captured program stats (tests)."""
+    with _lock:
+        _programs.clear()
+
+
+# ----------------------------------------------------------------- roofline
+
+
+def peak_for(device_kind: str | None) -> tuple | None:
+    """(peak flops/s, peak HBM bytes/s) ESTIMATE for a device kind.
+    Env overrides (LIGHTHOUSE_TPU_PEAK_FLOPS teraflops/s,
+    LIGHTHOUSE_TPU_PEAK_HBM_GBPS gigabytes/s) beat the table."""
+    env_f = os.environ.get("LIGHTHOUSE_TPU_PEAK_FLOPS")
+    env_b = os.environ.get("LIGHTHOUSE_TPU_PEAK_HBM_GBPS")
+    if env_f and env_b:
+        return float(env_f) * 1e12, float(env_b) * 1e9
+    if not device_kind:
+        return None
+    best = None
+    for prefix, peaks in PEAK_ESTIMATES.items():
+        if device_kind.lower().startswith(prefix.lower()):
+            if best is None or len(prefix) > best[0]:
+                best = (len(prefix), peaks)
+    if best is None:
+        return None
+    pf, pb = best[1]
+    if env_f:
+        pf = float(env_f) * 1e12
+    if env_b:
+        pb = float(env_b) * 1e9
+    return pf, pb
+
+
+def roofline(stats: dict, secs: float, device_kind: str | None) -> dict | None:
+    """Achieved vs estimated-peak throughput for one stage execution.
+
+    `stats` is a capture_program dict; `secs` a measured wall time for
+    one execution of that program. Returns achieved flops/s + bytes/s,
+    utilization fractions where a peak estimate exists, and which wall
+    the stage is closer to ("compute" vs "memory")."""
+    if not secs or secs <= 0:
+        return None
+    flops = float(stats.get("flops") or 0.0)
+    byts = float(stats.get("bytes_accessed") or 0.0)
+    out = {
+        "seconds": round(secs, 6),
+        "achieved_gflops_per_sec": round(flops / secs / 1e9, 3),
+        "achieved_gbytes_per_sec": round(byts / secs / 1e9, 3),
+        "peak_note": "peaks are ESTIMATES (PEAK_ESTIMATES / env overrides)",
+    }
+    peaks = peak_for(device_kind)
+    if peaks is not None:
+        pf, pb = peaks
+        fu = flops / secs / pf if pf else 0.0
+        bu = byts / secs / pb if pb else 0.0
+        out.update(
+            flops_utilization=round(fu, 6),
+            hbm_utilization=round(bu, 6),
+            bound="memory" if bu > fu else "compute",
+            device_kind=device_kind,
+        )
+    return out
+
+
+# ------------------------------------------------------------- bench trend
+
+#: every vs_est_* denominator in bench.py is an estimate; the report
+#: header must say so (BASELINE.md / bench.py baseline_note)
+EST_CAVEAT = (
+    "vs_est_*/vs_baseline ratios divide by ESTIMATED single-core "
+    "blst/c-kzg throughputs (EST_* constants in bench.py) — "
+    "estimated, not measured"
+)
+
+DEFAULT_REGRESSION_THRESHOLD = 0.10
+
+
+def default_root() -> str:
+    """Repo root (where the BENCH_r*/MULTICHIP_r* artifacts live)."""
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def _round_files(root: str, pattern: str) -> list:
+    out = []
+    for path in glob.glob(os.path.join(root, pattern)):
+        m = re.search(r"_r(\d+)\.json$", path)
+        if m:
+            out.append((int(m.group(1)), path))
+    return sorted(out)
+
+
+def load_bench_rounds(root: str | None = None) -> list:
+    """BENCH_r*.json -> round dicts, oldest first, with skipped rounds
+    carrying forward the latest fresh value (flagged, never silently)."""
+    root = root or default_root()
+    rounds = []
+    for n, path in _round_files(root, "BENCH_r*.json"):
+        name = os.path.basename(path)
+        try:
+            with open(path) as f:
+                parsed = (json.load(f) or {}).get("parsed") or {}
+        except (OSError, json.JSONDecodeError, AttributeError):
+            parsed = {}
+        metric = str(parsed.get("metric", ""))
+        try:
+            value = float(parsed.get("value") or 0.0)
+        except (TypeError, ValueError):
+            value = 0.0
+        vs_est = parsed.get("vs_baseline")
+        # a round is FRESH only when it measured something: an explicit
+        # skipped flag, a zero value, or a tunnel-outage marker in the
+        # metric string all mean "no measurement this run"
+        skipped = (
+            bool(parsed.get("skipped"))
+            or value <= 0.0
+            or "UNAVAILABLE" in metric.upper()
+            or "SKIPPED" in metric.upper()
+        )
+        rounds.append(
+            {
+                "round": n,
+                "source": name,
+                "fresh": not skipped and bool(parsed),
+                "value": value if not skipped else (value or None),
+                "vs_est": vs_est if not skipped else None,
+                "raw_vs_est": vs_est,
+                "note": parsed.get("note"),
+            }
+        )
+    last_fresh = None
+    for r in rounds:
+        if r["fresh"]:
+            last_fresh = r
+            r["carried"] = False
+            continue
+        if r["value"]:
+            # the artifact itself carried a value forward (bench.py
+            # _tunnel_down since r5): keep its value AND vs ratio, and
+            # name the source round it cites (falling back to the latest
+            # fresh round we saw)
+            r["carried"] = True
+            m = re.search(r"BENCH_r\d+\.json", r.get("note") or "")
+            r["carried_from"] = m.group(0) if m else (
+                last_fresh["source"] if last_fresh else "artifact carry-forward"
+            )
+            if r["vs_est"] is None:
+                r["vs_est"] = r["raw_vs_est"]
+        elif last_fresh is not None:
+            r["carried"] = True
+            r["carried_from"] = last_fresh["source"]
+            r["value"] = last_fresh["value"]
+            r["vs_est"] = last_fresh["vs_est"]
+        else:
+            r["carried"] = False
+    for r in rounds:
+        r.pop("raw_vs_est", None)
+    return rounds
+
+
+def load_multichip_rounds(root: str | None = None) -> list:
+    root = root or default_root()
+    rounds = []
+    for n, path in _round_files(root, "MULTICHIP_r*.json"):
+        try:
+            with open(path) as f:
+                d = json.load(f) or {}
+        except (OSError, json.JSONDecodeError):
+            d = {}
+        rounds.append(
+            {
+                "round": n,
+                "source": os.path.basename(path),
+                "skipped": bool(d.get("skipped")),
+                "ok": bool(d.get("ok")),
+                "n_devices": d.get("n_devices"),
+            }
+        )
+    return rounds
+
+
+_RATE_KEYS = (
+    "sets_per_sec", "verifies_per_sec", "blocks_per_sec", "blobs_per_sec",
+)
+
+
+def load_matrix(root: str | None = None, name: str = "BENCH_MATRIX.json") -> dict:
+    """Per-config summary of the current measurement matrix, with
+    config*_skipped / config*_error flags kept distinct from measured
+    configs (a skipped config must never read as a measured one)."""
+    root = root or default_root()
+    try:
+        with open(os.path.join(root, name)) as f:
+            matrix = json.load(f) or {}
+    except (OSError, json.JSONDecodeError):
+        return {}
+    out: dict = {}
+    for key, val in matrix.items():
+        m = re.match(r"^(config\d+)(?:_(skipped|error))?", key)
+        if not m:
+            continue
+        config, flag = m.group(1), m.group(2)
+        entry = out.setdefault(config, {})
+        if flag:
+            entry[flag] = val
+            continue
+        if not isinstance(val, dict):
+            continue
+        entry["name"] = key
+        for rk in _RATE_KEYS:
+            if rk in val:
+                entry["rate"] = float(val[rk])
+                entry["rate_unit"] = rk
+                break
+        for k in ("p50_ms", "p99_ms"):
+            if k in val:
+                entry[k] = val[k]
+        for k, v in val.items():
+            if k.startswith("vs_est"):
+                entry["vs_est"] = v
+                entry["vs_est_key"] = k
+    return out
+
+
+def trend_report(
+    root: str | None = None,
+    threshold: float = DEFAULT_REGRESSION_THRESHOLD,
+) -> dict:
+    """The full per-config trend + regression verdict over the checked-in
+    artifacts. Regressions compare FRESH values only — carried-forward
+    rounds can neither cause nor mask one."""
+    root = root or default_root()
+    bench = load_bench_rounds(root)
+    multichip = load_multichip_rounds(root)
+    matrix = load_matrix(root)
+    regressions = []
+
+    fresh = [r for r in bench if r["fresh"]]
+    deltas = []
+    for prev, cur in zip(fresh, fresh[1:]):
+        delta = (cur["value"] - prev["value"]) / prev["value"]
+        deltas.append(
+            {
+                "config": "headline",
+                "from": prev["source"],
+                "to": cur["source"],
+                "delta_pct": round(delta * 100.0, 2),
+            }
+        )
+        if delta < -threshold:
+            regressions.append(
+                {
+                    "config": "headline",
+                    "prev": prev["value"],
+                    "cur": cur["value"],
+                    "from": prev["source"],
+                    "to": cur["source"],
+                    "delta_pct": round(delta * 100.0, 2),
+                }
+            )
+
+    mc_fresh = [r for r in multichip if not r["skipped"]]
+    if mc_fresh and not mc_fresh[-1]["ok"] and any(r["ok"] for r in mc_fresh[:-1]):
+        last_ok = [r for r in mc_fresh[:-1] if r["ok"]][-1]
+        regressions.append(
+            {
+                "config": "multichip",
+                "prev": "ok",
+                "cur": "failed",
+                "from": last_ok["source"],
+                "to": mc_fresh[-1]["source"],
+                "delta_pct": None,
+            }
+        )
+
+    return {
+        "caveat": EST_CAVEAT,
+        "threshold_pct": round(threshold * 100.0, 1),
+        "headline": {"rounds": bench, "deltas": deltas},
+        "multichip": {"rounds": multichip},
+        "matrix": matrix,
+        "regressions": regressions,
+        "ok": not regressions,
+    }
+
+
+def check(
+    root: str | None = None,
+    threshold: float = DEFAULT_REGRESSION_THRESHOLD,
+) -> tuple:
+    """(exit_code, report): nonzero when any fresh-to-fresh delta drops
+    more than `threshold` (the CI gate behind scripts/perf_trend.py
+    --check and the lint gate)."""
+    report = trend_report(root, threshold)
+    return (0 if report["ok"] else 1), report
+
+
+_trend_cache: dict = {}  # root -> (monotonic deadline, summary)
+
+
+def trend_summary(root: str | None = None) -> dict | None:
+    """Small cached aggregate for /lighthouse_tpu/pipeline: the latest
+    headline round (with its carried-forward flag), the regression
+    verdict, and the estimate caveat. None when no artifacts exist."""
+    import time
+
+    root = root or default_root()
+    now = time.monotonic()
+    hit = _trend_cache.get(root)
+    if hit is not None and hit[0] > now:
+        return hit[1]
+    try:
+        report = trend_report(root)
+    except Exception:
+        return None
+    rounds = report["headline"]["rounds"]
+    if not rounds and not report["matrix"]:
+        return None
+    latest = rounds[-1] if rounds else None
+    summary = {
+        "caveat": report["caveat"],
+        "regressions": len(report["regressions"]),
+        "ok": report["ok"],
+    }
+    if latest is not None:
+        summary["headline_latest"] = {
+            "source": latest["source"],
+            "value_sets_per_sec": latest["value"],
+            "vs_est_blst": latest["vs_est"],
+            "fresh": latest["fresh"],
+            **(
+                {"carried_from": latest.get("carried_from")}
+                if latest.get("carried")
+                else {}
+            ),
+        }
+    _trend_cache[root] = (now + 30.0, summary)
+    return summary
+
+
+# ------------------------------------------------------------ report render
+
+
+def render_report(report: dict) -> str:
+    """Human-readable trend report (bn perf report / scripts/perf_trend.py).
+    Carried-forward rounds and skipped matrix configs render unmistakably
+    distinct from fresh measurements."""
+    lines = [
+        "lighthouse-tpu perf trend",
+        f"  CAVEAT: {report['caveat']}",
+        "",
+        "headline (BENCH_r*.json, sets/s):",
+    ]
+    for r in report["headline"]["rounds"]:
+        val = f"{r['value']:.2f}" if r["value"] else "—"
+        vs = f"  vs_est_blst={r['vs_est']}" if r.get("vs_est") is not None else ""
+        if r["fresh"]:
+            tag = "fresh"
+        elif r.get("carried"):
+            tag = (
+                f"CARRIED FORWARD from {r['carried_from']} — "
+                "not a fresh measurement"
+            )
+        else:
+            tag = "SKIPPED (no measurement, nothing to carry)"
+        lines.append(f"  r{r['round']:02d}  {val:>10s}{vs}  [{tag}]")
+    for d in report["headline"]["deltas"]:
+        lines.append(
+            f"  delta {d['from']} -> {d['to']}: {d['delta_pct']:+.2f}%"
+        )
+    lines.append("")
+    lines.append("multichip (MULTICHIP_r*.json):")
+    for r in report["multichip"]["rounds"]:
+        if r["skipped"]:
+            tag = "SKIPPED"
+        else:
+            tag = "ok" if r["ok"] else "FAILED"
+        lines.append(
+            f"  r{r['round']:02d}  {tag}  (n_devices={r['n_devices']})"
+        )
+    if report["matrix"]:
+        lines.append("")
+        lines.append("current matrix (BENCH_MATRIX.json):")
+        for config in sorted(report["matrix"]):
+            e = report["matrix"][config]
+            if "skipped" in e:
+                lines.append(
+                    f"  {config}: SKIPPED ({e['skipped']}) — no measurement"
+                )
+                continue
+            if "error" in e and "rate" not in e:
+                lines.append(f"  {config}: ERROR ({e['error']})")
+                continue
+            bits = []
+            if "rate" in e:
+                bits.append(f"{e['rate']} {e['rate_unit']}")
+            if "p50_ms" in e:
+                bits.append(f"p50={e['p50_ms']}ms")
+            if e.get("vs_est") is not None:
+                bits.append(f"{e['vs_est_key']}={e['vs_est']} (estimated)")
+            lines.append(f"  {config}: " + ", ".join(bits))
+    lines.append("")
+    if report["regressions"]:
+        lines.append(
+            f"REGRESSION: {len(report['regressions'])} config(s) dropped "
+            f">{report['threshold_pct']}% between fresh rounds:"
+        )
+        for r in report["regressions"]:
+            lines.append(
+                f"  {r['config']}: {r['prev']} -> {r['cur']} "
+                f"({r['from']} -> {r['to']}, {r['delta_pct']}%)"
+            )
+    else:
+        lines.append(
+            f"verdict: OK — no fresh-to-fresh drop exceeds "
+            f"{report['threshold_pct']}%"
+        )
+    return "\n".join(lines)
+
+
+def run_report(
+    root: str | None = None,
+    check_mode: bool = False,
+    threshold: float = DEFAULT_REGRESSION_THRESHOLD,
+    as_json: bool = False,
+) -> int:
+    """Shared driver behind `bn perf report` and scripts/perf_trend.py."""
+    rc, report = check(root, threshold)
+    if as_json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(render_report(report))
+    return rc if check_mode else 0

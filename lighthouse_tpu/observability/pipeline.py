@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import weakref
 
+from . import perf
 from .trace import PIPELINE_STAGES, STAGE_SECONDS, TRACER
 
 _processors: list = []  # weakrefs to registered BeaconProcessors
@@ -77,13 +78,21 @@ def snapshot() -> dict:
                    if tr.meta else {}),
             }
         )
-    return {
+    out = {
         "stages": [s for s in PIPELINE_STAGES if s in stats],
         "stage_timings": stats,
         "processors": procs,
         "traces_completed": TRACER.completed,
         "recent_traces": recent,
     }
+    # bench trend aggregate (observability/perf.py): latest headline round
+    # with its carried-forward flag + the regression verdict, so the ops
+    # endpoint answers "did we get slower" without shell access. Cached,
+    # best-effort, absent when no BENCH artifacts ship with this install.
+    trend = perf.trend_summary()
+    if trend is not None:
+        out["perf_trend"] = trend
+    return out
 
 
 def run_probe(n_items: int = 8) -> int:

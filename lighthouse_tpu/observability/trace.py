@@ -15,7 +15,12 @@ serves two consumers:
   - Chrome trace-event export (`bn --trace-out`): `chrome_trace_events`
     renders the ring in the trace-event JSON schema Perfetto/chrome://
     tracing load directly — one "thread" row per pipeline lane, complete
-    ("ph": "X") events with microsecond timestamps.
+    ("ph": "X") events with microsecond timestamps. Spans named
+    `device:<stage>` (the per-stage attribution sub-spans from
+    observability/device.py) are routed onto dedicated, named device
+    lanes so host pipeline stages and device stage execution read as one
+    timeline; sampled queue depths export as counter events ("ph": "C")
+    so backlog renders next to the spans.
 
 Cost model: the hot path pays one Trace alloc + a span tuple append per
 stage per BATCH (not per attestation), and one histogram observe per span
@@ -88,8 +93,11 @@ class Trace:
 class Tracer:
     """Bounded ring of completed traces + per-stage histogram feed."""
 
-    def __init__(self, ring_size: int = 256):
+    def __init__(self, ring_size: int = 256, counter_ring_size: int = 2048):
         self.ring: deque = deque(maxlen=ring_size)
+        # sampled counter values (t, name, {series: value}) — queue depths
+        # today; exported as "ph": "C" rows next to the spans
+        self.counter_ring: deque = deque(maxlen=counter_ring_size)
         self._lock = threading.Lock()
         self.completed = 0
         self.out_path: str | None = None  # bn --trace-out destination
@@ -107,20 +115,33 @@ class Tracer:
             self.ring.append(trace)
             self.completed += 1
 
+    def sample_counters(self, name: str, values: dict) -> None:
+        """Record one sample of a counter track (e.g. per-WorkKind queue
+        depth at batch-formation time); bounded, lock-guarded, cheap."""
+        with self._lock:
+            self.counter_ring.append((perf_counter(), name, dict(values)))
+
     def snapshot_ring(self) -> list[Trace]:
         with self._lock:
             return list(self.ring)
 
+    def snapshot_counters(self) -> list[tuple]:
+        with self._lock:
+            return list(self.counter_ring)
+
     def reset(self) -> None:
         with self._lock:
             self.ring.clear()
+            self.counter_ring.clear()
             self.completed = 0
 
     # ------------------------------------------------------------- export
 
     def write_chrome_trace(self, path: str) -> int:
         """Write the ring as Chrome trace-event JSON; returns event count."""
-        events = chrome_trace_events(self.snapshot_ring())
+        events = chrome_trace_events(
+            self.snapshot_ring(), counters=self.snapshot_counters()
+        )
         doc = {
             "traceEvents": events,
             "displayTimeUnit": "ms",
@@ -131,21 +152,47 @@ class Tracer:
         return len(events)
 
 
-def chrome_trace_events(traces: list[Trace]) -> list[dict]:
+#: spans named `device:<stage>` render on dedicated lanes starting here
+#: (host pipeline lanes recycle tid 0..31)
+DEVICE_LANE_BASE = 1000
+
+
+def chrome_trace_events(
+    traces: list[Trace], counters: list[tuple] | None = None
+) -> list[dict]:
     """Trace-event ("X" complete events, µs) rows for a list of traces.
 
     Each trace gets its own tid so overlapping pipeline lanes (up to
     max_inflight device batches) render as parallel rows; tids recycle
-    mod 32 to keep the track count readable. Timestamps are rebased so
-    the oldest span in the export is t=0."""
-    if not traces:
+    mod 32 to keep the track count readable. Spans whose name starts
+    with `device:` (per-stage device attribution sub-spans) are routed
+    to one dedicated lane per stage (tid >= DEVICE_LANE_BASE) with a
+    thread_name metadata row, so host pipeline and device stages show as
+    distinct lanes of ONE timeline. `counters` — (t, name, {series:
+    value}) samples from Tracer.sample_counters — export as "ph": "C"
+    counter rows. Timestamps are rebased so the oldest event is t=0."""
+    counters = counters or []
+    if not traces and not counters:
         return []
-    base = min(t0 for tr in traces for _, t0, _, _ in tr.spans or [("", tr.t0, tr.t0, None)])
+    span_starts = [
+        t0
+        for tr in traces
+        for _, t0, _, _ in tr.spans or [("", tr.t0, tr.t0, None)]
+    ]
+    base = min(span_starts + [t for t, _, _ in counters])
     pid = os.getpid()
     events = []
+    device_lanes: dict = {}  # span name -> dedicated tid
     for i, tr in enumerate(traces):
-        tid = i % 32
+        host_tid = i % 32
         for name, t0, t1, args in tr.spans:
+            if name.startswith("device:"):
+                tid = device_lanes.get(name)
+                if tid is None:
+                    tid = DEVICE_LANE_BASE + len(device_lanes)
+                    device_lanes[name] = tid
+            else:
+                tid = host_tid
             ev = {
                 "name": name,
                 "cat": tr.kind,
@@ -161,6 +208,27 @@ def chrome_trace_events(traces: list[Trace]) -> list[dict]:
             if merged:
                 ev["args"] = {k: str(v) for k, v in merged.items()}
             events.append(ev)
+    for name, tid in device_lanes.items():
+        events.append(
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": tid,
+                "args": {"name": name},
+            }
+        )
+    for t, name, values in counters:
+        events.append(
+            {
+                "name": name,
+                "ph": "C",
+                "ts": (t - base) * 1e6,
+                "pid": pid,
+                "tid": 0,
+                "args": {k: float(v) for k, v in values.items()},
+            }
+        )
     return events
 
 

@@ -42,6 +42,8 @@ METRIC_MODULES = (
     "lighthouse_tpu.crypto.bls.hybrid",
     "lighthouse_tpu.autotune.profiler",
     "lighthouse_tpu.observability",
+    "lighthouse_tpu.observability.device",
+    "lighthouse_tpu.observability.perf",
     "lighthouse_tpu.api.http_api",
     "lighthouse_tpu.qos",
 )
@@ -93,6 +95,16 @@ def lint_registry(registry=None) -> list[str]:
                 errors.append(
                     f"{where}: qos_* metrics must be labeled families"
                 )
+        if m.name.startswith(("jaxbls_stage_", "xla_program_")):
+            # per-stage attribution and compiled-program analytics exist
+            # to LOCALIZE cost — an aggregate over stages or padding
+            # buckets answers nothing, so these families must carry the
+            # stage + bucket labels (observability/device.py, perf.py)
+            if not getattr(m, "labelnames", ()):
+                errors.append(
+                    f"{where}: jaxbls_stage_*/xla_program_* metrics must "
+                    "be labeled families (stage + padding bucket)"
+                )
         if m.kind == "histogram":
             # a histogram's exposition series must not shadow other metrics
             for suf in _RESERVED_SUFFIXES:
@@ -114,6 +126,21 @@ def main() -> int:
         print(f"{len(errors)} violation(s) across {n} metrics", file=sys.stderr)
         return 1
     print(f"{n} metrics/families clean")
+    # the bench trend gate rides the same CI entry point: host-only,
+    # sub-second, fails the lint run on a >10% fresh-to-fresh regression
+    # in the checked-in BENCH_r*/MULTICHIP_r* series
+    from lighthouse_tpu.observability import perf
+
+    rc, report = perf.check()
+    if rc:
+        for r in report["regressions"]:
+            print(
+                f"PERF: {r['config']} regressed {r['delta_pct']}% "
+                f"({r['from']} -> {r['to']})",
+                file=sys.stderr,
+            )
+        return rc
+    print("perf trend gate clean (no fresh-to-fresh regression)")
     return 0
 
 

@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Bench trend report + regression gate over the checked-in round artifacts.
+
+Thin CLI over lighthouse_tpu/observability/perf.py (the same driver behind
+`bn perf report`): parses BENCH_r*.json / MULTICHIP_r*.json and the current
+BENCH_MATRIX.json, prints per-config trend with carried-forward rounds
+rendered distinctly (a skipped round inherits the latest fresh value but is
+NEVER shown as a fresh measurement), and with --check exits nonzero when a
+fresh-to-fresh headline delta drops more than --threshold (default 10%) —
+the CI gate scripts/lint_metrics.py also runs.
+
+Host-only and sub-second: no jax, no device, stdlib JSON over a handful of
+small files. The report header restates bench.py's caveat — every vs_est_*
+ratio divides by an ESTIMATED blst/c-kzg throughput, not a measurement.
+
+Usage: python scripts/perf_trend.py [--root DIR] [--check]
+       [--threshold 0.10] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=None,
+                    help="directory holding the BENCH_r*/MULTICHIP_r* "
+                         "artifacts (default: the repo root)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit nonzero on a >threshold fresh-to-fresh "
+                         "regression (CI gate)")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="regression threshold as a fraction (default 0.10)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full report as JSON instead of text")
+    args = ap.parse_args(argv)
+
+    from lighthouse_tpu.observability import perf
+
+    return perf.run_report(
+        root=args.root,
+        check_mode=args.check,
+        threshold=args.threshold,
+        as_json=args.json,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,146 +1,35 @@
 #!/usr/bin/env python
-"""Time the individual device pieces of the verify kernel on the real chip.
+"""Time the real jitted verify stages on the attached device, per stage.
 
-Usage: python scripts/profile_components.py [--shift] [--sets N] [--pks M]
+Thin CLI over lighthouse_tpu/observability/device.profile_stages — the ONE
+owner of per-stage timing (the same attribution path `bn --device-trace`
+and bench.py use), so script-measured and runtime-measured stage numbers
+can never diverge. Each run also feeds the jaxbls_stage_device_seconds /
+jaxbls_stage_compile_seconds families and (unless --no-analytics) captures
+the compiled programs' flops/bytes/HBM into the xla_program_* gauges and
+the autotune profile snapshot, printing roofline utilization against the
+device's ESTIMATED peak.
 
-Each stage is jitted standalone, warmed once, then timed over REPS runs with
-block_until_ready. --shift flips limbs._POLY_SHIFT to the shift-accumulate
-poly_mul form (vs the default banded-einsum form) for A/B comparison.
+Usage: python scripts/profile_components.py [--sets N] [--pks M] [--reps R]
+       [--shift] [--msm] [--no-analytics]
+
+--shift flips limbs._POLY_SHIFT to the shift-accumulate poly_mul form (vs
+the default banded-einsum form) for A/B comparison. --msm appends the
+variable-base vs fixed-base comb MSM comparison at KZG scale (the one
+measurement here that is not stage timing).
 """
 
 import argparse
-import time
+import json
 import sys
 
 sys.path.insert(0, ".")
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--shift", action="store_true")
-    ap.add_argument("--sets", type=int, default=64)
-    ap.add_argument("--pks", type=int, default=128)
-    ap.add_argument("--reps", type=int, default=3)
-    args = ap.parse_args()
-
-    from lighthouse_tpu.utils.jaxcfg import setup_compilation_cache
-
-    setup_compilation_cache()
-    import numpy as np
-    import jax
-    import jax.numpy as jnp
-
-    from lighthouse_tpu.crypto.jaxbls import limbs as lb
-
-    if args.shift:
-        lb._POLY_SHIFT = True
-        print("poly_mul: SHIFT-ACCUMULATE form", file=sys.stderr)
-    else:
-        print("poly_mul: BANDED-EINSUM form", file=sys.stderr)
-
-    from lighthouse_tpu.crypto.jaxbls import tower as tw, curve_ops as co
-    from lighthouse_tpu.crypto.jaxbls import h2c_ops as h2, pairing_ops as po
-
-    print(f"devices: {jax.devices()}", file=sys.stderr)
-    rng = np.random.default_rng(7)
-
-    def rand_limbs(shape):
-        # random < 2^16 per limb; top limb small so value < P
-        a = rng.integers(0, 1 << 16, size=shape + (lb.NL,), dtype=np.uint32)
-        a[..., -1] = 0
-        return jnp.asarray(a)
-
-    n, m = args.sets, args.pks
-
-    def bench(name, fn, *xs):
-        f = jax.jit(fn)
-        t0 = time.time()
-        r = f(*xs)
-        jax.block_until_ready(r)
-        compile_s = time.time() - t0
-        t0 = time.time()
-        for _ in range(args.reps):
-            r = f(*xs)
-        jax.block_until_ready(r)
-        dt = (time.time() - t0) / args.reps
-        print(f"{name:34s} {dt*1000:9.1f} ms   (compile {compile_s:6.1f}s)")
-        return dt
-
-    # 1. mont_mul on a big batch (the raw primitive)
-    a = rand_limbs((4096, 54))
-    b = rand_limbs((4096, 54))
-    bench("mont_mul (4096x54 lanes)", lb.mont_mul, a, b)
-
-    # 2. pubkey tree aggregation (n sets x m keys)
-    pkx = rand_limbs((n, m))
-    pky = rand_limbs((n, m))
-    mask = jnp.ones((n, m), jnp.uint32)
-
-    def agg(pk_x, pk_y, pk_mask):
-        pk_jac = co.affine_to_jac(
-            co.FQ_OPS, (pk_x, pk_y), inf_mask=jnp.logical_not(pk_mask)
-        )
-        pk_jac_t = tuple(jnp.moveaxis(c, 1, 0) for c in pk_jac)
-        mm = m
-        aggv = pk_jac_t
-        while mm > 1:
-            half = mm // 2
-            aa = tuple(c[:half] for c in aggv)
-            bb = tuple(c[half:mm] for c in aggv)
-            aggv = co.jac_add(aa, bb, co.FQ_OPS)
-            mm = half
-        return tuple(c[0] for c in aggv)
-
-    bench(f"pk tree-agg ({n}x{m})", agg, pkx, pky, mask)
-
-    # 3. windowed z-mul on G1 (n points, 64-bit scalars)
-    digs = jnp.asarray(
-        rng.integers(0, 16, size=(n, 16), dtype=np.uint32)
-    )
-    g1 = (rand_limbs((n,)), rand_limbs((n,)), rand_limbs((n,)))
-    bench(f"z*aggpk windowed G1 ({n})", lambda p, d: co.scalar_mul_windowed(p, d, co.FQ_OPS), g1, digs)
-
-    # 4. hash-to-G2 (SSWU+isogeny+cofactor), n messages
-    us = jnp.asarray(
-        rng.integers(0, 1 << 16, size=(n, 2, 2, lb.NL), dtype=np.uint32)
-    )
-    us = us.at[..., -1].set(0)
-    bench(f"hash_to_g2 ({n} msgs)", h2.hash_to_g2_jacobian, us)
-
-    # 5. windowed z-mul on G2 + tree sum
-    g2 = (rand_limbs((n, 2)), rand_limbs((n, 2)), rand_limbs((n, 2)))
-
-    def zsig(p, d):
-        zs = co.scalar_mul_windowed(p, d, co.FQ2_OPS)
-        return co.tree_sum(zs, co.FQ2_OPS)
-
-    bench(f"z*sig windowed G2 + tree ({n})", zsig, g2, digs)
-
-    # 6. shared-f multi-pairing Miller loop at the exact pair count
-    npairs = n + 1
-    p_aff = (rand_limbs((npairs,)), rand_limbs((npairs,)))
-    q_aff = (rand_limbs((npairs, 2)), rand_limbs((npairs, 2)))
-    vm = jnp.ones((npairs,), bool)
-    bench(f"miller loop product ({npairs} pairs)", po.miller_loop_product, p_aff, q_aff, vm)
-
-    # 7. final exp (single element)
-    fs = jnp.asarray(
-        rng.integers(0, 1 << 16, size=(2, 3, 2, lb.NL), dtype=np.uint32)
-    )
-    fs = fs.at[..., -1].set(0)
-    bench("final exp (single)", po.final_exponentiation, fs)
-
-    # 8. batched affine conversion (the single Fermat inversion)
-    zs2 = rand_limbs((2 * n + 1, 2))
-
-    def inv(z):
-        return tw.fq2_inv(z)
-
-    bench(f"fq2_inv batch ({2*n+1})", inv, zs2)
-
-    # 9. MSM comparison at KZG scale: variable-base double-and-add vs the
-    # fixed-base comb (msm.py) — the VERDICT r4 #4 "≥4x at 4096 points"
-    # measurement, runnable on the real chip when a window opens
+def run_msm_comparison(reps: int) -> None:
+    """Variable-base double-and-add vs the fixed-base comb (msm.py) — the
+    VERDICT r4 #4 "≥4x at 4096 points" measurement, runnable on the real
+    chip when a window opens."""
     import random as _random
     import time as _time
 
@@ -165,7 +54,7 @@ def main():
         print(f"g1_msm_fixed ({n_msm} pts) {tag}: "
               f"{_time.time()-t0:.2f}s", file=sys.stderr)
     assert r_var == r_fix, "MSM paths disagree"
-    for _ in range(args.reps):
+    for _ in range(reps):
         t0 = _time.time()
         backend.g1_msm(pts, scalars)
         tv = _time.time() - t0
@@ -174,6 +63,62 @@ def main():
         tf = _time.time() - t0
         print(f"msm steady: variable {tv:.3f}s fixed {tf:.3f}s "
               f"({tv/max(tf,1e-9):.1f}x)", file=sys.stderr)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shift", action="store_true")
+    ap.add_argument("--sets", type=int, default=64)
+    ap.add_argument("--pks", type=int, default=128)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--msm", action="store_true",
+                    help="also run the variable- vs fixed-base MSM comparison")
+    ap.add_argument("--no-analytics", action="store_true",
+                    help="skip compiled-program cost/memory capture")
+    args = ap.parse_args()
+
+    from lighthouse_tpu.utils.jaxcfg import setup_compilation_cache
+
+    setup_compilation_cache()
+
+    from lighthouse_tpu.crypto.jaxbls import limbs as lb
+
+    if args.shift:
+        lb._POLY_SHIFT = True
+        print("poly_mul: SHIFT-ACCUMULATE form", file=sys.stderr)
+    else:
+        print("poly_mul: BANDED-EINSUM form", file=sys.stderr)
+
+    import jax
+
+    print(f"devices: {jax.devices()}", file=sys.stderr)
+
+    from lighthouse_tpu.observability import device as obs_device
+
+    report = obs_device.profile_stages(
+        args.sets, args.pks, reps=args.reps, analytics=not args.no_analytics
+    )
+    n, m = report["bucket"]
+    print(f"bucket {n}x{m} on {report['device_kind']} "
+          f"({args.reps} timed reps/stage; first rep = residual compile):",
+          file=sys.stderr)
+    for stage in obs_device.STAGES:
+        st = report["stages"].get(stage)
+        if not st:
+            continue
+        roof = st.get("roofline") or {}
+        util = (
+            f"   flops-util {roof['flops_utilization']:.4%}"
+            f"  hbm-util {roof['hbm_utilization']:.4%}"
+            f"  bound={roof['bound']} (vs ESTIMATED peak)"
+            if "flops_utilization" in roof else ""
+        )
+        print(f"{stage:10s} {st['mean_ms']:9.1f} ms"
+              f"   (compile {st.get('compile_s', 0.0):6.1f}s){util}")
+    print(json.dumps(report, indent=1))
+
+    if args.msm:
+        run_msm_comparison(args.reps)
 
 
 if __name__ == "__main__":

@@ -103,6 +103,39 @@ def test_verify_signature_sets_parity():
     assert not backend.verify_signature_sets(tampered, rands)
 
 
+def test_stage_attribution_on_real_dispatch():
+    """Acceptance: a dispatch through the jax backend with attribution on
+    records per-stage device seconds with a compile/execute split per
+    padding bucket, and the carried trace grows device:<stage> sub-spans
+    alongside the host spans (merged-export lanes are covered in
+    test_observability). Stages are already warm (module fixture), so the
+    two attributed verifies only pay event-timed resolves."""
+    from lighthouse_tpu.observability import device as obsdev
+    from lighthouse_tpu.observability import trace as obstrace
+
+    backend = bls_api.set_backend("jax")
+    sets = [_mk_set(1, b"\xab" * 32)]
+    tr = obstrace.Trace("gossip_attestation", 1)
+    obstrace.set_current_trace(tr)
+    try:
+        with obsdev.attributed():
+            assert backend.verify_signature_sets(sets, [1])
+            assert backend.verify_signature_sets(sets, [1])
+    finally:
+        obstrace.set_current_trace(None)
+
+    import lighthouse_tpu.crypto.jaxbls.backend as be
+
+    n, m = be.padding_bucket(1, 1)
+    for stage in obsdev.STAGES:
+        # split per bucket: first resolve -> compile gauge, second ->
+        # steady-state histogram
+        assert obsdev.STAGE_COMPILE_SECONDS.labels(stage, n, m).value > 0, stage
+        assert obsdev.STAGE_DEVICE_SECONDS.labels(stage, n, m).n >= 1, stage
+    device_spans = [s[0] for s in tr.spans if s[0].startswith("device:")]
+    assert device_spans == [f"device:{s}" for s in obsdev.STAGES] * 2
+
+
 def test_single_verify_parity():
     bls_api.set_backend("jax")
     sk = bls.SecretKey(rng.randrange(1, R))

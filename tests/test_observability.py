@@ -88,6 +88,155 @@ def test_tracer_write_chrome_trace(tmp_path):
     assert doc["displayTimeUnit"] == "ms"
 
 
+# ------------------------------------------------- device attribution
+
+
+def test_device_attribution_records_split_and_spans():
+    """run_stage with attribution on: the first timed resolve per
+    (stage, bucket) classifies as residual compile, later ones as
+    steady-state execute, and each adds a device:<stage> sub-span to the
+    carried trace. Pure host — no device, no jax backend needed."""
+    from lighthouse_tpu.observability import device as obsdev
+
+    obsdev.reset_seen()
+    bucket = (16, 2)  # distinctive: no other test dispatches at it
+    tr = Trace("gossip_attestation", 4)
+    with obsdev.attributed():
+        attr = obsdev.begin(bucket, trace=tr)
+        assert attr is not None
+        assert obsdev.run_stage(attr, "prepare", lambda a, b: a + b, 1, 2) == 3
+        assert obsdev.run_stage(attr, "prepare", lambda a, b: a + b, 3, 4) == 7
+        obsdev.run_stage(attr, "pairing", lambda: None)
+    # attribution off outside the scope: begin() is None, run_stage is a
+    # plain annotated pass-through that records nothing
+    assert obsdev.begin(bucket) is None
+    assert obsdev.run_stage(None, "prepare", lambda: 5) == 5
+
+    names = [s[0] for s in tr.spans]
+    assert names == ["device:prepare", "device:prepare", "device:pairing"]
+    phases = [s[3]["phase"] for s in tr.spans]
+    assert phases == ["compile", "execute", "compile"]
+    assert obsdev.STAGE_COMPILE_SECONDS.labels("prepare", 16, 2).value > 0
+    assert obsdev.STAGE_DEVICE_SECONDS.labels("prepare", 16, 2).n == 1
+    assert obsdev.STAGE_DEVICE_SECONDS.labels("pairing", 16, 2).n == 0
+    snap = obsdev.snapshot_stages()
+    assert snap["16x2"]["prepare"]["count"] == 1
+    assert "compile_s" in snap["16x2"]["pairing"]
+
+
+def test_merged_export_puts_device_spans_on_distinct_lanes():
+    """Acceptance: one trace-event file holds host pipeline spans and
+    per-stage device spans on DISTINCT lanes — host spans on the trace's
+    pipeline tid, device:<stage> spans each on a dedicated named lane."""
+    from lighthouse_tpu.observability.trace import DEVICE_LANE_BASE
+
+    tr = Trace("gossip_attestation", 8)
+    tr.add_span("enqueue", 1.0, 1.1)
+    tr.add_span("marshal", 1.1, 1.3)
+    tr.add_span("device:prepare", 1.3, 1.5, phase="execute")
+    tr.add_span("device:h2c", 1.5, 1.8, phase="execute")
+    tr.add_span("device", 1.3, 1.9)
+    events = chrome_trace_events([tr])
+    json.dumps(events)  # must be loadable as-is
+    by_name = {}
+    for ev in events:
+        if ev["ph"] == "X":
+            by_name[ev["name"]] = ev["tid"]
+    host_tids = {by_name["enqueue"], by_name["marshal"], by_name["device"]}
+    assert host_tids == {0}  # one pipeline lane for the host spans
+    assert by_name["device:prepare"] >= DEVICE_LANE_BASE
+    assert by_name["device:h2c"] >= DEVICE_LANE_BASE
+    assert by_name["device:prepare"] != by_name["device:h2c"]
+    # each device lane is named via thread_name metadata
+    meta = {
+        ev["tid"]: ev["args"]["name"]
+        for ev in events
+        if ev["ph"] == "M" and ev["name"] == "thread_name"
+    }
+    assert meta[by_name["device:prepare"]] == "device:prepare"
+    assert meta[by_name["device:h2c"]] == "device:h2c"
+
+
+def test_counter_samples_export_as_counter_events(tmp_path):
+    """Tracer counter samples (per-WorkKind queue depths) export as
+    "ph": "C" rows next to the spans, rebased on the same clock."""
+    tracer = Tracer()
+    tr = tracer.begin("gossip_attestation")
+    tr.add_span("enqueue", 10.0, 10.5)
+    tracer.finish(tr)
+    tracer.counter_ring.append((10.25, "queue_depth", {"gossip_attestation": 3.0}))
+    out = tmp_path / "trace.json"
+    tracer.write_chrome_trace(str(out))
+    doc = json.loads(out.read_text())
+    counters = [ev for ev in doc["traceEvents"] if ev["ph"] == "C"]
+    (c,) = counters
+    assert c["name"] == "queue_depth"
+    assert c["args"] == {"gossip_attestation": 3.0}
+    assert abs(c["ts"] - 0.25e6) < 1
+    # meta annotations still ride the span args (satellite invariant)
+    span = next(ev for ev in doc["traceEvents"] if ev["ph"] == "X")
+    assert span["name"] == "enqueue"
+
+
+def test_processor_samples_queue_depth_counters():
+    """Every batch formation samples the per-WorkKind queue-depth gauges
+    into the tracer's counter ring."""
+    before = len(TRACER.snapshot_counters())
+    _drain_probe()
+    samples = TRACER.snapshot_counters()
+    assert len(samples) > before
+    t, name, values = samples[-1]
+    assert name == "queue_depth"
+    assert "gossip_attestation" in values
+
+
+def test_program_analytics_capture_to_gauges_profile_and_snapshot():
+    """perf.capture_program on a compiled function: flops/bytes/HBM land
+    in the labeled xla_program_* gauges, the autotune profiler's bucket
+    recorder (and from there the persisted profile schema), and the
+    snapshot bench.py embeds in artifacts."""
+    import jax
+    import jax.numpy as jnp
+
+    from lighthouse_tpu.autotune import profile as ap
+    from lighthouse_tpu.autotune import profiler as apf
+    from lighthouse_tpu.observability import perf
+    from lighthouse_tpu.utils.metrics import REGISTRY
+
+    f = jax.jit(lambda x: x * 2.0 + 1.0)
+    x = jnp.ones((8, 8), jnp.float32)
+    f(x)  # normal call path compiles; capture re-traces, never re-compiles
+
+    assert not perf.analytics_enabled()
+    prev = perf.set_analytics(True)
+    try:
+        stats = perf.maybe_capture_program("h2c", f, (x,), (32, 4))
+        again = perf.maybe_capture_program("h2c", f, (x,), (32, 4))
+    finally:
+        perf.set_analytics(prev)
+    assert stats is not None and again == stats  # second call is a cache hit
+    assert stats["flops"] > 0 and stats["bytes_accessed"] > 0
+    assert stats["argument_bytes"] == 8 * 8 * 4
+
+    text = REGISTRY.expose_text()
+    assert 'xla_program_flops{stage="h2c",n_sets="32",n_pks="4"}' in text
+    assert ('xla_program_hbm_bytes{stage="h2c",n_sets="32",n_pks="4",'
+            'region="argument"} 256') in text
+
+    # the bucket recorder carries the program, and it round-trips through
+    # the versioned profile schema
+    bp = apf.snapshot_buckets()[(32, 4)]
+    assert bp.programs["h2c"]["flops"] == stats["flops"]
+    prof = ap.DeviceProfile(
+        key={"platform": "cpu", "backend_revision": ap.BACKEND_REVISION},
+        buckets={(32, 4): bp}, source="test",
+    )
+    rt = ap.DeviceProfile.from_json(prof.to_json())
+    assert rt.buckets[(32, 4)].programs == bp.programs
+
+    assert perf.program_snapshot()["32x4"]["h2c"] == stats
+
+
 # ------------------------------------------------------------- processor
 
 
@@ -311,5 +460,10 @@ def test_bn_trace_out_end_to_end(tmp_path):
     doc = json.loads(out.read_text())
     events = doc["traceEvents"]
     assert {ev["name"] for ev in events} >= set(PIPELINE_STAGES)
-    for ev in events:
-        assert ev["ph"] == "X" and ev["ts"] >= 0 and ev["dur"] >= 0
+    spans = [ev for ev in events if ev["ph"] == "X"]
+    for ev in spans:
+        assert ev["ts"] >= 0 and ev["dur"] >= 0
+    # the probe's batch formations also sampled queue depths -> counter rows
+    counters = [ev for ev in events if ev["ph"] == "C"]
+    assert counters and counters[0]["name"] == "queue_depth"
+    assert all(ev["ph"] in ("X", "C", "M") for ev in events)
