@@ -376,7 +376,20 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
         )
 
     def get_health(self):
-        self.send_response(200)
+        """GET /eth/v1/node/health — reflects real signal instead of an
+        unconditional 200: while the SLO burn rate exceeds its threshold or
+        the device breaker is open the node is serving degraded, and a load
+        balancer probing here should know (206 = serving-but-degraded, the
+        beacon-api code the reference uses for a syncing-but-usable node).
+        Stays rate-limit exempt; the check is two in-memory reads."""
+        from ..observability import slo as obs_slo
+
+        h = obs_slo.health()
+        self.send_response(206 if h["degraded"] else 200)
+        if h["degraded"]:
+            # machine-visible reason without a body (health probes often
+            # discard bodies): a header names what degraded
+            self.send_header("X-Node-Degraded", ",".join(h["reasons"]))
         self.end_headers()
 
     def get_version(self):
@@ -837,6 +850,23 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
         from ..observability import snapshot
 
         self._json({"data": snapshot()})
+
+    def get_lh_slo(self):
+        """/lighthouse_tpu/slo: the slot-level SLO accountant's snapshot —
+        per-slot reports, the rolling 5-slot / 32-slot windows with burn
+        rate, and the degraded verdict (observability/slo.py). This is the
+        live SLI surface a closed-loop capacity controller consumes."""
+        from ..observability import flight_recorder as obs_fr
+        from ..observability import slo as obs_slo
+
+        data = obs_slo.ACCOUNTANT.snapshot()
+        data["health"] = obs_slo.health()
+        data["flight_recorder"] = {
+            "events_recorded": obs_fr.RECORDER.events_recorded,
+            "breaker_states": dict(obs_fr.RECORDER.breaker_states),
+            "incidents_written": list(obs_fr.RECORDER.incidents_written),
+        }
+        self._json({"data": data})
 
     def get_lh_peers_scores(self):
         net = getattr(self.chain, "_network_node", None)
@@ -1485,6 +1515,7 @@ _ROUTES = [
     (r"/lighthouse_tpu/ui/validator-metrics", "POST", BeaconApiHandler.post_lh_validator_metrics),
     (r"/lighthouse_tpu/logs", "GET", BeaconApiHandler.get_lh_logs),
     (r"/lighthouse_tpu/pipeline", "GET", BeaconApiHandler.get_lh_pipeline),
+    (r"/lighthouse_tpu/slo", "GET", BeaconApiHandler.get_lh_slo),
     (r"/eth/v1/validator/attestation_data", "GET", BeaconApiHandler.get_attestation_data),
     (r"/eth/v3/validator/blocks/(\d+)", "GET", BeaconApiHandler.get_produce_block),
     (r"/eth/v1/beacon/light_client/bootstrap/(0x[0-9a-f]+)", "GET", BeaconApiHandler.get_lc_bootstrap),

@@ -35,6 +35,7 @@ from enum import IntEnum
 from time import perf_counter
 from typing import Callable
 
+from ..observability import slo as obs_slo
 from ..observability import trace as obs
 from ..qos.admission import count_shed
 from ..utils.logging import get_logger
@@ -219,6 +220,11 @@ class BeaconProcessor:
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        # slot-level SLO accountant (observability/slo.py): every admit /
+        # shed / processed / queue-wait lands in the current slot's report.
+        # Defaults to the node's global accountant; loadgen swaps in a
+        # private instance so scenario reports stay seed-deterministic.
+        self.slo = obs_slo.ACCOUNTANT
         from ..observability import register_processor
 
         register_processor(self)
@@ -272,11 +278,13 @@ class BeaconProcessor:
         if shed is not None:
             self._notify_shed(shed[0], shed[1])
         if accepted:
+            self.slo.record_admitted(kind.name)
             self._wake.set()
         return accepted
 
     def _notify_shed(self, item: WorkItem, reason: str) -> None:
         count_shed(item.kind.name, reason)
+        self.slo.record_shed(item.kind.name, reason)
         if item.on_shed is not None:
             try:
                 item.on_shed(reason)
@@ -355,6 +363,7 @@ class BeaconProcessor:
         OLDEST item's queue residency (== the max wait in the unit), the
         coalesce span the pop/batch-form step itself."""
         self._m_wait[kind].observe(t_pop - oldest.t_enq)
+        self.slo.record_queue_wait(kind.name, t_pop - oldest.t_enq)
         # sample the per-kind queue-depth gauges into the tracer's counter
         # ring: the Chrome trace export renders them as counter rows
         # ("ph": "C") so backlog is visible next to the pipeline spans
@@ -397,6 +406,7 @@ class BeaconProcessor:
         n = len(batch) if batch is not None else 1
         self.processed[kind] += n
         self._m_processed[kind].inc(n)
+        self.slo.record_processed(kind.name, n)
         self._handle_result(result, trace)
 
     def _handle_result(self, result, trace=None) -> None:
@@ -444,6 +454,7 @@ class BeaconProcessor:
             return True
         if trace is not None:
             trace.add_span("device", t_dev, perf_counter())
+        self.slo.record_verify_latency(perf_counter() - t_dev)
         t_cont = perf_counter()
         try:
             with self._exec_lock:

@@ -28,29 +28,28 @@ log = get_logger("validator_monitor")
 MONITORED_VALIDATORS = REGISTRY.gauge(
     "validator_monitor_validators", "Number of validators being monitored"
 )
-MONITOR_PROPOSALS = REGISTRY.counter(
-    "validator_monitor_blocks_proposed_total",
-    "Blocks proposed by monitored validators",
+# hit/miss observations as labeled families (one family per duty, broken
+# down by outcome) instead of the previous six ad-hoc singletons — a
+# dashboard computes a per-duty hit ratio with one `sum by (result)`
+# query, and the SLO epoch window ingests the same verdicts
+# (observability/slo.py record_validator_epoch).
+MONITOR_BLOCKS = REGISTRY.counter_vec(
+    "validator_monitor_blocks_total",
+    "Monitored validators' proposal duties, by outcome "
+    "(proposed / missed)",
+    ("result",),
 )
-MONITOR_MISSED_BLOCKS = REGISTRY.counter(
-    "validator_monitor_blocks_missed_total",
-    "Proposals missed by monitored validators",
+MONITOR_ATTESTATIONS = REGISTRY.counter_vec(
+    "validator_monitor_attestations_total",
+    "Monitored validators' per-epoch attestation verdicts, by outcome "
+    "(timely_target = credit earned / miss = epoch closed with no credit)",
+    ("result",),
 )
-MONITOR_ATT_HITS = REGISTRY.counter(
-    "validator_monitor_attestation_timely_target_total",
-    "Timely-target attestation credits earned by monitored validators",
-)
-MONITOR_ATT_MISSES = REGISTRY.counter(
-    "validator_monitor_attestation_misses_total",
-    "Epochs with no timely-target credit for a monitored validator",
-)
-MONITOR_SYNC_HITS = REGISTRY.counter(
-    "validator_monitor_sync_signatures_total",
-    "Sync-committee signatures included for monitored validators",
-)
-MONITOR_SYNC_MISSES = REGISTRY.counter(
-    "validator_monitor_sync_misses_total",
-    "Sync-committee slots missed by monitored validators",
+MONITOR_SYNC = REGISTRY.counter_vec(
+    "validator_monitor_sync_total",
+    "Monitored validators' sync-committee slots, by outcome "
+    "(included / missed)",
+    ("result",),
 )
 
 
@@ -115,7 +114,7 @@ class ValidatorMonitor:
         self._proposed_slots[epoch].add(int(block.slot))
         if self._tracked(block.proposer_index):
             self.summaries[(block.proposer_index, epoch)].blocks_proposed += 1
-            MONITOR_PROPOSALS.inc()
+            MONITOR_BLOCKS.labels("proposed").inc()
             log.info(
                 "monitored proposal included",
                 validator=int(block.proposer_index),
@@ -143,10 +142,10 @@ class ValidatorMonitor:
             s = self.summaries[(vi, epoch)]
             if bit:
                 s.sync_signatures += 1
-                MONITOR_SYNC_HITS.inc()
+                MONITOR_SYNC.labels("included").inc()
             else:
                 s.sync_misses += 1
-                MONITOR_SYNC_MISSES.inc()
+                MONITOR_SYNC.labels("missed").inc()
 
     def on_proposer_duties(self, epoch: int, duties) -> None:
         """Record expected proposers for an epoch: [(slot, validator_idx)]."""
@@ -189,16 +188,23 @@ class ValidatorMonitor:
             self.on_attestation_participation(state, epoch)
 
         proposed = self._proposed_slots.get(epoch, set())
+        epoch_hits = 0
+        epoch_misses = 0
         for slot, vi in self._proposer_duties.pop(epoch, []):
             if not self._tracked(vi):
                 continue
             if slot not in proposed:
                 self.summaries[(vi, epoch)].blocks_missed += 1
-                MONITOR_MISSED_BLOCKS.inc()
+                MONITOR_BLOCKS.labels("missed").inc()
+                epoch_misses += 1
                 log.warn(
                     "monitored validator MISSED a block",
                     validator=vi, slot=slot, epoch=epoch,
                 )
+            else:
+                # fulfilled proposal duties are HITS in the SLO epoch
+                # window — misses alone would bias the ratio downward
+                epoch_hits += 1
 
         # explicit registrations always get a verdict (including "no data" ->
         # miss); in auto mode, every validator the epoch produced data for
@@ -208,7 +214,10 @@ class ValidatorMonitor:
         for vi in sorted(report_set):
             s = self.summaries[(vi, epoch)]
             if s.attestation_target_hits:
-                MONITOR_ATT_HITS.inc(s.attestation_target_hits)
+                MONITOR_ATTESTATIONS.labels("timely_target").inc(
+                    s.attestation_target_hits
+                )
+                epoch_hits += s.attestation_target_hits
                 log.info(
                     "validator epoch summary", validator=vi, epoch=epoch,
                     attestations=s.attestations,
@@ -219,11 +228,22 @@ class ValidatorMonitor:
                     sync_signatures=s.sync_signatures,
                 )
             else:
-                MONITOR_ATT_MISSES.inc()
+                MONITOR_ATTESTATIONS.labels("miss").inc()
+                epoch_misses += 1
                 log.warn(
                     "monitored validator MISSED attestation credit",
                     validator=vi, epoch=epoch, attestations=s.attestations,
                 )
+            # sync-committee verdicts were counted per slot at import time
+            # (on_sync_aggregate); fold them into the same epoch feed
+            epoch_hits += s.sync_signatures
+            epoch_misses += s.sync_misses
+        if epoch_hits or epoch_misses:
+            # the duty verdicts land in the SLO epoch window next to the
+            # pipeline's deadline accounting (observability/slo.py)
+            from ..observability import slo as obs_slo
+
+            obs_slo.ACCOUNTANT.record_validator_epoch(epoch_hits, epoch_misses)
 
     # ------------------------------------------------------------- queries
 

@@ -546,6 +546,25 @@ def cmd_bn(args):
         log.info("per-stage device attribution enabled (--device-trace); "
                  "dispatch pipelining is serialized while active")
 
+    # slot-level SLO accounting + flight recorder (observability/slo.py,
+    # flight_recorder.py): the accountant attributes pipeline events to
+    # slots via the chain clock and the slot timer below closes one
+    # SlotReport per boundary; with a datadir, incident triggers (breaker
+    # open, burn rate, miss streak) dump diagnosis snapshots to
+    # <datadir>/incidents for `bn debug-bundle` to package.
+    from .observability import flight_recorder as obs_fr
+    from .observability import slo as obs_slo
+
+    obs_slo.ACCOUNTANT.bind_clock(clock)
+    if args.datadir:
+        obs_fr.RECORDER.configure(
+            incident_dir=_os_env.path.join(args.datadir, "incidents"),
+            clock=clock,
+            slo_provider=obs_slo.ACCOUNTANT.snapshot,
+        )
+        log.info("flight recorder armed",
+                 incident_dir=_os_env.path.join(args.datadir, "incidents"))
+
     tracer = None
     if getattr(args, "trace_out", None):
         # pipeline tracing is always on (bounded ring); --trace-out adds a
@@ -591,6 +610,13 @@ def cmd_bn(args):
         while not exit_signal.wait(clock.duration_to_next_slot()):
             chain.per_slot_task()
             persist_on_finalization()
+            # close the just-finished slot's SLO report (watermarked: a
+            # missed tick emits empty reports for the skipped slots);
+            # pre-genesis ticks (now() None) and slot 0 have no finished
+            # slot to close
+            now_slot = clock.now()
+            if now_slot is not None and now_slot >= 1:
+                obs_slo.ACCOUNTANT.close_slot(now_slot - 1)
             head_slot = chain.head_state().slot
             HEAD_SLOT.set(head_slot)
             log.info("slot", slot=clock.now(), head=chain.head_root.hex()[:8])
@@ -932,6 +958,20 @@ def cmd_doctor(args):
     report = fsck_datadir(args.datadir, repair=args.repair)
     print(json.dumps(report, indent=1))
     return 0 if report["ok"] else 1
+
+
+# ------------------------------------------------------------------ debug-bundle
+
+
+def cmd_debug_bundle(args):
+    """`bn debug-bundle`: one tarball for offline diagnosis — metrics
+    exposition, pipeline + SLO snapshots, the flight-recorder ring, every
+    incident dump under <datadir>/incidents, `bn doctor` output, the
+    installed autotune profile and bench metadata
+    (observability/debug_bundle.py). Stdlib-only; never touches a device."""
+    from .observability.debug_bundle import run_from_args
+
+    return run_from_args(args)
 
 
 # ------------------------------------------------------------------ perf
@@ -1514,11 +1554,12 @@ def build_parser() -> argparse.ArgumentParser:
                          "use for diagnosis, not serving")
     bn.set_defaults(fn=cmd_bn)
 
-    # `bn loadtest` / `bn doctor` / `bn perf`: operator sub-subcommands
-    # (loadgen driver; datadir fsck; bench trend report). Optional —
-    # plain `bn` still runs the node.
+    # `bn loadtest` / `bn doctor` / `bn perf` / `bn debug-bundle`:
+    # operator sub-subcommands (loadgen driver; datadir fsck; bench trend
+    # report; offline-diagnosis tarball). Optional — plain `bn` still runs
+    # the node.
     bnsub = bn.add_subparsers(dest="bn_command", required=False,
-                              metavar="{loadtest,doctor,perf}")
+                              metavar="{loadtest,doctor,perf,debug-bundle}")
     bnlt = bnsub.add_parser(
         "loadtest",
         help="run a deterministic loadgen scenario (mainnet-shaped gossip "
@@ -1547,6 +1588,26 @@ def build_parser() -> argparse.ArgumentParser:
                             "tail back to the last valid record and delete "
                             "stray compaction tmp files")
     bndoc.set_defaults(fn=cmd_doctor)
+
+    bndbg = bnsub.add_parser(
+        "debug-bundle",
+        help="package everything a diagnosis needs into one tarball: "
+             "metrics exposition, pipeline + SLO snapshots, the flight-"
+             "recorder event ring, incident dumps from <datadir>/incidents, "
+             "doctor output, the autotune profile and bench metadata",
+    )
+    bndbg.add_argument("--out", default="debug-bundle.tar.gz",
+                       help="output tarball path "
+                            "(default: debug-bundle.tar.gz)")
+    bndbg.add_argument("--datadir", default=None,
+                       help="beacon datadir to collect incident dumps and "
+                            "doctor output from (optional: process-side "
+                            "surfaces are bundled regardless)")
+    bndbg.add_argument("--root", default=None,
+                       help="directory holding BENCH_MATRIX.json and the "
+                            "BENCH_r* artifacts (default: the install's "
+                            "repo root)")
+    bndbg.set_defaults(fn=cmd_debug_bundle)
 
     bnperf = bnsub.add_parser(
         "perf",

@@ -42,6 +42,9 @@ import time
 from collections import deque
 from typing import Sequence
 
+from ...observability import flight_recorder as _fr
+from ...observability import slo as _slo
+from ...observability import trace as _obs_trace
 from ...utils.logging import get_logger
 from ...utils.metrics import REGISTRY
 
@@ -63,6 +66,18 @@ _REASONS = {
     )
 }
 _DEVICE_ROUTED = _ROUTE_DECISIONS.labels("device", "ok")
+
+
+def _note_route(path: str, reason: str, n_sets: int = 1) -> None:
+    """One served verification: the route family child, the SLO
+    accountant's per-slot route share, and a flight-recorder event when
+    the path FLIPS (device->host or back) — route flips are exactly the
+    transitions an incident dump should show next to breaker events."""
+    (_DEVICE_ROUTED if path == "device" else _REASONS[reason]).inc()
+    _slo.ACCOUNTANT.record_route(path, n_sets)
+    _fr.RECORDER.note_route("bls_device", path, reason)
+
+
 _DEVICE_LATENCY = REGISTRY.histogram(
     "bls_hybrid_device_verify_seconds", "device multi-set verify wall time"
 )
@@ -370,7 +385,7 @@ class HybridBackend:
 
         return api._BACKENDS["python"]
 
-    def _record_device_ok(self, bucket, dt):
+    def _record_device_ok(self, bucket, dt, n_sets: int = 1):
         _DEVICE_LATENCY.observe(dt)
         with self._lock:
             self._lats.append(dt)
@@ -383,6 +398,15 @@ class HybridBackend:
                            secs=round(dt, 2),
                            budget_secs=self._stall_budget_secs)
             self._breaker.record_failure()
+            # SLO: the sets verified, but past their usefulness budget —
+            # processed for conservation, deadline MISSES for the SLI.
+            # Kind rides the current trace (set by the processor for the
+            # sync verify path) so a late BLOCK batch is excluded; async
+            # batch resolves carry no trace here and those are exactly the
+            # coalesced attestation/aggregate (TIMELY) dispatches.
+            tr = _obs_trace.current_trace()
+            _slo.ACCOUNTANT.record_late(n_sets,
+                                        kind=tr.kind if tr else None)
         else:
             self._breaker.record_success()
 
@@ -402,18 +426,18 @@ class HybridBackend:
     def verify_signature_sets(self, sets, rands) -> bool:
         path, reason = self._route(sets)
         if path == "host":
-            _REASONS[reason].inc()
+            _note_route("host", reason, len(sets))
             return self._host().verify_signature_sets(sets, rands)
         bucket = self._bucket(sets)
         try:
             t0 = time.time()
             ok = self._device.verify_signature_sets(sets, rands)
-            self._record_device_ok(bucket, time.time() - t0)
-            _DEVICE_ROUTED.inc()
+            self._record_device_ok(bucket, time.time() - t0, len(sets))
+            _note_route("device", "ok", len(sets))
             return ok
         except Exception as e:
             self._record_device_error(e)
-            _REASONS["device_error"].inc()
+            _note_route("host", "device_error", len(sets))
             return self._host().verify_signature_sets(sets, rands)
 
     def verify_signature_sets_async(self, sets, rands):
@@ -421,7 +445,7 @@ class HybridBackend:
 
         path, reason = self._route(sets)
         if path == "host":
-            _REASONS[reason].inc()
+            _note_route("host", reason, len(sets))
             return api._ReadyHandle(
                 self._host().verify_signature_sets(sets, rands)
             )
@@ -438,12 +462,14 @@ class HybridBackend:
             def result(self) -> bool:
                 try:
                     r = self._inner.result()
-                    outer._record_device_ok(bucket, time.time() - self._t0)
-                    _DEVICE_ROUTED.inc()
+                    outer._record_device_ok(
+                        bucket, time.time() - self._t0, len(sets)
+                    )
+                    _note_route("device", "ok", len(sets))
                     return r
                 except Exception as e:
                     outer._record_device_error(e)
-                    _REASONS["device_error"].inc()
+                    _note_route("host", "device_error", len(sets))
                     return outer._host().verify_signature_sets(sets, rands)
 
         try:
@@ -451,7 +477,7 @@ class HybridBackend:
             return _Handle(self._device.verify_signature_sets_async(sets, rands), t0)
         except Exception as e:
             self._record_device_error(e)
-            _REASONS["device_error"].inc()
+            _note_route("host", "device_error", len(sets))
             return api._ReadyHandle(self._host().verify_signature_sets(sets, rands))
 
     def __getattr__(self, name):
@@ -489,9 +515,10 @@ class HybridBackend:
                     self._breaker.record_failure()
                 else:
                     self._breaker.record_success()
+                _note_route("device", "ok")
                 return ok
             except Exception as e:
                 self._record_device_error(e)
                 reason = "device_error"
-        _REASONS[reason].inc()
+        _note_route("host", reason)
         return self._host().aggregate_verify(pks, messages, sig)

@@ -62,6 +62,11 @@ def drive(*, scenario=None, smoke=False, slots=None, validators=None,
         "qos_totals": report["qos_totals"],
         "breaker_transitions": report["breaker_transitions"],
         "blocks_processed_in_slot": report["blocks_processed_in_slot"],
+        "slo": {
+            "deadline_hit_ratio": report["slo"]["deadline_hit_ratio"],
+            "windows": report["slo"]["windows"],
+            "incidents": report["slo"]["incidents"],
+        },
         "elapsed_secs": report["elapsed_secs"],
     }
     if "crash" in report:
@@ -74,6 +79,15 @@ def drive(*, scenario=None, smoke=False, slots=None, validators=None,
     ):
         print("error: crash-restart invariants violated (see report)",
               file=stderr)
+        return 1
+    if "device_stall" in report.get("faults", ()) and not (
+        report["slo"]["incidents"]
+    ):
+        # a device stall MUST leave a durable incident trail: the breaker
+        # opening is the canonical trigger, and a run where it produced no
+        # dump means the black box is broken — fail loudly
+        print("error: device_stall produced no incident dump "
+              "(see report slo block)", file=stderr)
         return 1
     return 0
 

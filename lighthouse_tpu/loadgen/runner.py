@@ -14,6 +14,15 @@ Time is a `ManualSlotClock` advanced slot by slot; the breaker reads the
 same logical clock. Within a slot the generator is open-loop (everything
 publishes whether or not the pipeline keeps up), then the pump drains, so
 every count in the report is a pure function of (scenario, seed).
+
+Service-level accounting: each run drives a PRIVATE SlotAccountant
+(observability/slo.py — the global one belongs to the node) whose slot
+reports close after every drained slot, so the report's `slo` block shows
+the per-slot deadline-hit ratio degrading through a device stall and
+recovering after. The global flight recorder is reset per run and pointed
+at `<datadir>/incidents`: the breaker opening (or a burn-rate/miss-streak
+trigger) dumps a real incident snapshot, which the report lists and
+`bn debug-bundle --datadir` packages.
 """
 
 from __future__ import annotations
@@ -32,6 +41,8 @@ from ..chain.beacon_processor import (
 )
 from ..network import gossip as gs
 from ..network import snappy
+from ..observability.flight_recorder import RECORDER
+from ..observability.slo import SlotAccountant
 from ..qos.admission import AdmissionController
 from ..qos.breaker import CircuitBreaker
 from ..utils.slot_clock import ManualSlotClock
@@ -48,7 +59,8 @@ _FORK_DIGEST = b"\x00" * 4
 class LoadgenNode:
     """Router topics -> QoS-guarded BeaconProcessor -> counting verifiers."""
 
-    def __init__(self, sc: Scenario, clock: ManualSlotClock, store=None):
+    def __init__(self, sc: Scenario, clock: ManualSlotClock, store=None,
+                 slo_acct: SlotAccountant | None = None):
         self.scenario = sc
         self.clock = clock
         # optional durable store: the block handler persists the head slot
@@ -60,6 +72,14 @@ class LoadgenNode:
         self.processor = BeaconProcessor(
             BeaconProcessorConfig(), admission=self.admission
         )
+        # private per-run accountant (export_metrics=False keeps the
+        # process-global slo_* gauges owned by the node's accountant);
+        # crash_restart passes ONE accountant across both node phases
+        self.slo = slo_acct if slo_acct is not None else SlotAccountant(
+            export_metrics=False
+        )
+        self.slo.bind_clock(clock)
+        self.processor.slo = self.slo
         if sc.att_queue_cap is not None:
             self.processor.max_lengths[WorkKind.gossip_attestation] = (
                 sc.att_queue_cap
@@ -167,20 +187,34 @@ class LoadgenNode:
         crypto (fake semantics; loadgen measures QoS, not pairings)."""
         n = len(payloads)
         self.verified_sets += n
+        t0 = time.perf_counter()
         if self.breaker.allow():
             try:
                 self.device.verify_signature_sets([None] * n, [1] * n)
                 self.breaker.record_success()
                 self.batches["device"] += 1
+                self.slo.record_route("device", n)
+                self.slo.record_verify_latency(time.perf_counter() - t0)
+                RECORDER.note_route("loadgen_device", "device", "ok")
                 return None
             except DeviceStallError:
                 self.breaker.record_failure()
                 self.batches["device_stalls"] += 1
+                # the host serves the batch below, but it already blew the
+                # device stall budget: these items verified LATE — counted
+                # processed for conservation, deadline MISSES for the SLI
+                self.slo.record_late(n)
         else:
             self.batches["circuit_refusals"] += 1
         if self.slow_host is not None:
             self.slow_host(n)
         self.batches["host"] += 1
+        self.slo.record_route("host", n)
+        self.slo.record_verify_latency(time.perf_counter() - t0)
+        RECORDER.note_route(
+            "loadgen_device", "host",
+            "device_stall" if self.device.stalled else "circuit_open",
+        )
         return None
 
     # --------------------------------------------------------- publishing
@@ -209,6 +243,53 @@ class LoadgenNode:
         self.published["blocks"] += traffic.blocks
 
 
+def _prepare_recorder(datadir: str | None, clock, slo_acct) -> str:
+    """Reset the global flight recorder for a deterministic run and point
+    it at this run's incident directory; returns that directory."""
+    datadir = datadir or tempfile.mkdtemp(prefix="loadgen-")
+    incident_dir = os.path.join(datadir, "incidents")
+    RECORDER.reset()
+    RECORDER.configure(incident_dir=incident_dir, clock=clock,
+                       slo_provider=slo_acct.snapshot)
+    return incident_dir
+
+
+def _slo_block(slo_acct: SlotAccountant, incident_dir: str) -> dict:
+    """The report's service-level block: per-slot deadline-hit ratios, the
+    rolling windows, and the incidents the run dumped."""
+    reports = [r for r in slo_acct.recent if not r.empty]
+    hits = sum(r.hits for r in reports)
+    misses = sum(r.misses for r in reports)
+    total = hits + misses
+    return {
+        "target": slo_acct.target,
+        "deadline_hits": hits,
+        "deadline_misses": misses,
+        "deadline_hit_ratio": round(hits / total, 4) if total else None,
+        "per_slot": [
+            {
+                "slot": r.slot,
+                "deadline_hit_ratio": (
+                    None if r.hit_ratio() is None else round(r.hit_ratio(), 4)
+                ),
+                "hits": r.hits,
+                "misses": r.misses,
+                "late": r.late,
+                "routes": r.routes,
+            }
+            for r in reports
+        ],
+        "windows": {
+            name: slo_acct.window_summary(name) for name in slo_acct.windows
+        },
+        "incident_dir": incident_dir,
+        "incidents": [
+            os.path.basename(p) for p in RECORDER.incidents_written
+        ],
+        "flight_recorder_events": RECORDER.events_recorded,
+    }
+
+
 def run_scenario(sc: Scenario, out_path: str | None = None,
                  log_fn=None, datadir: str | None = None) -> dict:
     """Run one scenario to completion; returns (and optionally writes) the
@@ -218,7 +299,9 @@ def run_scenario(sc: Scenario, out_path: str | None = None,
                                  datadir=datadir)
     t_wall = time.time()
     clock = ManualSlotClock(0, max(1, int(sc.seconds_per_slot)))
-    node = LoadgenNode(sc, clock)
+    slo_acct = SlotAccountant(export_metrics=False)
+    incident_dir = _prepare_recorder(datadir, clock, slo_acct)
+    node = LoadgenNode(sc, clock, slo_acct=slo_acct)
     injector = FaultInjector()
     if "device_stall" in sc.faults:
         start, end = sc.stall_slots
@@ -231,6 +314,7 @@ def run_scenario(sc: Scenario, out_path: str | None = None,
         injector.on_slot(slot)
         node.publish_slot(slot, traffic, rng)
         node.processor.run_until_idle()
+        slo_acct.close_slot(slot)
         if log_fn is not None:
             log_fn(f"slot {slot}: published "
                    f"{traffic.attestations + traffic.stale_attestations} att "
@@ -241,6 +325,7 @@ def run_scenario(sc: Scenario, out_path: str | None = None,
     injector.on_slot(sc.slots + max(0, sc.stall_slots[1] - sc.slots))
     node.device.release()
     node.processor.run_until_idle()
+    slo_acct.close_slot(sc.slots)
     proc = node.processor
     report = {
         "scenario": sc.name,
@@ -263,8 +348,16 @@ def run_scenario(sc: Scenario, out_path: str | None = None,
         "breaker_transitions": list(node.breaker.transitions),
         "blocks_processed_in_slot": bool(node.block_slot_lag)
         and max(node.block_slot_lag) == 0,
+        "slo": _slo_block(slo_acct, incident_dir),
         "elapsed_secs": round(time.time() - t_wall, 3),
     }
+    # the deadline-hit ratio rides next to the loss accounting so one
+    # glance answers both "was work conserved" and "was it in time"
+    report["deadline_hit_ratio"] = report["slo"]["deadline_hit_ratio"]
+    # fully detach the run's wiring: a later incident in this process
+    # must not be stamped by the dead manual clock or carry this run's
+    # private accountant windows
+    RECORDER.configure(incident_dir=None, clock=None, slo_provider=None)
     if out_path:
         with open(out_path, "w") as f:
             json.dump(report, f, indent=1)
@@ -309,7 +402,11 @@ def run_crash_restart(sc: Scenario, out_path: str | None = None,
         fsync="always",
     )
     clock = ManualSlotClock(0, max(1, int(sc.seconds_per_slot)))
-    node = LoadgenNode(sc, clock, store=store)
+    # ONE accountant across the crash and the restart: the scenario's
+    # service level is what the OPERATOR saw, node identity aside
+    slo_acct = SlotAccountant(export_metrics=False)
+    incident_dir = _prepare_recorder(datadir, clock, slo_acct)
+    node = LoadgenNode(sc, clock, store=store, slo_acct=slo_acct)
     schedule = traffic_schedule(sc)
     rng = random.Random(sc.seed ^ 0x10AD6E4)
 
@@ -323,9 +420,12 @@ def run_crash_restart(sc: Scenario, out_path: str | None = None,
         except SimulatedCrash as e:
             crash_msg = str(e)
             resume_at = slot + 1   # the node is down for the rest of the slot
+            RECORDER.record("node_crash", severity="error", slot=slot,
+                            fault=str(e))
             if log_fn is not None:
                 log_fn(f"slot {slot}: CRASH — {e}")
             break
+        slo_acct.close_slot(slot)
         if log_fn is not None:
             log_fn(f"slot {slot}: published "
                    f"{traffic.attestations + traffic.stale_attestations} att "
@@ -347,16 +447,18 @@ def run_crash_restart(sc: Scenario, out_path: str | None = None,
         int.from_bytes(raw, "little", signed=True) if raw is not None else None
     )
     expected_head = crash_slot - 1 if crash_msg is not None else sc.slots - 1
-    node2 = LoadgenNode(sc, clock, store=store2)
+    node2 = LoadgenNode(sc, clock, store=store2, slo_acct=slo_acct)
     for slot in range(resume_at, sc.slots):
         clock.set_slot(slot)
         node2.publish_slot(slot, schedule[slot], rng)
         node2.processor.run_until_idle()
+        slo_acct.close_slot(slot)
         if log_fn is not None:
             log_fn(f"slot {slot}: resumed node published "
                    f"{schedule[slot].attestations} att")
     clock.set_slot(sc.slots)
     node2.processor.run_until_idle()
+    slo_acct.close_slot(sc.slots)
     store2.close()
     proc2 = node2.processor
 
@@ -385,6 +487,10 @@ def run_crash_restart(sc: Scenario, out_path: str | None = None,
         conservation["processed"] + conservation["dropped"]
         + conservation["expired"] + conservation["lost_to_crash"]
     )
+    slo_block = _slo_block(slo_acct, incident_dir)
+    # the deadline-hit ratio sits INSIDE the conservation block: "was work
+    # conserved" and "was it in time" are the two halves of one verdict
+    conservation["deadline_hit_ratio"] = slo_block["deadline_hit_ratio"]
     lag = node.block_slot_lag + node2.block_slot_lag
     report = {
         "scenario": sc.name,
@@ -420,8 +526,14 @@ def run_crash_restart(sc: Scenario, out_path: str | None = None,
         "breaker_transitions": list(node.breaker.transitions)
         + list(node2.breaker.transitions),
         "blocks_processed_in_slot": bool(lag) and max(lag) == 0,
+        "slo": slo_block,
+        "deadline_hit_ratio": slo_block["deadline_hit_ratio"],
         "elapsed_secs": round(time.time() - t_wall, 3),
     }
+    # fully detach the run's wiring: a later incident in this process
+    # must not be stamped by the dead manual clock or carry this run's
+    # private accountant windows
+    RECORDER.configure(incident_dir=None, clock=None, slo_provider=None)
     if out_path:
         with open(out_path, "w") as f:
             json.dump(report, f, indent=1)

@@ -80,6 +80,14 @@ class NetworkNode:
         self.admission = AdmissionController(chain.slot_clock)
         self.processor = BeaconProcessor(processor_config,
                                          admission=self.admission)
+        # SLO slot attribution rides the same clock. First node wins (tests
+        # assemble many nodes; the live process has one) — and slots only
+        # CLOSE from the bn slot timer, so merely binding a clock never
+        # emits reports or trips incident triggers on its own.
+        from ..observability import slo as obs_slo
+
+        if not obs_slo.ACCOUNTANT.clock_bound():
+            obs_slo.ACCOUNTANT.bind_clock(chain.slot_clock)
         # optional gossip ingest token buckets (msgs/sec per batchable
         # kind; over-quota messages become gossip IGNOREs before touching
         # the queues). None = unlimited, the default.

@@ -17,6 +17,18 @@ the system's hot path; this package makes it legible from the outside:
     cost/memory analysis), roofline derivation, and the BENCH_r*/
     MULTICHIP_r* trend + regression gate (`bn perf report`,
     scripts/perf_trend.py).
+  - `slo`: the slot-level service-level accountant — one SlotReport per
+    slot-clock boundary (admitted/processed/shed per kind, deadline-hit
+    ratio for TIMELY work, route share, wait/latency quantiles), rolling
+    5-slot and 32-slot windows with burn-rate, `slo_*` families, the
+    `/lighthouse_tpu/slo` ops endpoint and the health degraded signal.
+  - `flight_recorder`: the always-on black box — a bounded ring of
+    structured events (breaker transitions, shed bursts, deadline misses,
+    supervisor restarts, route flips, WARN+ log records) with incident
+    triggers that dump diagnosis snapshots to `datadir/incidents/` and
+    render as instant markers in the Perfetto export.
+  - `debug_bundle`: `bn debug-bundle` — one tarball of everything above
+    plus `bn doctor` output and bench metadata, for offline diagnosis.
 
 Always-on by design: recording a trace is appending a few floats to a
 deque, so there is no enabled/disabled bifurcation to test — `--trace-out`
@@ -34,3 +46,6 @@ from .trace import (  # noqa: F401
 )
 from .pipeline import register_processor, snapshot  # noqa: F401
 from . import device, perf  # noqa: F401  (registers the device/xla families)
+from . import flight_recorder, slo  # noqa: F401  (registers slo_*/flight_recorder_* families + the log sink)
+from .flight_recorder import RECORDER  # noqa: F401
+from .slo import ACCOUNTANT  # noqa: F401

@@ -1,0 +1,208 @@
+"""`bn debug-bundle` — one tarball for offline diagnosis.
+
+An operator filing "the node misbehaved" should not have to know which of
+a dozen surfaces holds the evidence. The bundle collects, best-effort,
+everything a diagnosis session starts from:
+
+  - `manifest.json`      what was collected (+ per-member status), the
+                         config fingerprint, bundle schema version
+  - `metrics.prom`       full Prometheus exposition of this process
+  - `pipeline.json`      the /lighthouse_tpu/pipeline snapshot
+  - `slo.json`           the slot-level SLO accountant snapshot
+  - `flight_recorder.json`  the black-box event ring + trigger state
+  - `logs.json`          recent structured log records
+  - `incidents/*.json`   every incident dump found in <datadir>/incidents
+  - `doctor.json`        `bn doctor` fsck of the datadir (when given)
+  - `autotune_profile.json`  the installed autotune profile (when any)
+  - `bench.json`         BENCH_MATRIX.json + the perf trend summary
+                         (when the install's repo root carries them)
+
+Every member is independent: a half-initialized process (or a datadir-less
+invocation) still produces a useful bundle, and the manifest says exactly
+what is missing and why. Stdlib-only; nothing here touches a device.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import tarfile
+import time
+
+BUNDLE_SCHEMA = "lighthouse_tpu/debug-bundle/v1"
+
+
+def _collect(fn):
+    """Run one collector; returns (payload, error-or-None)."""
+    try:
+        return fn(), None
+    except Exception as e:  # noqa: BLE001 — collectors are best-effort
+        return None, f"{type(e).__name__}: {e}"
+
+
+def _collect_metrics() -> str:
+    from ..utils.metrics import REGISTRY
+
+    return REGISTRY.expose_text()
+
+
+def _collect_pipeline() -> dict:
+    from . import snapshot
+
+    return snapshot()
+
+
+def _collect_slo() -> dict:
+    from .slo import ACCOUNTANT
+
+    return ACCOUNTANT.snapshot(recent=32)
+
+
+def _collect_flight_recorder() -> dict:
+    from .flight_recorder import RECORDER
+
+    return RECORDER.snapshot()
+
+
+def _collect_logs() -> list:
+    from ..utils.logging import RECENT
+
+    return [
+        {"ts": ts, "level": level, "component": component, "msg": msg,
+         **{k: str(v) for k, v in fields.items()}}
+        for ts, level, component, msg, fields in list(RECENT)[-256:]
+    ]
+
+
+def _collect_doctor(datadir: str) -> dict:
+    from ..store.doctor import fsck_datadir
+
+    return fsck_datadir(datadir, repair=False)
+
+
+def _collect_autotune() -> dict:
+    from ..autotune import profile as at_profile
+    from ..autotune import runtime as at_runtime
+
+    prof = at_runtime.active_profile()
+    if prof is None:
+        # not installed in this process: fall back to this device's
+        # canonical on-disk profile if one exists
+        key = at_runtime.detect_device_key(wait_secs=2.0)
+        if key is None:
+            raise FileNotFoundError("no installed or detectable profile")
+        prof = at_profile.load(at_profile.default_path(key))
+    return prof.to_json()
+
+
+def _collect_bench(root: str) -> dict:
+    out: dict = {}
+    matrix = os.path.join(root, "BENCH_MATRIX.json")
+    if os.path.exists(matrix):
+        with open(matrix) as f:
+            out["bench_matrix"] = json.load(f)
+    from . import perf
+
+    trend = perf.trend_summary()
+    if trend is not None:
+        out["perf_trend"] = trend
+    if not out:
+        raise FileNotFoundError("no bench artifacts at install root")
+    return out
+
+
+def build_bundle(out_path: str, datadir: str | None = None,
+                 root: str | None = None) -> dict:
+    """Write the tarball; returns the manifest (also stored inside it)."""
+    from .flight_recorder import config_fingerprint
+
+    if root is None:
+        # the install's repo root (where BENCH_r*.json live)
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        )))
+    members: list[tuple[str, bytes]] = []
+    status: dict[str, str] = {}
+
+    def add_json(name: str, fn) -> None:
+        payload, err = _collect(fn)
+        if err is not None:
+            status[name] = f"skipped: {err}"
+            return
+        members.append(
+            (name, json.dumps(payload, indent=1, default=str).encode())
+        )
+        status[name] = "ok"
+
+    payload, err = _collect(_collect_metrics)
+    if err is None:
+        members.append(("metrics.prom", payload.encode()))
+        status["metrics.prom"] = "ok"
+    else:
+        status["metrics.prom"] = f"skipped: {err}"
+    add_json("pipeline.json", _collect_pipeline)
+    add_json("slo.json", _collect_slo)
+    add_json("flight_recorder.json", _collect_flight_recorder)
+    add_json("logs.json", _collect_logs)
+    add_json("autotune_profile.json", _collect_autotune)
+    add_json("bench.json", lambda: _collect_bench(root))
+
+    incidents: list[str] = []
+    if datadir:
+        add_json("doctor.json", lambda: _collect_doctor(datadir))
+        inc_dir = os.path.join(datadir, "incidents")
+        if os.path.isdir(inc_dir):
+            for name in sorted(os.listdir(inc_dir)):
+                if not name.endswith(".json"):
+                    continue
+                try:
+                    with open(os.path.join(inc_dir, name), "rb") as f:
+                        members.append((f"incidents/{name}", f.read()))
+                    incidents.append(name)
+                except OSError as e:
+                    status[f"incidents/{name}"] = f"skipped: {e}"
+            status["incidents"] = f"ok: {len(incidents)} dump(s)"
+        else:
+            status["incidents"] = "skipped: no incidents directory"
+    else:
+        status["doctor.json"] = "skipped: no --datadir"
+        status["incidents"] = "skipped: no --datadir"
+
+    manifest = {
+        "schema": BUNDLE_SCHEMA,
+        "created": time.time(),
+        "datadir": datadir,
+        "members": sorted(n for n, _ in members) + ["manifest.json"],
+        "status": status,
+        "incidents": incidents,
+        "config_fingerprint": config_fingerprint(),
+    }
+    members.append(
+        ("manifest.json", json.dumps(manifest, indent=1, default=str).encode())
+    )
+
+    with tarfile.open(out_path, "w:gz") as tar:
+        for name, data in members:
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            info.mtime = int(manifest["created"])
+            tar.addfile(info, io.BytesIO(data))
+    return manifest
+
+
+def run_from_args(args) -> int:
+    """CLI entry for `bn debug-bundle`."""
+    manifest = build_bundle(
+        out_path=args.out, datadir=args.datadir, root=args.root
+    )
+    print(json.dumps(
+        {
+            "bundle": args.out,
+            "members": manifest["members"],
+            "incidents": manifest["incidents"],
+            "status": manifest["status"],
+        },
+        indent=1,
+    ))
+    return 0

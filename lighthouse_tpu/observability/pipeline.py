@@ -113,6 +113,7 @@ def run_probe(n_items: int = 8) -> int:
     )
     from ..crypto import bls
     from ..crypto.bls381 import curve as cv
+    from .slo import SlotAccountant
 
     pk = bls.PublicKey(cv.G1_GEN)
     sig = bls.Signature(cv.G2_GEN)
@@ -128,6 +129,10 @@ def run_probe(n_items: int = 8) -> int:
     proc = BeaconProcessor(
         BeaconProcessorConfig(max_attestation_batch=max(2, n_items))
     )
+    # synthetic probe work must not pollute the node's production SLI (a
+    # cold first dispatch reading as 8 deadline misses could trip the
+    # burn-rate incident on a healthy node): throwaway accountant
+    proc.slo = SlotAccountant(export_metrics=False)
     for i in range(n_items):
         proc.submit(
             WorkItem(

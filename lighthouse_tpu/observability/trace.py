@@ -31,6 +31,7 @@ t=0 is the oldest event in the ring.
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import threading
@@ -63,15 +64,22 @@ TRACES_TOTAL = REGISTRY.counter_vec(
 )
 
 
+#: process-wide monotonic trace ids — the correlation key the flight
+#: recorder stamps on events recorded while a trace is current, so an
+#: incident dump's event list joins against its recent-trace list
+_next_trace_id = itertools.count(1)
+
+
 class Trace:
     """One work unit's spans. Append-only; finished via Tracer.finish."""
 
-    __slots__ = ("kind", "n_items", "t0", "spans", "meta")
+    __slots__ = ("kind", "n_items", "t0", "spans", "meta", "trace_id")
 
     def __init__(self, kind: str, n_items: int = 1):
         self.kind = kind
         self.n_items = n_items
         self.t0 = perf_counter()
+        self.trace_id = next(_next_trace_id)
         self.spans: list = []        # (name, t0, t1, args|None)
         self.meta: dict = {}
 
@@ -101,6 +109,11 @@ class Tracer:
         self._lock = threading.Lock()
         self.completed = 0
         self.out_path: str | None = None  # bn --trace-out destination
+        # optional () -> [(t_mono, name, args)] provider of instant-event
+        # markers for the export; the flight recorder wires itself onto
+        # the global TRACER at import (test-local Tracer instances export
+        # only their own spans)
+        self.instants_source = None
 
     def begin(self, kind: str, n_items: int = 1) -> Trace:
         return Trace(kind, n_items)
@@ -138,9 +151,13 @@ class Tracer:
     # ------------------------------------------------------------- export
 
     def write_chrome_trace(self, path: str) -> int:
-        """Write the ring as Chrome trace-event JSON; returns event count."""
+        """Write the ring as Chrome trace-event JSON; returns event count.
+        With an instants_source wired (the flight recorder on the global
+        TRACER), its events render as instant markers on a dedicated lane
+        of the same timeline."""
         events = chrome_trace_events(
-            self.snapshot_ring(), counters=self.snapshot_counters()
+            self.snapshot_ring(), counters=self.snapshot_counters(),
+            instants=self.instants_source() if self.instants_source else None,
         )
         doc = {
             "traceEvents": events,
@@ -156,9 +173,13 @@ class Tracer:
 #: (host pipeline lanes recycle tid 0..31)
 DEVICE_LANE_BASE = 1000
 
+#: flight-recorder instant events render on this dedicated lane
+INSTANT_LANE = 900
+
 
 def chrome_trace_events(
-    traces: list[Trace], counters: list[tuple] | None = None
+    traces: list[Trace], counters: list[tuple] | None = None,
+    instants: list[tuple] | None = None,
 ) -> list[dict]:
     """Trace-event ("X" complete events, µs) rows for a list of traces.
 
@@ -170,16 +191,25 @@ def chrome_trace_events(
     thread_name metadata row, so host pipeline and device stages show as
     distinct lanes of ONE timeline. `counters` — (t, name, {series:
     value}) samples from Tracer.sample_counters — export as "ph": "C"
-    counter rows. Timestamps are rebased so the oldest event is t=0."""
+    counter rows. `instants` — (t, name, args) markers from the flight
+    recorder (breaker transitions, incidents, deadline misses) — export as
+    "ph": "i" instant events on the dedicated INSTANT_LANE, so the black
+    box's view lines up against the pipeline spans. Timestamps are rebased
+    so the oldest event is t=0."""
     counters = counters or []
-    if not traces and not counters:
+    instants = instants or []
+    if not traces and not counters and not instants:
         return []
     span_starts = [
         t0
         for tr in traces
         for _, t0, _, _ in tr.spans or [("", tr.t0, tr.t0, None)]
     ]
-    base = min(span_starts + [t for t, _, _ in counters])
+    base = min(
+        span_starts
+        + [t for t, _, _ in counters]
+        + [t for t, _, _ in instants]
+    )
     pid = os.getpid()
     events = []
     device_lanes: dict = {}  # span name -> dedicated tid
@@ -229,6 +259,28 @@ def chrome_trace_events(
                 "args": {k: float(v) for k, v in values.items()},
             }
         )
+    if instants:
+        events.append(
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": INSTANT_LANE,
+                "args": {"name": "flight_recorder"},
+            }
+        )
+        for t, name, args in instants:
+            ev = {
+                "name": name,
+                "ph": "i",
+                "s": "p",          # process-scope marker: full-height line
+                "ts": (t - base) * 1e6,
+                "pid": pid,
+                "tid": INSTANT_LANE,
+            }
+            if args:
+                ev["args"] = {k: str(v) for k, v in args.items()}
+            events.append(ev)
     return events
 
 

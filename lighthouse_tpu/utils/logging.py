@@ -41,6 +41,40 @@ _sink = None          # None = sys.stderr at call time (respects redirects)
 #: last N records for the ops API / tests: (ts, level, component, msg, fields)
 RECENT: deque = deque(maxlen=512)
 
+#: record observers: callables fed (ts, level_name, component, msg, fields)
+#: for every WARN-or-worse record (the flight recorder's log sink —
+#: observability/flight_recorder.py). Deliberately NOT called for
+#: info/debug: the hot path must not pay a callback per routine line.
+_OBSERVER_MIN_LEVEL = LEVELS["warn"]
+_observers: list = []
+_in_observer = threading.local()
+
+
+def add_observer(fn) -> None:
+    if fn not in _observers:
+        _observers.append(fn)
+
+
+def remove_observer(fn) -> None:
+    if fn in _observers:
+        _observers.remove(fn)
+
+
+def _notify_observers(ts, level_name, component, msg, fields) -> None:
+    # reentrancy guard: an observer that itself logs (or crashes into an
+    # error path that logs) must not recurse back into the observer chain
+    if getattr(_in_observer, "active", False):
+        return
+    _in_observer.active = True
+    try:
+        for fn in list(_observers):
+            try:
+                fn(ts, level_name, component, msg, fields)
+            except Exception:
+                pass  # observers are best-effort; logging must never raise
+    finally:
+        _in_observer.active = False
+
 
 def set_level(level: str) -> None:
     global _global_level
@@ -67,6 +101,8 @@ class Logger:
             return
         ts = time.time()
         RECENT.append((ts, _LEVEL_NAMES[level], self.component, msg, fields))
+        if level >= _OBSERVER_MIN_LEVEL and _observers:
+            _notify_observers(ts, _LEVEL_NAMES[level], self.component, msg, fields)
         if _json_mode:
             line = json.dumps(
                 {

@@ -152,6 +152,17 @@ class MonitoringService:
                 totals = proc.qos_totals()
                 rec["qos_shed_total"] = int(totals["shed"])
                 rec["qos_expired_total"] = int(totals["expired"])
+            # slot-level SLO headline (observability/slo.py): the remote
+            # monitor sees "is this node meeting its slot deadlines" and
+            # the current burn rate without scraping /metrics
+            try:
+                from ..observability import slo as obs_slo
+
+                short = obs_slo.ACCOUNTANT.window_summary("slot_5")
+                rec["slo_deadline_hit_ratio"] = short["deadline_hit_ratio"]
+                rec["slo_burn_rate"] = short["burn_rate"]
+            except Exception:  # noqa: BLE001 — monitoring must never fail
+                pass
             out.append(rec)
         if self.vc_store is not None:
             out.append(

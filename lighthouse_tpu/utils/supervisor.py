@@ -98,6 +98,17 @@ class Supervisor:
                     attempt += 1
                     self.restarts[service] = attempt
                     SERVICE_RESTARTS.labels(service).inc()
+                    try:
+                        # black-box record: a restarted background service
+                        # is exactly the kind of event an incident dump
+                        # should show next to breaker/SLO transitions
+                        from ..observability.flight_recorder import RECORDER
+
+                        RECORDER.note_supervisor_restart(
+                            service, attempt, f"{type(e).__name__}: {e}"
+                        )
+                    except Exception:
+                        pass
                     self._log.warn(
                         "service crashed; restarting",
                         service=service, attempt=attempt,

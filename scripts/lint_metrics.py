@@ -44,6 +44,8 @@ METRIC_MODULES = (
     "lighthouse_tpu.observability",
     "lighthouse_tpu.observability.device",
     "lighthouse_tpu.observability.perf",
+    "lighthouse_tpu.observability.slo",
+    "lighthouse_tpu.observability.flight_recorder",
     "lighthouse_tpu.api.http_api",
     "lighthouse_tpu.qos",
 )
@@ -94,6 +96,16 @@ def lint_registry(registry=None) -> list[str]:
             if not getattr(m, "labelnames", ()):
                 errors.append(
                     f"{where}: qos_* metrics must be labeled families"
+                )
+        if m.name.startswith(("slo_", "flight_recorder_")):
+            # the SLO engine's series answer "which window / which outcome
+            # / which route" and the flight recorder's "which event kind /
+            # which trigger" — an unlabeled aggregate answers none of
+            # them, so the convention is enforced like qos_*
+            if not getattr(m, "labelnames", ()):
+                errors.append(
+                    f"{where}: slo_*/flight_recorder_* metrics must be "
+                    "labeled families"
                 )
         if m.name.startswith(("jaxbls_stage_", "xla_program_")):
             # per-stage attribution and compiled-program analytics exist

@@ -103,6 +103,66 @@ def test_steady_scenario_sheds_nothing():
     assert report["dropped"] == {} and report["expired"] == {}
     assert report["breaker_transitions"] == ["closed"]
     assert report["batches"]["host"] == 0      # healthy device took it all
+    # a healthy run's SLO block: perfect deadline ratio, no incidents
+    assert report["deadline_hit_ratio"] == 1.0
+    assert report["slo"]["incidents"] == []
+    assert report["slo"]["windows"]["slot_5"]["burn_rate"] == 0.0
+
+
+def test_device_stall_slo_degradation_and_incident(tmp_path):
+    """The acceptance surface: device_stall at smoke scale shows the
+    per-slot deadline-hit ratio DEGRADING through the stall window and
+    RECOVERING after, and the breaker/burn triggers leave >=1 schema-valid
+    incident dump in <datadir>/incidents that `bn debug-bundle` packages."""
+    import tarfile
+
+    from lighthouse_tpu.loadgen import smoke_variant
+    from lighthouse_tpu.observability.debug_bundle import build_bundle
+    from lighthouse_tpu.observability.flight_recorder import validate_incident
+
+    sc = smoke_variant(get_scenario("device_stall"))
+    datadir = tmp_path / "dd"
+    report = run_scenario(sc, datadir=str(datadir))
+    stall_start, stall_end = sc.stall_slots
+    by_slot = {s["slot"]: s for s in report["slo"]["per_slot"]}
+    # healthy before the stall, degraded inside it, recovered after
+    for slot in range(stall_start):
+        assert by_slot[slot]["deadline_hit_ratio"] == 1.0, slot
+    stall_ratios = [
+        by_slot[s]["deadline_hit_ratio"] for s in range(stall_start, stall_end)
+    ]
+    assert min(stall_ratios) < 0.5, stall_ratios
+    assert by_slot[sc.slots - 1]["deadline_hit_ratio"] == 1.0
+    assert report["deadline_hit_ratio"] < 1.0
+    # route share flipped to the host fallback during the stall
+    assert by_slot[stall_start]["routes"].get("host", 0) > 0
+    assert by_slot[0]["routes"] == {"device": by_slot[0]["routes"]["device"]}
+    # deterministic rerun: the SLO accounting is a function of (scenario,
+    # seed) like every other count
+    report2 = run_scenario(sc, datadir=str(tmp_path / "dd2"))
+    assert report2["slo"]["per_slot"] == report["slo"]["per_slot"]
+    assert report2["slo"]["incidents"] == report["slo"]["incidents"]
+    # >=1 incident dump landed and validates
+    incidents = report["slo"]["incidents"]
+    assert incidents, "a device stall must leave a durable incident trail"
+    assert any("breaker_open" in n for n in incidents)
+    for name in incidents:
+        with open(datadir / "incidents" / name) as f:
+            doc = json.load(f)
+        assert validate_incident(doc) == []
+    # the breaker-open dump carries THIS run's SLO windows + the event ring
+    (breaker_dump,) = [n for n in incidents if "breaker_open" in n]
+    with open(datadir / "incidents" / breaker_dump) as f:
+        doc = json.load(f)
+    assert doc["slo"]["windows"]["slot_5"]["slots"] >= 1
+    assert any(e["kind"] == "breaker_transition" for e in doc["events"])
+    # ...and `bn debug-bundle --datadir` packages every dump
+    out = tmp_path / "bundle.tar.gz"
+    manifest = build_bundle(str(out), datadir=str(datadir))
+    assert sorted(manifest["incidents"]) == sorted(incidents)
+    with tarfile.open(out) as tar:
+        for name in incidents:
+            assert f"incidents/{name}" in tar.getnames()
 
 
 def _run_cli(args, timeout=300):
@@ -121,8 +181,13 @@ def test_bn_loadtest_smoke_cli(tmp_path):
     assert summary["scenario"] == "smoke"
     assert summary["blocks_processed_in_slot"] is True
     assert summary["breaker_transitions"][-1] == "closed"
+    # the one-line summary carries the SLO headline (smoke has a stall +
+    # flood, so the ratio is degraded and the stall left an incident)
+    assert summary["slo"]["deadline_hit_ratio"] < 1.0
+    assert summary["slo"]["incidents"]
     report = json.loads(out.read_text())
     assert report["qos_totals"]["shed"] > 0
+    assert report["slo"]["per_slot"]
     assert report["elapsed_secs"] < 30
 
 
@@ -146,6 +211,9 @@ def test_bn_loadtest_crash_restart_smoke_cli(tmp_path):
     assert report["crash"]["recovered_head_slot"] == (
         report["crash"]["slot"] - 1
     )
+    # the deadline-hit ratio rides next to the conservation invariant
+    assert "deadline_hit_ratio" in report["conservation"]
+    assert report["slo"]["windows"]["epoch_32"]["slots"] > 0
     assert report["elapsed_secs"] < 30
 
 
