@@ -97,14 +97,22 @@ def _headline_json():
     )
     if _HEADLINE["note"]:
         metric += f" [{_HEADLINE['note']}]"
-    return json.dumps(
-        {
-            "metric": metric,
-            "value": round(v, 2),
-            "unit": "sets/s",
-            "vs_baseline": round(v / EST_BLST_SETS_PER_SEC, 3),
-        }
-    )
+    out = {
+        "metric": metric,
+        "value": round(v, 2),
+        "unit": "sets/s",
+        "vs_baseline": round(v / EST_BLST_SETS_PER_SEC, 3),
+    }
+    # executor configuration + the config1 latency series: BENCH_r*.json
+    # carries these so `bn perf report` / perf_trend.py can trend the
+    # urgent-path p50 (a latency regression gates CI like a throughput
+    # drop) and depth/donation next to every headline
+    if _MATRIX.get("pipeline"):
+        out["pipeline"] = _MATRIX["pipeline"]
+    c1 = _MATRIX.get("config1_single_fast_aggregate_verify") or {}
+    if c1.get("p50_ms"):
+        out["config1_p50_ms"] = c1["p50_ms"]
+    return json.dumps(out)
 
 
 def _set_headline(value, note):
@@ -391,22 +399,30 @@ def run_headline(backend, fx, rng):
 
 
 def run_single_fav(backend, fx, rng):
-    """Config 1 + urgent-path latency: one 128-pk set, depth 1."""
+    """Config 1 + urgent-path latency: one 128-pk set through the jaxbls
+    urgent fast lane (bypasses the pipelined batch window — the exact
+    path a gossip block's proposer signature takes on a loaded node).
+    Target: p50 under one slot-fraction (<100 ms)."""
     n_pks = fx["meta"]["n_pks"]
-    log(f"[config 1] single fast_aggregate_verify ({n_pks} pks), urgent path")
+    submit = getattr(backend, "verify_signature_sets_urgent", None)
+    lane = "urgent" if submit is not None else "batch"
+    submit = submit or backend.verify_signature_sets
+    log(f"[config 1] single fast_aggregate_verify ({n_pks} pks), "
+        f"{lane} lane")
     one = [fx["att"][0]]
     rands = [1]
-    assert backend.verify_signature_sets(one, rands)  # compile bucket
+    assert submit(one, rands)  # compile bucket
     samples = []
     for _ in range(LAT_REPS):
         t0 = time.time()
-        assert backend.verify_signature_sets(one, rands)
+        assert submit(one, rands)
         samples.append(time.time() - t0)
     st = _latency_stats(samples)
     per_sec = 1.0 / (st["mean_ms"] / 1e3)
     log(f"  {st}")
     _MATRIX["config1_single_fast_aggregate_verify"] = {
         **st,
+        "lane": lane,
         "verifies_per_sec": round(per_sec, 2),
         "vs_est_blst": round(per_sec / EST_BLST_SINGLE_FAV_PER_SEC, 3),
     }
@@ -592,6 +608,30 @@ def main():
 
     backend = bls_api.set_backend("jax")
     rng = random.Random(0xBE7C)
+
+    # pipelined-executor configuration of THIS run, recorded in the
+    # artifact so `bn perf report` trends depth/donation/MSM-window next
+    # to the numbers they produced. The headline loop drives the measured
+    # depth; smoke stays shallow (DEPTH=2) regardless of resolution.
+    global DEPTH
+    from lighthouse_tpu.crypto.jaxbls import pipeline as _pl
+    from lighthouse_tpu.crypto.jaxbls.msm import msm_window as _msm_window
+
+    depth, depth_src = _pl.resolve_depth()
+    if not _SMOKE:
+        DEPTH = depth
+    donate, donate_src = _pl.donation_enabled()
+    w = _msm_window()
+    _MATRIX["pipeline"] = {
+        "depth": DEPTH,
+        "depth_source": depth_src,
+        "donated_inputs": bool(donate),
+        "donation_source": donate_src,
+        "msm_window": w if w else "bits",
+    }
+    log(f"pipeline config: depth {DEPTH} ({depth_src}), "
+        f"donation {'on' if donate else 'off'} ({donate_src}), "
+        f"msm window {w or 'bits'}")
 
     try:
         try:

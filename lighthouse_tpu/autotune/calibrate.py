@@ -196,6 +196,64 @@ def _attribution_pass(backend, workloads) -> None:
     _log("per-stage attribution + program analytics captured")
 
 
+#: measured buckets at or under this many (padded) sets are "small":
+#: they are the urgent fast path's shapes and land in the profile's
+#: warmup_small_buckets so bring-up precompiles them even when the
+#: throughput-ordered warmup list is full of wide firehose buckets
+SMALL_WARMUP_MAX_SETS = 8
+
+#: varying-base MSM workload size for the window sweep: big enough that
+#: the windowed form's depth cut shows, small enough that each width's
+#: one-time compile stays inside a tunnel window
+MSM_SWEEP_POINTS = 32
+
+
+def msm_window_sweep(backend, points, reps: int, rng=None) -> dict:
+    """Time `backend.g1_msm` at every ALLOWED_WINDOWS width (plus the bit
+    form w=0) and return {"window": winner, "secs_by_window": {...}}.
+
+    Each width is forced via the LIGHTHOUSE_TPU_MSM_WINDOW env override
+    (the layer above the plan, below an explicit arg — exactly what a
+    sweep should use) and pays its own compile on the first call; only
+    the subsequent `reps` are timed. The winner is the width with the
+    best median steady-state time and is what `run_from_args` persists
+    as DeviceProfile.msm_window."""
+    from ..crypto.jaxbls.msm import ALLOWED_WINDOWS
+
+    rng = rng or random.Random(0xA08)
+    pts = list(points)[:MSM_SWEEP_POINTS]
+    scalars = [rng.getrandbits(255) for _ in pts]
+    prev_env = os.environ.get("LIGHTHOUSE_TPU_MSM_WINDOW")
+    secs_by_window: dict = {}
+    try:
+        for w in (0,) + tuple(ALLOWED_WINDOWS):
+            os.environ["LIGHTHOUSE_TPU_MSM_WINDOW"] = str(w)
+            backend.g1_msm(pts, scalars)       # compile rep (uncounted)
+            samples = []
+            for _ in range(max(1, reps)):
+                t0 = time.perf_counter()
+                if backend.g1_msm(pts, scalars) is None:
+                    raise CalibrationError(
+                        f"MSM sweep at window {w} returned identity for a "
+                        "non-trivial workload"
+                    )
+                samples.append(time.perf_counter() - t0)
+            samples.sort()
+            secs_by_window[w] = samples[len(samples) // 2]
+            _log("msm window measured", window=w,
+                 median_secs=round(secs_by_window[w], 4))
+    finally:
+        if prev_env is None:
+            os.environ.pop("LIGHTHOUSE_TPU_MSM_WINDOW", None)
+        else:
+            os.environ["LIGHTHOUSE_TPU_MSM_WINDOW"] = prev_env
+    winner = min(secs_by_window, key=secs_by_window.get)
+    # the winner persists EVEN when it is the bit form (w=0): "windowed
+    # lost the sweep on this device" is a measured verdict the platform
+    # default must not override (None stays reserved for "unmeasured")
+    return {"window": winner, "secs_by_window": secs_by_window}
+
+
 def measure_host_reference(sets, reps: int) -> dict:
     """Host (pure python) single-set verify time — the planner's reference
     for the urgent-set threshold."""
@@ -233,6 +291,15 @@ def add_calibrate_args(p) -> None:
                    help="profile output path (default: the canonical "
                         "per-device path; --smoke: "
                         "./autotune_profile_smoke.json)")
+    p.add_argument("--no-msm-sweep", action="store_true",
+                   help="skip the varying-base MSM window-width sweep "
+                        "(w in {2,4,5,6} vs the bit form; device backend "
+                        "only — the winner persists as the profile's "
+                        "msm_window)")
+    p.add_argument("--pipeline-depth", type=int, default=None,
+                   help="record this measured dispatch pipeline depth in "
+                        "the profile (from a scripts/bench_batch_scaling"
+                        ".py --depths sweep; default: leave unmeasured)")
 
 
 def run_from_args(args) -> tuple:
@@ -262,9 +329,12 @@ def run_from_args(args) -> tuple:
 
     setup_compilation_cache()
 
+    msm_sweep = backend_name == "jax" and not getattr(
+        args, "no_msm_sweep", False
+    )
     _log("calibration starting", smoke=smoke, backend=backend_name,
-         fixtures=fixtures, reps=reps)
-    groups = load_fixture_groups(fixtures)
+         fixtures=fixtures, reps=reps, msm_sweep=msm_sweep)
+    groups = load_fixture_groups(fixtures, include_kzg=msm_sweep)
 
     from ..crypto.bls import api as bls_api
 
@@ -275,6 +345,23 @@ def run_from_args(args) -> tuple:
     t0 = time.time()
     measure_backend(backend, workloads, reps)
     host = measure_host_reference(groups["att"], 1 if smoke else 3)
+
+    msm_window = None
+    msm_secs = None
+    if msm_sweep:
+        try:
+            sweep = msm_window_sweep(
+                backend, groups["kzg"]["g1_lagrange"], reps
+            )
+            msm_window, msm_secs = sweep["window"], sweep["secs_by_window"]
+            _log("msm window sweep complete", winner=msm_window)
+        except CalibrationError:
+            raise
+        except Exception as e:  # the verify sweep already succeeded — a
+            # broken MSM path degrades to an unmeasured window, it must
+            # not discard the whole calibration
+            _log("msm window sweep failed; profile keeps msm_window "
+                 "unmeasured", error=f"{type(e).__name__}: {e}")
 
     try:
         key = profile.current_device_key(bls_backend=backend_name)
@@ -292,6 +379,18 @@ def run_from_args(args) -> tuple:
     )
     if not prof.buckets:
         raise CalibrationError("sweep recorded no buckets")
+    # r7 tuning fields: the calibrated MSM window, the operator-supplied
+    # measured pipeline depth, and the small/urgent buckets the warmup
+    # plan must never drop (the urgent fast path's precompile shapes)
+    prof.msm_window = msm_window
+    depth_arg = getattr(args, "pipeline_depth", None)
+    if depth_arg is not None:
+        prof.pipeline_depth = max(1, int(depth_arg))
+    small = tuple(
+        b for b in sorted(prof.buckets)
+        if b[0] <= SMALL_WARMUP_MAX_SETS
+    )
+    prof.warmup_small_buckets = small or None
 
     out = args.out or (
         os.path.join(repo_root, "autotune_profile_smoke.json")
@@ -301,11 +400,14 @@ def run_from_args(args) -> tuple:
     path = profile.save(prof, out)
     plan = planner.plan_from_profile(prof)
     _log("calibration complete", secs=round(time.time() - t0, 1),
-         buckets=len(prof.buckets), path=path)
+         buckets=len(prof.buckets), path=path,
+         msm_secs_by_window=str(msm_secs) if msm_secs else "")
     _log("derived plan", max_attestation_batch=plan.max_attestation_batch,
          max_aggregate_batch=plan.max_aggregate_batch,
          p99_budget_ms=plan.p99_budget_ms,
          urgent_max_sets=plan.urgent_max_sets,
+         pipeline_depth=plan.pipeline_depth,
+         msm_window=plan.msm_window,
          warmup_buckets=str(list(plan.warmup_buckets)))
     return prof, path
 

@@ -32,10 +32,20 @@ profile equivalent to re-measuring. Derivation rules, in order:
                host reference measurement; defaults to 4 without one.
   warmup plan  Measured buckets ordered by achieved sets/sec (descending;
                ties: smaller first, so cheap compiles land early), capped
-               at 4 buckets. With no measured buckets the node warms the
-               two highest-traffic default shapes: the subnet-attestation
-               firehose (1024 x 1, the fast compile) then the aggregate
-               bucket (512 x 128).
+               at 4 buckets — then the profile's SMALL/urgent buckets
+               (warmup_small_buckets, falling back to the smallest
+               measured bucket) are appended if the throughput ordering
+               dropped them, so bring-up always precompiles the urgent
+               fast path's shapes, not just the firehose ones. With no
+               measured buckets the node warms the two highest-traffic
+               default shapes: the subnet-attestation firehose (1024 x 1,
+               the fast compile) then the aggregate bucket (512 x 128).
+  pipeline     Dispatch double-buffering depth: the profile's measured
+  depth        pipeline_depth (scripts/bench_batch_scaling.py --depths
+               sweep), clamped to [1, 16]; default 4 when unmeasured.
+  msm window   The calibrated varying-base MSM window width (calibrate's
+               w in {2,4,5,6} sweep), passed through verbatim; None when
+               unmeasured (consumers fall back to the platform default).
 """
 
 from __future__ import annotations
@@ -66,6 +76,12 @@ MIN_BATCH_CAP = 4            # jaxbls MIN_SETS floor
 P99_BUDGET_FACTOR = 2.0
 P99_BUDGET_CLAMP_MS = (50.0, 5000.0)
 MAX_WARMUP_BUCKETS = 4
+# appended small/urgent warmup shapes may exceed MAX_WARMUP_BUCKETS by
+# this many entries (they are the cheap compiles; dropping them is what
+# made every cold node pay the host detour on its first urgent verify)
+MAX_SMALL_WARMUP_EXTRA = 2
+DEFAULT_PIPELINE_DEPTH = 4   # mirrors jaxbls pipeline.DEFAULT_DEPTH
+PIPELINE_DEPTH_CLAMP = (1, 16)
 
 
 @dataclass(frozen=True)
@@ -77,6 +93,8 @@ class Plan:
     p99_budget_ms: float = DEFAULT_P99_BUDGET_MS
     urgent_max_sets: int = DEFAULT_URGENT_MAX_SETS
     warmup_buckets: tuple = DEFAULT_WARMUP_BUCKETS
+    pipeline_depth: int = DEFAULT_PIPELINE_DEPTH
+    msm_window: int | None = None
     source: str = "defaults"
 
 
@@ -135,17 +153,40 @@ def plan_from_profile(profile: DeviceProfile) -> Plan:
         ]
         urgent = max(candidates) if candidates else 1
 
-    # ---- warmup: best-throughput buckets first; cheap shapes break ties
+    # ---- warmup: best-throughput buckets first; cheap shapes break ties.
+    # The profile's small/urgent shapes are then APPENDED if the
+    # throughput ordering dropped them — the urgent fast path needs its
+    # bucket hot at bring-up even when it never wins a throughput sort.
     if measured:
         ordered = sorted(
             measured,
             key=lambda b: (-b.sets_per_sec, b.n_sets, b.n_pks),
         )
-        warmup = tuple(
+        warmup_list = [
             (b.n_sets, b.n_pks) for b in ordered[:MAX_WARMUP_BUCKETS]
-        )
+        ]
+        small = profile.warmup_small_buckets
+        if not small:
+            smallest = min(measured, key=lambda b: (b.n_sets, b.n_pks))
+            small = ((smallest.n_sets, smallest.n_pks),)
+        for shape in small:
+            shape = (int(shape[0]), int(shape[1]))
+            if shape not in warmup_list:
+                warmup_list.append(shape)
+            if len(warmup_list) >= MAX_WARMUP_BUCKETS + MAX_SMALL_WARMUP_EXTRA:
+                break
+        warmup = tuple(warmup_list)
     else:
         warmup = DEFAULT_WARMUP_BUCKETS
+
+    # ---- dispatch pipeline depth + MSM window: measured values pass
+    # through (clamped/validated); unmeasured falls back to the defaults
+    depth = DEFAULT_PIPELINE_DEPTH
+    if profile.pipeline_depth:
+        depth = int(_clamp(int(profile.pipeline_depth), *PIPELINE_DEPTH_CLAMP))
+    msm_window = (
+        int(profile.msm_window) if profile.msm_window is not None else None
+    )
 
     return Plan(
         max_attestation_batch=att_cap,
@@ -153,5 +194,7 @@ def plan_from_profile(profile: DeviceProfile) -> Plan:
         p99_budget_ms=round(float(p99_budget), 3),
         urgent_max_sets=int(urgent),
         warmup_buckets=warmup,
+        pipeline_depth=depth,
+        msm_window=msm_window,
         source=source,
     )

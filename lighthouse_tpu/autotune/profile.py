@@ -49,8 +49,19 @@ SCHEMA_VERSION = 1
 # compile/dispatch numbers stop transferring (mirrors the implicit
 # invalidation of the persistent jit cache). r6: named scopes on the
 # fused-kernel variants + profiles now carry per-stage compiled-program
-# analytics next to the timings.
-BACKEND_REVISION = "r6"
+# analytics next to the timings. r7: the pipelined executor + buffer
+# donation change dispatch economics (old p50/p99 measured the
+# un-donated serial path), and profiles now carry the autotuned MSM
+# window width, the measured pipeline depth, and the warmup small-bucket
+# list. Profiles keyed to an older revision are STALE: runtime.install
+# refuses them (runtime.py) so a pre-donation budget never routes the
+# donated path.
+BACKEND_REVISION = "r7"
+
+#: varying-base MSM window widths a profile may persist (the calibrate
+#: sweep's search space — crypto/jaxbls/msm.py ALLOWED_WINDOWS, duplicated
+#: here so the schema module stays jax-import-free)
+ALLOWED_MSM_WINDOWS = (2, 4, 5, 6)
 
 
 @dataclass
@@ -110,6 +121,15 @@ class DeviceProfile:
     host: dict | None = None
     source: str = "unknown"
     created_unix: float | None = None
+    # r7 tuning fields: the calibrated varying-base MSM window width
+    # (ALLOWED_MSM_WINDOWS; None = unmeasured, consumers fall back to the
+    # platform default), the measured dispatch pipeline depth
+    # (scripts/bench_batch_scaling.py --depths; None = planner default),
+    # and the small/urgent (n_sets, n_pks) buckets bring-up should
+    # precompile IN ADDITION to the throughput-ordered warmup list
+    msm_window: int | None = None
+    pipeline_depth: int | None = None
+    warmup_small_buckets: tuple | None = None
 
     def key_string(self) -> str:
         """Stable, filesystem-safe identity string for file naming. The
@@ -133,6 +153,12 @@ class DeviceProfile:
             "source": self.source,
             "created_unix": self.created_unix,
             "host": dict(self.host) if self.host else None,
+            "msm_window": self.msm_window,
+            "pipeline_depth": self.pipeline_depth,
+            "warmup_small_buckets": (
+                [[int(n), int(m)] for n, m in self.warmup_small_buckets]
+                if self.warmup_small_buckets else None
+            ),
             "buckets": [
                 self.buckets[k].to_json() for k in sorted(self.buckets)
             ],
@@ -162,13 +188,50 @@ class DeviceProfile:
         host = d.get("host")
         if host is not None and not isinstance(host, dict):
             raise ValueError("autotune profile 'host' must be an object")
+        msm_window = d.get("msm_window")
+        if msm_window is not None:
+            msm_window = int(msm_window)
+            # 0 is a valid MEASURED verdict ("the bit form won the sweep
+            # on this device"), distinct from None ("unmeasured")
+            if msm_window != 0 and msm_window not in ALLOWED_MSM_WINDOWS:
+                raise ValueError(
+                    f"autotune profile msm_window {msm_window!r} not 0 or "
+                    f"in {ALLOWED_MSM_WINDOWS}"
+                )
+        pipeline_depth = d.get("pipeline_depth")
+        if pipeline_depth is not None:
+            pipeline_depth = int(pipeline_depth)
+            if pipeline_depth < 1:
+                raise ValueError(
+                    f"autotune profile pipeline_depth {pipeline_depth!r} "
+                    "must be >= 1"
+                )
+        small = d.get("warmup_small_buckets")
+        if small is not None:
+            try:
+                small = tuple((int(n), int(m)) for n, m in small)
+            except (TypeError, ValueError) as e:
+                raise ValueError(
+                    f"malformed autotune profile warmup_small_buckets "
+                    f"{small!r}: {type(e).__name__}: {e}"
+                ) from e
         return cls(
             key=dict(key),
             buckets=buckets,
             host=dict(host) if host else None,
             source=str(d.get("source", "unknown")),
             created_unix=_opt_float(d.get("created_unix")),
+            msm_window=msm_window,
+            pipeline_depth=pipeline_depth,
+            warmup_small_buckets=small,
         )
+
+    def is_stale(self) -> bool:
+        """True when the profile's measured backend revision is not THIS
+        build's: the kernel structure its numbers were measured on no
+        longer exists, so budgets/caps derived from it would misroute
+        (runtime.install_profile refuses stale profiles)."""
+        return str(self.key.get("backend_revision")) != BACKEND_REVISION
 
 
 def _opt_float(v):

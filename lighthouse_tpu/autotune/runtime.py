@@ -23,17 +23,82 @@ from __future__ import annotations
 
 import os
 import threading
+import weakref
 
 from ..utils.logging import get_logger
 from .planner import DEFAULT_WARMUP_BUCKETS, Plan, plan_from_profile
-from .profile import DeviceProfile
+from .profile import BACKEND_REVISION, DeviceProfile
 
 _lock = threading.Lock()
 _state: dict = {"profile": None, "plan": None}
+# plan-change listeners (weak refs — consumers are long-lived singletons
+# on the live node, but tests construct many HybridBackends and a dead
+# listener must not pin one). Called OUTSIDE _lock with the new Plan (or
+# None on clear) so a listener may read active_plan()/take its own locks.
+_listeners: list = []
 
 
-def install_profile(profile: DeviceProfile, path: str | None = None) -> Plan:
-    """Make `profile` the process-wide knob source; returns its Plan."""
+def add_plan_listener(fn) -> None:
+    """Register `fn(plan_or_none)` to run whenever a profile is installed
+    or cleared at runtime — the mechanism consumers (the hybrid router's
+    budgets, the jaxbls dispatcher's depth) use to re-resolve
+    profile-derived knobs WITHOUT a restart. Bound methods are held via
+    WeakMethod: a garbage-collected owner silently unsubscribes."""
+    try:
+        ref = weakref.WeakMethod(fn)
+    except TypeError:
+        ref = weakref.ref(fn)
+    with _lock:
+        _listeners.append(ref)
+
+
+def _notify_listeners(plan) -> None:
+    with _lock:
+        refs = list(_listeners)
+    for ref in refs:
+        fn = ref()
+        if fn is None:
+            with _lock:
+                try:
+                    _listeners.remove(ref)
+                except ValueError:
+                    pass
+            continue
+        try:
+            fn(plan)
+        except Exception as e:  # a listener must never break install
+            get_logger("autotune").warn(
+                "plan listener failed", error=f"{type(e).__name__}: {e}"
+            )
+
+
+def install_profile(profile: DeviceProfile, path: str | None = None,
+                    allow_stale: bool = False) -> Plan | None:
+    """Make `profile` the process-wide knob source; returns its Plan.
+
+    A STALE profile — measured under a different jaxbls BACKEND_REVISION,
+    i.e. on kernels that no longer exist — is refused (returns None, the
+    consumers keep their current knobs): budgets and caps derived from a
+    dead kernel structure misroute the live one. `allow_stale=True` is
+    the explicit operator override (`--autotune-profile PATH` names a
+    file on purpose); the rejection is still logged loudly."""
+    if profile.is_stale():
+        log = get_logger("autotune")
+        if not allow_stale:
+            log.warn(
+                "STALE autotune profile refused (backend revision "
+                "mismatch); run `autotune calibrate` on this build",
+                profile_revision=str(profile.key.get("backend_revision")),
+                current_revision=BACKEND_REVISION,
+                path=path or "",
+            )
+            return None
+        log.warn(
+            "installing STALE autotune profile (operator override); its "
+            "numbers were measured on a different kernel structure",
+            profile_revision=str(profile.key.get("backend_revision")),
+            current_revision=BACKEND_REVISION,
+        )
     plan = plan_from_profile(profile)
     measured_backend = profile.key.get("bls_backend")
     if measured_backend not in (None, "jax"):
@@ -56,8 +121,11 @@ def install_profile(profile: DeviceProfile, path: str | None = None) -> Plan:
         max_aggregate_batch=plan.max_aggregate_batch,
         p99_budget_ms=plan.p99_budget_ms,
         urgent_max_sets=plan.urgent_max_sets,
+        pipeline_depth=plan.pipeline_depth,
+        msm_window=plan.msm_window,
         warmup_buckets=str(list(plan.warmup_buckets)),
     )
+    _notify_listeners(plan)
     return plan
 
 
@@ -76,6 +144,7 @@ def clear() -> None:
     with _lock:
         _state["profile"] = None
         _state["plan"] = None
+    _notify_listeners(None)
 
 
 # ---------------------------------------------------------------- autoload
@@ -125,7 +194,12 @@ def autoload(wait_secs: float | None = None,
     path = path or os.environ.get("LIGHTHOUSE_TPU_AUTOTUNE_PROFILE")
     if path:
         try:
-            return install_profile(prof.load(path), path=path)
+            # an explicitly named profile is an operator override: a
+            # stale revision installs WITH a loud warning instead of
+            # being refused (the canonical-path branch below stays
+            # strict — its filename embeds the revision)
+            return install_profile(prof.load(path), path=path,
+                                   allow_stale=True)
         except Exception as e:
             log.warn("autotune profile load failed; serving on defaults",
                      path=path, error=f"{type(e).__name__}: {e}")

@@ -125,6 +125,19 @@ def _planned(attr: str, default: int) -> int:
     except Exception:
         pass
     return default
+
+
+def _pipeline_depth() -> int:
+    """Default in-flight window: the jaxbls pipeline depth resolution
+    (env LIGHTHOUSE_TPU_PIPELINE_DEPTH > autotune plan > 4). jax-free —
+    crypto/jaxbls/pipeline.py imports nothing device-side at module
+    level — and never raises into config construction."""
+    try:
+        from ..crypto.jaxbls.pipeline import resolve_depth
+
+        return int(resolve_depth()[0])
+    except Exception:
+        return 4
 DEFAULT_QUEUE_LENGTHS = {
     WorkKind.gossip_attestation: 16384,
     WorkKind.gossip_aggregate: 4096,
@@ -177,8 +190,11 @@ class BeaconProcessorConfig:
     )
     # max device batches in flight before the pump blocks on the oldest —
     # the double-buffering depth (SURVEY §7 step 2: host marshals batch N+1
-    # while the device verifies batch N)
-    max_inflight: int = 4
+    # while the device verifies batch N). Shares the jaxbls dispatcher's
+    # depth resolution (env > autotune plan > default 4) so the processor
+    # window and the backend window agree; --max-inflight-batches stays
+    # the explicit override.
+    max_inflight: int = field(default_factory=lambda: _pipeline_depth())
 
 
 class BeaconProcessor:

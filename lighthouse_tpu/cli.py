@@ -79,6 +79,15 @@ def cmd_bn(args):
         _os_env.environ["LIGHTHOUSE_TPU_DEVICE_PROBE_WAIT_SECS"] = str(
             args.device_probe_wait
         )
+    # pipelined-executor knobs (crypto/jaxbls/pipeline.py) ride env for
+    # the same reason: the dispatcher constructs lazily inside the
+    # backend, and env sits above the autotune profile in precedence
+    if getattr(args, "pipeline_depth", None) is not None:
+        _os_env.environ["LIGHTHOUSE_TPU_PIPELINE_DEPTH"] = str(
+            args.pipeline_depth
+        )
+    if getattr(args, "no_donate", False):
+        _os_env.environ["LIGHTHOUSE_TPU_DONATE"] = "0"
 
     # autotune: install this device's persisted profile BEFORE the backend
     # and processor construct, so the hybrid router's knobs and the batch
@@ -1470,6 +1479,16 @@ def build_parser() -> argparse.ArgumentParser:
     bn.add_argument("--max-inflight-batches", type=int, default=None,
                     help="device verification batches in flight before the "
                          "processor blocks on the oldest")
+    bn.add_argument("--pipeline-depth", type=int, default=None,
+                    help="jaxbls dispatch double-buffering depth: batches "
+                         "in flight while the host marshals the next "
+                         "(default: the autotune profile's measured "
+                         "depth, else 4)")
+    bn.add_argument("--no-donate", action="store_true",
+                    help="build the staged jit programs WITHOUT "
+                         "donate_argnums input-buffer donation "
+                         "(diagnostic; donation is the default on "
+                         "accelerators)")
     bn.add_argument("--processor-workers", type=int, default=None,
                     help="beacon-processor worker threads")
     # -- hybrid BLS routing (crypto/bls/hybrid.py)

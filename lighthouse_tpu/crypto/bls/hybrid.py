@@ -160,16 +160,17 @@ class HybridBackend:
         breaker_reset_secs: float | None = None,
         stall_budget_ms: float | None = None,
     ):
-        plan = _autotune_plan()
-        urgent, urgent_src = _resolve_knob(
-            urgent_max_sets, "LIGHTHOUSE_TPU_URGENT_MAX_SETS",
-            plan.urgent_max_sets if plan else None, 4,
-        )
-        self.urgent_max_sets = int(urgent)
-        self.p99_budget_ms, p99_src = _resolve_knob(
-            p99_budget_ms, "LIGHTHOUSE_TPU_DEVICE_P99_BUDGET_MS",
-            plan.p99_budget_ms if plan else None, 500.0,
-        )
+        self._log = get_logger("bls.hybrid")
+        self._lock = threading.Lock()
+        # the raw constructor args, kept so a plan installed at RUNTIME
+        # (autotune calibrate + install mid-run) can re-run the exact
+        # resolution — constructor/env layers keep winning, only the
+        # profile/default layers move (_apply_plan)
+        self._ctor_knobs = {
+            "urgent_max_sets": urgent_max_sets,
+            "p99_budget_ms": p99_budget_ms,
+            "stall_budget_ms": stall_budget_ms,
+        }
         self._probe_startup_wait, _ = _resolve_knob(
             probe_startup_wait_secs, "LIGHTHOUSE_TPU_DEVICE_PROBE_WAIT_SECS",
             None, 20.0,
@@ -182,32 +183,23 @@ class HybridBackend:
             breaker_reset_secs, "LIGHTHOUSE_TPU_BREAKER_RESET_SECS",
             None, 10.0,
         )
-        # a verify slower than this is a STALL (breaker failure signal):
-        # well past anything the p99 budget router would tolerate, so legit
-        # heavy batches never trip it, a wedged tunnel does
-        self._stall_budget_secs, _ = _resolve_knob(
-            stall_budget_ms, "LIGHTHOUSE_TPU_DEVICE_STALL_BUDGET_MS",
-            None, self.p99_budget_ms * 4.0,
-        )
-        self._stall_budget_secs /= 1e3
         from ...qos.breaker import CircuitBreaker
 
         self._breaker = CircuitBreaker(
             "bls_device", failure_threshold=3,
             reset_timeout=breaker_reset, state_gauge=_CIRCUIT_STATE,
         )
-        self.knob_sources = {
-            "urgent_max_sets": urgent_src, "p99_budget_ms": p99_src,
-        }
-        self._log = get_logger("bls.hybrid")
-        self._log.info(
-            "routing knobs resolved",
-            urgent_max_sets=self.urgent_max_sets,
-            urgent_max_sets_source=urgent_src,
-            p99_budget_ms=self.p99_budget_ms,
-            p99_budget_ms_source=p99_src,
-        )
-        self._lock = threading.Lock()
+        self._apply_plan(_autotune_plan())
+        try:
+            from ...autotune import runtime as _at_runtime
+
+            # live retune: installing/clearing a profile mid-run re-derives
+            # the p99 budget and urgent threshold immediately (pre-r8 these
+            # were resolved once at construction, so a mid-run `autotune
+            # calibrate` + install served stale budgets until restart)
+            _at_runtime.add_plan_listener(self._apply_plan)
+        except Exception:
+            pass  # a broken autotune subsystem must not block construction
         self._state = "probing"            # probing | up | down
         self._device = None                # JaxBackend once probed up
         self._device_failures = 0
@@ -216,6 +208,46 @@ class HybridBackend:
         self._lats: deque = deque(maxlen=128)
         self._probe_started = threading.Event()
         self._probe_done = threading.Event()
+
+    def _apply_plan(self, plan) -> None:
+        """(Re-)resolve every plan-derived routing knob against `plan`
+        (None = no profile installed). Runs at construction AND from the
+        autotune plan listener on runtime installs/clears; the knob
+        precedence contract is untouched — only the profile/default
+        layers ever produce a new value here."""
+        urgent, urgent_src = _resolve_knob(
+            self._ctor_knobs["urgent_max_sets"],
+            "LIGHTHOUSE_TPU_URGENT_MAX_SETS",
+            plan.urgent_max_sets if plan else None, 4,
+        )
+        p99, p99_src = _resolve_knob(
+            self._ctor_knobs["p99_budget_ms"],
+            "LIGHTHOUSE_TPU_DEVICE_P99_BUDGET_MS",
+            plan.p99_budget_ms if plan else None, 500.0,
+        )
+        # a verify slower than this is a STALL (breaker failure signal):
+        # well past anything the p99 budget router would tolerate, so legit
+        # heavy batches never trip it, a wedged tunnel does
+        stall, _ = _resolve_knob(
+            self._ctor_knobs["stall_budget_ms"],
+            "LIGHTHOUSE_TPU_DEVICE_STALL_BUDGET_MS",
+            None, p99 * 4.0,
+        )
+        with self._lock:
+            self.urgent_max_sets = int(urgent)
+            self.p99_budget_ms = p99
+            self._stall_budget_secs = stall / 1e3
+            self.knob_sources = {
+                "urgent_max_sets": urgent_src, "p99_budget_ms": p99_src,
+            }
+        self._log.info(
+            "routing knobs resolved",
+            urgent_max_sets=self.urgent_max_sets,
+            urgent_max_sets_source=urgent_src,
+            p99_budget_ms=self.p99_budget_ms,
+            p99_budget_ms_source=p99_src,
+            plan_source=plan.source if plan else "none",
+        )
 
     # ------------------------------------------------------------- probing
 
@@ -423,15 +455,35 @@ class HybridBackend:
 
     # ------------------------------------------------------------- surface
 
+    def _device_submitters(self, sets):
+        """(sync_fn, async_fn) for a device-routed batch: urgent-sized
+        batches take the jaxbls dispatcher's BYPASS lane (no waiting
+        behind the coalesced firehose window — the config1 p50 lever)
+        when the device backend exposes one; stub/legacy backends fall
+        back to the plain submission path."""
+        dev = self._device
+        if len(sets) <= self.urgent_max_sets:
+            sync = getattr(dev, "verify_signature_sets_urgent", None)
+            asyn = getattr(dev, "verify_signature_sets_urgent_async", None)
+            return (
+                sync or dev.verify_signature_sets,
+                asyn or getattr(dev, "verify_signature_sets_async", None),
+            )
+        return (
+            dev.verify_signature_sets,
+            getattr(dev, "verify_signature_sets_async", None),
+        )
+
     def verify_signature_sets(self, sets, rands) -> bool:
         path, reason = self._route(sets)
         if path == "host":
             _note_route("host", reason, len(sets))
             return self._host().verify_signature_sets(sets, rands)
         bucket = self._bucket(sets)
+        submit, _ = self._device_submitters(sets)
         try:
             t0 = time.time()
-            ok = self._device.verify_signature_sets(sets, rands)
+            ok = submit(sets, rands)
             self._record_device_ok(bucket, time.time() - t0, len(sets))
             _note_route("device", "ok", len(sets))
             return ok
@@ -472,9 +524,17 @@ class HybridBackend:
                     _note_route("host", "device_error", len(sets))
                     return outer._host().verify_signature_sets(sets, rands)
 
+        sync_submit, async_submit = self._device_submitters(sets)
         try:
             t0 = time.time()
-            return _Handle(self._device.verify_signature_sets_async(sets, rands), t0)
+            if async_submit is None:
+                # device backend without async submission (test stubs):
+                # serve synchronously through the same accounting
+                r = sync_submit(sets, rands)
+                self._record_device_ok(bucket, time.time() - t0, len(sets))
+                _note_route("device", "ok", len(sets))
+                return api._ReadyHandle(r)
+            return _Handle(async_submit(sets, rands), t0)
         except Exception as e:
             self._record_device_error(e)
             _note_route("host", "device_error", len(sets))

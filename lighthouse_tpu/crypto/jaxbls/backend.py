@@ -19,19 +19,23 @@ Shapes are padded to power-of-two buckets (pad lanes masked out) so XLA
 compiles one program per bucket, cached persistently (utils/jaxcfg.py) —
 the bucketing policy answers SURVEY.md §7 hard part (c).
 
-Throughput design (r2): the device round trip through the remote-TPU tunnel
-costs tens of milliseconds of pure latency, so the backend exposes an async
-submission API (`verify_signature_sets_async`) that keeps several batches in
-flight — the beacon processor's double-buffered dispatch and bench.py both
-use it. Host marshalling is vectorized numpy (no per-element Python bigint
-work) and pubkey limb arrays are cached on device keyed by the identity of
-the key objects, mirroring the reference's decompressed ValidatorPubkeyCache
-(validator_pubkey_cache.rs:17) feeding blst.
+Throughput design (r2, rebuilt r8): the device round trip through the
+remote-TPU tunnel costs tens of milliseconds of pure latency, so every
+batch rides the pipelined executor (crypto/jaxbls/pipeline.py): an async
+submission API (`verify_signature_sets_async`) keeps up to `depth` batches
+in flight (depth from the autotune plan; `jaxbls_pipeline_*` metrics),
+per-batch input buffers are DONATED to the staged jit programs on
+accelerators (donate_argnums — intermediates reuse their HBM instead of
+fresh allocations), and urgent single-set verifies take a bypass lane
+that never waits behind the batch window. Host marshalling is vectorized
+numpy (no per-element Python bigint work) and pubkey limb arrays are
+cached on device keyed by the identity of the key objects, mirroring the
+reference's decompressed ValidatorPubkeyCache
+(validator_pubkey_cache.rs:17) feeding blst — which is also why the
+pubkey grids are the one input family donation never touches.
 """
 
 from __future__ import annotations
-
-import os
 
 import numpy as np
 
@@ -300,21 +304,50 @@ def _init_consts():
 
 
 def _get_stages():
-    """Jitted stage functions (each cached separately on disk)."""
+    """Jitted stage functions (each cached separately on disk).
+
+    With buffer donation on (pipeline.donation_enabled — default on
+    accelerators, env/flag overridable) the per-batch inputs are marked
+    `donate_argnums` so XLA may reuse their HBM for same-shaped
+    intermediates instead of fresh allocations:
+
+      prepare: sig_x/sig_y/z_digits (their Montgomery conversions are
+               shape-identical), NEVER pk_x/pk_y/pk_mask (the
+               device-resident pubkey cache outlives the batch) and
+               NEVER set_mask (stage 3 reads it again);
+      h2c:     us (consumed into the SSWU map);
+      pairs:   the stage-1/2 intermediates (z_pk, h_jac, sig_acc) and
+               set_mask — all dead after pair assembly;
+      pairing: everything (the output is one scalar).
+
+    Cached per donation mode — tests flip LIGHTHOUSE_TPU_DONATE within
+    one process and the donation decision is baked into the jit."""
     import jax
 
+    from . import pipeline as pl
+
     _init_consts()
-    if "stages" not in _kernel_cache:
+    donate = pl.donation_enabled()[0]
+    key = f"stages_d{int(donate)}"
+    if key not in _kernel_cache:
         from ...utils.jaxcfg import setup_compilation_cache
 
         setup_compilation_cache()
-        _kernel_cache["stages"] = (
-            jax.jit(_stage_prepare),
-            jax.jit(h2.hash_to_g2_jacobian),
-            jax.jit(_stage_pairs),
-            jax.jit(_stage_pairing),
-        )
-    return _kernel_cache["stages"]
+        if donate:
+            _kernel_cache[key] = (
+                jax.jit(_stage_prepare, donate_argnums=(3, 4, 5)),
+                jax.jit(h2.hash_to_g2_jacobian, donate_argnums=(0,)),
+                jax.jit(_stage_pairs, donate_argnums=(0, 1, 2, 3)),
+                jax.jit(_stage_pairing, donate_argnums=(0, 1, 2, 3, 4)),
+            )
+        else:
+            _kernel_cache[key] = (
+                jax.jit(_stage_prepare),
+                jax.jit(h2.hash_to_g2_jacobian),
+                jax.jit(_stage_pairs),
+                jax.jit(_stage_pairing),
+            )
+    return _kernel_cache[key]
 
 
 def _get_kernel():
@@ -385,7 +418,16 @@ def warm_stages(n_sets: int, n_pks: int) -> None:
         # lower+compile pair only re-traces: capture the compiled
         # programs' flops/bytes/HBM for this bucket (stages 3/4 are
         # captured at their first attributed dispatch instead — their
-        # inputs are stage outputs)
+        # inputs are stage outputs). With donation on, the warm executes
+        # above CONSUMED the per-batch dummies — re-place fresh zeros so
+        # the capture never touches a donated buffer.
+        from . import pipeline as _pl
+
+        if _pl.donation_enabled()[0]:
+            sig_x = put_sets(np.zeros((n, 2, lb.NL), np.uint32))
+            sig_y = put_sets(np.zeros((n, 2, lb.NL), np.uint32))
+            z_digits = put_sets(np.ones((n, Z_DIGITS), np.uint32))
+            us = put_sets(np.zeros((n, 2, 2, lb.NL), np.uint32))
         _obs_perf.maybe_capture_program(
             "prepare", prepare,
             (pk_x, pk_y, pk_mask, sig_x, sig_y, z_digits, set_mask), (n, m),
@@ -444,11 +486,34 @@ class JaxBackend:
     autotune_self_recording = True
 
     def __init__(self, dst: bytes = DST_POP):
+        from . import pipeline as pl
+
         self.dst = dst
         # device-resident pubkey marshalling cache:
         #   fingerprint(tuple of id(pk)) -> (pk_x_dev, pk_y_dev, mask, keepalive)
         self._pk_cache: dict = {}
         self._pk_cache_order: list = []
+        # the pipelined executor: depth-bounded double-buffering window +
+        # the urgent bypass lane (crypto/jaxbls/pipeline.py). Depth and
+        # donation resolve env > autotune plan > default at construction;
+        # a profile installed later re-resolves through the plan listener
+        # (autotune/runtime.add_plan_listener).
+        self.dispatcher = pl.PipelinedDispatcher()
+        try:
+            from ...autotune import runtime as _at_runtime
+
+            _at_runtime.add_plan_listener(self._on_plan_installed)
+        except Exception:
+            pass  # autotune broken must never take down the backend
+
+    def _on_plan_installed(self, _plan) -> None:
+        """A new autotune profile was installed mid-run: re-resolve the
+        dispatch depth unless an explicit env/flag pinned it (the same
+        live-retune contract as the hybrid router's budgets)."""
+        from . import pipeline as pl
+
+        if self.dispatcher.depth_source in ("profile", "default"):
+            self.dispatcher.set_depth(*pl.resolve_depth())
 
     # -- the multi-set hot path ------------------------------------------
 
@@ -502,7 +567,16 @@ class JaxBackend:
             self._pk_cache.pop(old, None)
         return dx, dy, dm
 
-    def verify_signature_sets_async(self, sets, rands) -> VerifyHandle:
+    def verify_signature_sets_async(self, sets, rands, urgent: bool = False):
+        """Marshal + submit one batch through the pipelined executor.
+
+        Host marshalling runs HERE (it overlaps whatever the device is
+        executing); the staged device dispatch runs inside the
+        dispatcher's submit, which blocks first when `depth` batches are
+        already in flight (resolving the oldest — the double-buffering
+        backpressure). `urgent=True` takes the bypass lane: no window
+        wait, no window slot — the low-latency path for single-set
+        verifies. Returns a ticket with .result() -> bool."""
         import time
 
         from ...parallel import put_sets
@@ -553,34 +627,53 @@ class JaxBackend:
             put_sets(sig_x), put_sets(sig_y), put_sets(z_digits),
             put_sets(set_mask), put_sets(us),
         )
-        t0 = time.perf_counter()
-        _MARSHAL_SECONDS.observe(t0 - t_marshal)
+        t_marshalled = time.perf_counter()
+        _MARSHAL_SECONDS.observe(t_marshalled - t_marshal)
         tr = _obs.current_trace()
         if tr is not None:
             tr.annotate(bucket=f"{n}x{m}", real_sets=n_real)
-        # each stage dispatch runs under a named annotation scope; with
-        # device attribution on (bn --device-trace, bench, calibrator)
-        # run_stage also event-times each resolve into the per-stage
-        # jaxbls_stage_* families and device:<stage> trace sub-spans —
-        # which SERIALIZES the stages (diagnostic mode; the default path
-        # stays fully async)
-        attr = _obs_dev.begin((n, m), trace=tr)
-        z_pk, sig_acc, bad = _obs_dev.run_stage(
-            attr, "prepare", prepare,
-            pk_x, pk_y, pk_mask, sig_x, sig_y, z_digits, set_mask,
-        )
-        h_jac = _obs_dev.run_stage(attr, "h2c", h2c_stage, us)
-        px, py, qxx, qyy, pair_mask = _obs_dev.run_stage(
-            attr, "pairs", pairs_stage, z_pk, h_jac, sig_acc, set_mask
-        )
-        ok = _obs_dev.run_stage(
-            attr, "pairing", pairing_stage, px, py, qxx, qyy, pair_mask
-        )
-        _DISPATCH_ENQUEUE_SECONDS.observe(time.perf_counter() - t0)
-        return VerifyHandle(ok, bad, bucket=(n, m), t0=t0, n_real=n_real)
+
+        def dispatch():
+            # each stage dispatch runs under a named annotation scope;
+            # with device attribution on (bn --device-trace, bench,
+            # calibrator) run_stage also event-times each resolve into
+            # the per-stage jaxbls_stage_* families and device:<stage>
+            # trace sub-spans — which SERIALIZES the stages (diagnostic
+            # mode; the default path stays fully async)
+            t0 = time.perf_counter()
+            attr = _obs_dev.begin((n, m), trace=tr)
+            z_pk, sig_acc, bad = _obs_dev.run_stage(
+                attr, "prepare", prepare,
+                pk_x, pk_y, pk_mask, sig_x, sig_y, z_digits, set_mask,
+            )
+            h_jac = _obs_dev.run_stage(attr, "h2c", h2c_stage, us)
+            px, py, qxx, qyy, pair_mask = _obs_dev.run_stage(
+                attr, "pairs", pairs_stage, z_pk, h_jac, sig_acc, set_mask
+            )
+            ok = _obs_dev.run_stage(
+                attr, "pairing", pairing_stage, px, py, qxx, qyy, pair_mask
+            )
+            _DISPATCH_ENQUEUE_SECONDS.observe(time.perf_counter() - t0)
+            return VerifyHandle(ok, bad, bucket=(n, m), t0=t0, n_real=n_real)
+
+        return self.dispatcher.submit(dispatch, urgent=urgent)
 
     def verify_signature_sets(self, sets, rands) -> bool:
         return self.verify_signature_sets_async(sets, rands).result()
+
+    # -- the urgent fast path --------------------------------------------
+    # single-set / small urgent verifies (a gossip block's proposer sig,
+    # the hybrid router's warm small batches) ride the dispatcher's
+    # bypass lane: they never wait behind the depth window of coalesced
+    # firehose batches. Exposed as separate methods so policy layers
+    # (crypto/bls/hybrid.py) can probe with getattr and stay compatible
+    # with backends that have no lane concept.
+
+    def verify_signature_sets_urgent_async(self, sets, rands):
+        return self.verify_signature_sets_async(sets, rands, urgent=True)
+
+    def verify_signature_sets_urgent(self, sets, rands) -> bool:
+        return self.verify_signature_sets_async(sets, rands, urgent=True).result()
 
     # -- single-set paths reuse the same kernel ---------------------------
 
@@ -590,7 +683,8 @@ class JaxBackend:
         from .. import bls
 
         s = bls.SignatureSet(sig, (pk,), message)
-        return self.verify_signature_sets([s], [1])
+        # a lone verify is urgent by definition: bypass the batch window
+        return self.verify_signature_sets_urgent([s], [1])
 
     def aggregate_verify(self, pks, messages, sig) -> bool:
         """Distinct-message AggregateVerify:
@@ -640,16 +734,20 @@ class JaxBackend:
             return None
         n = max(MIN_SETS, _next_pow2(n_real))
 
+        from . import msm as _msm
+
+        kernel, w = _get_msm_kernel()
         px = np.zeros((n, lb.NL), np.uint32)
         py = np.zeros((n, lb.NL), np.uint32)
         mask = np.zeros((n,), np.uint32)
         px[:n_real] = pack_ints_vec([p[0] if p else 0 for p in pts])
         py[:n_real] = pack_ints_vec([p[1] if p else 0 for p in pts])
         mask[:n_real] = [0 if p is None else 1 for p in pts]
-        digits = np.zeros((n, 64), np.uint32)
-        digits[:n_real] = co.scalars_to_digits([s % R for s in scs], 256)
+        real_digits = _msm.msm_digits(scs, w)
+        digits = np.zeros((n, real_digits.shape[1]), np.uint32)
+        digits[:n_real] = real_digits
 
-        x, y, inf = _get_msm_kernel()(px, py, mask, digits)
+        x, y, inf = kernel(px, py, mask, digits)
         if bool(np.asarray(inf)):
             return None
         return (lb.unpack(np.asarray(x)), lb.unpack(np.asarray(y)))
@@ -697,59 +795,28 @@ class JaxBackend:
         return bool(np.asarray(ok))
 
 
-def _msm_windowed() -> bool:
-    """Varying-base MSM form selection. WINDOWED (w=4) runs 64 digit steps
-    of (4 doublings + one table add) instead of 256 (double + cond-add) —
-    ~2.4x less sequential depth for the latency-bound small MSMs of the
-    batch blob verifier — but its runtime table build + one-hot gather
-    compiles ~4x slower, so XLA:CPU (the test platform, ~400 HLO ops/s)
-    keeps the bit form. LIGHTHOUSE_TPU_MSM_WINDOWED=0/1 overrides."""
-    env = os.environ.get("LIGHTHOUSE_TPU_MSM_WINDOWED", "").strip().lower()
-    if env:
-        return env not in ("0", "no", "off", "false")
-    import jax
-
-    return jax.default_backend() != "cpu"
-
-
-def _msm_g1_kernel(px, py, mask, digits):
-    """G1 multi-scalar multiplication: batched per-point scalar mults +
-    masked tree reduction (the device path for KZG commitments and proof
-    combination — reference /root/reference/crypto/kzg/src/lib.rs:47-81
-    via c-kzg's MSM). digits: (n, 64) base-16 MSB-first."""
-    import jax.numpy as jnp
-
-    pxm = _to_mont_dev(px)
-    pym = _to_mont_dev(py)
-    valid = jnp.asarray(mask, bool)
-    jac = co.affine_to_jac(co.FQ_OPS, (pxm, pym), inf_mask=jnp.logical_not(valid))
-    if _msm_windowed():
-        prod = co.scalar_mul_windowed(jac, digits, co.FQ_OPS)
-    else:
-        # digits -> bits inside the kernel (cheap, data-parallel): keeps
-        # ONE host-side calling convention for both forms
-        weights = jnp.asarray(np.array([8, 4, 2, 1], np.uint32))
-        bits = (digits[..., :, None] // weights[None, None, :]) % 2
-        bits = bits.reshape(digits.shape[0], -1)
-        prod = co.scalar_mul_bits(jac, bits, co.FQ_OPS)
-    acc = co.masked_tree_sum(prod, mask, co.FQ_OPS)
-    x, y, inf = co.jac_to_affine(acc, co.FQ_OPS)
-    return lb.from_mont(x), lb.from_mont(y), inf
-
-
 def _get_msm_kernel():
+    """(jitted varying-base MSM kernel, window width) at the currently
+    resolved width (msm.msm_window: env > autotune plan > platform).
+    Cached per WIDTH: the form is baked into the trace, and tests flip
+    the env overrides within one process."""
+    import functools
+
     import jax
+
+    from . import msm as _msm
 
     _init_consts()
-    # cache per FORM: the windowed/bit branch is baked into the trace, and
-    # tests flip LIGHTHOUSE_TPU_MSM_WINDOWED within one process
-    key = f"msm_w{int(_msm_windowed())}"
+    w = _msm.msm_window()
+    key = f"msm_w{w}"
     if key not in _kernel_cache:
         from ...utils.jaxcfg import setup_compilation_cache
 
         setup_compilation_cache()
-        _kernel_cache[key] = jax.jit(_msm_g1_kernel)
-    return _kernel_cache[key]
+        _kernel_cache[key] = jax.jit(
+            functools.partial(_msm.varying_base_msm_kernel, window=w)
+        )
+    return _kernel_cache[key], w
 
 
 def _aggregate_kernel(pk_x, pk_y, mask, sig_xy, h_jac):

@@ -24,6 +24,8 @@ test_jaxbls_msm.py).
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from . import curve_ops as co
@@ -31,6 +33,95 @@ from . import limbs as lb
 
 CHUNK_BITS = 16
 N_CHUNKS = 256 // CHUNK_BITS      # 16 comb rows cover the 256-bit scalar
+
+#: window widths the autotune sweep measures and a profile may persist
+#: (`autotune calibrate` — the winner lands in DeviceProfile.msm_window)
+ALLOWED_WINDOWS = (2, 4, 5, 6)
+
+
+def msm_window() -> int:
+    """Varying-base MSM window width; 0 selects the bit double-and-add
+    form. A width-w window runs ceil(256/w) digit steps of (w doublings +
+    one table add) instead of 256 (double + cond-add) — less sequential
+    depth for the latency-bound KZG linear combinations — but its runtime
+    table build (2^w entries) compiles and executes wider, so the best w
+    is a device property: `autotune calibrate` sweeps ALLOWED_WINDOWS and
+    persists the winner per device kind.
+
+    Resolution (the autotune precedence contract):
+      LIGHTHOUSE_TPU_MSM_WINDOW=<0|2|4|5|6>         explicit width
+      LIGHTHOUSE_TPU_MSM_WINDOWED=0/1 (legacy)      bit form / w=4
+      installed plan's msm_window                   calibrated winner
+      platform default                              w=4 accel, bits on CPU
+                                                    (the windowed table
+                                                    build compiles ~4x
+                                                    slower on XLA:CPU and
+                                                    CPU runs are tests)"""
+    raw = os.environ.get("LIGHTHOUSE_TPU_MSM_WINDOW", "").strip()
+    if raw:
+        try:
+            w = int(raw)
+            if w == 0 or w in ALLOWED_WINDOWS:
+                return w
+        except ValueError:
+            pass  # malformed env falls through to the next layer
+    legacy = os.environ.get("LIGHTHOUSE_TPU_MSM_WINDOWED", "").strip().lower()
+    if legacy:
+        return 0 if legacy in ("0", "no", "off", "false") else 4
+    try:
+        from ...autotune import runtime as _at_runtime
+
+        plan = _at_runtime.active_plan()
+    except Exception:
+        plan = None
+    w = getattr(plan, "msm_window", None) if plan is not None else None
+    # 0 is a measured verdict (the bit form won the calibration sweep on
+    # this device) — honor it; None means unmeasured -> platform default
+    if w == 0 or w in ALLOWED_WINDOWS:
+        return int(w)
+    import jax
+
+    return 0 if jax.default_backend() == "cpu" else 4
+
+
+def msm_digits(scalars, window: int) -> np.ndarray:
+    """Host packing for `varying_base_msm_kernel`: ints mod r ->
+    (n, ceil(256/w)) MSB-first digit array at width `window` (the bit
+    form, window=0, consumes base-16 digits and expands them in-kernel —
+    one calling convention per width)."""
+    from ..bls381.constants import R
+
+    return co.scalars_to_digits(
+        [s % R for s in scalars], 256, window or 4
+    )
+
+
+def varying_base_msm_kernel(px, py, mask, digits, window: int = 4):
+    """G1 multi-scalar multiplication over per-call (varying) bases:
+    batched per-point scalar mults + masked tree reduction — the device
+    path for KZG commitments and batch proof combination. `digits` from
+    `msm_digits` at the same width; window=0 expands base-16 digits to
+    bits in-kernel (the compile-cheap, depth-heavy CPU form)."""
+    import jax.numpy as jnp
+
+    r2x = jnp.broadcast_to(lb.R2, px.shape)
+    pxm = lb.mont_mul(px, r2x)
+    pym = lb.mont_mul(py, r2x)
+    valid = jnp.asarray(mask, bool)
+    jac = co.affine_to_jac(
+        co.FQ_OPS, (pxm, pym), inf_mask=jnp.logical_not(valid)
+    )
+    if window:
+        prod = co.scalar_mul_windowed(jac, digits, co.FQ_OPS, window=window)
+    else:
+        # base-16 digits -> bits inside the kernel (cheap, data-parallel)
+        weights = jnp.asarray(np.array([8, 4, 2, 1], np.uint32))
+        bits = (digits[..., :, None] // weights[None, None, :]) % 2
+        bits = bits.reshape(digits.shape[0], -1)
+        prod = co.scalar_mul_bits(jac, bits, co.FQ_OPS)
+    acc = co.masked_tree_sum(prod, mask, co.FQ_OPS)
+    x, y, inf = co.jac_to_affine(acc, co.FQ_OPS)
+    return lb.from_mont(x), lb.from_mont(y), inf
 
 
 def _next_pow2(n: int) -> int:

@@ -302,6 +302,10 @@ def load_bench_rounds(root: str | None = None) -> list:
             or "UNAVAILABLE" in metric.upper()
             or "SKIPPED" in metric.upper()
         )
+        try:
+            c1_p50 = float(parsed.get("config1_p50_ms") or 0.0) or None
+        except (TypeError, ValueError):
+            c1_p50 = None
         rounds.append(
             {
                 "round": n,
@@ -311,6 +315,12 @@ def load_bench_rounds(root: str | None = None) -> list:
                 "vs_est": vs_est if not skipped else None,
                 "raw_vs_est": vs_est,
                 "note": parsed.get("note"),
+                # the urgent-path latency series (bench.py config 1,
+                # recorded in the headline JSON since r8): only a FRESH
+                # round's p50 may enter the latency trend
+                "config1_p50_ms": c1_p50 if not skipped else None,
+                # executor config of the run (depth/donation/msm window)
+                "pipeline": parsed.get("pipeline"),
             }
         )
     last_fresh = None
@@ -444,6 +454,36 @@ def trend_report(
                 }
             )
 
+    # config1 urgent-path p50 (ms, LOWER is better): a fresh-to-fresh
+    # latency increase past the threshold gates CI exactly like a
+    # throughput drop — raw speed regressions on the urgent lane must
+    # not hide behind a healthy headline
+    lat_fresh = [r for r in fresh if r.get("config1_p50_ms")]
+    lat_deltas = []
+    for prev, cur in zip(lat_fresh, lat_fresh[1:]):
+        delta = (
+            cur["config1_p50_ms"] - prev["config1_p50_ms"]
+        ) / prev["config1_p50_ms"]
+        lat_deltas.append(
+            {
+                "config": "config1_p50",
+                "from": prev["source"],
+                "to": cur["source"],
+                "delta_pct": round(delta * 100.0, 2),
+            }
+        )
+        if delta > threshold:
+            regressions.append(
+                {
+                    "config": "config1_p50",
+                    "prev": prev["config1_p50_ms"],
+                    "cur": cur["config1_p50_ms"],
+                    "from": prev["source"],
+                    "to": cur["source"],
+                    "delta_pct": round(delta * 100.0, 2),
+                }
+            )
+
     mc_fresh = [r for r in multichip if not r["skipped"]]
     if mc_fresh and not mc_fresh[-1]["ok"] and any(r["ok"] for r in mc_fresh[:-1]):
         last_ok = [r for r in mc_fresh[:-1] if r["ok"]][-1]
@@ -462,6 +502,17 @@ def trend_report(
         "caveat": EST_CAVEAT,
         "threshold_pct": round(threshold * 100.0, 1),
         "headline": {"rounds": bench, "deltas": deltas},
+        "config1_p50": {
+            "rounds": [
+                {
+                    "round": r["round"],
+                    "source": r["source"],
+                    "p50_ms": r["config1_p50_ms"],
+                }
+                for r in lat_fresh
+            ],
+            "deltas": lat_deltas,
+        },
         "multichip": {"rounds": multichip},
         "matrix": matrix,
         "regressions": regressions,
@@ -553,6 +604,19 @@ def render_report(report: dict) -> str:
         lines.append(
             f"  delta {d['from']} -> {d['to']}: {d['delta_pct']:+.2f}%"
         )
+    lat = report.get("config1_p50") or {}
+    if lat.get("rounds"):
+        lines.append("")
+        lines.append(
+            "config1 urgent-path p50 (ms, lower is better; fresh rounds "
+            "only):"
+        )
+        for r in lat["rounds"]:
+            lines.append(f"  r{r['round']:02d}  {r['p50_ms']:>10.2f}")
+        for d in lat["deltas"]:
+            lines.append(
+                f"  delta {d['from']} -> {d['to']}: {d['delta_pct']:+.2f}%"
+            )
     lines.append("")
     lines.append("multichip (MULTICHIP_r*.json):")
     for r in report["multichip"]["rounds"]:

@@ -10,8 +10,16 @@ measured v5e curve lives in docs/PERF_NOTES.md: 64->100, 128->187,
 committed fixtures (distinct sets up to the fixture width; each result
 is checked, with a negative control on the widest batch).
 
+The `--depths` sweep then measures pipelined dispatch depth at the knee
+bucket (the best-throughput width just measured): depth d keeps d batches
+in flight through `verify_signature_sets_async` while the host marshals
+the next — the double-buffering the serving path runs by default
+(crypto/jaxbls/pipeline.py). The winning depth is what
+`autotune calibrate --pipeline-depth N` persists into the device profile.
+
 Usage: python scripts/bench_batch_scaling.py [--widths 64,128,256,512]
                                              [--batches 4]
+                                             [--depths 1,2,4,8]
 Run to completion — never interrupt a remote compile.
 """
 
@@ -38,8 +46,12 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--widths", default="64,128,256,512")
     ap.add_argument("--batches", type=int, default=4)
+    ap.add_argument("--depths", default="1,2,4,8",
+                    help="pipeline depths to sweep at the knee bucket "
+                         "(empty string skips the depth sweep)")
     args = ap.parse_args()
     widths = [int(w) for w in args.widths.split(",")]
+    depths = [int(d) for d in args.depths.split(",") if d.strip()]
 
     import jax
 
@@ -88,6 +100,43 @@ def main():
         results[w] = round(rate, 2)
         log(f"[{w}] {args.batches} batches in {dt:.2f}s -> {rate:.1f} sets/s")
 
+    # depth sweep at the knee bucket: the best-throughput width just
+    # measured (its stages are already warm), driven through the async
+    # submission API with a d-deep in-flight window — exactly the shape
+    # the pipelined executor serves with. Writes the curve the operator
+    # feeds back via `autotune calibrate --pipeline-depth <winner>`.
+    by_depth = {}
+    if results and depths:
+        knee = max(results, key=results.get)
+        batch = sets[:knee]
+        rands = [1] + [rng.getrandbits(64) | 1 for _ in range(knee - 1)]
+        # the backend's OWN dispatcher window would silently cap any sweep
+        # point above its resolved depth (admission resolves the oldest at
+        # `depth` in flight), so each iteration pins the dispatcher to the
+        # depth under measurement and the original is restored after
+        disp = backend.dispatcher
+        prev_depth, prev_src = disp.depth, disp.depth_source
+        try:
+            for d in depths:
+                disp.set_depth(d, "explicit")
+                t0 = time.time()
+                inflight = []
+                for _ in range(args.batches):
+                    inflight.append(
+                        backend.verify_signature_sets_async(batch, rands)
+                    )
+                    if len(inflight) >= d:
+                        assert inflight.pop(0).result(), f"depth {d} failed"
+                while inflight:
+                    assert inflight.pop(0).result(), f"depth {d} failed"
+                dt = time.time() - t0
+                rate = knee * args.batches / dt
+                by_depth[d] = round(rate, 2)
+                log(f"[depth {d}] {args.batches} x {knee}-set batches in "
+                    f"{dt:.2f}s -> {rate:.1f} sets/s")
+        finally:
+            disp.set_depth(prev_depth, prev_src)
+
     # negative control on the widest measured batch
     if results:
         w = max(results)
@@ -101,7 +150,14 @@ def main():
         )
         log(f"[{w}] negative control: tampered batch rejected")
 
-    print(json.dumps({"sets_per_sec_by_width": results}))
+    out = {"sets_per_sec_by_width": results}
+    if by_depth:
+        best = max(by_depth, key=by_depth.get)
+        out["sets_per_sec_by_depth"] = by_depth
+        out["best_depth"] = best
+        log(f"best depth {best} ({by_depth[best]} sets/s) — persist with "
+            f"`autotune calibrate --pipeline-depth {best}`")
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -40,6 +40,7 @@ METRIC_MODULES = (
     "lighthouse_tpu.chain.beacon_processor",
     "lighthouse_tpu.chain.validator_monitor",
     "lighthouse_tpu.crypto.bls.hybrid",
+    "lighthouse_tpu.crypto.jaxbls.pipeline",
     "lighthouse_tpu.autotune.profiler",
     "lighthouse_tpu.observability",
     "lighthouse_tpu.observability.device",
@@ -106,6 +107,17 @@ def lint_registry(registry=None) -> list[str]:
                 errors.append(
                     f"{where}: slo_*/flight_recorder_* metrics must be "
                     "labeled families"
+                )
+        if m.name.startswith("jaxbls_pipeline_"):
+            # the pipelined executor's series answer "which lane, decided
+            # by which config layer" — an unlabeled aggregate over the
+            # urgent and batch lanes (or over config sources) hides
+            # exactly the routing the executor exists to provide, so the
+            # convention is enforced like qos_*
+            if not getattr(m, "labelnames", ()):
+                errors.append(
+                    f"{where}: jaxbls_pipeline_* metrics must be labeled "
+                    "families (lane / config source)"
                 )
         if m.name.startswith(("jaxbls_stage_", "xla_program_")):
             # per-stage attribution and compiled-program analytics exist

@@ -28,11 +28,14 @@ def _clean_autotune_state():
 
 def synthetic_profile() -> profile.DeviceProfile:
     """A fixed v5e-shaped profile; the pinned plan assertions below encode
-    the planner's derivation rules against these numbers."""
+    the planner's derivation rules against these numbers. Keyed to the
+    CURRENT backend revision — runtime.install refuses stale ones (see
+    test_install_rejects_stale_backend_revision)."""
     p = profile.DeviceProfile(
         key={
             "platform": "tpu", "device_kind": "TPU v5e", "num_devices": 1,
-            "jax_version": "0.9.0", "backend_revision": "r5",
+            "jax_version": "0.9.0",
+            "backend_revision": profile.BACKEND_REVISION,
             "bls_backend": "jax",
         },
         source="calibrate",
@@ -50,6 +53,10 @@ def synthetic_profile() -> profile.DeviceProfile:
             p50_ms=p50, p99_ms=p99, sets_per_sec=rate,
         )
     p.host = {"single_set_ms": 577.0}
+    # r7 tuning fields (profile round-trip + plan pass-through pinned)
+    p.msm_window = 4
+    p.pipeline_depth = 6
+    p.warmup_small_buckets = ((4, 128),)
     return p
 
 
@@ -93,6 +100,9 @@ def test_planner_is_deterministic_and_pinned():
     assert plan1.urgent_max_sets == 1
     # warmup: best throughput first
     assert plan1.warmup_buckets == ((512, 128), (256, 128), (64, 128), (4, 128))
+    # r7 tuning fields pass through (clamped/validated)
+    assert plan1.pipeline_depth == 6
+    assert plan1.msm_window == 4
     assert plan1.source.startswith("profile:")
 
 
@@ -108,6 +118,8 @@ def test_planner_defaults_match_hardcoded_constants():
     assert plan.p99_budget_ms == 500.0
     assert plan.urgent_max_sets == 4
     assert plan.warmup_buckets == planner.DEFAULT_WARMUP_BUCKETS
+    assert plan.pipeline_depth == planner.DEFAULT_PIPELINE_DEPTH == 4
+    assert plan.msm_window is None
 
 
 def test_planner_never_lowers_cap_on_a_rising_sweep():
@@ -130,6 +142,87 @@ def test_profile_rejects_malformed_bucket_entry():
     del doc["buckets"][0]["n_sets"]
     with pytest.raises(ValueError, match="malformed autotune profile bucket"):
         profile.DeviceProfile.from_json(doc)
+
+
+# --------------------------------------------- r7 schema migration fields
+
+
+def test_profile_round_trips_r7_tuning_fields(tmp_path):
+    p = synthetic_profile()
+    path = profile.save(p, str(tmp_path / "p.json"))
+    loaded = profile.load(path)
+    assert loaded.msm_window == 4
+    assert loaded.pipeline_depth == 6
+    assert loaded.warmup_small_buckets == ((4, 128),)
+    # pre-r7 documents (no tuning fields) still parse: consumers fall
+    # back to the planner defaults, the file is not rejected for SHAPE
+    doc = p.to_json()
+    for key in ("msm_window", "pipeline_depth", "warmup_small_buckets"):
+        del doc[key]
+    old = profile.DeviceProfile.from_json(doc)
+    assert old.msm_window is None and old.pipeline_depth is None
+    plan = planner.plan_from_profile(old)
+    assert plan.pipeline_depth == planner.DEFAULT_PIPELINE_DEPTH
+    assert plan.msm_window is None
+
+
+def test_profile_rejects_invalid_msm_window_and_depth():
+    doc = synthetic_profile().to_json()
+    doc["msm_window"] = 3          # not in the sweep's search space
+    with pytest.raises(ValueError, match="msm_window"):
+        profile.DeviceProfile.from_json(doc)
+    # 0 is a VALID measured verdict: the bit form won the device sweep
+    doc["msm_window"] = 0
+    assert profile.DeviceProfile.from_json(doc).msm_window == 0
+    doc = synthetic_profile().to_json()
+    doc["pipeline_depth"] = 0
+    with pytest.raises(ValueError, match="pipeline_depth"):
+        profile.DeviceProfile.from_json(doc)
+    doc = synthetic_profile().to_json()
+    doc["warmup_small_buckets"] = ["not-a-pair"]
+    with pytest.raises(ValueError, match="warmup_small_buckets"):
+        profile.DeviceProfile.from_json(doc)
+
+
+def test_install_rejects_stale_backend_revision():
+    """A profile measured under an older jaxbls BACKEND_REVISION (pre-
+    donation kernel structure) must NOT become the knob source: install
+    refuses it cleanly and consumers keep their defaults. The explicit
+    operator override (allow_stale, the --autotune-profile path) still
+    installs, loudly."""
+    stale = synthetic_profile()
+    stale.key["backend_revision"] = "r5"
+    assert stale.is_stale()
+    assert runtime.install_profile(stale) is None
+    assert runtime.active_plan() is None
+
+    plan = runtime.install_profile(stale, allow_stale=True)
+    assert plan is not None and plan.max_attestation_batch == 256
+
+
+def test_planner_warmup_always_includes_small_buckets():
+    """Five wide buckets out-throughput the small one, filling the top-4
+    warmup list — the profile's small/urgent shapes must be APPENDED so
+    bring-up still precompiles the urgent fast path's bucket."""
+    p = synthetic_profile()
+    p.buckets[(1024, 128)] = profile.BucketProfile(
+        n_sets=1024, n_pks=128, samples=8, p50_ms=4000.0, p99_ms=4100.0,
+        sets_per_sec=260.0,
+    )
+    plan = planner.plan_from_profile(p)
+    assert plan.warmup_buckets[:4] == (
+        (1024, 128), (512, 128), (256, 128), (64, 128)
+    )
+    assert (4, 128) in plan.warmup_buckets  # appended, not dropped
+
+    # without an explicit small list the smallest measured bucket is used
+    p2 = synthetic_profile()
+    p2.warmup_small_buckets = None
+    p2.buckets[(1024, 128)] = profile.BucketProfile(
+        n_sets=1024, n_pks=128, samples=8, p50_ms=4000.0, p99_ms=4100.0,
+        sets_per_sec=260.0,
+    )
+    assert (4, 128) in planner.plan_from_profile(p2).warmup_buckets
 
 
 def test_planner_urgent_threshold_uses_host_reference():
@@ -158,13 +251,17 @@ def test_beacon_processor_caps_follow_installed_profile():
     tuned = BeaconProcessorConfig()
     assert tuned.max_attestation_batch == 256
     assert tuned.max_aggregate_batch == 128
+    # the in-flight window follows the plan's measured pipeline depth
+    assert tuned.max_inflight == 6
     # explicit values (CLI flags) still win over the plan
-    explicit = BeaconProcessorConfig(max_attestation_batch=7)
+    explicit = BeaconProcessorConfig(max_attestation_batch=7, max_inflight=2)
     assert explicit.max_attestation_batch == 7
+    assert explicit.max_inflight == 2
 
     runtime.clear()
     again = BeaconProcessorConfig()
     assert again.max_attestation_batch == DEFAULT_MAX_ATTESTATION_BATCH
+    assert again.max_inflight == 4
 
 
 def _make_hybrid(**kw):
@@ -213,6 +310,73 @@ def test_hybrid_knob_precedence(monkeypatch):
     b = _make_hybrid()
     assert b.p99_budget_ms == 1120.0
     assert b.knob_sources["p99_budget_ms"] == "profile"
+
+
+def test_hybrid_reresolves_budgets_on_runtime_install(monkeypatch):
+    """The mid-run retune fix: installing a profile AFTER the router was
+    constructed re-derives the p99 budget and urgent threshold
+    immediately (pre-r8 they were resolved once at construction, so an
+    `autotune calibrate` + install mid-run served stale budgets until
+    restart). Clearing reverts; env-pinned knobs never move."""
+    monkeypatch.delenv("LIGHTHOUSE_TPU_URGENT_MAX_SETS", raising=False)
+    monkeypatch.delenv("LIGHTHOUSE_TPU_DEVICE_P99_BUDGET_MS", raising=False)
+
+    b = _make_hybrid()
+    assert (b.urgent_max_sets, b.p99_budget_ms) == (4, 500.0)
+    # stall budget tracks the resolved p99 budget (4x) unless pinned
+    assert b._stall_budget_secs == pytest.approx(2.0)
+
+    runtime.install_profile(synthetic_profile())
+    assert (b.urgent_max_sets, b.p99_budget_ms) == (1, 1120.0)
+    assert b.knob_sources["p99_budget_ms"] == "profile"
+    assert b._stall_budget_secs == pytest.approx(4.48)
+
+    runtime.clear()
+    assert (b.urgent_max_sets, b.p99_budget_ms) == (4, 500.0)
+    assert b.knob_sources["p99_budget_ms"] == "default"
+
+    # an env-pinned knob stays pinned across installs (precedence holds)
+    monkeypatch.setenv("LIGHTHOUSE_TPU_DEVICE_P99_BUDGET_MS", "123")
+    b2 = _make_hybrid()
+    runtime.install_profile(synthetic_profile())
+    assert b2.p99_budget_ms == 123.0
+    assert b2.knob_sources["p99_budget_ms"] == "env"
+    assert b2.urgent_max_sets == 1  # un-pinned knob still retunes
+
+
+def test_msm_window_resolution_honors_measured_bit_form(monkeypatch):
+    """A profile whose sweep measured the bit form as the winner
+    (msm_window=0) must serve the bit form — the accelerator default
+    (w=4) only applies when the window is UNMEASURED (None)."""
+    from lighthouse_tpu.crypto.jaxbls.msm import msm_window
+
+    monkeypatch.delenv("LIGHTHOUSE_TPU_MSM_WINDOW", raising=False)
+    monkeypatch.delenv("LIGHTHOUSE_TPU_MSM_WINDOWED", raising=False)
+    p = synthetic_profile()
+    p.msm_window = 0
+    runtime.install_profile(p)
+    assert msm_window() == 0
+    p2 = synthetic_profile()
+    p2.msm_window = 5
+    runtime.install_profile(p2)
+    assert msm_window() == 5
+    # env override still beats the plan
+    monkeypatch.setenv("LIGHTHOUSE_TPU_MSM_WINDOW", "2")
+    assert msm_window() == 2
+
+
+def test_jaxbls_dispatcher_depth_follows_runtime_install():
+    """The jaxbls pipeline depth resolution consults the installed plan
+    (env > plan > default) — the depth the backend's dispatcher and the
+    processor's in-flight window both derive from."""
+    from lighthouse_tpu.crypto.jaxbls import pipeline as pl
+
+    assert pl.resolve_depth() == (4, "default")
+    runtime.install_profile(synthetic_profile())
+    assert pl.resolve_depth() == (6, "profile")
+    assert pl.resolve_depth(explicit=2) == (2, "explicit")
+    runtime.clear()
+    assert pl.resolve_depth() == (4, "default")
 
 
 # ---------------------------------------------------------------- profiler
@@ -397,3 +561,9 @@ def test_cli_autotune_show(tmp_path, capsys):
     doc = json.loads(capsys.readouterr().out)
     assert doc["plan"]["max_attestation_batch"] == 256
     assert doc["profile"]["schema_version"] == profile.SCHEMA_VERSION
+    # the r7 tuning fields render in both the profile and the plan
+    assert doc["profile"]["msm_window"] == 4
+    assert doc["profile"]["pipeline_depth"] == 6
+    assert doc["profile"]["warmup_small_buckets"] == [[4, 128]]
+    assert doc["plan"]["pipeline_depth"] == 6
+    assert doc["plan"]["msm_window"] == 4

@@ -82,13 +82,18 @@ def test_smoke_matrix_carries_program_analytics_schema():
 # ------------------------------------------------------ synthetic series
 
 
-def _write_round(root, n, value, *, skipped=False, carried_value=None):
+def _write_round(root, n, value, *, skipped=False, carried_value=None,
+                 config1_p50=None, pipeline=None):
     parsed = {
         "metric": "BLS signature-sets verified/sec (synthetic)",
         "unit": "sets/s",
         "value": value,
         "vs_baseline": round(value / 700.0, 3),
     }
+    if config1_p50 is not None:
+        parsed["config1_p50_ms"] = config1_p50
+    if pipeline is not None:
+        parsed["pipeline"] = pipeline
     if skipped:
         parsed["skipped"] = True
         parsed["value"] = carried_value or 0.0
@@ -144,6 +149,49 @@ def test_carried_forward_rounds_never_trigger_or_mask_regression(tmp_path):
     # tighter threshold: the same drop becomes a regression
     rc2, _ = perf.check(root, threshold=0.04)
     assert rc2 == 1
+
+
+def test_config1_p50_latency_regression_gates(tmp_path):
+    """The urgent-path latency series: a fresh-to-fresh config1 p50
+    INCREASE past the threshold fails the gate exactly like a headline
+    throughput drop — and a healthy headline cannot mask it."""
+    root = str(tmp_path)
+    _write_round(root, 1, 100.0, config1_p50=90.0,
+                 pipeline={"depth": 4, "donated_inputs": True})
+    _write_round(root, 2, 110.0, config1_p50=150.0)  # +67% latency
+    rc, report = perf.check(root)
+    assert rc == 1 and not report["ok"]
+    (reg,) = report["regressions"]
+    assert reg["config"] == "config1_p50"
+    assert reg["prev"] == 90.0 and reg["cur"] == 150.0
+    text = perf.render_report(report)
+    assert "config1 urgent-path p50" in text
+    assert "REGRESSION" in text
+    # the CI entry point exits nonzero on the same series
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "perf_trend.py"),
+         "--check", "--root", root],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 1, r.stdout + r.stderr
+
+
+def test_config1_p50_improvement_and_missing_rounds_pass(tmp_path):
+    """Latency improving (or rounds without the series — every pre-r8
+    artifact) must not trip the gate; a skipped round's p50 never enters
+    the fresh series."""
+    root = str(tmp_path)
+    _write_round(root, 1, 100.0, config1_p50=529.0)
+    _write_round(root, 2, 0.0, skipped=True, carried_value=100.0,
+                 config1_p50=529.0)          # outage: must not read fresh
+    _write_round(root, 3, 101.0, config1_p50=95.0)   # big improvement
+    _write_round(root, 4, 102.0)                     # series absent: ok
+    rc, report = perf.check(root)
+    assert rc == 0, report["regressions"]
+    lat_rounds = report["config1_p50"]["rounds"]
+    assert [r["round"] for r in lat_rounds] == [1, 3]
+    (delta,) = report["config1_p50"]["deltas"]
+    assert delta["delta_pct"] < 0  # improvement, negative latency delta
 
 
 def test_multichip_regression_flagged(tmp_path):
