@@ -161,14 +161,23 @@ class OperationPool:
             if electra_block
             else spec.preset.MAX_ATTESTATIONS
         )
-        chosen = max_cover(
-            [
-                it
-                for it in items
-                if (it[2].committee_bits is not None) == electra_block
-            ],
-            limit,
+        candidates = [
+            it
+            for it in items
+            if (it[2].committee_bits is not None) == electra_block
+        ]
+        # canonical order before the greedy pass: max_cover breaks ties by
+        # list position, and the pool fills in gossip ARRIVAL order — two
+        # nodes holding identical contents must pack identical blocks
+        # (deterministic multi-node runs depend on it)
+        candidates.sort(
+            key=lambda it: (
+                int(it[2].data.slot),
+                sorted(it[0]),
+                bytes(it[2].signature),
+            )
         )
+        chosen = max_cover(candidates, limit)
         out = []
         for entry in chosen:
             kwargs = dict(

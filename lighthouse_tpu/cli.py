@@ -514,6 +514,7 @@ def cmd_bn(args):
             batch_gossip=not args.disable_gossip_batching,
             processor_config=proc_cfg,
             ingest_rate=args.gossip_ingest_rate,
+            rpc_timeout=args.rpc_timeout,
         )
         log.info("p2p listening", addr=str(net.host.listen_addr),
                  fork_digest=digest.hex())
@@ -1461,6 +1462,12 @@ def build_parser() -> argparse.ArgumentParser:
     # -- execution
     bn.add_argument("--execution-timeout", type=float, default=8.0,
                     help="engine-API HTTP timeout seconds")
+    bn.add_argument("--rpc-timeout", type=float, default=None,
+                    help="p2p Req/Resp round-trip budget in seconds "
+                         "(default: LIGHTHOUSE_TPU_RPC_TIMEOUT env or 10); "
+                         "range-sync batch requests add per-block streaming "
+                         "time on top, so a stuck peer costs one deadline "
+                         "and a failover, never a stalled range")
     # -- gossip / processor
     bn.add_argument("--gossip-heartbeat-interval", type=float, default=0.3,
                     help="gossipsub mesh-maintenance heartbeat seconds")

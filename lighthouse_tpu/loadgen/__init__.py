@@ -19,6 +19,17 @@ the same datadir, and asserts resume-from-persisted-head plus the extended
 conservation invariant published == processed + dropped + expired +
 lost_to_crash (docs/RECOVERY.md).
 
+Since PR 9 the board also covers the NETWORK: `netfaults.py` (a seeded
+fault plan — partitions, counter-based link drop/delay, silent/torn/empty
+RPC peers, churn, equivocating proposers — spliced into the real
+transport/gossip/rpc path) and `multinode.py` (N full BeaconChain +
+NetworkNode stacks over localhost TCP, clusters producing on their own
+heads through partitions, heals won by fork choice). Scenario families
+`partition_heal`, `fork_reorg`, `sync_catchup`, `equivocation_storm`
+assert cross-node head agreement within K slots of heal and the
+conservation invariant "no message lost without a counted reason"
+(docs/NETFAULTS.md).
+
 Entry points: `bn loadtest [--smoke]` and `scripts/loadgen.py --smoke`
 (CPU-only, ~seconds, gitignored JSON report); `--smoke` with an explicit
 `--scenario` runs that scenario shrunk to smoke scale. Everything is
@@ -43,6 +54,22 @@ _EXPORTS = {
     "get_scenario": ".scenarios",
     "smoke_variant": ".scenarios",
     "traffic_schedule": ".scenarios",
+    "MultiNodeScenario": ".scenarios",
+    "get_multinode_scenario": ".scenarios",
+    "is_multinode": ".scenarios",
+    "multinode_smoke_variant": ".scenarios",
+    "NetFaultPlan": ".netfaults",
+    "NetFaultInjector": ".netfaults",
+    "FaultyPeer": ".netfaults",
+    "FaultyGossipSend": ".netfaults",
+    "InjectedTimeout": ".netfaults",
+    "Partition": ".netfaults",
+    "LinkFault": ".netfaults",
+    "RpcFault": ".netfaults",
+    "Churn": ".netfaults",
+    "Equivocation": ".netfaults",
+    "run_multinode_scenario": ".multinode",
+    "MultiNodeHarness": ".multinode",
 }
 
 __all__ = list(_EXPORTS)

@@ -35,11 +35,17 @@ def drive(*, scenario=None, smoke=False, slots=None, validators=None,
     shrunk to smoke scale (same faults and mix, clamped validators/slots),
     e.g. `bn loadtest --scenario crash_restart --smoke`."""
     from .runner import run_scenario
-    from .scenarios import get_scenario, smoke_variant
+    from .scenarios import get_scenario, is_multinode, smoke_variant
 
     stdout = stdout or sys.stdout
     stderr = stderr or sys.stderr
     name = "smoke" if smoke and scenario is None else (scenario or "smoke")
+    if is_multinode(name):
+        return _drive_multinode(
+            name, smoke=smoke, slots=slots, validators=validators,
+            seed=seed, out=out, quiet=quiet, datadir=datadir,
+            stdout=stdout, stderr=stderr,
+        )
     try:
         sc = get_scenario(name, slots=slots, n_validators=validators,
                           seed=seed, flood_factor=flood_factor)
@@ -92,11 +98,73 @@ def drive(*, scenario=None, smoke=False, slots=None, validators=None,
     return 0
 
 
+def _drive_multinode(name, *, smoke, slots, validators, seed, out, quiet,
+                     datadir, stdout, stderr) -> int:
+    """Multi-node scenario leg: N full nodes over real TCP under a network
+    fault plan (loadgen/multinode.py). Exit code is the scenario verdict —
+    nonzero on divergence, broken conservation, or an un-exercised fault."""
+    from .multinode import run_multinode_scenario
+    from .scenarios import get_multinode_scenario, multinode_smoke_variant
+
+    sc = get_multinode_scenario(name, slots=slots, n_validators=validators,
+                                seed=seed)
+    if smoke:
+        sc = multinode_smoke_variant(sc)
+    out = out or default_report_path(smoke)
+    try:
+        report = run_multinode_scenario(
+            sc, out_path=out, datadir=datadir,
+            log_fn=None if quiet else (
+                lambda m: print(m, file=stderr, flush=True)
+            ),
+        )
+    except ValueError as e:
+        # e.g. a --validators override that no longer matches the
+        # scenario's fixed validator_split
+        print(f"error: {e}", file=stderr)
+        return 1
+    det = report["deterministic"]
+    summary = {
+        "scenario": report["scenario"],
+        "report": out,
+        "ok": report["ok"],
+        "convergence": det["convergence"],
+        "blocks": det["blocks"],
+        "orphaned_blocks": det["orphaned_blocks"],
+        "netfault_events": len(det["netfault_events"]),
+        "incidents": report["slo"]["incidents"],
+        "elapsed_secs": report["elapsed_secs"],
+    }
+    if det["sync"] is not None:
+        summary["sync"] = {
+            "reached_head": det["sync"]["reached_head"],
+            "imported_blocks": det["sync"]["imported_blocks"],
+            "failovers": det["sync"]["stats"]["failovers"],
+            "batch_retries": det["sync"]["stats"]["batch_retries"],
+        }
+    if det["equivocation"]["injected"]:
+        summary["equivocation"] = {
+            "injected": det["equivocation"]["injected"],
+            "detections": sum(
+                det["equivocation"]["detections_by_node"].values()
+            ),
+            "slashed": det["equivocation"]["slashed_in_final_state"],
+        }
+    print(json.dumps(summary), file=stdout)
+    if not report["ok"]:
+        for reason in report["failures"]:
+            print(f"error: {reason}", file=stderr)
+        return 1
+    return 0
+
+
 def add_loadtest_args(parser) -> None:
     """The flag set shared by both entry points."""
     parser.add_argument("--scenario", default=None,
                         help="named scenario: smoke, steady, flood, "
-                             "device_stall, slow_host, crash_restart "
+                             "device_stall, slow_host, crash_restart, "
+                             "or a multi-node family: partition_heal, "
+                             "fork_reorg, sync_catchup, equivocation_storm "
                              "(default: smoke)")
     parser.add_argument("--smoke", action="store_true",
                         help="alone: run the ~5s CPU-only smoke scenario; "

@@ -1,0 +1,940 @@
+"""Multi-node loadtest harness: N full nodes under injected network faults.
+
+The promotion of `testing/simulator.py` into a loadgen-drivable proving
+ground: N complete `BeaconChain` + `NetworkNode` stacks in one process,
+connected over real localhost TCP (real transport frames, real gossipsub
+forwarding, real Req/Resp sync), seeded `ManualSlotClock`s, the validator
+set split across nodes — and a `NetFaultPlan` (loadgen/netfaults.py)
+injecting partitions, lossy links, silent peers, churn, and equivocating
+proposers while the lock-step slot loop drives production, gossip, and
+attestation flow.
+
+Where the happy-path simulator asserts "everyone always converges", this
+harness asserts the ADVERSARIAL versions the reference client lives with:
+
+  - fork-aware production: nodes are CLUSTERED by head root each slot and
+    every cluster whose proposer it can reach produces on its own head —
+    a partition therefore grows competing forks exactly like a real one,
+    and the heal is won by attestation weight through fork choice;
+  - partition-aware propagation: blocks are awaited only on nodes the
+    fault plan says are reachable, every unreachable delivery is counted
+    with its reason (partition / churn / detached) — the cross-node
+    conservation invariant is "no message lost without a counted reason";
+  - convergence: after the last heal, all alive nodes must agree on one
+    head within K slots (`converge_slots`) or the scenario FAILS;
+  - sync under faults: a node started behind range-syncs to head through
+    `SyncManager` with its peers wrapped in `FaultyPeer` — injected batch
+    stalls force the retry/backoff/failover engine and the report carries
+    the manager's deterministic `stats`;
+  - equivocation storms route both conflicting signed headers through
+    every honest node's slasher; detections are counted and the assembled
+    `ProposerSlashing` flows through op pools into later blocks.
+
+Reports: everything a rerun with the same seed must reproduce bit-for-bit
+lives under `report["deterministic"]` (per-slot cluster/production log,
+delivery conservation, convergence, sync stats, equivocation verdicts,
+fault-plan transition events). Wall-clock-shaped observations (gossip
+frame counts including heartbeat traffic, SLO latency quantiles, elapsed
+time) live next to it, outside the determinism contract.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+
+from ..chain.beacon_chain import BeaconChain
+from ..chain.op_pool import OperationPool
+from ..crypto import bls
+from ..network import gossip as gs
+from ..network.node import NetworkNode
+from ..observability.flight_recorder import RECORDER
+from ..observability.slo import SlotAccountant
+from ..state_transition import accessors as acc
+from ..state_transition.slot import process_slots, types_for_slot
+from ..testing.harness import StateHarness, _sign, clone_state
+from ..types import helpers as h
+from ..types.spec import DOMAIN_BEACON_ATTESTER, ForkName, minimal_spec
+from .netfaults import (
+    FaultyGossipSend,
+    FaultyPeer,
+    NetFaultInjector,
+    NetFaultPlan,
+)
+from .scenarios import MultiNodeScenario
+
+
+class MultiNode:
+    """One node's full stack inside the harness."""
+
+    def __init__(self, mh: "MultiNodeHarness", index: int,
+                 validator_indices: list[int], slasher: bool = False):
+        self.index = index
+        self.validators = set(validator_indices)
+        self.chain = BeaconChain(
+            mh.spec, clone_state(mh.harness.state, mh.spec)
+        )
+        self.op_pool = OperationPool(mh.spec)
+        self.slasher_svc = None
+        if slasher:
+            from ..slasher.service import SlasherService
+
+            self.slasher_svc = SlasherService(
+                op_pool=self.op_pool, types=types_for_slot(mh.spec, 1)
+            )
+            self.chain.slasher = self.slasher_svc
+        self.net = NetworkNode(
+            self.chain,
+            f"node{index}-{mh.seed & 0xFFFFFF:06x}",
+            # heartbeats are driven EXPLICITLY by the slot loop by default:
+            # a wall-clock heartbeat thread would make mesh maintenance
+            # (and so frame counts) depend on how long a slot took in real
+            # time (testing/simulator.py opts back into the timer thread)
+            heartbeat_interval=mh.heartbeat_interval,
+            subnets=mh.subnets,
+            op_pool=self.op_pool,
+            # inline single-threaded gossip verification by default:
+            # deterministic handler ordering under the node lock (the
+            # device-batching path is the single-node loadgen's subject)
+            batch_gossip=mh.batch_gossip,
+            rpc_timeout=mh.rpc_timeout,
+        )
+        # per-node service-level accountant (private: the global one
+        # belongs to a live bn process)
+        self.slo = SlotAccountant(export_metrics=False)
+        self.slo.bind_clock(self.chain.slot_clock)
+        self.detections = 0          # slasher evidence broadcast by this node
+
+    @property
+    def head(self) -> bytes:
+        return self.chain.head_root
+
+
+class MultiNodeHarness:
+    """N-node lock-step sim over real TCP with a fault injector spliced in."""
+
+    WAIT_SECS = 30.0
+
+    def __init__(self, spec, n_nodes: int, n_validators: int,
+                 subnets: int = 2, seed: int = 0, injector=None,
+                 attest: bool = True, slasher: bool = False,
+                 detached: tuple = (), rpc_timeout: float = 2.0,
+                 validator_split: tuple | None = None,
+                 batch_gossip: bool = False,
+                 heartbeat_interval: float = 60.0):
+        self.spec = spec
+        self.subnets = subnets
+        self.seed = seed
+        self.injector = injector
+        self.attest = attest
+        self.rpc_timeout = rpc_timeout
+        self.batch_gossip = batch_gossip
+        self.heartbeat_interval = heartbeat_interval
+        self.harness = StateHarness.new(spec, n_validators)
+        if validator_split is None:
+            per = n_validators // n_nodes
+            counts = [per] * (n_nodes - 1) + [n_validators - per * (n_nodes - 1)]
+        else:
+            # uneven stake per node (fork_reorg gives the majority side a
+            # decisive LMD weight — a perfectly balanced fork is a genuine
+            # stalemate and would never reorg)
+            if len(validator_split) != n_nodes or sum(validator_split) != n_validators:
+                raise ValueError("validator_split must cover every node and "
+                                 "sum to n_validators")
+            counts = list(validator_split)
+        bounds = [0]
+        for c in counts:
+            bounds.append(bounds[-1] + c)
+        self.nodes = [
+            MultiNode(self, i, list(range(bounds[i], bounds[i + 1])),
+                      slasher=slasher)
+            for i in range(n_nodes)
+        ]
+        self.detached: set[int] = set(detached)
+        self.id_map = {n.net.node_id: n.index for n in self.nodes}
+        if injector is not None:
+            # every encoded gossip RPC frame now passes the fault plan
+            # before its real TCP write
+            for n in self.nodes:
+                FaultyGossipSend.install(n.net, injector, n.index, self.id_map)
+        attached = [n for n in self.nodes if n.index not in self.detached]
+        for i, a in enumerate(attached):
+            for b in attached[i + 1:]:
+                a.net.connect(b.net)
+        self._wait_mesh(attached)
+        self.slot = 0
+        self.per_slot: list[dict] = []
+        self.blocks = {
+            "published": 0,
+            "deliveries_expected": 0,
+            "delivered": 0,
+            "blocked": {},           # reason -> count
+        }
+        self.att_published = 0
+        self.equivocations_published: list[dict] = []
+
+    # ------------------------------------------------------------ plumbing
+
+    @staticmethod
+    def _wait(cond, timeout: float, what: str) -> None:
+        deadline = time.monotonic() + timeout
+        while not cond():
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"timed out waiting for {what}")
+            time.sleep(0.005)
+
+    def _wait_mesh(self, members: list[MultiNode]) -> None:
+        """Wait until every member pair is connected AND mutually knows the
+        block topic (publishing before subscription knowledge propagates
+        races the flood-publish fallback — see testing/simulator.py)."""
+        if len(members) < 2:
+            return
+        block_topic = gs.topic_name(members[0].net.fork_digest, "beacon_block")
+        self._wait(
+            lambda: all(
+                b.net.node_id in a.net.host.connections
+                and block_topic
+                in a.net.gossipsub.peer_topics.get(b.net.node_id, set())
+                for a in members for b in members if a is not b
+            ),
+            20.0, "mesh formation",
+        )
+
+    def node_for_validator(self, vi: int) -> MultiNode:
+        for n in self.nodes:
+            if vi in n.validators:
+                return n
+        raise KeyError(vi)
+
+    def _alive(self, idx: int) -> bool:
+        if idx in self.detached:
+            return False
+        if self.injector is not None and idx in self.injector.down:
+            return False
+        return True
+
+    def _reachable(self, a: int, b: int) -> bool:
+        if not (self._alive(a) and self._alive(b)):
+            return False
+        if self.injector is None:
+            return True
+        return self.injector.reachable(a, b)
+
+    def _blocked_reason(self, idx: int) -> str:
+        if idx in self.detached:
+            return "detached"
+        if self.injector is not None and idx in self.injector.down:
+            return "churn"
+        return "partition"
+
+    def attach(self, idx: int) -> None:
+        """Connect a previously detached node to every alive peer (the
+        sync_catchup join). The caller then drives its SyncManager."""
+        self.detached.discard(idx)
+        node = self.nodes[idx]
+        peers = [n for n in self.nodes
+                 if n.index != idx and self._alive(n.index)]
+        for other in peers:
+            node.net.connect(other.net)
+        self._wait_mesh([node] + peers)
+        # the Status handshakes run on helper threads; sync needs them done
+        self._wait(
+            lambda: len(node.net.sync.peers) >= len(peers),
+            self.WAIT_SECS, f"sync handshakes for node{idx}",
+        )
+
+    # ------------------------------------------------------------ churn
+
+    def _take_down(self, idx: int) -> None:
+        node = self.nodes[idx]
+        for conn in list(node.net.host.connections.values()):
+            conn.close()
+        others = [n for n in self.nodes
+                  if n.index != idx and n.index not in self.detached]
+        self._wait(
+            lambda: all(node.net.node_id not in o.net.host.connections
+                        for o in others),
+            self.WAIT_SECS, f"churn-down of node{idx}",
+        )
+
+    def _bring_up(self, idx: int) -> None:
+        node = self.nodes[idx]
+        peers = [n for n in self.nodes
+                 if n.index != idx and self._alive(n.index)]
+        for other in peers:
+            node.net.connect(other.net)
+        self._wait_mesh([node] + peers)
+
+    # ------------------------------------------------------------ slot loop
+
+    def run_slot(self) -> dict:
+        self.slot += 1
+        slot = self.slot
+        inj = self.injector
+        prev_down = set(inj.down) if inj is not None else set()
+        if inj is not None:
+            inj.on_slot(slot)
+            for idx in sorted(inj.down - prev_down):
+                self._take_down(idx)
+            for idx in sorted(prev_down - inj.down):
+                self._bring_up(idx)
+        alive = [n for n in self.nodes if self._alive(n.index)]
+        for n in alive:
+            n.chain.slot_clock.set_slot(slot)
+            with n.net._lock:
+                n.chain.per_slot_task()
+        if inj is not None and self._partition_key(slot) != self._partition_key(
+            slot - 1
+        ):
+            # A partition/churn boundary just crossed. While peers were cut
+            # off, gossipsub's P3 delivery-deficit machinery scored them
+            # into the graylist (correct for a live mesh) — and in real
+            # time the minutes-long outage would ALSO have run minutes of
+            # score decay and prune-backoff expiry before traffic resumed.
+            # The lock-step sim compresses those minutes into milliseconds,
+            # so the decay can never catch up with the heal; model the
+            # elapsed wall time by clearing transient score state at the
+            # transition (meshes re-form from scratch; flood-publish covers
+            # delivery meanwhile).
+            self._reset_gossip_transients()
+        # deterministic mesh maintenance: one explicit heartbeat per slot
+        for n in alive:
+            try:
+                n.net.gossipsub.heartbeat()
+            except Exception:  # noqa: BLE001 — dying conn mid-tick is fine
+                pass
+        produced, slot_blocks = self._produce_and_propagate(slot, alive)
+        if self.attest:
+            self._attest_and_pool(slot, alive, produced)
+        detections = {}
+        for n in alive:
+            if n.slasher_svc is not None:
+                found = n.slasher_svc.process()
+                if found:
+                    n.detections += found
+                    detections[str(n.index)] = found
+        if inj is not None:
+            # drain in-flight forwards before the clock moves: a frame
+            # sent at slot N must never be evaluated against slot N+1's
+            # fault rules (determinism depends on it)
+            self._quiesce()
+        for n in self.nodes:
+            n.slo.close_slot(slot)
+        entry = {
+            "slot": slot,
+            "clusters": [sorted(x.index for x in c)
+                         for c in self._clusters(alive)],
+            "blocks": slot_blocks,
+            "heads": {str(n.index): n.head.hex()[:8] for n in self.nodes},
+            "down": sorted(inj.down) if inj is not None else [],
+            "detached": sorted(self.detached),
+        }
+        if detections:
+            entry["slasher_detections"] = detections
+        self.per_slot.append(entry)
+        return entry
+
+    def _quiesce(self) -> None:
+        """End-of-slot network barrier: wait until every live connection
+        pair has received everything the other side sent AND every gossip
+        dispatcher is idle, twice in a row. Without it, a mesh FORWARD of
+        a slot-N message still in flight when the clock advances to N+1
+        can cross a fault boundary the plan says it must not (one leaked
+        partition-era vote is enough to flip a head race)."""
+        def settled() -> bool:
+            for a in self.nodes:
+                for pid, conn in list(a.net.host.connections.items()):
+                    if not conn.gossip_idle():
+                        return False
+                    idx = self.id_map.get(pid)
+                    if idx is None:
+                        continue
+                    back = self.nodes[idx].net.host.connections.get(
+                        a.net.node_id
+                    )
+                    if back is None:
+                        continue
+                    if conn.sent_frames != back.recv_frames:
+                        return False
+                    if back.sent_frames != conn.recv_frames:
+                        return False
+            return True
+
+        deadline = time.monotonic() + self.WAIT_SECS
+        streak = 0
+        while streak < 2:
+            if settled():
+                streak += 1
+            else:
+                streak = 0
+            if time.monotonic() > deadline:
+                raise TimeoutError("network never quiesced at slot end")
+            time.sleep(0.002)
+
+    def _reset_gossip_transients(self) -> None:
+        """Clear per-peer gossip score state, graft backoffs and the IHAVE
+        message-cache window on every node — the logical-time stand-in for
+        the score decay, backoff expiry and mcache aging a real minutes-
+        long partition would have run before heal. (Without the mcache
+        flush, whether a partition-era message leaks across the heal via
+        IHAVE/IWANT recovery depends on heartbeat timing, not the seed.)"""
+        for n in self.nodes:
+            g = n.net.gossipsub
+            with g._lock:
+                g.peer_score.peers.clear()
+                for p in g.peers:
+                    g.peer_score.add_peer(p)
+                g.backoff.clear()
+                g.mcache = type(g.mcache)()
+
+    def _partition_key(self, slot: int) -> tuple:
+        """Hashable description of connectivity at `slot`: the partition
+        group of every node plus the churned-down set."""
+        inj = self.injector
+        if inj is None:
+            return ()
+        down = frozenset(
+            c.node for c in inj.plan.churn if c.down_slot <= slot < c.up_slot
+        )
+        return (
+            tuple(inj.partition_of(i, slot) for i in range(len(self.nodes))),
+            down,
+        )
+
+    def _clusters(self, alive: list[MultiNode]) -> list[list[MultiNode]]:
+        """Alive nodes grouped by (partition group, head root), ordered by
+        lowest member index — the deterministic iteration order for
+        fork-aware work. The partition group is part of the key: at the
+        slot a partition starts, both sides still share a head but can no
+        longer exchange a block, so they are separate production units."""
+        by_key: dict[tuple, list[MultiNode]] = {}
+        for n in alive:
+            group = (
+                self.injector.partition_of(n.index)
+                if self.injector is not None else -1
+            )
+            by_key.setdefault((group, n.head), []).append(n)
+        return sorted(by_key.values(), key=lambda c: min(x.index for x in c))
+
+    # ------------------------------------------------------------ produce
+
+    def _produce_and_propagate(self, slot: int, alive: list[MultiNode]):
+        spec = self.spec
+        inj = self.injector
+        equivocate = inj is not None and any(
+            e.slot == slot for e in inj.plan.equivocations
+        )
+        produced = []
+        slot_blocks = []
+        for cluster in self._clusters(alive):
+            ref = cluster[0]
+            pre = clone_state(ref.chain.head_state(), spec)
+            if pre.slot < slot:
+                process_slots(pre, spec, slot)
+            proposer = int(acc.get_beacon_proposer_index(pre, spec))
+            owner = self.node_for_validator(proposer)
+            cluster_ids = sorted(x.index for x in cluster)
+            if owner.index not in cluster_ids:
+                # the proposer's node is partitioned away from (or down
+                # for) this cluster: the slot is missed on this fork —
+                # exactly what a real minority partition experiences
+                slot_blocks.append({
+                    "cluster": cluster_ids, "proposer": proposer,
+                    "missed": "proposer_unreachable",
+                })
+                continue
+            epoch = h.compute_epoch_at_slot(slot, spec)
+            types = types_for_slot(spec, slot)
+            reveal = self.harness.randao_reveal(pre, proposer, epoch)
+            try:
+                block = owner.chain.produce_block(
+                    slot, reveal, op_pool=owner.op_pool
+                )
+            except Exception as e:  # noqa: BLE001 — e.g. slashed proposer
+                slot_blocks.append({
+                    "cluster": cluster_ids, "proposer": proposer,
+                    "missed": f"production_failed:{type(e).__name__}",
+                })
+                continue
+            signed = self.harness.sign_block(block, types)
+            root = types.BeaconBlock.hash_tree_root(block)
+            with owner.net._lock:
+                owner.chain.process_block(
+                    signed, block_root=root, proposal_already_verified=True
+                )
+            owner.net.publish_block(signed)
+            produced.append((owner, root, signed, types, cluster))
+            self.blocks["published"] += 1
+            slot_blocks.append({
+                "cluster": cluster_ids, "proposer": proposer,
+                "owner": owner.index, "root": root.hex()[:8],
+            })
+        # propagation: reachable nodes must import (directly or via parent
+        # lookup); unreachable ones are counted with their blocking reason
+        for owner, root, signed, types, cluster in produced:
+            reach = [n for n in alive if n is not owner
+                     and self._reachable(owner.index, n.index)]
+            unreach = [n for n in self.nodes if n is not owner
+                       and n not in reach]
+            self.blocks["deliveries_expected"] += len(reach) + len(unreach)
+            # cluster members extend their own head: they must ADOPT the
+            # block (fork choice), not merely store it — sampling heads
+            # before adoption settles would race the reader threads. Other
+            # reachable nodes only owe an import (their own fork choice
+            # decides adoption on attestation weight).
+            members = {x.index for x in cluster}
+            self._wait(
+                lambda: all(
+                    (n.head == root) if n.index in members
+                    else n.chain.store.block_exists(root)
+                    for n in reach
+                ),
+                self.WAIT_SECS, f"block propagation at slot {slot}",
+            )
+            self.blocks["delivered"] += len(reach)
+            owner.slo.record_processed("gossip_block")
+            for n in reach:
+                n.slo.record_processed("gossip_block")
+            for n in unreach:
+                reason = self._blocked_reason(n.index)
+                self.blocks["blocked"][reason] = (
+                    self.blocks["blocked"].get(reason, 0) + 1
+                )
+                n.slo.record_shed("gossip_block", f"netfault_{reason}")
+        if equivocate and produced:
+            self._equivocate(slot, alive, produced[0])
+        return produced, slot_blocks
+
+    def _equivocate(self, slot: int, alive: list[MultiNode],
+                    first_produced) -> None:
+        """The scheduled proposer signs a SECOND, conflicting block for the
+        slot. Honest reachable nodes must reject it at gossip verification
+        and feed BOTH signed headers to their slashers."""
+        owner, root, signed, types, _cluster = first_produced
+        block = signed.message
+        twin_msg = block.copy_with(
+            body=block.body.copy_with(graffiti=b"\x45" * 32)
+        )
+        twin = self.harness.sign_block(twin_msg, types)
+        reach = [n for n in alive if n is not owner
+                 and self._reachable(owner.index, n.index)]
+        baselines = {n.index: n.net.gossipsub.rejected for n in reach}
+        owner.net.publish_block(twin)
+        self._wait(
+            lambda: all(n.net.gossipsub.rejected > baselines[n.index]
+                        for n in reach),
+            self.WAIT_SECS, f"equivocation rejection at slot {slot}",
+        )
+        self.equivocations_published.append({
+            "slot": slot, "proposer": int(block.proposer_index),
+            "owner": owner.index, "rejected_by": len(reach),
+        })
+        RECORDER.record("equivocation_detected", severity="warn",
+                        slot=slot, proposer=int(block.proposer_index),
+                        rejected_by=len(reach))
+
+    # ------------------------------------------------------------ attest
+
+    def _attest_and_pool(self, slot: int, alive: list[MultiNode],
+                         produced) -> None:
+        """Every cluster that produced publishes single-bit attestations
+        from the validators its members own — the weight that decides the
+        post-heal fork choice. Waits for fan-out only within the cluster
+        (the fault plan blocks the rest, with counted reasons)."""
+        spec = self.spec
+        epoch = h.compute_epoch_at_slot(slot, spec)
+        for owner, root, signed, types, cluster in produced:
+            if owner.head != root:
+                continue             # head moved under us: skip this fork
+            post = owner.chain.head_state()
+            cache = acc.build_committee_cache(post, spec, epoch)
+            start_slot = h.compute_start_slot_at_epoch(epoch, spec)
+            if slot == start_slot:
+                target_root = root
+            else:
+                target_root = post.block_roots[
+                    start_slot % spec.preset.SLOTS_PER_HISTORICAL_ROOT
+                ]
+            source = post.current_justified_checkpoint
+            domain = h.get_domain(post, spec, DOMAIN_BEACON_ATTESTER, epoch)
+            electra = spec.fork_name_at_slot(slot) >= ForkName.electra
+            cluster_ids = {x.index for x in cluster}
+            published = 0
+            published_idx: set[int] = set()
+            for cidx in range(cache.committees_per_slot):
+                committee = cache.committee(slot, cidx)
+                data = types.AttestationData.make(
+                    slot=slot,
+                    index=0 if electra else cidx,
+                    beacon_block_root=root,
+                    source=source,
+                    target=types.Checkpoint.make(epoch=epoch, root=target_root),
+                )
+                signing_root = h.compute_signing_root(
+                    types.AttestationData, data, domain
+                )
+                subnet = gs.compute_subnet_for_attestation(
+                    cache.committees_per_slot, slot, cidx, spec
+                ) % self.subnets
+                for pos, vi in enumerate(committee):
+                    node = self.node_for_validator(vi)
+                    if node.index not in cluster_ids:
+                        continue     # that validator's node can't see root
+                    bits = [p == pos for p in range(len(committee))]
+                    sig = _sign(self.harness.sk(vi), signing_root).serialize()
+                    kwargs = dict(aggregation_bits=bits, data=data,
+                                  signature=sig)
+                    if electra:
+                        cb = [False] * spec.preset.MAX_COMMITTEES_PER_SLOT
+                        cb[cidx] = True
+                        kwargs["committee_bits"] = cb
+                    att = types.Attestation.make(**kwargs)
+                    with node.net._lock:
+                        results = node.chain.verify_unaggregated_attestations(
+                            [att]
+                        )
+                        for a, idxs in results:
+                            node.chain.apply_attestation_to_fork_choice(a, idxs)
+                            node.op_pool.insert_attestation(a, idxs, types)
+                    node.net.publish_attestation(att, subnet)
+                    published += 1
+                    published_idx.add(int(vi))
+            self.att_published += published
+            if not published:
+                continue
+
+            def pooled(n: MultiNode) -> set[int]:
+                seen: set[int] = set()
+                for bucket in n.op_pool.attestations.values():
+                    for e in bucket:
+                        if e.data.slot == slot:
+                            seen |= e.attesting_indices
+                return seen
+
+            # EVERY reachable node must pool this cluster's votes before
+            # the slot ends (cross-cluster nodes imported the fork's blocks
+            # in the propagation wait, so verification can succeed) — a
+            # vote still in flight when the next block packs would make
+            # pool contents, and so block roots, a function of thread
+            # timing instead of the seed
+            targets = [n for n in alive
+                       if n in cluster or self._reachable(owner.index, n.index)]
+            self._wait(
+                lambda: all(published_idx <= pooled(x) for x in targets),
+                self.WAIT_SECS, f"attestation fan-out at slot {slot}",
+            )
+            for x in targets:
+                x.slo.record_admitted("gossip_attestation", published)
+                x.slo.record_processed("gossip_attestation", published)
+            for n in self.nodes:
+                if n in targets:
+                    continue
+                reason = self._blocked_reason(n.index)
+                n.slo.record_admitted("gossip_attestation", published)
+                n.slo.record_shed(
+                    "gossip_attestation", f"netfault_{reason}", published
+                )
+
+    # ------------------------------------------------------------ checks
+
+    def heads_agree(self, among: list[MultiNode] | None = None) -> bool:
+        nodes = among if among is not None else [
+            n for n in self.nodes if self._alive(n.index)
+        ]
+        return len({n.head for n in nodes}) == 1
+
+    def canonical_roots(self, node: MultiNode) -> set[bytes]:
+        """Roots on the node's canonical chain (orphan detection)."""
+        out = set()
+        root = node.head
+        for _ in range(4096):
+            out.add(root)
+            blk = node.chain.store.get_block(
+                root, types_for_slot(self.spec, node.chain.block_slots.get(
+                    root, 0))
+            )
+            if blk is None:
+                break
+            parent = bytes(blk.message.parent_root)
+            if parent == root or parent == b"\x00" * 32:
+                break
+            root = parent
+        return out
+
+    def close(self) -> None:
+        for n in self.nodes:
+            n.net.close()
+
+
+# ---------------------------------------------------------------- runner
+
+
+def _node_slo_block(node: MultiNode) -> dict:
+    """Per-node service-level summary for the scenario report."""
+    reports = [r for r in node.slo.recent if not r.empty]
+    hits = sum(r.hits for r in reports)
+    misses = sum(r.misses for r in reports)
+    total = hits + misses
+    return {
+        "deadline_hits": hits,
+        "deadline_misses": misses,
+        "deadline_hit_ratio": round(hits / total, 4) if total else None,
+        "per_slot": [
+            {
+                "slot": r.slot,
+                "deadline_hit_ratio": (
+                    None if r.hit_ratio() is None else round(r.hit_ratio(), 4)
+                ),
+                "processed": r.processed,
+                "shed": r.shed,
+            }
+            for r in reports
+        ],
+        "windows": {
+            name: node.slo.window_summary(name) for name in node.slo.windows
+        },
+    }
+
+
+def _drive_catchup(mh: MultiNodeHarness, sc: MultiNodeScenario,
+                   inj: NetFaultInjector, log_fn=None) -> dict:
+    """The sync_catchup leg: attach the behind node, wrap its sync peers in
+    the fault plan, and drive range sync synchronously to head."""
+    behind = mh.nodes[sc.catchup_node]
+    reference = next(n for n in mh.nodes if mh._alive(n.index))
+    target_head = reference.head
+    target_slot = int(reference.chain.head_state().slot)
+    behind.chain.slot_clock.set_slot(mh.slot)
+    with behind.net._lock:
+        behind.chain.per_slot_task()
+    mh.attach(sc.catchup_node)
+    sm = behind.net.sync
+    # deterministic peer order (handshakes land on racing threads), then
+    # the fault plan wraps every peer's Req/Resp surface
+    ordered = sorted(sm.peers, key=lambda pid: mh.id_map[pid])
+    sm.peers = {
+        pid: FaultyPeer(sm.peers[pid], inj, mh.id_map[pid], behind.index)
+        for pid in ordered
+    }
+    sm.peer_status = {pid: sm.peer_status[pid] for pid in ordered}
+    sm.sleep_fn = lambda _s: None      # backoffs recorded, not slept
+    if log_fn is not None:
+        log_fn(f"catchup: node{behind.index} syncing from slot "
+               f"{behind.chain.head_state().slot} to {target_slot}")
+    imported = sm.sync()
+    reached = behind.head == target_head
+    return {
+        "node": behind.index,
+        "behind_slots": target_slot,
+        "imported_blocks": imported,
+        "reached_head": reached,
+        "head": behind.head.hex()[:8],
+        "target_head": target_head.hex()[:8],
+        "stats": sm.stats,
+        "backoffs": len(sm.backoffs_taken),
+        "final_state": sm.state.value,
+    }
+
+
+def run_multinode_scenario(sc: MultiNodeScenario, out_path: str | None = None,
+                           log_fn=None, datadir: str | None = None) -> dict:
+    """Run one multi-node scenario to completion; returns (and optionally
+    writes) the machine-readable report. CPU-only (fake BLS backend over
+    the minimal spec), seconds at smoke scale."""
+    bls.set_backend("fake")
+    spec = minimal_spec()
+    t_wall = time.time()
+    datadir = datadir or tempfile.mkdtemp(prefix="loadgen-net-")
+    incident_dir = os.path.join(datadir, "incidents")
+    plan = NetFaultPlan(
+        partitions=tuple(sc.partitions),
+        links=tuple(sc.links),
+        rpc_faults=tuple(sc.rpc_faults),
+        churn=tuple(sc.churn),
+        equivocations=tuple(sc.equivocations),
+    )
+    RECORDER.reset()
+    inj = NetFaultInjector(plan, sc.n_nodes, recorder=RECORDER)
+    mh = MultiNodeHarness(
+        spec, sc.n_nodes, sc.n_validators, subnets=sc.subnets, seed=sc.seed,
+        injector=inj, attest=sc.attest, slasher=sc.slasher,
+        detached=(sc.catchup_node,) if sc.catchup_node is not None else (),
+        rpc_timeout=sc.rpc_timeout, validator_split=sc.validator_split,
+    )
+    RECORDER.configure(incident_dir=incident_dir,
+                       clock=mh.nodes[0].chain.slot_clock,
+                       slo_provider=mh.nodes[0].slo.snapshot)
+    sync_block = None
+    try:
+        for _ in range(sc.slots):
+            entry = mh.run_slot()
+            if log_fn is not None:
+                heads = len({v for v in entry["heads"].values()})
+                log_fn(f"slot {entry['slot']}: clusters={entry['clusters']} "
+                       f"distinct_heads={heads}")
+        if sc.catchup_node is not None:
+            sync_block = _drive_catchup(mh, sc, inj, log_fn=log_fn)
+            for _ in range(sc.post_slots):
+                entry = mh.run_slot()
+                if log_fn is not None:
+                    log_fn(f"slot {entry['slot']} (post-catchup): "
+                           f"heads={sorted(set(entry['heads'].values()))}")
+    finally:
+        try:
+            mh.close()
+        finally:
+            RECORDER.configure(incident_dir=None, clock=None,
+                               slo_provider=None)
+
+    # -------- convergence verdict
+    heal_slot = max(
+        [p.heal_slot for p in plan.partitions]
+        + [c.up_slot for c in plan.churn] + [0]
+    )
+    converged_at = None
+    for entry in mh.per_slot:
+        if entry["slot"] < heal_slot:
+            continue
+        alive_heads = {
+            head for idx, head in entry["heads"].items()
+            if int(idx) not in entry["down"]
+            and int(idx) not in entry["detached"]
+        }
+        if len(alive_heads) == 1:
+            converged_at = entry["slot"]
+            break
+    final = mh.per_slot[-1] if mh.per_slot else {"heads": {}}
+    within_k = (
+        converged_at is not None
+        and converged_at - heal_slot <= sc.converge_slots
+    )
+    convergence = {
+        "heal_slot": heal_slot,
+        "converge_slots": sc.converge_slots,
+        "converged_at_slot": converged_at,
+        "within_k": within_k,
+        "final_heads": final["heads"],
+    }
+
+    # -------- delivery conservation: nothing lost without a counted reason
+    blocks = dict(mh.blocks)
+    blocks["conservation_ok"] = (
+        blocks["deliveries_expected"]
+        == blocks["delivered"] + sum(blocks["blocked"].values())
+    )
+
+    # -------- fork/orphan accounting (fork_reorg)
+    alive_nodes = [n for n in mh.nodes if mh._alive(n.index)]
+    canonical = mh.canonical_roots(alive_nodes[0]) if alive_nodes else set()
+    produced_roots = [
+        bytes.fromhex(b["root"]) for e in mh.per_slot for b in e["blocks"]
+        if "root" in b
+    ]
+    orphaned = sum(
+        1 for r in produced_roots
+        if not any(c.startswith(r) for c in canonical)
+    )
+
+    # -------- equivocation verdict
+    equiv_block = {
+        "injected": len(plan.equivocations),
+        "published": mh.equivocations_published,
+        "detections_by_node": {
+            str(n.index): n.detections for n in mh.nodes if n.detections
+        },
+        "slashed_in_final_state": [],
+    }
+    if alive_nodes and mh.equivocations_published:
+        final_state = alive_nodes[0].chain.head_state()
+        for ev in mh.equivocations_published:
+            p = ev["proposer"]
+            if p < len(final_state.validators) and bool(
+                final_state.validators[p].slashed
+            ):
+                equiv_block["slashed_in_final_state"].append(p)
+
+    # -------- scenario verdict
+    failures: list[str] = []
+    if plan.partitions or plan.churn:
+        if not within_k:
+            failures.append(
+                f"nodes diverged: no single head within "
+                f"{sc.converge_slots} slots of heal "
+                f"(converged_at={converged_at})"
+            )
+    elif not mh.heads_agree():
+        failures.append("alive nodes ended on different heads")
+    if not blocks["conservation_ok"]:
+        failures.append("block delivery conservation violated")
+    if sc.expect_reorg and orphaned == 0:
+        failures.append("no block was orphaned: the partition never forced "
+                        "a reorg")
+    if sc.catchup_node is not None:
+        if sync_block is None or not sync_block["reached_head"]:
+            failures.append("catchup node never reached the target head")
+        else:
+            st = sync_block["stats"]
+            if not (st["failovers"] >= 1 and st["batch_retries"] >= 1):
+                failures.append(
+                    "injected batch stall never exercised retry/failover "
+                    f"(stats={st})"
+                )
+    if plan.equivocations:
+        detected = sum(n.detections for n in mh.nodes)
+        if len(mh.equivocations_published) < len(plan.equivocations):
+            failures.append(
+                f"only {len(mh.equivocations_published)}/"
+                f"{len(plan.equivocations)} equivocations published "
+                "(proposer unreachable at a scheduled slot)"
+            )
+        if detected < len(mh.equivocations_published):
+            failures.append(
+                f"slasher detected {detected} < "
+                f"{len(mh.equivocations_published)} published equivocations"
+            )
+    ok = not failures
+
+    deterministic = {
+        "per_slot": mh.per_slot,
+        "blocks": blocks,
+        "attestations_published": mh.att_published,
+        "orphaned_blocks": orphaned,
+        "netfault_events": inj.counts["events"],
+        "rpc_faults": inj.counts["rpc"],
+        "convergence": convergence,
+        "sync": sync_block,
+        "equivocation": equiv_block,
+        "failures": failures,
+        "ok": ok,
+    }
+    report = {
+        "scenario": sc.name,
+        "seed": sc.seed,
+        "multinode": True,
+        "slots": mh.slot,
+        "n_nodes": sc.n_nodes,
+        "n_validators": sc.n_validators,
+        "fault_plan": plan.as_dict(),
+        "ok": ok,
+        "failures": failures,
+        "deterministic": deterministic,
+        # wall-clock-shaped observations: OUTSIDE the determinism contract
+        # (gossip counts include heartbeat/control frames)
+        "netfaults_observed": {"gossip": dict(inj.counts["gossip"])},
+        "slo": {
+            "per_node": {
+                str(n.index): _node_slo_block(n) for n in mh.nodes
+            },
+            "incident_dir": incident_dir,
+            "incidents": [
+                os.path.basename(p) for p in RECORDER.incidents_written
+            ],
+        },
+        "elapsed_secs": round(time.time() - t_wall, 3),
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=1)
+    return report

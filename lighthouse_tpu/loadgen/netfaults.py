@@ -1,0 +1,440 @@
+"""Network fault injection: partitions, lossy links, silent peers, churn,
+equivocation — the network analog of `storefaults.FaultyKVStore`.
+
+A `NetFaultPlan` declares WHEN and HOW the network misbehaves, keyed on
+logical slots so every run of a seeded scenario sees the identical fault
+sequence. A `NetFaultInjector` evaluates the plan as the slot clock
+advances and exposes decision surfaces the real networking layers consult:
+
+  - `FaultyGossipSend` wraps a node's gossipsub send callback (the
+    function `Gossipsub` hands encoded RPC frames to — in production the
+    transport's `send_gossip`, i.e. a real TCP frame write). A frame to an
+    unreachable peer (partition / churned-down node) or one eaten by a
+    lossy link is dropped BEFORE the wire with a counted reason; a delayed
+    link queues the frame and the injector flushes it at the next slot
+    tick (slot-quantized latency, deterministic by construction).
+  - `FaultyPeer` wraps any Req/Resp `handle()` surface (RpcHandler,
+    transport.RemotePeer) with the plan's RPC faults: a "silent" peer
+    raises the same `TransportError("request timeout")` a wedged socket
+    produces (without consuming wall-clock), a "torn" peer serves half its
+    response chunks then goes silent, an "empty" peer answers cleanly with
+    nothing. This is what forces `SyncManager`'s retry/backoff/failover
+    and `BackFillSync`'s window widening for real.
+  - `gossip.InProcessGossipRouter(fault_filter=...)` takes the injector's
+    `router_filter` for single-process rigs that never open a socket.
+
+Every eaten/delayed message is counted in the labeled `netfault_*` metric
+families and in the injector's deterministic per-run `counts` dict; every
+partition/heal/churn transition lands as a flight-recorder event — "no
+message lost without a counted reason" is the invariant the multi-node
+scenarios assert.
+
+Node identity is by INDEX into the harness's node list; `id_map` maps the
+transport-level peer ids (node_id strings) back to indices.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..utils.logging import get_logger
+from ..utils.metrics import REGISTRY
+from .faults import FaultInjector
+
+log = get_logger("netfaults")
+
+NETFAULT_MESSAGES = REGISTRY.counter_vec(
+    "netfault_messages_total",
+    "messages the fault injector acted on, by fault kind "
+    "(partition / churn / drop / delay / rpc_silent / rpc_torn / "
+    "rpc_empty) and scope (gossip / rpc)",
+    ("fault", "scope"),
+)
+NETFAULT_EVENTS = REGISTRY.counter_vec(
+    "netfault_events_total",
+    "fault-plan transitions fired, by kind (partition_start / "
+    "partition_heal / churn_down / churn_up / equivocation)",
+    ("kind",),
+)
+
+
+class InjectedTimeout(Exception):
+    """Raised by FaultyPeer for a silent/stalled peer — duck-types the
+    transport's request-timeout failure without consuming wall-clock."""
+
+
+@dataclass(frozen=True)
+class Partition:
+    """Nodes split into isolated groups over [start_slot, heal_slot).
+    Nodes not listed in any group form one implicit extra group."""
+
+    start_slot: int
+    heal_slot: int
+    groups: tuple[tuple[int, ...], ...]
+
+
+@dataclass(frozen=True)
+class LinkFault:
+    """A lossy/slow directed link over [start_slot, end_slot). `src`/`dst`
+    None match any node. Deterministic by construction: `drop_every=k`
+    drops every k-th frame crossing the link in its window (counter-based,
+    no RNG in the hot path), `delay_slots` holds frames until that many
+    slot ticks later."""
+
+    src: int | None = None
+    dst: int | None = None
+    start_slot: int = 0
+    end_slot: int | None = None
+    drop_every: int = 0
+    delay_slots: int = 0
+
+    def active(self, slot: int) -> bool:
+        return slot >= self.start_slot and (
+            self.end_slot is None or slot < self.end_slot
+        )
+
+    def matches(self, src: int, dst: int) -> bool:
+        return (self.src is None or self.src == src) and (
+            self.dst is None or self.dst == dst
+        )
+
+
+@dataclass(frozen=True)
+class RpcFault:
+    """Node `server`'s served Req/Resp misbehaves over [start_slot,
+    end_slot): "silent" = request times out (stalled peer), "torn" = half
+    the response chunks then silence, "empty" = clean empty response
+    (exercises BackFillSync widening / lying-peer ejection)."""
+
+    server: int
+    start_slot: int
+    end_slot: int
+    mode: str = "silent"            # silent | torn | empty
+    protocols: tuple[str, ...] = () # empty = all protocols
+    max_hits: int | None = None     # stop faulting after N requests
+
+    def active(self, slot: int) -> bool:
+        return self.start_slot <= slot < self.end_slot
+
+
+@dataclass(frozen=True)
+class Churn:
+    """Node drops off the network at down_slot, redials at up_slot."""
+
+    node: int
+    down_slot: int
+    up_slot: int
+
+
+@dataclass(frozen=True)
+class Equivocation:
+    """The proposer of `slot` signs and publishes TWO conflicting blocks;
+    honest nodes must reject the second and route both signed headers
+    through the slasher."""
+
+    slot: int
+
+
+@dataclass
+class NetFaultPlan:
+    """The full declarative fault schedule for one scenario run."""
+
+    partitions: tuple[Partition, ...] = ()
+    links: tuple[LinkFault, ...] = ()
+    rpc_faults: tuple[RpcFault, ...] = ()
+    churn: tuple[Churn, ...] = ()
+    equivocations: tuple[Equivocation, ...] = ()
+
+    def as_dict(self) -> dict:
+        """JSON-serializable plan description for the scenario report."""
+        return {
+            "partitions": [
+                {"start_slot": p.start_slot, "heal_slot": p.heal_slot,
+                 "groups": [list(g) for g in p.groups]}
+                for p in self.partitions
+            ],
+            "links": [
+                {"src": lf.src, "dst": lf.dst, "start_slot": lf.start_slot,
+                 "end_slot": lf.end_slot, "drop_every": lf.drop_every,
+                 "delay_slots": lf.delay_slots}
+                for lf in self.links
+            ],
+            "rpc_faults": [
+                {"server": r.server, "start_slot": r.start_slot,
+                 "end_slot": r.end_slot, "mode": r.mode,
+                 "protocols": list(r.protocols), "max_hits": r.max_hits}
+                for r in self.rpc_faults
+            ],
+            "churn": [
+                {"node": c.node, "down_slot": c.down_slot,
+                 "up_slot": c.up_slot}
+                for c in self.churn
+            ],
+            "equivocations": [
+                {"slot": e.slot} for e in self.equivocations
+            ],
+        }
+
+
+class NetFaultInjector:
+    """Evaluates a NetFaultPlan against the advancing slot clock.
+
+    `on_slot(slot)` drives the schedule: it flushes due delayed frames,
+    emits partition/heal/churn transition events (flight recorder +
+    netfault_events_total), and leaves the injector's decision surfaces
+    (`reachable`, `gossip_decision`, `rpc_mode`) answering for the new
+    slot. All counters in `counts` are deterministic per (plan, drive
+    sequence)."""
+
+    def __init__(self, plan: NetFaultPlan, n_nodes: int, recorder=None):
+        self.plan = plan
+        self.n_nodes = n_nodes
+        self.recorder = recorder
+        self.slot = -1
+        self.down: set[int] = set()
+        # per-fault per-link frame counters for drop_every, keyed
+        # (fault index, src, dst): two overlapping LinkFaults matching the
+        # same link must each keep their own cadence
+        self._link_seen: dict[tuple[int, int, int], int] = {}
+        # delayed frames: release_slot -> [thunk]
+        self._delayed: dict[int, list] = {}
+        # per-RpcFault hit counters (index into plan.rpc_faults)
+        self._rpc_hits: dict[int, int] = {}
+        self.counts = {
+            "gossip": {},       # reason -> frames eaten/delayed pre-wire
+            "rpc": {},          # reason -> requests faulted
+            "events": [],       # ordered (slot, kind, detail) transitions
+        }
+        # the event board reuses loadgen's slot-triggered one-shot engine
+        self._board = FaultInjector()
+        for p in plan.partitions:
+            self._board.at(p.start_slot, lambda p=p: self._event(
+                "partition_start", groups=[list(g) for g in p.groups]))
+            self._board.at(p.heal_slot, lambda p=p: self._event(
+                "partition_heal", groups=[list(g) for g in p.groups]))
+        for c in plan.churn:
+            self._board.at(c.down_slot, lambda c=c: self._event(
+                "churn_down", node=c.node))
+            self._board.at(c.up_slot, lambda c=c: self._event(
+                "churn_up", node=c.node))
+        for e in plan.equivocations:
+            self._board.at(e.slot, lambda e=e: self._event(
+                "equivocation", slot=e.slot))
+
+    # ------------------------------------------------------------ schedule
+
+    def _event(self, kind: str, **detail) -> None:
+        NETFAULT_EVENTS.labels(kind).inc()
+        self.counts["events"].append({"slot": self.slot, "kind": kind,
+                                      **detail})
+        log.warn("netfault transition", kind=kind, at_slot=self.slot, **{
+            k: str(v) for k, v in detail.items() if k != "slot"})
+        if self.recorder is not None:
+            self.recorder.record(f"netfault_{kind}", severity="warn",
+                                 **detail)
+
+    def on_slot(self, slot: int) -> None:
+        """Advance the schedule to `slot`: transition events fire, churned
+        node state updates, and due delayed frames flush (in send order —
+        slot-quantized latency, not reordering; a link that should reorder
+        can use two different delay_slots)."""
+        self.slot = slot
+        self._board.on_slot(slot)
+        self.down = {
+            c.node for c in self.plan.churn
+            if c.down_slot <= slot < c.up_slot
+        }
+        for release in sorted(s for s in self._delayed if s <= slot):
+            for thunk in self._delayed.pop(release):
+                try:
+                    thunk()
+                except Exception as e:  # noqa: BLE001 — a dead conn is fine
+                    log.warn("delayed frame delivery failed",
+                             error=f"{type(e).__name__}: {e}")
+
+    # ----------------------------------------------------------- decisions
+
+    def partition_of(self, node: int, slot: int | None = None) -> int:
+        """Group index of `node` under the partition active at `slot`
+        (-1 = no partition active)."""
+        slot = self.slot if slot is None else slot
+        for p in self.plan.partitions:
+            if p.start_slot <= slot < p.heal_slot:
+                for gi, group in enumerate(p.groups):
+                    if node in group:
+                        return gi
+                return len(p.groups)        # implicit leftover group
+        return -1
+
+    def reachable(self, a: int, b: int, slot: int | None = None) -> bool:
+        """Can a frame flow between nodes a and b right now? False while
+        either is churned down or a partition separates them."""
+        if a in self.down or b in self.down:
+            return False
+        return self.partition_of(a, slot) == self.partition_of(b, slot)
+
+    def _count(self, scope: str, reason: str) -> None:
+        NETFAULT_MESSAGES.labels(reason, scope).inc()
+        bucket = self.counts[scope]
+        bucket[reason] = bucket.get(reason, 0) + 1
+
+    def gossip_decision(self, src: int, dst: int):
+        """Decision for one gossip frame src -> dst: None = deliver,
+        ("drop", reason) = eat it, ("delay", slots) = queue it."""
+        if src in self.down or dst in self.down:
+            self._count("gossip", "churn")
+            return ("drop", "churn")
+        if self.partition_of(src) != self.partition_of(dst):
+            self._count("gossip", "partition")
+            return ("drop", "partition")
+        # every active matching fault OBSERVES every frame (its cadence
+        # counter advances) before any decision returns, so overlapping
+        # faults on one link keep independent, seed-stable cadences
+        decision = None
+        for li, lf in enumerate(self.plan.links):
+            if not (lf.active(self.slot) and lf.matches(src, dst)):
+                continue
+            if lf.drop_every:
+                key = (li, src, dst)
+                self._link_seen[key] = self._link_seen.get(key, 0) + 1
+                if self._link_seen[key] % lf.drop_every == 0:
+                    decision = ("drop", "drop")
+            if lf.delay_slots and decision is None:
+                decision = ("delay", lf.delay_slots)
+        if decision is not None:
+            self._count(
+                "gossip", "drop" if decision[0] == "drop" else "delay"
+            )
+        return decision
+
+    def queue_delayed(self, release_slot: int, thunk) -> None:
+        self._delayed.setdefault(release_slot, []).append(thunk)
+
+    def rpc_mode(self, server: int, protocol: str) -> str | None:
+        """Active RPC fault mode for a request SERVED by `server`, or None.
+        Partition/churn unreachability is the caller's (FaultyPeer's)
+        concern — this answers only for the scripted server faults."""
+        for i, rf in enumerate(self.plan.rpc_faults):
+            if rf.server != server or not rf.active(self.slot):
+                continue
+            if rf.protocols and str(protocol) not in rf.protocols:
+                continue
+            hits = self._rpc_hits.get(i, 0)
+            if rf.max_hits is not None and hits >= rf.max_hits:
+                continue
+            self._rpc_hits[i] = hits + 1
+            return rf.mode
+        return None
+
+    # -------------------------------------------------- router integration
+
+    def router_filter(self, id_map: dict[str, int]):
+        """A `fault_filter` for gossip.InProcessGossipRouter: maps the
+        router's peer-id strings through `id_map` and answers drop reasons
+        (the in-process rigs have no delay queue — delays degrade to
+        delivery, partitions/drops are honored)."""
+
+        def fault_filter(source_peer: str, dest_peer: str, topic: str):
+            src, dst = id_map.get(source_peer), id_map.get(dest_peer)
+            if src is None or dst is None:
+                return None
+            decision = self.gossip_decision(src, dst)
+            if decision is not None and decision[0] == "drop":
+                return decision[1]
+            return None
+
+        return fault_filter
+
+
+class FaultyGossipSend:
+    """Wraps one node's gossipsub send callback with the fault plan.
+
+    Install with `FaultyGossipSend.install(node, injector, idx, id_map)`:
+    the node's `Gossipsub._send_raw` is replaced, so every encoded RPC
+    frame — publishes, forwards, control traffic — passes the injector
+    before reaching the real TCP connection. Dropped frames never hit the
+    wire; delayed frames are queued on the injector and flushed at a later
+    slot tick."""
+
+    def __init__(self, injector: NetFaultInjector, src_idx: int,
+                 id_map: dict[str, int], inner_send):
+        self.injector = injector
+        self.src_idx = src_idx
+        self.id_map = id_map
+        self.inner_send = inner_send
+
+    def __call__(self, peer_id: str, rpc_bytes: bytes) -> None:
+        dst = self.id_map.get(peer_id)
+        if dst is None:
+            return self.inner_send(peer_id, rpc_bytes)
+        decision = self.injector.gossip_decision(self.src_idx, dst)
+        if decision is None:
+            return self.inner_send(peer_id, rpc_bytes)
+        kind, arg = decision
+        if kind == "delay":
+            inner, pid, data = self.inner_send, peer_id, rpc_bytes
+            self.injector.queue_delayed(
+                self.injector.slot + arg, lambda: inner(pid, data)
+            )
+        # "drop": the frame is eaten with its reason already counted
+
+    @classmethod
+    def install(cls, node, injector: NetFaultInjector, src_idx: int,
+                id_map: dict[str, int]):
+        wrapped = cls(injector, src_idx, id_map, node.gossipsub._send_raw)
+        node.gossipsub._send_raw = wrapped
+        return wrapped
+
+
+class FaultyPeer:
+    """Wraps a Req/Resp peer handle with the plan's RPC faults — the
+    `FaultyKVStore` of the network: same interface, scriptable failure.
+
+    `server_idx`/`client_idx` locate the link: partition/churn
+    unreachability raises the injected timeout exactly like a dead socket,
+    and the server's scripted fault modes apply on top."""
+
+    def __init__(self, inner, injector: NetFaultInjector, server_idx: int,
+                 client_idx: int):
+        self.inner = inner
+        self.injector = injector
+        self.server_idx = server_idx
+        self.client_idx = client_idx
+
+    def handle(self, peer_id: str, protocol, request_bytes: bytes,
+               timeout: float | None = None) -> list[bytes]:
+        inj = self.injector
+        if not inj.reachable(self.client_idx, self.server_idx):
+            reason = (
+                "churn" if (self.server_idx in inj.down
+                            or self.client_idx in inj.down)
+                else "partition"
+            )
+            inj._count("rpc", reason)
+            raise InjectedTimeout(
+                f"request timeout (injected: {reason} blocks "
+                f"node{self.client_idx} -> node{self.server_idx})"
+            )
+        proto = protocol.value if hasattr(protocol, "value") else str(protocol)
+        mode = inj.rpc_mode(self.server_idx, proto)
+        if mode == "silent":
+            inj._count("rpc", "rpc_silent")
+            raise InjectedTimeout(
+                f"request timeout (injected: node{self.server_idx} "
+                f"silent on {proto})"
+            )
+        chunks = self.inner.handle(peer_id, protocol, request_bytes,
+                                   timeout=timeout)
+        if mode == "torn":
+            inj._count("rpc", "rpc_torn")
+            # the peer streamed half the response then went silent: the
+            # caller's read deadline fires with partial data lost
+            raise InjectedTimeout(
+                f"request timeout (injected: node{self.server_idx} "
+                f"stalled mid-response after {len(chunks) // 2}/"
+                f"{len(chunks)} chunks on {proto})"
+            )
+        if mode == "empty":
+            inj._count("rpc", "rpc_empty")
+            return []
+        return chunks

@@ -152,8 +152,141 @@ def get_scenario(name: str, **overrides) -> Scenario:
     """A named scenario, optionally with field overrides (CLI flags)."""
     base = SCENARIOS.get(name)
     if base is None:
+        have = sorted(SCENARIOS) + sorted(MULTINODE_SCENARIOS)
         raise KeyError(
-            f"unknown scenario {name!r} (have: {', '.join(sorted(SCENARIOS))})"
+            f"unknown scenario {name!r} (have: {', '.join(have)})"
         )
     overrides = {k: v for k, v in overrides.items() if v is not None}
     return replace(base, **overrides) if overrides else replace(base)
+
+
+# ------------------------------------------------------------- multi-node
+
+
+@dataclass
+class MultiNodeScenario:
+    """A scenario over N full BeaconChain+NetworkNode stacks under a
+    network fault plan (loadgen/multinode.py + netfaults.py). These run on
+    the MINIMAL spec with the fake BLS backend: the subject is the
+    network — forks, partitions, sync, slashing — not the device path, so
+    every family is CPU-sized (seconds) by construction and `--smoke` is a
+    clamp, not a reshape."""
+
+    name: str
+    n_nodes: int = 4
+    n_validators: int = 64
+    slots: int = 12
+    seed: int = 0xC0FFEE
+    subnets: int = 2
+    #: publish per-validator attestations (the weight fork choice needs to
+    #: resolve competing forks); off for families that don't fork
+    attest: bool = True
+    #: attach a SlasherService to every node (equivocation_storm)
+    slasher: bool = False
+    #: K: slots after the last heal within which all alive nodes must
+    #: agree on one head, or the scenario FAILS
+    converge_slots: int = 4
+    #: fault plan pieces (loadgen/netfaults.py dataclasses)
+    partitions: tuple = ()
+    links: tuple = ()
+    rpc_faults: tuple = ()
+    churn: tuple = ()
+    equivocations: tuple = ()
+    #: sync_catchup: this node starts detached and range-syncs after
+    #: `slots`, then `post_slots` more live slots run with it attached
+    catchup_node: int | None = None
+    post_slots: int = 2
+    #: p2p Req/Resp budget for the in-sim nodes (small: injected faults
+    #: raise immediately, real requests are localhost)
+    rpc_timeout: float = 2.0
+    #: validators owned per node (None = even split); must sum to
+    #: n_validators — fork_reorg uses an uneven split so the healed fork
+    #: race has a decisive majority
+    validator_split: tuple | None = None
+    #: fail the run unless >=1 produced block ends up orphaned (the
+    #: fork_reorg acceptance: a reorg actually happened)
+    expect_reorg: bool = False
+
+
+def _multinode_scenarios() -> dict[str, MultiNodeScenario]:
+    from .netfaults import Equivocation, Partition, RpcFault
+
+    return {
+        # 3-vs-1 partition mid-run: the minority node forks or stalls,
+        # the heal must reconverge every head within K slots through
+        # parent lookups + attestation-weighted fork choice
+        "partition_heal": MultiNodeScenario(
+            name="partition_heal", n_nodes=4, n_validators=64, slots=12,
+            partitions=(Partition(start_slot=4, heal_slot=8,
+                                  groups=((0, 1, 2), (3,))),),
+            converge_slots=4,
+        ),
+        # 2-vs-2 node split with UNEVEN stake (48 vs 16 validators) held
+        # long enough that BOTH sides grow a fork: the heal forces a real
+        # reorg — the minority fork's blocks end up orphaned (the run
+        # fails unless >=1 block is reorged out) before convergence
+        "fork_reorg": MultiNodeScenario(
+            name="fork_reorg", n_nodes=4, n_validators=64, slots=16,
+            validator_split=(24, 24, 8, 8),
+            partitions=(Partition(start_slot=4, heal_slot=10,
+                                  groups=((0, 1), (2, 3))),),
+            converge_slots=5, expect_reorg=True,
+        ),
+        # a node started behind range-syncs to head while the first peer
+        # it targets stalls silently mid-range: SyncManager must time out,
+        # blame, back off, and fail over to an alternate peer
+        "sync_catchup": MultiNodeScenario(
+            name="sync_catchup", n_nodes=4, n_validators=32, slots=8,
+            attest=False, catchup_node=3, post_slots=2,
+            rpc_faults=(RpcFault(
+                server=0, start_slot=0, end_slot=10**9, mode="silent",
+                protocols=(
+                    "/eth2/beacon_chain/req/beacon_blocks_by_range/2/"
+                    "ssz_snappy",
+                ),
+            ),),
+        ),
+        # repeated double-proposals: every honest node must reject the
+        # second block, route both signed headers through its slasher,
+        # and the assembled ProposerSlashings must reach later blocks
+        "equivocation_storm": MultiNodeScenario(
+            name="equivocation_storm", n_nodes=4, n_validators=64,
+            slots=12, attest=False, slasher=True,
+            equivocations=(Equivocation(slot=3), Equivocation(slot=6),
+                           Equivocation(slot=9)),
+        ),
+    }
+
+
+#: lazily built (netfaults imports the metrics registry; keep module
+#: import as light as the CLI parser expects)
+MULTINODE_SCENARIOS: dict[str, MultiNodeScenario] = {}
+
+
+def _ensure_multinode() -> dict[str, MultiNodeScenario]:
+    if not MULTINODE_SCENARIOS:
+        MULTINODE_SCENARIOS.update(_multinode_scenarios())
+    return MULTINODE_SCENARIOS
+
+
+def is_multinode(name: str) -> bool:
+    return name in _ensure_multinode()
+
+
+def get_multinode_scenario(name: str, **overrides) -> MultiNodeScenario:
+    base = _ensure_multinode().get(name)
+    if base is None:
+        raise KeyError(f"unknown multi-node scenario {name!r}")
+    overrides = {k: v for k, v in overrides.items() if v is not None}
+    return replace(base, **overrides) if overrides else replace(base)
+
+
+def multinode_smoke_variant(sc: MultiNodeScenario) -> MultiNodeScenario:
+    """Multi-node scenarios are CPU-sized by construction; `--smoke` only
+    clamps an operator override back into the seconds range without
+    changing the fault plan (the plan IS the scenario's shape)."""
+    return replace(
+        sc,
+        n_validators=min(sc.n_validators, 64),
+        slots=min(sc.slots, 16),
+    )

@@ -110,15 +110,23 @@ class InProcessGossipRouter:
     were always free no-ops and must not drain tokens), before delivery —
     the in-process analog of the TCP node's `--gossip-ingest-rate`. Scopes
     follow `ingest_scope`; shed messages count in `rate_limited` and stay
-    un-seen, so a later re-publish can retry."""
+    un-seen, so a later re-publish can retry.
 
-    def __init__(self, ingest_limiter=None):
+    `fault_filter(source_peer, dest_peer, topic) -> reason|None` (optional,
+    see loadgen/netfaults.NetFaultInjector.router_filter) vetoes individual
+    deliveries — the in-process analog of a partitioned or lossy link.
+    Vetoed deliveries count per reason in `faulted`, so no message is lost
+    without a counted cause."""
+
+    def __init__(self, ingest_limiter=None, fault_filter=None):
         self.subscriptions: dict[str, list] = defaultdict(list)   # topic -> [(peer_id, handler)]
         self.seen: set[bytes] = set()
         self.delivered = 0
         self.dropped = 0
         self.rate_limited = 0
         self.ingest_limiter = ingest_limiter
+        self.fault_filter = fault_filter
+        self.faulted: dict[str, int] = {}
 
     def subscribe(self, peer_id: str, topic: str, handler) -> None:
         self.subscriptions[topic].append((peer_id, handler))
@@ -148,6 +156,11 @@ class InProcessGossipRouter:
         for peer_id, handler in list(self.subscriptions.get(topic, [])):
             if peer_id == source_peer:
                 continue
+            if self.fault_filter is not None:
+                reason = self.fault_filter(source_peer, peer_id, topic)
+                if reason is not None:
+                    self.faulted[reason] = self.faulted.get(reason, 0) + 1
+                    continue
             ok = handler(msg)
             if ok:
                 count += 1

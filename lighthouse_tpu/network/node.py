@@ -58,6 +58,7 @@ class NetworkNode:
         batch_gossip: bool = True,
         processor_config=None,
         ingest_rate: float | None = None,
+        rpc_timeout: float | None = None,
     ):
         self.chain = chain
         chain._network_node = self          # identity/peers API surface
@@ -105,7 +106,17 @@ class NetworkNode:
         self.op_pool = op_pool
         self.peer_manager = PeerManager()
         self.rpc = RpcHandler(chain, fork_digest)
-        self.sync = SyncManager(chain)
+        # Req/Resp round-trip budget: explicit arg > env > 10 s default.
+        # One resolution feeds both the transport's default and the sync
+        # manager's per-batch deadlines.
+        if rpc_timeout is None:
+            import os as _os
+
+            env = _os.environ.get("LIGHTHOUSE_TPU_RPC_TIMEOUT")
+            rpc_timeout = float(env) if env else 10.0
+        self.rpc_timeout = float(rpc_timeout)
+        self.sync = SyncManager(chain, request_timeout=self.rpc_timeout,
+                                on_peer_failure=self._on_sync_peer_failure)
         self.gossipsub = Gossipsub(
             node_id,
             self._gossip_send,
@@ -117,7 +128,7 @@ class NetworkNode:
         # rejected instead of served unencrypted
         self.require_encryption = require_encryption
         self.host = TcpHost(self, node_id, host=listen_host, port=port,
-                            encrypt=encrypt)
+                            encrypt=encrypt, rpc_timeout=self.rpc_timeout)
         self.heartbeat_interval = heartbeat_interval
         self._hb_stop = threading.Event()
         # the heartbeat runs supervised: a crash of the LOOP (not a caught
@@ -214,6 +225,15 @@ class NetworkNode:
     def connect(self, other: "NetworkNode") -> None:
         host, port = other.host.listen_addr
         self.host.dial(host, port)
+
+    def _on_sync_peer_failure(self, peer_id: str, stage: str) -> None:
+        """SyncManager blame hook: a failed batch/backfill attempt
+        deprioritizes the peer in the connection-level peer manager, so
+        repeat offenders sink below honest peers in best_peers() selection
+        and eventually cross the disconnect/ban thresholds."""
+        from .peer_manager import PeerAction
+
+        self.peer_manager.report(peer_id, PeerAction.mid_tolerance)
 
     # ------------------------------------------------------ peer exchange
 

@@ -174,8 +174,13 @@ class RpcHandler:
             head_slot=head_state.slot,
         )
 
-    def handle(self, peer_id: str, protocol: Protocol, request_bytes: bytes) -> list[bytes]:
-        """Returns a list of encoded response chunks."""
+    def handle(self, peer_id: str, protocol: Protocol, request_bytes: bytes,
+               timeout: float | None = None) -> list[bytes]:
+        """Returns a list of encoded response chunks. `timeout` is part of
+        the shared handler surface (SyncManager passes its per-batch
+        deadline); a local in-process handler answers synchronously, so it
+        is accepted and ignored here — transport-backed peers (RemotePeer)
+        enforce it."""
         cost = 1
         if protocol == Protocol.blocks_by_range:
             req = BlocksByRangeRequest.deserialize(decode_chunk(request_bytes)[0])

@@ -42,7 +42,34 @@ import struct
 import threading
 import time
 
+from ..utils.logging import get_logger
+
+log = get_logger("transport")
+
 HELLO, REQ, RESP_CHUNK, RESP_END, GOSSIP, CLOSE, EHELLO, ENC = range(8)
+
+_CRYPTO_AVAILABLE: bool | None = None
+
+
+def crypto_available() -> bool:
+    """Whether the `cryptography` package (X25519 + AES-GCM) is importable.
+    Environments without it fall back to plaintext HELLO service — the
+    interop path the protocol already defines — with one structured warn;
+    `require_encryption` hosts still refuse plaintext peers, so the
+    fallback can never silently weaken a host that demanded encryption."""
+    global _CRYPTO_AVAILABLE
+    if _CRYPTO_AVAILABLE is None:
+        try:
+            from cryptography.hazmat.primitives.ciphers.aead import (  # noqa: F401
+                AESGCM,
+            )
+
+            _CRYPTO_AVAILABLE = True
+        except ImportError:
+            _CRYPTO_AVAILABLE = False
+            log.warn("cryptography package unavailable; p2p transport "
+                     "falls back to plaintext HELLO (no link encryption)")
+    return _CRYPTO_AVAILABLE
 
 MAX_FRAME = 16 * 1024 * 1024
 # ENC wraps an inner frame in 1 type byte + 16-byte GCM tag: the receiver
@@ -81,10 +108,14 @@ class Connection:
     """One live peer connection (either direction)."""
 
     def __init__(self, sock: socket.socket, local_id: str, node,
-                 encrypt: bool = True, dialer: bool = False):
+                 encrypt: bool = True, dialer: bool = False,
+                 rpc_timeout: float = 10.0):
         self.sock = sock
         self.node = node
         self.local_id = local_id
+        # default Req/Resp round-trip budget; request(timeout=...) overrides
+        # per call (SyncManager derives batch deadlines from batch size)
+        self.rpc_timeout = rpc_timeout
         self.peer_id: str | None = None
         # the peer's DIALABLE address: its socket IP + the listen port it
         # advertises in HELLO (the ephemeral source port is useless for
@@ -94,6 +125,19 @@ class Connection:
         self._streams: dict[int, queue.Queue] = {}
         self._next_stream = 1
         self._stream_lock = threading.Lock()
+        # Gossip frames dispatch on a dedicated per-connection thread (in
+        # arrival order), NOT inline on the reader: a gossip handler that
+        # performs a blocking Req/Resp round trip on this same connection
+        # (parent lookup for an unknown-parent block — the standard path
+        # out of a healed partition) would otherwise deadlock waiting for
+        # a response only the occupied reader thread could deliver.
+        self._gossip_q: queue.Queue = queue.Queue()
+        self._gossip_thread: threading.Thread | None = None
+        # frame counters: wire-level quiescence detection (a lock-step
+        # harness can assert sent==received across a pair before advancing
+        # its logical clock)
+        self.sent_frames = 0
+        self.recv_frames = 0
         self.alive = True
         # encryption state (see module docstring): keys exist only after
         # both EHELLOs; the dialer role fixes key directionality
@@ -139,6 +183,7 @@ class Connection:
                 write_frame(self.sock, ENC, key.encrypt(self._nonce(ctr), inner, b""))
             else:
                 write_frame(self.sock, ftype, payload)
+            self.sent_frames += 1
 
     def _hello_payload(self) -> bytes:
         ident = self.local_id.encode()
@@ -152,6 +197,8 @@ class Connection:
         return struct.pack(">H", len(ident)) + ident + struct.pack(">H", listen_port)
 
     def send_hello(self) -> None:
+        if self.encrypt and not crypto_available():
+            self.encrypt = False
         if self.encrypt:
             from cryptography.hazmat.primitives.asymmetric.x25519 import (
                 X25519PrivateKey,
@@ -174,8 +221,13 @@ class Connection:
         except OSError:
             self.close()
 
-    def request(self, protocol: str, request_bytes: bytes, timeout: float = 10.0) -> list[bytes]:
-        """Blocking Req/Resp round trip; returns response chunks."""
+    def request(self, protocol: str, request_bytes: bytes,
+                timeout: float | None = None) -> list[bytes]:
+        """Blocking Req/Resp round trip; returns response chunks. `timeout`
+        None means the connection's configured `rpc_timeout` (plumbed from
+        `bn --rpc-timeout` / LIGHTHOUSE_TPU_RPC_TIMEOUT)."""
+        if timeout is None:
+            timeout = self.rpc_timeout
         with self._stream_lock:
             sid = self._next_stream
             self._next_stream += 1
@@ -211,6 +263,7 @@ class Connection:
         try:
             while self.alive:
                 ftype, payload = read_frame(self.sock)
+                self.recv_frames += 1
                 if ftype == ENC:
                     if self._rx is None:
                         raise TransportError("ENC frame before handshake")
@@ -285,13 +338,42 @@ class Connection:
                     if q is not None:
                         q.put(None)
                 elif ftype == GOSSIP:
-                    self.node._on_gossip(self.peer_id, payload)
+                    self._dispatch_gossip(payload)
                 elif ftype == CLOSE:
                     break
         except (TransportError, OSError):
             pass
         finally:
             self.close()
+
+    def _dispatch_gossip(self, payload: bytes) -> None:
+        """Queue a gossip frame for the serial dispatcher (started lazily;
+        only the reader thread calls this, so creation cannot race)."""
+        if self._gossip_thread is None:
+            self._gossip_thread = threading.Thread(
+                target=self._gossip_loop, name="gossip-dispatch", daemon=True
+            )
+            self._gossip_thread.start()
+        self._gossip_q.put(payload)
+
+    def _gossip_loop(self) -> None:
+        while True:
+            payload = self._gossip_q.get()
+            if payload is None:
+                return
+            try:
+                self.node._on_gossip(self.peer_id, payload)
+            except Exception as e:  # noqa: BLE001 — one bad frame must not
+                log.warn("gossip dispatch failed",    # kill the dispatcher
+                         peer=str(self.peer_id),
+                         error=f"{type(e).__name__}: {e}")
+            finally:
+                self._gossip_q.task_done()
+
+    def gossip_idle(self) -> bool:
+        """No gossip frame queued or mid-handler on this connection
+        (unfinished_tasks covers the queued-to-done window atomically)."""
+        return self._gossip_q.unfinished_tasks == 0
 
     def _serve(self, sid: int, protocol: str, req: bytes) -> None:
         try:
@@ -310,6 +392,14 @@ class Connection:
             return
         self.alive = False
         try:
+            # shutdown BEFORE close: close() alone does not interrupt a
+            # reader blocked in recv() on this same socket (the fd close
+            # defers and no FIN reaches the peer) — shutdown forces the
+            # FIN out and wakes both ends' readers immediately
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self.sock.close()
         except OSError:
             pass
@@ -317,6 +407,7 @@ class Connection:
         with self._stream_lock:
             for q in self._streams.values():
                 q.put(None)
+        self._gossip_q.put(None)       # stop the gossip dispatcher
         self.node._unregister_connection(self)
 
 
@@ -328,9 +419,10 @@ class RemotePeer:
     def __init__(self, conn: Connection):
         self.conn = conn
 
-    def handle(self, _peer_id: str, protocol, request_bytes: bytes) -> list[bytes]:
+    def handle(self, _peer_id: str, protocol, request_bytes: bytes,
+               timeout: float | None = None) -> list[bytes]:
         proto = protocol.value if hasattr(protocol, "value") else str(protocol)
-        return self.conn.request(proto, request_bytes)
+        return self.conn.request(proto, request_bytes, timeout=timeout)
 
 
 class TcpHost:
@@ -343,10 +435,11 @@ class TcpHost:
     """
 
     def __init__(self, node, local_id: str, host: str = "127.0.0.1", port: int = 0,
-                 encrypt: bool = True):
+                 encrypt: bool = True, rpc_timeout: float = 10.0):
         self.node = node
         self.local_id = local_id
         self.encrypt = encrypt
+        self.rpc_timeout = rpc_timeout
         self.server = socket.create_server((host, port))
         self.host, self.port = self.server.getsockname()
         self.connections: dict[str, Connection] = {}
@@ -373,7 +466,8 @@ class TcpHost:
 
     def _spawn(self, sock: socket.socket, dialer: bool = False) -> Connection:
         conn = Connection(sock, self.local_id, self.node,
-                          encrypt=self.encrypt, dialer=dialer)
+                          encrypt=self.encrypt, dialer=dialer,
+                          rpc_timeout=self.rpc_timeout)
         # HELLO must hit the wire BEFORE the reader starts: processing the
         # remote HELLO triggers registration, whose subscription announce
         # would otherwise overtake our own HELLO — the remote then drops

@@ -6,43 +6,25 @@ localhost TCP, the validator set split across nodes. Each simulated slot:
 the owning node produces/signs/publishes the block over gossip, every node
 publishes single-bit attestations for its own validators to subnet topics,
 and the sim asserts convergence (shared head) and — over enough epochs —
-advancing finalization (checks.rs)."""
+advancing finalization (checks.rs).
+
+The slot-driving machinery now lives in `loadgen/multinode.py`
+(`MultiNodeHarness`), which generalizes it with fork-aware cluster
+production and network fault injection (partitions, churn, equivocation —
+the `bn loadtest` multi-node scenario families). `Simulator` is the
+happy-path specialization that the original basic-sim tests consume: no
+injector, gossip batching through the real BeaconProcessor, and a
+wall-clock heartbeat thread like a live node."""
 
 from __future__ import annotations
 
-import time
+from ..loadgen.multinode import MultiNode, MultiNodeHarness
 
-from ..chain.beacon_chain import BeaconChain
-from ..chain.op_pool import OperationPool
-from ..crypto import bls
-from ..network import gossip as gs
-from ..network.node import NetworkNode
-from ..state_transition import accessors as acc
-from ..state_transition.slot import process_slots, types_for_slot
-from ..types import helpers as h
-from ..types.spec import DOMAIN_BEACON_ATTESTER, ForkName
-from .harness import StateHarness, _sign, clone_state
+# re-export: SimNode was this module's node container before the promotion
+SimNode = MultiNode
 
 
-class SimNode:
-    def __init__(self, sim, index: int, validator_indices: list[int]):
-        self.sim = sim
-        self.index = index
-        self.validators = set(validator_indices)
-        self.chain = BeaconChain(
-            sim.spec, clone_state(sim.harness.state, sim.spec)
-        )
-        self.op_pool = OperationPool(sim.spec)
-        self.net = NetworkNode(
-            self.chain,
-            f"node{index}",
-            heartbeat_interval=0.1,
-            subnets=sim.subnets,
-            op_pool=self.op_pool,
-        )
-
-
-class Simulator:
+class Simulator(MultiNodeHarness):
     def __init__(
         self,
         spec,
@@ -50,163 +32,21 @@ class Simulator:
         n_validators: int = 64,
         subnets: int = 4,
     ):
-        self.spec = spec
-        self.subnets = subnets
-        self.harness = StateHarness.new(spec, n_validators)
-        per = n_validators // n_nodes
-        self.nodes = [
-            SimNode(
-                self,
-                i,
-                list(range(i * per, (i + 1) * per if i < n_nodes - 1 else n_validators)),
-            )
-            for i in range(n_nodes)
-        ]
-        # full mesh (the reference sim connects all nodes on localhost too)
-        for i, a in enumerate(self.nodes):
-            for b in self.nodes[i + 1 :]:
-                a.net.connect(b.net)
-        self._wait(lambda: all(
-            len(n.net.host.connections) == n_nodes - 1 for n in self.nodes
-        ), 20.0, "node connections")
-        # Subscription announcements ride the connections asynchronously;
-        # publishing before every peer KNOWS every other peer subscribes
-        # races the flood-publish fallback (a message can miss a node with
-        # no mesh to relay it yet). Wait until the block topic is mutually
-        # known — the real node tolerates this via IHAVE recovery windows,
-        # the lock-step sim must not start with a partitioned view.
-        block_topic = gs.topic_name(self.nodes[0].net.fork_digest, "beacon_block")
-        self._wait(lambda: all(
-            block_topic in a.net.gossipsub.peer_topics.get(b.net.node_id, set())
-            for a in self.nodes
-            for b in self.nodes
-            if a is not b
-        ), 20.0, "subscription propagation")
-
-    # ------------------------------------------------------------ helpers
-
-    @staticmethod
-    def _wait(cond, timeout: float, what: str) -> None:
-        deadline = time.monotonic() + timeout
-        while not cond():
-            if time.monotonic() > deadline:
-                raise TimeoutError(f"timed out waiting for {what}")
-            time.sleep(0.01)
-
-    def node_for_validator(self, vi: int) -> SimNode:
-        for n in self.nodes:
-            if vi in n.validators:
-                return n
-        raise KeyError(vi)
-
-    # ------------------------------------------------------------ slot driving
-
-    def run_slot(self) -> bytes:
-        spec = self.spec
-        slot = self.nodes[0].chain.head_state().slot + 1
-        for n in self.nodes:
-            n.chain.slot_clock.set_slot(slot)
-            n.chain.per_slot_task()
-
-        # 1. proposer's node produces + publishes the block
-        ref = self.nodes[0].chain
-        pre = clone_state(ref.head_state(), spec)
-        if pre.slot < slot:
-            process_slots(pre, spec, slot)
-        proposer = acc.get_beacon_proposer_index(pre, spec)
-        owner = self.node_for_validator(proposer)
-        epoch = h.compute_epoch_at_slot(slot, spec)
-        reveal = self.harness.randao_reveal(pre, proposer, epoch)
-        types = types_for_slot(spec, slot)
-        block = owner.chain.produce_block(slot, reveal, op_pool=owner.op_pool)
-        signed = self.harness.sign_block(block, types)
-        root = types.BeaconBlock.hash_tree_root(block)
-        # import locally, then gossip to the rest
-        owner.chain.process_block(signed, block_root=root)
-        owner.net.publish_block(signed)
-        self._wait(
-            lambda: all(n.chain.head_root == root for n in self.nodes),
-            60.0,
-            f"block propagation at slot {slot}",
+        super().__init__(
+            spec,
+            n_nodes,
+            n_validators,
+            subnets=subnets,
+            # the happy-path sim keeps the live-node wiring the fault
+            # harness trades away for determinism: gossip batched through
+            # the BeaconProcessor, heartbeats on their own timer thread
+            batch_gossip=True,
+            heartbeat_interval=0.1,
         )
-
-        # 2. every node attests for its own validators (single-bit gossip)
-        post = owner.chain.head_state()
-        cache = acc.build_committee_cache(post, spec, epoch)
-        start_slot = h.compute_start_slot_at_epoch(epoch, spec)
-        if slot == start_slot:
-            target_root = root
-        else:
-            target_root = post.block_roots[
-                start_slot % spec.preset.SLOTS_PER_HISTORICAL_ROOT
-            ]
-        source = post.current_justified_checkpoint
-        domain = h.get_domain(post, spec, DOMAIN_BEACON_ATTESTER, epoch)
-        electra = spec.fork_name_at_slot(slot) >= ForkName.electra
-        published = 0
-        for cidx in range(cache.committees_per_slot):
-            committee = cache.committee(slot, cidx)
-            data = types.AttestationData.make(
-                slot=slot,
-                index=0 if electra else cidx,
-                beacon_block_root=root,
-                source=source,
-                target=types.Checkpoint.make(epoch=epoch, root=target_root),
-            )
-            signing_root = h.compute_signing_root(types.AttestationData, data, domain)
-            subnet = gs.compute_subnet_for_attestation(
-                cache.committees_per_slot, slot, cidx, spec
-            ) % self.subnets
-            for pos, vi in enumerate(committee):
-                node = self.node_for_validator(vi)
-                bits = [p == pos for p in range(len(committee))]
-                sig = _sign(self.harness.sk(vi), signing_root).serialize()
-                kwargs = dict(aggregation_bits=bits, data=data, signature=sig)
-                if electra:
-                    cb = [False] * spec.preset.MAX_COMMITTEES_PER_SLOT
-                    cb[cidx] = True
-                    kwargs["committee_bits"] = cb
-                att = types.Attestation.make(**kwargs)
-                # verify + pool locally, then gossip
-                with node.net._lock:
-                    results = node.chain.verify_unaggregated_attestations([att])
-                    for a, idxs in results:
-                        node.chain.apply_attestation_to_fork_choice(a, idxs)
-                        node.op_pool.insert_attestation(a, idxs, types)
-                node.net.publish_attestation(att, subnet)
-                published += 1
-        # wait for attestation fan-out: every node should have pooled
-        # (close to) all attesting validators for this slot
-        want = int(published * 0.95)
-
-        def pooled(n):
-            seen = set()
-            for bucket in n.op_pool.attestations.values():
-                for e in bucket:
-                    if e.data.slot == slot:
-                        seen |= e.attesting_indices
-            return len(seen)
-
-        self._wait(
-            lambda: all(pooled(n) >= want for n in self.nodes),
-            60.0,
-            f"attestation propagation at slot {slot}",
-        )
-        return root
 
     def run_epochs(self, n_epochs: int) -> None:
         for _ in range(n_epochs * self.spec.preset.SLOTS_PER_EPOCH):
             self.run_slot()
 
-    # ------------------------------------------------------------ checks
-
     def finalized_epoch(self) -> int:
         return self.nodes[0].chain.fork_choice.store.finalized_checkpoint[0]
-
-    def heads_agree(self) -> bool:
-        heads = {n.chain.head_root for n in self.nodes}
-        return len(heads) == 1
-
-    def close(self) -> None:
-        for n in self.nodes:
-            n.net.close()

@@ -37,6 +37,8 @@ METRIC_MODULES = (
     "lighthouse_tpu.utils.monitoring",
     "lighthouse_tpu.utils.supervisor",
     "lighthouse_tpu.network.node",
+    "lighthouse_tpu.network.sync",
+    "lighthouse_tpu.loadgen.netfaults",
     "lighthouse_tpu.chain.beacon_processor",
     "lighthouse_tpu.chain.validator_monitor",
     "lighthouse_tpu.crypto.bls.hybrid",
@@ -118,6 +120,17 @@ def lint_registry(registry=None) -> list[str]:
                 errors.append(
                     f"{where}: jaxbls_pipeline_* metrics must be labeled "
                     "families (lane / config source)"
+                )
+        if m.name.startswith(("sync_", "netfault_")):
+            # sync failures and injected network faults are only
+            # actionable broken down (which stage failed, which fault
+            # fired, which scope ate the message) — an unlabeled
+            # aggregate cannot answer "why did the range stall", so the
+            # convention is enforced like qos_*
+            if not getattr(m, "labelnames", ()):
+                errors.append(
+                    f"{where}: sync_*/netfault_* metrics must be labeled "
+                    "families (stage / outcome / fault / scope)"
                 )
         if m.name.startswith(("jaxbls_stage_", "xla_program_")):
             # per-stage attribution and compiled-program analytics exist

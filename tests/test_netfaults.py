@@ -1,0 +1,414 @@
+"""Network fault injection + the multi-node loadtest scenarios: the
+deterministic fault plan (partitions / lossy links / silent peers / churn /
+equivocation), the FaultyPeer Req/Resp wrapper that drives SyncManager's
+retry/failover engine, and the `bn loadtest` multi-node families
+(partition_heal / fork_reorg / sync_catchup / equivocation_storm)."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from lighthouse_tpu.loadgen.netfaults import (
+    Churn,
+    Equivocation,
+    FaultyPeer,
+    InjectedTimeout,
+    LinkFault,
+    NetFaultInjector,
+    NetFaultPlan,
+    Partition,
+    RpcFault,
+)
+
+
+# ---------------------------------------------------------------- injector
+
+
+def test_partition_schedule_and_reachability():
+    plan = NetFaultPlan(partitions=(
+        Partition(start_slot=3, heal_slot=6, groups=((0, 1), (2, 3))),
+    ))
+    inj = NetFaultInjector(plan, 4)
+    inj.on_slot(2)
+    assert inj.reachable(0, 2) and inj.partition_of(0) == -1
+    inj.on_slot(3)
+    assert inj.partition_of(0) == 0 and inj.partition_of(3) == 1
+    assert inj.reachable(0, 1) and not inj.reachable(0, 2)
+    inj.on_slot(6)
+    assert inj.reachable(0, 2)
+    # transition events fired exactly once each, in slot order
+    kinds = [(e["slot"], e["kind"]) for e in inj.counts["events"]]
+    assert kinds == [(3, "partition_start"), (6, "partition_heal")]
+    # nodes OUTSIDE every listed group form an implicit extra group
+    plan2 = NetFaultPlan(partitions=(
+        Partition(start_slot=0, heal_slot=10, groups=((0,),)),
+    ))
+    inj2 = NetFaultInjector(plan2, 3)
+    inj2.on_slot(1)
+    assert not inj2.reachable(0, 1)
+    assert inj2.reachable(1, 2)
+
+
+def test_churn_down_up_and_counted_drops():
+    plan = NetFaultPlan(churn=(Churn(node=1, down_slot=2, up_slot=4),))
+    inj = NetFaultInjector(plan, 3)
+    inj.on_slot(1)
+    assert inj.gossip_decision(0, 1) is None
+    inj.on_slot(2)
+    assert inj.down == {1}
+    assert inj.gossip_decision(0, 1) == ("drop", "churn")
+    assert not inj.reachable(0, 1)
+    inj.on_slot(4)
+    assert inj.down == set()
+    assert inj.gossip_decision(0, 1) is None
+    assert inj.counts["gossip"] == {"churn": 1}
+    kinds = [e["kind"] for e in inj.counts["events"]]
+    assert kinds == ["churn_down", "churn_up"]
+
+
+def test_link_fault_drop_every_is_counter_based():
+    plan = NetFaultPlan(links=(
+        LinkFault(src=0, dst=1, drop_every=3),
+    ))
+    inj = NetFaultInjector(plan, 2)
+    inj.on_slot(0)
+    decisions = [inj.gossip_decision(0, 1) for _ in range(6)]
+    # every 3rd frame on the link is eaten — deterministic, no RNG
+    assert decisions == [None, None, ("drop", "drop")] * 2
+    assert inj.counts["gossip"] == {"drop": 2}
+    # the reverse direction is untouched
+    assert inj.gossip_decision(1, 0) is None
+
+
+def test_overlapping_link_faults_keep_independent_cadence():
+    """Two LinkFaults matching the same link each keep their OWN frame
+    counter: a wildcard fault overlapping a specific one must not double
+    the effective drop rate."""
+    plan = NetFaultPlan(links=(
+        LinkFault(dst=1, drop_every=4),
+        LinkFault(src=0, drop_every=4),
+    ))
+    inj = NetFaultInjector(plan, 2)
+    inj.on_slot(0)
+    decisions = [inj.gossip_decision(0, 1) for _ in range(8)]
+    # every 4th frame drops (the first matching fault fires; the second
+    # sees the same cadence), not every 2nd
+    assert decisions == [None, None, None, ("drop", "drop")] * 2
+
+
+def test_link_fault_delay_queues_until_slot():
+    plan = NetFaultPlan(links=(
+        LinkFault(src=None, dst=1, delay_slots=2),
+    ))
+    inj = NetFaultInjector(plan, 2)
+    inj.on_slot(1)
+    assert inj.gossip_decision(0, 1) == ("delay", 2)
+    fired = []
+    inj.queue_delayed(3, lambda: fired.append("a"))
+    inj.on_slot(2)
+    assert fired == []
+    inj.on_slot(3)
+    assert fired == ["a"]
+    assert inj.counts["gossip"] == {"delay": 1}
+
+
+def test_rpc_fault_modes_and_max_hits():
+    proto = "/test/proto"
+    plan = NetFaultPlan(rpc_faults=(
+        RpcFault(server=0, start_slot=1, end_slot=3, mode="silent",
+                 max_hits=1),
+        RpcFault(server=1, start_slot=0, end_slot=9, mode="empty",
+                 protocols=("/only/this",)),
+    ))
+    inj = NetFaultInjector(plan, 2)
+    inj.on_slot(0)
+    assert inj.rpc_mode(0, proto) is None        # not active yet
+    inj.on_slot(1)
+    assert inj.rpc_mode(0, proto) == "silent"
+    assert inj.rpc_mode(0, proto) is None        # max_hits exhausted
+    assert inj.rpc_mode(1, proto) is None        # protocol filter
+    assert inj.rpc_mode(1, "/only/this") == "empty"
+
+
+def test_faulty_peer_wraps_handle_surface():
+    class EchoPeer:
+        def handle(self, peer_id, protocol, request_bytes, timeout=None):
+            return [b"a", b"b", b"c", b"d"]
+
+    plan = NetFaultPlan(
+        partitions=(Partition(start_slot=5, heal_slot=9,
+                              groups=((0,), (1,))),),
+        rpc_faults=(
+            RpcFault(server=0, start_slot=0, end_slot=2, mode="silent"),
+            RpcFault(server=0, start_slot=2, end_slot=3, mode="torn"),
+            RpcFault(server=0, start_slot=3, end_slot=4, mode="empty"),
+        ),
+    )
+    inj = NetFaultInjector(plan, 2)
+    peer = FaultyPeer(EchoPeer(), inj, server_idx=0, client_idx=1)
+    inj.on_slot(0)
+    with pytest.raises(InjectedTimeout, match="silent"):
+        peer.handle("x", "/p", b"")
+    inj.on_slot(2)
+    with pytest.raises(InjectedTimeout, match="stalled mid-response"):
+        peer.handle("x", "/p", b"")
+    inj.on_slot(3)
+    assert peer.handle("x", "/p", b"") == []
+    inj.on_slot(4)
+    assert peer.handle("x", "/p", b"") == [b"a", b"b", b"c", b"d"]
+    inj.on_slot(5)                       # partition: unreachable entirely
+    with pytest.raises(InjectedTimeout, match="partition"):
+        peer.handle("x", "/p", b"")
+    assert inj.counts["rpc"] == {
+        "rpc_silent": 1, "rpc_torn": 1, "rpc_empty": 1, "partition": 1,
+    }
+
+
+def test_router_fault_filter_counts_reasons():
+    from lighthouse_tpu.network.gossip import InProcessGossipRouter
+
+    plan = NetFaultPlan(partitions=(
+        Partition(start_slot=0, heal_slot=9, groups=((0,), (1, 2))),
+    ))
+    inj = NetFaultInjector(plan, 3)
+    inj.on_slot(0)
+    router = InProcessGossipRouter(
+        fault_filter=inj.router_filter({"a": 0, "b": 1, "c": 2})
+    )
+    got = {"b": [], "c": []}
+    router.subscribe("b", "t", lambda m: got["b"].append(m.payload) or True)
+    router.subscribe("c", "t", lambda m: got["c"].append(m.payload) or True)
+    delivered = router.publish("a", "t", b"x" * 40)
+    # node a is partitioned away from both subscribers
+    assert delivered == 0
+    assert router.faulted == {"partition": 2}
+    delivered = router.publish("b", "t", b"y" * 40)
+    assert delivered == 1 and got["c"]          # same group: flows
+    assert not got["b"] or got["b"] == []
+
+
+def test_plan_as_dict_round_trips_to_json():
+    plan = NetFaultPlan(
+        partitions=(Partition(1, 2, ((0,), (1,))),),
+        links=(LinkFault(src=0, dst=1, drop_every=2, delay_slots=1),),
+        rpc_faults=(RpcFault(server=0, start_slot=0, end_slot=1),),
+        churn=(Churn(node=1, down_slot=1, up_slot=2),),
+        equivocations=(Equivocation(slot=3),),
+    )
+    doc = json.loads(json.dumps(plan.as_dict()))
+    assert doc["partitions"][0]["groups"] == [[0], [1]]
+    assert doc["links"][0]["drop_every"] == 2
+    assert doc["rpc_faults"][0]["mode"] == "silent"
+    assert doc["churn"][0]["node"] == 1
+    assert doc["equivocations"] == [{"slot": 3}]
+
+
+# ----------------------------------------------------------- rpc timeout
+
+
+def test_rpc_timeout_plumbing():
+    """--rpc-timeout reaches the transport default and the sync manager's
+    size-derived batch deadlines."""
+    from lighthouse_tpu.chain.beacon_chain import BeaconChain
+    from lighthouse_tpu.network.node import NetworkNode
+    from lighthouse_tpu.network.sync import PER_BLOCK_TIMEOUT
+    from lighthouse_tpu.testing.harness import StateHarness, clone_state
+    from lighthouse_tpu.types.spec import minimal_spec
+    from lighthouse_tpu.crypto import bls
+
+    bls.set_backend("fake")
+    spec = minimal_spec()
+    h = StateHarness.new(spec, 16)
+    chain = BeaconChain(spec, clone_state(h.state, spec))
+    node = NetworkNode(chain, "rpc-to", subnets=1, rpc_timeout=1.25)
+    try:
+        assert node.host.rpc_timeout == 1.25
+        assert node.sync.request_timeout == 1.25
+        assert node.sync._batch_timeout(64) == pytest.approx(
+            1.25 + 64 * PER_BLOCK_TIMEOUT
+        )
+    finally:
+        node.close()
+
+
+# ------------------------------------------------------- scenario families
+
+
+def _run(name, **kw):
+    from lighthouse_tpu.loadgen.multinode import run_multinode_scenario
+    from lighthouse_tpu.loadgen.scenarios import get_multinode_scenario
+
+    return run_multinode_scenario(get_multinode_scenario(name, **kw))
+
+
+def test_partition_heal_scenario_converges_and_conserves(tmp_path):
+    from lighthouse_tpu.loadgen.multinode import run_multinode_scenario
+    from lighthouse_tpu.loadgen.scenarios import get_multinode_scenario
+    from lighthouse_tpu.observability.flight_recorder import validate_incident
+
+    sc = get_multinode_scenario("partition_heal")
+    datadir = tmp_path / "dd"
+    report = run_multinode_scenario(sc, datadir=str(datadir),
+                                    out_path=str(tmp_path / "r.json"))
+    assert report["ok"], report["failures"]
+    det = report["deterministic"]
+    conv = det["convergence"]
+    assert conv["within_k"] and conv["converged_at_slot"] >= conv["heal_slot"]
+    assert len(set(conv["final_heads"].values())) == 1
+    # conservation: every expected delivery is either delivered or blocked
+    # with a counted reason
+    blocks = det["blocks"]
+    assert blocks["conservation_ok"]
+    assert blocks["blocked"].get("partition", 0) > 0
+    # fault transitions landed as flight-recorder-fed events
+    kinds = [e["kind"] for e in det["netfault_events"]]
+    assert kinds == ["partition_start", "partition_heal"]
+    # during the split, two clusters; after heal, one
+    mid = next(e for e in det["per_slot"] if e["slot"] == 5)
+    assert len(mid["clusters"]) == 2
+    # the partitioned node's service level degraded, the majority's less so
+    slo = report["slo"]["per_node"]
+    assert slo["3"]["deadline_hit_ratio"] < slo["0"]["deadline_hit_ratio"]
+    # burn-rate/miss-streak incidents dumped and schema-valid
+    assert report["slo"]["incidents"]
+    for name in report["slo"]["incidents"]:
+        with open(datadir / "incidents" / name) as f:
+            assert validate_incident(json.load(f)) == []
+    # identical seeds -> identical deterministic cores
+    report2 = run_multinode_scenario(sc)
+    assert report2["deterministic"] == det
+
+
+def test_fork_reorg_scenario_orphans_minority_fork():
+    report = _run("fork_reorg")
+    assert report["ok"], report["failures"]
+    det = report["deterministic"]
+    assert det["orphaned_blocks"] >= 1
+    assert det["convergence"]["within_k"]
+    # both sides of the split produced at least one block (competing
+    # forks, not just a stalled minority)
+    split_slots = [e for e in det["per_slot"] if len(e["clusters"]) == 2]
+    producing_sides = {
+        tuple(b["cluster"])
+        for e in split_slots for b in e["blocks"] if "root" in b
+    }
+    assert len(producing_sides) == 2, (
+        f"the 2-2 split never produced competing forks: {producing_sides}"
+    )
+
+
+def test_sync_catchup_scenario_retries_and_fails_over():
+    report = _run("sync_catchup")
+    assert report["ok"], report["failures"]
+    sync = report["deterministic"]["sync"]
+    assert sync["reached_head"] and sync["imported_blocks"] > 0
+    st = sync["stats"]
+    # the injected silent peer forced a timeout, a blame, a backoff and a
+    # failover to an alternate peer — the acceptance counters
+    assert st["errors"].get("range_request", 0) >= 1
+    assert st["peers_blamed"] >= 1
+    assert st["failovers"] >= 1 and st["batch_retries"] >= 1
+    assert sync["backoffs"] >= 1
+    assert sync["final_state"] == "synced"
+    # injected rpc faults were counted with their reason
+    assert report["deterministic"]["rpc_faults"].get("rpc_silent", 0) >= 1
+    # identical reruns
+    assert _run("sync_catchup")["deterministic"] == report["deterministic"]
+
+
+def test_equivocation_storm_detects_and_slashes():
+    report = _run("equivocation_storm")
+    assert report["ok"], report["failures"]
+    det = report["deterministic"]
+    eq = det["equivocation"]
+    assert eq["injected"] == 3 and len(eq["published"]) == 3
+    # every honest reachable node rejected each twin at gossip
+    assert all(p["rejected_by"] == 3 for p in eq["published"])
+    # slashers on honest nodes assembled evidence...
+    assert sum(eq["detections_by_node"].values()) >= 3
+    # ...and the ProposerSlashings flowed through op pools into blocks:
+    # every equivocating proposer is slashed in the final state
+    assert sorted(eq["slashed_in_final_state"]) == sorted(
+        p["proposer"] for p in eq["published"]
+    )
+    # the chain still converged despite the storm
+    assert len(set(det["convergence"]["final_heads"].values())) == 1
+
+
+def test_custom_churn_scenario_rejoins_and_conserves():
+    """Churn (disconnect/redial) through the real transport: the churned
+    node misses blocks while down — counted, not lost — and catches back
+    up through parent lookups after its redial."""
+    from lighthouse_tpu.loadgen.multinode import run_multinode_scenario
+    from lighthouse_tpu.loadgen.scenarios import MultiNodeScenario
+
+    sc = MultiNodeScenario(
+        name="churn_test", n_nodes=3, n_validators=24, slots=8,
+        attest=False, churn=(Churn(node=2, down_slot=3, up_slot=6),),
+        converge_slots=3,
+    )
+    report = run_multinode_scenario(sc)
+    assert report["ok"], report["failures"]
+    det = report["deterministic"]
+    assert det["blocks"]["blocked"].get("churn", 0) > 0
+    assert det["blocks"]["conservation_ok"]
+    kinds = [e["kind"] for e in det["netfault_events"]]
+    assert kinds == ["churn_down", "churn_up"]
+    assert det["convergence"]["within_k"]
+
+
+def test_divergence_fails_the_run():
+    """A partition that never heals inside the run must FAIL the scenario
+    (the CLI exit-nonzero-on-divergence contract)."""
+    report = _run("partition_heal", slots=6)
+    assert not report["ok"]
+    assert any("diverged" in f for f in report["failures"])
+
+
+# ------------------------------------------------------------------- CLI
+
+
+def _run_cli(args, timeout=300):
+    return subprocess.run(
+        [sys.executable, *args], capture_output=True, text=True,
+        timeout=timeout, cwd="/root/repo",
+    )
+
+
+def test_bn_loadtest_partition_heal_smoke_cli(tmp_path):
+    out = tmp_path / "report.json"
+    r = _run_cli(["-m", "lighthouse_tpu", "bn", "loadtest",
+                  "--scenario", "partition_heal", "--smoke", "--quiet",
+                  "--out", str(out), "--datadir", str(tmp_path / "dd")])
+    assert r.returncode == 0, r.stderr
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    assert summary["scenario"] == "partition_heal"
+    assert summary["ok"] is True
+    assert summary["convergence"]["within_k"] is True
+    assert summary["blocks"]["conservation_ok"] is True
+    report = json.loads(out.read_text())
+    assert report["multinode"] is True
+    assert report["fault_plan"]["partitions"]
+    assert report["elapsed_secs"] < 60
+
+
+def test_bn_loadtest_sync_catchup_smoke_cli(tmp_path):
+    out = tmp_path / "report.json"
+    r = _run_cli(["-m", "lighthouse_tpu", "bn", "loadtest",
+                  "--scenario", "sync_catchup", "--smoke", "--quiet",
+                  "--out", str(out)])
+    assert r.returncode == 0, r.stderr
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    assert summary["sync"]["reached_head"] is True
+    assert summary["sync"]["failovers"] >= 1
+    assert summary["sync"]["batch_retries"] >= 1
+
+
+def test_bn_loadtest_divergence_exits_nonzero(tmp_path):
+    r = _run_cli(["-m", "lighthouse_tpu", "bn", "loadtest",
+                  "--scenario", "partition_heal", "--slots", "6", "--smoke",
+                  "--quiet", "--out", str(tmp_path / "r.json")])
+    assert r.returncode == 1
+    assert "diverged" in r.stderr
