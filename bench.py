@@ -567,6 +567,20 @@ def main():
     log(f"devices: {devices}")
     _MATRIX["devices"] = str(devices)
     try:
+        # the serving topology: batch dispatches shard over this mesh
+        # (parallel/mesh.py), so the matrix must say what topology its
+        # numbers were measured on — the same key autotune profiles carry
+        from lighthouse_tpu.parallel import get_mesh, mesh_shape_key
+
+        mesh = get_mesh()
+        _MATRIX["mesh"] = {
+            "shape": mesh_shape_key(mesh),
+            "devices": int(mesh.devices.size) if mesh is not None else 1,
+        }
+        log(f"mesh: {_MATRIX['mesh']}")
+    except Exception as e:
+        log(f"mesh resolution failed (serving single-chip): {e}")
+    try:
         from lighthouse_tpu.autotune.profile import current_device_key
 
         _DEVICE_KEY.update(current_device_key())

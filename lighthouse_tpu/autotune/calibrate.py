@@ -109,7 +109,11 @@ def load_fixture_groups(path: str, include_small: bool = False,
 
 def bucket_of(sets) -> tuple:
     """The (n_sets, n_pks) padding bucket the jaxbls backend would compile
-    for this workload (the dispatch path's own rounding rule)."""
+    for this workload (the dispatch path's own rounding rule). The rule is
+    MESH-SHAPE-KEYED (parallel/mesh.py): on an 8-chip sets-mesh every
+    bucket is a multiple of 8, which is why the profile's key carries
+    `mesh_shape` and runtime.install refuses a topology mismatch — the
+    buckets measured here simply do not exist on another mesh."""
     from ..crypto.jaxbls.backend import padding_bucket
 
     return padding_bucket(

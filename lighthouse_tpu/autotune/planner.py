@@ -75,6 +75,13 @@ MAX_BATCH_CAP = 4096         # sanity ceiling on derived caps
 MIN_BATCH_CAP = 4            # jaxbls MIN_SETS floor
 P99_BUDGET_FACTOR = 2.0
 P99_BUDGET_CLAMP_MS = (50.0, 5000.0)
+# collective-aware budget slack: every halving level of a D-chip mesh adds
+# one ICI reduction round to the stage-1 tree-sum and stage-4 pair
+# product, so the p99 budget a profile justifies grows by this fraction
+# per log2(D) — a routing/stall verdict tuned single-chip must not flag a
+# healthy 8-chip batch whose collectives legitimately cost a few ms more
+COLLECTIVE_P99_SLACK_PER_HALVING = 0.05
+STALL_BUDGET_FACTOR = 4.0    # mirrors the hybrid router's stall default
 MAX_WARMUP_BUCKETS = 4
 # appended small/urgent warmup shapes may exceed MAX_WARMUP_BUCKETS by
 # this many entries (they are the cheap compiles; dropping them is what
@@ -95,6 +102,16 @@ class Plan:
     warmup_buckets: tuple = DEFAULT_WARMUP_BUCKETS
     pipeline_depth: int = DEFAULT_PIPELINE_DEPTH
     msm_window: int | None = None
+    # mesh-aware serving (r8): total chips of the measured topology, the
+    # per-chip share of the batch caps (global cap / set-axis size — what
+    # a capacity dashboard compares against per-chip roofline), and the
+    # collective-aware stall budget the hybrid router feeds the QoS
+    # breaker (None = unmeasured topology, consumers keep the 4x-p99
+    # default)
+    mesh_devices: int = 1
+    per_chip_attestation_batch: int = DEFAULT_MAX_ATTESTATION_BATCH
+    per_chip_aggregate_batch: int = DEFAULT_MAX_AGGREGATE_BATCH
+    stall_budget_ms: float | None = None
     source: str = "defaults"
 
 
@@ -114,6 +131,19 @@ def plan_from_profile(profile: DeviceProfile) -> Plan:
     )
     source = f"profile:{profile.key_string()}"
 
+    # ---- topology: axis sizes of the mesh the profile measured on.
+    # set_axis keys the batch-cap rounding (full batches must shard
+    # evenly); total chips key the collective-aware budget slack.
+    from ..parallel.mesh import parse_mesh_shape
+
+    shape = parse_mesh_shape(profile.mesh_shape)
+    set_axis = max(1, int(shape.get("sets", 1)))
+    mesh_devices = 1
+    for v in shape.values():
+        mesh_devices *= max(1, int(v))
+    collective_rounds = max(0, (mesh_devices - 1).bit_length())
+    collective_slack = 1.0 + COLLECTIVE_P99_SLACK_PER_HALVING * collective_rounds
+
     # ---- batch caps: smallest bucket within KNEE_FRACTION of peak rate.
     # If that knee IS the largest measured bucket, throughput was still
     # rising when the sweep ended — the data shows nothing about wider
@@ -130,15 +160,33 @@ def plan_from_profile(profile: DeviceProfile) -> Plan:
         if knee == max(b.n_sets for b in measured):
             knee = max(knee, DEFAULT_MAX_ATTESTATION_BATCH)
         att_cap = int(_clamp(knee, MIN_BATCH_CAP, MAX_BATCH_CAP))
+    # mesh-shape-keyed caps: a full batch must divide evenly over the set
+    # axis (jaxbls pads the remainder with masked lanes — a cap that is
+    # not a mesh multiple wastes the pad lanes on EVERY full batch)
+    if att_cap % set_axis:
+        att_cap += set_axis - (att_cap % set_axis)
     agg_cap = max(MIN_BATCH_CAP, att_cap // 2)
+    if agg_cap % set_axis:
+        agg_cap += set_axis - (agg_cap % set_axis)
 
-    # ---- p99 budget from the smallest (urgent) measured bucket
+    # ---- p99 budget from the smallest (urgent) measured bucket, widened
+    # by the collective slack on a multi-chip mesh (each halving level of
+    # the cross-set reductions adds one ICI round)
     p99_budget = DEFAULT_P99_BUDGET_MS
     smallest = next((b for b in measured if b.p99_ms is not None), None)
     if smallest is not None:
         p99_budget = _clamp(
-            P99_BUDGET_FACTOR * smallest.p99_ms, *P99_BUDGET_CLAMP_MS
+            P99_BUDGET_FACTOR * smallest.p99_ms * collective_slack,
+            *P99_BUDGET_CLAMP_MS,
         )
+    # the stall verdict the hybrid router feeds the QoS breaker: derived
+    # here (not in the router) so one planner owns every topology-aware
+    # budget; None when nothing was measured — consumers keep the 4x-p99
+    # default resolution
+    stall_budget = (
+        round(STALL_BUDGET_FACTOR * float(p99_budget), 3)
+        if smallest is not None else None
+    )
 
     # ---- urgent threshold: host wins while n * host_ms <= device p50
     urgent = DEFAULT_URGENT_MAX_SETS
@@ -196,5 +244,9 @@ def plan_from_profile(profile: DeviceProfile) -> Plan:
         warmup_buckets=warmup,
         pipeline_depth=depth,
         msm_window=msm_window,
+        mesh_devices=mesh_devices,
+        per_chip_attestation_batch=max(1, att_cap // set_axis),
+        per_chip_aggregate_batch=max(1, agg_cap // set_axis),
+        stall_budget_ms=stall_budget,
         source=source,
     )

@@ -55,8 +55,13 @@ SCHEMA_VERSION = 1
 # window width, the measured pipeline depth, and the warmup small-bucket
 # list. Profiles keyed to an older revision are STALE: runtime.install
 # refuses them (runtime.py) so a pre-donation budget never routes the
-# donated path.
-BACKEND_REVISION = "r7"
+# donated path. r8: the staged pipeline is mesh-sharded on the live path
+# (padding buckets, batch caps, and collective-aware budgets all depend
+# on the topology), so the profile key gains `mesh_shape` and
+# runtime.install additionally refuses a profile calibrated on a
+# DIFFERENT topology than the live mesh — same pattern as the stale
+# revision refusal.
+BACKEND_REVISION = "r8"
 
 #: varying-base MSM window widths a profile may persist (the calibrate
 #: sweep's search space — crypto/jaxbls/msm.py ALLOWED_WINDOWS, duplicated
@@ -143,8 +148,30 @@ class DeviceProfile:
             f"jax{self.key.get('jax_version', 'unknown')}",
             str(self.key.get("backend_revision", BACKEND_REVISION)),
             str(self.key.get("bls_backend", "jax")),
+            # topology segment (r8+): a profile measured on an 8-chip
+            # sets-mesh must never land on (or be autoloaded by) a
+            # single-chip node — padding buckets and budgets differ
+            str(self.key.get("mesh_shape", "single")),
         ]
         return re.sub(r"[^A-Za-z0-9_.-]+", "-", "_".join(parts))
+
+    @property
+    def mesh_shape(self) -> str | None:
+        """Canonical topology string the profile was measured on
+        (parallel.mesh_shape_key format: "single", "sets8", "sets4-pks2");
+        None on pre-r8 profiles that never recorded one."""
+        v = self.key.get("mesh_shape")
+        return None if v is None else str(v)
+
+    def mesh_mismatch(self, live_mesh_shape: str | None) -> bool:
+        """True when this profile was calibrated on a DIFFERENT topology
+        than `live_mesh_shape` — its buckets/budgets would misroute the
+        live mesh (runtime.install_profile refuses such profiles, the
+        same contract as the stale-revision check). Unknowable sides
+        (pre-r8 profile, undetected live mesh) never flag."""
+        if self.mesh_shape is None or live_mesh_shape is None:
+            return False
+        return self.mesh_shape != str(live_mesh_shape)
 
     def to_json(self) -> dict:
         return {
@@ -292,6 +319,12 @@ def current_device_key(bls_backend: str = "jax") -> dict:
     import jax
 
     devices = jax.devices()
+    try:
+        from ..parallel import mesh_shape_key
+
+        mesh_shape = mesh_shape_key()
+    except Exception:
+        mesh_shape = "single"
     return {
         "platform": devices[0].platform if devices else "none",
         "device_kind": devices[0].device_kind if devices else "none",
@@ -299,4 +332,7 @@ def current_device_key(bls_backend: str = "jax") -> dict:
         "jax_version": jax.__version__,
         "backend_revision": BACKEND_REVISION,
         "bls_backend": bls_backend,
+        # the topology the numbers are measured ON (r8+): padding buckets
+        # and collective costs are mesh-shape-dependent
+        "mesh_shape": mesh_shape,
     }

@@ -72,16 +72,38 @@ def _notify_listeners(plan) -> None:
             )
 
 
+def _record_refusal(reason: str, profile: DeviceProfile, path, **fields):
+    """Profile refusals are bring-up facts an incident dump should carry
+    (an operator wondering why the node serves on defaults reads the
+    flight recorder, not the startup scroll)."""
+    try:
+        from ..observability.flight_recorder import RECORDER
+
+        RECORDER.record(
+            "autotune_profile_refused", severity="warn", reason=reason,
+            path=str(path or ""), **fields,
+        )
+    except Exception:
+        pass  # diagnostics must never break install
+
+
 def install_profile(profile: DeviceProfile, path: str | None = None,
-                    allow_stale: bool = False) -> Plan | None:
+                    allow_stale: bool = False,
+                    live_mesh_shape: str | None = None) -> Plan | None:
     """Make `profile` the process-wide knob source; returns its Plan.
 
     A STALE profile — measured under a different jaxbls BACKEND_REVISION,
     i.e. on kernels that no longer exist — is refused (returns None, the
     consumers keep their current knobs): budgets and caps derived from a
-    dead kernel structure misroute the live one. `allow_stale=True` is
-    the explicit operator override (`--autotune-profile PATH` names a
-    file on purpose); the rejection is still logged loudly."""
+    dead kernel structure misroute the live one. The same contract covers
+    TOPOLOGY: when the caller knows the live mesh shape (`live_mesh_shape`
+    — autoload passes the detected key's, parallel.mesh_shape_key format),
+    a profile calibrated on a different topology is refused too — its
+    padding buckets, per-chip caps and collective budgets describe a mesh
+    this process is not serving on. `allow_stale=True` is the explicit
+    operator override (`--autotune-profile PATH` names a file on
+    purpose) for BOTH refusals; the rejection is still logged loudly and
+    lands in the flight recorder either way."""
     if profile.is_stale():
         log = get_logger("autotune")
         if not allow_stale:
@@ -92,12 +114,40 @@ def install_profile(profile: DeviceProfile, path: str | None = None,
                 current_revision=BACKEND_REVISION,
                 path=path or "",
             )
+            _record_refusal(
+                "stale_revision", profile, path,
+                profile_revision=str(profile.key.get("backend_revision")),
+                current_revision=BACKEND_REVISION,
+            )
             return None
         log.warn(
             "installing STALE autotune profile (operator override); its "
             "numbers were measured on a different kernel structure",
             profile_revision=str(profile.key.get("backend_revision")),
             current_revision=BACKEND_REVISION,
+        )
+    if profile.mesh_mismatch(live_mesh_shape):
+        log = get_logger("autotune")
+        if not allow_stale:
+            log.warn(
+                "autotune profile refused (mesh topology mismatch); run "
+                "`autotune calibrate` on this topology",
+                profile_mesh=str(profile.mesh_shape),
+                live_mesh=str(live_mesh_shape),
+                path=path or "",
+            )
+            _record_refusal(
+                "mesh_mismatch", profile, path,
+                profile_mesh=str(profile.mesh_shape),
+                live_mesh=str(live_mesh_shape),
+            )
+            return None
+        log.warn(
+            "installing MESH-MISMATCHED autotune profile (operator "
+            "override); its buckets/budgets were measured on a different "
+            "topology",
+            profile_mesh=str(profile.mesh_shape),
+            live_mesh=str(live_mesh_shape),
         )
     plan = plan_from_profile(profile)
     measured_backend = profile.key.get("bls_backend")
@@ -191,20 +241,6 @@ def autoload(wait_secs: float | None = None,
         return None
     from . import profile as prof
 
-    path = path or os.environ.get("LIGHTHOUSE_TPU_AUTOTUNE_PROFILE")
-    if path:
-        try:
-            # an explicitly named profile is an operator override: a
-            # stale revision installs WITH a loud warning instead of
-            # being refused (the canonical-path branch below stays
-            # strict — its filename embeds the revision)
-            return install_profile(prof.load(path), path=path,
-                                   allow_stale=True)
-        except Exception as e:
-            log.warn("autotune profile load failed; serving on defaults",
-                     path=path, error=f"{type(e).__name__}: {e}")
-            return None
-
     if wait_secs is None:
         try:
             wait_secs = float(
@@ -212,6 +248,30 @@ def autoload(wait_secs: float | None = None,
             )
         except ValueError:
             wait_secs = 5.0
+
+    path = path or os.environ.get("LIGHTHOUSE_TPU_AUTOTUNE_PROFILE")
+    if path:
+        try:
+            loaded = prof.load(path)
+            # an explicitly named profile is an operator override: a
+            # stale revision or mesh mismatch installs WITH a loud
+            # warning instead of being refused (the canonical-path
+            # branch below stays strict — its filename embeds the
+            # revision AND the topology). The mismatch warning still
+            # needs the LIVE topology: detect it with the same bounded
+            # wait (detection failure -> None -> unknowable, no check —
+            # the override installs either way, so this never blocks a
+            # tunnel-outage start beyond wait_secs).
+            live = None
+            if loaded.mesh_shape is not None:
+                key = detect_device_key(wait_secs)
+                live = key.get("mesh_shape") if key else None
+            return install_profile(loaded, path=path, allow_stale=True,
+                                   live_mesh_shape=live)
+        except Exception as e:
+            log.warn("autotune profile load failed; serving on defaults",
+                     path=path, error=f"{type(e).__name__}: {e}")
+            return None
 
     key = detect_device_key(wait_secs)
     if key is None:
@@ -224,7 +284,11 @@ def autoload(wait_secs: float | None = None,
                  expected_path=candidate)
         return None
     try:
-        return install_profile(prof.load(candidate), path=candidate)
+        # belt and braces: the canonical filename embeds the topology, but
+        # the key INSIDE the file is what install checks against the
+        # detected live mesh (a renamed/copied file must still be refused)
+        return install_profile(prof.load(candidate), path=candidate,
+                               live_mesh_shape=key.get("mesh_shape"))
     except Exception as e:
         log.warn("autotune profile load failed; serving on defaults",
                  path=candidate, error=f"{type(e).__name__}: {e}")
@@ -258,6 +322,7 @@ def start_warmup(buckets=None, warm_fn=None,
 
     def attempt():
         # raises on failure — the CALLER owns the retry policy (see below)
+        single_chip_too = False
         if warm_fn is not None:
             fn = warm_fn
         else:
@@ -265,14 +330,25 @@ def start_warmup(buckets=None, warm_fn=None,
 
             backend = bls_api.get_backend()
             if hasattr(backend, "warm_bucket"):
+                # hybrid: full-pipeline warm — small buckets ride the
+                # urgent lane inside the router, so the single-chip
+                # variant warms by construction
                 fn = backend.warm_bucket
             else:
                 import jax
 
                 jax.devices()  # may block on a dead tunnel: daemon thread
                 from ..crypto.jaxbls.backend import warm_stages as fn
+                from ..parallel import get_mesh
+
+                # only a MESHED node has a distinct single-chip urgent
+                # variant; warming it twice on one device would just skew
+                # the profiler's compile stats with a duplicate ~0s entry
+                single_chip_too = get_mesh() is not None
         import time as _time
 
+        plan = active_plan()
+        urgent_max = plan.urgent_max_sets if plan is not None else 4
         for n_sets, n_pks in plan_buckets:
             t0 = _time.time()
             ok = fn(n_sets, n_pks)
@@ -283,6 +359,15 @@ def start_warmup(buckets=None, warm_fn=None,
                 )
             else:
                 log.info("warmup bucket done", n_sets=n_sets,
+                         n_pks=n_pks, secs=round(_time.time() - t0, 1))
+            if single_chip_too and n_sets <= urgent_max:
+                # the urgent bypass lane is PINNED single-chip with its
+                # own (unsharded, plain-pow2) programs: warm those too or
+                # the first urgent verify on a meshed node pays the cold
+                # compile the warmup list exists to hide
+                t0 = _time.time()
+                fn(n_sets, n_pks, single_chip=True)
+                log.info("urgent single-chip bucket done", n_sets=n_sets,
                          n_pks=n_pks, secs=round(_time.time() - t0, 1))
 
     if supervisor is not None:

@@ -190,11 +190,22 @@ class BeaconProcessorConfig:
     )
     # max device batches in flight before the pump blocks on the oldest —
     # the double-buffering depth (SURVEY §7 step 2: host marshals batch N+1
-    # while the device verifies batch N). Shares the jaxbls dispatcher's
-    # depth resolution (env > autotune plan > default 4) so the processor
-    # window and the backend window agree; --max-inflight-batches stays
-    # the explicit override.
-    max_inflight: int = field(default_factory=lambda: _pipeline_depth())
+    # while the device verifies batch N). None (the default) auto-resolves
+    # through the jaxbls dispatcher's depth resolution (env > autotune
+    # plan > default 4) so the processor window and the backend window
+    # agree, AND keeps re-resolving on runtime profile installs via the
+    # processor's plan listener. Passing a NUMBER pins it: explicitness
+    # is self-describing (__post_init__ flips max_inflight_explicit), so
+    # a caller constructing BeaconProcessorConfig(max_inflight=2) is
+    # never clobbered by a later plan install.
+    max_inflight: int | None = None
+    max_inflight_explicit: bool = False
+
+    def __post_init__(self):
+        if self.max_inflight is None:
+            self.max_inflight = _pipeline_depth()
+        else:
+            self.max_inflight_explicit = True
 
 
 class BeaconProcessor:
@@ -244,6 +255,21 @@ class BeaconProcessor:
         from ..observability import register_processor
 
         register_processor(self)
+        # live retune (r8): a mesh-aware autotune profile installed
+        # mid-run re-resolves the in-flight window through the same plan
+        # listener contract the jaxbls dispatcher and the hybrid router
+        # use — unless the operator pinned --max-inflight-batches
+        try:
+            from ..autotune import runtime as _at_runtime
+
+            _at_runtime.add_plan_listener(self._on_plan_installed)
+        except Exception:
+            pass  # autotune broken must never take down the processor
+
+    def _on_plan_installed(self, _plan) -> None:
+        if self.config.max_inflight_explicit:
+            return
+        self.config.max_inflight = _pipeline_depth()
 
     # ------------------------------------------------------------- submit
 

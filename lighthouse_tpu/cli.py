@@ -463,7 +463,11 @@ def cmd_bn(args):
         if args.max_aggregate_batch is not None:
             proc_cfg.max_aggregate_batch = args.max_aggregate_batch
         if args.max_inflight_batches is not None:
+            # post-construction assignment: pin explicitly (constructor
+            # args self-describe via __post_init__; attribute writes
+            # cannot)
             proc_cfg.max_inflight = args.max_inflight_batches
+            proc_cfg.max_inflight_explicit = True
         if args.processor_workers is not None:
             proc_cfg.num_workers = args.processor_workers
 

@@ -227,11 +227,17 @@ class HybridBackend:
         )
         # a verify slower than this is a STALL (breaker failure signal):
         # well past anything the p99 budget router would tolerate, so legit
-        # heavy batches never trip it, a wedged tunnel does
+        # heavy batches never trip it, a wedged tunnel does. The planner
+        # emits a COLLECTIVE-AWARE stall budget on meshed topologies (r8:
+        # Plan.stall_budget_ms — each ICI reduction round widens it), so
+        # an 8-chip batch's legitimate collective time never feeds the
+        # breaker as a failure; env/ctor still win, and without a profile
+        # the 4x-p99 default stands.
         stall, _ = _resolve_knob(
             self._ctor_knobs["stall_budget_ms"],
             "LIGHTHOUSE_TPU_DEVICE_STALL_BUDGET_MS",
-            None, p99 * 4.0,
+            getattr(plan, "stall_budget_ms", None) if plan else None,
+            p99 * 4.0,
         )
         with self._lock:
             self.urgent_max_sets = int(urgent)
@@ -298,11 +304,21 @@ class HybridBackend:
 
     # ------------------------------------------------------------- routing
 
+    def _lane(self, n_sets: int) -> str:
+        return "urgent" if n_sets <= self.urgent_max_sets else "batch"
+
     def _bucket(self, sets) -> tuple:
+        """LANE-AWARE warm/cold key: (lane, padding bucket). The urgent
+        lane serves a different compiled program than the batch lane
+        (single-chip plain-pow2 vs mesh-padded sharded —
+        crypto/jaxbls/backend.py r10), so warmth for one lane's program
+        must never vouch for the other's uncompiled one."""
         from ..jaxbls.backend import padding_bucket
 
-        return padding_bucket(
-            len(sets), max(len(s.signing_keys) for s in sets)
+        lane = self._lane(len(sets))
+        return lane, padding_bucket(
+            len(sets), max(len(s.signing_keys) for s in sets),
+            single_chip=(lane == "urgent"),
         )
 
     def _p99_ms(self) -> float | None:
@@ -347,7 +363,13 @@ class HybridBackend:
         def warm():
             try:
                 t0 = time.time()
-                self._device.verify_signature_sets(snapshot, [1] * len(snapshot))
+                # warm through the SAME lane the serving path will pick
+                # (_device_submitters): a small batch routes urgent, whose
+                # program is the single-chip one on a meshed node — warming
+                # only the sharded program would leave the first
+                # 'warm'-routed urgent verify paying the cold compile
+                submit, _ = self._device_submitters(snapshot)
+                submit(snapshot, [1] * len(snapshot))
                 with self._lock:
                     self._warm_buckets.add(bucket)
                 self._log.info(
@@ -384,8 +406,15 @@ class HybridBackend:
         # bucket resolved BEFORE materializing the (up to 65k-object)
         # dummy sets, and claimed in _warming so a concurrent
         # _spawn_warm / warm_bucket at the same shape never launches a
-        # second multi-minute compile of the identical program
-        bucket = padding_bucket(max(1, n_sets), max(1, n_pks))
+        # second multi-minute compile of the identical program. The key
+        # is the SAME lane-aware one _bucket computes for a real batch of
+        # this size — the lane decides which program the warm below
+        # compiles (via _device_submitters) AND which program this warm
+        # state may vouch for.
+        lane = self._lane(max(1, n_sets))
+        bucket = (lane, padding_bucket(
+            max(1, n_sets), max(1, n_pks), single_chip=(lane == "urgent"),
+        ))
         with self._lock:
             if bucket in self._warm_buckets:
                 return True
@@ -397,8 +426,13 @@ class HybridBackend:
             t0 = time.time()
             # dummy sets verify False; the compile is the point. NOT
             # recorded via _record_device_ok: the compile-inclusive wall
-            # time must not enter the p99 window the budget router reads
-            self._device.verify_signature_sets(sets, [1] * len(sets))
+            # time must not enter the p99 window the budget router reads.
+            # Warm through the SAME lane the serving path will pick: a
+            # small bucket's verifies ride the urgent lane, whose program
+            # (single-chip on a meshed node) is distinct from the sharded
+            # one — the startup plan must precompile the one that serves
+            submit, _ = self._device_submitters(sets)
+            submit(sets, [1] * len(sets))
             with self._lock:
                 self._warm_buckets.add(bucket)
             self._log.info("bucket warmed (startup plan)", bucket=str(bucket),

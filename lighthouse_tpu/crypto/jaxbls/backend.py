@@ -91,6 +91,8 @@ MIN_PKS = 1
 Z_WINDOW = 1          # z-scaling digit width: 1 = plain double-and-add bits
 Z_DIGITS = 64 // Z_WINDOW
 
+_LIVE_MESH = object()  # sentinel: "resolve parallel.get_mesh() lazily"
+
 
 def _next_pow2(n: int) -> int:
     p = 1
@@ -99,17 +101,29 @@ def _next_pow2(n: int) -> int:
     return p
 
 
-def padding_bucket(n_sets: int, n_pks: int) -> tuple:
+def padding_bucket(n_sets: int, n_pks: int, mesh=_LIVE_MESH,
+                   single_chip: bool = False) -> tuple:
     """THE (n, m) compile-bucket rounding rule of the dispatch path, for a
     workload of n_sets sets whose widest set has n_pks pubkeys. Single
     owner — the hybrid router's bucket tracking and the autotune
     calibrator classify by calling this, so their keys can never desync
-    from what actually compiles."""
+    from what actually compiles.
+
+    Mesh-shape-keyed: the set (and on a 2-D mesh, pubkey) axis rounds up
+    to a multiple of the mesh axis so every dispatched batch shards
+    evenly; pass an explicit `mesh` to bucket for a topology other than
+    the live one (the --mesh-devices sweep), or `single_chip=True` for
+    the urgent bypass lane's plain pow2 buckets (urgent verifies are
+    pinned to one chip and never pay mesh padding)."""
+    n = max(MIN_SETS, _next_pow2(n_sets))
+    m = max(MIN_PKS, _next_pow2(n_pks))
+    if single_chip:
+        return n, m
     from ...parallel import pad_pks, pad_sets
 
-    n = pad_sets(max(MIN_SETS, _next_pow2(n_sets)))
-    m = pad_pks(max(MIN_PKS, _next_pow2(n_pks)))
-    return n, m
+    if mesh is _LIVE_MESH:
+        return pad_sets(n), pad_pks(m)
+    return pad_sets(n, mesh=mesh), pad_pks(m, mesh=mesh)
 
 
 # ------------------------------------------------------------ host marshalling
@@ -303,8 +317,136 @@ def _init_consts():
         _NEG_G1_GEN = (tw.fq_to_device(gx), tw.fq_to_device(gy))
 
 
-def _get_stages():
+def _build_shard_map_pairing(mesh):
+    """Stage-4 pair product as an EXPLICIT collective (the fallback when
+    sharding propagation through the jit build fails): each shard runs the
+    shared-accumulator Miller loop over its LOCAL pairs — partial products
+    over disjoint pair subsets multiply to the full Miller value, and
+    conjugation (x < 0) distributes over the product — then one all_gather
+    over the sets axis, an Fq12 product of the per-shard partials, and a
+    replicated final exponentiation. The pair axis (n_sets + 1, never
+    mesh-divisible) is padded with masked identity lanes first."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map as _shard_map  # newer jax
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+    from ...parallel.mesh import SET_AXIS
+
+    d = int(mesh.shape[SET_AXIS])
+
+    def local_product(px, py, qxx, qyy, pair_mask):
+        f = po.miller_loop_product((px, py), (qxx, qyy), pair_mask)
+        fs = jax.lax.all_gather(f, SET_AXIS)       # (d, ...) partials
+        f = po.fq12_product_any(fs)                # replicated compute
+        f = po.final_exponentiation(f)
+        return tw.fq12_eq_one(f)
+
+    sharded = _shard_map(
+        local_product, mesh=mesh,
+        in_specs=(
+            P(SET_AXIS, None), P(SET_AXIS, None),
+            P(SET_AXIS, None, None), P(SET_AXIS, None, None),
+            P(SET_AXIS),
+        ),
+        out_specs=P(),
+        check_rep=False,  # the gathered product IS replicated; the rep
+    )                     # checker cannot see through all_gather
+
+    def pairing(px, py, qxx, qyy, pair_mask):
+        pad = (-px.shape[0]) % d
+        if pad:
+            def z(a):
+                return jnp.concatenate(
+                    [a, jnp.zeros((pad,) + a.shape[1:], a.dtype)]
+                )
+
+            px, py, qxx, qyy = z(px), z(py), z(qxx), z(qyy)
+            pair_mask = jnp.concatenate(
+                [pair_mask, jnp.zeros((pad,), pair_mask.dtype)]
+            )
+        return sharded(px, py, qxx, qyy, pair_mask)
+
+    return jax.jit(pairing)
+
+
+class _PairingDispatch:
+    """Stage-4 dispatcher for the meshed pipeline: the explicit-sharding
+    jit build first; if its compile fails (XLA sharding propagation can
+    reject the uneven n+1 pair axis on some topologies), ONE structured
+    warn and a permanent flip to the shard_map build. Callable like the
+    plain jitted stage; `.lower` delegates so program-analytics capture
+    keeps working on whichever build serves."""
+
+    def __init__(self, mesh, jitted, donate: bool = False):
+        self._mesh = mesh
+        self._jit = jitted
+        self._donate = donate
+        self._fallback = None
+        self._use_fallback = False
+        self._jit_served = False  # the explicit build compiled + ran once
+
+    def _get_fallback(self):
+        if self._fallback is None:
+            self._fallback = _build_shard_map_pairing(self._mesh)
+        return self._fallback
+
+    def __call__(self, *args):
+        if not self._use_fallback:
+            try:
+                out = self._jit(*args)
+                self._jit_served = True
+                return out
+            except Exception as e:
+                if self._jit_served:
+                    # the explicit build has compiled and served before:
+                    # this is a RUNTIME failure (device OOM, tunnel drop),
+                    # not sharding propagation — surface it. Flipping here
+                    # would also retry with already-donated buffers.
+                    raise
+                from ...utils.logging import get_logger
+
+                self._use_fallback = True
+                get_logger("jaxbls").warn(
+                    "sharded pairing stage failed on first dispatch; "
+                    "future pairing dispatches take the shard_map "
+                    "pair-product collective",
+                    error=f"{type(e).__name__}: {e}",
+                )
+                if self._donate:
+                    # the failed attempt may have CONSUMED the donated
+                    # inputs — an in-line retry would mask the real error
+                    # with 'Array has been deleted'. Surface this failure
+                    # (the hybrid router serves it from the host); the
+                    # NEXT dispatch rides the fallback with fresh buffers.
+                    raise
+        return self._get_fallback()(*args)
+
+    def lower(self, *args):
+        fn = self._get_fallback() if self._use_fallback else self._jit
+        return fn.lower(*args)
+
+
+def _get_stages(mesh=None):
     """Jitted stage functions (each cached separately on disk).
+
+    With `mesh=None` (the urgent single-chip lane, host-side callers like
+    aggregate_verify, and single-device processes) the stages are plain
+    jits — input placement decides the executable. With a mesh, the
+    stages compile under that mesh's contract: explicit `in_shardings`
+    over the 1-D `sets` (2-D `(sets, pks)`) axes for every host-marshalled
+    input — exactly the NamedShardings `put_sets`/`put_pk_grid` commit, so
+    the lowered programs (and their persistent-cache keys) are identical
+    to what propagation produced, but a mis-placed input now fails loudly
+    instead of silently resharding. Stage-OUTPUT inputs (z_pk/h_jac/
+    sig_acc) keep `None` entries — their shardings are XLA's choice — and
+    output shardings stay XLA's too (pinning them forks the compile cache
+    for zero layout change; docs/PERF_NOTES.md "Multichip serving"). The
+    stage-4 pair product gets a shard_map fallback via _PairingDispatch.
 
     With buffer donation on (pipeline.donation_enabled — default on
     accelerators, env/flag overridable) the per-batch inputs are marked
@@ -320,32 +462,69 @@ def _get_stages():
                set_mask — all dead after pair assembly;
       pairing: everything (the output is one scalar).
 
-    Cached per donation mode — tests flip LIGHTHOUSE_TPU_DONATE within
-    one process and the donation decision is baked into the jit."""
+    Cached per (donation mode, mesh signature) — tests flip
+    LIGHTHOUSE_TPU_DONATE and the mesh seams within one process and both
+    decisions are baked into the jit."""
     import jax
 
     from . import pipeline as pl
 
     _init_consts()
     donate = pl.donation_enabled()[0]
-    key = f"stages_d{int(donate)}"
+    if mesh is None:
+        key = f"stages_d{int(donate)}"
+    else:
+        from ...parallel import mesh_shape_key
+
+        key = f"stages_d{int(donate)}_{mesh_shape_key(mesh)}"
     if key not in _kernel_cache:
         from ...utils.jaxcfg import setup_compilation_cache
 
         setup_compilation_cache()
-        if donate:
+        donate_kw = (
+            dict(
+                prepare=dict(donate_argnums=(3, 4, 5)),
+                h2c=dict(donate_argnums=(0,)),
+                pairs=dict(donate_argnums=(0, 1, 2, 3)),
+                pairing=dict(donate_argnums=(0, 1, 2, 3, 4)),
+            )
+            if donate
+            else dict(prepare={}, h2c={}, pairs={}, pairing={})
+        )
+        if mesh is None:
             _kernel_cache[key] = (
-                jax.jit(_stage_prepare, donate_argnums=(3, 4, 5)),
-                jax.jit(h2.hash_to_g2_jacobian, donate_argnums=(0,)),
-                jax.jit(_stage_pairs, donate_argnums=(0, 1, 2, 3)),
-                jax.jit(_stage_pairing, donate_argnums=(0, 1, 2, 3, 4)),
+                jax.jit(_stage_prepare, **donate_kw["prepare"]),
+                jax.jit(h2.hash_to_g2_jacobian, **donate_kw["h2c"]),
+                jax.jit(_stage_pairs, **donate_kw["pairs"]),
+                jax.jit(_stage_pairing, **donate_kw["pairing"]),
             )
         else:
+            from ...parallel import mesh as pm
+
+            def sets_s(ndim):
+                return pm.sets_sharding(mesh, ndim)
+
+            pk_s = (
+                pm.pks_sharding if pm.PK_AXIS in mesh.axis_names
+                else pm.sets_sharding
+            )
+            prepare_in = (
+                pk_s(mesh, 3), pk_s(mesh, 3), pk_s(mesh, 2),  # pk_x/y/mask
+                sets_s(3), sets_s(3),                          # sig_x/sig_y
+                sets_s(2), sets_s(1),                          # z_digits/mask
+            )
+            pairs_in = (None, None, None, sets_s(1))  # stage outputs + mask
             _kernel_cache[key] = (
-                jax.jit(_stage_prepare),
-                jax.jit(h2.hash_to_g2_jacobian),
-                jax.jit(_stage_pairs),
-                jax.jit(_stage_pairing),
+                jax.jit(_stage_prepare, in_shardings=prepare_in,
+                        **donate_kw["prepare"]),
+                jax.jit(h2.hash_to_g2_jacobian, in_shardings=(sets_s(4),),
+                        **donate_kw["h2c"]),
+                jax.jit(_stage_pairs, in_shardings=pairs_in,
+                        **donate_kw["pairs"]),
+                _PairingDispatch(
+                    mesh, jax.jit(_stage_pairing, **donate_kw["pairing"]),
+                    donate=donate,
+                ),
             )
     return _kernel_cache[key]
 
@@ -362,11 +541,12 @@ def _get_kernel():
     return _kernel_cache["k"]
 
 
-def warm_stages(n_sets: int, n_pks: int) -> None:
+def warm_stages(n_sets: int, n_pks: int, single_chip: bool = False) -> None:
     """Pre-compile the prepare and hash-to-G2 stages for one bucket shape,
     CONCURRENTLY. Their input layouts are fully determined by the marshal
-    (leading set axis sharded over the mesh), so dummy zero inputs placed
-    the same way hit the same jit-cache entries the real dispatch will use,
+    (leading set axis sharded over the mesh — or whole on one chip for the
+    urgent lane with `single_chip=True`), so dummy zero inputs placed the
+    same way hit the same jit-cache entries the real dispatch will use,
     and compiling both in threads makes the wall cost ~max of the two
     largest programs instead of their sum (the r4 multichip dryrun timed
     out in sequential XLA:CPU stage compiles — ~3 min for prepare alone).
@@ -374,7 +554,8 @@ def warm_stages(n_sets: int, n_pks: int) -> None:
     they still compile on first real dispatch.
 
     Callers: the node's startup warmup thread walks the autotune plan's
-    bucket list through here (autotune/runtime.start_warmup); tests and
+    bucket list through here (autotune/runtime.start_warmup — which also
+    warms the single-chip variant of the plan's urgent shapes); tests and
     bench warm ad-hoc shapes. The wall time is recorded as the bucket's
     compile cost in the autotune profiler."""
     import threading
@@ -383,12 +564,15 @@ def warm_stages(n_sets: int, n_pks: int) -> None:
     import jax
 
     from ...autotune import profiler
-    from ...parallel import put_pk_grid, put_sets
+    from ...parallel import get_mesh, put_pk_grid, put_single, put_sets
 
-    prepare, h2c_stage, _, _ = _get_stages()
-    n, m = padding_bucket(n_sets, n_pks)
+    mesh = None if single_chip else get_mesh()
+    prepare, h2c_stage, _, _ = _get_stages(mesh=mesh)
+    n, m = padding_bucket(n_sets, n_pks, mesh=mesh, single_chip=single_chip)
     t0 = time.time()
 
+    if single_chip:
+        put_pk_grid = put_sets = put_single  # noqa: F811 — one placement
     pk_x = put_pk_grid(np.zeros((n, m, lb.NL), np.uint32))
     pk_y = put_pk_grid(np.zeros((n, m, lb.NL), np.uint32))
     pk_mask = put_pk_grid(np.ones((n, m), np.uint32))
@@ -517,20 +701,31 @@ class JaxBackend:
 
     # -- the multi-set hot path ------------------------------------------
 
-    def _marshal_pubkeys(self, sets, n: int, m: int):
+    def _marshal_pubkeys(self, sets, n: int, m: int, single_chip: bool = False):
         """(n, m, NL) standard-form limb arrays for all signing keys.
 
         Cached on device keyed by the identity of the pubkey objects — the
         steady-state path (gossip firehose over a known validator registry)
         re-verifies the same PublicKey objects every slot, so after the
         first batch the pubkey upload cost disappears (the analog of the
-        reference keeping decompressed keys in ValidatorPubkeyCache)."""
+        reference keeping decompressed keys in ValidatorPubkeyCache). The
+        placement lane is part of the key — single-chip by name, meshed
+        by TOPOLOGY: a grid sharded for one mesh must never feed the
+        urgent single-chip program or a re-resolved mesh of another
+        shape (the --mesh-devices sweep flips topologies mid-process)."""
         import jax
 
+        if single_chip:
+            lane = "single"
+        else:
+            from ...parallel import mesh_shape_key
+
+            lane = mesh_shape_key()
         # fingerprint covers the set grouping, not just the flat key sequence:
         # the same keys split differently must not reuse another layout's
         # aggregation mask
         fp = (
+            lane,
             tuple(len(s.signing_keys) for s in sets),
             tuple(id(pk) for s in sets for pk in s.signing_keys),
         )
@@ -550,14 +745,16 @@ class JaxBackend:
             pk_x[i, : len(keys)] = xs
             pk_y[i, : len(keys)] = ys
             pk_mask[i, : len(keys)] = 1
-        from ...parallel import put_pk_grid
+        from ...parallel import put_pk_grid, put_single
 
         _MARSHALLED_BYTES.labels("pubkeys").inc(
             pk_x.nbytes + pk_y.nbytes + pk_mask.nbytes
         )
         # (n, m, ...) pubkey arrays: set axis sharded; on a 2-D mesh the
-        # pubkey axis is sharded too (within-set aggregation parallelism)
-        dx, dy, dm = put_pk_grid(pk_x), put_pk_grid(pk_y), put_pk_grid(pk_mask)
+        # pubkey axis is sharded too (within-set aggregation parallelism).
+        # Urgent single-chip batches place whole on one device instead.
+        put = put_single if single_chip else put_pk_grid
+        dx, dy, dm = put(pk_x), put(pk_y), put(pk_mask)
         # keep strong refs to the key objects so ids stay valid while cached
         keepalive = (fp, [pk for s in sets for pk in s.signing_keys])
         self._pk_cache[fp] = (dx, dy, dm, keepalive)
@@ -576,20 +773,39 @@ class JaxBackend:
         already in flight (resolving the oldest — the double-buffering
         backpressure). `urgent=True` takes the bypass lane: no window
         wait, no window slot — the low-latency path for single-set
-        verifies. Returns a ticket with .result() -> bool."""
+        verifies, PINNED SINGLE-CHIP (plain pow2 bucket, whole-array
+        placement on one device, the unsharded stage programs) so
+        sharding never taxes the ~ms path with mesh padding or
+        collective latency. Returns a ticket with .result() -> bool."""
         import time
 
-        from ...parallel import put_sets
+        from ...parallel import get_mesh, put_single, put_sets
+        from ...parallel.mesh import MESH_DISPATCH
 
         t_marshal = time.perf_counter()
-        prepare, h2c_stage, pairs_stage, pairing_stage = _get_stages()
+        mesh = None if urgent else get_mesh()
+        single_chip = mesh is None
+        prepare, h2c_stage, pairs_stage, pairing_stage = _get_stages(mesh=mesh)
         n_real = len(sets)
         # pad the set axis to the compile bucket AND to a multiple of the
         # device mesh (multi-chip: sets are data-parallel over the mesh,
-        # the cross-set reductions become collectives — parallel/mesh.py)
-        n, m = padding_bucket(n_real, max(len(s.signing_keys) for s in sets))
+        # the cross-set reductions become collectives — parallel/mesh.py);
+        # the urgent lane keeps plain pow2 buckets on one chip
+        n, m = padding_bucket(
+            n_real, max(len(s.signing_keys) for s in sets),
+            mesh=mesh, single_chip=single_chip,
+        )
+        # three truthful lanes: urgent bypass (pinned to one chip), meshed
+        # batch, and ordinary batch on a mesh-less node — a dashboard must
+        # never read single-device batch traffic as urgent-path activity
+        MESH_DISPATCH.labels(
+            "urgent" if urgent else ("sharded" if mesh is not None
+                                     else "single_device")
+        ).inc()
 
-        pk_x, pk_y, pk_mask = self._marshal_pubkeys(sets, n, m)
+        pk_x, pk_y, pk_mask = self._marshal_pubkeys(
+            sets, n, m, single_chip=single_chip
+        )
 
         sig_x = np.zeros((n, 2, lb.NL), np.uint32)
         sig_y = np.zeros((n, 2, lb.NL), np.uint32)
@@ -621,11 +837,11 @@ class JaxBackend:
             + set_mask.nbytes + us.nbytes
         )
         # staged dispatch: intermediates stay on device between jit calls,
-        # inputs placed with the set axis sharded over the mesh (no-op on
-        # one device)
+        # inputs placed with the set axis sharded over the mesh (urgent:
+        # whole on one chip; also the no-mesh single-device case)
+        put = put_single if single_chip else put_sets
         sig_x, sig_y, z_digits, set_mask, us = (
-            put_sets(sig_x), put_sets(sig_y), put_sets(z_digits),
-            put_sets(set_mask), put_sets(us),
+            put(sig_x), put(sig_y), put(z_digits), put(set_mask), put(us),
         )
         t_marshalled = time.perf_counter()
         _MARSHAL_SECONDS.observe(t_marshalled - t_marshal)

@@ -21,7 +21,11 @@ dispatch & buffer donation"):
     window entirely — they never wait behind queued firehose batches
     and never occupy a window slot, so a gossip block's proposer check
     is not taxed by 4 x 512-set batches in flight (the config1 p50
-    lever, target < 100 ms = one slot-fraction).
+    lever, target < 100 ms = one slot-fraction). On a multi-chip mesh
+    the lane is additionally PINNED SINGLE-CHIP (backend.py r10): plain
+    pow2 buckets, whole-array placement on one device, the unsharded
+    stage programs — mesh padding and collective latency never tax the
+    ~ms path (`mesh_sharded_dispatch_total{lane}` counts both lanes).
   - **input-buffer donation policy**: whether the four staged jit
     programs are built with `donate_argnums` (crypto/jaxbls/backend.py
     `_get_stages`). Donated per-batch inputs (sig/z/us/stage

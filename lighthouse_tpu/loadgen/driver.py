@@ -28,18 +28,34 @@ def default_report_path(smoke: bool) -> str:
 
 def drive(*, scenario=None, smoke=False, slots=None, validators=None,
           seed=None, flood_factor=None, out=None, quiet=False,
-          datadir=None, stdout=None, stderr=None) -> int:
+          datadir=None, mesh_devices=None, bench_matrix=False,
+          bench_root=None, stdout=None, stderr=None) -> int:
     """Run one scenario and print the one-line JSON summary. Returns a
     process exit code. `--smoke` alone runs the 'smoke' scenario; combined
     with an explicit --scenario it is a SIZE modifier — the named scenario
     shrunk to smoke scale (same faults and mix, clamped validators/slots),
-    e.g. `bn loadtest --scenario crash_restart --smoke`."""
+    e.g. `bn loadtest --scenario crash_restart --smoke`.
+
+    `--mesh-devices 1,8` turns the run into a mesh SWEEP: the scenario
+    runs once per chip count over the mesh-sharded device harness
+    (loadgen/meshsim.py), the summary reports sets/s + p50 per point,
+    the run FAILS unless the largest point out-serves the smallest, and
+    every point lands as a `source: loadtest` BENCH_MATRIX row.
+    `--bench-matrix` opts a single (non-sweep) run into the same row
+    write; `--bench-root` redirects where the matrix lives (tests)."""
     from .runner import run_scenario
     from .scenarios import get_scenario, is_multinode, smoke_variant
 
     stdout = stdout or sys.stdout
     stderr = stderr or sys.stderr
     name = "smoke" if smoke and scenario is None else (scenario or "smoke")
+    if mesh_devices:
+        return _drive_mesh_sweep(
+            name, mesh_devices, smoke=smoke, slots=slots,
+            validators=validators, seed=seed, flood_factor=flood_factor,
+            out=out, quiet=quiet, datadir=datadir, bench_root=bench_root,
+            stdout=stdout, stderr=stderr,
+        )
     if is_multinode(name):
         return _drive_multinode(
             name, smoke=smoke, slots=slots, validators=validators,
@@ -78,7 +94,17 @@ def drive(*, scenario=None, smoke=False, slots=None, validators=None,
     if "crash" in report:
         summary["crash"] = report["crash"]
         summary["conservation"] = report["conservation"]
+    if "mesh" in report:
+        summary["mesh"] = {
+            k: report["mesh"][k]
+            for k in ("devices", "sets_per_sec", "verify_p50_ms",
+                      "stall_hits", "urgent_served", "urgent_stalled")
+            if k in report["mesh"]
+        }
     print(json.dumps(summary), file=stdout)
+    if bench_matrix:
+        _write_matrix_rows(name, {None: report}, smoke=smoke,
+                           bench_root=bench_root, stderr=stderr)
     if "crash" in report and not (
         report["crash"]["resumed_from_persisted_head"]
         and report["conservation"]["ok"]
@@ -94,6 +120,199 @@ def drive(*, scenario=None, smoke=False, slots=None, validators=None,
         # dump means the black box is broken — fail loudly
         print("error: device_stall produced no incident dump "
               "(see report slo block)", file=stderr)
+        return 1
+    if "mesh_stall" in report.get("faults", ()):
+        rc = _check_mesh_stall(report, stderr)
+        if rc:
+            return rc
+    return 0
+
+
+def _check_mesh_stall(report, stderr) -> int:
+    """mesh_stall acceptance: the stalled chip must produce breaker-
+    mediated DEGRADATION (deadline-hit ratio dips while the collective is
+    wedged) followed by RECOVERY (the healed slots serve on time again),
+    with at least one schema-valid incident dumped — never a silently
+    wedged pipeline window."""
+    if not report["slo"]["incidents"]:
+        print("error: mesh_stall produced no incident dump "
+              "(see report slo block)", file=stderr)
+        return 1
+    ratios = [
+        s["deadline_hit_ratio"] for s in report["slo"]["per_slot"]
+        if s["deadline_hit_ratio"] is not None
+    ]
+    if not ratios or min(ratios) >= 1.0:
+        print("error: mesh_stall produced no deadline-hit-ratio dip "
+              "(the stalled shard was never felt)", file=stderr)
+        return 1
+    if ratios[-1] <= min(ratios):
+        print("error: mesh_stall never recovered after the heal "
+              f"(per-slot ratios: {ratios})", file=stderr)
+        return 1
+    return 0
+
+
+def _write_matrix_rows(name, reports_by_point, *, smoke, bench_root,
+                       stderr) -> dict:
+    """Snapshot measured sets/s + p50 into the BENCH_MATRIX schema with a
+    `source: loadtest` tag (observability/perf.write_loadtest_rows) — the
+    tunnel-proof bench seam: any soak through `bn loadtest` doubles as a
+    bench round, and the trend gate reads the rows as fresh."""
+    import time as _time
+
+    from ..observability import perf as _perf
+
+    rows = {}
+    stamp = round(_time.time(), 3)
+    for point, report in reports_by_point.items():
+        mesh = report.get("mesh") or {}
+        obs = mesh or report.get("verify_observations") or {}
+        key = f"loadtest_{name}" if point is None else (
+            f"loadtest_{name}_mesh{point}"
+        )
+        row = {
+            "source": "loadtest",
+            "scenario": report["scenario"],
+            "measured_unix": stamp,
+            "n_devices": mesh.get("devices", 1),
+            "deadline_hit_ratio": report["slo"]["deadline_hit_ratio"],
+        }
+        # only measured values enter the matrix: a null rate row would
+        # read as a measurement (and trip every later matrix parse) when
+        # it really means "this run had no device-timed batches"
+        if obs.get("sets_per_sec") is not None:
+            row["sets_per_sec"] = obs["sets_per_sec"]
+        if obs.get("verify_p50_ms") is not None:
+            row["p50_ms"] = obs["verify_p50_ms"]
+        rows[key] = row
+    try:
+        path = _perf.write_loadtest_rows(rows, smoke=smoke, root=bench_root)
+        print(f"bench matrix rows -> {path}", file=stderr)
+    except Exception as e:  # a bench snapshot must never fail the run
+        print(f"warning: bench matrix write failed: {e}", file=stderr)
+    return rows
+
+
+def _drive_mesh_sweep(name, points, *, smoke, slots, validators, seed,
+                      flood_factor, out, quiet, datadir, bench_root,
+                      stdout, stderr) -> int:
+    """The --mesh-devices sweep: one run per chip count over the
+    mesh-sharded harness; asserts the biggest mesh out-serves the
+    smallest (near-linear scaling is the whole point of sharding the
+    dispatcher) and snapshots every point into BENCH_MATRIX rows."""
+    from dataclasses import replace
+
+    from .runner import run_scenario
+    from .scenarios import get_scenario, is_multinode, smoke_variant
+
+    if is_multinode(name):
+        print(f"error: --mesh-devices does not apply to multi-node "
+              f"scenario {name!r}", file=stderr)
+        return 1
+    try:
+        points = sorted({int(p) for p in points})
+    except (TypeError, ValueError):
+        print(f"error: bad --mesh-devices list {points!r}", file=stderr)
+        return 1
+    try:
+        base = get_scenario(name, slots=slots, n_validators=validators,
+                            seed=seed, flood_factor=flood_factor)
+    except KeyError as e:
+        print(f"error: {e.args[0]}", file=stderr)
+        return 1
+    if smoke and base.name != "smoke":
+        base = smoke_variant(base)
+    incompatible = {"device_stall", "storage_crash", "mesh_stall"} & set(
+        base.faults
+    )
+    if incompatible:
+        # device_stall/storage_crash drive surfaces the mesh harness does
+        # not have; mesh_stall's acceptance (urgent lane unaffected, dip +
+        # recovery) is ill-defined at the sweep's 1-chip point, where the
+        # wedged chip IS the urgent lane's — run it standalone, where the
+        # driver enforces its gate. Refuse cleanly instead of tracebacking
+        # (or silently skipping a gate) mid-sweep.
+        print(
+            f"error: --mesh-devices cannot sweep scenario {name!r} "
+            f"(fault(s) {sorted(incompatible)} don't compose with a "
+            "chip-count sweep); use flood/steady/slow_host, and run "
+            "mesh_stall standalone",
+            file=stderr,
+        )
+        return 1
+    out = out or default_report_path(smoke)
+    reports = {}
+    prev_env = os.environ.get("LIGHTHOUSE_TPU_MESH_DEVICES")
+
+    def _reset_mesh():
+        try:
+            from ..parallel import reset_mesh_cache
+
+            reset_mesh_cache()
+        except Exception:
+            pass
+
+    try:
+        for d in points:
+            sc = replace(base, mesh=True, mesh_devices=d)
+            # flip the REAL mesh seam too, so a harness with virtual
+            # devices (XLA_FLAGS=--xla_force_host_platform_device_count=8)
+            # exercises production mesh bring-up at every sweep point
+            os.environ["LIGHTHOUSE_TPU_MESH_DEVICES"] = str(d)
+            _reset_mesh()
+            reports[d] = run_scenario(
+                sc, out_path=None, datadir=datadir,
+                log_fn=None if quiet else (
+                    lambda m, _d=d: print(f"[mesh={_d}] {m}", file=stderr,
+                                          flush=True)
+                ),
+            )
+    finally:
+        # restore (never destroy) an operator-set seam and re-resolve the
+        # process-wide mesh so nothing after the sweep serves on the last
+        # point's topology
+        if prev_env is None:
+            os.environ.pop("LIGHTHOUSE_TPU_MESH_DEVICES", None)
+        else:
+            os.environ["LIGHTHOUSE_TPU_MESH_DEVICES"] = prev_env
+        _reset_mesh()
+    rows = _write_matrix_rows(name, reports, smoke=smoke,
+                              bench_root=bench_root, stderr=stderr)
+    sweep = {
+        "scenario": name,
+        "report": out,
+        "mesh_sweep": {
+            str(d): {
+                "sets_per_sec": r["mesh"]["sets_per_sec"],
+                "verify_p50_ms": r["mesh"]["verify_p50_ms"],
+                "deadline_hit_ratio": r["slo"]["deadline_hit_ratio"],
+                "device_batches": r["mesh"]["device_batches"],
+            }
+            for d, r in reports.items()
+        },
+        "matrix_rows": sorted(rows),
+    }
+    lo, hi = points[0], points[-1]
+    lo_rate = reports[lo]["mesh"]["sets_per_sec"] or 0.0
+    hi_rate = reports[hi]["mesh"]["sets_per_sec"] or 0.0
+    if len(points) > 1:
+        sweep["scaling"] = {
+            "from_devices": lo, "to_devices": hi,
+            "speedup": round(hi_rate / lo_rate, 3) if lo_rate else None,
+        }
+    if out:
+        with open(out, "w") as f:
+            json.dump({"sweep": sweep, "points": {
+                str(d): r for d, r in reports.items()
+            }}, f, indent=1)
+    print(json.dumps(sweep), file=stdout)
+    if len(points) > 1 and not hi_rate > lo_rate:
+        print(
+            f"error: mesh sweep did not scale: {hi}-device point "
+            f"({hi_rate} sets/s) is not above the {lo}-device point "
+            f"({lo_rate} sets/s)", file=stderr,
+        )
         return 1
     return 0
 
@@ -162,10 +381,10 @@ def add_loadtest_args(parser) -> None:
     """The flag set shared by both entry points."""
     parser.add_argument("--scenario", default=None,
                         help="named scenario: smoke, steady, flood, "
-                             "device_stall, slow_host, crash_restart, "
-                             "or a multi-node family: partition_heal, "
-                             "fork_reorg, sync_catchup, equivocation_storm "
-                             "(default: smoke)")
+                             "device_stall, mesh_stall, slow_host, "
+                             "crash_restart, or a multi-node family: "
+                             "partition_heal, fork_reorg, sync_catchup, "
+                             "equivocation_storm (default: smoke)")
     parser.add_argument("--smoke", action="store_true",
                         help="alone: run the ~5s CPU-only smoke scenario; "
                              "with --scenario: run that scenario shrunk to "
@@ -188,12 +407,30 @@ def add_loadtest_args(parser) -> None:
     parser.add_argument("--datadir", default=None,
                         help="datadir for store-backed scenarios "
                              "(crash_restart); default: a fresh tmp dir")
+    parser.add_argument("--mesh-devices", default=None,
+                        help="comma list of chip counts (e.g. 1,8): run "
+                             "the scenario once per count over the "
+                             "mesh-sharded device harness, assert the "
+                             "largest mesh out-serves the smallest, and "
+                             "write each point as a source:loadtest "
+                             "BENCH_MATRIX row")
+    parser.add_argument("--bench-matrix", action="store_true",
+                        help="snapshot this run's measured sets/s + p50 "
+                             "into the BENCH_MATRIX schema (source: "
+                             "loadtest); sweeps always do")
+    parser.add_argument("--bench-root", default=None,
+                        help="directory for the BENCH_MATRIX write "
+                             "(default: the repo root)")
 
 
 def drive_from_args(args) -> int:
+    mesh_devices = None
+    if getattr(args, "mesh_devices", None):
+        mesh_devices = [p for p in str(args.mesh_devices).split(",") if p]
     return drive(
         scenario=args.scenario, smoke=args.smoke, slots=args.slots,
         validators=args.validators, seed=args.seed,
         flood_factor=args.flood_factor, out=args.out, quiet=args.quiet,
-        datadir=args.datadir,
+        datadir=args.datadir, mesh_devices=mesh_devices,
+        bench_matrix=args.bench_matrix, bench_root=args.bench_root,
     )

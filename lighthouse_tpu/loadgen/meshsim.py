@@ -1,0 +1,229 @@
+"""Mesh-aware device simulation: the multichip harness behind the
+`bn loadtest --mesh-devices` sweep and the `mesh_stall` scenario.
+
+`MeshShardedBackend` stands in for the device leg of an N-chip mesh with
+the COLLECTIVE cost semantics the real sharded pipeline has
+(crypto/jaxbls/backend.py + parallel/mesh.py):
+
+  - a batch of n sets shards over the set axis: each chip serves
+    ceil(n / D) sets, so the batch's device time is
+    `base_ms + per_set_ms * ceil(n / D)` — near-linear sets/s scaling
+    1 -> D is the shape the sweep asserts;
+  - the cross-set reductions are collectives: EVERY chip must arrive, so
+    one stalled chip stalls the WHOLE batch (`stall_chip(i)` — the
+    mesh_stall scenario's fault). A stalled batch waits a bounded
+    `wait_secs` then raises DeviceStallError, exactly the signal the
+    breaker/hybrid router sees from a wedged chip;
+  - the urgent lane is PINNED SINGLE-CHIP (the jaxbls contract): urgent
+    submissions cost the full single-chip time and only stall when chip 0
+    (the pinned one) is stalled.
+
+Every submission rides a REAL `PipelinedDispatcher`
+(crypto/jaxbls/pipeline.py — jax-free at import), so the loadgen mesh
+scenarios drive the production FIFO window, urgent bypass and
+jaxbls_pipeline_* accounting end to end; the simulated part is only the
+per-chip cost model. The chip count resolves against the REAL mesh layer
+(`parallel.get_mesh()` under the forced-host-device harness,
+XLA_FLAGS=--xla_force_host_platform_device_count=8) unless pinned
+explicitly, so mesh bring-up, axis gauges and flight-recorder events are
+the production ones.
+
+Wall-clock observations (sets/s, p50) are kept OUT of the deterministic
+report core — they land in the report's `mesh` block and, via the
+--mesh-devices sweep, in BENCH_MATRIX rows tagged `source: loadtest`
+(observability/perf.write_loadtest_rows).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+
+from ..utils.metrics import REGISTRY
+from .faults import DeviceStallError
+
+# mesh_* series are labeled families (scripts/lint_metrics.py enforces
+# it): per-chip breakdowns are the whole point of the harness
+_CHIP_BUSY = REGISTRY.counter_vec(
+    "mesh_chip_busy_seconds_total",
+    "simulated per-chip compute seconds served by the mesh harness",
+    ("chip",),
+)
+_CHIP_STALLS = REGISTRY.counter_vec(
+    "mesh_chip_stalls_total",
+    "batches that hit a stalled chip's shard at the collective barrier, "
+    "by the chip that stalled them",
+    ("chip",),
+)
+_COLLECTIVE_WAIT = REGISTRY.histogram_vec(
+    "mesh_collective_wait_seconds",
+    "simulated wait at the collective barrier, by outcome (arrived = all "
+    "chips on time, stalled = a chip never arrived within the budget)",
+    ("outcome",),
+    buckets=(0.0001, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0),
+)
+
+
+def resolve_mesh_devices(explicit: int | None = None) -> int:
+    """Chip count for a mesh scenario: explicit override (the sweep's
+    points) > the REAL resolved mesh's total device count > 1. Resolving
+    through parallel.get_mesh() is deliberate — it exercises production
+    mesh bring-up (env seams, axis gauges, flight-recorder event) under
+    the forced-host-device harness."""
+    if explicit is not None:
+        return max(1, int(explicit))
+    try:
+        from ..parallel import get_mesh
+
+        mesh = get_mesh()
+        return int(mesh.devices.size) if mesh is not None else 1
+    except Exception:
+        return 1
+
+
+class MeshShardedBackend:
+    """Scriptable N-chip device stand-in with collective semantics."""
+
+    name = "loadgen_mesh"
+
+    def __init__(self, n_devices: int, *, base_ms: float = 0.5,
+                 per_set_ms: float = 0.02, wait_secs: float = 0.02,
+                 verdict: bool = True):
+        self.n_devices = max(1, int(n_devices))
+        self.base_secs = base_ms / 1e3
+        self.per_set_secs = per_set_ms / 1e3
+        self.wait_secs = wait_secs
+        self.verdict = verdict
+        self.calls = 0
+        self.stall_hits = 0
+        # simulated compute seconds per chip (the occupancy ledger the
+        # report's mesh block summarizes)
+        self.chip_busy = [0.0] * self.n_devices
+        self._stalled: set = set()
+        self._released = threading.Event()
+        self._released.set()
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ faults
+
+    def stall_chip(self, chip: int) -> None:
+        """Stall one chip's shard: every sharded batch (and urgent work
+        when chip 0 is hit) now blocks at the collective barrier."""
+        with self._lock:
+            self._stalled.add(int(chip))
+        self._released.clear()
+        try:
+            from ..observability.flight_recorder import RECORDER
+
+            RECORDER.record("mesh_chip_stall", severity="warn",
+                            chip=int(chip), devices=self.n_devices)
+        except Exception:
+            pass
+
+    def release_chip(self, chip: int | None = None) -> None:
+        """Heal one chip (or all with None)."""
+        with self._lock:
+            if chip is None:
+                self._stalled.clear()
+            else:
+                self._stalled.discard(int(chip))
+            clear = not self._stalled
+        if clear:
+            self._released.set()
+        try:
+            from ..observability.flight_recorder import RECORDER
+
+            RECORDER.record("mesh_chip_release",
+                            chip=-1 if chip is None else int(chip))
+        except Exception:
+            pass
+
+    def release(self) -> None:
+        """StallingBackend-compatible blanket heal (the runner's epilogue
+        releases whatever is still armed)."""
+        self.release_chip(None)
+
+    @property
+    def stalled_chips(self) -> tuple:
+        with self._lock:
+            return tuple(sorted(self._stalled))
+
+    @property
+    def stalled(self) -> bool:
+        """Any chip stalled (the StallingBackend-compatible flag the
+        runner's route accounting reads)."""
+        with self._lock:
+            return bool(self._stalled)
+
+    # ------------------------------------------------------------- serve
+
+    def _serve(self, n_sets: int, single_chip: bool) -> bool:
+        with self._lock:
+            self.calls += 1
+            stalled = set(self._stalled)
+        d = 1 if single_chip else self.n_devices
+        share = max(1, math.ceil(max(1, n_sets) / d))
+        compute = self.base_secs + self.per_set_secs * share
+        time.sleep(compute)
+        chips = (0,) if single_chip else tuple(range(self.n_devices))
+        with self._lock:
+            # the busy ledger is read by occupancy() and written from
+            # concurrent worker threads (urgent vs batch verifies): the
+            # read-modify-write must not lose increments
+            for c in chips:
+                self.chip_busy[c] += compute
+        for c in chips:
+            _CHIP_BUSY.labels(c).inc(compute)
+        # the collective barrier: a stalled chip in this batch's shard set
+        # means the reduction never completes within the stall budget
+        blocking = sorted(stalled.intersection(chips))
+        if blocking:
+            t0 = time.perf_counter()
+            if not self._released.wait(self.wait_secs):
+                _COLLECTIVE_WAIT.labels("stalled").observe(
+                    time.perf_counter() - t0
+                )
+                with self._lock:
+                    self.stall_hits += 1
+                for c in blocking:
+                    _CHIP_STALLS.labels(c).inc()
+                raise DeviceStallError(
+                    f"mesh collective stalled on chip(s) {blocking} past "
+                    f"{self.wait_secs}s wait"
+                )
+        _COLLECTIVE_WAIT.labels("arrived").observe(0.0)
+        return self.verdict
+
+    def verify_signature_sets(self, sets, rands) -> bool:
+        return self._serve(len(sets), single_chip=False)
+
+    def verify_signature_sets_urgent(self, sets, rands) -> bool:
+        # the urgent lane is pinned to chip 0 (jaxbls contract): it pays
+        # single-chip compute and only chip 0's stall can block it
+        return self._serve(len(sets), single_chip=True)
+
+    def verify_signature_sets_async(self, sets, rands):
+        outer = self
+        n = len(sets)
+
+        class _Handle:
+            def result(self) -> bool:
+                return outer._serve(n, single_chip=False)
+
+        return _Handle()
+
+    def occupancy(self) -> dict:
+        """Per-chip busy seconds + the busy-balance summary for reports."""
+        with self._lock:
+            busy = [round(b, 6) for b in self.chip_busy]
+        peak = max(busy) if busy else 0.0
+        return {
+            "devices": self.n_devices,
+            "chip_busy_secs": busy,
+            "busy_balance": (
+                round(min(busy) / peak, 4) if peak > 0 else None
+            ),
+            "stall_hits": self.stall_hits,
+            "stalled_chips": list(self.stalled_chips),
+        }

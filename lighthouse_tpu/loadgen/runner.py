@@ -88,13 +88,31 @@ class LoadgenNode:
             self.processor.max_lengths[WorkKind.gossip_aggregate] = (
                 sc.agg_queue_cap
             )
-        self.device = StallingBackend()
+        if sc.mesh:
+            # mesh scenario: an N-chip device sim with collective
+            # semantics (one stalled chip stalls the whole batch) behind
+            # a REAL PipelinedDispatcher — chip count resolves against
+            # parallel.get_mesh() unless the sweep pins it
+            from ..crypto.jaxbls.pipeline import PipelinedDispatcher
+            from .meshsim import MeshShardedBackend, resolve_mesh_devices
+
+            self.mesh_devices = resolve_mesh_devices(sc.mesh_devices)
+            self.device = MeshShardedBackend(self.mesh_devices)
+            self.dispatcher = PipelinedDispatcher()
+        else:
+            self.mesh_devices = None
+            self.device = StallingBackend()
+            self.dispatcher = None
         # breaker on the scenario's logical clock: one-slot cooldown, so
         # recovery is observable within the run
         self.breaker = CircuitBreaker(
             "loadgen_device", failure_threshold=3,
             reset_timeout=float(sc.seconds_per_slot), time_fn=clock._time,
         )
+        # wall-clock verify observations for mesh runs (device-served
+        # batches only): the sweep's sets/s + p50 numbers — kept OUT of
+        # the deterministic report core
+        self.batch_verify_obs: list = []  # (n_sets, secs)
         self.slow_host = (
             SlowHostVerify() if "slow_host" in sc.faults else None
         )
@@ -163,9 +181,34 @@ class LoadgenNode:
 
         def run():
             # blocks verify on the host path unconditionally (the hybrid
-            # urgent path); what matters here is WHEN they run
+            # urgent path); what matters here is WHEN they run. Mesh runs
+            # additionally push the proposer check through the REAL
+            # dispatcher's urgent BYPASS lane, pinned to chip 0 — the
+            # mesh_stall scenario (chip 1 wedged) proves the urgent path
+            # keeps serving while every sharded batch stalls
             now = self.clock.now() or 0
             self.block_slot_lag.append(now - slot)
+            if self.dispatcher is not None:
+                from ..crypto.bls.api import _ReadyHandle
+
+                try:
+                    # pre-resolved handle (the bypass lane resolves
+                    # in-band; crypto/bls/api owns the handle contract)
+                    self.dispatcher.submit(
+                        lambda: _ReadyHandle(
+                            self.device.verify_signature_sets_urgent(
+                                [None], [1]
+                            )
+                        ),
+                        urgent=True,
+                    ).result()
+                    self.batches["urgent"] = self.batches.get("urgent", 0) + 1
+                except Exception:
+                    # a stalled chip 0 fails the urgent verify; the block
+                    # still imports (host fallback semantics) — count it
+                    self.batches["urgent_stalled"] = (
+                        self.batches.get("urgent_stalled", 0) + 1
+                    )
             if self.store is not None:
                 # the durable head record (BeaconChain.persist() at loadgen
                 # scale): one CRC-framed fsynced append per imported block —
@@ -190,11 +233,24 @@ class LoadgenNode:
         t0 = time.perf_counter()
         if self.breaker.allow():
             try:
-                self.device.verify_signature_sets([None] * n, [1] * n)
+                if self.dispatcher is not None:
+                    # mesh lane: the REAL pipelined dispatcher owns the
+                    # submission (FIFO window + jaxbls_pipeline_* series);
+                    # resolution stays in-band so reports remain
+                    # deterministic functions of (scenario, seed)
+                    self.dispatcher.submit(
+                        lambda: self.device.verify_signature_sets_async(
+                            [None] * n, [1] * n
+                        )
+                    ).result()
+                else:
+                    self.device.verify_signature_sets([None] * n, [1] * n)
+                dt = time.perf_counter() - t0
                 self.breaker.record_success()
                 self.batches["device"] += 1
+                self.batch_verify_obs.append((n, dt))
                 self.slo.record_route("device", n)
-                self.slo.record_verify_latency(time.perf_counter() - t0)
+                self.slo.record_verify_latency(dt)
                 RECORDER.note_route("loadgen_device", "device", "ok")
                 return None
             except DeviceStallError:
@@ -290,6 +346,38 @@ def _slo_block(slo_acct: SlotAccountant, incident_dir: str) -> dict:
     }
 
 
+def _verify_obs_block(node: LoadgenNode) -> dict:
+    """Wall-clock verify observations (EVERY run): sets/s + p50 over the
+    device-served batches — what `bn loadtest --bench-matrix` and the
+    --mesh-devices sweep snapshot into BENCH_MATRIX rows. Deliberately
+    OUTSIDE the deterministic report core — these are measurements, not
+    seed functions."""
+    obs = node.batch_verify_obs
+    total_sets = sum(n for n, _ in obs)
+    total_secs = sum(s for _, s in obs)
+    lats = sorted(s for _, s in obs)
+    p50 = lats[len(lats) // 2] if lats else None
+    return {
+        "device_batches": len(obs),
+        "sets_per_sec": (
+            round(total_sets / total_secs, 2) if total_secs > 0 else None
+        ),
+        "verify_p50_ms": round(p50 * 1e3, 3) if p50 is not None else None,
+    }
+
+
+def _mesh_block(node: LoadgenNode) -> dict:
+    """Mesh runs additionally report per-chip occupancy + the urgent-lane
+    ledger next to the verify observations."""
+    block = dict(node.device.occupancy())
+    block.update(
+        _verify_obs_block(node),
+        urgent_served=node.batches.get("urgent", 0),
+        urgent_stalled=node.batches.get("urgent_stalled", 0),
+    )
+    return block
+
+
 def run_scenario(sc: Scenario, out_path: str | None = None,
                  log_fn=None, datadir: str | None = None) -> dict:
     """Run one scenario to completion; returns (and optionally writes) the
@@ -307,6 +395,11 @@ def run_scenario(sc: Scenario, out_path: str | None = None,
         start, end = sc.stall_slots
         injector.at(start, node.device.stall)
         injector.at(end, node.device.release)
+    if "mesh_stall" in sc.faults:
+        start, end = sc.stall_slots
+        chip = sc.mesh_stall_chip % max(1, node.mesh_devices or 1)
+        injector.at(start, lambda: node.device.stall_chip(chip))
+        injector.at(end, lambda: node.device.release_chip(chip))
     schedule = traffic_schedule(sc)
     rng = random.Random(sc.seed ^ 0x10AD6E4)
     for slot, traffic in enumerate(schedule):
@@ -351,6 +444,9 @@ def run_scenario(sc: Scenario, out_path: str | None = None,
         "slo": _slo_block(slo_acct, incident_dir),
         "elapsed_secs": round(time.time() - t_wall, 3),
     }
+    report["verify_observations"] = _verify_obs_block(node)
+    if node.mesh_devices is not None:
+        report["mesh"] = _mesh_block(node)
     # the deadline-hit ratio rides next to the loss accounting so one
     # glance answers both "was work conserved" and "was it in time"
     report["deadline_hit_ratio"] = report["slo"]["deadline_hit_ratio"]

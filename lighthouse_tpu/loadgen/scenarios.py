@@ -44,10 +44,21 @@ class Scenario:
     # fault injections: "device_stall" stalls the device backend over
     # stall_slots; "slow_host" adds per-batch host latency; "storage_crash"
     # tears the durable head write at crash_slot and kills the node, then
-    # the runner restarts it from the same datadir (crash_restart scenario)
+    # the runner restarts it from the same datadir (crash_restart scenario);
+    # "mesh_stall" stalls ONE chip's shard of the mesh device over
+    # stall_slots (the collective blocks — loadgen/meshsim.py)
     faults: tuple = ()
     stall_slots: tuple = (2, 4)      # [start, end) in scenario slots
     crash_slot: int | None = None    # storage_crash: slot whose head write tears
+    # mesh serving (loadgen/meshsim.py): mesh=True routes batches through
+    # a real PipelinedDispatcher over an N-chip mesh device sim whose chip
+    # count resolves against parallel.get_mesh() (mesh_devices overrides —
+    # the --mesh-devices sweep's points); mesh_stall_chip names the chip
+    # the "mesh_stall" fault wedges (chip 1 by default: the urgent lane is
+    # pinned to chip 0 and must keep serving through the stall)
+    mesh: bool = False
+    mesh_devices: int | None = None
+    mesh_stall_chip: int = 1
     # queue bounds for the attestation/aggregate queues (None = processor
     # defaults); flood scenarios shrink them so shedding is observable in
     # a few seconds instead of at mainnet scale
@@ -118,6 +129,17 @@ SCENARIOS: dict[str, Scenario] = {
         name="slow_host", n_validators=8192, slots=8, flood_factor=2.0,
         faults=("slow_host",), stale_fraction=0.1,
         att_queue_cap=512, agg_queue_cap=128,
+    ),
+    # one chip of the mesh wedges mid-run while the flood continues: the
+    # collective blocks every SHARDED batch, the breaker must open and the
+    # host path serve (SLO ratio dips), the urgent lane (pinned to chip 0)
+    # keeps serving, and the heal must close the breaker — the multichip
+    # analog of device_stall, proving a stalled shard degrades gracefully
+    # instead of wedging the pipeline window
+    "mesh_stall": Scenario(
+        name="mesh_stall", n_validators=16384, slots=10, flood_factor=2.0,
+        mesh=True, faults=("mesh_stall",), stall_slots=(3, 6),
+        att_queue_cap=1024, agg_queue_cap=256,
     ),
     # crash recovery proof: mainnet-shaped load over a DURABLE store whose
     # head write tears mid-record at crash_slot (the node "dies"); the

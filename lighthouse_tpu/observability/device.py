@@ -260,9 +260,15 @@ def profile_stages(
 
     from ..crypto.jaxbls import backend as be
     from ..crypto.jaxbls import limbs as lb
+    from ..parallel import get_mesh, put_pk_grid, put_sets
 
-    prepare, h2c_stage, pairs_stage, pairing_stage = be._get_stages()
-    n, m = be.padding_bucket(n_sets, n_pks)
+    # profile the programs the SERVING path runs: on a meshed process the
+    # batch lane compiles the mesh-variant stages over mesh-padded
+    # buckets with sharded placement — timing fresh unsharded variants at
+    # those shapes would attribute cost to programs nothing executes
+    mesh = get_mesh()
+    prepare, h2c_stage, pairs_stage, pairing_stage = be._get_stages(mesh=mesh)
+    n, m = be.padding_bucket(n_sets, n_pks, mesh=mesh)
     rng = np.random.default_rng(seed)
 
     def rl(shape):
@@ -271,17 +277,26 @@ def profile_stages(
         a[..., -1] = 0
         return a
 
-    pk_x, pk_y = rl((n, m)), rl((n, m))
-    pk_mask = np.ones((n, m), np.uint32)
-    sig_x, sig_y = rl((n, 2)), rl((n, 2))
-    z_digits = np.ones((n, be.Z_DIGITS), np.uint32)
-    set_mask = np.ones((n,), np.uint32)
-    us = rl((n, 2, 2))
+    # host masters; per-batch inputs are RE-PLACED every rep because with
+    # donation on (accelerator default) the stages CONSUME them — reusing
+    # a donated array on rep 2 would raise 'Array has been deleted'. The
+    # pubkey grids are never donated, so they place once (like the
+    # serving path's device-resident pubkey cache).
+    h_pk_x, h_pk_y = rl((n, m)), rl((n, m))
+    h_sig_x, h_sig_y = rl((n, 2)), rl((n, 2))
+    h_z = np.ones((n, be.Z_DIGITS), np.uint32)
+    h_mask = np.ones((n,), np.uint32)
+    h_us = rl((n, 2, 2))
+    pk_x, pk_y = put_pk_grid(h_pk_x), put_pk_grid(h_pk_y)
+    pk_mask = put_pk_grid(np.ones((n, m), np.uint32))
 
     prev_analytics = _perf.set_analytics(analytics)
     try:
         with attributed():
             for _ in range(reps + 1):  # +1: first rep eats residual compile
+                sig_x, sig_y = put_sets(h_sig_x), put_sets(h_sig_y)
+                z_digits, set_mask = put_sets(h_z), put_sets(h_mask)
+                us = put_sets(h_us)
                 attr = begin((n, m))
                 z_pk, sig_acc, _bad = run_stage(
                     attr, "prepare", prepare,

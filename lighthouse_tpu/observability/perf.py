@@ -379,10 +379,46 @@ _RATE_KEYS = (
 )
 
 
+def write_loadtest_rows(rows: dict, smoke: bool = True,
+                        root: str | None = None) -> str:
+    """Merge `source: loadtest` rows into the BENCH_MATRIX schema — the
+    tunnel-proof bench seam: `bn loadtest` (flood / the --mesh-devices
+    sweep, and any future on-TPU soak) snapshots its measured sets/s +
+    p50 here, so a soak doubles as a bench round and the trend gate reads
+    the rows as FRESH measurements. Read-merge-write: bench.py's configs
+    are preserved; only loadtest_* keys are touched. Smoke runs land in
+    the gitignored-by-convention *_SMOKE variant, same rule as bench.py —
+    a CPU harness must never clobber the on-chip artifact of record."""
+    root = root or default_root()
+    name = "BENCH_MATRIX_SMOKE.json" if smoke else "BENCH_MATRIX.json"
+    path = os.path.join(root, name)
+    try:
+        with open(path) as f:
+            matrix = json.load(f) or {}
+    except (OSError, json.JSONDecodeError):
+        matrix = {}
+    for key, row in rows.items():
+        key = str(key)
+        if not key.startswith("loadtest_"):
+            raise ValueError(
+                f"loadtest matrix rows must be keyed loadtest_*: {key!r}"
+            )
+        matrix[key] = dict(row, source="loadtest")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(matrix, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
 def load_matrix(root: str | None = None, name: str = "BENCH_MATRIX.json") -> dict:
     """Per-config summary of the current measurement matrix, with
     config*_skipped / config*_error flags kept distinct from measured
-    configs (a skipped config must never read as a measured one)."""
+    configs (a skipped config must never read as a measured one).
+    loadtest_* rows (write_loadtest_rows) parse like configs and carry
+    their `source: loadtest` tag through — they are fresh by
+    construction (the writer stamps them at measurement time)."""
     root = root or default_root()
     try:
         with open(os.path.join(root, name)) as f:
@@ -391,7 +427,9 @@ def load_matrix(root: str | None = None, name: str = "BENCH_MATRIX.json") -> dic
         return {}
     out: dict = {}
     for key, val in matrix.items():
-        m = re.match(r"^(config\d+)(?:_(skipped|error))?", key)
+        m = re.match(r"^(config\d+|loadtest_\w+)(?:_(skipped|error))?$", key)
+        if not m:
+            m = re.match(r"^(config\d+)(?:_(skipped|error))?", key)
         if not m:
             continue
         config, flag = m.group(1), m.group(2)
@@ -403,11 +441,16 @@ def load_matrix(root: str | None = None, name: str = "BENCH_MATRIX.json") -> dic
             continue
         entry["name"] = key
         for rk in _RATE_KEYS:
-            if rk in val:
+            # a null rate (hand-edited or legacy artifact) must degrade to
+            # "no measurement", not crash every later trend read
+            if val.get(rk) is not None:
                 entry["rate"] = float(val[rk])
                 entry["rate_unit"] = rk
                 break
         for k in ("p50_ms", "p99_ms"):
+            if k in val:
+                entry[k] = val[k]
+        for k in ("source", "n_devices", "measured_unix"):
             if k in val:
                 entry[k] = val[k]
         for k, v in val.items():
@@ -647,6 +690,12 @@ def render_report(report: dict) -> str:
                 bits.append(f"p50={e['p50_ms']}ms")
             if e.get("vs_est") is not None:
                 bits.append(f"{e['vs_est_key']}={e['vs_est']} (estimated)")
+            if e.get("source") == "loadtest":
+                nd = e.get("n_devices")
+                bits.append(
+                    "source=loadtest (fresh soak snapshot"
+                    + (f", {nd} device(s))" if nd else ")")
+                )
             lines.append(f"  {config}: " + ", ".join(bits))
     lines.append("")
     if report["regressions"]:

@@ -39,6 +39,8 @@ METRIC_MODULES = (
     "lighthouse_tpu.network.node",
     "lighthouse_tpu.network.sync",
     "lighthouse_tpu.loadgen.netfaults",
+    "lighthouse_tpu.loadgen.meshsim",
+    "lighthouse_tpu.parallel.mesh",
     "lighthouse_tpu.chain.beacon_processor",
     "lighthouse_tpu.chain.validator_monitor",
     "lighthouse_tpu.crypto.bls.hybrid",
@@ -131,6 +133,17 @@ def lint_registry(registry=None) -> list[str]:
                 errors.append(
                     f"{where}: sync_*/netfault_* metrics must be labeled "
                     "families (stage / outcome / fault / scope)"
+                )
+        if m.name.startswith("mesh_"):
+            # the mesh layer's series answer "which axis / which chip /
+            # which lane" (axis sizes, per-chip occupancy and stalls,
+            # sharded-vs-single-chip dispatch) — an aggregate over chips
+            # hides exactly the straggler a mesh_stall incident needs to
+            # localize, so the convention is enforced like qos_*
+            if not getattr(m, "labelnames", ()):
+                errors.append(
+                    f"{where}: mesh_* metrics must be labeled families "
+                    "(axis / chip / lane / outcome)"
                 )
         if m.name.startswith(("jaxbls_stage_", "xla_program_")):
             # per-stage attribution and compiled-program analytics exist

@@ -567,3 +567,165 @@ def test_cli_autotune_show(tmp_path, capsys):
     assert doc["profile"]["warmup_small_buckets"] == [[4, 128]]
     assert doc["plan"]["pipeline_depth"] == 6
     assert doc["plan"]["msm_window"] == 4
+
+
+# ------------------------------------------------------------ mesh (r8)
+
+
+def mesh_profile(mesh_shape="sets8") -> profile.DeviceProfile:
+    """synthetic_profile measured on an 8-chip sets-mesh: buckets are
+    mesh-multiples and the key carries the topology."""
+    p = synthetic_profile()
+    p.key["mesh_shape"] = mesh_shape
+    p.key["num_devices"] = 8
+    return p
+
+
+def test_profile_mesh_shape_round_trip_and_key_string(tmp_path):
+    p = mesh_profile()
+    assert p.mesh_shape == "sets8"
+    assert "sets8" in p.key_string()
+    path = profile.save(p, str(tmp_path / "m.json"))
+    again = profile.load(path)
+    assert again.mesh_shape == "sets8"
+    assert again.key_string() == p.key_string()
+    # pre-r8 profiles have no mesh_shape: unknowable, never flags
+    legacy = synthetic_profile()
+    assert legacy.mesh_shape is None
+    assert legacy.mesh_mismatch("sets8") is False
+    # distinct topologies must land in distinct canonical files
+    assert profile.default_path(p.key) != profile.default_path(legacy.key)
+
+
+def test_install_refuses_mesh_mismatched_profile():
+    """A profile calibrated on one topology is refused on another — the
+    same contract as the stale-revision refusal — and the refusal lands
+    in the flight recorder (reason mesh_mismatch). The explicit operator
+    override still installs, loudly."""
+    from lighthouse_tpu.observability.flight_recorder import RECORDER
+
+    p = mesh_profile("sets8")
+    # no live topology known -> no check possible -> installs
+    assert runtime.install_profile(p) is not None
+    runtime.clear()
+    # matching topology installs
+    assert runtime.install_profile(p, live_mesh_shape="sets8") is not None
+    runtime.clear()
+    # mismatch refuses + records
+    before = RECORDER.events_recorded
+    assert runtime.install_profile(p, live_mesh_shape="single") is None
+    assert runtime.active_plan() is None
+    ev = [e for e in RECORDER.events(16)
+          if e["kind"] == "autotune_profile_refused"]
+    assert ev and ev[-1]["reason"] == "mesh_mismatch"
+    assert ev[-1]["profile_mesh"] == "sets8"
+    assert ev[-1]["live_mesh"] == "single"
+    assert RECORDER.events_recorded > before
+    # operator override: installs with the warning
+    plan = runtime.install_profile(p, live_mesh_shape="single",
+                                   allow_stale=True)
+    assert plan is not None
+
+
+def test_install_stale_refusal_lands_in_flight_recorder():
+    from lighthouse_tpu.observability.flight_recorder import RECORDER
+
+    stale = synthetic_profile()
+    stale.key["backend_revision"] = "r5"
+    assert runtime.install_profile(stale) is None
+    ev = [e for e in RECORDER.events(16)
+          if e["kind"] == "autotune_profile_refused"]
+    assert ev and ev[-1]["reason"] == "stale_revision"
+
+
+def test_planner_mesh_derivations():
+    """Pinned r8 derivation rules: caps round up to mesh multiples,
+    per-chip caps are the even split, the p99 budget carries the
+    collective slack (1 + 0.05*log2(D)), and the stall budget is 4x the
+    widened p99 — all None/neutral on a single-chip profile."""
+    plan1 = planner.plan_from_profile(synthetic_profile())
+    assert plan1.mesh_devices == 1
+    assert plan1.per_chip_attestation_batch == plan1.max_attestation_batch
+    assert plan1.p99_budget_ms == 1120.0          # 2 x 560, no slack
+    assert plan1.stall_budget_ms == 4480.0
+
+    plan8 = planner.plan_from_profile(mesh_profile("sets8"))
+    assert plan8.mesh_devices == 8
+    # knee at 256 already divides 8; per-chip split is exact
+    assert plan8.max_attestation_batch == 256
+    assert plan8.per_chip_attestation_batch == 32
+    assert plan8.per_chip_aggregate_batch == 16
+    # collective slack: 2 x 560 x (1 + 0.05*3) = 1288
+    assert plan8.p99_budget_ms == 1288.0
+    assert plan8.stall_budget_ms == 5152.0
+
+    # a knee that does NOT divide the mesh rounds UP to a multiple
+    p = mesh_profile("sets8")
+    p.buckets.clear()
+    rows = [(4, 1, 10.0), (20, 1, 100.0), (64, 1, 101.0)]
+    for n, m, rate in rows:
+        p.buckets[(n, m)] = profile.BucketProfile(
+            n_sets=n, n_pks=m, samples=4, p50_ms=100.0, p99_ms=120.0,
+            sets_per_sec=rate,
+        )
+    plan = planner.plan_from_profile(p)
+    assert plan.max_attestation_batch == 24       # knee 20 -> next mult of 8
+    assert plan.max_attestation_batch % 8 == 0
+
+    # 2-D topology: total chips = product of the axes
+    plan2d = planner.plan_from_profile(mesh_profile("sets4-pks2"))
+    assert plan2d.mesh_devices == 8
+    assert plan2d.per_chip_attestation_batch == 64  # split over sets axis
+
+
+def test_hybrid_stall_budget_follows_plan(monkeypatch):
+    """The hybrid router's stall verdict (the QoS breaker's failure
+    signal) re-resolves from the plan's collective-aware stall budget on
+    a runtime install; env still wins."""
+    from lighthouse_tpu.crypto.bls.hybrid import HybridBackend
+
+    hb = HybridBackend()
+    # default: 4x the default 500ms budget
+    assert hb._stall_budget_secs == pytest.approx(2.0)
+    runtime.install_profile(mesh_profile("sets8"), live_mesh_shape="sets8")
+    # plan: stall 5152 ms
+    assert hb._stall_budget_secs == pytest.approx(5.152)
+    runtime.clear()
+    assert hb._stall_budget_secs == pytest.approx(2.0)
+
+    monkeypatch.setenv("LIGHTHOUSE_TPU_DEVICE_STALL_BUDGET_MS", "750")
+    hb2 = HybridBackend()
+    runtime.install_profile(mesh_profile("sets8"), live_mesh_shape="sets8")
+    assert hb2._stall_budget_secs == pytest.approx(0.75)  # env wins
+
+
+def test_processor_max_inflight_retunes_on_install(monkeypatch):
+    """BeaconProcessorConfig.max_inflight consumes the plan through the
+    live listener (the same contract as the jaxbls dispatcher's depth);
+    an explicit --max-inflight-batches value stays pinned."""
+    from lighthouse_tpu.chain.beacon_processor import (
+        BeaconProcessor, BeaconProcessorConfig,
+    )
+
+    monkeypatch.delenv("LIGHTHOUSE_TPU_PIPELINE_DEPTH", raising=False)
+    proc = BeaconProcessor(BeaconProcessorConfig())
+    try:
+        assert proc.config.max_inflight == 4      # default depth
+        p = mesh_profile("sets8")
+        p.pipeline_depth = 7
+        runtime.install_profile(p, live_mesh_shape="sets8")
+        assert proc.config.max_inflight == 7      # retuned live
+        runtime.clear()
+        assert proc.config.max_inflight == 4
+
+        # explicitness is self-describing: passing a number to the
+        # constructor pins it without a second flag
+        pinned = BeaconProcessor(BeaconProcessorConfig(max_inflight=2))
+        assert pinned.config.max_inflight_explicit is True
+        try:
+            runtime.install_profile(p, live_mesh_shape="sets8")
+            assert pinned.config.max_inflight == 2  # operator pin holds
+        finally:
+            pinned.shutdown() if hasattr(pinned, "shutdown") else None
+    finally:
+        proc.shutdown() if hasattr(proc, "shutdown") else None

@@ -255,3 +255,64 @@ def test_pipeline_snapshot_surfaces_perf_trend():
     assert latest["source"] == "BENCH_r05.json"
     assert latest["fresh"] is False
     assert latest["carried_from"] == "BENCH_r01.json"
+
+
+# ----------------------------------------------------- loadtest rows (r8)
+
+
+def test_write_loadtest_rows_merge_and_parse(tmp_path):
+    """write_loadtest_rows read-merge-writes the BENCH_MATRIX schema:
+    bench.py's configs survive, loadtest_* rows parse like configs with
+    their source tag (fresh by construction), and non-loadtest keys are
+    refused."""
+    import json
+
+    from lighthouse_tpu.observability import perf
+
+    (tmp_path / "BENCH_MATRIX_SMOKE.json").write_text(json.dumps({
+        "config5_firehose": {"sets_per_sec": 99.85, "vs_est_blst": 0.143},
+        "elapsed_secs": 1.0,
+    }))
+    path = perf.write_loadtest_rows(
+        {"loadtest_flood_mesh8": {
+            "sets_per_sec": 1234.5, "p50_ms": 2.0, "n_devices": 8,
+            "measured_unix": 1.0,
+        }},
+        smoke=True, root=str(tmp_path),
+    )
+    doc = json.loads(open(path).read())
+    assert doc["config5_firehose"]["sets_per_sec"] == 99.85  # preserved
+    assert doc["loadtest_flood_mesh8"]["source"] == "loadtest"
+
+    parsed = perf.load_matrix(root=str(tmp_path),
+                              name="BENCH_MATRIX_SMOKE.json")
+    assert parsed["config5"]["rate"] == 99.85
+    row = parsed["loadtest_flood_mesh8"]
+    assert row["rate"] == 1234.5 and row["rate_unit"] == "sets_per_sec"
+    assert row["source"] == "loadtest" and row["n_devices"] == 8
+
+    with pytest.raises(ValueError):
+        perf.write_loadtest_rows({"config9": {}}, smoke=True,
+                                 root=str(tmp_path))
+
+
+def test_render_report_marks_loadtest_rows_fresh(tmp_path):
+    """Rendered trend output labels loadtest rows as fresh soak snapshots
+    (never skipped/carried), and the check() gate stays clean with them
+    present."""
+    import json
+
+    from lighthouse_tpu.observability import perf
+
+    (tmp_path / "BENCH_MATRIX.json").write_text(json.dumps({
+        "loadtest_flood_mesh8": {
+            "sets_per_sec": 500.0, "p50_ms": 3.1, "n_devices": 8,
+            "source": "loadtest", "measured_unix": 2.0,
+        },
+    }))
+    rc, report = perf.check(root=str(tmp_path))
+    assert rc == 0
+    text = perf.render_report(report)
+    assert "loadtest_flood_mesh8" in text
+    assert "source=loadtest (fresh soak snapshot, 8 device(s))" in text
+    assert "SKIPPED" not in text.split("loadtest_flood_mesh8")[1].split("\n")[0]
