@@ -258,6 +258,35 @@ def msm_window_sweep(backend, points, reps: int, rng=None) -> dict:
     return {"window": winner, "secs_by_window": secs_by_window}
 
 
+def tree_hash_sweep(buckets, reps: int) -> tuple:
+    """Measure the jaxhash tree-hash ladder at each leaf-count bucket:
+    `warm_tree_bucket` pays (and times) the compile, then `reps` warm
+    roots confirm the steady path serves. Returns the measured bucket
+    tuple — what run_from_args persists as DeviceProfile.tree_hash_buckets
+    (r9), i.e. the ladders bring-up precompiles on this device."""
+    import numpy as np
+
+    from ..jaxhash import engine
+
+    out = []
+    for n in buckets:
+        n = int(n)
+        compile_secs = engine.warm_tree_bucket(n)
+        leaves = np.zeros((n, 32), np.uint8)
+        depth = engine.hash_bucket(n).bit_length() - 1
+        samples = []
+        for _ in range(max(1, reps)):
+            t0 = time.perf_counter()
+            engine.device_build_levels(leaves, depth, root_only=True)
+            samples.append(time.perf_counter() - t0)
+        samples.sort()
+        _log("tree-hash bucket measured", n_leaves=n,
+             compile_secs=round(compile_secs, 2),
+             median_secs=round(samples[len(samples) // 2], 4))
+        out.append(n)
+    return tuple(out)
+
+
 def measure_host_reference(sets, reps: int) -> dict:
     """Host (pure python) single-set verify time — the planner's reference
     for the urgent-set threshold."""
@@ -304,6 +333,15 @@ def add_calibrate_args(p) -> None:
                    help="record this measured dispatch pipeline depth in "
                         "the profile (from a scripts/bench_batch_scaling"
                         ".py --depths sweep; default: leave unmeasured)")
+    p.add_argument("--tree-hash-buckets", default=None,
+                   help="comma list of jaxhash ladder leaf counts to "
+                        "measure + persist as the profile's "
+                        "tree_hash_buckets (r9; default 16384 — the "
+                        "registry scale; device backend only)")
+    p.add_argument("--no-tree-hash-sweep", action="store_true",
+                   help="skip the tree-hash ladder sweep (profile keeps "
+                        "tree_hash_buckets unmeasured; bring-up warms the "
+                        "default registry-scale ladder)")
 
 
 def run_from_args(args) -> tuple:
@@ -367,6 +405,23 @@ def run_from_args(args) -> tuple:
             _log("msm window sweep failed; profile keeps msm_window "
                  "unmeasured", error=f"{type(e).__name__}: {e}")
 
+    tree_hash_buckets = None
+    if backend_name == "jax" and not getattr(
+        args, "no_tree_hash_sweep", False
+    ):
+        raw = getattr(args, "tree_hash_buckets", None) or "16384"
+        try:
+            tree_hash_buckets = tree_hash_sweep(
+                [int(x) for x in str(raw).split(",") if x.strip()],
+                1 if smoke else reps,
+            )
+            _log("tree-hash sweep complete",
+                 buckets=str(list(tree_hash_buckets)))
+        except Exception as e:  # second-workload sweep must not discard
+            # the BLS calibration — degrade to unmeasured
+            _log("tree-hash sweep failed; profile keeps tree_hash_buckets "
+                 "unmeasured", error=f"{type(e).__name__}: {e}")
+
     try:
         key = profile.current_device_key(bls_backend=backend_name)
     except Exception as e:  # no jax device at all: still a valid profile
@@ -395,6 +450,9 @@ def run_from_args(args) -> tuple:
         if b[0] <= SMALL_WARMUP_MAX_SETS
     )
     prof.warmup_small_buckets = small or None
+    # r9: the measured tree-hash ladder buckets (None when the sweep was
+    # skipped/failed or the measured backend is not the device one)
+    prof.tree_hash_buckets = tree_hash_buckets
 
     out = args.out or (
         os.path.join(repo_root, "autotune_profile_smoke.json")

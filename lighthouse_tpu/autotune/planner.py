@@ -89,6 +89,16 @@ MAX_WARMUP_BUCKETS = 4
 MAX_SMALL_WARMUP_EXTRA = 2
 DEFAULT_PIPELINE_DEPTH = 4   # mirrors jaxbls pipeline.DEFAULT_DEPTH
 PIPELINE_DEPTH_CLAMP = (1, 16)
+# jaxhash tree-hash warmup (r9): leaf-count ladders bring-up precompiles
+# when --hash-backend is device-backed. The default is the mainnet-shaped
+# validator-registry scale a state root hits first; profile values clamp
+# to this range (a typo'd 2**40 bucket must not compile for an hour).
+DEFAULT_TREE_HASH_WARMUP = (16384,)
+TREE_HASH_BUCKET_CLAMP = (64, 1 << 22)
+# bring-up compiles the listed ladders SEQUENTIALLY: cap the count like
+# MAX_WARMUP_BUCKETS caps the BLS list — a 60-entry profile must not
+# monopolize the device for the whole warm-up window
+MAX_TREE_HASH_WARMUP = 4
 
 
 @dataclass(frozen=True)
@@ -112,6 +122,9 @@ class Plan:
     per_chip_attestation_batch: int = DEFAULT_MAX_ATTESTATION_BATCH
     per_chip_aggregate_batch: int = DEFAULT_MAX_AGGREGATE_BATCH
     stall_budget_ms: float | None = None
+    # the second workload's warmup list (r9): leaf-count buckets the
+    # jaxhash tree-hash engine precompiles at bring-up
+    tree_hash_warmup: tuple = DEFAULT_TREE_HASH_WARMUP
     source: str = "defaults"
 
 
@@ -236,6 +249,21 @@ def plan_from_profile(profile: DeviceProfile) -> Plan:
         int(profile.msm_window) if profile.msm_window is not None else None
     )
 
+    # ---- tree-hash warmup (r9): the profile's measured leaf-count
+    # buckets pass through clamped + deduplicated in order; unmeasured
+    # falls back to the registry-scale default
+    if profile.tree_hash_buckets:
+        seen = []
+        for n in profile.tree_hash_buckets:
+            n = int(_clamp(int(n), *TREE_HASH_BUCKET_CLAMP))
+            if n not in seen:
+                seen.append(n)
+            if len(seen) >= MAX_TREE_HASH_WARMUP:
+                break
+        tree_hash_warmup = tuple(seen)
+    else:
+        tree_hash_warmup = DEFAULT_TREE_HASH_WARMUP
+
     return Plan(
         max_attestation_batch=att_cap,
         max_aggregate_batch=agg_cap,
@@ -248,5 +276,6 @@ def plan_from_profile(profile: DeviceProfile) -> Plan:
         per_chip_attestation_batch=max(1, att_cap // set_axis),
         per_chip_aggregate_batch=max(1, agg_cap // set_axis),
         stall_budget_ms=stall_budget,
+        tree_hash_warmup=tree_hash_warmup,
         source=source,
     )

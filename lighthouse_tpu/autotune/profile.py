@@ -60,8 +60,12 @@ SCHEMA_VERSION = 1
 # on the topology), so the profile key gains `mesh_shape` and
 # runtime.install additionally refuses a profile calibrated on a
 # DIFFERENT topology than the live mesh — same pattern as the stale
-# revision refusal.
-BACKEND_REVISION = "r8"
+# revision refusal. r9: the device tree-hash engine (lighthouse_tpu/
+# jaxhash) is the second workload sharing the device — profiles now carry
+# `tree_hash_buckets` (the leaf-count ladders bring-up precompiles), and
+# budgets measured on a BLS-only device no longer describe a device that
+# also serves state roots.
+BACKEND_REVISION = "r9"
 
 #: varying-base MSM window widths a profile may persist (the calibrate
 #: sweep's search space — crypto/jaxbls/msm.py ALLOWED_WINDOWS, duplicated
@@ -135,6 +139,11 @@ class DeviceProfile:
     msm_window: int | None = None
     pipeline_depth: int | None = None
     warmup_small_buckets: tuple | None = None
+    # r9: leaf-count buckets of the jaxhash tree-hash ladder worth
+    # precompiling at bring-up (the validator-registry scale this node's
+    # state roots actually hit); None = unmeasured, the planner falls
+    # back to the default registry-scale bucket
+    tree_hash_buckets: tuple | None = None
 
     def key_string(self) -> str:
         """Stable, filesystem-safe identity string for file naming. The
@@ -185,6 +194,10 @@ class DeviceProfile:
             "warmup_small_buckets": (
                 [[int(n), int(m)] for n, m in self.warmup_small_buckets]
                 if self.warmup_small_buckets else None
+            ),
+            "tree_hash_buckets": (
+                [int(n) for n in self.tree_hash_buckets]
+                if self.tree_hash_buckets else None
             ),
             "buckets": [
                 self.buckets[k].to_json() for k in sorted(self.buckets)
@@ -242,6 +255,20 @@ class DeviceProfile:
                     f"malformed autotune profile warmup_small_buckets "
                     f"{small!r}: {type(e).__name__}: {e}"
                 ) from e
+        tree_hash = d.get("tree_hash_buckets")
+        if tree_hash is not None:
+            try:
+                tree_hash = tuple(int(n) for n in tree_hash)
+            except (TypeError, ValueError) as e:
+                raise ValueError(
+                    f"malformed autotune profile tree_hash_buckets "
+                    f"{tree_hash!r}: {type(e).__name__}: {e}"
+                ) from e
+            if any(n < 1 for n in tree_hash):
+                raise ValueError(
+                    f"autotune profile tree_hash_buckets {tree_hash!r} "
+                    "must be positive leaf counts"
+                )
         return cls(
             key=dict(key),
             buckets=buckets,
@@ -251,6 +278,7 @@ class DeviceProfile:
             msm_window=msm_window,
             pipeline_depth=pipeline_depth,
             warmup_small_buckets=small,
+            tree_hash_buckets=tree_hash,
         )
 
     def is_stale(self) -> bool:

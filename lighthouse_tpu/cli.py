@@ -107,6 +107,27 @@ def cmd_bn(args):
 
     bls.set_backend(args.bls_backend)
 
+    # the second device workload (lighthouse_tpu/jaxhash): tree-hash /
+    # state-root routing. Host is the default — a node without the flag
+    # hashes exactly as before; device/hybrid route large merkleizations
+    # and the epoch vectors to the device engine (bit-exact, breaker-
+    # guarded). Env stays the weaker layer (flag > env > host).
+    if getattr(args, "hash_backend", None):
+        from .jaxhash import set_hash_backend
+
+        _os_env.environ["LIGHTHOUSE_TPU_HASH_BACKEND"] = args.hash_backend
+        set_hash_backend(args.hash_backend)
+    from .jaxhash import hash_backend as _hash_backend
+
+    if _hash_backend() in ("device", "hybrid"):
+        from .jaxhash import start_warmup as _hash_warmup
+
+        # precompile the plan's tree-hash ladders in the background (the
+        # autotune r9 profile carries tree_hash_buckets; default is the
+        # registry scale) — same degradation contract as the BLS warmup
+        _hash_warmup()
+        log.info("tree-hash backend selected", hash_backend=_hash_backend())
+
     if autotune_on and device_backed:
         # precompile the plan's warmup buckets in the background (daemon
         # thread; a dead tunnel degrades to cold-compile-on-first-dispatch,
@@ -1381,6 +1402,16 @@ def build_parser() -> argparse.ArgumentParser:
              "verifies to the host while the device is cold, absent, or "
              "over its latency budget (the recommended production setting "
              "for a TPU-attached node)",
+    )
+    bn.add_argument(
+        "--hash-backend", default=None,
+        choices=["host", "device", "hybrid"],
+        help="tree-hash / state-root backend (lighthouse_tpu/jaxhash): "
+             "'host' (default) keeps the hashlib ladder; 'device' routes "
+             "large merkleizations and the epoch vectors to the device "
+             "tree-hash engine; 'hybrid' adds the circuit-breaker guard "
+             "(small trees stay on host either way — every device result "
+             "is bit-exact vs hashlib). Env: LIGHTHOUSE_TPU_HASH_BACKEND",
     )
     bn.add_argument("--slasher", action="store_true", help="enable the slasher")
     bn.add_argument(

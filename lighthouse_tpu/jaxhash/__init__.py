@@ -1,0 +1,37 @@
+"""Device tree-hash engine — the second TPU workload.
+
+BLS verification was the only device workload; once it is fast, the
+survey's next hot paths — SSZ merkleization/state roots and the per-epoch
+balance/reward vectors (SURVEY §2.4: the reference's `cached_tree_hash` +
+hand-tuned SHA-NI assembly) — dominate by Amdahl. This package generalizes
+the crypto-backend plugin boundary beyond BLS:
+
+  engine.py         the jnp SHA-256 ladder (one schedule shared with the
+                    numpy host formulation in ssz/sha256_batch.py), the
+                    level-by-level `tree_hash_root` kernel with padding
+                    buckets, buffer donation and mesh-aware shardings over
+                    the leaf axis, dispatched through a PipelinedDispatcher
+  epoch_vectors.py  vectorized epoch processing (flag/inactivity deltas,
+                    effective-balance hysteresis) as device arrays, shared
+                    host-numpy/device-jnp formulation, bit-exact vs the
+                    pure-Python spec path
+  router.py         the hybrid route policy: hashlib ladder below a size
+                    threshold, device above, breaker-guarded, with
+                    `tree_hash_route_total{path,reason}` mirroring
+                    `bls_hybrid_route_total`
+
+Selection: `bn --hash-backend {host,device,hybrid}` >
+LIGHTHOUSE_TPU_HASH_BACKEND > "host". The host default means a node
+without the flag is byte-identical to the pre-jaxhash behavior; every
+device result is bit-exact against hashlib by construction (pinned in
+tests/test_jaxhash.py + test_sha256_batch.py).
+"""
+
+from .router import (  # noqa: F401
+    ROUTER,
+    hash_backend,
+    set_hash_backend,
+    start_warmup,
+)
+
+__all__ = ["ROUTER", "hash_backend", "set_hash_backend", "start_warmup"]

@@ -1,0 +1,202 @@
+"""Hybrid tree-hash routing: hashlib below the threshold, device above.
+
+The policy mirror of crypto/bls/hybrid.py for the second workload. Every
+large merkleization (ssz/core.merkleize, ssz/tree_cache._build) asks the
+router first; the decision is counted ONCE in
+`tree_hash_route_total{path,reason}` by the path that finally served it —
+the exact contract of `bls_hybrid_route_total`, so one dashboard reads
+both workloads the same way.
+
+Routing policy:
+  - backend "host" (the default)      -> host, always (reason backend_host;
+    a node without --hash-backend is byte-identical to pre-jaxhash)
+  - below `min_leaves`                -> host (reason small): the hashlib
+    SHA-NI ladder beats any device round trip on small trees
+  - breaker OPEN (backend "hybrid")   -> host, O(1) refusal (reason
+    circuit_open). The breaker trips on consecutive device failures;
+    recovery is half-open probe-driven (lighthouse_tpu/qos/breaker.py),
+    state exported as `tree_hash_circuit_state`. Backend "device" skips
+    the open-circuit refusal (an operator pinning the device path wants
+    every attempt) but still records outcomes.
+  - device dispatch raises            -> host answers (reason
+    device_error), failure recorded.
+  - otherwise                         -> device (reason ok).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from ..utils.logging import get_logger
+from ..utils.metrics import REGISTRY
+
+HASH_BACKENDS = ("host", "device", "hybrid")
+DEFAULT_MIN_LEAVES = 1024
+
+_ROUTE = REGISTRY.counter_vec(
+    "tree_hash_route_total",
+    "large-tree merkleizations by the path that served them and the "
+    "routing reason (the tree-hash analog of bls_hybrid_route_total)",
+    ("path", "reason"),
+)
+_CIRCUIT_STATE = REGISTRY.gauge(
+    "tree_hash_circuit_state",
+    "tree-hash device-path circuit breaker state (0=closed, 1=open, "
+    "2=half_open)",
+)
+
+_state = {"backend": None}
+
+
+def hash_backend() -> str:
+    """The active hash backend: explicit set_hash_backend >
+    LIGHTHOUSE_TPU_HASH_BACKEND > "host"."""
+    if _state["backend"] is not None:
+        return _state["backend"]
+    env = os.environ.get("LIGHTHOUSE_TPU_HASH_BACKEND", "").strip().lower()
+    return env if env in HASH_BACKENDS else "host"
+
+
+def set_hash_backend(name: str | None) -> None:
+    """Pin the hash backend for this process (bn --hash-backend; None
+    reverts to env/default resolution)."""
+    if name is not None and name not in HASH_BACKENDS:
+        raise ValueError(
+            f"unknown hash backend {name!r} (have: {', '.join(HASH_BACKENDS)})"
+        )
+    _state["backend"] = name
+
+
+class TreeHashRouter:
+    """One process-wide instance (ROUTER below) owns the breaker and the
+    threshold; tests construct private ones."""
+
+    def __init__(self, min_leaves: int | None = None):
+        if min_leaves is None:
+            raw = os.environ.get("LIGHTHOUSE_TPU_HASH_MIN_LEAVES", "").strip()
+            try:
+                min_leaves = int(raw) if raw else DEFAULT_MIN_LEAVES
+            except ValueError:
+                min_leaves = DEFAULT_MIN_LEAVES
+        self.min_leaves = max(2, int(min_leaves))
+        self._log = get_logger("jaxhash.router")
+        from ..qos.breaker import CircuitBreaker
+
+        self._breaker = CircuitBreaker(
+            "tree_hash_device", failure_threshold=3,
+            state_gauge=_CIRCUIT_STATE,
+        )
+
+    # ------------------------------------------------------------- routing
+
+    def allow_device(self) -> bool:
+        """Breaker admission for OTHER device consumers sharing this
+        device (the epoch-vector stage): open = refuse O(1); a half-open
+        True claims the probe slot, so the caller MUST report the attempt
+        via record_device."""
+        return self._breaker.allow()
+
+    def record_device(self, ok: bool) -> None:
+        (self._breaker.record_success if ok
+         else self._breaker.record_failure)()
+
+    def _route(self, n_leaves: int) -> tuple[str, str]:
+        backend = hash_backend()
+        if backend == "host":
+            return "host", "backend_host"
+        if n_leaves < self.min_leaves:
+            return "host", "small"
+        if backend == "hybrid" and not self._breaker.allow():
+            return "host", "circuit_open"
+        return "device", "ok"
+
+    def maybe_build_levels(self, leaves, depth: int, n_leaves: int | None = None,
+                           root_only: bool = False):
+        """(levels, root) exactly as ssz/tree_cache._build would return,
+        via the device — or None when the host path should serve (the
+        caller runs its unchanged hashlib ladder). Never raises. `leaves`
+        may be a zero-arg callable producing the (n, 32) uint8 array (with
+        `n_leaves` given), so a host-routed call never pays the marshal;
+        `root_only` skips the per-level device->host transfers (levels
+        comes back None)."""
+        n = int(n_leaves if n_leaves is not None else leaves.shape[0])
+        path, reason = self._route(n)
+        if path == "host":
+            _ROUTE.labels("host", reason).inc()
+            return None
+        if callable(leaves):
+            leaves = leaves()
+        from . import engine
+
+        try:
+            result = engine.device_build_levels(leaves, depth,
+                                                root_only=root_only)
+        except Exception as e:
+            self._breaker.record_failure()
+            self._log.warn(
+                "device tree hash failed; host ladder serves",
+                n_leaves=n, error=f"{type(e).__name__}: {e}",
+            )
+            _ROUTE.labels("host", "device_error").inc()
+            return None
+        self._breaker.record_success()
+        _ROUTE.labels("device", "ok").inc()
+        return result
+
+    def maybe_tree_root(self, leaves, depth: int, n_leaves: int | None = None):
+        """Root-only entry for ssz/core.merkleize: bytes, or None for the
+        host ladder. Only the top device level transfers to host."""
+        routed = self.maybe_build_levels(leaves, depth, n_leaves=n_leaves,
+                                         root_only=True)
+        return None if routed is None else routed[1]
+
+
+ROUTER = TreeHashRouter()
+
+
+def route_totals() -> dict:
+    """{"path/reason": count} snapshot of tree_hash_route_total — the
+    loadgen state_root scenario reports the per-run delta."""
+    return {
+        "/".join(str(v) for v in key): child.value
+        for key, child in _ROUTE.children()
+    }
+
+
+# ------------------------------------------------------------------ warmup
+
+
+def start_warmup(buckets=None) -> threading.Thread:
+    """Precompile the plan's tree-hash buckets in a daemon thread (node
+    bring-up when --hash-backend is device/hybrid): the autotune r9
+    profile carries `tree_hash_buckets`; without one the default warms
+    the validator-registry scale the state root hits first. Any failure
+    degrades to cold-compile-on-first-root, never a crashed node."""
+    log = get_logger("jaxhash.warmup")
+    if buckets is None:
+        plan = None
+        try:
+            from ..autotune import runtime
+
+            plan = runtime.active_plan()
+        except Exception:
+            pass
+        buckets = tuple(getattr(plan, "tree_hash_warmup", ()) or ()) or (16384,)
+
+    def run():
+        from . import engine
+
+        for n_leaves in buckets:
+            try:
+                secs = engine.warm_tree_bucket(int(n_leaves))
+                log.info("tree-hash bucket warmed", n_leaves=int(n_leaves),
+                         secs=round(secs, 1))
+            except Exception as e:
+                log.warn("tree-hash bucket warm failed",
+                         n_leaves=int(n_leaves),
+                         error=f"{type(e).__name__}: {e}")
+
+    t = threading.Thread(target=run, daemon=True, name="jaxhash-warmup")
+    t.start()
+    return t

@@ -29,7 +29,8 @@ def default_report_path(smoke: bool) -> str:
 def drive(*, scenario=None, smoke=False, slots=None, validators=None,
           seed=None, flood_factor=None, out=None, quiet=False,
           datadir=None, mesh_devices=None, bench_matrix=False,
-          bench_root=None, stdout=None, stderr=None) -> int:
+          bench_root=None, hash_backend=None, stdout=None,
+          stderr=None) -> int:
     """Run one scenario and print the one-line JSON summary. Returns a
     process exit code. `--smoke` alone runs the 'smoke' scenario; combined
     with an explicit --scenario it is a SIZE modifier — the named scenario
@@ -55,6 +56,15 @@ def drive(*, scenario=None, smoke=False, slots=None, validators=None,
             validators=validators, seed=seed, flood_factor=flood_factor,
             out=out, quiet=quiet, datadir=datadir, bench_root=bench_root,
             stdout=stdout, stderr=stderr,
+        )
+    from .scenarios import is_state_root
+
+    if is_state_root(name):
+        return _drive_state_root(
+            name, smoke=smoke, slots=slots, validators=validators,
+            seed=seed, out=out, quiet=quiet,
+            bench_matrix=bench_matrix, bench_root=bench_root,
+            hash_backend=hash_backend, stdout=stdout, stderr=stderr,
         )
     if is_multinode(name):
         return _drive_multinode(
@@ -206,9 +216,12 @@ def _drive_mesh_sweep(name, points, *, smoke, slots, validators, seed,
     from .runner import run_scenario
     from .scenarios import get_scenario, is_multinode, smoke_variant
 
-    if is_multinode(name):
-        print(f"error: --mesh-devices does not apply to multi-node "
-              f"scenario {name!r}", file=stderr)
+    from .scenarios import is_state_root
+
+    if is_multinode(name) or is_state_root(name):
+        print(f"error: --mesh-devices does not apply to scenario "
+              f"{name!r} (multi-node and state_root scenarios drive "
+              "surfaces the mesh sweep does not)", file=stderr)
         return 1
     try:
         points = sorted({int(p) for p in points})
@@ -317,6 +330,71 @@ def _drive_mesh_sweep(name, points, *, smoke, slots, validators, seed,
     return 0
 
 
+def _drive_state_root(name, *, smoke, slots, validators, seed, out, quiet,
+                      bench_matrix, bench_root, hash_backend=None,
+                      stdout=None, stderr=None) -> int:
+    """The second-workload soak (loadgen/state_root.py): seeded
+    mutate-and-reroot churn at validator scale through the active hash
+    backend. Exit code is the conservation verdict — nonzero when the
+    balance ledger breaks or the final root diverges from the cache-free
+    ground truth. `--bench-matrix` snapshots the measured reroot p50 as
+    a `state_root` BENCH_MATRIX row (the bench_state_root.py schema)."""
+    from .scenarios import get_state_root_scenario, state_root_smoke_variant
+    from .state_root import run_state_root_scenario
+
+    sc = get_state_root_scenario(name, slots=slots, n_validators=validators,
+                                 seed=seed, hash_backend=hash_backend)
+    if smoke:
+        sc = state_root_smoke_variant(sc)
+    out = out or default_report_path(smoke)
+    report = run_state_root_scenario(
+        sc, out_path=out,
+        log_fn=None if quiet else (
+            lambda m: print(m, file=stderr, flush=True)
+        ),
+    )
+    summary = {
+        "scenario": report["scenario"],
+        "report": out,
+        "hash_backend": report["hash_backend"],
+        "published": report["published"],
+        "roots": report["roots"],
+        "reroot_p50_ms": report["reroot_p50_ms"],
+        "conservation": report["conservation"],
+        "tree_hash_routes": report["tree_hash_routes"],
+        "elapsed_secs": report["elapsed_secs"],
+    }
+    print(json.dumps(summary), file=stdout)
+    if not report["conservation"]["ok"]:
+        # verdict BEFORE the matrix write: a run serving wrong roots must
+        # never land a fresh p50 entry in the artifact of record
+        print("error: state_root conservation violated (see report)",
+              file=stderr)
+        return 1
+    if bench_matrix:
+        import time as _time
+
+        from ..observability import perf as _perf
+
+        row = {
+            "source": "loadtest",
+            "scenario": report["scenario"],
+            "measured_unix": round(_time.time(), 3),
+            "validators": report["n_validators"],
+            "hash_backend": report["hash_backend"],
+            "p50_ms": report["reroot_p50_ms"],
+            "roots_per_sec": report["roots_per_sec"],
+        }
+        try:
+            path = _perf.write_loadtest_rows(
+                {"state_root": row}, smoke=smoke, root=bench_root
+            )
+            print(f"bench matrix rows -> {path}", file=stderr)
+        except Exception as e:  # a bench snapshot must never fail the run
+            print(f"warning: bench matrix write failed: {e}", file=stderr)
+    return 0
+
+
 def _drive_multinode(name, *, smoke, slots, validators, seed, out, quiet,
                      datadir, stdout, stderr) -> int:
     """Multi-node scenario leg: N full nodes over real TCP under a network
@@ -382,9 +460,11 @@ def add_loadtest_args(parser) -> None:
     parser.add_argument("--scenario", default=None,
                         help="named scenario: smoke, steady, flood, "
                              "device_stall, mesh_stall, slow_host, "
-                             "crash_restart, or a multi-node family: "
-                             "partition_heal, fork_reorg, sync_catchup, "
-                             "equivocation_storm (default: smoke)")
+                             "crash_restart, state_root (mutate-and-reroot "
+                             "churn through the active hash backend), or a "
+                             "multi-node family: partition_heal, fork_reorg, "
+                             "sync_catchup, equivocation_storm "
+                             "(default: smoke)")
     parser.add_argument("--smoke", action="store_true",
                         help="alone: run the ~5s CPU-only smoke scenario; "
                              "with --scenario: run that scenario shrunk to "
@@ -421,6 +501,12 @@ def add_loadtest_args(parser) -> None:
     parser.add_argument("--bench-root", default=None,
                         help="directory for the BENCH_MATRIX write "
                              "(default: the repo root)")
+    parser.add_argument("--hash-backend", default=None,
+                        choices=["host", "device", "hybrid"],
+                        help="tree-hash backend the state_root scenario "
+                             "re-roots through (default: "
+                             "LIGHTHOUSE_TPU_HASH_BACKEND or host; other "
+                             "scenarios ignore it)")
 
 
 def drive_from_args(args) -> int:
@@ -433,4 +519,5 @@ def drive_from_args(args) -> int:
         flood_factor=args.flood_factor, out=args.out, quiet=args.quiet,
         datadir=args.datadir, mesh_devices=mesh_devices,
         bench_matrix=args.bench_matrix, bench_root=args.bench_root,
+        hash_backend=getattr(args, "hash_backend", None),
     )

@@ -174,12 +174,68 @@ def get_scenario(name: str, **overrides) -> Scenario:
     """A named scenario, optionally with field overrides (CLI flags)."""
     base = SCENARIOS.get(name)
     if base is None:
-        have = sorted(SCENARIOS) + sorted(MULTINODE_SCENARIOS)
+        have = (
+            sorted(SCENARIOS) + sorted(STATE_ROOT_SCENARIOS)
+            + sorted(MULTINODE_SCENARIOS)
+        )
         raise KeyError(
             f"unknown scenario {name!r} (have: {', '.join(have)})"
         )
     overrides = {k: v for k, v in overrides.items() if v is not None}
     return replace(base, **overrides) if overrides else replace(base)
+
+
+# ------------------------------------------------------------- state root
+
+
+@dataclass
+class StateRootScenario:
+    """The second workload's soak: seeded mutate-and-reroot churn over a
+    validator-scale BeaconState (loadgen/state_root.py). Every slot
+    mutates a block's worth of validators/balances and re-roots through
+    the ACTIVE hash backend (bn --hash-backend / the scenario override);
+    the run is conservation-checked — the balance ledger must sum and the
+    final root must equal a cache-free ground-truth rehash — so a soak
+    that passes proves the device path bit-exact under churn, not just on
+    a fixture."""
+
+    name: str
+    n_validators: int = 16384
+    slots: int = 8
+    seed: int = 0xC0FFEE
+    #: validators whose effective balance (and balance) mutate per slot
+    churn_validators: int = 8
+    #: additional balance-only mutations per slot
+    churn_balances: int = 32
+    #: override the process hash backend for the run (None = whatever
+    #: bn --hash-backend / env resolved)
+    hash_backend: str | None = None
+
+
+STATE_ROOT_SCENARIOS: dict[str, StateRootScenario] = {
+    "state_root": StateRootScenario(name="state_root"),
+}
+
+
+def is_state_root(name: str) -> bool:
+    return name in STATE_ROOT_SCENARIOS
+
+
+def get_state_root_scenario(name: str, **overrides) -> StateRootScenario:
+    base = STATE_ROOT_SCENARIOS.get(name)
+    if base is None:
+        raise KeyError(f"unknown state-root scenario {name!r}")
+    overrides = {k: v for k, v in overrides.items() if v is not None}
+    return replace(base, **overrides) if overrides else replace(base)
+
+
+def state_root_smoke_variant(sc: StateRootScenario) -> StateRootScenario:
+    """Seconds-sized clamp, same churn shape (the --smoke modifier)."""
+    return replace(
+        sc,
+        n_validators=min(sc.n_validators, 2048),
+        slots=min(sc.slots, 4),
+    )
 
 
 # ------------------------------------------------------------- multi-node

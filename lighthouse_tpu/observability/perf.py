@@ -376,19 +376,35 @@ def load_multichip_rounds(root: str | None = None) -> list:
 
 _RATE_KEYS = (
     "sets_per_sec", "verifies_per_sec", "blocks_per_sec", "blobs_per_sec",
+    "roots_per_sec", "epochs_per_sec",
 )
+
+#: key families write_loadtest_rows accepts: loadtest_* rows come from
+#: `bn loadtest` snapshots; state_root / epoch_transition rows from
+#: scripts/bench_state_root.py --bench-matrix — the second workload's
+#: bench rows beside the BLS configs
+WORKLOAD_ROW_PREFIXES = ("loadtest_", "state_root", "epoch_transition")
+
+#: bounded per-row measurement history (the state-root p50 trend series
+#: reads it — every appended entry is a fresh measurement by construction)
+MAX_ROW_HISTORY = 12
 
 
 def write_loadtest_rows(rows: dict, smoke: bool = True,
                         root: str | None = None) -> str:
-    """Merge `source: loadtest` rows into the BENCH_MATRIX schema — the
+    """Merge measured workload rows into the BENCH_MATRIX schema — the
     tunnel-proof bench seam: `bn loadtest` (flood / the --mesh-devices
     sweep, and any future on-TPU soak) snapshots its measured sets/s +
-    p50 here, so a soak doubles as a bench round and the trend gate reads
-    the rows as FRESH measurements. Read-merge-write: bench.py's configs
-    are preserved; only loadtest_* keys are touched. Smoke runs land in
-    the gitignored-by-convention *_SMOKE variant, same rule as bench.py —
-    a CPU harness must never clobber the on-chip artifact of record."""
+    p50 here, and `bench_state_root.py --bench-matrix` lands the
+    state_root / epoch_transition rows of the second device workload the
+    same way — so any soak or host-provable bench doubles as a bench
+    round and the trend gate reads the rows as FRESH measurements.
+    Read-merge-write: bench.py's configs are preserved; only
+    WORKLOAD_ROW_PREFIXES keys are touched, and rows carrying a p50
+    accumulate a bounded `history` of fresh entries (the fresh-to-fresh
+    series the state-root p50 trend gate checks). Smoke runs land in the
+    gitignored-by-convention *_SMOKE variant, same rule as bench.py — a
+    CPU harness must never clobber the on-chip artifact of record."""
     root = root or default_root()
     name = "BENCH_MATRIX_SMOKE.json" if smoke else "BENCH_MATRIX.json"
     path = os.path.join(root, name)
@@ -399,11 +415,32 @@ def write_loadtest_rows(rows: dict, smoke: bool = True,
         matrix = {}
     for key, row in rows.items():
         key = str(key)
-        if not key.startswith("loadtest_"):
+        if not key.startswith(WORKLOAD_ROW_PREFIXES):
             raise ValueError(
-                f"loadtest matrix rows must be keyed loadtest_*: {key!r}"
+                "workload matrix rows must be keyed "
+                f"{'/'.join(WORKLOAD_ROW_PREFIXES)}*: {key!r}"
             )
-        matrix[key] = dict(row, source="loadtest")
+        row = dict(row, source=row.get("source", "loadtest"))
+        if row.get("p50_ms") is not None:
+            prev = matrix.get(key)
+            history = list(prev.get("history") or []) if isinstance(
+                prev, dict
+            ) else []
+            entry = {
+                "measured_unix": row.get("measured_unix"),
+                "p50_ms": row["p50_ms"],
+                "fresh": True,
+            }
+            # measurement config rides each entry so the trend gate only
+            # compares like with like — a host-vs-device (or resized)
+            # re-measurement, or a different harness (bench_state_root vs
+            # a loadtest soak), is a configuration change, not a regression
+            for k in ("hash_backend", "validators", "source"):
+                if row.get(k) is not None:
+                    entry[k] = row[k]
+            history.append(entry)
+            row["history"] = history[-MAX_ROW_HISTORY:]
+        matrix[key] = row
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as f:
         json.dump(matrix, f, indent=1)
@@ -427,7 +464,11 @@ def load_matrix(root: str | None = None, name: str = "BENCH_MATRIX.json") -> dic
         return {}
     out: dict = {}
     for key, val in matrix.items():
-        m = re.match(r"^(config\d+|loadtest_\w+)(?:_(skipped|error))?$", key)
+        m = re.match(
+            r"^(config\d+|loadtest_\w+|state_root\w*|epoch_transition\w*)"
+            r"(?:_(skipped|error))?$",
+            key,
+        )
         if not m:
             m = re.match(r"^(config\d+)(?:_(skipped|error))?", key)
         if not m:
@@ -450,7 +491,7 @@ def load_matrix(root: str | None = None, name: str = "BENCH_MATRIX.json") -> dic
         for k in ("p50_ms", "p99_ms"):
             if k in val:
                 entry[k] = val[k]
-        for k in ("source", "n_devices", "measured_unix"):
+        for k in ("source", "n_devices", "measured_unix", "history"):
             if k in val:
                 entry[k] = val[k]
         for k, v in val.items():
@@ -527,6 +568,50 @@ def trend_report(
                 }
             )
 
+    # state-root p50 (ms, LOWER is better) — the second workload's trend
+    # series, read from the BENCH_MATRIX state_root row's bounded history
+    # (every entry written by bench_state_root.py --bench-matrix is a
+    # fresh measurement; entries marked fresh=false — a hand-carried or
+    # legacy value — render as carried and can neither cause nor mask a
+    # regression, the config1_p50 contract)
+    sr_row = matrix.get("state_root") or {}
+    sr_entries = [
+        e for e in (sr_row.get("history") or []) if isinstance(e, dict)
+    ]
+    sr_fresh = [
+        e for e in sr_entries if e.get("fresh", True) and e.get("p50_ms")
+    ]
+    sr_deltas = []
+    # each fresh entry compares against the MOST RECENT prior fresh entry
+    # of the SAME measurement config (backend/validators/harness) — a
+    # config flip (host->device, resized run, bench vs loadtest) is not a
+    # regression, and an interleaved flip must not mask the next
+    # same-config comparison either
+    _last_by_config: dict = {}
+    for cur in sr_fresh:
+        cfg = tuple(
+            cur.get(k) for k in ("hash_backend", "validators", "source")
+        )
+        prev = _last_by_config.get(cfg)
+        _last_by_config[cfg] = cur
+        if prev is None:
+            continue
+        delta = (cur["p50_ms"] - prev["p50_ms"]) / prev["p50_ms"]
+        sr_deltas.append(
+            {"config": "state_root_p50", "delta_pct": round(delta * 100.0, 2)}
+        )
+        if delta > threshold:
+            regressions.append(
+                {
+                    "config": "state_root_p50",
+                    "prev": prev["p50_ms"],
+                    "cur": cur["p50_ms"],
+                    "from": f"history@{prev.get('measured_unix')}",
+                    "to": f"history@{cur.get('measured_unix')}",
+                    "delta_pct": round(delta * 100.0, 2),
+                }
+            )
+
     mc_fresh = [r for r in multichip if not r["skipped"]]
     if mc_fresh and not mc_fresh[-1]["ok"] and any(r["ok"] for r in mc_fresh[:-1]):
         last_ok = [r for r in mc_fresh[:-1] if r["ok"]][-1]
@@ -556,6 +641,7 @@ def trend_report(
             ],
             "deltas": lat_deltas,
         },
+        "state_root_p50": {"entries": sr_entries, "deltas": sr_deltas},
         "multichip": {"rounds": multichip},
         "matrix": matrix,
         "regressions": regressions,
@@ -660,6 +746,24 @@ def render_report(report: dict) -> str:
             lines.append(
                 f"  delta {d['from']} -> {d['to']}: {d['delta_pct']:+.2f}%"
             )
+    sr = report.get("state_root_p50") or {}
+    if sr.get("entries"):
+        lines.append("")
+        lines.append(
+            "state_root p50 (ms, lower is better; BENCH_MATRIX "
+            "state_root row history):"
+        )
+        for e in sr["entries"]:
+            if e.get("fresh", True) and e.get("p50_ms"):
+                tag = "fresh"
+            else:
+                tag = "CARRIED FORWARD — not a fresh measurement"
+            val = f"{e['p50_ms']:.2f}" if e.get("p50_ms") else "—"
+            lines.append(
+                f"  @{e.get('measured_unix')}  {val:>10s}  [{tag}]"
+            )
+        for d in sr["deltas"]:
+            lines.append(f"  delta: {d['delta_pct']:+.2f}%")
     lines.append("")
     lines.append("multichip (MULTICHIP_r*.json):")
     for r in report["multichip"]["rounds"]:

@@ -55,7 +55,12 @@ def next_pow2(n: int) -> int:
 
 
 def merkleize(chunks: Sequence[bytes], limit: int | None = None) -> bytes:
-    """Merkleize 32-byte chunks, zero-padded to next_pow2(limit or count)."""
+    """Merkleize 32-byte chunks, zero-padded to next_pow2(limit or count).
+
+    Large chunk sets ask the jaxhash router first (bn --hash-backend):
+    above its size threshold the device tree-hash engine serves the root
+    (bit-exact by construction — lighthouse_tpu/jaxhash); the host
+    default and everything below the threshold keep this hashlib ladder."""
     count = len(chunks)
     if limit is not None and count > limit:
         raise ValueError(f"chunk count {count} exceeds limit {limit}")
@@ -63,6 +68,15 @@ def merkleize(chunks: Sequence[bytes], limit: int | None = None) -> bytes:
     depth = width.bit_length() - 1
     if count == 0:
         return ZERO_HASHES[depth]
+    if count >= _TREE_CACHE_MIN:
+        from ..jaxhash.router import ROUTER
+
+        root = ROUTER.maybe_tree_root(
+            lambda: np.frombuffer(b"".join(chunks), np.uint8).reshape(-1, 32),
+            depth, n_leaves=count,
+        )
+        if root is not None:
+            return root
     layer = list(chunks)
     for d in range(depth):
         nxt = []

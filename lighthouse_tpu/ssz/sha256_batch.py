@@ -1,18 +1,29 @@
-"""Vectorized batched SHA-256 for merkleization.
+"""Vectorized batched SHA-256 for merkleization — ONE schedule, two lanes.
 
 The reference leans on hand-tuned assembly sha256 (ethereum_hashing with
 SHA-NI) because tree hashing dominates state-root computation
 (/root/reference/consensus/cached_tree_hash + SURVEY.md §2.4). The
 TPU-native equivalent is DATA-PARALLEL hashing: every tree level hashes all
-its sibling pairs at once. This module implements the SHA-256 compression
-schedule over uint lanes (numpy here; the same straight-line schedule is
-the basis for a jnp/Pallas device tree-hash of large leaf sets — the
-batched-sha256 path noted in SURVEY §2.4).
+its sibling pairs at once.
+
+This module owns the ONE straight-line compression schedule both lanes
+compile from (`compress`): the constants are plain-int tuples and the
+round function is written over an abstract array namespace `xp`, so the
+host path (numpy) and the device path (jax.numpy, via
+lighthouse_tpu/jaxhash/engine.py) trace the IDENTICAL arithmetic. Lanes
+are native uint32 — unsigned wraparound is mod-2^32 addition in both
+namespaces, which is exactly SHA-256's word arithmetic. (The pre-jaxhash
+formulation widened to uint64 with an explicit mask; the device port
+needs native uint32 — masking doubles the op count and uint64 lanes halve
+a TPU register's throughput — so the widened variant is gone and both
+lanes share this one.)
 
 Measured honestly: on HOST CPU this does NOT beat hashlib's OpenSSL
 SHA-NI assembly (~0.5us per 64-byte hash); merkleize() therefore keeps the
-hashlib ladder, and this module exists as the verified vector formulation
-for the device path. Correctness is pinned against hashlib in
+hashlib ladder below the jaxhash router's size threshold, and this module
+is the verified vector formulation the device tree-hash engine compiles.
+Correctness is pinned against hashlib — host AND device lanes, multi-block
+messages and the 64-byte padding edge included — in
 tests/test_sha256_batch.py.
 """
 
@@ -20,7 +31,10 @@ from __future__ import annotations
 
 import numpy as np
 
-_K = np.array([
+#: SHA-256 round constants / initial state, as plain ints: the single
+#: source both the numpy and the jnp lane materialize their uint32
+#: constant arrays from (lighthouse_tpu/jaxhash/engine.py).
+SHA256_K = (
     0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
     0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
     0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
@@ -32,45 +46,112 @@ _K = np.array([
     0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
     0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
     0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2,
-], dtype=np.uint64)
+)
 
-_H0 = np.array([
+SHA256_H0 = (
     0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
     0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19,
-], dtype=np.uint64)
+)
 
-_MASK = np.uint64(0xFFFFFFFF)
+#: Padding block words for a 64-byte message (one merkle pair): 0x80 bit,
+#: zeros, 512-bit length — every tree level appends exactly this block.
+PAIR_PAD_WORDS = (0x80000000,) + (0,) * 14 + (512,)
 
-# Padding block for a 64-byte message: 0x80, zeros, bit length 512.
-_PAD_WORDS = np.zeros(16, dtype=np.uint64)
-_PAD_WORDS[0] = 0x80000000
-_PAD_WORDS[15] = 512
+_K32 = np.array(SHA256_K, dtype=np.uint32)
+_H032 = np.array(SHA256_H0, dtype=np.uint32)
+_PAIR_PAD32 = np.array(PAIR_PAD_WORDS, dtype=np.uint32)
 
 
 def _rotr(x, n):
-    return ((x >> np.uint64(n)) | (x << np.uint64(32 - n))) & _MASK
+    return (x >> n) | (x << (32 - n))
 
 
-def _compress(state, w16):
-    """One compression round batch: state (8, n), w16 (16, n) u64 lanes."""
-    w = np.empty((64,) + w16.shape[1:], dtype=np.uint64)
-    w[:16] = w16
+def schedule_word(w_m16, w_m15, w_m7, w_m2):
+    """One message-schedule word: W[t] from W[t-16], W[t-15], W[t-7],
+    W[t-2]. THE shared round math — the numpy lane drives it with a
+    Python loop (straight-line), the device lane with lax.fori_loop
+    (jaxhash/engine.py; rolled, so the XLA graph stays small)."""
+    s0 = _rotr(w_m15, 7) ^ _rotr(w_m15, 18) ^ (w_m15 >> 3)
+    s1 = _rotr(w_m2, 17) ^ _rotr(w_m2, 19) ^ (w_m2 >> 10)
+    return w_m16 + s0 + w_m7 + s1
+
+
+def round_step(v, kt, wt):
+    """One compression round over the 8-tuple of working variables —
+    shared by both lane drivers like schedule_word."""
+    a, b, c, d, e, f, g, h = v
+    S1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+    ch = (e & f) ^ (~e & g)
+    t1 = h + S1 + ch + kt + wt
+    S0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+    maj = (a & b) ^ (a & c) ^ (b & c)
+    return (t1 + S0 + maj, a, b, c, d + t1, e, f, g)
+
+
+def compress(state, w16, k, xp):
+    """One SHA-256 compression over a batch of lanes (the straight-line
+    driver over schedule_word/round_step).
+
+    state: (8, ...) uint32, w16: (16, ...) uint32 message words, k: the
+    (64,) uint32 round-constant array OF THE SAME NAMESPACE. `xp` is
+    numpy or jax.numpy — uint32 wraparound IS the mod-2^32 word
+    arithmetic, so the schedule is one definition for both lanes. (The
+    device ladder kernels use the ROLLED driver in jaxhash/engine.py over
+    the same two bodies: a 64x-unrolled trace per level compiles an order
+    of magnitude slower for identical output.)"""
+    w = [w16[t] for t in range(16)]
     for t in range(16, 64):
-        s0 = _rotr(w[t - 15], 7) ^ _rotr(w[t - 15], 18) ^ (w[t - 15] >> np.uint64(3))
-        s1 = _rotr(w[t - 2], 17) ^ _rotr(w[t - 2], 19) ^ (w[t - 2] >> np.uint64(10))
-        w[t] = (w[t - 16] + s0 + w[t - 7] + s1) & _MASK
-
-    a, b, c, d, e, f, g, h = state
+        w.append(schedule_word(w[t - 16], w[t - 15], w[t - 7], w[t - 2]))
+    v = tuple(state[i] for i in range(8))
     for t in range(64):
-        S1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
-        ch = (e & f) ^ (~e & g) & _MASK
-        t1 = (h + S1 + ch + _K[t] + w[t]) & _MASK
-        S0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
-        maj = (a & b) ^ (a & c) ^ (b & c)
-        t2 = (S0 + maj) & _MASK
-        h, g, f, e, d, c, b, a = g, f, e, (d + t1) & _MASK, c, b, a, (t1 + t2) & _MASK
-    out = np.stack([a, b, c, d, e, f, g, h])
-    return (out + state) & _MASK
+        v = round_step(v, k[t], w[t])
+    return xp.stack(v) + state
+
+
+# ------------------------------------------------------ bytes <-> word lanes
+
+
+def words_from_bytes(data: np.ndarray) -> np.ndarray:
+    """(n, 4*w) uint8 big-endian bytes -> (n, w) uint32 words."""
+    n = data.shape[0]
+    b = data.reshape(n, -1, 4).astype(np.uint32)
+    return (b[..., 0] << 24) | (b[..., 1] << 16) | (b[..., 2] << 8) | b[..., 3]
+
+
+def bytes_from_words(words: np.ndarray) -> np.ndarray:
+    """(n, w) uint32 words -> (n, 4*w) uint8 big-endian bytes."""
+    n, w = words.shape
+    out = np.empty((n, 4 * w), dtype=np.uint8)
+    for j in range(4):
+        out[:, j::4] = (words >> np.uint32(24 - 8 * j)).astype(np.uint8)
+    return out
+
+
+def pad_blocks(length: int) -> bytes:
+    """SHA-256 padding suffix for an `length`-byte message: 0x80, zeros to
+    56 mod 64, 64-bit bit length. A message whose length is 0 mod 64 (the
+    merkle-pair 64-byte edge included) gains a WHOLE extra block."""
+    pad_zeros = (55 - length) % 64
+    return b"\x80" + b"\x00" * pad_zeros + (8 * length).to_bytes(8, "big")
+
+
+def sha256_msgs(msgs: np.ndarray) -> np.ndarray:
+    """sha256 of n equal-length messages, vectorized on the host lane.
+
+    msgs: (n, L) uint8. Returns (n, 32) uint8. Handles any L (multi-block
+    messages included) — the general entry the hashlib-parity test matrix
+    drives; `sha256_pairs` is the L=64 merkle fast path."""
+    n, length = msgs.shape
+    suffix = np.frombuffer(pad_blocks(length), np.uint8)
+    padded = np.concatenate(
+        [msgs, np.broadcast_to(suffix, (n, suffix.shape[0]))], axis=1
+    )
+    words = words_from_bytes(padded)                    # (n, 16*blocks)
+    state = np.broadcast_to(_H032[:, None], (8, n)).copy()
+    for blk in range(words.shape[1] // 16):
+        w16 = words[:, 16 * blk : 16 * blk + 16].T.copy()   # (16, n)
+        state = compress(state, w16, _K32, np)
+    return bytes_from_words(state.T)
 
 
 def sha256_pairs(left: np.ndarray, right: np.ndarray) -> np.ndarray:
@@ -78,21 +159,14 @@ def sha256_pairs(left: np.ndarray, right: np.ndarray) -> np.ndarray:
 
     left/right: (n, 32) uint8 arrays. Returns (n, 32) uint8."""
     n = left.shape[0]
-    msg = np.concatenate([left, right], axis=1)           # (n, 64)
-    w16 = (
-        msg.reshape(n, 16, 4).astype(np.uint64)
-        @ np.array([1 << 24, 1 << 16, 1 << 8, 1], dtype=np.uint64)
-    ).T                                                    # (16, n) big-endian words
-    state = np.broadcast_to(_H0[:, None], (8, n)).copy()
-    state = _compress(state, w16)
-    pad = np.broadcast_to(_PAD_WORDS[:, None], (16, n))
-    state = _compress(state, pad)
-    # (8, n) words -> (n, 32) bytes big-endian
-    out = np.empty((n, 32), dtype=np.uint8)
-    s = state.T                                            # (n, 8)
-    for j in range(4):
-        out[:, j::4] = (s >> np.uint64(24 - 8 * j)).astype(np.uint8)
-    return out
+    w16 = np.concatenate(
+        [words_from_bytes(left), words_from_bytes(right)], axis=1
+    ).T.copy()                                          # (16, n)
+    state = np.broadcast_to(_H032[:, None], (8, n)).copy()
+    state = compress(state, w16, _K32, np)
+    pad = np.broadcast_to(_PAIR_PAD32[:, None], (16, n))
+    state = compress(state, pad, _K32, np)
+    return bytes_from_words(state.T)
 
 
 def hash_level(layer: list[bytes], pad: bytes) -> list[bytes]:

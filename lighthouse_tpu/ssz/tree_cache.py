@@ -54,6 +54,18 @@ def _hash_level_full(arr: np.ndarray, d: int) -> np.ndarray:
 
 
 def _build(leaves: np.ndarray, depth: int):
+    if leaves.shape[0]:
+        # full rebuilds of large lists are the device tree-hash engine's
+        # workload (bn --hash-backend); the router returns levels in THIS
+        # function's exact format (or None: the ladder below serves), so
+        # the snapshot diff machinery works identically over device-built
+        # levels — the dirty-path _update stays host (a handful of
+        # hashes; a device round trip per touched node would lose)
+        from ..jaxhash.router import ROUTER
+
+        routed = ROUTER.maybe_build_levels(leaves, depth)
+        if routed is not None:
+            return routed
     levels = []
     cur = leaves
     for d in range(depth):

@@ -212,13 +212,21 @@ def process_rewards_and_penalties_altair(state, spec, fork):
     # pyspec application order: each delta set is applied across the whole
     # registry before the next (matters only at the zero-balance clamp)
     eligible = _eligible_validator_indices(state, spec)
-    deltas = [
-        get_flag_index_deltas(state, spec, f, fork, eligible=eligible)
-        for f in range(len(acc.PARTICIPATION_FLAG_WEIGHTS))
-    ]
-    deltas.append(
-        get_inactivity_penalty_deltas(state, spec, fork, eligible=eligible)
-    )
+    # second accelerated workload (lighthouse_tpu/jaxhash): with a
+    # device-backed --hash-backend and a large registry the four delta
+    # sets compute as vectors (device arrays, host-numpy fallback) —
+    # bit-exact with the scalar loops, which remain the host default
+    from ..jaxhash import epoch_vectors as _ev
+
+    deltas = _ev.altair_deltas(state, spec, fork, eligible)
+    if deltas is None:
+        deltas = [
+            get_flag_index_deltas(state, spec, f, fork, eligible=eligible)
+            for f in range(len(acc.PARTICIPATION_FLAG_WEIGHTS))
+        ]
+        deltas.append(
+            get_inactivity_penalty_deltas(state, spec, fork, eligible=eligible)
+        )
     for rewards, penalties in deltas:
         for i in range(len(state.validators)):
             mut.increase_balance(state, i, rewards[i])
@@ -287,6 +295,18 @@ def process_eth1_data_reset(state, spec):
 
 
 def process_effective_balance_updates(state, spec):
+    # vectorized hysteresis scan at registry scale (jaxhash epoch stage);
+    # the copy_with writes below stay scalar either way — only CHANGED
+    # validators are rewritten, preserving the memoized-root semantics
+    from ..jaxhash import epoch_vectors as _ev
+
+    changes = _ev.effective_balance_updates(state, spec)
+    if changes is not None:
+        for i, new_eff in changes:
+            state.validators[i] = state.validators[i].copy_with(
+                effective_balance=new_eff
+            )
+        return
     hysteresis_increment = spec.effective_balance_increment // spec.hysteresis_quotient
     downward = hysteresis_increment * spec.hysteresis_downward_multiplier
     upward = hysteresis_increment * spec.hysteresis_upward_multiplier

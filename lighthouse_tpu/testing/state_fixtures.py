@@ -1,0 +1,85 @@
+"""Synthetic validator-scale BeaconStates for benches and loadgen.
+
+One builder shared by scripts/bench_state_root.py, the `bn loadtest
+state_root` scenario (loadgen/state_root.py) and the jaxhash tests, so
+the state-root workload every harness measures is the SAME shape:
+an n-validator deneb state on the minimal spec (pubkeys are opaque bytes
+for hashing purposes — no key derivation), optionally with seeded
+participation/inactivity so the epoch-transition vectors have real work.
+"""
+
+from __future__ import annotations
+
+import random
+
+
+def build_synthetic_state(n: int, *, participation_seed: int | None = None,
+                          slot: int | None = None):
+    """(spec, types, state) with n validators. With `participation_seed`
+    the participation flags / inactivity scores / balances are seeded
+    non-trivial (the epoch-transition workload); `slot` defaults to 0
+    (pass an epoch-boundary-minus-one slot to bench process_epoch)."""
+    from ..state_transition.slot import types_for_slot
+    from ..types.spec import FAR_FUTURE_EPOCH, minimal_spec
+
+    spec = minimal_spec()
+    types = types_for_slot(spec, 0)
+    validators = [
+        types.Validator.make(
+            pubkey=i.to_bytes(48, "big"),
+            withdrawal_credentials=i.to_bytes(32, "big"),
+            effective_balance=32 * 10**9,
+            slashed=False,
+            activation_eligibility_epoch=0,
+            activation_epoch=0,
+            exit_epoch=FAR_FUTURE_EPOCH,
+            withdrawable_epoch=FAR_FUTURE_EPOCH,
+        )
+        for i in range(n)
+    ]
+    state = types.BeaconState.default()
+    state.validators = validators
+    state.balances = [32 * 10**9] * n
+    state.previous_epoch_participation = [0] * n
+    state.current_epoch_participation = [0] * n
+    state.inactivity_scores = [0] * n
+    if participation_seed is not None:
+        rng = random.Random(participation_seed)
+        state.previous_epoch_participation = [
+            rng.randrange(0, 8) for _ in range(n)
+        ]
+        state.current_epoch_participation = [
+            rng.randrange(0, 8) for _ in range(n)
+        ]
+        state.inactivity_scores = [rng.randrange(0, 8) for _ in range(n)]
+        state.balances = [
+            32 * 10**9 + rng.randrange(-10**9, 10**9) for _ in range(n)
+        ]
+    if slot is not None:
+        state.slot = slot
+    return spec, types, state
+
+
+def uncached_state_root(types, state) -> bytes:
+    """Ground-truth root: a from-scratch rehash of a deep copy with every
+    cache defeated — memoized container roots stripped, a FRESH list tree
+    cache, and the host hash backend — so a cached/device root can be
+    proven against it."""
+    import copy
+
+    from ..jaxhash import router as _router
+    from ..ssz import tree_cache as _tc
+
+    st = copy.deepcopy(state)
+    for v in st.validators:
+        if hasattr(v, "_htr"):
+            object.__delattr__(v, "_htr")
+    prev_cache = _tc.GLOBAL_LIST_CACHE
+    prev_backend = _router._state["backend"]
+    _tc.GLOBAL_LIST_CACHE = _tc.ListTreeCache()
+    try:
+        _router.set_hash_backend("host")
+        return types.BeaconState.hash_tree_root(st)
+    finally:
+        _router._state["backend"] = prev_backend
+        _tc.GLOBAL_LIST_CACHE = prev_cache

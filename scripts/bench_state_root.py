@@ -1,17 +1,33 @@
 #!/usr/bin/env python
-"""Micro-bench: BeaconState.hash_tree_root at validator scale.
+"""Micro-bench: BeaconState.hash_tree_root + epoch transition at validator
+scale — the second workload's bench, CPU-provable.
 
-Measures the tree-hash caching layer (ssz/core.py MEMOIZED_ROOT_TYPES +
-the structural-sharing clone_state): `cold` is a first-ever root (every
-validator hashed), `steady` is the production pattern — clone the state,
-mutate a handful of validators/balances (one block's worth), re-root.
+Measures the tree-hash stack end to end (ssz/core.py MEMOIZED_ROOT_TYPES +
+structural-sharing clone_state + the jaxhash device engine when
+--hash-backend selects it): `cold` is a first-ever root (every validator
+hashed), `steady` is the production pattern — clone the state, mutate a
+handful of validators/balances (one block's worth), re-root — and
+`epoch_transition` times process_epoch on a participation-seeded state.
+Every steady root is proven against a cache-free ground-truth rehash, so
+unlike the BLS bench this whole run is verifiable without TPU access.
 The reference gets the same effect from milhouse + cached_tree_hash
 (/root/reference/consensus/cached_tree_hash/src/lib.rs:1).
 
+--bench-matrix lands `state_root` / `epoch_transition` rows (p50 +
+roots/s, with a bounded fresh-measurement history) in the BENCH_MATRIX
+schema via observability/perf.write_loadtest_rows, beside the BLS
+configs; the perf trend gate checks the state-root p50 series
+fresh-to-fresh like config1_p50. --smoke shrinks the run to seconds and
+writes the gitignored *_SMOKE variant.
+
 Usage: python scripts/bench_state_root.py [--validators 16384]
+           [--reps 5] [--hash-backend host|device|hybrid]
+           [--bench-matrix] [--bench-root DIR] [--smoke]
 """
 
 import argparse
+import json
+import statistics
 import sys
 import time
 
@@ -19,80 +35,185 @@ sys.path.insert(0, ".")
 
 
 def build_state(n):
-    """Synthetic n-validator deneb state (pubkeys are opaque bytes for
-    hashing purposes; no key derivation needed)."""
-    from lighthouse_tpu.types.spec import minimal_spec, FAR_FUTURE_EPOCH
-    from lighthouse_tpu.state_transition.slot import types_for_slot
+    """Kept for compatibility: the builder lives in
+    lighthouse_tpu/testing/state_fixtures.py (shared with the loadgen
+    state_root scenario and the jaxhash tests)."""
+    from lighthouse_tpu.testing.state_fixtures import build_synthetic_state
 
-    spec = minimal_spec()
-    types = types_for_slot(spec, 0)
-    validators = [
-        types.Validator.make(
-            pubkey=i.to_bytes(48, "big"),
-            withdrawal_credentials=i.to_bytes(32, "big"),
-            effective_balance=32 * 10**9,
-            slashed=False,
-            activation_eligibility_epoch=0,
-            activation_epoch=0,
-            exit_epoch=FAR_FUTURE_EPOCH,
-            withdrawable_epoch=FAR_FUTURE_EPOCH,
-        )
-        for i in range(n)
-    ]
-    state = types.BeaconState.default()
-    state.validators = validators
-    state.balances = [32 * 10**9] * n
-    state.previous_epoch_participation = [0] * n
-    state.current_epoch_participation = [0] * n
-    state.inactivity_scores = [0] * n
-    return spec, types, state
+    return build_synthetic_state(n)
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--validators", type=int, default=16384)
-    args = ap.parse_args()
-
+def bench_state_root(n, reps):
     from lighthouse_tpu.testing.harness import clone_state
+    from lighthouse_tpu.testing.state_fixtures import (
+        build_synthetic_state,
+        uncached_state_root,
+    )
 
-    spec, types, state = build_state(args.validators)
+    spec, types, state = build_synthetic_state(n)
 
     t0 = time.time()
     root_cold = types.BeaconState.hash_tree_root(state)
     cold = time.time() - t0
 
-    # steady state: clone + one block's worth of mutation + re-root
-    st2 = clone_state(state, spec)
-    for i in range(8):
-        st2.validators[i * 7] = st2.validators[i * 7].copy_with(
-            effective_balance=31 * 10**9
-        )
-        st2.balances[i * 7] = 31 * 10**9
-    st2.slot = 1
-    t0 = time.time()
-    root_steady = types.BeaconState.hash_tree_root(st2)
-    steady = time.time() - t0
-    assert root_steady != root_cold
+    # steady state: clone + one block's worth of mutation + re-root,
+    # repeated so the p50 is a median of real reroots, not one sample
+    steady_secs = []
+    prev_root = root_cold
+    st = state
+    for rep in range(max(1, reps)):
+        st = clone_state(st, spec)
+        for i in range(8):
+            idx = (i * 7 + rep * 61) % n
+            st.validators[idx] = st.validators[idx].copy_with(
+                effective_balance=31 * 10**9 + rep
+            )
+            st.balances[idx] = 31 * 10**9 + rep
+        st.slot = rep + 1
+        t0 = time.time()
+        root_steady = types.BeaconState.hash_tree_root(st)
+        steady_secs.append(time.time() - t0)
+        assert root_steady != prev_root
+        prev_root = root_steady
 
     # ground truth: the steady root must equal a from-scratch rehash of an
-    # identical state with no caches anywhere
-    import copy
-
-    st3 = copy.deepcopy(st2)
-    for v in st3.validators:
-        if hasattr(v, "_htr"):
-            object.__delattr__(v, "_htr")
+    # identical state with no caches anywhere (device or host path alike)
     t0 = time.time()
-    root_check = types.BeaconState.hash_tree_root(st3)
+    root_check = uncached_state_root(types, st)
     uncached = time.time() - t0
     assert root_check == root_steady, "cached root diverged from ground truth"
 
+    steady_p50 = statistics.median(steady_secs)
+    return {
+        "validators": n,
+        "cold_ms": round(cold * 1e3, 3),
+        "p50_ms": round(steady_p50 * 1e3, 3),
+        "roots_per_sec": round(1.0 / steady_p50, 2) if steady_p50 else None,
+        "uncached_ms": round(uncached * 1e3, 3),
+        "speedup_steady_vs_uncached": (
+            round(uncached / steady_p50, 1) if steady_p50 else None
+        ),
+        "samples": len(steady_secs),
+    }
+
+
+def bench_epoch_transition(n, reps):
+    """process_epoch on a participation-seeded state one slot before an
+    epoch boundary — the per-epoch balance/reward vector workload the
+    jaxhash epoch stage accelerates."""
+    import copy
+
+    from lighthouse_tpu.state_transition.epoch import process_epoch
+    from lighthouse_tpu.state_transition.slot import types_for_slot
+    from lighthouse_tpu.testing.state_fixtures import build_synthetic_state
+
+    spec, types, state = build_synthetic_state(n, participation_seed=0xE9)
+    spe = spec.preset.SLOTS_PER_EPOCH
+    state.slot = 3 * spe - 1
+    fork = spec.fork_name_at_slot(state.slot)
+    types = types_for_slot(spec, state.slot)
+
+    secs = []
+    balances = None
+    for _ in range(max(1, reps)):
+        st = copy.deepcopy(state)
+        t0 = time.time()
+        process_epoch(st, spec, types, fork)
+        secs.append(time.time() - t0)
+        # determinism across reps (and across hash backends — the
+        # vectorized epoch stage must not change a single balance)
+        if balances is None:
+            balances = list(st.balances)
+        else:
+            assert balances == list(st.balances), "epoch transition diverged"
+    p50 = statistics.median(secs)
+    return {
+        "validators": n,
+        "p50_ms": round(p50 * 1e3, 3),
+        "epochs_per_sec": round(1.0 / p50, 3) if p50 else None,
+        "samples": len(secs),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--validators", type=int, default=16384)
+    ap.add_argument("--reps", type=int, default=5,
+                    help="steady reroots / epoch reps the p50 is taken over")
+    ap.add_argument("--hash-backend", default=None,
+                    choices=["host", "device", "hybrid"],
+                    help="tree-hash backend (default: "
+                         "LIGHTHOUSE_TPU_HASH_BACKEND or host)")
+    ap.add_argument("--bench-matrix", action="store_true",
+                    help="write state_root / epoch_transition rows (with "
+                         "fresh-measurement history) into the BENCH_MATRIX "
+                         "schema via observability/perf.write_loadtest_rows")
+    ap.add_argument("--bench-root", default=None,
+                    help="directory for the matrix write (default: repo root)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="seconds-sized run (2048 validators, 3 reps) into "
+                         "the gitignored BENCH_MATRIX_SMOKE.json")
+    ap.add_argument("--skip-epoch", action="store_true",
+                    help="state root only")
+    args = ap.parse_args()
+
+    if args.hash_backend:
+        from lighthouse_tpu.jaxhash import set_hash_backend
+
+        set_hash_backend(args.hash_backend)
+    from lighthouse_tpu.jaxhash import hash_backend
+
+    n = min(args.validators, 2048) if args.smoke else args.validators
+    reps = min(args.reps, 3) if args.smoke else args.reps
+
+    sr = bench_state_root(n, reps)
     print(
-        f"validators={args.validators} cold={cold:.3f}s "
-        f"steady={steady:.3f}s uncached={uncached:.3f}s "
-        f"speedup_steady_vs_uncached={uncached / steady:.1f}x"
+        f"state_root validators={n} cold={sr['cold_ms']:.1f}ms "
+        f"steady_p50={sr['p50_ms']:.1f}ms uncached={sr['uncached_ms']:.1f}ms "
+        f"speedup_steady_vs_uncached={sr['speedup_steady_vs_uncached']}x "
+        f"hash_backend={hash_backend()}"
     )
+    rows = {
+        "state_root": dict(
+            sr, source="bench_state_root", hash_backend=hash_backend(),
+            measured_unix=round(time.time(), 3),
+        )
+    }
+    if not args.skip_epoch:
+        et = bench_epoch_transition(n, reps)
+        print(
+            f"epoch_transition validators={n} p50={et['p50_ms']:.1f}ms "
+            f"hash_backend={hash_backend()}"
+        )
+        rows["epoch_transition"] = dict(
+            et, source="bench_state_root", hash_backend=hash_backend(),
+            measured_unix=round(time.time(), 3),
+        )
+    if args.bench_matrix:
+        from lighthouse_tpu.observability import perf
+
+        path = perf.write_loadtest_rows(
+            rows, smoke=args.smoke, root=args.bench_root
+        )
+        print(f"bench matrix rows -> {path}")
+        if args.smoke:
+            # the gate reads BENCH_MATRIX.json; smoke rows land in the
+            # ungated *_SMOKE variant — a verdict here would describe an
+            # artifact this run never touched
+            print("perf trend gate not evaluated (smoke rows land in the "
+                  "ungated BENCH_MATRIX_SMOKE.json)")
+        else:
+            rc, report = perf.check(root=args.bench_root)
+            if rc:
+                print(
+                    "PERF: trend gate failed after this run: "
+                    + json.dumps(report["regressions"]),
+                    file=sys.stderr,
+                )
+                return rc
+            print("perf trend gate clean")
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -45,6 +45,8 @@ METRIC_MODULES = (
     "lighthouse_tpu.chain.validator_monitor",
     "lighthouse_tpu.crypto.bls.hybrid",
     "lighthouse_tpu.crypto.jaxbls.pipeline",
+    "lighthouse_tpu.jaxhash",
+    "lighthouse_tpu.jaxhash.engine",
     "lighthouse_tpu.autotune.profiler",
     "lighthouse_tpu.observability",
     "lighthouse_tpu.observability.device",
@@ -144,6 +146,17 @@ def lint_registry(registry=None) -> list[str]:
                 errors.append(
                     f"{where}: mesh_* metrics must be labeled families "
                     "(axis / chip / lane / outcome)"
+                )
+        if m.name.startswith(("jaxhash_", "tree_hash_route_")):
+            # the tree-hash engine's series answer "which lane / which op
+            # / which path served and why" — an unlabeled aggregate over
+            # the sharded and single-chip lanes (or over route reasons)
+            # hides exactly the second workload's routing, so the
+            # convention is enforced like bls_hybrid_route/mesh_*
+            if not getattr(m, "labelnames", ()):
+                errors.append(
+                    f"{where}: jaxhash_*/tree_hash_route_* metrics must "
+                    "be labeled families (lane / op / path+reason)"
                 )
         if m.name.startswith(("jaxbls_stage_", "xla_program_")):
             # per-stage attribution and compiled-program analytics exist

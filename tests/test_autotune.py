@@ -729,3 +729,53 @@ def test_processor_max_inflight_retunes_on_install(monkeypatch):
             pinned.shutdown() if hasattr(pinned, "shutdown") else None
     finally:
         proc.shutdown() if hasattr(proc, "shutdown") else None
+
+
+# ------------------------------------------------------- tree hashing (r9)
+
+
+def test_profile_tree_hash_buckets_round_trip(tmp_path):
+    """r9: tree_hash_buckets persist, validate, and round-trip; a
+    malformed/negative bucket list is refused at parse time."""
+    p = synthetic_profile()
+    p.tree_hash_buckets = (16384, 65536)
+    path = profile.save(p, str(tmp_path / "p.json"))
+    again = profile.load(path)
+    assert again.tree_hash_buckets == (16384, 65536)
+    # absent -> None (pre-r9 docs parse)
+    doc = json.loads(open(path).read())
+    doc.pop("tree_hash_buckets")
+    (tmp_path / "legacy.json").write_text(json.dumps(doc))
+    assert profile.load(str(tmp_path / "legacy.json")).tree_hash_buckets is None
+    # invalid values refuse loudly
+    doc["tree_hash_buckets"] = [0]
+    (tmp_path / "bad.json").write_text(json.dumps(doc))
+    with pytest.raises(ValueError):
+        profile.load(str(tmp_path / "bad.json"))
+    doc["tree_hash_buckets"] = ["x"]
+    (tmp_path / "bad2.json").write_text(json.dumps(doc))
+    with pytest.raises(ValueError):
+        profile.load(str(tmp_path / "bad2.json"))
+
+
+def test_plan_tree_hash_warmup_derivation():
+    """Planner pass-through: measured buckets clamp to the sane range and
+    deduplicate in order; unmeasured profiles get the registry-scale
+    default (the jaxhash warmup consumes plan.tree_hash_warmup)."""
+    p = synthetic_profile()
+    assert planner.plan_from_profile(p).tree_hash_warmup == \
+        planner.DEFAULT_TREE_HASH_WARMUP
+    p.tree_hash_buckets = (4, 16384, 16384, 1 << 40)
+    plan = planner.plan_from_profile(p)
+    assert plan.tree_hash_warmup == (
+        planner.TREE_HASH_BUCKET_CLAMP[0], 16384,
+        planner.TREE_HASH_BUCKET_CLAMP[1],
+    )
+    # COUNT cap (the BLS MAX_WARMUP_BUCKETS contract): a 60-entry profile
+    # must not compile 60 ladders at bring-up
+    p.tree_hash_buckets = tuple(64 * 2**i for i in range(10))
+    capped = planner.plan_from_profile(p).tree_hash_warmup
+    assert len(capped) == planner.MAX_TREE_HASH_WARMUP
+    # and the installed plan surfaces it to consumers
+    runtime.install_profile(p)
+    assert runtime.active_plan().tree_hash_warmup == capped

@@ -316,3 +316,138 @@ def test_render_report_marks_loadtest_rows_fresh(tmp_path):
     assert "loadtest_flood_mesh8" in text
     assert "source=loadtest (fresh soak snapshot, 8 device(s))" in text
     assert "SKIPPED" not in text.split("loadtest_flood_mesh8")[1].split("\n")[0]
+
+
+# -------------------------------------------------- state-root series (r9)
+
+
+def _write_state_root(root, p50, smoke=False, backend="host",
+                      validators=16384):
+    from lighthouse_tpu.observability import perf
+
+    return perf.write_loadtest_rows(
+        {"state_root": {
+            "p50_ms": p50, "roots_per_sec": round(1000.0 / p50, 2),
+            "source": "bench_state_root", "measured_unix": float(p50),
+            "hash_backend": backend, "validators": validators,
+        }},
+        smoke=smoke, root=root,
+    )
+
+
+def test_state_root_rows_accumulate_history(tmp_path):
+    """bench_state_root rows merge like loadtest rows and accumulate a
+    bounded fresh-measurement history; epoch_transition keys are accepted
+    too and both parse through load_matrix."""
+    root = str(tmp_path)
+    _write_state_root(root, 100.0)
+    _write_state_root(root, 98.0)
+    from lighthouse_tpu.observability import perf
+
+    perf.write_loadtest_rows(
+        {"epoch_transition": {"p50_ms": 50.0, "epochs_per_sec": 20.0,
+                              "source": "bench_state_root",
+                              "measured_unix": 3.0}},
+        smoke=False, root=root,
+    )
+    parsed = perf.load_matrix(root=root)
+    assert parsed["state_root"]["p50_ms"] == 98.0
+    assert [e["p50_ms"] for e in parsed["state_root"]["history"]] == [
+        100.0, 98.0,
+    ]
+    assert parsed["epoch_transition"]["rate"] == 20.0
+    assert parsed["epoch_transition"]["rate_unit"] == "epochs_per_sec"
+    # history is bounded
+    for i in range(perf.MAX_ROW_HISTORY + 4):
+        _write_state_root(root, 98.0 + i * 0.01)
+    parsed = perf.load_matrix(root=root)
+    assert len(parsed["state_root"]["history"]) == perf.MAX_ROW_HISTORY
+
+
+def test_state_root_p50_regression_gates(tmp_path):
+    """A fresh-to-fresh state-root p50 INCREASE past the threshold fails
+    the gate exactly like config1_p50 (lower is better)."""
+    root = str(tmp_path)
+    _write_state_root(root, 100.0)
+    _write_state_root(root, 125.0)  # +25% latency
+    from lighthouse_tpu.observability import perf
+
+    rc, report = perf.check(root)
+    assert rc == 1
+    reg = [r for r in report["regressions"]
+           if r["config"] == "state_root_p50"]
+    assert reg and reg[0]["delta_pct"] == 25.0
+    text = perf.render_report(report)
+    assert "state_root p50" in text
+    # the script CLI rides the same verdict
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "perf_trend.py"),
+         "--root", root, "--check"],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 1
+
+
+def test_state_root_p50_improvement_and_carried_pass(tmp_path):
+    """Improvements pass; an entry marked fresh=false (a hand-carried
+    value) is EXCLUDED from deltas and renders as carried — it can
+    neither cause nor mask a regression."""
+    import json
+
+    root = str(tmp_path)
+    _write_state_root(root, 100.0)
+    # inject a non-fresh entry between two fresh ones
+    path = os.path.join(root, "BENCH_MATRIX.json")
+    doc = json.loads(open(path).read())
+    doc["state_root"]["history"].append(
+        {"measured_unix": 2.0, "p50_ms": 500.0, "fresh": False}
+    )
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    _write_state_root(root, 92.0)  # fresh improvement vs 100.0
+    from lighthouse_tpu.observability import perf
+
+    rc, report = perf.check(root)
+    assert rc == 0, report["regressions"]
+    deltas = report["state_root_p50"]["deltas"]
+    assert len(deltas) == 1 and deltas[0]["delta_pct"] == -8.0
+    text = perf.render_report(report)
+    assert "CARRIED FORWARD" in text
+
+
+def test_state_root_p50_config_change_not_a_regression(tmp_path):
+    """A host->device (or resized) re-measurement is a CONFIGURATION
+    change: the pair must not gate, and the next same-config pair must
+    compare — so a backend flip can neither fail CI nor mask a real
+    same-config regression."""
+    from lighthouse_tpu.observability import perf
+
+    root = str(tmp_path)
+    _write_state_root(root, 20.0, backend="device")
+    _write_state_root(root, 100.0, backend="host")   # +400%: config change
+    rc, report = perf.check(root)
+    assert rc == 0, report["regressions"]
+    assert report["state_root_p50"]["deltas"] == []
+    # same-config regression after the flip still gates
+    _write_state_root(root, 125.0, backend="host")   # +25% host-to-host
+    rc, report = perf.check(root)
+    assert rc == 1
+    assert [r["config"] for r in report["regressions"]] == ["state_root_p50"]
+
+
+def test_state_root_p50_interleaved_config_cannot_mask(tmp_path):
+    """An interleaved config-change entry must not break the same-config
+    chain: host 100 -> device 20 -> host 125 still gates the host-to-host
+    +25% (entries compare against the most recent SAME-config entry, not
+    the adjacent one)."""
+    from lighthouse_tpu.observability import perf
+
+    root = str(tmp_path)
+    _write_state_root(root, 100.0, backend="host")
+    _write_state_root(root, 20.0, backend="device")
+    _write_state_root(root, 125.0, backend="host")
+    rc, report = perf.check(root)
+    assert rc == 1, report["state_root_p50"]
+    reg = [r for r in report["regressions"]
+           if r["config"] == "state_root_p50"]
+    assert reg and reg[0]["delta_pct"] == 25.0

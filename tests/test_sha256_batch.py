@@ -1,23 +1,33 @@
-"""Vectorized batched sha256 vs hashlib ground truth."""
+"""Vectorized batched sha256 vs hashlib ground truth — ONE schedule, both
+lanes: the numpy host formulation and the jnp device port share the
+schedule definition (ssz/sha256_batch schedule_word/round_step), so the
+parity matrix pins BOTH against hashlib, multi-block messages and the
+64-byte padding edge included."""
 
 import hashlib
 import random
 
 import numpy as np
+import pytest
 
-from lighthouse_tpu.ssz.sha256_batch import hash_level, sha256_pairs
 from lighthouse_tpu.ssz.core import ZERO_HASHES, merkleize
+from lighthouse_tpu.ssz.sha256_batch import (
+    hash_level,
+    pad_blocks,
+    sha256_msgs,
+    sha256_pairs,
+)
+
+
+def _rand_bytes(rng, n):
+    return bytes(rng.getrandbits(8) for _ in range(n))
 
 
 def test_sha256_pairs_matches_hashlib():
     rng = random.Random(0x5A)
     n = 257
-    left = np.frombuffer(
-        bytes(rng.getrandbits(8) for _ in range(32 * n)), np.uint8
-    ).reshape(n, 32)
-    right = np.frombuffer(
-        bytes(rng.getrandbits(8) for _ in range(32 * n)), np.uint8
-    ).reshape(n, 32)
+    left = np.frombuffer(_rand_bytes(rng, 32 * n), np.uint8).reshape(n, 32)
+    right = np.frombuffer(_rand_bytes(rng, 32 * n), np.uint8).reshape(n, 32)
     got = sha256_pairs(left, right)
     for i in range(n):
         want = hashlib.sha256(left[i].tobytes() + right[i].tobytes()).digest()
@@ -33,9 +43,71 @@ def test_hash_level_odd_padding():
 
 def test_level_ladder_matches_merkleize():
     rng = random.Random(1)
-    chunks = [bytes(rng.getrandbits(8) for _ in range(32)) for _ in range(1000)]
+    chunks = [_rand_bytes(rng, 32) for _ in range(1000)]
     want = merkleize(chunks, 1024)
     layer = list(chunks)
     for d in range(10):
         layer = hash_level(layer, ZERO_HASHES[d])
     assert layer[0] == want
+
+
+def test_pad_blocks_edges():
+    """The padding suffix must land every message on a block boundary —
+    the 64-byte (merkle pair) edge gains a WHOLE extra block."""
+    for length in (0, 1, 55, 56, 63, 64, 65, 119, 128):
+        assert (length + len(pad_blocks(length))) % 64 == 0
+    # 64-byte edge: 0x80 + 55 zeros + 8 length bytes = one extra block
+    assert len(pad_blocks(64)) == 64
+
+
+# the shared-schedule parity matrix: every (lane, message length) cell is
+# pinned against hashlib. Lengths cover sub-block, the 55/56 length-field
+# straddle, the 64-byte merkle-pair padding edge, and multi-block.
+_HOST_LENGTHS = (0, 1, 55, 56, 63, 64, 65, 119, 128, 200)
+_DEVICE_LENGTHS = (64, 65, 128, 200)  # one jit per block count: keep it lean
+
+
+@pytest.mark.parametrize("length", _HOST_LENGTHS)
+def test_sha256_msgs_host_matches_hashlib(length):
+    rng = random.Random(length)
+    n = 9
+    msgs = np.frombuffer(
+        _rand_bytes(rng, n * length), np.uint8
+    ).reshape(n, length)
+    got = sha256_msgs(msgs)
+    for i in range(n):
+        assert got[i].tobytes() == hashlib.sha256(msgs[i].tobytes()).digest()
+
+
+@pytest.mark.parametrize("length", _DEVICE_LENGTHS)
+def test_sha256_msgs_device_matches_hashlib(length):
+    """The jnp lane over the SAME schedule bodies (rolled driver)."""
+    from lighthouse_tpu.jaxhash.engine import sha256_msgs_device
+
+    rng = random.Random(1000 + length)
+    n = 9
+    msgs = np.frombuffer(
+        _rand_bytes(rng, n * length), np.uint8
+    ).reshape(n, length)
+    got = sha256_msgs_device(msgs)
+    for i in range(n):
+        assert got[i].tobytes() == hashlib.sha256(msgs[i].tobytes()).digest()
+
+
+def test_device_pairs_via_one_level_ladder():
+    """The device ladder's bottom level IS sha256(left||right) for every
+    pair — pin one level against hashlib directly (the engine-level
+    analog of test_sha256_pairs_matches_hashlib)."""
+    from lighthouse_tpu.jaxhash import engine
+
+    rng = random.Random(0xDE)
+    n = engine.MIN_LEAVES
+    leaves = np.frombuffer(
+        _rand_bytes(rng, 32 * n), np.uint8
+    ).reshape(n, 32)
+    levels, _root = engine.device_build_levels(leaves, n.bit_length() - 1)
+    for i in range(n // 2):
+        want = hashlib.sha256(
+            leaves[2 * i].tobytes() + leaves[2 * i + 1].tobytes()
+        ).digest()
+        assert levels[0][i].tobytes() == want

@@ -1,0 +1,127 @@
+"""Incremental merkleization cache (ssz/tree_cache.py) edge cases the
+jaxhash routing exposes: shrinking lists, growth across a virtual-depth
+boundary, ring eviction under interleaved list types, and
+diff-vs-snapshot correctness when the DEVICE path returned the cached
+levels."""
+
+import numpy as np
+import pytest
+
+import lighthouse_tpu.ssz.tree_cache as tc
+from lighthouse_tpu.jaxhash.router import ROUTER, set_hash_backend
+from lighthouse_tpu.ssz.core import merkleize, next_pow2
+
+
+@pytest.fixture(autouse=True)
+def _host_default():
+    set_hash_backend(None)
+    yield
+    set_hash_backend(None)
+
+
+DEPTH = 12  # virtual depth (limit 4096): every test list is far below it
+
+
+def _leaves(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, (n, 32), dtype=np.uint8)
+
+
+def _expected_root(leaves):
+    chunks = [leaves[i].tobytes() for i in range(leaves.shape[0])]
+    return merkleize(chunks, 2**DEPTH)
+
+
+def test_shrinking_list_rebuilds_correctly():
+    cache = tc.ListTreeCache()
+    key = object()
+    big = _leaves(300, seed=1)
+    assert cache.root(key, big, DEPTH) == _expected_root(big)
+    # shrink: snapshot shapes no longer match -> full rebuild, right root
+    small = big[:200].copy()
+    assert cache.root(key, small, DEPTH) == _expected_root(small)
+    # and the shrunken snapshot serves incremental updates afterwards
+    small2 = small.copy()
+    small2[7] ^= 0xFF
+    assert cache.root(key, small2, DEPTH) == _expected_root(small2)
+
+
+def test_growth_across_pow2_boundary():
+    """255 -> 257 leaves crosses the next_pow2 boundary: every level
+    array lengthens, the update path must fall back to a rebuild and the
+    new snapshot must be internally consistent."""
+    cache = tc.ListTreeCache()
+    key = object()
+    a = _leaves(255, seed=2)
+    assert cache.root(key, a, DEPTH) == _expected_root(a)
+    assert next_pow2(257) != next_pow2(255)
+    b = np.concatenate([a, _leaves(2, seed=3)])
+    assert cache.root(key, b, DEPTH) == _expected_root(b)
+    b2 = b.copy()
+    b2[256] ^= 0x55
+    assert cache.root(key, b2, DEPTH) == _expected_root(b2)
+
+
+def test_incremental_update_actually_used(monkeypatch):
+    """A small diff against a warm snapshot must take the dirty-path
+    update, not a rebuild (the cache's whole point): wedge _build after
+    warmup and require the re-root to still succeed."""
+    cache = tc.ListTreeCache()
+    key = object()
+    a = _leaves(300, seed=4)
+    cache.root(key, a, DEPTH)
+
+    def no_rebuild(leaves, depth):
+        raise AssertionError("full rebuild on a small diff")
+
+    monkeypatch.setattr(tc, "_build", no_rebuild)
+    b = a.copy()
+    b[3] ^= 1
+    b[299] ^= 7
+    assert cache.root(key, b, DEPTH) == _expected_root(b)
+
+
+def test_ring_eviction_interleaved_list_types():
+    """Two list types interleaved across more shapes than the ring holds:
+    rings stay bounded per key and every root stays correct."""
+    cache = tc.ListTreeCache()
+    key_a, key_b = object(), object()
+    for i in range(tc._RING + 2):
+        n = 260 + 2 * i
+        la = _leaves(n, seed=10 + i)
+        lb = _leaves(n + 1, seed=50 + i)
+        assert cache.root(key_a, la, DEPTH) == _expected_root(la)
+        assert cache.root(key_b, lb, DEPTH) == _expected_root(lb)
+    assert len(cache._rings[key_a]) == tc._RING
+    assert len(cache._rings[key_b]) == tc._RING
+    # the hot-entry path: an exact replay returns the snapshot root
+    assert cache.root(key_a, la, DEPTH) == _expected_root(la)
+
+
+def test_diff_vs_snapshot_with_device_levels(monkeypatch):
+    """Interop: the snapshot is built by the DEVICE engine, then a small
+    host-side dirty-path update runs over those device-built levels —
+    the root must match ground truth (this is what breaks if device
+    levels were trimmed or laid out differently than _build's)."""
+    monkeypatch.setattr(ROUTER, "min_leaves", 64)
+    set_hash_backend("device")
+    cache = tc.ListTreeCache()
+    key = object()
+    a = _leaves(300, seed=6)
+    from lighthouse_tpu.jaxhash.router import route_totals
+
+    before = route_totals().get("device/ok", 0)
+    root_a = cache.root(key, a, DEPTH)
+    assert route_totals().get("device/ok", 0) == before + 1
+    set_hash_backend("host")  # updates run host-side either way
+    assert root_a == _expected_root(a)
+
+    def no_rebuild(leaves, depth):
+        raise AssertionError("device-built snapshot forced a rebuild")
+
+    monkeypatch.setattr(tc, "_build", no_rebuild)
+    b = a.copy()
+    b[0] ^= 0xAA
+    b[150] ^= 0x0F
+    b[299] ^= 0xF0
+    assert cache.root(key, b, DEPTH) == _expected_root(b)
