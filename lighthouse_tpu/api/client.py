@@ -11,7 +11,26 @@ import urllib.error
 import urllib.request
 
 from ..state_transition.slot import types_for_slot
-from ..validator.beacon_node import AttesterDuty, BeaconNodeError, ProposerDuty
+from ..validator.beacon_node import (
+    AttesterDuty,
+    BeaconNodeError,
+    NodeRateLimited,
+    ProposerDuty,
+)
+
+
+def _http_error(verb: str, path: str, e: urllib.error.HTTPError) -> BeaconNodeError:
+    """429s become the TYPED rate-limit shape so the fallback retries
+    without demoting the node (classification by type, not text)."""
+    if e.code == 429:
+        try:
+            retry_after = float(e.headers.get("Retry-After", 0) or 0)
+        except (TypeError, ValueError):
+            retry_after = 0.0
+        return NodeRateLimited(
+            f"{verb} {path}: 429 rate limited", retry_after=retry_after
+        )
+    return BeaconNodeError(f"{verb} {path}: {e.code} {e.read()[:200]}")
 
 
 class BeaconNodeHttpClient:
@@ -27,7 +46,7 @@ class BeaconNodeHttpClient:
                 body = r.read()
                 return json.loads(body) if body else {}
         except urllib.error.HTTPError as e:
-            raise BeaconNodeError(f"GET {path}: {e.code} {e.read()[:200]}") from e
+            raise _http_error("GET", path, e) from e
         except urllib.error.URLError as e:
             raise BeaconNodeError(f"GET {path}: {e}") from e
 
@@ -41,7 +60,7 @@ class BeaconNodeHttpClient:
                 body = r.read()
                 return json.loads(body) if body else {}
         except urllib.error.HTTPError as e:
-            raise BeaconNodeError(f"POST {path}: {e.code} {e.read()[:200]}") from e
+            raise _http_error("POST", path, e) from e
         except urllib.error.URLError as e:
             raise BeaconNodeError(f"POST {path}: {e}") from e
 

@@ -741,7 +741,12 @@ def cmd_vc(args):
 
     spec = _load_spec(args)
     clients = [BeaconNodeHttpClient(u) for u in args.beacon_nodes.split(",")]
-    nodes = BeaconNodeFallback(clients)
+    # per-call deadline + health-ranked retry/failover knobs
+    # (--vc-timeout > LIGHTHOUSE_TPU_VC_TIMEOUT > 5s; see
+    # validator/beacon_node.py resolve_call_timeout)
+    nodes = BeaconNodeFallback(
+        clients, call_timeout=args.vc_timeout, max_retries=args.vc_retries
+    )
     gvr = clients[0].genesis_validators_root()
     sdb = SlashingDatabase(args.slashing_db or ":memory:")
     store = ValidatorStore(spec, gvr, sdb)
@@ -1702,6 +1707,16 @@ def build_parser() -> argparse.ArgumentParser:
     vc.add_argument("--interop-validators", type=int, default=None)
     vc.add_argument("--graffiti", default=None,
                     help="graffiti for blocks this VC proposes (<=32 bytes)")
+    vc.add_argument("--vc-timeout", type=float, default=None,
+                    help="per-call beacon-node deadline in seconds "
+                         "(default: LIGHTHOUSE_TPU_VC_TIMEOUT env or 5); a "
+                         "node that times out is demoted in the fallback "
+                         "ranking and probed back, never retried first; "
+                         "<=0 disables the deadline")
+    vc.add_argument("--vc-retries", type=int, default=2,
+                    help="extra retry rounds across the ranked beacon "
+                         "nodes per duty call, with exponential backoff "
+                         "(default 2)")
     vc.set_defaults(fn=cmd_vc)
 
     ss = sub.add_parser("skip-slots", help="advance a state N slots")

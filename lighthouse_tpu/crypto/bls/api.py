@@ -74,9 +74,14 @@ class PythonBackend:
 
 
 class FakeBackend:
-    """Always-valid stub (plumbing tests only)."""
+    """Always-valid stub (plumbing tests only). Like the reference's
+    fake_crypto.rs it also no-ops SIGNING: `sign()` returns a fixed valid
+    G2 point, so plumbing lanes that sign through production code paths
+    (validator stores, the fleet harness) skip the ~50ms hash-to-curve +
+    scalar mul per message."""
 
     name = "fake"
+    _sig_cache: "Signature | None" = None
 
     def verify_signature_sets(self, sets, rands) -> bool:
         return all(len(s.signing_keys) > 0 for s in sets)
@@ -86,6 +91,11 @@ class FakeBackend:
 
     def aggregate_verify(self, pks, messages, sig) -> bool:
         return True
+
+    def sign(self, sk: SecretKey, message: bytes) -> Signature:
+        if FakeBackend._sig_cache is None:
+            FakeBackend._sig_cache = Signature(cv.G2_GEN)
+        return FakeBackend._sig_cache
 
 
 _BACKENDS: dict[str, object] = {}
@@ -149,6 +159,9 @@ def get_backend():
 
 
 def sign(sk: SecretKey, message: bytes) -> Signature:
+    backend_sign = getattr(get_backend(), "sign", None)
+    if backend_sign is not None:
+        return backend_sign(sk, message)
     return Signature(cv.g2_mul(hash_to_g2_point(message), sk.scalar))
 
 

@@ -66,6 +66,14 @@ def drive(*, scenario=None, smoke=False, slots=None, validators=None,
             bench_matrix=bench_matrix, bench_root=bench_root,
             hash_backend=hash_backend, stdout=stdout, stderr=stderr,
         )
+    from .scenarios import is_fleet
+
+    if is_fleet(name):
+        return _drive_fleet(
+            name, smoke=smoke, slots=slots, validators=validators,
+            seed=seed, out=out, quiet=quiet, datadir=datadir,
+            stdout=stdout, stderr=stderr,
+        )
     if is_multinode(name):
         return _drive_multinode(
             name, smoke=smoke, slots=slots, validators=validators,
@@ -216,12 +224,12 @@ def _drive_mesh_sweep(name, points, *, smoke, slots, validators, seed,
     from .runner import run_scenario
     from .scenarios import get_scenario, is_multinode, smoke_variant
 
-    from .scenarios import is_state_root
+    from .scenarios import is_fleet, is_state_root
 
-    if is_multinode(name) or is_state_root(name):
+    if is_multinode(name) or is_state_root(name) or is_fleet(name):
         print(f"error: --mesh-devices does not apply to scenario "
-              f"{name!r} (multi-node and state_root scenarios drive "
-              "surfaces the mesh sweep does not)", file=stderr)
+              f"{name!r} (multi-node, fleet and state_root scenarios "
+              "drive surfaces the mesh sweep does not)", file=stderr)
         return 1
     try:
         points = sorted({int(p) for p in points})
@@ -395,6 +403,57 @@ def _drive_state_root(name, *, smoke, slots, validators, seed, out, quiet,
     return 0
 
 
+def _drive_fleet(name, *, smoke, slots, validators, seed, out, quiet,
+                 datadir, stdout, stderr) -> int:
+    """Validator-fleet soak leg (loadgen/fleet.py): real VC stacks drive
+    every duty through rate-limited node surfaces under composed faults.
+    Exit code is the scenario verdict — nonzero on a broken invariant:
+    duty conservation, zero slashable signatures (post-hoc replay),
+    convergence within K of heal, or burn not recovering under 1x."""
+    from .fleet import run_fleet_scenario
+    from .scenarios import fleet_smoke_variant, get_fleet_scenario
+
+    sc = get_fleet_scenario(name, slots=slots, n_validators=validators,
+                            seed=seed)
+    if smoke:
+        sc = fleet_smoke_variant(sc)
+    out = out or default_report_path(smoke)
+    report = run_fleet_scenario(
+        sc, out_path=out, datadir=datadir,
+        log_fn=None if quiet else (
+            lambda m: print(m, file=stderr, flush=True)
+        ),
+    )
+    det = report["deterministic"]
+    summary = {
+        "scenario": report["scenario"],
+        "report": out,
+        "ok": report["ok"],
+        "n_vcs": report["n_vcs"],
+        "duty_conservation": {
+            k: det["duty_conservation"][k]
+            for k in ("scheduled", "performed", "missed",
+                      "performed_ratio", "ok")
+        },
+        "slashable": {
+            "signed_blocks": det["slashable_replay"]["signed_blocks"],
+            "signed_attestations":
+                det["slashable_replay"]["signed_attestations"],
+            "ok": det["slashable_replay"]["ok"],
+        },
+        "convergence": det["convergence"],
+        "burn_final": report["burn_final"],
+        "incidents": report["slo"]["incidents"],
+        "elapsed_secs": report["elapsed_secs"],
+    }
+    print(json.dumps(summary), file=stdout)
+    if not report["ok"]:
+        for reason in report["failures"]:
+            print(f"error: {reason}", file=stderr)
+        return 1
+    return 0
+
+
 def _drive_multinode(name, *, smoke, slots, validators, seed, out, quiet,
                      datadir, stdout, stderr) -> int:
     """Multi-node scenario leg: N full nodes over real TCP under a network
@@ -461,9 +520,11 @@ def add_loadtest_args(parser) -> None:
                         help="named scenario: smoke, steady, flood, "
                              "device_stall, mesh_stall, slow_host, "
                              "crash_restart, state_root (mutate-and-reroot "
-                             "churn through the active hash backend), or a "
+                             "churn through the active hash backend), a "
                              "multi-node family: partition_heal, fork_reorg, "
-                             "sync_catchup, equivocation_storm "
+                             "sync_catchup, equivocation_storm, or a "
+                             "validator-fleet family: fleet_steady, "
+                             "fleet_partition, fleet_crash, combined_chaos "
                              "(default: smoke)")
     parser.add_argument("--smoke", action="store_true",
                         help="alone: run the ~5s CPU-only smoke scenario; "

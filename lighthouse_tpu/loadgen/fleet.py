@@ -1,0 +1,915 @@
+"""Validator fleet at scale + combined-chaos soak harness.
+
+The "millions of users" axis: thousands of validator keys, split across
+many real validator-client stacks (seeded deterministic `ValidatorStore`s
+with uneven splits), drive attestation / proposal / aggregation / sync
+duties against the multi-node harness's beacon nodes THROUGH the duty
+path this repo ships — `DutiesService` polling, `BeaconNodeFallback`
+health-ranked failover with per-call deadlines and backoff, slashing-
+protected signing — instead of the harness signing with raw keys.
+
+Every VC reaches a node through a `NodeView`: the in-process beacon-node
+surface behind (a) the SAME `qos.ratelimit` token bucket the HTTP API
+mounts (over-quota calls raise the 429 shape `NodeRateLimited`), (b) the
+scenario's network fault plan (a VC "runs beside" its home node, so a
+partition that isolates the node isolates its VCs' view of the far side),
+and (c) the fleet fault axes:
+
+  - `NodeStall`   — the node's VC-facing API times out over a slot window
+                    (the duty-path shape of a wedged device backend);
+                    injected timeouts, no wall-clock burned;
+  - `NodeCrash`   — a REAL torn write on a REAL CRC-framed store log
+                    (`storefaults.FaultyKVStore`) kills the node mid-epoch;
+                    it never comes back, and its VCs must fail over;
+  - `FlashCrowd`  — a synthetic crowd drains every node's token bucket at
+                    each duty phase of the window: the fleet sees 429s,
+                    retries, and accounts what it could not perform.
+
+Scenario families (`bn loadtest --scenario X [--smoke]`): `fleet_steady`
+(control), `fleet_partition` (netfault partition while the fleet signs),
+`fleet_crash` (storefault-killed node mid-epoch), `combined_chaos`
+(3-way partition x node stall x flash crowd x one torn-write crash — every
+fault axis at once). Each run exits nonzero unless the invariants hold:
+
+  - duty conservation: scheduled == performed + sum(missed{reason}) on
+    every VC (a missed duty is counted with a reason, never swallowed);
+  - ZERO slashable messages signed: every signature every store produced
+    is replayed post-hoc through a fresh slashing-protection DB and both
+    slashers (proposer + attester detection);
+  - heads converge within K slots of the last heal;
+  - SLO burn recovers under 1x by the end of the run, with schema-valid
+    incident dumps during the fault window.
+
+Reports follow the multinode split: `deterministic` must be bit-identical
+across reruns under a fixed seed; wall-clock observations live outside it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import tempfile
+import time
+from dataclasses import dataclass
+
+from ..observability.flight_recorder import RECORDER
+from ..qos.ratelimit import TokenBucket
+from ..utils.logging import get_logger
+from ..utils.metrics import REGISTRY
+from ..validator.beacon_node import (
+    BeaconNodeError,
+    BeaconNodeFallback,
+    InProcessBeaconNode,
+    NodeRateLimited,
+    NodeTimeout,
+    ProposerDuty,
+)
+from ..validator.services import (
+    AggregationService,
+    AttestationService,
+    BlockService,
+    DutiesService,
+    DutyAccountant,
+    SyncCommitteeService,
+)
+from ..validator.validator_store import ValidatorStore
+from .multinode import MultiNodeHarness
+from .netfaults import NetFaultInjector, NetFaultPlan
+from .storefaults import FaultPlan, FaultyKVStore, SimulatedCrash
+
+log = get_logger("fleet")
+
+FLEET_RATE_LIMITED = REGISTRY.counter_vec(
+    "fleet_rate_limited_total",
+    "validator-client calls refused by a node surface's token bucket "
+    "(the HTTP API's 429 shape), by method",
+    ("method",),
+)
+FLEET_FAULTS = REGISTRY.counter_vec(
+    "fleet_fault_injections_total",
+    "fleet fault-axis injections, by kind (stall = VC-facing API timeout "
+    "served / crash = storefault-killed node / crowd_drain = token-bucket "
+    "drain event / unreachable = netfault blocked a VC's node call)",
+    ("kind",),
+)
+
+
+# ------------------------------------------------------------ fault axes
+
+
+@dataclass(frozen=True)
+class NodeStall:
+    """Node's VC-facing API times out over [start_slot, end_slot) — the
+    duty path's view of a wedged device/verification backend."""
+
+    node: int
+    start_slot: int
+    end_slot: int
+
+    def active(self, slot: int) -> bool:
+        return self.start_slot <= slot < self.end_slot
+
+
+@dataclass(frozen=True)
+class NodeCrash:
+    """Torn store write kills the node at `slot` (mid-epoch by design in
+    the shipped scenarios); it stays dead for the rest of the run."""
+
+    node: int
+    slot: int
+    tear_keep_bytes: int = 11
+
+
+@dataclass(frozen=True)
+class FlashCrowd:
+    """A synthetic crowd exhausts node token buckets at every duty phase
+    of [start_slot, end_slot); `nodes=None` means every node."""
+
+    start_slot: int
+    end_slot: int
+    nodes: tuple | None = None
+
+    def active(self, slot: int) -> bool:
+        return self.start_slot <= slot < self.end_slot
+
+    def hits(self, node: int) -> bool:
+        return self.nodes is None or node in self.nodes
+
+
+# ------------------------------------------------------------ node views
+
+
+class FleetClock:
+    """Logical fleet time: slot boundaries + duty phases, never wall
+    clock. Token buckets, fallback deadlines and backoff accounting all
+    read it, so a report is a pure function of the seed."""
+
+    def __init__(self, seconds_per_slot: float = 1.0):
+        self.seconds_per_slot = float(seconds_per_slot)
+        self._now = 0.0
+
+    def set_phase(self, slot: int, frac: float) -> None:
+        self._now = (slot + frac) * self.seconds_per_slot
+
+    def now(self) -> float:
+        return self._now
+
+
+class NodeSurface:
+    """Shared per-node state: the wired `InProcessBeaconNode`, the token
+    bucket every VC call pays (health probes exempt, HTTP-API parity),
+    and the stall/crash fault state."""
+
+    def __init__(self, node, clock: FleetClock, rate: float, burst: float,
+                 stalls: tuple[NodeStall, ...], subnets: int = 2):
+        self.node = node              # loadgen.multinode.MultiNode
+        self.index = node.index
+        self.api = InProcessBeaconNode(
+            node.chain, op_pool=node.op_pool, net=node.net,
+            lock=node.net._lock,
+        )
+        self.api.subnet_count = subnets
+        self.bucket = TokenBucket(rate, burst, time_fn=clock.now)
+        self.stalls = tuple(s for s in stalls if s.node == node.index)
+        self.crashed = False
+        #: slot the crash fired: health answers go STALE-healthy for the
+        #: rest of that slot (a real /health cache lags the process
+        #: death), so VCs discover the crash the way production does —
+        #: through a failed duty call, demotion, and failover
+        self.crash_slot: int | None = None
+        self.slot = 0
+        self.drained_tokens = 0
+
+    def stalled(self) -> bool:
+        return any(s.active(self.slot) for s in self.stalls)
+
+    def health_answer(self) -> bool:
+        if not self.crashed:
+            return True
+        return self.crash_slot is not None and self.slot <= self.crash_slot
+
+    def drain_bucket(self) -> int:
+        """Flash-crowd semantics: the crowd takes every token that is in
+        the bucket right now. Returns how many it got."""
+        taken = 0
+        while self.bucket.allow(1.0):
+            taken += 1
+        self.drained_tokens += taken
+        if taken:
+            FLEET_FAULTS.labels("crowd_drain").inc()
+        return taken
+
+
+class NodeView:
+    """One VC's view of one node: reachability is judged from the VC's
+    HOME node's side of the fault plan (the VC machine sits next to its
+    node), then the node's own crash/stall/rate-limit state applies.
+    `is_healthy` deliberately bypasses `_call` — health probes never pay
+    the token bucket (/eth/v1/node/health parity)."""
+
+    def __init__(self, surface: NodeSurface, home: int,
+                 injector: NetFaultInjector | None):
+        self._surface = surface
+        self._home = home
+        self._injector = injector
+        self.index = surface.index
+
+    def _unreachable(self) -> bool:
+        if self._injector is None or self._home == self.index:
+            return False
+        if self.index in self._injector.down:
+            return True
+        return (self._injector.partition_of(self._home)
+                != self._injector.partition_of(self.index))
+
+    def is_healthy(self) -> bool:
+        s = self._surface
+        if s.crashed:
+            return s.health_answer()
+        if s.stalled() or self._unreachable():
+            return False
+        return s.api.is_healthy()
+
+    def _call(self, method: str, *args, **kwargs):
+        s = self._surface
+        if s.crashed:
+            raise BeaconNodeError(
+                f"connection refused (node{s.index} crashed)"
+            )
+        if self._unreachable():
+            FLEET_FAULTS.labels("unreachable").inc()
+            raise NodeTimeout(
+                f"request timeout (injected: netfault blocks "
+                f"node{self._home} -> node{s.index})"
+            )
+        if s.stalled():
+            FLEET_FAULTS.labels("stall").inc()
+            raise NodeTimeout(
+                f"request timeout (injected: node{s.index} API stalled "
+                f"at slot {s.slot})"
+            )
+        if not s.bucket.allow(1.0):
+            FLEET_RATE_LIMITED.labels(method).inc()
+            raise NodeRateLimited(
+                f"429 rate limited (node{s.index} token bucket empty)",
+                retry_after=s.bucket.retry_after(1.0),
+            )
+        return getattr(s.api, method)(*args, **kwargs)
+
+    def __getattr__(self, method: str):
+        if method.startswith("_"):
+            raise AttributeError(method)
+        return lambda *a, **kw: self._call(method, *a, **kw)
+
+
+# ------------------------------------------------------------------- VCs
+
+
+class FleetVC:
+    """One validator-client stack: a slashing-protected ValidatorStore
+    over a slice of the keys, every duty service, and a hardened
+    BeaconNodeFallback whose first node is the VC's home."""
+
+    def __init__(self, index: int, home: int, spec, gvr: bytes,
+                 key_slice, surfaces, injector, clock: FleetClock,
+                 sc, slo=None):
+        self.index = index
+        self.home = home
+        self.backoffs: list[float] = []
+        self.store = ValidatorStore(spec, gvr, record_signed=True)
+        for vi, sk in key_slice:
+            self.store.add_validator(sk, index=vi)
+        self.accountant = DutyAccountant(slo=slo)
+        # home node first, the rest in index order — rank order before
+        # health scoring kicks in
+        self.node_order = [home] + [
+            i for i in sorted(surfaces) if i != home
+        ]
+        views = [
+            NodeView(surfaces[i], home, injector) for i in self.node_order
+        ]
+        self.nodes = BeaconNodeFallback(
+            views, call_timeout=sc.vc_timeout, clock=clock.now,
+            sleep_fn=self.backoffs.append, max_retries=sc.vc_retries,
+            probe_every=4, recorder=RECORDER,
+        )
+        self.duties = DutiesService(
+            spec, self.store, self.nodes, accountant=self.accountant
+        )
+        self.attestations = AttestationService(
+            spec, self.store, self.duties, self.nodes,
+            accountant=self.accountant,
+        )
+        self.aggregations = AggregationService(
+            spec, self.store, self.duties, self.nodes,
+            accountant=self.accountant,
+        )
+        self.sync_committee = SyncCommitteeService(
+            spec, self.store, self.nodes, accountant=self.accountant
+        )
+        self.blocks = BlockService(
+            spec, self.store, self.duties, self.nodes,
+            accountant=self.accountant,
+        )
+
+    def served_node(self) -> int | None:
+        """Global node index that served this VC's last successful call."""
+        pos = self.nodes.last_served
+        return None if pos is None else self.node_order[pos]
+
+    def summary(self) -> dict:
+        s, p, m = self.accountant.totals()
+        return {
+            "home": self.home,
+            "validators": len(self.store.validators),
+            "duties": self.accountant.summary(),
+            "scheduled": s, "performed": p, "missed": m,
+            "fallback": dict(self.nodes.stats),
+            "backoffs": len(self.backoffs),
+        }
+
+
+def seeded_key_splits(per_node: dict[int, list[int]], vcs_per_node: int,
+                      seed: int) -> list[tuple[int, list[int]]]:
+    """Split each node's validator range into `vcs_per_node` UNEVEN
+    contiguous slices (seeded weights) — (home, indices) per VC."""
+    rng = random.Random(seed ^ 0xF1EE7)
+    out: list[tuple[int, list[int]]] = []
+    for node_idx in sorted(per_node):
+        vis = sorted(per_node[node_idx])
+        k = max(1, min(vcs_per_node, len(vis)))
+        weights = [0.5 + rng.random() for _ in range(k)]
+        total = sum(weights)
+        cuts, acc = [], 0.0
+        for w in weights[:-1]:
+            acc += w / total
+            cuts.append(round(acc * len(vis)))
+        bounds = [0] + cuts + [len(vis)]
+        for i in range(k):
+            chunk = vis[bounds[i]:bounds[i + 1]]
+            if chunk:
+                out.append((node_idx, chunk))
+    return out
+
+
+class ValidatorFleet:
+    """All VCs plus the node surfaces and the slot/phase driver."""
+
+    def __init__(self, mh: "FleetHarness", sc):
+        self.mh = mh
+        self.sc = sc
+        self.clock = FleetClock(sc.seconds_per_slot)
+        self.surfaces = {
+            n.index: NodeSurface(
+                n, self.clock, sc.node_rate, sc.node_burst, sc.node_stalls,
+                subnets=sc.subnets,
+            )
+            for n in mh.nodes
+        }
+        gvr = bytes(mh.nodes[0].chain.head_state().genesis_validators_root)
+        splits = seeded_key_splits(
+            {n.index: sorted(n.validators) for n in mh.nodes},
+            sc.vcs_per_node, sc.seed,
+        )
+        self.vcs = [
+            FleetVC(
+                i, home, mh.spec, gvr,
+                [(vi, mh.harness.sk(vi)) for vi in chunk],
+                self.surfaces, mh.injector, self.clock, sc,
+                slo=mh.nodes[home].slo,
+            )
+            for i, (home, chunk) in enumerate(splits)
+        ]
+        self._vc_by_validator = {
+            v.index: vc
+            for vc in self.vcs for v in vc.store.validators.values()
+        }
+        self._polled_epoch: int | None = None
+        self.crashes_fired: list[dict] = []
+
+    # ---------------------------------------------------------- plumbing
+
+    def vc_for_validator(self, vi: int):
+        return self._vc_by_validator.get(vi)
+
+    def head_for_vc(self, vc: FleetVC) -> bytes:
+        mh = self.mh
+        for idx in vc.node_order:
+            if mh._alive(idx) and not self.surfaces[idx].crashed:
+                if (mh.injector is None
+                        or mh.injector.partition_of(vc.home)
+                        == mh.injector.partition_of(idx)):
+                    return mh.nodes[idx].head
+        return mh.nodes[vc.home].head
+
+    def duty_totals(self) -> tuple[int, int, int]:
+        s = p = m = 0
+        for vc in self.vcs:
+            vs, vp, vm = vc.accountant.totals()
+            s, p, m = s + vs, p + vp, m + vm
+        return s, p, m
+
+    # ------------------------------------------------------------ phases
+
+    def set_phase(self, slot: int, frac: float) -> None:
+        self.clock.set_phase(slot, frac)
+        for s in self.surfaces.values():
+            s.slot = slot
+        for crowd in self.sc.flash_crowds:
+            if not crowd.active(slot):
+                continue
+            for s in self.surfaces.values():
+                if crowd.hits(s.index):
+                    s.drain_bucket()
+
+    def begin_slot(self, slot: int) -> None:
+        self.set_phase(slot, 0.0)
+        for crash in self.sc.node_crashes:
+            if crash.slot == slot:
+                self._fire_crash(crash, slot)
+
+    def _fire_crash(self, crash: NodeCrash, slot: int) -> None:
+        """Kill a node with a REAL torn write: the node's head record
+        tears mid-frame on a real CRC log, the 'process' dies, and the
+        harness marks it gone. The torn log stays on disk for doctors."""
+        surface = self.surfaces[crash.node]
+        if surface.crashed:
+            return
+        from ..store.kv import Column
+
+        path = os.path.join(self.mh.fleet_datadir,
+                            f"node{crash.node}-store")
+        store = FaultyKVStore(
+            path,
+            plan=FaultPlan(tear_at=1,
+                           tear_keep_bytes=crash.tear_keep_bytes),
+        )
+        torn = False
+        try:
+            store.put(Column.beacon_chain, b"head",
+                      self.mh.nodes[crash.node].head)
+        except SimulatedCrash:
+            torn = True
+        surface.crashed = True
+        surface.crash_slot = slot
+        surface.api.healthy = False
+        self.mh.crash_node(crash.node)
+        FLEET_FAULTS.labels("crash").inc()
+        log.warn("node storefault-crashed", node=crash.node, slot=slot,
+                 torn_write=torn)
+        RECORDER.record("fleet_node_crash", severity="error",
+                        node=crash.node, slot=slot, torn_write=torn)
+        self.crashes_fired.append(
+            {"node": crash.node, "slot": slot, "torn_write": torn}
+        )
+
+    def poll_duties(self, slot: int) -> None:
+        spec = self.mh.spec
+        epoch = slot // spec.preset.SLOTS_PER_EPOCH
+        if self._polled_epoch == epoch:
+            return
+        self._polled_epoch = epoch
+        fork = spec.fork_version(spec.fork_name_at_epoch(epoch))
+        for vc in self.vcs:
+            vc.store.update_fork(fork)
+            vc.duties.poll(epoch)
+            if self.sc.sync_duties:
+                vc.sync_committee.poll(epoch)
+
+    def attest(self, slot: int) -> dict[int, tuple[set, int]]:
+        """Every VC performs its attestation duties; returns
+        {serving_node: (published_validator_indices, count)} for the
+        harness's fan-out bookkeeping."""
+        out: dict[int, tuple[set, int]] = {}
+        for vc in self.vcs:
+            n = vc.attestations.attest(slot)
+            if n <= 0:
+                continue
+            served = vc.served_node()
+            if served is None:
+                continue
+            idx_set, count = out.get(served, (set(), 0))
+            idx_set |= set(vc.attestations.last_published)
+            out[served] = (idx_set, count + n)
+        return out
+
+    def aggregate(self, slot: int) -> int:
+        return sum(vc.aggregations.aggregate(slot) for vc in self.vcs)
+
+    def sync_messages(self, slot: int) -> tuple[int, int]:
+        if not self.sc.sync_duties:
+            return 0, 0
+        msgs = contribs = 0
+        heads = {vc.index: self.head_for_vc(vc) for vc in self.vcs}
+        for vc in self.vcs:
+            msgs += vc.sync_committee.sign_and_publish(
+                slot, heads[vc.index]
+            )
+        for vc in self.vcs:
+            contribs += vc.sync_committee.aggregate(slot, heads[vc.index])
+        return msgs, contribs
+
+    # ------------------------------------------------------------ report
+
+    def conservation(self) -> dict:
+        per_vc = {str(vc.index): vc.summary() for vc in self.vcs}
+        s, p, m = self.duty_totals()
+        return {
+            "per_vc": per_vc,
+            "scheduled": s,
+            "performed": p,
+            "missed": m,
+            "performed_ratio": round(p / s, 4) if s else None,
+            "ok": all(
+                vc.accountant.conserved() for vc in self.vcs
+            ) and s == p + m,
+        }
+
+
+# ------------------------------------------------------ slashable replay
+
+
+def replay_slashable(vcs) -> dict:
+    """Post-hoc proof that the fleet signed ZERO slashable messages:
+    every signature every store produced, replayed in signing order
+    through (a) a fresh slashing-protection DB and (b) both slasher
+    detection engines — proposer (double proposal) and attester
+    (double/surround vote)."""
+    from ..slasher.slasher import (
+        AttestationRecord,
+        ProposalRecord,
+        Slasher,
+    )
+    from ..validator.slashing_protection import (
+        SlashingDatabase,
+        SlashingProtectionError,
+    )
+
+    db = SlashingDatabase()
+    slasher = Slasher()
+    violations: list[str] = []
+    blocks = atts = 0
+    for vc in vcs:
+        index_of = {
+            pk: v.index for pk, v in vc.store.validators.items()
+        }
+        for entry in vc.store.signed_log or ():
+            if entry[0] == "block":
+                _, pk, slot, root = entry
+                blocks += 1
+                db.register_validator(pk)
+                try:
+                    db.check_and_insert_block_proposal(pk, slot, root)
+                except SlashingProtectionError as e:
+                    violations.append(
+                        f"vc{vc.index} block slot {slot}: {e}"
+                    )
+                slasher.accept_proposal(ProposalRecord(
+                    proposer_index=index_of.get(pk, -1), slot=slot,
+                    block_root=root,
+                ))
+            else:
+                _, pk, source, target, root = entry
+                atts += 1
+                db.register_validator(pk)
+                try:
+                    db.check_and_insert_attestation(pk, source, target, root)
+                except SlashingProtectionError as e:
+                    violations.append(
+                        f"vc{vc.index} attestation target {target}: {e}"
+                    )
+                slasher.accept_attestation(AttestationRecord(
+                    validator_index=index_of.get(pk, -1), source=source,
+                    target=target, data_root=root,
+                ))
+    evidence = slasher.process_queued()
+    return {
+        "signed_blocks": blocks,
+        "signed_attestations": atts,
+        "protection_violations": violations,
+        "slasher_evidence": [
+            {"kind": ev.kind, "validator": ev.validator_index}
+            for ev in evidence
+        ],
+        "ok": not violations and not evidence,
+    }
+
+
+# ----------------------------------------------------------- the harness
+
+
+class FleetHarness(MultiNodeHarness):
+    """MultiNodeHarness whose block production and attestation flow run
+    through real validator-client stacks instead of harness keys."""
+
+    def __init__(self, spec, sc, injector, datadir: str):
+        super().__init__(
+            spec, sc.n_nodes, sc.n_validators, subnets=sc.subnets,
+            seed=sc.seed, injector=injector, attest=True,
+        )
+        self.sc = sc
+        self.fleet_datadir = datadir
+        self.fleet = ValidatorFleet(self, sc)
+        self.fleet_per_slot: list[dict] = []
+
+    # ------------------------------------------------------------- slots
+
+    def run_slot(self) -> dict:
+        next_slot = self.slot + 1
+        self.fleet.begin_slot(next_slot)
+        before = self.fleet.duty_totals()
+        entry = super().run_slot()
+        after = self.fleet.duty_totals()
+        entry["duties"] = {
+            "scheduled": after[0] - before[0],
+            "performed": after[1] - before[1],
+            "missed": after[2] - before[2],
+        }
+        self.fleet_per_slot.append({
+            "slot": entry["slot"], **entry["duties"],
+        })
+        return entry
+
+    # -------------------------------------------------------- production
+
+    def _produce_and_propagate(self, slot: int, alive):
+        self.fleet.set_phase(slot, 0.0)
+        self.fleet.poll_duties(slot)
+        return super()._produce_and_propagate(slot, alive)
+
+    def _produce_for_cluster(self, slot: int, cluster):
+        pre, proposer, owner = self._cluster_proposer(slot, cluster)
+        cluster_ids = sorted(x.index for x in cluster)
+        vc = self.fleet.vc_for_validator(proposer)
+        if owner.index not in cluster_ids:
+            # the proposer's node belongs to a different cluster: the DUTY
+            # is accounted there (or nowhere, if the home node is dead) —
+            # charging this fork's miss to the VC too would count one real
+            # duty once per cluster. The fork-level miss is still recorded
+            # in slot_blocks + block conservation.
+            return {
+                "cluster": cluster_ids, "proposer": proposer,
+                "missed": "proposer_unreachable",
+            }, None
+        if vc is None:   # defensive: every validator belongs to a VC
+            return {
+                "cluster": cluster_ids, "proposer": proposer,
+                "missed": "no_vc",
+            }, None
+        duty = ProposerDuty(
+            pubkey=bytes(pre.validators[proposer].pubkey),
+            validator_index=proposer, slot=slot,
+        )
+        root = vc.blocks.propose_duty(duty)
+        if root is None:
+            return {
+                "cluster": cluster_ids, "proposer": proposer,
+                "missed": "vc_duty_failed",
+            }, None
+        served = vc.served_node()
+        serving = self.nodes[served if served is not None else owner.index]
+        types = None   # unused downstream; the VC published the block
+        return {
+            "cluster": cluster_ids, "proposer": proposer,
+            "owner": serving.index, "root": root.hex()[:8],
+        }, (serving, bytes(root), None, types, cluster)
+
+    # ------------------------------------------------------- attestation
+
+    def _attest_and_pool(self, slot: int, alive, produced) -> None:
+        fleet = self.fleet
+        fleet.set_phase(slot, 1 / 3)
+        by_serving = fleet.attest(slot)
+        clusters = self._clusters(alive)
+        cluster_of = {
+            n.index: ci for ci, c in enumerate(clusters) for n in c
+        }
+        # fan-out bookkeeping per serving cluster: the same wait +
+        # conservation the direct harness runs
+        per_cluster: dict[int, tuple[set, int]] = {}
+        for served, (idx_set, count) in sorted(by_serving.items()):
+            ci = cluster_of.get(served)
+            if ci is None:
+                continue
+            got = per_cluster.get(ci, (set(), 0))
+            per_cluster[ci] = (got[0] | idx_set, got[1] + count)
+        for ci, (published_idx, count) in sorted(per_cluster.items()):
+            cluster = clusters[ci]
+            self.att_published += count
+            self._await_attestation_fanout(
+                slot, alive, cluster[0], cluster, published_idx, count
+            )
+        fleet.set_phase(slot, 2 / 3)
+        fleet.aggregate(slot)
+        fleet.sync_messages(slot)
+
+
+# ------------------------------------------------------------ the runner
+
+
+def run_fleet_scenario(sc, out_path: str | None = None, log_fn=None,
+                       datadir: str | None = None) -> dict:
+    """Run one fleet scenario to completion; returns (and optionally
+    writes) the machine-readable report. CPU-only (fake BLS over the
+    minimal spec); exit-code semantics live in loadgen/driver.py."""
+    from ..crypto import bls
+    from ..types.spec import minimal_spec
+
+    bls.set_backend("fake")
+    spec = minimal_spec()
+    t_wall = time.time()
+    datadir = datadir or tempfile.mkdtemp(prefix="loadgen-fleet-")
+    incident_dir = os.path.join(datadir, "incidents")
+    plan = NetFaultPlan(
+        partitions=tuple(sc.partitions),
+        links=tuple(sc.links),
+        churn=tuple(sc.churn),
+    )
+    RECORDER.reset()
+    inj = NetFaultInjector(plan, sc.n_nodes, recorder=RECORDER)
+    mh = FleetHarness(spec, sc, inj, datadir)
+    RECORDER.configure(incident_dir=incident_dir,
+                       clock=mh.nodes[0].chain.slot_clock,
+                       slo_provider=mh.nodes[0].slo.snapshot)
+    try:
+        for _ in range(sc.slots):
+            entry = mh.run_slot()
+            if log_fn is not None:
+                heads = len(set(entry["heads"].values()))
+                log_fn(
+                    f"slot {entry['slot']}: "
+                    f"duties={entry['duties']['performed']}"
+                    f"/{entry['duties']['scheduled']} "
+                    f"distinct_heads={heads}"
+                )
+    finally:
+        try:
+            mh.close()
+        finally:
+            RECORDER.configure(incident_dir=None, clock=None,
+                               slo_provider=None)
+
+    # -------- convergence (crashed nodes are dead, not diverged). "Heal"
+    # is when the LAST fault axis clears: a flash crowd that starves fork
+    # choice of duty traffic right after a partition heals delays the
+    # reorg exactly like the partition did
+    heal_slot = max(
+        [p.heal_slot for p in plan.partitions]
+        + [c.up_slot for c in plan.churn]
+        + [c.slot for c in sc.node_crashes]
+        + [s.end_slot for s in sc.node_stalls]
+        + [c.end_slot for c in sc.flash_crowds] + [0]
+    )
+    converged_at = None
+    for entry in mh.per_slot:
+        if entry["slot"] < heal_slot:
+            continue
+        alive_heads = {
+            head for idx, head in entry["heads"].items()
+            if int(idx) not in entry["down"]
+            and int(idx) not in entry["detached"]
+            and int(idx) not in entry.get("crashed", [])
+        }
+        if len(alive_heads) == 1:
+            converged_at = entry["slot"]
+            break
+    within_k = (
+        converged_at is not None
+        and converged_at - heal_slot <= sc.converge_slots
+    )
+    convergence = {
+        "heal_slot": heal_slot,
+        "converge_slots": sc.converge_slots,
+        "converged_at_slot": converged_at,
+        "within_k": within_k,
+        "final_heads": (
+            mh.per_slot[-1]["heads"] if mh.per_slot else {}
+        ),
+    }
+
+    blocks = dict(mh.blocks)
+    blocks["conservation_ok"] = (
+        blocks["deliveries_expected"]
+        == blocks["delivered"] + sum(blocks["blocked"].values())
+    )
+
+    conservation = mh.fleet.conservation()
+    slashable = replay_slashable(mh.fleet.vcs)
+
+    # -------- SLO burn recovery: alive nodes must be back under 1x
+    burn_final = {}
+    for n in mh.nodes:
+        if not mh._alive(n.index):
+            continue
+        w = n.slo.window_summary("slot_5")
+        burn_final[str(n.index)] = w.get("burn_rate")
+    burn_recovered = all(
+        b is None or b < 1.0 for b in burn_final.values()
+    )
+
+    failures: list[str] = []
+    faulted = bool(plan.partitions or plan.churn or sc.node_crashes)
+    if faulted:
+        if not within_k:
+            failures.append(
+                f"nodes diverged: no single head within "
+                f"{sc.converge_slots} slots of heal "
+                f"(converged_at={converged_at})"
+            )
+    elif not mh.heads_agree():
+        failures.append("alive nodes ended on different heads")
+    if not blocks["conservation_ok"]:
+        failures.append("block delivery conservation violated")
+    if not conservation["ok"]:
+        failures.append("duty conservation violated: scheduled != "
+                        "performed + missed on some VC")
+    if conservation["scheduled"] == 0:
+        failures.append("fleet scheduled zero duties (harness broken)")
+    if not slashable["ok"]:
+        failures.append(
+            f"SLASHABLE messages signed: "
+            f"{len(slashable['protection_violations'])} protection "
+            f"violations, {len(slashable['slasher_evidence'])} slasher "
+            "detections"
+        )
+    if not burn_recovered:
+        failures.append(
+            f"SLO burn did not recover under 1x by the last slot "
+            f"({burn_final})"
+        )
+    if sc.min_performed_ratio is not None:
+        ratio = conservation["performed_ratio"] or 0.0
+        if ratio < sc.min_performed_ratio:
+            failures.append(
+                f"fleet performed only {ratio:.4f} of duties "
+                f"(need >= {sc.min_performed_ratio})"
+            )
+    if sc.expect_incident and not RECORDER.incidents_written:
+        failures.append("fault window produced no incident dump")
+    if sc.node_crashes and len(mh.fleet.crashes_fired) != len(
+        sc.node_crashes
+    ):
+        failures.append("a scheduled node crash never fired")
+    ok = not failures
+
+    deterministic = {
+        "per_slot": mh.per_slot,
+        "fleet_per_slot": mh.fleet_per_slot,
+        "blocks": blocks,
+        "attestations_published": mh.att_published,
+        "duty_conservation": conservation,
+        "slashable_replay": slashable,
+        "crashes": mh.fleet.crashes_fired,
+        "netfault_events": inj.counts["events"],
+        "convergence": convergence,
+        "failures": failures,
+        "ok": ok,
+    }
+    report = {
+        "scenario": sc.name,
+        "seed": sc.seed,
+        "fleet": True,
+        "slots": mh.slot,
+        "n_nodes": sc.n_nodes,
+        "n_validators": sc.n_validators,
+        "n_vcs": len(mh.fleet.vcs),
+        "fault_plan": plan.as_dict(),
+        "fleet_faults": {
+            "stalls": [
+                {"node": s.node, "start_slot": s.start_slot,
+                 "end_slot": s.end_slot} for s in sc.node_stalls
+            ],
+            "crashes": [
+                {"node": c.node, "slot": c.slot} for c in sc.node_crashes
+            ],
+            "flash_crowds": [
+                {"start_slot": c.start_slot, "end_slot": c.end_slot}
+                for c in sc.flash_crowds
+            ],
+        },
+        "ok": ok,
+        "failures": failures,
+        "deterministic": deterministic,
+        "burn_final": burn_final,
+        "slo": {
+            "per_node": {
+                str(n.index): _fleet_slo_block(n) for n in mh.nodes
+            },
+            "incident_dir": incident_dir,
+            "incidents": [
+                os.path.basename(p) for p in RECORDER.incidents_written
+            ],
+        },
+        "elapsed_secs": round(time.time() - t_wall, 3),
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=1)
+    return report
+
+
+def _fleet_slo_block(node) -> dict:
+    from .multinode import _node_slo_block
+
+    return _node_slo_block(node)

@@ -153,6 +153,9 @@ class MultiNodeHarness:
             for i in range(n_nodes)
         ]
         self.detached: set[int] = set(detached)
+        #: storefault-killed nodes (the fleet harness's crash axis): dead
+        #: for the rest of the run, every blocked delivery counted "crash"
+        self.crashed: set[int] = set()
         self.id_map = {n.net.node_id: n.index for n in self.nodes}
         if injector is not None:
             # every encoded gossip RPC frame now passes the fault plan
@@ -209,7 +212,7 @@ class MultiNodeHarness:
         raise KeyError(vi)
 
     def _alive(self, idx: int) -> bool:
-        if idx in self.detached:
+        if idx in self.detached or idx in self.crashed:
             return False
         if self.injector is not None and idx in self.injector.down:
             return False
@@ -223,11 +226,19 @@ class MultiNodeHarness:
         return self.injector.reachable(a, b)
 
     def _blocked_reason(self, idx: int) -> str:
+        if idx in self.crashed:
+            return "crash"
         if idx in self.detached:
             return "detached"
         if self.injector is not None and idx in self.injector.down:
             return "churn"
         return "partition"
+
+    def crash_node(self, idx: int) -> None:
+        """Kill a node for the rest of the run (the storefault-crash axis):
+        connections close like churn-down, but nothing redials."""
+        self.crashed.add(idx)
+        self._take_down(idx)
 
     def attach(self, idx: int) -> None:
         """Connect a previously detached node to every alive peer (the
@@ -331,6 +342,8 @@ class MultiNodeHarness:
             "down": sorted(inj.down) if inj is not None else [],
             "detached": sorted(self.detached),
         }
+        if self.crashed:
+            entry["crashed"] = sorted(self.crashed)
         if detections:
             entry["slasher_detections"] = detections
         self.per_slot.append(entry)
@@ -420,8 +433,57 @@ class MultiNodeHarness:
 
     # ------------------------------------------------------------ produce
 
-    def _produce_and_propagate(self, slot: int, alive: list[MultiNode]):
+    def _cluster_proposer(self, slot: int, cluster: list[MultiNode]):
+        """(pre_state, proposer_index, owner_node) for a cluster's slot."""
         spec = self.spec
+        ref = cluster[0]
+        pre = clone_state(ref.chain.head_state(), spec)
+        if pre.slot < slot:
+            process_slots(pre, spec, slot)
+        proposer = int(acc.get_beacon_proposer_index(pre, spec))
+        return pre, proposer, self.node_for_validator(proposer)
+
+    def _produce_for_cluster(self, slot: int, cluster: list[MultiNode]):
+        """Produce/sign/publish one cluster's block. Returns (entry,
+        produced) where produced is None on a miss — the seam the fleet
+        harness overrides to route the duty through real validator-client
+        services instead of harness keys."""
+        spec = self.spec
+        pre, proposer, owner = self._cluster_proposer(slot, cluster)
+        cluster_ids = sorted(x.index for x in cluster)
+        if owner.index not in cluster_ids:
+            # the proposer's node is partitioned away from (or down
+            # for) this cluster: the slot is missed on this fork —
+            # exactly what a real minority partition experiences
+            return {
+                "cluster": cluster_ids, "proposer": proposer,
+                "missed": "proposer_unreachable",
+            }, None
+        epoch = h.compute_epoch_at_slot(slot, spec)
+        types = types_for_slot(spec, slot)
+        reveal = self.harness.randao_reveal(pre, proposer, epoch)
+        try:
+            block = owner.chain.produce_block(
+                slot, reveal, op_pool=owner.op_pool
+            )
+        except Exception as e:  # noqa: BLE001 — e.g. slashed proposer
+            return {
+                "cluster": cluster_ids, "proposer": proposer,
+                "missed": f"production_failed:{type(e).__name__}",
+            }, None
+        signed = self.harness.sign_block(block, types)
+        root = types.BeaconBlock.hash_tree_root(block)
+        with owner.net._lock:
+            owner.chain.process_block(
+                signed, block_root=root, proposal_already_verified=True
+            )
+        owner.net.publish_block(signed)
+        return {
+            "cluster": cluster_ids, "proposer": proposer,
+            "owner": owner.index, "root": root.hex()[:8],
+        }, (owner, root, signed, types, cluster)
+
+    def _produce_and_propagate(self, slot: int, alive: list[MultiNode]):
         inj = self.injector
         equivocate = inj is not None and any(
             e.slot == slot for e in inj.plan.equivocations
@@ -429,48 +491,18 @@ class MultiNodeHarness:
         produced = []
         slot_blocks = []
         for cluster in self._clusters(alive):
-            ref = cluster[0]
-            pre = clone_state(ref.chain.head_state(), spec)
-            if pre.slot < slot:
-                process_slots(pre, spec, slot)
-            proposer = int(acc.get_beacon_proposer_index(pre, spec))
-            owner = self.node_for_validator(proposer)
-            cluster_ids = sorted(x.index for x in cluster)
-            if owner.index not in cluster_ids:
-                # the proposer's node is partitioned away from (or down
-                # for) this cluster: the slot is missed on this fork —
-                # exactly what a real minority partition experiences
-                slot_blocks.append({
-                    "cluster": cluster_ids, "proposer": proposer,
-                    "missed": "proposer_unreachable",
-                })
-                continue
-            epoch = h.compute_epoch_at_slot(slot, spec)
-            types = types_for_slot(spec, slot)
-            reveal = self.harness.randao_reveal(pre, proposer, epoch)
-            try:
-                block = owner.chain.produce_block(
-                    slot, reveal, op_pool=owner.op_pool
-                )
-            except Exception as e:  # noqa: BLE001 — e.g. slashed proposer
-                slot_blocks.append({
-                    "cluster": cluster_ids, "proposer": proposer,
-                    "missed": f"production_failed:{type(e).__name__}",
-                })
-                continue
-            signed = self.harness.sign_block(block, types)
-            root = types.BeaconBlock.hash_tree_root(block)
-            with owner.net._lock:
-                owner.chain.process_block(
-                    signed, block_root=root, proposal_already_verified=True
-                )
-            owner.net.publish_block(signed)
-            produced.append((owner, root, signed, types, cluster))
-            self.blocks["published"] += 1
-            slot_blocks.append({
-                "cluster": cluster_ids, "proposer": proposer,
-                "owner": owner.index, "root": root.hex()[:8],
-            })
+            entry, prod = self._produce_for_cluster(slot, cluster)
+            slot_blocks.append(entry)
+            if prod is not None:
+                produced.append(prod)
+                self.blocks["published"] += 1
+        self._propagate_produced(slot, alive, produced)
+        if equivocate and produced:
+            self._equivocate(slot, alive, produced[0])
+        return produced, slot_blocks
+
+    def _propagate_produced(self, slot: int, alive: list[MultiNode],
+                            produced) -> None:
         # propagation: reachable nodes must import (directly or via parent
         # lookup); unreachable ones are counted with their blocking reason
         for owner, root, signed, types, cluster in produced:
@@ -503,9 +535,6 @@ class MultiNodeHarness:
                     self.blocks["blocked"].get(reason, 0) + 1
                 )
                 n.slo.record_shed("gossip_block", f"netfault_{reason}")
-        if equivocate and produced:
-            self._equivocate(slot, alive, produced[0])
-        return produced, slot_blocks
 
     def _equivocate(self, slot: int, alive: list[MultiNode],
                     first_produced) -> None:
@@ -602,40 +631,46 @@ class MultiNodeHarness:
                     published += 1
                     published_idx.add(int(vi))
             self.att_published += published
-            if not published:
-                continue
-
-            def pooled(n: MultiNode) -> set[int]:
-                seen: set[int] = set()
-                for bucket in n.op_pool.attestations.values():
-                    for e in bucket:
-                        if e.data.slot == slot:
-                            seen |= e.attesting_indices
-                return seen
-
-            # EVERY reachable node must pool this cluster's votes before
-            # the slot ends (cross-cluster nodes imported the fork's blocks
-            # in the propagation wait, so verification can succeed) — a
-            # vote still in flight when the next block packs would make
-            # pool contents, and so block roots, a function of thread
-            # timing instead of the seed
-            targets = [n for n in alive
-                       if n in cluster or self._reachable(owner.index, n.index)]
-            self._wait(
-                lambda: all(published_idx <= pooled(x) for x in targets),
-                self.WAIT_SECS, f"attestation fan-out at slot {slot}",
+            self._await_attestation_fanout(
+                slot, alive, owner, cluster, published_idx, published
             )
-            for x in targets:
-                x.slo.record_admitted("gossip_attestation", published)
-                x.slo.record_processed("gossip_attestation", published)
-            for n in self.nodes:
-                if n in targets:
-                    continue
-                reason = self._blocked_reason(n.index)
-                n.slo.record_admitted("gossip_attestation", published)
-                n.slo.record_shed(
-                    "gossip_attestation", f"netfault_{reason}", published
-                )
+
+    def _await_attestation_fanout(self, slot: int, alive, owner, cluster,
+                                  published_idx: set, published: int) -> None:
+        """Wait until every reachable node pooled a cluster's votes, then
+        settle the per-node SLO ledger. Cross-cluster nodes imported the
+        fork's blocks in the propagation wait, so verification can
+        succeed — a vote still in flight when the next block packs would
+        make pool contents, and so block roots, a function of thread
+        timing instead of the seed."""
+        if not published:
+            return
+
+        def pooled(n: MultiNode) -> set[int]:
+            seen: set[int] = set()
+            for bucket in n.op_pool.attestations.values():
+                for e in bucket:
+                    if e.data.slot == slot:
+                        seen |= e.attesting_indices
+            return seen
+
+        targets = [n for n in alive
+                   if n in cluster or self._reachable(owner.index, n.index)]
+        self._wait(
+            lambda: all(published_idx <= pooled(x) for x in targets),
+            self.WAIT_SECS, f"attestation fan-out at slot {slot}",
+        )
+        for x in targets:
+            x.slo.record_admitted("gossip_attestation", published)
+            x.slo.record_processed("gossip_attestation", published)
+        for n in self.nodes:
+            if n in targets:
+                continue
+            reason = self._blocked_reason(n.index)
+            n.slo.record_admitted("gossip_attestation", published)
+            n.slo.record_shed(
+                "gossip_attestation", f"netfault_{reason}", published
+            )
 
     # ------------------------------------------------------------ checks
 
@@ -801,6 +836,7 @@ def run_multinode_scenario(sc: MultiNodeScenario, out_path: str | None = None,
             head for idx, head in entry["heads"].items()
             if int(idx) not in entry["down"]
             and int(idx) not in entry["detached"]
+            and int(idx) not in entry.get("crashed", [])
         }
         if len(alive_heads) == 1:
             converged_at = entry["slot"]

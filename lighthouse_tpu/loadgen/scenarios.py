@@ -176,7 +176,7 @@ def get_scenario(name: str, **overrides) -> Scenario:
     if base is None:
         have = (
             sorted(SCENARIOS) + sorted(STATE_ROOT_SCENARIOS)
-            + sorted(MULTINODE_SCENARIOS)
+            + sorted(MULTINODE_SCENARIOS) + sorted(_ensure_fleet())
         )
         raise KeyError(
             f"unknown scenario {name!r} (have: {', '.join(have)})"
@@ -334,6 +334,131 @@ def _multinode_scenarios() -> dict[str, MultiNodeScenario]:
                            Equivocation(slot=9)),
         ),
     }
+
+
+# ------------------------------------------------------------------ fleet
+
+
+@dataclass
+class FleetScenario:
+    """A validator-fleet soak over the multi-node harness (loadgen/
+    fleet.py): real VC stacks (slashing-protected stores, duty services,
+    hardened BeaconNodeFallback) drive every duty through the nodes'
+    rate-limited API surfaces while the fault axes compose. Minimal spec,
+    fake BLS, CPU-sized; `--smoke` clamps size, never the fault shape."""
+
+    name: str
+    n_nodes: int = 4
+    #: thousands of keys at full scale; smoke clamps (FLEET_SMOKE_*)
+    n_validators: int = 2048
+    #: each node's keys split UNEVENLY (seeded) across this many VCs
+    vcs_per_node: int = 4
+    slots: int = 16
+    seed: int = 0xC0FFEE
+    subnets: int = 2
+    converge_slots: int = 4
+    #: network fault axes (loadgen/netfaults.py dataclasses)
+    partitions: tuple = ()
+    links: tuple = ()
+    churn: tuple = ()
+    #: fleet fault axes (loadgen/fleet.py dataclasses)
+    node_stalls: tuple = ()
+    node_crashes: tuple = ()
+    flash_crowds: tuple = ()
+    #: token-bucket rate/burst on every node's VC-facing API surface
+    #: (logical tokens/second — the HTTP API's --http-rate-limit shape)
+    node_rate: float = 4096.0
+    node_burst: float = 8192.0
+    #: hardened-fallback knobs (validator/beacon_node.py resolution)
+    vc_timeout: float = 2.0
+    vc_retries: int = 2
+    #: sign + aggregate sync-committee duties too
+    sync_duties: bool = True
+    #: fail unless performed/scheduled reaches this (None = no floor)
+    min_performed_ratio: float | None = None
+    #: fail unless >=1 incident dumped during the run
+    expect_incident: bool = False
+    seconds_per_slot: float = 1.0
+
+
+FLEET_SMOKE_VALIDATORS = 96
+FLEET_SMOKE_SLOTS = 20
+
+
+def _fleet_scenarios() -> dict[str, FleetScenario]:
+    from .fleet import FlashCrowd, NodeCrash, NodeStall
+    from .netfaults import Partition
+
+    return {
+        # the control run: no faults, the fleet must perform >=99% of its
+        # duties (the remainder: genuinely empty aggregation pools)
+        "fleet_steady": FleetScenario(
+            name="fleet_steady", min_performed_ratio=0.99,
+        ),
+        # a 3v1 partition while the fleet signs: both sides keep serving
+        # their forks (zero slashable signatures!), heads reconverge
+        # within K of heal, every missed duty carries a reason
+        "fleet_partition": FleetScenario(
+            name="fleet_partition",
+            partitions=(Partition(start_slot=4, heal_slot=8,
+                                  groups=((0, 1, 2), (3,))),),
+            converge_slots=4, expect_incident=True,
+        ),
+        # a torn store write kills node 1 mid-epoch: its VCs time out,
+        # demote it, and fail over — the fleet keeps meeting duties
+        "fleet_crash": FleetScenario(
+            name="fleet_crash",
+            node_crashes=(NodeCrash(node=1, slot=5),),
+            converge_slots=4, expect_incident=True,
+            min_performed_ratio=0.9,
+        ),
+        # everything at once: 3-way partition x node-0 API stall x flash
+        # crowd x one torn-write crash. The duty path must degrade with
+        # counted reasons and recover — zero slashable messages, heads
+        # converge after heal, burn back under 1x by the end
+        "combined_chaos": FleetScenario(
+            name="combined_chaos", slots=20,
+            partitions=(Partition(start_slot=4, heal_slot=8,
+                                  groups=((0, 1), (2,), (3,))),),
+            node_stalls=(NodeStall(node=0, start_slot=5, end_slot=7),),
+            node_crashes=(NodeCrash(node=1, slot=6),),
+            flash_crowds=(FlashCrowd(start_slot=10, end_slot=12),),
+            converge_slots=5, expect_incident=True,
+        ),
+    }
+
+
+FLEET_SCENARIOS: dict[str, FleetScenario] = {}
+
+
+def _ensure_fleet() -> dict[str, FleetScenario]:
+    if not FLEET_SCENARIOS:
+        FLEET_SCENARIOS.update(_fleet_scenarios())
+    return FLEET_SCENARIOS
+
+
+def is_fleet(name: str) -> bool:
+    return name in _ensure_fleet()
+
+
+def get_fleet_scenario(name: str, **overrides) -> FleetScenario:
+    base = _ensure_fleet().get(name)
+    if base is None:
+        raise KeyError(f"unknown fleet scenario {name!r}")
+    overrides = {k: v for k, v in overrides.items() if v is not None}
+    return replace(base, **overrides) if overrides else replace(base)
+
+
+def fleet_smoke_variant(sc: FleetScenario) -> FleetScenario:
+    """Seconds-sized clamp: fewer keys and VCs, same fault plan (the
+    plan IS the scenario's shape — slots are NOT clamped below the last
+    fault window)."""
+    return replace(
+        sc,
+        n_validators=min(sc.n_validators, FLEET_SMOKE_VALIDATORS),
+        vcs_per_node=min(sc.vcs_per_node, 2),
+        slots=min(sc.slots, FLEET_SMOKE_SLOTS),
+    )
 
 
 #: lazily built (netfaults imports the metrics registry; keep module

@@ -117,12 +117,32 @@ class NetworkNode:
         self.rpc_timeout = float(rpc_timeout)
         self.sync = SyncManager(chain, request_timeout=self.rpc_timeout,
                                 on_peer_failure=self._on_sync_peer_failure)
+        # beacon-shaped score params for the core topics this node serves
+        # (gossipsub_scoring_parameters.rs analog) — topics left out (blob
+        # subnets, sync-committee) score neutral, so an idle topic can
+        # never decay honest peers toward the graylist
+        from .peer_score import beacon_score_params
+
+        n_subnets = (
+            subnets if subnets is not None else chain.spec.attestation_subnet_count
+        )
+        score_params = beacon_score_params(
+            block_topic=gs.topic_name(fork_digest, "beacon_block"),
+            aggregate_topic=gs.topic_name(
+                fork_digest, "beacon_aggregate_and_proof"
+            ),
+            subnet_topics=[
+                gs.attestation_subnet_topic(fork_digest, i)
+                for i in range(n_subnets)
+            ],
+        )
         self.gossipsub = Gossipsub(
             node_id,
             self._gossip_send,
             self.peer_manager,
             addr_provider=self._peer_dial_addr,
             px_handler=self._on_px,
+            score_params=score_params,
         )
         # transport consults this: when True, plaintext-HELLO peers are
         # rejected instead of served unencrypted

@@ -70,9 +70,26 @@ class PeerScoreThresholds:
     opportunistic_graft_threshold: float = 2.0
 
 
+#: scoring identity for unparameterized topics: counters still accrue
+#: (delivery bookkeeping is shared), but every weight is zero
+_NEUTRAL_TOPIC = TopicScoreParams(
+    topic_weight=0.0,
+    time_in_mesh_weight=0.0,
+    first_message_deliveries_weight=0.0,
+    mesh_message_deliveries_weight=0.0,
+    mesh_failure_penalty_weight=0.0,
+    invalid_message_deliveries_weight=0.0,
+)
+
+
 @dataclass
 class PeerScoreParams:
     topics: dict[str, TopicScoreParams] = field(default_factory=dict)
+    #: whether topics ABSENT from `topics` get the (punishing) default
+    #: TopicScoreParams (True — handy for small ad-hoc rigs) or score
+    #: neutral (False — libp2p semantics; what beacon_score_params uses,
+    #: see topic())
+    score_unknown_topics: bool = True
     # cap on the TOTAL positive contribution across topics
     topic_score_cap: float = 400.0
     app_specific_weight: float = 1.0
@@ -85,8 +102,20 @@ class PeerScoreParams:
     retain_score: float = 10.0             # seconds to keep disconnected peers
 
     def topic(self, t: str) -> TopicScoreParams:
+        """Params for a topic. With `score_unknown_topics=False` (the
+        beacon parameterization), topics nobody configured score NEUTRAL
+        (every weight 0) — libp2p gossipsub semantics: only explicitly
+        parameterized topics contribute. Scoring unknown topics by the
+        punishing default meant an idle subscribed topic — blob-sidecar
+        subnets in a blobless sim, any quiet subnet on a real node —
+        accrued a P3 deficit of threshold^2 per mesh peer once the
+        activation grace passed, dragging EVERY peer toward the
+        publish/graylist thresholds until the whole mesh wedged (found
+        by the fleet harness's steady soak)."""
         got = self.topics.get(t)
         if got is None:
+            if not self.score_unknown_topics:
+                return _NEUTRAL_TOPIC
             got = TopicScoreParams()
             self.topics[t] = got
         return got
@@ -98,7 +127,10 @@ def beacon_score_params(block_topic: str | None = None,
     """Beacon-chain parameterization in the spirit of
     gossipsub_scoring_parameters.rs: blocks weigh most, aggregates next,
     per-subnet attestation topics least (there are 64 of them)."""
-    params = PeerScoreParams()
+    # only the topics parameterized below contribute to scores: an idle
+    # unconfigured topic (blob subnets with no blobs yet) must not accrue
+    # mesh-delivery deficits against honest peers
+    params = PeerScoreParams(score_unknown_topics=False)
     if block_topic:
         params.topics[block_topic] = TopicScoreParams(
             topic_weight=0.5,

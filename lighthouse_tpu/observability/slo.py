@@ -50,6 +50,10 @@ TIMELY_KINDS = frozenset(
         "gossip_aggregate",
         "gossip_sync_contribution",
         "gossip_sync_signature",
+        # validator-client duties are slot-deadlined by definition: the
+        # fleet harness feeds performed/missed duty verdicts per slot
+        # (validator/services.py DutyAccountant)
+        "vc_duty",
     }
 )
 

@@ -22,9 +22,109 @@ from dataclasses import dataclass, field
 from ..types import helpers as h
 from ..types.spec import ChainSpec
 from ..state_transition.slot import types_for_slot
-from .beacon_node import BeaconNodeFallback
+from ..utils.logging import get_logger
+from ..utils.metrics import REGISTRY
+from .beacon_node import BeaconNodeError, BeaconNodeFallback
 from .slashing_protection import SlashingProtectionError
 from .validator_store import DoppelgangerProtected, ValidatorStore
+
+log = get_logger("vc_services")
+
+VC_DUTIES = REGISTRY.counter_vec(
+    "vc_duty_total",
+    "validator duties by kind (attestation / proposal / aggregation / "
+    "sync_message / sync_contribution) and outcome (performed, or "
+    "missed_<reason>: node_error / rate_limited / slashing_protection / "
+    "doppelganger / no_aggregate / no_contribution / rejected)",
+    ("duty", "result"),
+)
+VC_DUTY_ERRORS = REGISTRY.counter_vec(
+    "vc_duty_errors_total",
+    "validator-client service errors by pipeline stage (duties_poll / "
+    "attestation_data / attestation_publish / aggregate_fetch / "
+    "aggregate_publish / block_produce / block_publish / sync_publish / "
+    "sync_contribution_fetch / sync_contribution_publish)",
+    ("stage",),
+)
+
+
+class DutyAccountant:
+    """Duty conservation ledger: `scheduled == performed + Σmissed{reason}`
+    per duty kind — a missed duty is COUNTED with a reason, never silently
+    swallowed. One instance is shared by all of a VC's services; `counts`
+    is deterministic and lands in fleet reports. Verdicts also feed the
+    SLO epoch window through the validator_monitor path when an accountant
+    is bound (`slo.record_validator_epoch`)."""
+
+    def __init__(self, slo=None):
+        self.slo = slo
+        self.counts: dict[str, dict] = {}
+
+    def _bucket(self, duty: str) -> dict:
+        b = self.counts.get(duty)
+        if b is None:
+            b = self.counts[duty] = {
+                "scheduled": 0, "performed": 0, "missed": {},
+            }
+        return b
+
+    def scheduled(self, duty: str, n: int = 1) -> None:
+        self._bucket(duty)["scheduled"] += n
+
+    def performed(self, duty: str, n: int = 1) -> None:
+        if n <= 0:
+            return
+        self._bucket(duty)["performed"] += n
+        VC_DUTIES.labels(duty, "performed").inc(n)
+        if self.slo is not None:
+            # epoch window via the validator_monitor path, slot window as
+            # the TIMELY "vc_duty" kind — burn rates see duty misses
+            self.slo.record_validator_epoch(n, 0)
+            self.slo.record_admitted("vc_duty", n)
+            self.slo.record_processed("vc_duty", n)
+
+    def missed(self, duty: str, reason: str, n: int = 1) -> None:
+        if n <= 0:
+            return
+        b = self._bucket(duty)
+        b["missed"][reason] = b["missed"].get(reason, 0) + n
+        VC_DUTIES.labels(duty, f"missed_{reason}").inc(n)
+        if self.slo is not None:
+            self.slo.record_validator_epoch(0, n)
+            self.slo.record_admitted("vc_duty", n)
+            self.slo.record_shed("vc_duty", f"duty_{reason}", n)
+
+    def conserved(self) -> bool:
+        return all(
+            b["scheduled"] == b["performed"] + sum(b["missed"].values())
+            for b in self.counts.values()
+        )
+
+    def summary(self) -> dict:
+        out = {
+            duty: {
+                "scheduled": b["scheduled"],
+                "performed": b["performed"],
+                "missed": dict(sorted(b["missed"].items())),
+            }
+            for duty, b in sorted(self.counts.items())
+        }
+        out["conserved"] = self.conserved()
+        return out
+
+    def totals(self) -> tuple[int, int, int]:
+        s = sum(b["scheduled"] for b in self.counts.values())
+        p = sum(b["performed"] for b in self.counts.values())
+        m = sum(sum(b["missed"].values()) for b in self.counts.values())
+        return s, p, m
+
+
+def _miss_reason(exc: Exception) -> str:
+    """Why a node-facing duty step failed, as a conservation reason."""
+    from .beacon_node import classify_failure
+
+    kind = classify_failure(exc)
+    return "rate_limited" if kind == "rate_limited" else "node_error"
 
 
 @dataclass
@@ -34,24 +134,39 @@ class DutiesService:
     nodes: BeaconNodeFallback
     attester_duties: dict = field(default_factory=dict)   # epoch -> [AttesterDuty]
     proposer_duties: dict = field(default_factory=dict)   # epoch -> [ProposerDuty]
+    accountant: DutyAccountant = field(default_factory=DutyAccountant)
+    poll_failures: int = 0
 
-    def poll(self, current_epoch: int) -> None:
+    def poll(self, current_epoch: int) -> bool:
         """Refresh duty maps for current and next epoch (duties_service.rs
-        poll loop)."""
+        poll loop). Returns False (keeping any stale maps, which still
+        cover the current epoch on a healthy cadence) when every node
+        refused — the caller keeps ticking; duties missed because of a
+        stale map are accounted by the per-duty services."""
         my_pubkeys = set(self.store.voting_pubkeys())
         # resolve indices
         indices = [
             v.index for v in self.store.validators.values() if v.index is not None
         ]
+        ok = True
         for epoch in (current_epoch, current_epoch + 1):
-            duties = self.nodes.first_success("attester_duties", epoch, indices)
-            self.attester_duties[epoch] = [
-                d for d in duties if d.pubkey in my_pubkeys
-            ]
-            proposals = self.nodes.first_success("proposer_duties", epoch)
-            self.proposer_duties[epoch] = [
-                d for d in proposals if d.pubkey in my_pubkeys
-            ]
+            try:
+                duties = self.nodes.first_success(
+                    "attester_duties", epoch, indices
+                )
+                self.attester_duties[epoch] = [
+                    d for d in duties if d.pubkey in my_pubkeys
+                ]
+                proposals = self.nodes.first_success("proposer_duties", epoch)
+                self.proposer_duties[epoch] = [
+                    d for d in proposals if d.pubkey in my_pubkeys
+                ]
+            except BeaconNodeError as e:
+                ok = False
+                self.poll_failures += 1
+                VC_DUTY_ERRORS.labels("duties_poll").inc()
+                log.warn("duties poll failed", epoch=epoch,
+                         error=f"{type(e).__name__}: {e}")
         # prune old epochs
         for e in list(self.attester_duties):
             if e < current_epoch:
@@ -59,6 +174,7 @@ class DutiesService:
         for e in list(self.proposer_duties):
             if e < current_epoch:
                 del self.proposer_duties[e]
+        return ok
 
     def attesters_at_slot(self, slot: int):
         epoch = slot // self.spec.preset.SLOTS_PER_EPOCH
@@ -75,29 +191,53 @@ class AttestationService:
     store: ValidatorStore
     duties: DutiesService
     nodes: BeaconNodeFallback
+    accountant: DutyAccountant = field(default_factory=DutyAccountant)
     published: int = 0
     failed: int = 0
+    #: validator indices whose attestation the serving node accepted in
+    #: the LAST attest() call (the fleet harness's fan-out bookkeeping)
+    last_published: list = field(default_factory=list)
 
     def attest(self, slot: int) -> int:
         """Produce+sign+publish attestations for all duties at `slot`
-        (the slot+1/3 phase of attestation_service.rs)."""
+        (the slot+1/3 phase of attestation_service.rs). Every duty is
+        accounted: performed, or missed with a reason."""
         duties = self.duties.attesters_at_slot(slot)
+        self.last_published = []
         if not duties:
             return 0
+        acct = self.accountant
         types = types_for_slot(self.spec, slot)
         by_committee: dict[int, list] = defaultdict(list)
         for d in duties:
             by_committee[d.committee_index].append(d)
         produced = 0
         for cidx, ds in by_committee.items():
-            data = self.nodes.first_success("attestation_data", slot, cidx, types)
+            acct.scheduled("attestation", len(ds))
+            try:
+                data = self.nodes.first_success(
+                    "attestation_data", slot, cidx, types
+                )
+            except BeaconNodeError as e:
+                VC_DUTY_ERRORS.labels("attestation_data").inc()
+                log.warn("attestation data fetch failed", slot=slot,
+                         committee=cidx, error=f"{type(e).__name__}: {e}")
+                acct.missed("attestation", _miss_reason(e), len(ds))
+                self.failed += len(ds)
+                continue
             atts = []
+            signers = []
             for d in ds:
                 bits = [False] * d.committee_length
                 bits[d.committee_position] = True
                 try:
                     sig = self.store.sign_attestation(d.pubkey, data, types)
-                except (SlashingProtectionError, DoppelgangerProtected):
+                except SlashingProtectionError:
+                    acct.missed("attestation", "slashing_protection")
+                    self.failed += 1
+                    continue
+                except DoppelgangerProtected:
+                    acct.missed("attestation", "doppelganger")
                     self.failed += 1
                     continue
                 atts.append(
@@ -105,10 +245,29 @@ class AttestationService:
                         aggregation_bits=bits, data=data, signature=sig
                     )
                 )
-            if atts:
-                produced += self.nodes.first_success(
+                signers.append(d.validator_index)
+            if not atts:
+                continue
+            try:
+                accepted = self.nodes.first_success(
                     "publish_attestations", atts, types
                 )
+            except BeaconNodeError as e:
+                VC_DUTY_ERRORS.labels("attestation_publish").inc()
+                log.warn("attestation publish failed", slot=slot,
+                         committee=cidx, error=f"{type(e).__name__}: {e}")
+                acct.missed("attestation", _miss_reason(e), len(atts))
+                self.failed += len(atts)
+                continue
+            produced += accepted
+            acct.performed("attestation", accepted)
+            if accepted < len(atts):
+                # the node rejected some (already-observed attester, bad
+                # sig): count the shortfall so conservation still holds
+                acct.missed("attestation", "rejected", len(atts) - accepted)
+                self.failed += len(atts) - accepted
+            else:
+                self.last_published.extend(signers)
         self.published += produced
         return produced
 
@@ -131,12 +290,14 @@ class AggregationService:
     store: ValidatorStore
     duties: DutiesService
     nodes: BeaconNodeFallback
+    accountant: DutyAccountant = field(default_factory=DutyAccountant)
     published: int = 0
 
     def aggregate(self, slot: int) -> int:
         duties = self.duties.attesters_at_slot(slot)
         if not duties:
             return 0
+        acct = self.accountant
         types = types_for_slot(self.spec, slot)
         count = 0
         for d in duties:
@@ -148,11 +309,28 @@ class AggregationService:
                 proof, d.committee_length, self.spec.target_aggregators_per_committee
             ):
                 continue
-            data = self.nodes.first_success("attestation_data", slot, d.committee_index)
-            data_root = types.AttestationData.hash_tree_root(data)
+            # selected: from here on the aggregation duty is accounted
+            acct.scheduled("aggregation")
             try:
-                agg = self.nodes.first_success("aggregate_attestation", slot, data_root)
-            except Exception:
+                data = self.nodes.first_success(
+                    "attestation_data", slot, d.committee_index
+                )
+                data_root = types.AttestationData.hash_tree_root(data)
+                agg = self.nodes.first_success(
+                    "aggregate_attestation", slot, data_root
+                )
+            except BeaconNodeError as e:
+                # "no aggregate known" is an empty naive pool (nobody
+                # attested to that data root), not a node failure
+                reason = (
+                    "no_aggregate" if "no aggregate" in str(e).lower()
+                    else _miss_reason(e)
+                )
+                VC_DUTY_ERRORS.labels("aggregate_fetch").inc()
+                log.warn("aggregate fetch failed", slot=slot,
+                         committee=d.committee_index, reason=reason,
+                         error=f"{type(e).__name__}: {e}")
+                acct.missed("aggregation", reason)
                 continue
             msg = types.AggregateAndProof.make(
                 aggregator_index=d.validator_index,
@@ -161,7 +339,21 @@ class AggregationService:
             )
             sig = self.store.sign_aggregate_and_proof(d.pubkey, msg, types)
             signed = types.SignedAggregateAndProof.make(message=msg, signature=sig)
-            count += self.nodes.first_success("publish_aggregates", [signed]) or 0
+            try:
+                accepted = self.nodes.first_success(
+                    "publish_aggregates", [signed]
+                ) or 0
+            except BeaconNodeError as e:
+                VC_DUTY_ERRORS.labels("aggregate_publish").inc()
+                log.warn("aggregate publish failed", slot=slot,
+                         error=f"{type(e).__name__}: {e}")
+                acct.missed("aggregation", _miss_reason(e))
+                continue
+            count += accepted
+            if accepted:
+                acct.performed("aggregation")
+            else:
+                acct.missed("aggregation", "rejected")
         self.published += count
         return count
 
@@ -176,26 +368,37 @@ class SyncCommitteeService:
     store: ValidatorStore
     nodes: BeaconNodeFallback
     duties: list = field(default_factory=list)     # [SyncDuty]
+    accountant: DutyAccountant = field(default_factory=DutyAccountant)
     published_messages: int = 0
     published_contributions: int = 0
 
-    def poll(self, epoch: int) -> None:
+    def poll(self, epoch: int) -> bool:
         indices = [
             v.index for v in self.store.validators.values() if v.index is not None
         ]
         my_pubkeys = set(self.store.voting_pubkeys())
-        duties = self.nodes.first_success("sync_duties", epoch, indices)
+        try:
+            duties = self.nodes.first_success("sync_duties", epoch, indices)
+        except BeaconNodeError as e:
+            VC_DUTY_ERRORS.labels("duties_poll").inc()
+            log.warn("sync duties poll failed", epoch=epoch,
+                     error=f"{type(e).__name__}: {e}")
+            return False
         self.duties = [d for d in duties if d.pubkey in my_pubkeys]
+        return True
 
     def sign_and_publish(self, slot: int, head_root: bytes) -> int:
         if not self.duties:
             return 0
+        acct = self.accountant
+        acct.scheduled("sync_message", len(self.duties))
         types = types_for_slot(self.spec, slot)
         msgs = []
         for d in self.duties:
             try:
                 sig = self.store.sign_sync_committee_message(d.pubkey, head_root)
             except DoppelgangerProtected:
+                acct.missed("sync_message", "doppelganger")
                 continue
             msgs.append(
                 types.SyncCommitteeMessage.make(
@@ -207,13 +410,24 @@ class SyncCommitteeService:
             )
         if not msgs:
             return 0
-        n = self.nodes.first_success("publish_sync_messages", msgs)
+        try:
+            n = self.nodes.first_success("publish_sync_messages", msgs)
+        except BeaconNodeError as e:
+            VC_DUTY_ERRORS.labels("sync_publish").inc()
+            log.warn("sync message publish failed", slot=slot,
+                     error=f"{type(e).__name__}: {e}")
+            acct.missed("sync_message", _miss_reason(e), len(msgs))
+            return 0
+        acct.performed("sync_message", n)
+        if n < len(msgs):
+            acct.missed("sync_message", "rejected", len(msgs) - n)
         self.published_messages += n
         return n
 
     def aggregate(self, slot: int, head_root: bytes) -> int:
         if not self.duties:
             return 0
+        acct = self.accountant
         types = types_for_slot(self.spec, slot)
         sub_size = (
             self.spec.preset.SYNC_COMMITTEE_SIZE
@@ -232,11 +446,22 @@ class SyncCommitteeService:
                     proof, sub_size, self.spec.target_aggregators_per_sync_subcommittee
                 ):
                     continue
+                acct.scheduled("sync_contribution")
                 try:
                     contrib = self.nodes.first_success(
                         "sync_committee_contribution", slot, sub_idx, head_root
                     )
-                except Exception:
+                except BeaconNodeError as e:
+                    reason = (
+                        "no_contribution"
+                        if "no contribution" in str(e).lower()
+                        else _miss_reason(e)
+                    )
+                    VC_DUTY_ERRORS.labels("sync_contribution_fetch").inc()
+                    log.warn("sync contribution fetch failed", slot=slot,
+                             subcommittee=sub_idx, reason=reason,
+                             error=f"{type(e).__name__}: {e}")
+                    acct.missed("sync_contribution", reason)
                     continue
                 msg = types.ContributionAndProof.make(
                     aggregator_index=d.validator_index,
@@ -245,7 +470,21 @@ class SyncCommitteeService:
                 )
                 sig = self.store.sign_contribution_and_proof(d.pubkey, msg, types)
                 signed = types.SignedContributionAndProof.make(message=msg, signature=sig)
-                count += self.nodes.first_success("publish_contributions", [signed])
+                try:
+                    accepted = self.nodes.first_success(
+                        "publish_contributions", [signed]
+                    )
+                except BeaconNodeError as e:
+                    VC_DUTY_ERRORS.labels("sync_contribution_publish").inc()
+                    log.warn("sync contribution publish failed", slot=slot,
+                             error=f"{type(e).__name__}: {e}")
+                    acct.missed("sync_contribution", _miss_reason(e))
+                    continue
+                count += accepted
+                if accepted:
+                    acct.performed("sync_contribution")
+                else:
+                    acct.missed("sync_contribution", "rejected")
         self.published_contributions += count
         return count
 
@@ -288,30 +527,63 @@ class BlockService:
     nodes: BeaconNodeFallback
     produce_block_fn: object = None   # (slot, randao_reveal) -> unsigned block
     graffiti: bytes | None = None     # per-VC graffiti (--graffiti)
+    accountant: DutyAccountant = field(default_factory=DutyAccountant)
     published: int = 0
 
     def propose(self, slot: int) -> int:
-        duties = self.duties.proposers_at_slot(slot)
         count = 0
-        for d in duties:
-            types = types_for_slot(self.spec, slot)
-            epoch = slot // self.spec.preset.SLOTS_PER_EPOCH
+        for d in self.duties.proposers_at_slot(slot):
+            if self.propose_duty(d) is not None:
+                count += 1
+        return count
+
+    def propose_duty(self, d) -> bytes | None:
+        """Perform ONE proposer duty end to end: produce (via fn or node),
+        sign under slashing protection, publish. Returns the block root on
+        success, None on an accounted miss."""
+        acct = self.accountant
+        acct.scheduled("proposal")
+        slot = d.slot
+        types = types_for_slot(self.spec, slot)
+        epoch = slot // self.spec.preset.SLOTS_PER_EPOCH
+        try:
             randao = self.store.sign_randao(d.pubkey, epoch)
+        except DoppelgangerProtected:
+            acct.missed("proposal", "doppelganger")
+            return None
+        try:
             if self.produce_block_fn is not None:
                 block = self.produce_block_fn(slot, randao)
             else:
                 block = self.nodes.first_success(
                     "produce_block", slot, randao, types, self.graffiti
                 )
-            try:
-                sig = self.store.sign_block(d.pubkey, block, types)
-            except (SlashingProtectionError, DoppelgangerProtected):
-                continue
-            signed = types.SignedBeaconBlock.make(message=block, signature=sig)
+        except Exception as e:  # noqa: BLE001 — production failed
+            VC_DUTY_ERRORS.labels("block_produce").inc()
+            log.warn("block production failed", slot=slot,
+                     error=f"{type(e).__name__}: {e}")
+            acct.missed("proposal", _miss_reason(e))
+            return None
+        try:
+            sig = self.store.sign_block(d.pubkey, block, types)
+        except SlashingProtectionError:
+            acct.missed("proposal", "slashing_protection")
+            return None
+        except DoppelgangerProtected:
+            acct.missed("proposal", "doppelganger")
+            return None
+        signed = types.SignedBeaconBlock.make(message=block, signature=sig)
+        try:
             self.nodes.first_success("publish_block", signed, types)
-            count += 1
-        self.published += count
-        return count
+        except BeaconNodeError as e:
+            VC_DUTY_ERRORS.labels("block_publish").inc()
+            log.warn("block publish failed", slot=slot,
+                     error=f"{type(e).__name__}: {e}")
+            acct.missed("proposal", _miss_reason(e))
+            return None
+        acct.performed("proposal")
+        self.published += 1
+        return types.BeaconBlock.hash_tree_root(block)
 
 
 @dataclass

@@ -1,9 +1,20 @@
-"""Slashing-protection database: SQLite guards + EIP-3076 interchange.
+"""Slashing-protection database: SQLite guards + EIP-3076 interchange +
+a durable sign-intent journal.
 
 Parity surface: /root/reference/validator_client/slashing_protection/src/
 slashing_database.rs (per-pubkey min/max slot & epoch guards enforced in a
 single transaction per signing) and interchange.rs (EIP-3076 import/export,
 including minification semantics on import).
+
+`SignIntentJournal` writes every sign intent as ONE CRC-framed record to a
+`KeyValueStore`-shaped log BEFORE the key produces a signature, and
+replays the surviving records into a fresh `SlashingDatabase` on restart
+with EIP-3076 minification semantics (keep the max watermarks). Combined
+with the ordering in `ValidatorStore` (guard check -> durable intent ->
+sign), a crash at ANY point — including a torn journal write, proven by
+the `loadgen/storefaults.py` fault matrix — can never permit a double-sign
+after restart: either the intent survived (the restart refuses a
+conflicting message) or it tore (no signature was ever produced).
 """
 
 from __future__ import annotations
@@ -203,26 +214,112 @@ class SlashingDatabase:
             raise SlashingProtectionError("interchange genesis_validators_root mismatch")
         for record in interchange["data"]:
             pk = bytes.fromhex(record["pubkey"][2:])
-            self.register_validator(pk)
-            with self._lock, self._conn:
-                vid = self._validator_id(pk)
-                slots = [int(b["slot"]) for b in record.get("signed_blocks", [])]
-                if slots:
-                    mx = max(slots)
-                    self._conn.execute(
-                        "INSERT OR REPLACE INTO signed_blocks (validator_id, slot, signing_root) VALUES (?,?,NULL)",
-                        (vid, mx),
-                    )
-                atts = record.get("signed_attestations", [])
-                if atts:
-                    max_source = max(int(a["source_epoch"]) for a in atts)
-                    max_target = max(int(a["target_epoch"]) for a in atts)
-                    self._conn.execute(
-                        """INSERT OR REPLACE INTO signed_attestations
-                           (validator_id, source_epoch, target_epoch, signing_root)
-                           VALUES (?,?,?,NULL)""",
-                        (vid, max_source, max_target),
-                    )
+            slots = [int(b["slot"]) for b in record.get("signed_blocks", [])]
+            atts = record.get("signed_attestations", [])
+            self.import_watermarks(
+                pk,
+                max_block_slot=max(slots) if slots else None,
+                max_source=(
+                    max(int(a["source_epoch"]) for a in atts) if atts else None
+                ),
+                max_target=(
+                    max(int(a["target_epoch"]) for a in atts) if atts else None
+                ),
+            )
+
+    def import_watermarks(self, pubkey: bytes, max_block_slot: int | None = None,
+                          max_source: int | None = None,
+                          max_target: int | None = None) -> None:
+        """Install minified low-watermark guards for one validator (the
+        EIP-3076 import shape: only the maxima survive; signing roots are
+        NULL, so even a same-root re-sign at the watermark is refused —
+        conservative and safe). The journal replay path."""
+        self.register_validator(pubkey)
+        with self._lock, self._conn:
+            vid = self._validator_id(pubkey)
+            if max_block_slot is not None:
+                self._conn.execute(
+                    "INSERT OR REPLACE INTO signed_blocks "
+                    "(validator_id, slot, signing_root) VALUES (?,?,NULL)",
+                    (vid, int(max_block_slot)),
+                )
+            if max_target is not None:
+                self._conn.execute(
+                    """INSERT OR REPLACE INTO signed_attestations
+                       (validator_id, source_epoch, target_epoch, signing_root)
+                       VALUES (?,?,?,NULL)""",
+                    (vid, int(max_source or 0), int(max_target)),
+                )
 
     def close(self):
         self._conn.close()
+
+
+# ---------------------------------------------------------------- journal
+
+
+class SignIntentJournal:
+    """Durable sign-intent log in front of a `SlashingDatabase`.
+
+    Backed by any `KeyValueStore`-shaped object (`store/native_kv.py`
+    PurePythonKVStore for a real datadir; `loadgen/storefaults.py`
+    FaultyKVStore in the interruption tests) so every intent is ONE
+    CRC-framed record write — the exact surface the torn-write fault
+    matrix tears at every byte offset. Record, then sign: if the record
+    write crashes, no signature exists; if it lands, the restart replay
+    refuses anything conflicting."""
+
+    def __init__(self, store):
+        from ..store.kv import Column
+
+        self.store = store
+        self._col = Column.metadata
+
+    # ------------------------------------------------------------- writes
+
+    def record_block(self, pubkey: bytes, slot: int, signing_root: bytes) -> None:
+        self.store.put(
+            self._col,
+            b"b:" + pubkey + int(slot).to_bytes(8, "big"),
+            bytes(signing_root),
+        )
+
+    def record_attestation(self, pubkey: bytes, source: int, target: int,
+                           signing_root: bytes) -> None:
+        self.store.put(
+            self._col,
+            b"a:" + pubkey + int(target).to_bytes(8, "big"),
+            int(source).to_bytes(8, "big") + bytes(signing_root),
+        )
+
+    # ------------------------------------------------------------- replay
+
+    def replay_into(self, db: SlashingDatabase) -> dict:
+        """Replay the crash-consistent journal prefix into `db` with
+        minification semantics. Returns per-pubkey watermarks installed
+        (diagnostics)."""
+        marks: dict[bytes, dict] = {}
+        for key, value in self.store.iter_column(self._col):
+            kind, pk = key[:2], key[2:50]
+            m = marks.setdefault(
+                pk, {"block_slot": None, "source": None, "target": None}
+            )
+            if kind == b"b:":
+                slot = int.from_bytes(key[50:58], "big")
+                if m["block_slot"] is None or slot > m["block_slot"]:
+                    m["block_slot"] = slot
+            elif kind == b"a:":
+                target = int.from_bytes(key[50:58], "big")
+                source = int.from_bytes(value[:8], "big")
+                if m["target"] is None or target > m["target"]:
+                    m["target"] = target
+                if m["source"] is None or source > m["source"]:
+                    m["source"] = source
+        for pk, m in marks.items():
+            db.import_watermarks(
+                pk, max_block_slot=m["block_slot"],
+                max_source=m["source"], max_target=m["target"],
+            )
+        return {
+            pk.hex()[:16]: m for pk, m in sorted(marks.items())
+        }

@@ -53,10 +53,21 @@ class ValidatorStore:
         spec: ChainSpec,
         genesis_validators_root: bytes,
         slashing_db: SlashingDatabase | None = None,
+        journal=None,
+        record_signed: bool = False,
     ):
+        """`journal` (slashing_protection.SignIntentJournal) makes sign
+        intents DURABLE before any signature exists: the slashing-DB check
+        passes, the intent lands on disk, THEN the key signs — a crash at
+        any byte of that sequence can never permit a double-sign after
+        restart. `record_signed=True` keeps an in-memory log of every
+        slashable message signed (fleet post-hoc replay proof); leave it
+        off for long-running processes."""
         self.spec = spec
         self.genesis_validators_root = genesis_validators_root
         self.slashing_db = slashing_db or SlashingDatabase()
+        self.journal = journal
+        self.signed_log: list | None = [] if record_signed else None
         self.validators: dict[bytes, InitializedValidator] = {}
         self.fork_version: bytes = spec.fork_version(spec.fork_name_at_epoch(0))
 
@@ -95,16 +106,32 @@ class ValidatorStore:
         v = self._validator(pubkey)
         domain = self._domain(DOMAIN_BEACON_PROPOSER)
         root = h.compute_signing_root(types.BeaconBlock, block, domain)
-        self.slashing_db.check_and_insert_block_proposal(pubkey, block.slot, root)
+        slot = int(block.slot)
+        self.slashing_db.check_and_insert_block_proposal(pubkey, slot, root)
+        if self.journal is not None:
+            # durable intent BEFORE the signature exists: a torn journal
+            # write crashes here, so no signature was ever produced
+            self.journal.record_block(pubkey, slot, root)
+        if self.signed_log is not None:
+            self.signed_log.append(
+                ("block", pubkey, slot, bytes(root))
+            )
         return v.signer.sign(root).serialize()
 
     def sign_attestation(self, pubkey: bytes, data, types) -> bytes:
         v = self._validator(pubkey)
         domain = self._domain(DOMAIN_BEACON_ATTESTER)
         root = h.compute_signing_root(types.AttestationData, data, domain)
+        source, target = int(data.source.epoch), int(data.target.epoch)
         self.slashing_db.check_and_insert_attestation(
-            pubkey, data.source.epoch, data.target.epoch, root
+            pubkey, source, target, root
         )
+        if self.journal is not None:
+            self.journal.record_attestation(pubkey, source, target, root)
+        if self.signed_log is not None:
+            self.signed_log.append(
+                ("attestation", pubkey, source, target, bytes(root))
+            )
         return v.signer.sign(root).serialize()
 
     def sign_randao(self, pubkey: bytes, epoch: int) -> bytes:

@@ -40,6 +40,9 @@ METRIC_MODULES = (
     "lighthouse_tpu.network.sync",
     "lighthouse_tpu.loadgen.netfaults",
     "lighthouse_tpu.loadgen.meshsim",
+    "lighthouse_tpu.loadgen.fleet",
+    "lighthouse_tpu.validator.beacon_node",
+    "lighthouse_tpu.validator.services",
     "lighthouse_tpu.parallel.mesh",
     "lighthouse_tpu.chain.beacon_processor",
     "lighthouse_tpu.chain.validator_monitor",
@@ -157,6 +160,16 @@ def lint_registry(registry=None) -> list[str]:
                 errors.append(
                     f"{where}: jaxhash_*/tree_hash_route_* metrics must "
                     "be labeled families (lane / op / path+reason)"
+                )
+        if m.name.startswith(("vc_", "fleet_")):
+            # the validator duty path's series answer "which duty / which
+            # method / which outcome / which node" — an unlabeled
+            # aggregate cannot say WHAT was missed or WHY a fallback
+            # failed over, so the convention is enforced like qos_*
+            if not getattr(m, "labelnames", ()):
+                errors.append(
+                    f"{where}: vc_*/fleet_* metrics must be labeled "
+                    "families (duty+result / method+result / node / kind)"
                 )
         if m.name.startswith(("jaxbls_stage_", "xla_program_")):
             # per-stage attribution and compiled-program analytics exist

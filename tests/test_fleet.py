@@ -1,0 +1,331 @@
+"""Validator fleet at scale + combined-chaos soak (loadgen/fleet.py).
+
+The duty path under everything at once: real VC stacks (slashing-protected
+stores, duty services, hardened BeaconNodeFallback) drive every duty
+through rate-limited node surfaces while partitions, API stalls, flash
+crowds and torn-write crashes compose. Invariants: duty conservation,
+ZERO slashable signatures (post-hoc replay through slashing protection +
+both slashers), convergence within K of heal, burn recovery — and the
+deterministic report core bit-identical across reruns.
+"""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from lighthouse_tpu.loadgen.fleet import (
+    FlashCrowd,
+    FleetClock,
+    NodeRateLimited,
+    NodeStall,
+    NodeTimeout,
+    NodeView,
+    run_fleet_scenario,
+    seeded_key_splits,
+)
+from lighthouse_tpu.loadgen.scenarios import (
+    fleet_smoke_variant,
+    get_fleet_scenario,
+    is_fleet,
+)
+
+
+# ------------------------------------------------------------------ units
+
+
+def test_seeded_key_splits_uneven_and_deterministic():
+    per_node = {0: list(range(24)), 1: list(range(24, 48))}
+    a = seeded_key_splits(per_node, vcs_per_node=3, seed=7)
+    b = seeded_key_splits(per_node, vcs_per_node=3, seed=7)
+    assert a == b
+    # full coverage, no overlap
+    covered = [vi for _home, chunk in a for vi in chunk]
+    assert sorted(covered) == list(range(48))
+    # seeded weights actually produce UNEVEN slices
+    sizes = [len(chunk) for _home, chunk in a]
+    assert len(set(sizes)) > 1
+    # a different seed cuts differently
+    c = seeded_key_splits(per_node, vcs_per_node=3, seed=8)
+    assert a != c
+
+
+class _StubApi:
+    healthy = True
+
+    def is_healthy(self):
+        return True
+
+    def attester_duties(self, epoch, indices):
+        return ["duty"]
+
+
+class _StubSurface:
+    """Duck-typed NodeSurface for NodeView unit tests."""
+
+    def __init__(self, index=0, rate=2.0, burst=2.0):
+        from lighthouse_tpu.qos.ratelimit import TokenBucket
+
+        self.index = index
+        self.api = _StubApi()
+        self.clock = FleetClock()
+        self.bucket = TokenBucket(rate, burst, time_fn=self.clock.now)
+        self.crashed = False
+        self.slot = 0
+        self._stalls = ()
+        self.drained_tokens = 0
+
+    def stalled(self):
+        return any(s.active(self.slot) for s in self._stalls)
+
+    def health_answer(self):
+        return False
+
+    def drain_bucket(self):
+        taken = 0
+        while self.bucket.allow(1.0):
+            taken += 1
+        return taken
+
+
+def test_node_view_stall_raises_timeout_shape():
+    s = _StubSurface()
+    s._stalls = (NodeStall(node=0, start_slot=2, end_slot=4),)
+    view = NodeView(s, home=0, injector=None)
+    assert view.attester_duties(0, []) == ["duty"]
+    s.slot = 2
+    with pytest.raises(NodeTimeout, match="stalled"):
+        view.attester_duties(0, [])
+    assert view.is_healthy() is False
+    s.slot = 4                      # window over: serving again
+    assert view.attester_duties(0, []) == ["duty"]
+
+
+def test_node_view_crash_refuses_and_rate_limit_429s():
+    s = _StubSurface(rate=0.0, burst=2.0)   # 2 tokens, never refills
+    view = NodeView(s, home=0, injector=None)
+    assert view.attester_duties(0, []) == ["duty"]
+    assert view.attester_duties(0, []) == ["duty"]
+    with pytest.raises(NodeRateLimited):
+        view.attester_duties(0, [])
+    # health probes are exempt from the bucket (HTTP API parity)
+    assert view.is_healthy() is True
+    s.crashed = True
+    from lighthouse_tpu.validator.beacon_node import BeaconNodeError
+
+    with pytest.raises(BeaconNodeError, match="crashed"):
+        view.attester_duties(0, [])
+    assert view.is_healthy() is False
+
+
+def test_node_view_honors_partition_from_home_side():
+    from lighthouse_tpu.loadgen.netfaults import (
+        NetFaultInjector,
+        NetFaultPlan,
+        Partition,
+    )
+
+    inj = NetFaultInjector(
+        NetFaultPlan(partitions=(
+            Partition(start_slot=2, heal_slot=4, groups=((0, 1), (2, 3))),
+        )),
+        4,
+    )
+    far = _StubSurface(index=2)
+    view = NodeView(far, home=0, injector=inj)
+    inj.on_slot(1)
+    assert view.attester_duties(0, []) == ["duty"]
+    inj.on_slot(2)                  # partition separates home 0 from node 2
+    with pytest.raises(NodeTimeout, match="netfault"):
+        view.attester_duties(0, [])
+    inj.on_slot(4)                  # healed
+    assert view.attester_duties(0, []) == ["duty"]
+
+
+def test_flash_crowd_windows():
+    crowd = FlashCrowd(start_slot=3, end_slot=5, nodes=(1,))
+    assert not crowd.active(2) and crowd.active(3) and crowd.active(4)
+    assert not crowd.active(5)
+    assert crowd.hits(1) and not crowd.hits(0)
+    assert FlashCrowd(0, 1).hits(7)     # nodes=None: everyone
+
+
+def test_scenario_registry():
+    for name in ("fleet_steady", "fleet_partition", "fleet_crash",
+                 "combined_chaos"):
+        assert is_fleet(name)
+        sc = get_fleet_scenario(name)
+        smoke = fleet_smoke_variant(sc)
+        assert smoke.n_validators <= 96
+        # the clamp never cuts a fault window off the end of the run
+        ends = (
+            [p.heal_slot for p in smoke.partitions]
+            + [c.slot for c in smoke.node_crashes]
+            + [s.end_slot for s in smoke.node_stalls]
+            + [c.end_slot for c in smoke.flash_crowds]
+        )
+        assert all(e <= smoke.slots for e in ends)
+    assert not is_fleet("partition_heal")
+
+
+# ------------------------------------------------------------------- e2e
+
+
+def test_fleet_partition_conserves_and_reruns_identically(tmp_path):
+    from lighthouse_tpu.observability.flight_recorder import validate_incident
+
+    sc = fleet_smoke_variant(get_fleet_scenario("fleet_partition"))
+    datadir = tmp_path / "dd"
+    report = run_fleet_scenario(sc, datadir=str(datadir),
+                                out_path=str(tmp_path / "r.json"))
+    assert report["ok"], report["failures"]
+    det = report["deterministic"]
+    cons = det["duty_conservation"]
+    # duty conservation on every VC; a partition does NOT cost duties —
+    # every VC keeps serving its own side's fork (the cost shows up as
+    # blocked deliveries and the fork/convergence race below)
+    assert cons["ok"]
+    assert cons["scheduled"] == cons["performed"] + cons["missed"]
+    # every miss (if any) carries a reason
+    for vc in cons["per_vc"].values():
+        for duty in vc["duties"].values():
+            if isinstance(duty, dict):
+                assert sum(duty["missed"].values()) == (
+                    duty["scheduled"] - duty["performed"]
+                )
+    # zero slashable messages despite both sides signing through the split
+    replay = det["slashable_replay"]
+    assert replay["ok"]
+    assert replay["signed_blocks"] > 0
+    assert replay["signed_attestations"] > 0
+    assert replay["protection_violations"] == []
+    assert replay["slasher_evidence"] == []
+    # convergence within K of heal
+    assert det["convergence"]["within_k"]
+    # block delivery conservation (inherited from the multinode harness)
+    assert det["blocks"]["conservation_ok"]
+    assert det["blocks"]["blocked"].get("partition", 0) > 0
+    # incidents dumped during the fault window, schema-valid
+    assert report["slo"]["incidents"]
+    for name in report["slo"]["incidents"]:
+        with open(datadir / "incidents" / name) as f:
+            assert validate_incident(json.load(f)) == []
+    # identical seed -> bit-identical deterministic core
+    report2 = run_fleet_scenario(sc)
+    assert report2["deterministic"] == det
+
+
+def test_fleet_crash_fails_over_and_keeps_duty_floor(tmp_path):
+    sc = fleet_smoke_variant(get_fleet_scenario("fleet_crash"))
+    report = run_fleet_scenario(sc, datadir=str(tmp_path / "dd"))
+    assert report["ok"], report["failures"]
+    det = report["deterministic"]
+    assert det["crashes"] == [{"node": 1, "slot": 5, "torn_write": True}]
+    # the torn write really landed on disk: a real CRC log with a torn tail
+    store_log = tmp_path / "dd" / "node1-store"
+    assert store_log.exists()
+    # the crashed node's VCs failed over: their fallbacks show failovers
+    # and their duties kept being performed (>= the scenario floor)
+    crashed_vcs = [
+        vc for vc in det["duty_conservation"]["per_vc"].values()
+        if vc["home"] == 1
+    ]
+    assert crashed_vcs
+    assert any(vc["fallback"]["failovers"] > 0 for vc in crashed_vcs)
+    assert any(
+        vc["fallback"]["timeouts"] + vc["fallback"]["errors"] > 0
+        for vc in crashed_vcs
+    )
+    ratio = det["duty_conservation"]["performed_ratio"]
+    assert ratio >= 0.9
+    assert det["slashable_replay"]["ok"]
+
+
+@pytest.mark.slow
+def test_fleet_partition_20run_determinism_stress():
+    """The PR 9 bar: 20 reruns under a fixed seed, bit-identical
+    deterministic cores."""
+    sc = fleet_smoke_variant(get_fleet_scenario("fleet_partition"))
+    ref = None
+    for _ in range(20):
+        r = run_fleet_scenario(sc)
+        assert r["ok"], r["failures"]
+        core = json.dumps(r["deterministic"], sort_keys=True)
+        ref = ref or core
+        assert core == ref
+
+
+# ------------------------------------------------------------------- CLI
+
+
+def _run_cli(args, timeout=300):
+    return subprocess.run(
+        [sys.executable, *args], capture_output=True, text=True,
+        timeout=timeout, cwd="/root/repo",
+    )
+
+
+def test_bn_loadtest_fleet_steady_smoke_cli(tmp_path):
+    out = tmp_path / "report.json"
+    r = _run_cli(["-m", "lighthouse_tpu", "bn", "loadtest",
+                  "--scenario", "fleet_steady", "--smoke", "--quiet",
+                  "--out", str(out), "--datadir", str(tmp_path / "dd")])
+    assert r.returncode == 0, r.stderr
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    assert summary["scenario"] == "fleet_steady"
+    assert summary["ok"] is True
+    cons = summary["duty_conservation"]
+    # the >=99% acceptance floor on the steady control run
+    assert cons["performed_ratio"] >= 0.99
+    assert cons["ok"] is True
+    assert summary["slashable"]["ok"] is True
+    report = json.loads(out.read_text())
+    assert report["fleet"] is True
+    assert report["n_vcs"] > report["n_nodes"]   # several VCs per node
+
+
+def test_bn_loadtest_combined_chaos_smoke_cli(tmp_path):
+    from lighthouse_tpu.observability.flight_recorder import validate_incident
+
+    out = tmp_path / "report.json"
+    datadir = tmp_path / "dd"
+    r = _run_cli(["-m", "lighthouse_tpu", "bn", "loadtest",
+                  "--scenario", "combined_chaos", "--smoke", "--quiet",
+                  "--out", str(out), "--datadir", str(datadir)])
+    assert r.returncode == 0, r.stderr
+    report = json.loads(out.read_text())
+    det = report["deterministic"]
+    # every invariant the acceptance criteria name, from one passing run:
+    # duty conservation across every VC...
+    assert det["duty_conservation"]["ok"]
+    # ...zero slashable signatures via post-hoc replay...
+    assert det["slashable_replay"]["ok"]
+    assert det["slashable_replay"]["signed_blocks"] > 0
+    # ...>=1 schema-valid incident dumped during the fault window...
+    assert report["slo"]["incidents"]
+    for name in report["slo"]["incidents"]:
+        with open(datadir / "incidents" / name) as f:
+            assert validate_incident(json.load(f)) == []
+    # ...heads converged within K of heal...
+    assert det["convergence"]["within_k"]
+    # ...and burn recovered under 1x
+    assert all(
+        b is None or b < 1.0 for b in report["burn_final"].values()
+    )
+    # the chaos actually bit: all four fault axes fired
+    assert det["crashes"]
+    assert det["netfault_events"]
+    assert det["duty_conservation"]["missed"] > 0
+
+
+def test_bn_loadtest_fleet_broken_invariant_exits_nonzero(tmp_path):
+    # truncating fleet_partition before its heal slot makes convergence
+    # impossible: the run must fail loudly, not report success
+    r = _run_cli(["-m", "lighthouse_tpu", "bn", "loadtest",
+                  "--scenario", "fleet_partition", "--smoke", "--quiet",
+                  "--slots", "6",
+                  "--out", str(tmp_path / "r.json"),
+                  "--datadir", str(tmp_path / "dd")])
+    assert r.returncode == 1
+    assert "diverged" in r.stderr

@@ -444,3 +444,31 @@ def test_pending_validation_deferred_resolution():
     # duplicate of the rejected message penalizes the replayer too
     g.on_rpc("relay", encode_rpc(Rpc(msgs=[("t", data2)])))
     assert g.scores["relay"] < 0
+
+
+def test_beacon_params_unknown_topics_score_neutral():
+    """An idle topic nobody parameterized (e.g. blob subnets with no blob
+    traffic) must not accrue mesh-delivery deficits against honest peers:
+    under beacon params it scores NEUTRAL (libp2p semantics). With
+    punishing defaults for unknown topics, every mesh peer of every quiet
+    topic drifted to ~-(threshold^2 x topics) once the activation grace
+    passed — past the publish threshold, wedging the whole mesh."""
+    clock = Clock()
+    p = beacon_score_params(block_topic="blocks")
+    ps = PeerScore(p, now=clock)
+    ps.add_peer("peer")
+    ps.graft("peer", "blocks")
+    for t in ("blob_0", "blob_1", "sync_committee"):
+        ps.graft("peer", t)          # in mesh, zero traffic, forever
+    ps.deliver_message("peer", "blocks")
+    ps.deliver_message("peer", "blocks")
+    clock.t = 100.0                  # far past every activation window
+    # the parameterized block topic satisfied its threshold; the idle
+    # unknown topics contribute NOTHING — not threshold^2 each
+    assert ps.score("peer") >= 0.0
+    # rejections on unknown topics stay neutral too; on the block topic
+    # they still bite
+    ps.reject_message("peer", "blob_0")
+    assert ps.score("peer") >= 0.0
+    ps.reject_message("peer", "blocks")
+    assert ps.score("peer") < 0.0

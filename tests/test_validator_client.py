@@ -172,3 +172,279 @@ def test_preparation_service(sim):
     assert n == VALIDATORS
     idx = store.validators[pk].index
     assert chain.proposer_preparations[idx] == b"\xaa" * 20
+
+
+# ----------------------------------------------- hardened fallback (PR 13)
+
+
+class _SilentNode:
+    """A beacon node whose socket never answers: every call raises the
+    timeout shape WITHOUT consuming wall-clock (the netfaults idiom)."""
+
+    def __init__(self):
+        self.calls = 0
+        self.healthy_answers = True
+
+    def is_healthy(self):
+        if not self.healthy_answers:
+            raise TimeoutError("health probe timed out")
+        return True   # it LOOKS healthy until you actually call it
+
+    def __getattr__(self, name):
+        def fail(*a, **kw):
+            self.calls += 1
+            raise TimeoutError(f"request timeout ({name} never answered)")
+
+        return fail
+
+
+def _counter(method, result):
+    from lighthouse_tpu.validator.beacon_node import VC_FALLBACK
+
+    return VC_FALLBACK.labels(method, result).value
+
+
+def test_fallback_timeout_demotes_then_prefers_healthy(sim):
+    spec, chain, op_pool, duties, atts, blocks, store, node = sim
+    silent = _SilentNode()
+    fb = BeaconNodeFallback([silent, node], sleep_fn=lambda _s: None)
+    before_to = _counter("proposer_duties", "timeout")
+    before_ok = _counter("proposer_duties", "success")
+    got = fb.first_success("proposer_duties", 0)
+    assert len(got) == spec.preset.SLOTS_PER_EPOCH
+    # the silent node was tried once, classified TIMEOUT, and demoted
+    assert _counter("proposer_duties", "timeout") == before_to + 1
+    assert _counter("proposer_duties", "success") == before_ok + 1
+    assert fb.health_scores()[0] < 0.5 < fb.health_scores()[1]
+    assert fb.stats["timeouts"] == 1 and fb.stats["failovers"] == 1
+    # from now on the healthy node ranks FIRST: the silent node is not
+    # retried first forever
+    calls_before = silent.calls
+    for _ in range(3):
+        fb.first_success("proposer_duties", 0)
+    assert silent.calls == calls_before
+    assert fb.stats["successes"] == 4
+
+
+def test_fallback_slow_answer_counts_as_timeout():
+    class SlowNode:
+        def is_healthy(self):
+            return True
+
+        def proposer_duties(self, epoch):
+            t[0] += 10.0      # the injectable clock jumps past the deadline
+            return ["late but real"]
+
+    t = [0.0]
+    fb = BeaconNodeFallback([SlowNode()], call_timeout=5.0,
+                            clock=lambda: t[0], sleep_fn=lambda _s: None)
+    got = fb.first_success("proposer_duties", 0)
+    assert got == ["late but real"]      # the answer is used...
+    assert fb.stats["timeouts"] == 1     # ...but the node sinks
+    assert fb.health_scores()[0] < 0.5
+
+
+def test_fallback_rate_limited_never_demotes():
+    from lighthouse_tpu.validator.beacon_node import (
+        BeaconNodeError,
+        NodeRateLimited,
+    )
+
+    class BusyNode:
+        def is_healthy(self):
+            return True
+
+        def publish_attestations(self, atts):
+            raise NodeRateLimited("429 rate limited", retry_after=0.5)
+
+    fb = BeaconNodeFallback([BusyNode()], max_retries=1,
+                            sleep_fn=lambda _s: None)
+    with pytest.raises(BeaconNodeError):
+        fb.first_success("publish_attestations", [])
+    assert fb.stats["rate_limited"] == 2    # initial + 1 retry round
+    assert fb.stats["retries"] == 1
+    assert fb.health_scores()[0] == 1.0     # busy != unhealthy
+
+
+def test_fallback_probes_demoted_node_back():
+    class FlappyNode:
+        def __init__(self):
+            self.up = False
+
+        def is_healthy(self):
+            return True    # the health endpoint still answers
+
+        def attester_duties(self, epoch, indices):
+            if not self.up:
+                raise TimeoutError("request timeout")
+            return ["flappy"]
+
+    class SteadyNode:
+        def __init__(self):
+            self.broken = False
+
+        def is_healthy(self):
+            return not self.broken
+
+        def attester_duties(self, epoch, indices):
+            if self.broken:
+                raise RuntimeError("down")
+            return ["steady"]
+
+    flappy, steady = FlappyNode(), SteadyNode()
+    fb = BeaconNodeFallback([flappy, steady], max_retries=0,
+                            probe_every=4, sleep_fn=lambda _s: None)
+    fb.first_success("attester_duties", 0, [])   # flappy times out, sinks
+    assert fb.health_scores()[0] < 0.5
+    # the steady node serves; every probe_every-th call the demoted node
+    # is health-probed back to the demotion BOUNDARY — below the healthy
+    # node, so it is never retried first, but no longer written off
+    for _ in range(4):
+        assert fb.first_success("attester_duties", 0, []) == ["steady"]
+    assert fb.stats["probes_up"] >= 1
+    assert fb.health_scores()[0] == 0.5
+    # when the good node later breaks, the probed-back node serves again
+    # and re-earns its score through real successes
+    flappy.up = True
+    steady.broken = True
+    assert fb.first_success("attester_duties", 0, []) == ["flappy"]
+    assert fb.health_scores()[0] > 0.5
+
+
+def test_dead_first_node_fleet_still_meets_duties(sim):
+    """The regression the old fallback failed: is_healthy() says fine but
+    every call times out — health must be FAILURE-driven, and a fleet
+    whose first fallback peer is silent still performs >=99% of duties
+    (asserted via vc_fallback_total counters, not sleeps)."""
+    from lighthouse_tpu.validator.services import (
+        AttestationService,
+        BlockService,
+        DutiesService,
+        DutyAccountant,
+    )
+    from lighthouse_tpu.validator.validator_store import ValidatorStore
+
+    spec, chain, op_pool, duties0, atts0, blocks0, store0, node = sim
+    silent = _SilentNode()
+    fb = BeaconNodeFallback([silent, node], sleep_fn=lambda _s: None)
+    store = ValidatorStore(spec, node.genesis_validators_root())
+    # fresh duty services over the SAME chain: reuse the sim's key set
+    # (minus any validator the doppelganger test left quarantined — that
+    # miss is accounted, but it is not this test's subject)
+    for pk, v in store0.validators.items():
+        if v.doppelganger_safe:
+            store.validators[pk] = v
+            store.slashing_db.register_validator(pk)
+    acct = DutyAccountant()
+    duties = DutiesService(spec, store, fb, accountant=acct)
+    atts = AttestationService(spec, store, duties, fb, accountant=acct)
+    before_to = _counter("attestation_data", "timeout")
+    start = int(chain.head_state().slot) + 1
+    performed = scheduled = 0
+    for slot in range(start, start + spec.preset.SLOTS_PER_EPOCH):
+        chain.slot_clock.set_slot(slot)
+        chain.per_slot_task()
+        epoch = slot // spec.preset.SLOTS_PER_EPOCH
+        duties.poll(epoch)
+        atts.attest(slot)
+    s, p, m = acct.totals()
+    assert s > 0
+    assert p / s >= 0.99, acct.summary()
+    # the timeout -> demote -> failover path is what carried the duties
+    assert _counter("attestation_data", "timeout") >= before_to
+    assert fb.stats["timeouts"] >= 1
+    assert fb.stats["failovers"] >= 1
+    # the silent node sits at (or below) the demotion boundary — probes
+    # lift it back to 0.5 at most, never above the healthy node
+    assert fb.health_scores()[0] <= 0.5 < fb.health_scores()[1]
+
+
+def test_duty_accountant_conservation_and_slo_feed():
+    from lighthouse_tpu.observability.slo import SlotAccountant
+    from lighthouse_tpu.validator.services import DutyAccountant
+
+    slo = SlotAccountant(export_metrics=False)
+    acct = DutyAccountant(slo=slo)
+    acct.scheduled("attestation", 10)
+    acct.performed("attestation", 8)
+    acct.missed("attestation", "node_error", 1)
+    acct.missed("attestation", "rate_limited", 1)
+    assert acct.conserved()
+    summary = acct.summary()
+    assert summary["attestation"]["missed"] == {
+        "node_error": 1, "rate_limited": 1
+    }
+    acct.scheduled("proposal")
+    assert not acct.conserved()          # scheduled but unresolved
+    acct.missed("proposal", "doppelganger")
+    assert acct.conserved()
+    # verdicts reached the slot window as the TIMELY vc_duty kind: the
+    # closed slot's hit ratio reflects 8 performed vs 3 missed
+    reports = slo.close_slot(0)
+    assert reports and reports[-1].processed.get("vc_duty") == 8
+    shed = sum(
+        n for key, n in reports[-1].shed.items()
+        if key.startswith("vc_duty:")
+    )
+    assert shed == 3
+    assert 0.7 < reports[-1].hit_ratio() < 0.8
+
+
+def test_aggregation_missed_duty_counts_reason(sim):
+    """The old silent `except Exception: continue` at the aggregate fetch
+    is now a structured warn + vc_duty_errors_total + a counted miss."""
+    from lighthouse_tpu.validator.beacon_node import BeaconNodeError
+    from lighthouse_tpu.validator.services import (
+        VC_DUTY_ERRORS,
+        AggregationService,
+        DutyAccountant,
+    )
+
+    spec, chain, op_pool, duties, atts, blocks, store, node = sim
+
+    class NoAggregateNode:
+        def is_healthy(self):
+            return True
+
+        def attestation_data(self, slot, cidx, types=None):
+            return node.attestation_data(slot, cidx, types)
+
+        def aggregate_attestation(self, slot, root):
+            raise BeaconNodeError("no aggregate known")
+
+    acct = DutyAccountant()
+    svc = AggregationService(
+        spec, store, duties,
+        BeaconNodeFallback([NoAggregateNode()], max_retries=0,
+                           sleep_fn=lambda _s: None),
+        accountant=acct,
+    )
+    slot = int(chain.head_state().slot)
+    duties.poll(slot // spec.preset.SLOTS_PER_EPOCH)
+    before = VC_DUTY_ERRORS.labels("aggregate_fetch").value
+    svc.aggregate(slot)
+    agg = acct.counts.get("aggregation")
+    if agg:   # some validator was a selected aggregator at this slot
+        assert agg["missed"].get("no_aggregate", 0) > 0
+        assert VC_DUTY_ERRORS.labels("aggregate_fetch").value > before
+        assert acct.conserved()
+
+
+def test_fallback_nonpositive_timeout_disables_deadline():
+    """--vc-timeout <= 0 disables the per-call deadline — it must never
+    classify healthy answers as timeouts (a -1 used to demote everyone)."""
+    class Node:
+        def is_healthy(self):
+            return True
+
+        def proposer_duties(self, epoch):
+            t[0] += 100.0
+            return ["ok"]
+
+    for disabled in (0, -1):
+        t = [0.0]
+        fb = BeaconNodeFallback([Node()], call_timeout=disabled,
+                                clock=lambda: t[0], sleep_fn=lambda _s: None)
+        assert fb.first_success("proposer_duties", 0) == ["ok"]
+        assert fb.stats["timeouts"] == 0
+        assert fb.health_scores()[0] == 1.0
