@@ -179,6 +179,23 @@ def install_profile(profile: DeviceProfile, path: str | None = None,
     return plan
 
 
+def install_runtime_plan(plan: Plan) -> Plan:
+    """Make a RUNTIME-derived plan (the capacity scheduler's retunes,
+    chain/scheduler.py) the process-wide knob source and notify the plan
+    listeners — the same actuation path a profile install uses, so the
+    hybrid router, the jaxbls dispatcher and the processor's max_inflight
+    listener all pick the change up live with their env/CLI precedence
+    layers untouched. The installed PROFILE is untouched: a later real
+    `install_profile` replaces this plan wholesale (and the scheduler
+    re-bases from it via its own listener). The plan's `source` should
+    name the producer (the scheduler uses "scheduler:<n>") so consumers
+    and logs can tell a control-loop retune from a calibration."""
+    with _lock:
+        _state["plan"] = plan
+    _notify_listeners(plan)
+    return plan
+
+
 def active_plan() -> Plan | None:
     with _lock:
         return _state["plan"]

@@ -171,17 +171,14 @@ class WorkItem:
 class BeaconProcessorConfig:
     # default caps consult the installed autotune plan (device-measured
     # throughput knee) and fall back to the guessed constants; an explicit
-    # value (CLI --max-*-batch) always wins over both
-    max_attestation_batch: int = field(
-        default_factory=lambda: _planned(
-            "max_attestation_batch", DEFAULT_MAX_ATTESTATION_BATCH
-        )
-    )
-    max_aggregate_batch: int = field(
-        default_factory=lambda: _planned(
-            "max_aggregate_batch", DEFAULT_MAX_AGGREGATE_BATCH
-        )
-    )
+    # value (CLI --max-*-batch) always wins over both — AND pins the cap
+    # against the capacity scheduler's runtime retuning (None auto-resolves
+    # and stays retunable, a number self-describes as explicit, the same
+    # contract max_inflight established in r8)
+    max_attestation_batch: int | None = None
+    max_aggregate_batch: int | None = None
+    max_attestation_batch_explicit: bool = False
+    max_aggregate_batch_explicit: bool = False
     # cores-wide like the reference's pool (beacon_processor/src/lib.rs:732
     # sizes by num_cpus); capped — beyond a few workers the Python-side
     # share of each task stops scaling under the GIL
@@ -200,12 +197,29 @@ class BeaconProcessorConfig:
     # never clobbered by a later plan install.
     max_inflight: int | None = None
     max_inflight_explicit: bool = False
+    # the capacity scheduler (chain/scheduler.py) publishes its retuned
+    # knobs process-wide through the autotune plan-listener contract only
+    # when this is set (the live bn node path; in-process harnesses with
+    # several processors keep actuation per-instance)
+    scheduler_publish_plan: bool = False
 
     def __post_init__(self):
         if self.max_inflight is None:
             self.max_inflight = _pipeline_depth()
         else:
             self.max_inflight_explicit = True
+        if self.max_attestation_batch is None:
+            self.max_attestation_batch = _planned(
+                "max_attestation_batch", DEFAULT_MAX_ATTESTATION_BATCH
+            )
+        else:
+            self.max_attestation_batch_explicit = True
+        if self.max_aggregate_batch is None:
+            self.max_aggregate_batch = _planned(
+                "max_aggregate_batch", DEFAULT_MAX_AGGREGATE_BATCH
+            )
+        else:
+            self.max_aggregate_batch_explicit = True
 
 
 class BeaconProcessor:
@@ -247,10 +261,21 @@ class BeaconProcessor:
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        # capacity scheduler (chain/scheduler.py): owns batch formation —
+        # _pop_locked delegates the dispatch-vs-coalesce verdict and the
+        # live batch caps to it — and closes the control loop by retuning
+        # caps/watermarks/urgent threshold from the SLO slot reports
+        from .scheduler import CapacityScheduler
+
+        self.scheduler = CapacityScheduler(
+            self.config, admission=self.admission,
+            publish_plan=self.config.scheduler_publish_plan,
+        )
         # slot-level SLO accountant (observability/slo.py): every admit /
         # shed / processed / queue-wait lands in the current slot's report.
         # Defaults to the node's global accountant; loadgen swaps in a
         # private instance so scenario reports stay seed-deterministic.
+        # (Property setter: the scheduler's control loop follows the swap.)
         self.slo = obs_slo.ACCOUNTANT
         from ..observability import register_processor
 
@@ -258,13 +283,34 @@ class BeaconProcessor:
         # live retune (r8): a mesh-aware autotune profile installed
         # mid-run re-resolves the in-flight window through the same plan
         # listener contract the jaxbls dispatcher and the hybrid router
-        # use — unless the operator pinned --max-inflight-batches
+        # use — unless the operator pinned --max-inflight-batches. A
+        # broken autotune import must never take down the processor, but
+        # it must be LOUD (the PR 9 no-silent-except rule): a node whose
+        # plan listener silently failed to register would serve stale
+        # knobs forever with nothing to show for it.
         try:
             from ..autotune import runtime as _at_runtime
 
             _at_runtime.add_plan_listener(self._on_plan_installed)
-        except Exception:
-            pass  # autotune broken must never take down the processor
+            _at_runtime.add_plan_listener(self.scheduler.on_plan_installed)
+        except Exception as e:
+            _ERRORS.labels("plan_listener").inc()
+            log.warn(
+                "autotune plan-listener registration failed; runtime "
+                "retunes disabled for this processor",
+                error=f"{type(e).__name__}: {e}",
+            )
+
+    @property
+    def slo(self):
+        return self._slo
+
+    @slo.setter
+    def slo(self, accountant) -> None:
+        """Swapping the accountant (loadgen's private per-run instance)
+        re-binds the scheduler's control-loop tick to the new one."""
+        self._slo = accountant
+        self.scheduler.bind_slo(accountant)
 
     def _on_plan_installed(self, _plan) -> None:
         if self.config.max_inflight_explicit:
@@ -337,24 +383,28 @@ class BeaconProcessor:
 
     # ------------------------------------------------------------- drain
 
-    def _next_work(self):
+    def _next_work(self, force: bool = False):
         """Pop the highest-priority work; coalesce batchable kinds.
         Returns (single, batch, trace) — the trace carries the enqueue and
         coalesce spans of whatever was popped. Items whose slot deadline
         has passed are shed HERE, counted `expired` (they already paid
         their queue residency; running them now would burn a device batch
-        slot on unactionable work)."""
+        slot on unactionable work). Batch FORMATION is the capacity
+        scheduler's call (chain/scheduler.py): a batchable queue may be
+        HELD to coalesce wider; `force=True` (run_until_idle, drain, the
+        worker's post-wait pass) overrides coalesce-holds so held work is
+        never starved — only a harness budget gate outlasts force."""
         expired: list[WorkItem] = []
         try:
             with self._lock:
-                return self._pop_locked(expired)
+                return self._pop_locked(expired, force)
         finally:
             # self.expired was bumped under the lock (workers race here);
             # only the metric + callback run outside it
             for it in expired:
                 self._notify_shed(it, "expired")
 
-    def _pop_locked(self, expired: list):
+    def _pop_locked(self, expired: list, force: bool = False):
         adm = self.admission
         for kind in WorkKind:
             q = self.queues[kind]
@@ -362,11 +412,15 @@ class BeaconProcessor:
                 continue
             t_pop = perf_counter()
             if kind in self.BATCHABLE:
-                cap = (
-                    self.config.max_attestation_batch
-                    if kind == WorkKind.gossip_attestation
-                    else self.config.max_aggregate_batch
+                decision = self.scheduler.decide(
+                    kind, len(q),
+                    inflight=len(self._inflight),
+                    max_inflight=self.config.max_inflight,
+                    force=force,
                 )
+                if not decision.dispatch:
+                    continue   # held to coalesce; lower priorities may run
+                cap = decision.cap
                 items = []
                 while q and len(items) < cap:
                     it = q.popleft()
@@ -449,9 +503,9 @@ class BeaconProcessor:
         self.processed[kind] += n
         self._m_processed[kind].inc(n)
         self.slo.record_processed(kind.name, n)
-        self._handle_result(result, trace)
+        self._handle_result(result, trace, kind, n)
 
-    def _handle_result(self, result, trace=None) -> None:
+    def _handle_result(self, result, trace=None, kind=None, n=1) -> None:
         """A runner may return (handle, continuation): the device batch is
         in flight and the continuation runs when it resolves. The pump keeps
         pulling (and marshalling) new work while up to max_inflight device
@@ -464,7 +518,7 @@ class BeaconProcessor:
             and callable(result[1])
         ):
             with self._lock:
-                self._inflight.append((result[0], result[1], trace))
+                self._inflight.append((result[0], result[1], trace, kind, n))
                 self.pipelined_batches += 1
                 _INFLIGHT.set(len(self._inflight))
                 over = len(self._inflight) > self.config.max_inflight
@@ -478,7 +532,7 @@ class BeaconProcessor:
         with self._lock:
             if not self._inflight:
                 return False
-            handle, cont, trace = self._inflight.popleft()
+            handle, cont, trace, kind, n = self._inflight.popleft()
             _INFLIGHT.set(len(self._inflight))
         # a device failure mid-batch (tunnel drop) must never kill the pump
         # worker: the batch is lost (its deferred gossip validations expire
@@ -496,7 +550,12 @@ class BeaconProcessor:
             return True
         if trace is not None:
             trace.add_span("device", t_dev, perf_counter())
-        self.slo.record_verify_latency(perf_counter() - t_dev)
+        dev_secs = perf_counter() - t_dev
+        self.slo.record_verify_latency(dev_secs)
+        if kind is not None and kind in self.BATCHABLE:
+            # the scheduler's batch cost model learns from DEVICE resolves
+            # only (host-path wall time must not steer device batch sizing)
+            self.scheduler.observe_verify(kind.name, n, dev_secs)
         t_cont = perf_counter()
         try:
             with self._exec_lock:
@@ -519,15 +578,47 @@ class BeaconProcessor:
         return n
 
     def run_until_idle(self) -> int:
-        """Synchronously drain everything (test/deterministic mode)."""
+        """Synchronously drain everything (test/deterministic mode).
+        Forced passes override the scheduler's coalesce-holds — only a
+        harness budget gate (loadgen/capacity.py) outlasts force, and a
+        gate-held queue counts as idle here (run_available is the pump
+        that respects it)."""
+        n = 0
+        while True:
+            single, batch, trace = self._next_work(force=True)
+            if single is None and batch is None:
+                n += self.drain_inflight()
+                if self.queues_empty() or self._only_gated():
+                    return n
+                continue
+            self._execute(single, batch, trace)
+            n += 1
+
+    def _only_gated(self) -> bool:
+        """True when everything still queued is held by a scheduler
+        budget gate: a forced pump must return instead of spinning."""
+        if self.scheduler._budget_gate is None:
+            return False
+        with self._lock:
+            if self._inflight:
+                return False
+            return all(
+                (not q) or k in self.BATCHABLE
+                for k, q in self.queues.items()
+            ) and any(q for q in self.queues.values())
+
+    def run_available(self) -> int:
+        """Pump only what the scheduler releases (no force): held batches
+        stay queued to coalesce — the capacity harness's per-slot drive,
+        where a device-time budget gate carries backlog across slots."""
         n = 0
         while True:
             single, batch, trace = self._next_work()
             if single is None and batch is None:
-                n += self.drain_inflight()
-                if self.queues_empty():
+                self.drain_inflight()
+                single, batch, trace = self._next_work()
+                if single is None and batch is None:
                     return n
-                continue
             self._execute(single, batch, trace)
             n += 1
 
@@ -550,7 +641,7 @@ class BeaconProcessor:
                 _time.sleep(0.005)
             return self.queues_empty()
         while perf_counter() < deadline:
-            single, batch, trace = self._next_work()
+            single, batch, trace = self._next_work(force=True)
             if single is None and batch is None:
                 self.drain_inflight()
                 if self.queues_empty():
@@ -585,6 +676,7 @@ class BeaconProcessor:
                 k.name: v for k, v in self.shed_admission.items() if v
             },
             "workers": len(self._threads),
+            "scheduler": self.scheduler.stats(),
         }
 
     def qos_totals(self) -> dict:
@@ -610,13 +702,19 @@ class BeaconProcessor:
             self._threads.append(t)
 
     def _worker(self) -> None:
+        force_next = False
         while not self._stop.is_set():
-            single, batch, trace = self._next_work()
+            single, batch, trace = self._next_work(force=force_next)
+            force_next = False
             if single is None and batch is None:
                 if self._resolve_oldest():
                     continue
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
+                # the wait bounds how long a coalesce-hold can starve a
+                # small batch on the live path: the next pass dispatches
+                # whatever the scheduler was still widening
+                force_next = True
                 continue
             try:
                 self._execute(single, batch, trace)

@@ -478,15 +478,22 @@ def cmd_bn(args):
 
         from .chain.beacon_processor import BeaconProcessorConfig
 
-        proc_cfg = BeaconProcessorConfig()
+        # the live node is the process's ONE capacity controller: its
+        # scheduler publishes retuned knobs through the autotune plan
+        # listeners (chain/scheduler.py) so the hybrid router and the
+        # jaxbls dispatcher follow; in-process harnesses with several
+        # processors keep actuation per-instance
+        proc_cfg = BeaconProcessorConfig(scheduler_publish_plan=True)
         if args.max_attestation_batch is not None:
-            proc_cfg.max_attestation_batch = args.max_attestation_batch
-        if args.max_aggregate_batch is not None:
-            proc_cfg.max_aggregate_batch = args.max_aggregate_batch
-        if args.max_inflight_batches is not None:
             # post-construction assignment: pin explicitly (constructor
             # args self-describe via __post_init__; attribute writes
-            # cannot)
+            # cannot). A pinned cap is never retuned by the scheduler.
+            proc_cfg.max_attestation_batch = args.max_attestation_batch
+            proc_cfg.max_attestation_batch_explicit = True
+        if args.max_aggregate_batch is not None:
+            proc_cfg.max_aggregate_batch = args.max_aggregate_batch
+            proc_cfg.max_aggregate_batch_explicit = True
+        if args.max_inflight_batches is not None:
             proc_cfg.max_inflight = args.max_inflight_batches
             proc_cfg.max_inflight_explicit = True
         if args.processor_workers is not None:

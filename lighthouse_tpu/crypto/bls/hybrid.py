@@ -240,20 +240,29 @@ class HybridBackend:
             p99 * 4.0,
         )
         with self._lock:
+            changed = (
+                getattr(self, "urgent_max_sets", None) != int(urgent)
+                or getattr(self, "p99_budget_ms", None) != p99
+                or getattr(self, "_stall_budget_secs", None) != stall / 1e3
+            )
             self.urgent_max_sets = int(urgent)
             self.p99_budget_ms = p99
             self._stall_budget_secs = stall / 1e3
             self.knob_sources = {
                 "urgent_max_sets": urgent_src, "p99_budget_ms": p99_src,
             }
-        self._log.info(
-            "routing knobs resolved",
-            urgent_max_sets=self.urgent_max_sets,
-            urgent_max_sets_source=urgent_src,
-            p99_budget_ms=self.p99_budget_ms,
-            p99_budget_ms_source=p99_src,
-            plan_source=plan.source if plan else "none",
-        )
+        if changed:
+            # change-only: the capacity scheduler may re-install a plan
+            # every few slots (chain/scheduler.py), and a no-op resolve
+            # must not turn the log into a metronome
+            self._log.info(
+                "routing knobs resolved",
+                urgent_max_sets=self.urgent_max_sets,
+                urgent_max_sets_source=urgent_src,
+                p99_budget_ms=self.p99_budget_ms,
+                p99_budget_ms_source=p99_src,
+                plan_source=plan.source if plan else "none",
+            )
 
     # ------------------------------------------------------------- probing
 

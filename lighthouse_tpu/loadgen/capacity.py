@@ -1,0 +1,401 @@
+"""Capacity-control proving ground: the closed-loop scheduler vs the best
+static plan, on a deterministic device-time ledger.
+
+The question PR 14's controller must answer is "can a node with NO
+autotune profile reach the throughput an oracle-tuned static plan gets?"
+— and the answer has to be provable on CPU, bit-reproducibly. So this
+harness replaces wall-clock with a LOGICAL device-time ledger:
+
+  - a batch of n sets costs `base_ms + per_set_ms * pow2ceil(n)` logical
+    milliseconds — the jaxbls padding-bucket economics (a 640-set batch
+    under a 1024 cap pays 1024 lanes; 512+128 under a 512 cap pays 640),
+    which is exactly what makes batch-cap choice a real optimization
+    problem instead of "bigger is always better";
+  - the device is a serial timeline (`busy_until`): a batch may START
+    only while the device frees up inside the current slot — the
+    scheduler's budget gate holds everything else, so backlog carries
+    across slots like a saturated accelerator's queue would;
+  - work verified after its publish slot is LATE (deadline miss for the
+    SLO, processed for conservation), so throughput is measured in
+    deadline-credited hits, not raw sets.
+
+Everything else is the REAL serving machinery: a `BeaconProcessor` whose
+batch formation is the `CapacityScheduler`'s call, a real
+`AdmissionController` on a `ManualSlotClock` (whose watermarks the
+controller retunes live), a private `SlotAccountant` closing real slot
+reports (the control loop's tick), and the global flight recorder
+collecting retune events and burn incidents. No RNG outside the seeded
+traffic draw, no wall-clock in any decision: reruns are bit-identical in
+the deterministic core.
+
+The driver (loadgen/driver.py `_drive_capacity`) runs the CONTROLLER leg
+(defaults, retune on) against a STATIC sweep (pow2 cap ladder, retune
+off — the plans an oracle calibrate could have installed) and exits
+nonzero unless controller hits >= gate_ratio * best static hits.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import tempfile
+import time
+
+from ..chain.beacon_processor import (
+    BeaconProcessor,
+    BeaconProcessorConfig,
+    WorkItem,
+    WorkKind,
+)
+from ..chain.scheduler import pow2ceil
+from ..observability.flight_recorder import RECORDER
+from ..observability.slo import SlotAccountant
+from ..qos.admission import AdmissionController
+from ..utils.slot_clock import ManualSlotClock
+from .scenarios import (
+    CapacityScenario,
+    capacity_slot_factors,
+    mainnet_mix,
+)
+
+#: pow2 cap candidates for the static-optimal reference sweep (the same
+#: ladder the controller chooses from, minus the degenerate extremes)
+STATIC_CAP_SWEEP = (128, 256, 512, 1024, 2048)
+
+
+class DeviceLedger:
+    """Serial logical device timeline + the cost model."""
+
+    def __init__(self, sc: CapacityScenario):
+        self.base_secs = sc.base_ms / 1e3
+        self.per_set_secs = sc.per_set_ms / 1e3
+        self.busy_until = 0.0
+        self.batches = 0
+        self.lanes_padded = 0
+        self.sets_served = 0
+
+    def cost(self, n_sets: int) -> float:
+        return self.base_secs + self.per_set_secs * pow2ceil(n_sets)
+
+    def serve(self, n_sets: int, now: float) -> tuple[float, float]:
+        """Run one batch: returns (start, end) on the logical timeline."""
+        start = max(self.busy_until, now)
+        end = start + self.cost(n_sets)
+        self.busy_until = end
+        self.batches += 1
+        self.lanes_padded += pow2ceil(n_sets)
+        self.sets_served += n_sets
+        return start, end
+
+
+def _capacity_traffic(sc: CapacityScenario) -> list[tuple[int, int]]:
+    """Per-slot (attestations, aggregates) — seeded, profile-scaled."""
+    rng = random.Random(sc.seed)
+    factors = capacity_slot_factors(sc)
+    out = []
+    for f in factors:
+        base = mainnet_mix(sc.n_validators, rng)
+        out.append(
+            (max(1, int(base.attestations * f)),
+             max(1, int(base.aggregates * f)))
+        )
+    return out
+
+
+def run_capacity_leg(sc: CapacityScenario, *, static_caps=None,
+                     datadir: str | None = None, log_fn=None) -> dict:
+    """One full run of the scenario. `static_caps=(att, agg)` pins the
+    caps (explicit config — the scheduler never retunes a pinned knob)
+    and disables the control loop entirely: the static-plan reference.
+    `static_caps=None` is the controller leg: default knobs, no profile,
+    retuning live."""
+    t_wall = time.time()
+    clock = ManualSlotClock(0, max(1, int(sc.seconds_per_slot)))
+    sps = float(max(1, int(sc.seconds_per_slot)))
+    slo_acct = SlotAccountant(export_metrics=False)
+    admission = AdmissionController(clock)
+    if static_caps is not None:
+        cfg = BeaconProcessorConfig(
+            max_attestation_batch=int(static_caps[0]),
+            max_aggregate_batch=int(static_caps[1]),
+        )
+    else:
+        cfg = BeaconProcessorConfig()
+    proc = BeaconProcessor(cfg, admission=admission)
+    proc.slo = slo_acct
+    slo_acct.bind_clock(clock)
+    sched = proc.scheduler
+    if static_caps is not None:
+        sched.retune_enabled = False
+    if sc.att_queue_cap is not None:
+        proc.max_lengths[WorkKind.gossip_attestation] = sc.att_queue_cap
+    if sc.agg_queue_cap is not None:
+        proc.max_lengths[WorkKind.gossip_aggregate] = sc.agg_queue_cap
+    proc.max_lengths[WorkKind.chain_segment] = sc.bulk_queue_cap
+
+    datadir = datadir or tempfile.mkdtemp(prefix="loadgen-capacity-")
+    incident_dir = os.path.join(datadir, "incidents")
+    RECORDER.reset()
+    RECORDER.configure(incident_dir=incident_dir, clock=clock,
+                       slo_provider=slo_acct.snapshot)
+
+    ledger = DeviceLedger(sc)
+    state = {"slot": 0}
+    counts = {
+        "published_att": 0, "published_agg": 0, "late_sets": 0,
+        "bulk_submitted": 0, "bulk_processed": 0, "bulk_refused": 0,
+    }
+
+    def _slot_t0() -> float:
+        """Current slot's start on the ABSOLUTE logical timeline — the
+        ledger, the clock and the lateness rule all speak seconds, so
+        slot indices convert through seconds_per_slot exactly once here
+        (mixing the two is only coincidentally right at sps == 1)."""
+        return state["slot"] * sps
+
+    def gate(_kind: str, n: int) -> bool:
+        # the device may START a batch only while it frees up inside the
+        # current slot; a backlogged timeline holds batch work to the
+        # next slot — the continuous-batching ledger semantics
+        return max(ledger.busy_until, _slot_t0()) < _slot_t0() + sps
+
+    sched.set_budget_gate(gate)
+
+    def mk_verify(kind_name: str):
+        def verify(payloads):
+            n = len(payloads)
+            start, end = ledger.serve(n, _slot_t0())
+            # the visible clock tracks device progress inside the slot so
+            # admission expiry and SLO attribution see intra-slot time;
+            # it never crosses the boundary (close_slot owns that)
+            clock.set_time(min(end, _slot_t0() + sps * 0.999))
+            late = sum(1 for s in payloads if end > (s + 1) * sps)
+            if late:
+                counts["late_sets"] += late
+                slo_acct.record_late(late)
+            slo_acct.record_route("device", n)
+            slo_acct.record_verify_latency(end - start)
+            sched.observe_verify(kind_name, n, end - start)
+            return None
+
+        return verify
+
+    verify_att = mk_verify("gossip_attestation")
+    verify_agg = mk_verify("gossip_aggregate")
+
+    def bulk_run():
+        # host-side bulk work (a chain segment import): no device time,
+        # but a queue the admission watermarks protect under pressure
+        counts["bulk_processed"] += 1
+
+    traffic = _capacity_traffic(sc)
+    per_slot: list[dict] = []
+    # run totals accumulate from every close_slot() return, NOT from the
+    # accountant's `recent` ring (bounded at 64 reports — a 100-slot run
+    # would silently count only its tail)
+    totals = {"hits": 0, "misses": 0}
+
+    def _tally(reports) -> None:
+        for r in reports:
+            totals["hits"] += r.hits
+            totals["misses"] += r.misses
+
+    def publish(slot: int, atts: int, aggs: int) -> None:
+        for _ in range(atts):
+            proc.submit(WorkItem(
+                kind=WorkKind.gossip_attestation, payload=slot,
+                run_batch=verify_att,
+                deadline_slot=admission.attestation_deadline_slot(slot),
+            ))
+        counts["published_att"] += atts
+        for _ in range(aggs):
+            proc.submit(WorkItem(
+                kind=WorkKind.gossip_aggregate, payload=slot,
+                run_batch=verify_agg,
+                deadline_slot=admission.attestation_deadline_slot(slot),
+            ))
+        counts["published_agg"] += aggs
+        for _ in range(sc.bulk_per_slot):
+            counts["bulk_submitted"] += 1
+            if not proc.submit(WorkItem(
+                kind=WorkKind.chain_segment, run=bulk_run,
+            )):
+                counts["bulk_refused"] += 1
+
+    total_slots = sc.slots + sc.epilogue_slots
+    for slot in range(total_slots):
+        state["slot"] = slot
+        clock.set_slot(slot)
+        if slot < sc.slots:
+            atts, aggs = traffic[slot]
+            publish(slot, atts, aggs)
+        proc.run_available()
+        reports = slo_acct.close_slot(slot)
+        _tally(reports)
+        rep = reports[-1] if reports else None
+        entry = {
+            "slot": slot,
+            "published": (traffic[slot] if slot < sc.slots else (0, 0)),
+            "caps": dict(sched.caps),
+            "watermarks": {
+                "bulk": round(admission.bulk_watermark, 3),
+                "backfill": round(admission.backfill_watermark, 3),
+            },
+            "busy_carry": round(
+                max(0.0, ledger.busy_until - (slot + 1) * sps), 6
+            ),
+        }
+        if rep is not None:
+            entry.update(
+                hits=rep.hits, misses=rep.misses, late=rep.late,
+                processed=dict(rep.processed), shed=dict(rep.shed),
+            )
+        per_slot.append(entry)
+        if log_fn is not None and slot < sc.slots:
+            log_fn(
+                f"slot {slot}: att={entry['published'][0]} "
+                f"agg={entry['published'][1]} caps={entry['caps']} "
+                f"hits={entry.get('hits')} late={entry.get('late')}"
+            )
+    # force-drain whatever the ledger still holds: it verifies LATE by
+    # construction (the run is over), so it lands as misses, never lost
+    sched.set_budget_gate(None)
+    state["slot"] = total_slots
+    clock.set_slot(total_slots)
+    proc.run_until_idle()
+    _tally(slo_acct.close_slot(total_slots))
+
+    hits = totals["hits"]
+    misses = totals["misses"]
+    published = counts["published_att"] + counts["published_agg"]
+    processed = sum(
+        v for k, v in proc.processed.items()
+        if k in (WorkKind.gossip_attestation, WorkKind.gossip_aggregate)
+    )
+    dropped = sum(proc.dropped.values())
+    expired = sum(proc.expired.values())
+    shed_admission = sum(
+        v for k, v in proc.shed_admission.items()
+        if k in (WorkKind.gossip_attestation, WorkKind.gossip_aggregate)
+    )
+    conservation = {
+        "published": published,
+        "processed": processed,
+        "dropped": dropped,
+        "expired": expired,
+        "shed_admission": shed_admission,
+        "ok": published == processed + dropped + expired + shed_admission,
+    }
+    deterministic = {
+        "per_slot": per_slot,
+        "deadline_hits": hits,
+        "deadline_misses": misses,
+        "late_sets": counts["late_sets"],
+        "published": {
+            "attestations": counts["published_att"],
+            "aggregates": counts["published_agg"],
+        },
+        "bulk": {
+            "submitted": counts["bulk_submitted"],
+            "processed": counts["bulk_processed"],
+            "refused": counts["bulk_refused"],
+        },
+        "conservation": conservation,
+        "device": {
+            "batches": ledger.batches,
+            "lanes_padded": ledger.lanes_padded,
+            "sets_served": ledger.sets_served,
+            "busy_secs": round(ledger.busy_until, 6),
+            "lane_efficiency": (
+                round(ledger.sets_served / ledger.lanes_padded, 4)
+                if ledger.lanes_padded else None
+            ),
+        },
+        "scheduler": sched.stats(),
+    }
+    leg = {
+        "static_caps": list(static_caps) if static_caps else None,
+        "deterministic": deterministic,
+        "slo": {
+            "windows": {
+                name: slo_acct.window_summary(name)
+                for name in slo_acct.windows
+            },
+            "incident_dir": incident_dir,
+            "incidents": [
+                os.path.basename(p) for p in RECORDER.incidents_written
+            ],
+        },
+        "elapsed_secs": round(time.time() - t_wall, 3),
+    }
+    RECORDER.configure(incident_dir=None, clock=None, slo_provider=None)
+    return leg
+
+
+def run_capacity_scenario(sc: CapacityScenario, out_path: str | None = None,
+                          log_fn=None, datadir: str | None = None) -> dict:
+    """The full proof: the controller leg (cold start, NO profile) vs the
+    static-optimal reference (best fixed-cap plan from the pow2 sweep,
+    retuning disabled). The gate verdict rides in the report; exit-code
+    semantics live in loadgen/driver.py."""
+    t_wall = time.time()
+    # ONE base dir per run (the other scenario runners' pattern): the
+    # sweep legs get subdirs so their incident dumps never collide with
+    # (or overwrite) the controller leg's, and a default-tmpdir run
+    # leaves a single directory behind, not six
+    datadir = datadir or tempfile.mkdtemp(prefix="loadgen-capacity-")
+    controller = run_capacity_leg(
+        sc, datadir=os.path.join(datadir, "controller"), log_fn=log_fn
+    )
+    sweep: dict[str, dict] = {}
+    best_caps, best_hits = None, -1
+    for cap in STATIC_CAP_SWEEP:
+        caps = (cap, max(64, cap // 2))
+        leg = run_capacity_leg(
+            sc, static_caps=caps,
+            datadir=os.path.join(datadir, f"static_{cap}"),
+        )
+        det = leg["deterministic"]
+        sweep[str(cap)] = {
+            "caps": list(caps),
+            "deadline_hits": det["deadline_hits"],
+            "deadline_misses": det["deadline_misses"],
+            "lane_efficiency": det["device"]["lane_efficiency"],
+        }
+        if det["deadline_hits"] > best_hits:
+            best_hits = det["deadline_hits"]
+            best_caps = caps
+    controller_hits = controller["deterministic"]["deadline_hits"]
+    ratio = (
+        round(controller_hits / best_hits, 4) if best_hits > 0 else None
+    )
+    gate = {
+        "controller_hits": controller_hits,
+        "static_optimal_hits": best_hits,
+        "static_optimal_caps": list(best_caps) if best_caps else None,
+        "ratio": ratio,
+        "gate_ratio": sc.gate_ratio,
+        "ok": (
+            ratio is not None and ratio >= sc.gate_ratio
+            and controller["deterministic"]["conservation"]["ok"]
+        ),
+    }
+    report = {
+        "scenario": sc.name,
+        "seed": sc.seed,
+        "slots": sc.slots,
+        "n_validators": sc.n_validators,
+        "profile": sc.profile,
+        "capacity": True,
+        "controller": controller,
+        "static_sweep": sweep,
+        "gate": gate,
+        "deterministic": controller["deterministic"],
+        "slo": controller["slo"],
+        "elapsed_secs": round(time.time() - t_wall, 3),
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=1)
+    return report

@@ -57,6 +57,15 @@ def drive(*, scenario=None, smoke=False, slots=None, validators=None,
             out=out, quiet=quiet, datadir=datadir, bench_root=bench_root,
             stdout=stdout, stderr=stderr,
         )
+    from .scenarios import is_capacity
+
+    if is_capacity(name):
+        return _drive_capacity(
+            name, smoke=smoke, slots=slots, validators=validators,
+            seed=seed, out=out, quiet=quiet, datadir=datadir,
+            bench_matrix=bench_matrix, bench_root=bench_root,
+            stdout=stdout, stderr=stderr,
+        )
     from .scenarios import is_state_root
 
     if is_state_root(name):
@@ -224,12 +233,14 @@ def _drive_mesh_sweep(name, points, *, smoke, slots, validators, seed,
     from .runner import run_scenario
     from .scenarios import get_scenario, is_multinode, smoke_variant
 
-    from .scenarios import is_fleet, is_state_root
+    from .scenarios import is_capacity, is_fleet, is_state_root
 
-    if is_multinode(name) or is_state_root(name) or is_fleet(name):
+    if (is_multinode(name) or is_state_root(name) or is_fleet(name)
+            or is_capacity(name)):
         print(f"error: --mesh-devices does not apply to scenario "
-              f"{name!r} (multi-node, fleet and state_root scenarios "
-              "drive surfaces the mesh sweep does not)", file=stderr)
+              f"{name!r} (multi-node, fleet, state_root and capacity "
+              "scenarios drive surfaces the mesh sweep does not)",
+              file=stderr)
         return 1
     try:
         points = sorted({int(p) for p in points})
@@ -333,6 +344,83 @@ def _drive_mesh_sweep(name, points, *, smoke, slots, validators, seed,
             f"error: mesh sweep did not scale: {hi}-device point "
             f"({hi_rate} sets/s) is not above the {lo}-device point "
             f"({lo_rate} sets/s)", file=stderr,
+        )
+        return 1
+    return 0
+
+
+def _drive_capacity(name, *, smoke, slots, validators, seed, out, quiet,
+                    datadir, bench_matrix, bench_root, stdout, stderr) -> int:
+    """The closed-loop capacity-control proof (loadgen/capacity.py): the
+    controller leg (NO pre-installed profile, scheduler retuning live)
+    against the static-optimal fixed-cap reference. Exit code is the
+    acceptance gate — nonzero unless the controller's deadline-credited
+    throughput lands within the scenario's gate_ratio (default 10%) of
+    the best static plan, with conservation intact. The measured
+    controller-vs-static ratio lands as a `source: loadtest` BENCH_MATRIX
+    row with a fresh-entry history, so the perf trend gate catches a
+    controller regression fresh-to-fresh."""
+    from .capacity import run_capacity_scenario
+    from .scenarios import capacity_smoke_variant, get_capacity_scenario
+
+    sc = get_capacity_scenario(name, slots=slots, n_validators=validators,
+                               seed=seed)
+    if smoke:
+        sc = capacity_smoke_variant(sc)
+    out = out or default_report_path(smoke)
+    report = run_capacity_scenario(
+        sc, out_path=out, datadir=datadir,
+        log_fn=None if quiet else (
+            lambda m: print(m, file=stderr, flush=True)
+        ),
+    )
+    det = report["deterministic"]
+    gate = report["gate"]
+    summary = {
+        "scenario": report["scenario"],
+        "report": out,
+        "gate": gate,
+        "scheduler": {
+            "caps": det["scheduler"]["caps"],
+            "retune_count": det["scheduler"]["retune_count"],
+            "last_retune_slot": det["scheduler"]["last_retune_slot"],
+            "urgent_max_sets": det["scheduler"]["urgent_max_sets"],
+            "watermarks": det["scheduler"]["watermarks"],
+        },
+        "lane_efficiency": det["device"]["lane_efficiency"],
+        "bulk_refused": det["bulk"]["refused"],
+        "incidents": report["slo"]["incidents"],
+        "elapsed_secs": report["elapsed_secs"],
+    }
+    print(json.dumps(summary), file=stdout)
+    if bench_matrix:
+        import time as _time
+
+        from ..observability import perf as _perf
+
+        row = {
+            "source": "loadtest",
+            "scenario": report["scenario"],
+            "measured_unix": round(_time.time(), 3),
+            "validators": report["n_validators"],
+            "scheduler_ratio": gate["ratio"],
+            "controller_hits": gate["controller_hits"],
+            "static_optimal_hits": gate["static_optimal_hits"],
+            "lane_efficiency": det["device"]["lane_efficiency"],
+        }
+        try:
+            path = _perf.write_loadtest_rows(
+                {f"loadtest_{name}": row}, smoke=smoke, root=bench_root
+            )
+            print(f"bench matrix rows -> {path}", file=stderr)
+        except Exception as e:  # a bench snapshot must never fail the run
+            print(f"warning: bench matrix write failed: {e}", file=stderr)
+    if not gate["ok"]:
+        print(
+            f"error: capacity controller missed the static-optimal gate "
+            f"(ratio={gate['ratio']}, need >= {gate['gate_ratio']}, "
+            f"conservation_ok="
+            f"{det['conservation']['ok']})", file=stderr,
         )
         return 1
     return 0
@@ -521,10 +609,14 @@ def add_loadtest_args(parser) -> None:
                              "device_stall, mesh_stall, slow_host, "
                              "crash_restart, state_root (mutate-and-reroot "
                              "churn through the active hash backend), a "
-                             "multi-node family: partition_heal, fork_reorg, "
-                             "sync_catchup, equivocation_storm, or a "
-                             "validator-fleet family: fleet_steady, "
-                             "fleet_partition, fleet_crash, combined_chaos "
+                             "capacity-control proof: diurnal_ramp, "
+                             "flash_crowd (closed-loop scheduler vs the "
+                             "static-optimal plan; nonzero exit outside "
+                             "the gate), a multi-node family: "
+                             "partition_heal, fork_reorg, sync_catchup, "
+                             "equivocation_storm, or a validator-fleet "
+                             "family: fleet_steady, fleet_partition, "
+                             "fleet_crash, combined_chaos, fleet_capacity "
                              "(default: smoke)")
     parser.add_argument("--smoke", action="store_true",
                         help="alone: run the ~5s CPU-only smoke scenario; "

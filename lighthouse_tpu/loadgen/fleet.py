@@ -607,6 +607,7 @@ class FleetHarness(MultiNodeHarness):
         super().__init__(
             spec, sc.n_nodes, sc.n_validators, subnets=sc.subnets,
             seed=sc.seed, injector=injector, attest=True,
+            batch_gossip=getattr(sc, "batch_gossip", False),
         )
         self.sc = sc
         self.fleet_datadir = datadir
@@ -847,6 +848,29 @@ def run_fleet_scenario(sc, out_path: str | None = None, log_fn=None,
             )
     if sc.expect_incident and not RECORDER.incidents_written:
         failures.append("fault window produced no incident dump")
+    # -------- capacity scheduler under VC demand (fleet_capacity): the
+    # controller must have actually formed batches on the nodes. Decision
+    # COUNTS depend on pump-pass timing, so they are observations, not
+    # part of the deterministic core — the duty floor above is the
+    # deterministic acceptance.
+    scheduler_obs = None
+    if getattr(sc, "batch_gossip", False):
+        scheduler_obs = {}
+        total_decisions = 0
+        for n in mh.nodes:
+            st = n.net.processor.scheduler.stats()
+            n_dec = sum(st["decisions"].values())
+            total_decisions += n_dec
+            scheduler_obs[str(n.index)] = {
+                "decisions": n_dec,
+                "caps": st["caps"],
+                "retune_count": st["retune_count"],
+            }
+        if getattr(sc, "expect_scheduler", False) and total_decisions == 0:
+            failures.append(
+                "capacity scheduler made no batch-formation decisions "
+                "(batch_gossip path not exercised)"
+            )
     if sc.node_crashes and len(mh.fleet.crashes_fired) != len(
         sc.node_crashes
     ):
@@ -891,6 +915,7 @@ def run_fleet_scenario(sc, out_path: str | None = None, log_fn=None,
         "ok": ok,
         "failures": failures,
         "deterministic": deterministic,
+        "scheduler": scheduler_obs,
         "burn_final": burn_final,
         "slo": {
             "per_node": {

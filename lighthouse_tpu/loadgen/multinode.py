@@ -99,12 +99,22 @@ class MultiNode:
             # deterministic handler ordering under the node lock (the
             # device-batching path is the single-node loadgen's subject)
             batch_gossip=mh.batch_gossip,
+            # batch_gossip mode runs the REAL processor + capacity
+            # scheduler in the gossip path, but the harness pumps it at
+            # its phase barriers (MultiNodeHarness._tick) instead of
+            # worker threads — lock-step determinism, real machinery
+            processor_autostart=False,
             rpc_timeout=mh.rpc_timeout,
         )
         # per-node service-level accountant (private: the global one
         # belongs to a live bn process)
         self.slo = SlotAccountant(export_metrics=False)
         self.slo.bind_clock(self.chain.slot_clock)
+        if mh.batch_gossip:
+            # the node's processor (and so its capacity scheduler's
+            # control loop) accounts into THIS node's accountant, not the
+            # process-global one
+            self.net.processor.slo = self.slo
         self.detections = 0          # slasher evidence broadcast by this node
 
     @property
@@ -180,13 +190,48 @@ class MultiNodeHarness:
 
     # ------------------------------------------------------------ plumbing
 
-    @staticmethod
-    def _wait(cond, timeout: float, what: str) -> None:
+    def _tick(self) -> int:
+        """batch_gossip mode: pump every alive node's queued processor
+        work (index order — deterministic). Gossip handlers defer
+        attestation/aggregate/block work into the REAL BeaconProcessor;
+        without worker threads the harness is the pump, and every
+        propagation wait ticks it so deferred (PENDING) validations
+        resolve and forward."""
+        if not self.batch_gossip:
+            return 0
+        moved = 0
+        for n in self.nodes:
+            if self._alive(n.index):
+                moved += n.net.processor.run_until_idle()
+        return moved
+
+    def _wait(self, cond, timeout: float, what: str) -> None:
         deadline = time.monotonic() + timeout
         while not cond():
+            self._tick()
+            if cond():
+                return
             if time.monotonic() > deadline:
                 raise TimeoutError(f"timed out waiting for {what}")
             time.sleep(0.005)
+
+    def _settle_processors(self) -> None:
+        """Drain every node's processor until the whole mesh stops moving
+        (a pump's forwards can enqueue more work on peers): the
+        batch_gossip analog of wire quiescence, run before slot close so
+        SLO reports never straddle a pump."""
+        if not self.batch_gossip:
+            return
+        deadline = time.monotonic() + self.WAIT_SECS
+        idle_streak = 0
+        while idle_streak < 2:
+            if self._tick() == 0:
+                idle_streak += 1
+            else:
+                idle_streak = 0
+            if time.monotonic() > deadline:
+                raise TimeoutError("processors never settled at slot end")
+            time.sleep(0.002)
 
     def _wait_mesh(self, members: list[MultiNode]) -> None:
         """Wait until every member pair is connected AND mutually knows the
@@ -331,6 +376,10 @@ class MultiNodeHarness:
             # sent at slot N must never be evaluated against slot N+1's
             # fault rules (determinism depends on it)
             self._quiesce()
+        # batch_gossip: queued processor work drains before the slot
+        # closes, so slot reports (and the capacity scheduler's control
+        # tick riding them) never straddle a pump
+        self._settle_processors()
         for n in self.nodes:
             n.slo.close_slot(slot)
         entry = {
@@ -797,6 +846,7 @@ def run_multinode_scenario(sc: MultiNodeScenario, out_path: str | None = None,
         injector=inj, attest=sc.attest, slasher=sc.slasher,
         detached=(sc.catchup_node,) if sc.catchup_node is not None else (),
         rpc_timeout=sc.rpc_timeout, validator_split=sc.validator_split,
+        batch_gossip=getattr(sc, "batch_gossip", False),
     )
     RECORDER.configure(incident_dir=incident_dir,
                        clock=mh.nodes[0].chain.slot_clock,
@@ -959,6 +1009,21 @@ def run_multinode_scenario(sc: MultiNodeScenario, out_path: str | None = None,
         # wall-clock-shaped observations: OUTSIDE the determinism contract
         # (gossip counts include heartbeat/control frames)
         "netfaults_observed": {"gossip": dict(inj.counts["gossip"])},
+        # batch_gossip mode: per-node capacity-scheduler state (decision
+        # counts depend on pump-pass timing — observations, like the
+        # gossip frame counts above)
+        "scheduler": (
+            {
+                str(n.index): {
+                    "decisions": sum(st["decisions"].values()),
+                    "caps": st["caps"],
+                    "retune_count": st["retune_count"],
+                }
+                for n in mh.nodes
+                for st in (n.net.processor.scheduler.stats(),)
+            }
+            if mh.batch_gossip else None
+        ),
         "slo": {
             "per_node": {
                 str(n.index): _node_slo_block(n) for n in mh.nodes

@@ -56,6 +56,7 @@ class NetworkNode:
         encrypt: bool = True,
         require_encryption: bool = False,
         batch_gossip: bool = True,
+        processor_autostart: bool = True,
         processor_config=None,
         ingest_rate: float | None = None,
         rpc_timeout: float | None = None,
@@ -101,7 +102,12 @@ class NetworkNode:
                 self.ingest_limiter.configure(
                     scope, float(ingest_rate), burst=2 * float(ingest_rate)
                 )
-        if batch_gossip:
+        if batch_gossip and processor_autostart:
+            # processor_autostart=False is the lock-step harness seam
+            # (loadgen/multinode.py): gossip work queues through the REAL
+            # processor + capacity scheduler, but the harness pumps it
+            # synchronously at its phase barriers instead of worker
+            # threads, so reports stay functions of the seed
             self.processor.start()
         self.op_pool = op_pool
         self.peer_manager = PeerManager()

@@ -421,21 +421,30 @@ def write_loadtest_rows(rows: dict, smoke: bool = True,
                 f"{'/'.join(WORKLOAD_ROW_PREFIXES)}*: {key!r}"
             )
         row = dict(row, source=row.get("source", "loadtest"))
-        if row.get("p50_ms") is not None:
+        if (
+            row.get("p50_ms") is not None
+            or row.get("scheduler_ratio") is not None
+        ):
             prev = matrix.get(key)
             history = list(prev.get("history") or []) if isinstance(
                 prev, dict
             ) else []
             entry = {
                 "measured_unix": row.get("measured_unix"),
-                "p50_ms": row["p50_ms"],
                 "fresh": True,
             }
+            if row.get("p50_ms") is not None:
+                entry["p50_ms"] = row["p50_ms"]
+            if row.get("scheduler_ratio") is not None:
+                # the capacity-control proof's controller-vs-static-optimal
+                # ratio (loadgen/capacity.py): the capacity_ratio trend
+                # series reads this history fresh-to-fresh
+                entry["scheduler_ratio"] = row["scheduler_ratio"]
             # measurement config rides each entry so the trend gate only
             # compares like with like — a host-vs-device (or resized)
             # re-measurement, or a different harness (bench_state_root vs
             # a loadtest soak), is a configuration change, not a regression
-            for k in ("hash_backend", "validators", "source"):
+            for k in ("hash_backend", "validators", "source", "scenario"):
                 if row.get(k) is not None:
                     entry[k] = row[k]
             history.append(entry)
@@ -491,7 +500,8 @@ def load_matrix(root: str | None = None, name: str = "BENCH_MATRIX.json") -> dic
         for k in ("p50_ms", "p99_ms"):
             if k in val:
                 entry[k] = val[k]
-        for k in ("source", "n_devices", "measured_unix", "history"):
+        for k in ("source", "n_devices", "measured_unix", "history",
+                  "scheduler_ratio"):
             if k in val:
                 entry[k] = val[k]
         for k, v in val.items():
@@ -612,6 +622,59 @@ def trend_report(
                 }
             )
 
+    # capacity controller-vs-static-optimal ratio (HIGHER is better) — the
+    # closed-loop scheduler's trend series, read from the loadtest_* rows'
+    # histories (loadgen/driver.py _drive_capacity writes them). A
+    # fresh-to-fresh DROP past the threshold gates CI: a scheduler change
+    # that loses ground against the same static-optimal reference is a
+    # controller regression even while the absolute gate still passes.
+    # Same-config comparison only (scenario/validators/source stamped per
+    # entry), the state_root_p50 contract.
+    cap_entries = []
+    cap_deltas = []
+    for cfg_key in sorted(matrix):
+        if not cfg_key.startswith("loadtest_"):
+            continue
+        hist = [
+            e for e in (matrix[cfg_key].get("history") or [])
+            if isinstance(e, dict) and e.get("scheduler_ratio") is not None
+        ]
+        if not hist:
+            continue
+        cap_entries.extend(dict(e, row=cfg_key) for e in hist)
+        _last: dict = {}
+        for cur in hist:
+            if not cur.get("fresh", True):
+                continue
+            cfg = tuple(
+                cur.get(k) for k in ("scenario", "validators", "source")
+            )
+            prev = _last.get(cfg)
+            _last[cfg] = cur
+            if prev is None or not prev.get("scheduler_ratio"):
+                continue
+            delta = (
+                cur["scheduler_ratio"] - prev["scheduler_ratio"]
+            ) / prev["scheduler_ratio"]
+            cap_deltas.append(
+                {
+                    "config": "capacity_ratio",
+                    "row": cfg_key,
+                    "delta_pct": round(delta * 100.0, 2),
+                }
+            )
+            if delta < -threshold:
+                regressions.append(
+                    {
+                        "config": "capacity_ratio",
+                        "prev": prev["scheduler_ratio"],
+                        "cur": cur["scheduler_ratio"],
+                        "from": f"{cfg_key}@{prev.get('measured_unix')}",
+                        "to": f"{cfg_key}@{cur.get('measured_unix')}",
+                        "delta_pct": round(delta * 100.0, 2),
+                    }
+                )
+
     mc_fresh = [r for r in multichip if not r["skipped"]]
     if mc_fresh and not mc_fresh[-1]["ok"] and any(r["ok"] for r in mc_fresh[:-1]):
         last_ok = [r for r in mc_fresh[:-1] if r["ok"]][-1]
@@ -642,6 +705,7 @@ def trend_report(
             "deltas": lat_deltas,
         },
         "state_root_p50": {"entries": sr_entries, "deltas": sr_deltas},
+        "capacity_ratio": {"entries": cap_entries, "deltas": cap_deltas},
         "multichip": {"rounds": multichip},
         "matrix": matrix,
         "regressions": regressions,
@@ -764,6 +828,25 @@ def render_report(report: dict) -> str:
             )
         for d in sr["deltas"]:
             lines.append(f"  delta: {d['delta_pct']:+.2f}%")
+    cap = report.get("capacity_ratio") or {}
+    if cap.get("entries"):
+        lines.append("")
+        lines.append(
+            "capacity controller vs static-optimal (ratio, higher is "
+            "better; loadtest_* row histories):"
+        )
+        for e in cap["entries"]:
+            tag = "fresh" if e.get("fresh", True) else (
+                "CARRIED FORWARD — not a fresh measurement"
+            )
+            lines.append(
+                f"  {e.get('row')}@{e.get('measured_unix')}  "
+                f"{e.get('scheduler_ratio')}  [{tag}]"
+            )
+        for d in cap["deltas"]:
+            lines.append(
+                f"  delta ({d['row']}): {d['delta_pct']:+.2f}%"
+            )
     lines.append("")
     lines.append("multichip (MULTICHIP_r*.json):")
     for r in report["multichip"]["rounds"]:

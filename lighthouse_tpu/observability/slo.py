@@ -278,6 +278,57 @@ class SlotAccountant:
         # break the one-dump-per-episode hysteresis guarantee)
         self._post_lock = threading.Lock()
         self._post_through = -1                    # newest slot evaluated
+        # close listeners (weak refs, the autotune plan-listener pattern):
+        # the capacity scheduler's control loop ticks on every closed
+        # report — called OUTSIDE the accountant lock, after _post_close,
+        # so a listener may read window summaries or take its own locks.
+        # A garbage-collected owner silently unsubscribes; tests that
+        # construct many processors against the global accountant must
+        # not pin dead schedulers through it.
+        self._close_listeners: list = []
+
+    def add_close_listener(self, fn) -> None:
+        """Register `fn(report)` to run for every newly closed SlotReport."""
+        import weakref
+
+        try:
+            ref = weakref.WeakMethod(fn)
+        except TypeError:
+            ref = weakref.ref(fn)
+        with self._lock:
+            self._close_listeners.append(ref)
+
+    def remove_close_listener(self, fn) -> None:
+        """Unsubscribe `fn` (registered via add_close_listener). A
+        consumer re-binding to another accountant (the scheduler's
+        bind_slo) must drop its old subscription explicitly — the
+        weakref only dies with the OWNER, and a live owner subscribed to
+        two accountants would tick on both."""
+        with self._lock:
+            self._close_listeners = [
+                ref for ref in self._close_listeners
+                if ref() is not None and ref() != fn
+            ]
+
+    def _notify_close(self, rep: "SlotReport") -> None:
+        with self._lock:
+            refs = list(self._close_listeners)
+        for ref in refs:
+            fn = ref()
+            if fn is None:
+                with self._lock:
+                    try:
+                        self._close_listeners.remove(ref)
+                    except ValueError:
+                        pass
+                continue
+            try:
+                fn(rep)
+            except Exception as e:  # a listener must never break a close
+                flight_recorder.RECORDER.record(
+                    "slo_close_listener_error", severity="warn",
+                    slot=rep.slot, error=f"{type(e).__name__}: {e}",
+                )
 
     # ----------------------------------------------------------- plumbing
 
@@ -469,6 +520,7 @@ class SlotAccountant:
                 self._post_through = min(self._post_through, upto - 1)
         for rep in reports:
             self._post_close(rep)
+            self._notify_close(rep)
         return reports
 
     # ----------------------------------------------------------- analysis

@@ -98,6 +98,12 @@ class AdmissionController:
     def __init__(self, slot_clock=None, *, bulk_watermark: float = 0.75,
                  backfill_watermark: float = 0.5):
         self.slot_clock = slot_clock
+        # LIVE watermarks: the capacity scheduler (chain/scheduler.py)
+        # retunes these between [0.25, configured base] from the rolling
+        # burn rate — tightened while timely work is burning error budget
+        # (bulk yields earlier), relaxed back as it recovers. The
+        # constructor values are the bases it relaxes toward; the live
+        # values are exported as scheduler_admission_watermark{klass}.
         self.bulk_watermark = bulk_watermark
         self.backfill_watermark = backfill_watermark
 

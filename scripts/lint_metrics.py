@@ -45,6 +45,8 @@ METRIC_MODULES = (
     "lighthouse_tpu.validator.services",
     "lighthouse_tpu.parallel.mesh",
     "lighthouse_tpu.chain.beacon_processor",
+    "lighthouse_tpu.chain.scheduler",
+    "lighthouse_tpu.loadgen.capacity",
     "lighthouse_tpu.chain.validator_monitor",
     "lighthouse_tpu.crypto.bls.hybrid",
     "lighthouse_tpu.crypto.jaxbls.pipeline",
@@ -170,6 +172,17 @@ def lint_registry(registry=None) -> list[str]:
                 errors.append(
                     f"{where}: vc_*/fleet_* metrics must be labeled "
                     "families (duty+result / method+result / node / kind)"
+                )
+        if m.name.startswith("scheduler_"):
+            # the capacity scheduler's series answer "which kind's cap,
+            # which decision reason, which knob moved which way" — an
+            # unlabeled scheduler_* aggregate cannot explain a single
+            # control-loop action, so the convention is enforced like
+            # qos_* (chain/scheduler.py)
+            if not getattr(m, "labelnames", ()):
+                errors.append(
+                    f"{where}: scheduler_* metrics must be labeled "
+                    "families (kind / reason / knob+direction / class)"
                 )
         if m.name.startswith(("jaxbls_stage_", "xla_program_")):
             # per-stage attribution and compiled-program analytics exist
