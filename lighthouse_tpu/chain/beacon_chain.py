@@ -39,6 +39,19 @@ from ..types.spec import ChainSpec, DOMAIN_BEACON_ATTESTER
 from ..utils.slot_clock import SlotClock
 from .pubkey_cache import ValidatorPubkeyCache
 
+# Validator-monitor attribution failures survived in place (the block is
+# already imported; monitoring must never fail it): previously bare
+# `except Exception: continue` — now each skipped attestation is a
+# counted, logged event (the node_gossip_errors_total treatment).
+from ..utils.metrics import REGISTRY as _REGISTRY
+
+_MONITOR_ERRORS = _REGISTRY.counter_vec(
+    "beacon_chain_monitor_errors_total",
+    "validator-monitor block-import attribution failures survived "
+    "(the attestation is skipped, the import stands), by stage",
+    ("stage",),
+)
+
 
 class BlockError(Exception):
     """Block rejected (block_verification.rs BlockError analog)."""
@@ -952,7 +965,9 @@ class BeaconChain:
         participation, and slashings (validator_monitor.rs
         register_attestation_in_block and friends)."""
         from ..types.spec import ForkName
+        from ..utils.logging import get_logger
 
+        mlog = get_logger("validator_monitor")
         spec = self.spec
         att_sets = []
         for att in block.body.attestations:
@@ -963,7 +978,11 @@ class BeaconChain:
                 cc = self.shuffling_cache.get_or_build(
                     post_state, spec, epoch, bytes(att.data.target.root)
                 )
-            except Exception:
+            except Exception as e:  # noqa: BLE001 — monitoring must never
+                _MONITOR_ERRORS.labels("shuffling").inc()  # fail an import
+                mlog.warn("monitor shuffling lookup failed; attestation "
+                          "skipped", slot=int(att.data.slot), epoch=epoch,
+                          error=f"{type(e).__name__}: {e}")
                 continue
             try:
                 if fork >= ForkName.electra:
@@ -975,7 +994,12 @@ class BeaconChain:
                     indices = [
                         i for i, bit in zip(committee, att.aggregation_bits) if bit
                     ]
-            except Exception:
+            except Exception as e:  # noqa: BLE001
+                _MONITOR_ERRORS.labels("attesting_indices").inc()
+                mlog.warn("monitor attesting-index recovery failed; "
+                          "attestation skipped", slot=int(att.data.slot),
+                          index=int(att.data.index),
+                          error=f"{type(e).__name__}: {e}")
                 continue
             att_sets.append((att, indices))
         self.monitor.on_block_imported(block, att_sets)

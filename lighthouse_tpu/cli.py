@@ -659,6 +659,14 @@ def cmd_bn(args):
             now_slot = clock.now()
             if now_slot is not None and now_slot >= 1:
                 obs_slo.ACCOUNTANT.close_slot(now_slot - 1)
+                if net is not None:
+                    # propagation-stall bookkeeping: peers connected but
+                    # nothing delivered over gossip for consecutive slots
+                    # fires the propagation_stall incident (hysteresis:
+                    # the next delivery re-arms)
+                    net.propagation.close_slot(
+                        now_slot - 1, peers=len(net.host.connections)
+                    )
             head_slot = chain.head_state().slot
             HEAD_SLOT.set(head_slot)
             log.info("slot", slot=clock.now(), head=chain.head_root.hex()[:8])

@@ -29,7 +29,7 @@ def default_report_path(smoke: bool) -> str:
 def drive(*, scenario=None, smoke=False, slots=None, validators=None,
           seed=None, flood_factor=None, out=None, quiet=False,
           datadir=None, mesh_devices=None, bench_matrix=False,
-          bench_root=None, hash_backend=None, stdout=None,
+          bench_root=None, hash_backend=None, trace_out=None, stdout=None,
           stderr=None) -> int:
     """Run one scenario and print the one-line JSON summary. Returns a
     process exit code. `--smoke` alone runs the 'smoke' scenario; combined
@@ -50,6 +50,17 @@ def drive(*, scenario=None, smoke=False, slots=None, validators=None,
     stdout = stdout or sys.stdout
     stderr = stderr or sys.stderr
     name = "smoke" if smoke and scenario is None else (scenario or "smoke")
+    if trace_out:
+        from .scenarios import is_fleet as _isf, is_multinode as _ism
+
+        if not (_isf(name) or _ism(name)) or mesh_devices:
+            # the merged cluster timeline is a multi-node artifact; a
+            # single-process scenario's spans already export via
+            # `bn --trace-out` — warn BEFORE any scenario branch so the
+            # flag is never dropped silently
+            print("warning: --trace-out only applies to multi-node/fleet "
+                  "scenarios; ignored", file=stderr)
+            trace_out = None
     if mesh_devices:
         return _drive_mesh_sweep(
             name, mesh_devices, smoke=smoke, slots=slots,
@@ -81,13 +92,13 @@ def drive(*, scenario=None, smoke=False, slots=None, validators=None,
         return _drive_fleet(
             name, smoke=smoke, slots=slots, validators=validators,
             seed=seed, out=out, quiet=quiet, datadir=datadir,
-            stdout=stdout, stderr=stderr,
+            trace_out=trace_out, stdout=stdout, stderr=stderr,
         )
     if is_multinode(name):
         return _drive_multinode(
             name, smoke=smoke, slots=slots, validators=validators,
             seed=seed, out=out, quiet=quiet, datadir=datadir,
-            stdout=stdout, stderr=stderr,
+            trace_out=trace_out, stdout=stdout, stderr=stderr,
         )
     try:
         sc = get_scenario(name, slots=slots, n_validators=validators,
@@ -492,7 +503,7 @@ def _drive_state_root(name, *, smoke, slots, validators, seed, out, quiet,
 
 
 def _drive_fleet(name, *, smoke, slots, validators, seed, out, quiet,
-                 datadir, stdout, stderr) -> int:
+                 datadir, trace_out=None, stdout=None, stderr=None) -> int:
     """Validator-fleet soak leg (loadgen/fleet.py): real VC stacks drive
     every duty through rate-limited node surfaces under composed faults.
     Exit code is the scenario verdict — nonzero on a broken invariant:
@@ -507,7 +518,7 @@ def _drive_fleet(name, *, smoke, slots, validators, seed, out, quiet,
         sc = fleet_smoke_variant(sc)
     out = out or default_report_path(smoke)
     report = run_fleet_scenario(
-        sc, out_path=out, datadir=datadir,
+        sc, out_path=out, datadir=datadir, trace_out=trace_out,
         log_fn=None if quiet else (
             lambda m: print(m, file=stderr, flush=True)
         ),
@@ -518,6 +529,7 @@ def _drive_fleet(name, *, smoke, slots, validators, seed, out, quiet,
         "report": out,
         "ok": report["ok"],
         "n_vcs": report["n_vcs"],
+        "cluster": det["cluster"],
         "duty_conservation": {
             k: det["duty_conservation"][k]
             for k in ("scheduled", "performed", "missed",
@@ -534,6 +546,8 @@ def _drive_fleet(name, *, smoke, slots, validators, seed, out, quiet,
         "incidents": report["slo"]["incidents"],
         "elapsed_secs": report["elapsed_secs"],
     }
+    if "trace" in report:
+        summary["trace_out"] = report["trace"]["path"]
     print(json.dumps(summary), file=stdout)
     if not report["ok"]:
         for reason in report["failures"]:
@@ -543,7 +557,8 @@ def _drive_fleet(name, *, smoke, slots, validators, seed, out, quiet,
 
 
 def _drive_multinode(name, *, smoke, slots, validators, seed, out, quiet,
-                     datadir, stdout, stderr) -> int:
+                     datadir, trace_out=None, stdout=None,
+                     stderr=None) -> int:
     """Multi-node scenario leg: N full nodes over real TCP under a network
     fault plan (loadgen/multinode.py). Exit code is the scenario verdict —
     nonzero on divergence, broken conservation, or an un-exercised fault."""
@@ -557,7 +572,7 @@ def _drive_multinode(name, *, smoke, slots, validators, seed, out, quiet,
     out = out or default_report_path(smoke)
     try:
         report = run_multinode_scenario(
-            sc, out_path=out, datadir=datadir,
+            sc, out_path=out, datadir=datadir, trace_out=trace_out,
             log_fn=None if quiet else (
                 lambda m: print(m, file=stderr, flush=True)
             ),
@@ -576,9 +591,12 @@ def _drive_multinode(name, *, smoke, slots, validators, seed, out, quiet,
         "blocks": det["blocks"],
         "orphaned_blocks": det["orphaned_blocks"],
         "netfault_events": len(det["netfault_events"]),
+        "cluster": det["cluster"],
         "incidents": report["slo"]["incidents"],
         "elapsed_secs": report["elapsed_secs"],
     }
+    if "trace" in report:
+        summary["trace_out"] = report["trace"]["path"]
     if det["sync"] is not None:
         summary["sync"] = {
             "reached_head": det["sync"]["reached_head"],
@@ -660,6 +678,12 @@ def add_loadtest_args(parser) -> None:
                              "re-roots through (default: "
                              "LIGHTHOUSE_TPU_HASH_BACKEND or host; other "
                              "scenarios ignore it)")
+    parser.add_argument("--trace-out", default=None,
+                        help="multi-node/fleet scenarios: merge every "
+                             "node's span ring into ONE Perfetto trace "
+                             "file — per-node process groups, cross-node "
+                             "flow links from each publish span to its "
+                             "remote import spans")
 
 
 def drive_from_args(args) -> int:
@@ -673,4 +697,5 @@ def drive_from_args(args) -> int:
         datadir=args.datadir, mesh_devices=mesh_devices,
         bench_matrix=args.bench_matrix, bench_root=args.bench_root,
         hash_backend=getattr(args, "hash_backend", None),
+        trace_out=getattr(args, "trace_out", None),
     )

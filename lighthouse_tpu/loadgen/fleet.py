@@ -710,10 +710,13 @@ class FleetHarness(MultiNodeHarness):
 
 
 def run_fleet_scenario(sc, out_path: str | None = None, log_fn=None,
-                       datadir: str | None = None) -> dict:
+                       datadir: str | None = None,
+                       trace_out: str | None = None) -> dict:
     """Run one fleet scenario to completion; returns (and optionally
     writes) the machine-readable report. CPU-only (fake BLS over the
-    minimal spec); exit-code semantics live in loadgen/driver.py."""
+    minimal spec); exit-code semantics live in loadgen/driver.py. With
+    `trace_out`, the nodes' span rings merge into one Perfetto timeline
+    (per-node process groups + cross-node flow links)."""
     from ..crypto import bls
     from ..types.spec import minimal_spec
 
@@ -877,6 +880,14 @@ def run_fleet_scenario(sc, out_path: str | None = None, log_fn=None,
         failures.append("a scheduled node crash never fired")
     ok = not failures
 
+    # cluster rollup: the same deterministic block the multinode reports
+    # carry (observability/propagation.build_cluster_report)
+    from ..observability.propagation import build_cluster_report
+
+    cluster = build_cluster_report(
+        (n.index, n.slo, n.net.propagation) for n in mh.nodes
+    )
+
     deterministic = {
         "per_slot": mh.per_slot,
         "fleet_per_slot": mh.fleet_per_slot,
@@ -887,6 +898,7 @@ def run_fleet_scenario(sc, out_path: str | None = None, log_fn=None,
         "crashes": mh.fleet.crashes_fired,
         "netfault_events": inj.counts["events"],
         "convergence": convergence,
+        "cluster": cluster,
         "failures": failures,
         "ok": ok,
     }
@@ -928,6 +940,18 @@ def run_fleet_scenario(sc, out_path: str | None = None, log_fn=None,
         },
         "elapsed_secs": round(time.time() - t_wall, 3),
     }
+    if trace_out:
+        from ..observability.trace import merge_chrome_traces
+
+        n_events = merge_chrome_traces(
+            [(f"node{n.index}", n.tracer) for n in mh.nodes], trace_out,
+            instants=RECORDER.perfetto_instants(),
+        )
+        report["trace"] = {
+            "path": trace_out,
+            "events": n_events,
+            "processes": len(mh.nodes),
+        }
     if out_path:
         with open(out_path, "w") as f:
             json.dump(report, f, indent=1)

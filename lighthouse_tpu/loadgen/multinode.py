@@ -51,7 +51,9 @@ from ..crypto import bls
 from ..network import gossip as gs
 from ..network.node import NetworkNode
 from ..observability.flight_recorder import RECORDER
+from ..observability.propagation import build_cluster_report
 from ..observability.slo import SlotAccountant
+from ..observability.trace import Tracer, merge_chrome_traces
 from ..state_transition import accessors as acc
 from ..state_transition.slot import process_slots, types_for_slot
 from ..testing.harness import StateHarness, _sign, clone_state
@@ -85,9 +87,14 @@ class MultiNode:
                 op_pool=self.op_pool, types=types_for_slot(mh.spec, 1)
             )
             self.chain.slasher = self.slasher_svc
+        # private span sink: the cluster merge (`--trace-out`) renders
+        # each node's ring as its own Perfetto process group; the global
+        # TRACER belongs to a live bn process
+        self.tracer = Tracer(ring_size=1024)
         self.net = NetworkNode(
             self.chain,
             f"node{index}-{mh.seed & 0xFFFFFF:06x}",
+            tracer=self.tracer,
             # heartbeats are driven EXPLICITLY by the slot loop by default:
             # a wall-clock heartbeat thread would make mesh maintenance
             # (and so frame counts) depend on how long a slot took in real
@@ -110,6 +117,8 @@ class MultiNode:
         # belongs to a live bn process)
         self.slo = SlotAccountant(export_metrics=False)
         self.slo.bind_clock(self.chain.slot_clock)
+        # a propagation-stall incident should dump THIS node's windows
+        self.net.propagation.slo_provider = self.slo.snapshot
         if mh.batch_gossip:
             # the node's processor (and so its capacity scheduler's
             # control loop) accounts into THIS node's accountant, not the
@@ -382,6 +391,14 @@ class MultiNodeHarness:
         self._settle_processors()
         for n in self.nodes:
             n.slo.close_slot(slot)
+            # propagation-stall bookkeeping per node: a partitioned node
+            # keeps its TCP connections (the plan eats frames), so "peers
+            # connected but nothing delivered" is exactly the stall the
+            # trigger exists to catch; index order keeps incident seqs
+            # deterministic
+            n.net.propagation.close_slot(
+                slot, peers=len(n.net.host.connections)
+            )
         entry = {
             "slot": slot,
             "clusters": [sorted(x.index for x in c)
@@ -823,10 +840,14 @@ def _drive_catchup(mh: MultiNodeHarness, sc: MultiNodeScenario,
 
 
 def run_multinode_scenario(sc: MultiNodeScenario, out_path: str | None = None,
-                           log_fn=None, datadir: str | None = None) -> dict:
+                           log_fn=None, datadir: str | None = None,
+                           trace_out: str | None = None) -> dict:
     """Run one multi-node scenario to completion; returns (and optionally
     writes) the machine-readable report. CPU-only (fake BLS backend over
-    the minimal spec), seconds at smoke scale."""
+    the minimal spec), seconds at smoke scale. With `trace_out`, every
+    node's span ring merges into ONE Perfetto file — per-node process
+    groups, cross-node flow links from each publish span to its remote
+    import spans."""
     bls.set_backend("fake")
     spec = minimal_spec()
     t_wall = time.time()
@@ -982,6 +1003,14 @@ def run_multinode_scenario(sc: MultiNodeScenario, out_path: str | None = None,
             )
     ok = not failures
 
+    # -------- cluster rollup: deadline ratios + per-topic propagation
+    # distributions aggregated across every node's private accountant and
+    # tracker — logical clocks and integer counters only, so the block is
+    # bit-identical across reruns of the seed
+    cluster = build_cluster_report(
+        (n.index, n.slo, n.net.propagation) for n in mh.nodes
+    )
+
     deterministic = {
         "per_slot": mh.per_slot,
         "blocks": blocks,
@@ -992,6 +1021,7 @@ def run_multinode_scenario(sc: MultiNodeScenario, out_path: str | None = None,
         "convergence": convergence,
         "sync": sync_block,
         "equivocation": equiv_block,
+        "cluster": cluster,
         "failures": failures,
         "ok": ok,
     }
@@ -1035,6 +1065,21 @@ def run_multinode_scenario(sc: MultiNodeScenario, out_path: str | None = None,
         },
         "elapsed_secs": round(time.time() - t_wall, 3),
     }
+    if trace_out:
+        # one merged Perfetto timeline: node index -> process group,
+        # publish->import flow links across groups, the (process-global,
+        # so cluster-wide) flight-recorder events as an instant lane
+        # (wall timestamps: observations, outside the determinism
+        # contract)
+        n_events = merge_chrome_traces(
+            [(f"node{n.index}", n.tracer) for n in mh.nodes], trace_out,
+            instants=RECORDER.perfetto_instants(),
+        )
+        report["trace"] = {
+            "path": trace_out,
+            "events": n_events,
+            "processes": len(mh.nodes),
+        }
     if out_path:
         with open(out_path, "w") as f:
             json.dump(report, f, indent=1)

@@ -86,6 +86,10 @@ class GossipMessage:
     payload: bytes            # snappy-compressed SSZ
     message_id: bytes
     source_peer: str
+    # wire-propagated origin context (observability/propagation.py
+    # WireTraceContext), when the frame envelope carried one — handlers
+    # adopt it into their local Trace for the cross-node causal join
+    ctx: object = None
 
 
 def ingest_scope(topic: str) -> str:

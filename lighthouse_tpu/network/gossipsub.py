@@ -35,8 +35,55 @@ import time
 from collections import defaultdict
 from dataclasses import dataclass, field
 
+from ..observability.propagation import (
+    decode_ctx,
+    encode_ctx,
+    quantile,
+    short_topic,
+)
+from ..utils.metrics import REGISTRY
 from . import snappy
 from .gossip import GOSSIP_MAX_SIZE, GossipMessage, message_id
+
+# mesh-health families (gossipsub_scoring_parameters.rs observability gap:
+# duplicates, mesh membership, rejects and peer scores existed as instance
+# ints and were invisible to every scrape). Topic labels are SHORT names
+# (subnet index collapsed — see propagation.short_topic) so cardinality is
+# bounded and stable across fork digests. Gauges are refreshed at
+# heartbeat; counters ride the message hot path (one labels() dict hit).
+GS_MESH_PEERS = REGISTRY.gauge_vec(
+    "gossipsub_mesh_peers",
+    "current mesh membership per subscribed topic (heartbeat-sampled)",
+    ("topic",),
+)
+GS_DELIVERED = REGISTRY.counter_vec(
+    "gossipsub_delivered_total",
+    "gossip messages accepted by validation (first deliveries), by topic",
+    ("topic",),
+)
+GS_DUPLICATES = REGISTRY.counter_vec(
+    "gossipsub_duplicates_total",
+    "duplicate gossip deliveries (already-seen message ids; mesh echoes "
+    "of this node's OWN publishes excluded), by topic",
+    ("topic",),
+)
+GS_REJECTS = REGISTRY.counter_vec(
+    "gossipsub_rejects_total",
+    "gossip messages rejected by validation (sender penalized), by topic",
+    ("topic",),
+)
+GS_DUP_RATIO = REGISTRY.gauge_vec(
+    "gossipsub_duplicate_ratio",
+    "duplicates / (first deliveries + duplicates) per topic "
+    "(heartbeat-sampled; the mesh-amplification health signal)",
+    ("topic",),
+)
+GS_SCORE = REGISTRY.gauge_vec(
+    "gossipsub_peer_score",
+    "peer-score distribution over connected peers (heartbeat-sampled), "
+    "by quantile",
+    ("quantile",),
+)
 
 D = 6           # target mesh degree (gossipsub D)
 D_LOW = 4
@@ -87,6 +134,11 @@ class Rpc:
     # prune entries: topic str, or (topic, [(peer_id, host, port)]) with
     # PX peer-exchange candidates (gossipsub v1.1 PRUNE.peers)
     prune: list = field(default_factory=list)
+    # wire trace contexts: (msgs index, encoded WireTraceContext bytes).
+    # Encoded as a TRAILING section so pre-context decoders (which stop
+    # after prune) and pre-context frames (which simply end there) stay
+    # wire-compatible in both directions.
+    ctx: list = field(default_factory=list)
 
     def empty(self) -> bool:
         return not (self.subs or self.msgs or self.ihave or self.iwant or self.graft or self.prune)
@@ -131,6 +183,10 @@ def encode_rpc(rpc: Rpc) -> bytes:
                 + struct.pack(">H", len(host_b)) + host_b
                 + struct.pack(">H", port)
             )
+    if rpc.ctx:
+        out.append(struct.pack(">H", len(rpc.ctx)))
+        for idx, cbytes in rpc.ctx:
+            out.append(struct.pack(">HH", idx, len(cbytes)) + cbytes)
     return b"".join(out)
 
 
@@ -194,6 +250,14 @@ def decode_rpc(buf: bytes) -> Rpc:
             pos += 2
             px.append((pid, host, port))
         rpc.prune.append((topic, px))
+    if pos < len(buf):      # optional trailing trace-context section
+        (n,) = struct.unpack_from(">H", buf, pos)
+        pos += 2
+        for _ in range(n):
+            idx, clen = struct.unpack_from(">HH", buf, pos)
+            pos += 4
+            rpc.ctx.append((idx, buf[pos : pos + clen]))
+            pos += clen
     return rpc
 
 
@@ -254,12 +318,39 @@ class Gossipsub:
 
     def __init__(self, local_id: str, send, peer_manager=None, rng=None,
                  score_params=None, thresholds=None, addr_provider=None,
-                 px_handler=None, flood_publish: bool = True):
+                 px_handler=None, flood_publish: bool = True,
+                 ctx_factory=None, propagation=None):
         from .peer_score import PeerScore, PeerScoreThresholds
 
         self.local_id = local_id
         self._send_raw = send
         self.peer_manager = peer_manager
+        # cross-node causality (observability/propagation.py):
+        # ctx_factory(topic) -> WireTraceContext|None builds the origin
+        # context for publishes that didn't pass one explicitly;
+        # `propagation` (a PropagationTracker) is fed every publish and
+        # every FIRST delivery (with its decoded context, when the frame
+        # carried one)
+        self.ctx_factory = ctx_factory
+        self.propagation = propagation
+        # mid -> encoded context bytes: re-attached when the message is
+        # forwarded to the mesh or served over IWANT, so multi-hop
+        # propagation keeps the ORIGIN's context. Expired with the seen
+        # cache (+ hard bound) at heartbeat.
+        self._msg_ctx: dict[bytes, bytes] = {}
+        # per-topic FIRST deliveries and duplicates (pre-validation,
+        # per INSTANCE): the duplicate-ratio inputs — GS_DELIVERED counts
+        # only validation-ACCEPTED messages (on topics where many first
+        # deliveries end as terminal IGNOREs that denominator would
+        # overstate mesh amplification), and the global counters mix every
+        # in-process instance
+        self._first_deliveries: dict[str, int] = {}
+        self._dup_counts: dict[str, int] = {}
+        # mids this node PUBLISHED: mesh echoes of our own messages come
+        # back as already-seen, but they are not redundant deliveries of
+        # anything we needed — counting them would read ~1.0 duplicate
+        # ratio on a healthy proposer (expired with the seen cache)
+        self._own_mids: set[bytes] = set()
         # PX peer exchange (v1.1 PRUNE.peers): addr_provider(peer_id) ->
         # (host, port)|None supplies dialable addresses for candidates we
         # attach to our PRUNEs; px_handler(topic, [(pid, host, port)])
@@ -374,16 +465,22 @@ class Gossipsub:
 
     # ------------------------------------------------------------ publish
 
-    def publish(self, topic: str, ssz_payload: bytes) -> int:
+    def publish(self, topic: str, ssz_payload: bytes, ctx=None) -> int:
         data = snappy.compress(ssz_payload)
         if len(data) > GOSSIP_MAX_SIZE:
             raise ValueError("gossip message too large")
         mid = message_id(topic, data)
+        if ctx is None and self.ctx_factory is not None:
+            ctx = self.ctx_factory(topic)
+        cbytes = encode_ctx(ctx) if ctx is not None else None
         with self._lock:
             if mid in self.seen:
                 return 0
             self.seen[mid] = time.monotonic()
+            self._own_mids.add(mid)
             self.mcache.put(mid, topic, data)
+            if cbytes is not None:
+                self._msg_ctx[mid] = cbytes
             targets = set(self.mesh.get(topic, ()))
             if self.flood_publish or len(targets) < D_LOW:
                 # v1.1 flood publish (always for own messages by default,
@@ -395,7 +492,10 @@ class Gossipsub:
                     and self.peer_score.score(p) >= self.thresholds.publish_threshold
                 }
             for p in targets:
-                self._send(p, Rpc(msgs=[(topic, data)]))
+                self._send(p, Rpc(msgs=[(topic, data)],
+                                  ctx=[(0, cbytes)] if cbytes else []))
+        if ctx is not None and self.propagation is not None:
+            self.propagation.note_publish(topic)
         return len(targets)
 
     # ------------------------------------------------------------ inbound
@@ -462,11 +562,17 @@ class Gossipsub:
                             break
                         got = self.mcache.get(mid)
                         if got is not None:
+                            cbytes = self._msg_ctx.get(mid)
+                            if cbytes is not None:
+                                # IWANT recovery keeps the ORIGIN context
+                                reply.ctx.append((len(reply.msgs), cbytes))
                             reply.msgs.append(got)
                             served += 1
             self._send(peer_id, reply)
-        for topic, data in rpc.msgs:
-            self._on_message(peer_id, topic, data)
+        ctx_by_idx = dict(rpc.ctx)
+        for i, (topic, data) in enumerate(rpc.msgs):
+            self._on_message(peer_id, topic, data,
+                             ctx_bytes=ctx_by_idx.get(i))
 
     def _prune_entry(self, topic: str, exclude: str):
         """PRUNE payload for `topic`: up to PX_PEERS mesh members (with
@@ -499,12 +605,17 @@ class Gossipsub:
             return
         self._mesh_add(topic, peer_id)
 
-    def _on_message(self, peer_id: str, topic: str, data: bytes) -> None:
+    def _on_message(self, peer_id: str, topic: str, data: bytes,
+                    ctx_bytes: bytes | None = None) -> None:
         mid = message_id(topic, data)
         now = time.monotonic()
         with self._lock:
             if mid in self.seen:
                 self.duplicates += 1
+                if mid not in self._own_mids:
+                    st = short_topic(topic)
+                    self._dup_counts[st] = self._dup_counts.get(st, 0) + 1
+                    GS_DUPLICATES.labels(st).inc()
                 if mid in self._rejected_mids:
                     # replaying a known-invalid message is itself invalid
                     # (peer_score.rs duplicate of a Rejected record)
@@ -529,12 +640,30 @@ class Gossipsub:
             # the message arrived: every outstanding IWANT promise for it is
             # fulfilled, whoever delivered first
             self._promises.pop(mid, None)
+            if ctx_bytes is not None:
+                self._msg_ctx[mid] = ctx_bytes   # forwarded hops keep it
+            # an IGNORE_RETRY redelivery re-enters this first-delivery
+            # path by design (the mid was popped from `seen`) — but it is
+            # NOT a new first delivery for the propagation SLI: feeding it
+            # again would double-count and sample the retry gap as latency
+            retried = mid in self._ignore_retries
+            if not retried:
+                st = short_topic(topic)
+                self._first_deliveries[st] = (
+                    self._first_deliveries.get(st, 0) + 1
+                )
             # pre-register the deferred-validation slot BEFORE the handler
             # runs: a handler that queues into the batch pipeline can be
             # resolved by a pump thread before it even returns (the
             # prepare-dropped path reports synchronously) — registering
             # after the fact would strand the entry until PENDING_TTL
             self._pending_validation[mid] = (topic, data, now)
+        # first delivery: the propagation SLI observes origin -> here
+        # latency (or counts a context-less delivery), and re-arms the
+        # stall trigger — BEFORE validation, which is a local concern
+        ctx = decode_ctx(ctx_bytes)
+        if self.propagation is not None and not retried:
+            self.propagation.note_delivery(topic, ctx)
         handler = self.handlers.get(topic)
         accept = True
         if handler is not None:
@@ -544,7 +673,7 @@ class Gossipsub:
                 accept = False
                 payload = b""
             if accept:
-                msg = GossipMessage(topic, data, mid, peer_id)
+                msg = GossipMessage(topic, data, mid, peer_id, ctx=ctx)
                 msg.decompressed = payload
                 try:
                     accept = handler(msg)
@@ -585,15 +714,18 @@ class Gossipsub:
                 self.rejected += 1
                 self._rejected_mids.add(mid)
                 self.peer_score.reject_message(peer_id, topic)
+            GS_REJECTS.labels(short_topic(topic)).inc()
             self._report_negative(peer_id, severe=True)
             return
         with self._lock:
             self.delivered += 1
             self.peer_score.deliver_message(peer_id, topic)
             self.mcache.put(mid, topic, data)
+            fwd_ctx = [(0, ctx_bytes)] if ctx_bytes is not None else []
             # forward to mesh peers (not the sender)
             for p in self.mesh.get(topic, set()) - {peer_id}:
-                self._send(p, Rpc(msgs=[(topic, data)]))
+                self._send(p, Rpc(msgs=[(topic, data)], ctx=fwd_ctx))
+        GS_DELIVERED.labels(short_topic(topic)).inc()
 
     def report_validation_result(self, mid: bytes, accept) -> None:
         """Resolve a PENDING validation (the async counterpart of the
@@ -612,12 +744,16 @@ class Gossipsub:
                 if senders:
                     self.peer_score.deliver_message(senders[0], topic)
                 self.mcache.put(mid, topic, data)
+                cbytes = self._msg_ctx.get(mid)
+                fwd_ctx = [(0, cbytes)] if cbytes is not None else []
                 for p in self.mesh.get(topic, set()) - set(senders):
-                    self._send(p, Rpc(msgs=[(topic, data)]))
+                    self._send(p, Rpc(msgs=[(topic, data)], ctx=fwd_ctx))
+                GS_DELIVERED.labels(short_topic(topic)).inc()
                 return
             if accept is False:
                 self.rejected += 1
                 self._rejected_mids.add(mid)
+                GS_REJECTS.labels(short_topic(topic)).inc()
                 for p in senders:
                     self.peer_score.reject_message(p, topic)
         if accept is False:
@@ -657,10 +793,16 @@ class Gossipsub:
                     self._rejected_mids.discard(mid)
                     self._ignore_retries.pop(mid, None)
                     self._pending_validation.pop(mid, None)
+                    self._msg_ctx.pop(mid, None)
+                    self._own_mids.discard(mid)
             # retry counters for mids no longer deduped die with the mesh
             # churn; hard-bound the map so it cannot grow without limit
             while len(self._ignore_retries) > 4096:
                 self._ignore_retries.pop(next(iter(self._ignore_retries)))
+            while len(self._msg_ctx) > 4096:
+                self._msg_ctx.pop(next(iter(self._msg_ctx)))
+            while len(self._own_mids) > 4096:
+                self._own_mids.pop()
             for topic in list(self.subscriptions):
                 mesh = self.mesh[topic]
                 for p in mesh - self.peers:  # drop vanished peers
@@ -728,3 +870,31 @@ class Gossipsub:
                     for p in lazy[:n_gossip]:
                         self._send(p, Rpc(ihave=[(topic, ids[:128])]))
             self.mcache.shift()
+            self._export_mesh_health()
+
+    def _export_mesh_health(self) -> None:
+        """Heartbeat-sampled gossipsub_* gauge refresh (lock held): mesh
+        membership and duplicate ratio per topic, peer-score quantiles
+        over every connected peer. Counters (delivered / duplicates /
+        rejects) ride the message paths; these gauges are the cheap
+        summary view a scrape reads between messages."""
+        mesh_sizes: dict[str, int] = {}    # short topic -> summed mesh size
+        for topic in self.subscriptions:
+            st = short_topic(topic)
+            mesh_sizes[st] = mesh_sizes.get(st, 0) + len(
+                self.mesh.get(topic, ())
+            )
+        for st, mesh_n in mesh_sizes.items():
+            GS_MESH_PEERS.labels(st).set(mesh_n)
+            # THIS instance's pre-validation counts (terminal IGNOREs
+            # included): acceptance is a local concern, mesh
+            # amplification is not — and the global counters mix every
+            # in-process instance
+            firsts = self._first_deliveries.get(st, 0)
+            dups = self._dup_counts.get(st, 0)
+            total = firsts + dups
+            GS_DUP_RATIO.labels(st).set(dups / total if total else 0.0)
+        if self.peers:
+            scores = sorted(self.peer_score.score(p) for p in self.peers)
+            for q, name in ((0.1, "p10"), (0.5, "p50"), (0.9, "p90")):
+                GS_SCORE.labels(name).set(quantile(scores, q))

@@ -8,8 +8,10 @@ gossip topics into the beacon chain's verification pipelines
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
+from time import perf_counter
 
 from ..chain.beacon_chain import AttestationError, BlockError
 from ..chain.data_availability import (
@@ -17,6 +19,12 @@ from ..chain.data_availability import (
     BlobError,
     BlobIgnoreError,
 )
+from ..observability.propagation import (
+    PropagationTracker,
+    WireTraceContext,
+    short_topic,
+)
+from ..observability.trace import TRACER, next_trace_id
 from ..state_transition.slot import types_for_slot
 from ..utils.logging import get_logger
 from ..utils.metrics import REGISTRY
@@ -40,6 +48,18 @@ _HEARTBEAT_ERRORS = REGISTRY.counter_vec(
     ("stage",),
 )
 
+# Gossip/dial path failures survived in place (the surrounding iteration
+# continues): PX/discovery dials that raced a vanished peer, sidecar
+# retries whose dependency import failed. Previously bare
+# `except Exception: continue` — now each is a counted, logged event
+# (the PR 9 sync_errors_total treatment).
+_GOSSIP_ERRORS = REGISTRY.counter_vec(
+    "node_gossip_errors_total",
+    "gossip/dial path failures survived in place (iteration continues), "
+    "by stage",
+    ("stage",),
+)
+
 
 class NetworkNode:
     def __init__(
@@ -60,10 +80,21 @@ class NetworkNode:
         processor_config=None,
         ingest_rate: float | None = None,
         rpc_timeout: float | None = None,
+        tracer=None,
     ):
         self.chain = chain
         chain._network_node = self          # identity/peers API surface
         self.node_id = node_id
+        # span sink for publish/consume traces: the process-global TRACER
+        # on a live node; the multinode harness hands each node a PRIVATE
+        # Tracer so the cluster merge can render per-node process groups
+        self.tracer = tracer if tracer is not None else TRACER
+        # cross-node propagation SLIs, clocked on the chain's slot clock
+        # (logical under ManualSlotClock -> seed-deterministic harness
+        # distributions; wall time live)
+        self.propagation = PropagationTracker(node_id,
+                                              clock=chain.slot_clock)
+        self._pub_seq = itertools.count()   # logical publish offset
         self.trusted_addrs = trusted_addrs or set()
         self.fork_digest = fork_digest
         # Gossip attestations/aggregates route through the beacon
@@ -149,6 +180,10 @@ class NetworkNode:
             addr_provider=self._peer_dial_addr,
             px_handler=self._on_px,
             score_params=score_params,
+            # every publish without an explicit context gets one minted
+            # here; every first delivery feeds the propagation SLIs
+            ctx_factory=self._make_ctx,
+            propagation=self.propagation,
         )
         # transport consults this: when True, plaintext-HELLO peers are
         # rejected instead of served unencrypted
@@ -308,7 +343,11 @@ class NetworkNode:
                 for host, port in fresh:
                     try:
                         self.host.dial(host, port)
-                    except Exception:
+                    except Exception as e:  # noqa: BLE001 — one dead PX
+                        _GOSSIP_ERRORS.labels("px_dial").inc()  # candidate
+                        log.warn("PX dial failed; trying next candidate",
+                                 node=self.node_id, peer=f"{host}:{port}",
+                                 error=f"{type(e).__name__}: {e}")
                         continue
             finally:
                 with self._px_lock:
@@ -345,7 +384,11 @@ class NetworkNode:
             try:
                 self.host.dial(rec.ip, rec.tcp_port)
                 dialed += 1
-            except Exception:
+            except Exception as e:  # noqa: BLE001 — stale table entry
+                _GOSSIP_ERRORS.labels("discovery_dial").inc()
+                log.warn("discovery dial failed; trying next record",
+                         node=self.node_id, peer=f"{rec.ip}:{rec.tcp_port}",
+                         error=f"{type(e).__name__}: {e}")
                 continue
         return dialed
 
@@ -384,7 +427,11 @@ class NetworkNode:
     # ------------------------------------------------------------ handlers
 
     def _on_block(self, msg) -> bool:
-        """process_gossip_block analog: verify -> propagate -> import."""
+        """process_gossip_block analog: verify -> propagate -> import.
+        Runs under a consumer-side trace that ADOPTS the block's wire
+        context (when the frame carried one), so this node's validate and
+        import spans share the producer's causal id — the remote half of
+        the cross-node timeline."""
         spec = self.chain.spec
         # decode with the right fork types: peek the slot (first 8 bytes of
         # the message body after the 96-byte signature container layout is
@@ -395,10 +442,30 @@ class NetworkNode:
             signed = types.SignedBeaconBlock.deserialize(payload)
         except Exception:
             return False
+        from ..observability.trace import set_current_trace
+
+        tr = self.tracer.begin("gossip_block")
+        ctx = getattr(msg, "ctx", None)
+        if ctx is not None:
+            tr.adopt(ctx)
+        # bind as the thread's current trace so a parent-lookup RPC fired
+        # from inside this import (request_ctx -> current_trace) joins the
+        # import's causal chain instead of minting a disconnected id
+        set_current_trace(tr)
+        try:
+            return self._import_gossip_block(msg, signed, tr, ctx)
+        finally:
+            set_current_trace(None)
+            self.tracer.finish(tr)
+
+    def _import_gossip_block(self, msg, signed, tr, ctx) -> bool:
         with self._lock:
+            t0 = perf_counter()
             try:
                 root = self.chain.verify_block_for_gossip(signed)
             except BlockError as e:
+                tr.add_span("validate", t0, perf_counter(),
+                            outcome="rejected")
                 if "already known" in str(e):
                     return False
                 if "parent unknown" in str(e):
@@ -406,6 +473,8 @@ class NetworkNode:
                     self._lookup_parent(msg.source_peer, signed)
                     return False
                 return False
+            t1 = perf_counter()
+            tr.add_span("validate", t0, t1)
             try:
                 self.chain.process_block(
                     signed, block_root=root, proposal_already_verified=True
@@ -413,9 +482,17 @@ class NetworkNode:
             except AvailabilityPendingError:
                 # block is NOT in the store yet — child sidecars still can't
                 # verify, so no pending retry here (it would drop them)
+                tr.add_span("import", t1, perf_counter(),
+                            outcome="availability_pending")
                 return True          # propagate; blobs will complete it
             except BlockError:
+                tr.add_span("import", t1, perf_counter(), outcome="rejected")
                 return False
+            tr.add_span("import", t1, perf_counter())
+            if ctx is not None and self.chain.head_root == root:
+                # time-to-head SLI: origin publish -> this node's
+                # fork-choice head update
+                self.propagation.note_time_to_head(ctx)
             self._retry_pending_sidecars(root)
         return True
 
@@ -465,7 +542,11 @@ class NetworkNode:
             except BlobIgnoreError as e:
                 if e.retriable and e.missing_parent is not None:
                     self._stash_pending_sidecar(e.missing_parent, sc)
-            except Exception:
+            except Exception as e:  # noqa: BLE001 — one bad sidecar must
+                _GOSSIP_ERRORS.labels("sidecar_retry").inc()  # not block
+                log.warn("pending-sidecar retry failed; dropping it",
+                         node=self.node_id, index=int(sc.index),
+                         error=f"{type(e).__name__}: {e}")
                 continue
 
     def _drain_early_sidecars(self) -> None:
@@ -484,7 +565,13 @@ class NetworkNode:
                     except BlobIgnoreError as e:
                         if e.retriable and e.missing_parent is not None:
                             self._stash_pending_sidecar(e.missing_parent, sc)
-                    except Exception:
+                    except Exception as e:  # noqa: BLE001 — one bad early
+                        _GOSSIP_ERRORS.labels("sidecar_drain").inc()
+                        log.warn(              # sidecar must not block due
+                            "early-sidecar revalidation failed; dropping it",
+                            node=self.node_id, index=int(sc.index),
+                            error=f"{type(e).__name__}: {e}",
+                        )
                         continue
 
     def _lookup_parent(self, peer_id: str, signed) -> None:
@@ -730,23 +817,67 @@ class NetworkNode:
 
     # ------------------------------------------------------------ publishing
 
+    def _make_ctx(self, _topic: str, trace_id: int | None = None
+                  ) -> WireTraceContext:
+        """Mint the compact origin context a publish (or Req/Resp request)
+        carries on the wire: this node's id, a causal trace id, the slot,
+        the logical publish offset, and the slot clock's raw time (logical
+        under a ManualSlotClock, wall time live)."""
+        clock = self.chain.slot_clock
+        return WireTraceContext(
+            origin=self.node_id,
+            trace_id=trace_id if trace_id is not None else next_trace_id(),
+            slot=int(clock.now() or 0),
+            seq=next(self._pub_seq),
+            sent_at=self.propagation.now(),
+        )
+
+    def request_ctx(self) -> WireTraceContext:
+        """Origin context for outbound Req/Resp requests (transport CREQ
+        frames). Reuses the in-flight trace's id when one is current, so a
+        parent-lookup RPC fired from inside a block import joins that
+        import's causal chain."""
+        from ..observability.trace import current_trace
+
+        tr = current_trace()
+        return self._make_ctx(
+            "", trace_id=tr.trace_id if tr is not None else None
+        )
+
+    def _publish(self, topic: str, ssz_payload: bytes) -> None:
+        """Publish with a producer-side trace: one `publish` span whose
+        wire context every remote validate/import span will adopt — the
+        cross-node causal anchor the merged timeline's flow events key on."""
+        tr = self.tracer.begin("gossip_publish")
+        ctx = self._make_ctx(topic, trace_id=tr.trace_id)
+        tr.adopt(ctx)
+        t0 = perf_counter()
+        try:
+            self.gossipsub.publish(topic, ssz_payload, ctx=ctx)
+        finally:
+            # the trace lands (and feeds the stage histogram) even when
+            # publish raises (oversized message) — the span still closed
+            tr.add_span("publish", t0, perf_counter(),
+                        topic=short_topic(topic))
+            self.tracer.finish(tr)
+
     def publish_block(self, signed_block) -> None:
         types = types_for_slot(self.chain.spec, signed_block.message.slot)
-        self.gossipsub.publish(
+        self._publish(
             gs.topic_name(self.fork_digest, "beacon_block"),
             types.SignedBeaconBlock.serialize(signed_block),
         )
 
     def publish_attestation(self, att, subnet_id: int) -> None:
         types = types_for_slot(self.chain.spec, att.data.slot)
-        self.gossipsub.publish(
+        self._publish(
             gs.attestation_subnet_topic(self.fork_digest, subnet_id),
             types.Attestation.serialize(att),
         )
 
     def publish_aggregate(self, signed_agg) -> None:
         types = types_for_slot(self.chain.spec, signed_agg.message.aggregate.data.slot)
-        self.gossipsub.publish(
+        self._publish(
             gs.topic_name(self.fork_digest, "beacon_aggregate_and_proof"),
             types.SignedAggregateAndProof.serialize(signed_agg),
         )
@@ -755,7 +886,7 @@ class NetworkNode:
         types = types_for_slot(
             self.chain.spec, sidecar.signed_block_header.message.slot
         )
-        self.gossipsub.publish(
+        self._publish(
             gs.blob_sidecar_topic(self.fork_digest, int(sidecar.index)),
             types.BlobSidecar.serialize(sidecar),
         )

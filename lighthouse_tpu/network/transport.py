@@ -18,6 +18,16 @@ Frame format (big-endian): [u8 type][u32 length][payload]
   EHELLO     6: HELLO payload plus a 32-byte X25519 ephemeral pubkey; when
                 BOTH sides send EHELLO every later frame travels inside ENC
   ENC        7: AES-256-GCM(nonce = dir counter, inner frame bytes)
+  CREQ       8: REQ with a leading wire trace context —
+                [u16 ctx_len][WireTraceContext][REQ payload] — so Req/Resp
+                requests carry the caller's origin context; the serving
+                side adopts it (observability/propagation.py) and its
+                spans join the caller's causal chain. NOTE: this transport
+                has no version negotiation (HELLO carries no version), so
+                a new frame type assumes same-binary peers — the property
+                every prior frame addition (EHELLO/ENC) relied on; a host
+                that must serve pre-CREQ peers can clear
+                `Connection.ctx_provider` to fall back to plain REQ
 
 Encryption (the libp2p-noise role in the reference's tcp+noise stack):
 each side sends an ephemeral X25519 key in EHELLO; the shared secret
@@ -46,7 +56,7 @@ from ..utils.logging import get_logger
 
 log = get_logger("transport")
 
-HELLO, REQ, RESP_CHUNK, RESP_END, GOSSIP, CLOSE, EHELLO, ENC = range(8)
+HELLO, REQ, RESP_CHUNK, RESP_END, GOSSIP, CLOSE, EHELLO, ENC, CREQ = range(9)
 
 _CRYPTO_AVAILABLE: bool | None = None
 
@@ -121,6 +131,10 @@ class Connection:
         # advertises in HELLO (the ephemeral source port is useless for
         # dialing back) — feeds gossipsub PX peer exchange
         self.peer_dial_addr: tuple[str, int] | None = None
+        # optional () -> WireTraceContext|None: when set (TcpHost wires the
+        # owning node's request_ctx), outbound requests ride CREQ frames
+        # carrying the caller's origin context
+        self.ctx_provider = None
         self._send_lock = threading.Lock()
         self._streams: dict[int, queue.Queue] = {}
         self._next_stream = 1
@@ -234,10 +248,17 @@ class Connection:
             q: queue.Queue = queue.Queue()
             self._streams[sid] = q
         proto = protocol.encode()
-        self._send(
-            REQ,
-            struct.pack(">QH", sid, len(proto)) + proto + request_bytes,
-        )
+        req_payload = struct.pack(">QH", sid, len(proto)) + proto + request_bytes
+        ctx = self.ctx_provider() if self.ctx_provider is not None else None
+        if ctx is not None:
+            from ..observability.propagation import NET_CTX, encode_ctx
+
+            cbytes = encode_ctx(ctx)
+            NET_CTX.labels("req_sent").inc()
+            self._send(CREQ, struct.pack(">H", len(cbytes)) + cbytes
+                       + req_payload)
+        else:
+            self._send(REQ, req_payload)
         chunks = []
         deadline = time.monotonic() + timeout
         try:
@@ -320,12 +341,26 @@ class Connection:
                             pass
                     self.peer_id = pid
                     self.node._register_connection(self)
-                elif ftype == REQ:
-                    sid, plen = struct.unpack(">QH", payload[:10])
-                    protocol = payload[10 : 10 + plen].decode()
+                elif ftype in (REQ, CREQ):
+                    try:
+                        ctx_bytes = None
+                        if ftype == CREQ:
+                            clen = struct.unpack(">H", payload[:2])[0]
+                            ctx_bytes = payload[2 : 2 + clen]
+                            payload = payload[2 + clen :]
+                        sid, plen = struct.unpack(">QH", payload[:10])
+                        protocol = payload[10 : 10 + plen].decode()
+                    except (struct.error, UnicodeDecodeError) as e:
+                        # malformed request frame: close via the reader's
+                        # clean error path, not an unhandled thread
+                        # traceback (the HELLO branch's discipline)
+                        raise TransportError(
+                            f"malformed request frame: {e}"
+                        ) from e
                     req = payload[10 + plen :]
                     threading.Thread(
-                        target=self._serve, args=(sid, protocol, req), daemon=True
+                        target=self._serve, args=(sid, protocol, req,
+                                                  ctx_bytes), daemon=True
                     ).start()
                 elif ftype == RESP_CHUNK:
                     sid = struct.unpack(">Q", payload[:8])[0]
@@ -375,11 +410,39 @@ class Connection:
         (unfinished_tasks covers the queued-to-done window atomically)."""
         return self._gossip_q.unfinished_tasks == 0
 
-    def _serve(self, sid: int, protocol: str, req: bytes) -> None:
+    def _serve(self, sid: int, protocol: str, req: bytes,
+               ctx_bytes: bytes | None = None) -> None:
+        tr = tracer = None
+        if ctx_bytes is not None:
+            # adopt the caller's wire context: the serve itself becomes a
+            # traced span under the caller's causal id (the remote half of
+            # a parent-lookup chain in the merged timeline), and the
+            # thread-local is bound so any deeper Trace the handler opens
+            # can join too
+            from ..observability.propagation import (
+                NET_CTX,
+                decode_ctx,
+                set_current_wire_ctx,
+            )
+
+            ctx = decode_ctx(ctx_bytes)
+            if ctx is not None:
+                set_current_wire_ctx(ctx)
+                NET_CTX.labels("req_adopted").inc()
+                tracer = getattr(self.node, "tracer", None)
+                if tracer is not None:
+                    tr = tracer.begin("rpc_serve")
+                    tr.adopt(ctx)
+        t0 = time.perf_counter()
         try:
             chunks = self.node._serve_rpc(self.peer_id, protocol, req)
         except Exception:
             chunks = []
+        finally:
+            if tr is not None:
+                tr.add_span("serve", t0, time.perf_counter(),
+                            protocol=protocol)
+                tracer.finish(tr)
         try:
             for c in chunks:
                 self._send(RESP_CHUNK, struct.pack(">Q", sid) + c)
@@ -468,6 +531,9 @@ class TcpHost:
         conn = Connection(sock, self.local_id, self.node,
                           encrypt=self.encrypt, dialer=dialer,
                           rpc_timeout=self.rpc_timeout)
+        # Req/Resp requests carry the node's origin context when it
+        # provides one (NetworkNode.request_ctx)
+        conn.ctx_provider = getattr(self.node, "request_ctx", None)
         # HELLO must hit the wire BEFORE the reader starts: processing the
         # remote HELLO triggers registration, whose subscription announce
         # would otherwise overtake our own HELLO — the remote then drops

@@ -27,6 +27,11 @@ the system's hot path; this package makes it legible from the outside:
     supervisor restarts, route flips, WARN+ log records) with incident
     triggers that dump diagnosis snapshots to `datadir/incidents/` and
     render as instant markers in the Perfetto export.
+  - `propagation`: cross-node causality — the wire trace context every
+    gossip publish / Req-Resp request carries, per-node propagation SLIs
+    (`net_propagation_seconds{topic}`, time-to-head), the
+    propagation-stall incident trigger, and the deterministic cluster
+    rollup the multinode/fleet reports embed.
   - `debug_bundle`: `bn debug-bundle` — one tarball of everything above
     plus `bn doctor` output and bench metadata, for offline diagnosis.
 
@@ -47,5 +52,6 @@ from .trace import (  # noqa: F401
 from .pipeline import register_processor, snapshot  # noqa: F401
 from . import device, perf  # noqa: F401  (registers the device/xla families)
 from . import flight_recorder, slo  # noqa: F401  (registers slo_*/flight_recorder_* families + the log sink)
+from . import propagation  # noqa: F401  (registers the net_* families)
 from .flight_recorder import RECORDER  # noqa: F401
 from .slo import ACCOUNTANT  # noqa: F401

@@ -16,6 +16,9 @@ everything a diagnosis session starts from:
   - `autotune_profile.json`  the installed autotune profile (when any)
   - `bench.json`         BENCH_MATRIX.json + the perf trend summary
                          (when the install's repo root carries them)
+  - `cluster_report.json`  the newest loadgen report's cluster rollup
+                         (cluster deadline-hit ratio, per-node outliers,
+                         per-topic propagation p50/p95), when one exists
 
 Every member is independent: a half-initialized process (or a datadir-less
 invocation) still produces a useful bundle, and the manifest says exactly
@@ -96,6 +99,32 @@ def _collect_autotune() -> dict:
     return prof.to_json()
 
 
+def _collect_cluster(root: str) -> dict:
+    """Latest cluster rollup (the `cluster` block a multinode/fleet
+    loadtest report carries: cluster deadline-hit ratio, per-node
+    outliers, per-topic propagation p50/p95): read from the newest
+    loadgen report at the install root."""
+    candidates = [
+        os.path.join(root, name)
+        for name in ("loadgen_report.json", "LOADGEN_SMOKE.json")
+        if os.path.exists(os.path.join(root, name))
+    ]
+    for path in sorted(candidates, key=os.path.getmtime, reverse=True):
+        with open(path) as f:
+            rep = json.load(f)
+        cluster = (rep.get("deterministic") or {}).get("cluster")
+        if cluster is not None:
+            return {
+                "source": os.path.basename(path),
+                "scenario": rep.get("scenario"),
+                "seed": rep.get("seed"),
+                "cluster": cluster,
+            }
+    raise FileNotFoundError(
+        "no loadgen report with a cluster block at install root"
+    )
+
+
 def _collect_bench(root: str) -> dict:
     out: dict = {}
     matrix = os.path.join(root, "BENCH_MATRIX.json")
@@ -147,6 +176,7 @@ def build_bundle(out_path: str, datadir: str | None = None,
     add_json("logs.json", _collect_logs)
     add_json("autotune_profile.json", _collect_autotune)
     add_json("bench.json", lambda: _collect_bench(root))
+    add_json("cluster_report.json", lambda: _collect_cluster(root))
 
     incidents: list[str] = []
     if datadir:

@@ -557,6 +557,16 @@ class SlotAccountant:
         with self._lock:
             return self._window_summary_locked(name)
 
+    def deadline_totals(self) -> tuple[int, int]:
+        """(hits, misses) summed over every retained closed report — the
+        cluster rollup's read (observability/propagation.py
+        build_cluster_report). Bounded by the `recent` ring (64 slots),
+        which covers every shipped scenario length; integer counts only,
+        so the rollup stays bit-deterministic."""
+        with self._lock:
+            reps = list(self.recent)
+        return (sum(r.hits for r in reps), sum(r.misses for r in reps))
+
     def burn_rate(self, window: str = "slot_5") -> float:
         return self.window_summary(window)["burn_rate"]
 

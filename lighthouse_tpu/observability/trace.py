@@ -31,6 +31,7 @@ t=0 is the oldest event in the ring.
 
 from __future__ import annotations
 
+import hashlib
 import itertools
 import json
 import os
@@ -70,10 +71,16 @@ TRACES_TOTAL = REGISTRY.counter_vec(
 _next_trace_id = itertools.count(1)
 
 
+def next_trace_id() -> int:
+    """Allocate one id from the process-wide trace-id sequence (publish
+    contexts built outside any Trace still need a unique causal key)."""
+    return next(_next_trace_id)
+
+
 class Trace:
     """One work unit's spans. Append-only; finished via Tracer.finish."""
 
-    __slots__ = ("kind", "n_items", "t0", "spans", "meta", "trace_id")
+    __slots__ = ("kind", "n_items", "t0", "spans", "meta", "trace_id", "ctx")
 
     def __init__(self, kind: str, n_items: int = 1):
         self.kind = kind
@@ -82,9 +89,25 @@ class Trace:
         self.trace_id = next(_next_trace_id)
         self.spans: list = []        # (name, t0, t1, args|None)
         self.meta: dict = {}
+        # wire-propagated origin context (observability/propagation.py):
+        # set on the producer side at publish and ADOPTED on every
+        # consumer, so a block's publish span and its remote
+        # validate/import spans share one causal id — the merged Perfetto
+        # export links them with flow events keyed on it
+        self.ctx = None
 
     def add_span(self, name: str, t0: float, t1: float, **args) -> None:
         self.spans.append((name, t0, t1, args or None))
+
+    def adopt(self, ctx) -> None:
+        """Adopt a WireTraceContext into this trace (cross-node causal
+        join): the context becomes the trace's flow key and its origin
+        fields land in the exported span args."""
+        self.ctx = ctx
+        self.meta.update(
+            causal=ctx.causal_id(), origin=ctx.origin,
+            origin_slot=ctx.slot, origin_seq=ctx.seq,
+        )
 
     def annotate(self, **kv) -> None:
         """Attach key/values to the whole trace (bucket, bytes, ...)."""
@@ -96,6 +119,23 @@ class Trace:
         return max(t1 for _, _, t1, _ in self.spans) - min(
             t0 for _, t0, _, _ in self.spans
         )
+
+
+# wire-context thread-local (set by the transport's CREQ serve path):
+# traces begun on a thread with a bound wire context auto-adopt it, so a
+# served request's spans join the caller's causal chain without plumbing
+# a context argument through every handler signature
+_wire_tls = threading.local()
+
+
+def set_current_wire_ctx(ctx) -> None:
+    """Bind the wire context of the request being served to this thread
+    (transport `Connection._serve`); `Tracer.begin` adopts it."""
+    _wire_tls.ctx = ctx
+
+
+def current_wire_ctx():
+    return getattr(_wire_tls, "ctx", None)
 
 
 class Tracer:
@@ -116,7 +156,11 @@ class Tracer:
         self.instants_source = None
 
     def begin(self, kind: str, n_items: int = 1) -> Trace:
-        return Trace(kind, n_items)
+        tr = Trace(kind, n_items)
+        ctx = current_wire_ctx()
+        if ctx is not None:
+            tr.adopt(ctx)
+        return tr
 
     def finish(self, trace: Trace | None) -> None:
         if trace is None:
@@ -170,8 +214,17 @@ class Tracer:
 
 
 #: spans named `device:<stage>` render on dedicated lanes starting here
-#: (host pipeline lanes recycle tid 0..31)
+#: (host pipeline lanes recycle tid 0..HOST_LANES-1)
 DEVICE_LANE_BASE = 1000
+
+#: host pipeline lane count; tids recycle mod this. ONE owner — both the
+#: span export and the flow-link synthesis derive a trace's lane from it,
+#: and a divergence would detach every flow arrow from its slice
+HOST_LANES = 32
+
+
+def _host_tid(trace_index: int) -> int:
+    return trace_index % HOST_LANES
 
 #: flight-recorder instant events render on this dedicated lane
 INSTANT_LANE = 900
@@ -179,7 +232,8 @@ INSTANT_LANE = 900
 
 def chrome_trace_events(
     traces: list[Trace], counters: list[tuple] | None = None,
-    instants: list[tuple] | None = None,
+    instants: list[tuple] | None = None, pid: int | None = None,
+    base: float | None = None,
 ) -> list[dict]:
     """Trace-event ("X" complete events, µs) rows for a list of traces.
 
@@ -195,7 +249,14 @@ def chrome_trace_events(
     recorder (breaker transitions, incidents, deadline misses) — export as
     "ph": "i" instant events on the dedicated INSTANT_LANE, so the black
     box's view lines up against the pipeline spans. Timestamps are rebased
-    so the oldest event is t=0."""
+    so the oldest event is t=0 (`base` overrides the rebase origin so the
+    cluster merge can put N tracers on one shared axis; `pid` overrides
+    the process id so each node renders as its own process group).
+
+    Cross-node flow events are NOT emitted here — they need the whole
+    cluster's traces at once (one distinct s/f pair per consumer, or the
+    trace-event flow model chains sibling importers into false causality);
+    `merge_chrome_traces` synthesizes them."""
     counters = counters or []
     instants = instants or []
     if not traces and not counters and not instants:
@@ -205,16 +266,18 @@ def chrome_trace_events(
         for tr in traces
         for _, t0, _, _ in tr.spans or [("", tr.t0, tr.t0, None)]
     ]
-    base = min(
-        span_starts
-        + [t for t, _, _ in counters]
-        + [t for t, _, _ in instants]
-    )
-    pid = os.getpid()
+    if base is None:
+        base = min(
+            span_starts
+            + [t for t, _, _ in counters]
+            + [t for t, _, _ in instants]
+        )
+    if pid is None:
+        pid = os.getpid()
     events = []
     device_lanes: dict = {}  # span name -> dedicated tid
     for i, tr in enumerate(traces):
-        host_tid = i % 32
+        host_tid = _host_tid(i)
         for name, t0, t1, args in tr.spans:
             if name.startswith("device:"):
                 tid = device_lanes.get(name)
@@ -282,6 +345,123 @@ def chrome_trace_events(
                 ev["args"] = {k: str(v) for k, v in args.items()}
             events.append(ev)
     return events
+
+
+def _flow_links(snaps, base: float) -> list[dict]:
+    """Cross-node flow pairs for the cluster merge: ONE distinct (s, f)
+    id per (publish, consumer trace). The trace-event flow model treats
+    same-id events as a single sequential chain, so a fan-out publish with
+    three importers keyed on one id would render import1 -> import2 —
+    false causality between siblings; per-consumer ids give the documented
+    publish -> each-import arrows. Consumers whose context has no publish
+    anchor in the merged set (e.g. an rpc_serve adopting a non-publish
+    caller context) emit nothing."""
+    from .propagation import flow_id
+
+    # pass 1: publish anchors — fid -> (pid, tid, mid-span time)
+    anchors: dict = {}
+    for i, (_name, traces, _c) in enumerate(snaps):
+        for j, tr in enumerate(traces):
+            if tr.kind == "gossip_publish" and tr.ctx is not None and tr.spans:
+                first = tr.spans[0]
+                anchors[flow_id(tr.ctx)] = (
+                    i + 1, _host_tid(j), (first[1] + first[2]) / 2.0
+                )
+    # pass 2: one unique flow per consumer trace with a matching anchor
+    events: list[dict] = []
+    for i, (_name, traces, _c) in enumerate(snaps):
+        pid = i + 1
+        for j, tr in enumerate(traces):
+            if tr.ctx is None or tr.kind == "gossip_publish" or not tr.spans:
+                continue
+            fid = flow_id(tr.ctx)
+            anchor = anchors.get(fid)
+            if anchor is None:
+                continue
+            # digest-derived per-consumer id (NOT an arithmetic pack of
+            # pid/index — wrapped indices or >31 pids would collide and
+            # re-chain sibling flows)
+            uid = int.from_bytes(
+                hashlib.sha256(f"{fid}:{pid}:{j}".encode()).digest()[:6],
+                "big",
+            )
+            apid, atid, ats = anchor
+            events.append({
+                "name": "propagation", "cat": "net", "ph": "s", "id": uid,
+                "ts": (ats - base) * 1e6, "pid": apid, "tid": atid,
+            })
+            first = tr.spans[0]
+            events.append({
+                "name": "propagation", "cat": "net", "ph": "f", "bp": "e",
+                "id": uid, "ts": (first[1] - base) * 1e6,
+                "pid": pid, "tid": _host_tid(j),
+            })
+    return events
+
+
+def merge_chrome_traces(named_tracers, path: str, instants=None) -> int:
+    """Merge N nodes' tracers into ONE Chrome-trace file: each node is a
+    distinct process group (pid = position + 1, named via process_name
+    metadata), every timestamp rebased against one shared origin, and
+    cross-node flow events link each publish span to the remote import
+    spans that adopted its wire context. `named_tracers` is an iterable of
+    (name, Tracer); `instants` — (t_mono, name, args) markers (the flight
+    recorder's `perfetto_instants()`, which is process-global and so
+    cluster-wide in an in-process harness) render as a dedicated
+    `flight_recorder` process group (pid 0). Returns the event count
+    written."""
+    snaps = [
+        (name, tr.snapshot_ring(), tr.snapshot_counters())
+        for name, tr in named_tracers
+    ]
+    instants = list(instants) if instants else []
+    starts = [
+        t0
+        for _, traces, counters in snaps
+        for tr in traces
+        for _, t0, _, _ in tr.spans or [("", tr.t0, tr.t0, None)]
+    ] + [t for _, _, counters in snaps for t, _, _ in counters] + [
+        t for t, _, _ in instants
+    ]
+    base = min(starts) if starts else 0.0
+    events: list[dict] = []
+    if instants:
+        events.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": 0,
+                "tid": 0,
+                "args": {"name": "flight_recorder"},
+            }
+        )
+        events.extend(
+            chrome_trace_events([], instants=instants, pid=0, base=base)
+        )
+    for i, (name, traces, counters) in enumerate(snaps):
+        pid = i + 1
+        events.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": 0,
+                "args": {"name": name},
+            }
+        )
+        events.extend(
+            chrome_trace_events(traces, counters=counters, pid=pid,
+                                base=base)
+        )
+    events.extend(_flow_links(snaps, base))
+    doc = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"producer": "lighthouse-tpu cluster trace merge"},
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return len(events)
 
 
 TRACER = Tracer()

@@ -37,7 +37,10 @@ METRIC_MODULES = (
     "lighthouse_tpu.utils.monitoring",
     "lighthouse_tpu.utils.supervisor",
     "lighthouse_tpu.network.node",
+    "lighthouse_tpu.network.gossipsub",
     "lighthouse_tpu.network.sync",
+    "lighthouse_tpu.observability.propagation",
+    "lighthouse_tpu.chain.beacon_chain",
     "lighthouse_tpu.loadgen.netfaults",
     "lighthouse_tpu.loadgen.meshsim",
     "lighthouse_tpu.loadgen.fleet",
@@ -129,6 +132,17 @@ def lint_registry(registry=None) -> list[str]:
                 errors.append(
                     f"{where}: jaxbls_pipeline_* metrics must be labeled "
                     "families (lane / config source)"
+                )
+        if m.name.startswith(("net_", "gossipsub_")):
+            # propagation SLIs and gossipsub mesh health are only readable
+            # broken down (which topic stalled, which quantile of the
+            # score distribution sank, which context event) — an unlabeled
+            # aggregate cannot localize a propagation problem to a topic
+            # or a mesh, so the convention is enforced like qos_*
+            if not getattr(m, "labelnames", ()):
+                errors.append(
+                    f"{where}: net_*/gossipsub_* metrics must be labeled "
+                    "families (topic / role / event / quantile)"
                 )
         if m.name.startswith(("sync_", "netfault_")):
             # sync failures and injected network faults are only

@@ -283,6 +283,13 @@ def test_bn_loadtest_fleet_steady_smoke_cli(tmp_path):
     report = json.loads(out.read_text())
     assert report["fleet"] is True
     assert report["n_vcs"] > report["n_nodes"]   # several VCs per node
+    # the deterministic cluster rollup rides every fleet report (and the
+    # one-line summary): per-topic propagation p50/p95 + deadline rollup
+    cluster = report["deterministic"]["cluster"]
+    assert summary["cluster"] == cluster
+    assert cluster["propagation"]["beacon_block"]["deliveries"] > 0
+    assert cluster["deadline_hit_ratio"] is not None
+    assert cluster["propagation_stalls"] == {}   # steady run: no stalls
 
 
 def test_bn_loadtest_combined_chaos_smoke_cli(tmp_path):

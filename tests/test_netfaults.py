@@ -271,12 +271,24 @@ def test_partition_heal_scenario_converges_and_conserves(tmp_path):
     # the partitioned node's service level degraded, the majority's less so
     slo = report["slo"]["per_node"]
     assert slo["3"]["deadline_hit_ratio"] < slo["0"]["deadline_hit_ratio"]
-    # burn-rate/miss-streak incidents dumped and schema-valid
+    # burn-rate/miss-streak incidents dumped and schema-valid — and the
+    # partition window produced >= 1 propagation-stall incident (the
+    # minority node had peers connected but received nothing over gossip)
     assert report["slo"]["incidents"]
+    assert any("propagation_stall" in n for n in report["slo"]["incidents"])
     for name in report["slo"]["incidents"]:
         with open(datadir / "incidents" / name) as f:
             assert validate_incident(json.load(f)) == []
-    # identical seeds -> identical deterministic cores
+    # cluster rollup: deadline rollup + per-topic propagation p50/p95 +
+    # the partitioned node flagged as the outlier with a counted stall
+    cluster = det["cluster"]
+    assert cluster["deadline_hit_ratio"] is not None
+    assert "beacon_block" in cluster["propagation"]
+    assert cluster["propagation"]["beacon_block"]["deliveries"] > 0
+    assert "3" in cluster["propagation_stalls"]
+    assert "3" in cluster["outlier_nodes"]
+    # identical seeds -> identical deterministic cores (incl. the cluster
+    # block: logical-clock samples + integer counters only)
     report2 = run_multinode_scenario(sc)
     assert report2["deterministic"] == det
 
