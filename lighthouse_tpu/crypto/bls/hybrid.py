@@ -86,7 +86,8 @@ _DEVICE_LATENCY = REGISTRY.histogram(
 # construct several) reports through the same series; the live node has one.
 _CIRCUIT_STATE = REGISTRY.gauge(
     "bls_device_circuit_state",
-    "device-path circuit breaker state (0=closed, 1=open, 2=half_open)",
+    "device-path circuit breaker state (0=closed, 1=open, 2=half_open); "
+    "DEPRECATED alias of circuit_state{workload=\"bls\"}",
 )
 
 
@@ -188,6 +189,7 @@ class HybridBackend:
         self._breaker = CircuitBreaker(
             "bls_device", failure_threshold=3,
             reset_timeout=breaker_reset, state_gauge=_CIRCUIT_STATE,
+            workload="bls",
         )
         self._apply_plan(_autotune_plan())
         try:

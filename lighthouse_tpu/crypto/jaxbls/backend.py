@@ -682,7 +682,7 @@ class JaxBackend:
         # donation resolve env > autotune plan > default at construction;
         # a profile installed later re-resolves through the plan listener
         # (autotune/runtime.add_plan_listener).
-        self.dispatcher = pl.PipelinedDispatcher()
+        self.dispatcher = pl.PipelinedDispatcher(workload="bls")
         try:
             from ...autotune import runtime as _at_runtime
 

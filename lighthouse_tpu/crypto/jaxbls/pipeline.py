@@ -48,6 +48,7 @@ import threading
 from collections import deque
 from time import perf_counter
 
+from ...observability.device_ledger import LEDGER
 from ...utils.metrics import REGISTRY
 
 # ------------------------------------------------------------------ metrics
@@ -161,13 +162,14 @@ class PipelineTicket:
     it never poisons later tickets."""
 
     __slots__ = ("_dispatcher", "lane", "handle", "continuation",
-                 "done", "value", "error", "claimed", "_ev")
+                 "done", "value", "error", "claimed", "_ev", "interval")
 
-    def __init__(self, dispatcher, lane, handle, continuation):
+    def __init__(self, dispatcher, lane, handle, continuation, interval=None):
         self._dispatcher = dispatcher
         self.lane = lane
         self.handle = handle
         self.continuation = continuation
+        self.interval = interval       # device-ledger interval, or None
         self.done = False
         self.value = None
         self.error = None
@@ -189,9 +191,15 @@ class PipelinedDispatcher:
     batch k instead of letting submissions pile up the device queue.
     Urgent submissions skip both the wait and the window."""
 
-    def __init__(self, depth=None, donate=None):
+    def __init__(self, depth=None, donate=None, workload=None):
         self.depth, self.depth_source = resolve_depth(depth)
         self.donate, self.donate_source = donation_enabled(donate)
+        # tenant identity in the process-wide device ledger: named
+        # dispatchers attribute every submission's device time to their
+        # workload; anonymous ones (ad-hoc tests) stay off the books
+        self.workload = None if workload is None else str(workload)
+        if self.workload is not None:
+            LEDGER.register(self.workload, self)
         # state lock (window bookkeeping, cheap) + a reentrant resolution
         # lock serializing FIFO drains: a continuation may legally submit
         # or resolve (the processor's continuation path does both)
@@ -218,12 +226,20 @@ class PipelinedDispatcher:
 
     # -- submission ------------------------------------------------------
 
-    def submit(self, dispatch, continuation=None, urgent=False) -> PipelineTicket:
+    def submit(self, dispatch, continuation=None, urgent=False,
+               bucket=None, est_cost=None) -> PipelineTicket:
         """Admit + dispatch one batch. `dispatch` is a thunk performing
         the device submission and returning a handle with .result();
         `continuation(value)` (optional) runs when the ticket resolves,
-        in submission order for the batch lane."""
+        in submission order for the batch lane. `bucket`/`est_cost`
+        (optional) annotate the device-ledger interval with the padding
+        bucket and the cost model's estimate for this batch."""
         lane = "urgent" if urgent else "batch"
+        interval = None
+        if self.workload is not None:
+            interval = LEDGER.open(
+                self.workload, lane=lane, bucket=bucket, est_cost=est_cost
+            )
         t0 = perf_counter()
         if not urgent:
             # claim a window slot ATOMICALLY (len(window) + reserved <
@@ -250,15 +266,19 @@ class PipelinedDispatcher:
                     with self._slot_free:
                         self._slot_free.wait(timeout=0.05)
         _ADMIT_WAIT.labels(lane).observe(perf_counter() - t0)
+        if interval is not None:
+            interval.start()           # admit wait over: device dispatch
         try:
             handle = dispatch()
         except BaseException:
+            if interval is not None:
+                interval.close("error")
             if not urgent:
                 with self._slot_free:
                     self._reserved -= 1
                     self._slot_free.notify_all()
             raise
-        ticket = PipelineTicket(self, lane, handle, continuation)
+        ticket = PipelineTicket(self, lane, handle, continuation, interval)
         with self._lock:
             if urgent:
                 self._urgent_inflight += 1
@@ -341,6 +361,9 @@ class PipelinedDispatcher:
         # keep device buffers (or captured marshal inputs) alive
         ticket.handle = None
         ticket.continuation = None
+        if ticket.interval is not None:
+            ticket.interval.close(outcome)
+            ticket.interval = None
         ticket._ev.set()
         _RESOLVED.labels(ticket.lane, outcome).inc()
 

@@ -90,7 +90,7 @@ def _get_dispatcher():
     if _dispatcher is None:
         from ..crypto.jaxbls.pipeline import PipelinedDispatcher
 
-        _dispatcher = PipelinedDispatcher()
+        _dispatcher = PipelinedDispatcher(workload="tree_hash")
     return _dispatcher
 
 

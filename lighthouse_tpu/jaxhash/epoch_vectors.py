@@ -284,14 +284,22 @@ def _device_altair_deltas(n, eff, part_masks, eligible_mask, target_part,
     host-numpy fallback must not masquerade as device latency."""
     import time
 
+    from ..observability.device_ledger import LEDGER
     from ..ssz.core import next_pow2
     from .engine import _DEVICE_SECONDS
 
     t0 = time.perf_counter()
+    # the epoch workload has no dispatcher — it books its device time in
+    # the process-wide ledger directly, as the `epoch` tenant
+    interval = LEDGER.open(
+        "epoch", lane="batch", bucket=None, est_cost=None
+    )
     try:
         from jax.experimental import enable_x64
 
         nb = next_pow2(n)
+        interval.bucket = nb
+        interval.start()
         with enable_x64():
             kernel = _device_epoch_kernel(nb)
             part = np.stack([_pad(m, nb) for m in part_masks])
@@ -308,9 +316,11 @@ def _device_altair_deltas(n, eff, part_masks, eligible_mask, target_part,
         _DEVICE_SECONDS.labels("epoch_deltas").observe(
             time.perf_counter() - t0
         )
+        interval.close("ok")
         _router_record(True)
         return list(rew), list(pen), inact
     except Exception as e:  # device down/misconfigured: host lane serves
+        interval.close("error")
         _log.warn("device epoch deltas failed; host vector lane serves",
                   error=f"{type(e).__name__}: {e}")
         _router_record(False)

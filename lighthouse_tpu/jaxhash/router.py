@@ -43,7 +43,7 @@ _ROUTE = REGISTRY.counter_vec(
 _CIRCUIT_STATE = REGISTRY.gauge(
     "tree_hash_circuit_state",
     "tree-hash device-path circuit breaker state (0=closed, 1=open, "
-    "2=half_open)",
+    "2=half_open); DEPRECATED alias of circuit_state{workload=\"tree_hash\"}",
 )
 
 _state = {"backend": None}
@@ -85,7 +85,7 @@ class TreeHashRouter:
 
         self._breaker = CircuitBreaker(
             "tree_hash_device", failure_threshold=3,
-            state_gauge=_CIRCUIT_STATE,
+            state_gauge=_CIRCUIT_STATE, workload="tree_hash",
         )
 
     # ------------------------------------------------------------- routing

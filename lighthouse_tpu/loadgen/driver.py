@@ -51,15 +51,20 @@ def drive(*, scenario=None, smoke=False, slots=None, validators=None,
     stderr = stderr or sys.stderr
     name = "smoke" if smoke and scenario is None else (scenario or "smoke")
     if trace_out:
-        from .scenarios import is_fleet as _isf, is_multinode as _ism
+        from .scenarios import (
+            is_fleet as _isf,
+            is_mixed_duty as _ismd,
+            is_multinode as _ism,
+        )
 
-        if not (_isf(name) or _ism(name)) or mesh_devices:
-            # the merged cluster timeline is a multi-node artifact; a
+        if not (_isf(name) or _ism(name) or _ismd(name)) or mesh_devices:
+            # the merged cluster timeline is a multi-node artifact (and
+            # mixed_duty's is the device-ledger timeline); a
             # single-process scenario's spans already export via
             # `bn --trace-out` — warn BEFORE any scenario branch so the
             # flag is never dropped silently
-            print("warning: --trace-out only applies to multi-node/fleet "
-                  "scenarios; ignored", file=stderr)
+            print("warning: --trace-out only applies to multi-node/fleet/"
+                  "mixed_duty scenarios; ignored", file=stderr)
             trace_out = None
     if mesh_devices:
         return _drive_mesh_sweep(
@@ -76,6 +81,15 @@ def drive(*, scenario=None, smoke=False, slots=None, validators=None,
             seed=seed, out=out, quiet=quiet, datadir=datadir,
             bench_matrix=bench_matrix, bench_root=bench_root,
             stdout=stdout, stderr=stderr,
+        )
+    from .scenarios import is_mixed_duty
+
+    if is_mixed_duty(name):
+        return _drive_mixed_duty(
+            name, smoke=smoke, slots=slots, validators=validators,
+            seed=seed, out=out, quiet=quiet, datadir=datadir,
+            bench_matrix=bench_matrix, bench_root=bench_root,
+            trace_out=trace_out, stdout=stdout, stderr=stderr,
         )
     from .scenarios import is_state_root
 
@@ -244,14 +258,19 @@ def _drive_mesh_sweep(name, points, *, smoke, slots, validators, seed,
     from .runner import run_scenario
     from .scenarios import get_scenario, is_multinode, smoke_variant
 
-    from .scenarios import is_capacity, is_fleet, is_state_root
+    from .scenarios import (
+        is_capacity,
+        is_fleet,
+        is_mixed_duty,
+        is_state_root,
+    )
 
     if (is_multinode(name) or is_state_root(name) or is_fleet(name)
-            or is_capacity(name)):
+            or is_capacity(name) or is_mixed_duty(name)):
         print(f"error: --mesh-devices does not apply to scenario "
-              f"{name!r} (multi-node, fleet, state_root and capacity "
-              "scenarios drive surfaces the mesh sweep does not)",
-              file=stderr)
+              f"{name!r} (multi-node, fleet, state_root, capacity and "
+              "mixed_duty scenarios drive surfaces the mesh sweep does "
+              "not)", file=stderr)
         return 1
     try:
         points = sorted({int(p) for p in points})
@@ -433,6 +452,115 @@ def _drive_capacity(name, *, smoke, slots, validators, seed, out, quiet,
             f"conservation_ok="
             f"{det['conservation']['ok']})", file=stderr,
         )
+        return 1
+    return 0
+
+
+def _drive_mixed_duty(name, *, smoke, slots, validators, seed, out, quiet,
+                      datadir, bench_matrix, bench_root, trace_out=None,
+                      stdout=None, stderr=None) -> int:
+    """The one-device-many-tenants proof (loadgen/mixed_duty.py): BLS,
+    state-root and epoch work share one logical device while the global
+    device ledger attributes every chip-second. Exit code is the
+    acceptance gate — nonzero unless per-chip conservation holds
+    (busy + idle + contention-wait == wall), every tenant lands a
+    per-workload SLO block, the injected mid-run stall produces >= 1
+    schema-valid device_contention incident naming victim + occupant,
+    and a full rerun is BIT-IDENTICAL in the deterministic core.
+    `--bench-matrix` snapshots one `loadtest_mixed_duty_<workload>` row
+    per tenant. `--trace-out` renders the ledger's merged per-workload
+    device timeline (occupancy tracks + waiting markers)."""
+    import tempfile as _tempfile
+
+    from .mixed_duty import run_mixed_duty_scenario
+    from .scenarios import get_mixed_duty_scenario, mixed_duty_smoke_variant
+
+    sc = get_mixed_duty_scenario(name, slots=slots, n_validators=validators,
+                                 seed=seed)
+    if smoke:
+        sc = mixed_duty_smoke_variant(sc)
+    out = out or default_report_path(smoke)
+    report = run_mixed_duty_scenario(
+        sc, out_path=out, datadir=datadir, trace_out=trace_out,
+        log_fn=None if quiet else (
+            lambda m: print(m, file=stderr, flush=True)
+        ),
+    )
+    # the determinism gate is a REAL rerun, not a pinky promise: same
+    # scenario, fresh datadir, then byte-compare the deterministic cores
+    rerun = run_mixed_duty_scenario(
+        sc, out_path=None, log_fn=None,
+        datadir=_tempfile.mkdtemp(prefix="loadgen-mixed-duty-rerun-"),
+    )
+    identical = (
+        json.dumps(report["deterministic"], sort_keys=True)
+        == json.dumps(rerun["deterministic"], sort_keys=True)
+    )
+    det = report["deterministic"]
+    gate = dict(report["gate"])
+    gate["rerun_identical"] = identical
+    gate["ok"] = gate["ok"] and identical
+    summary = {
+        "scenario": report["scenario"],
+        "report": out,
+        "gate": gate,
+        "workloads": det["workloads"],
+        "conservation": {
+            "ok": det["device_ledger"]["conservation"]["ok"],
+            "wall": det["device_ledger"]["conservation"]["wall"],
+        },
+        "contention_seconds": det["device_ledger"]["contention_seconds"],
+        "contention_incidents": det["contention_incidents"],
+        "incidents": report["slo"]["incidents"],
+        "elapsed_secs": report["elapsed_secs"],
+    }
+    if trace_out:
+        summary["trace_out"] = trace_out
+    print(json.dumps(summary), file=stdout)
+    if bench_matrix:
+        import time as _time
+
+        from ..observability import perf as _perf
+
+        stamp = round(_time.time(), 3)
+        rows = {}
+        for w, blk in det["workloads"].items():
+            rows[f"loadtest_{name}_{w}"] = {
+                "source": "loadtest",
+                "scenario": report["scenario"],
+                "workload": w,
+                "measured_unix": stamp,
+                "n_chips": det["device_ledger"]["n_chips"],
+                "deadline_hit_ratio": blk["hit_ratio"],
+                "busy_seconds": blk["busy_seconds"],
+                "contention_victim_seconds": round(sum(
+                    s for k, s in
+                    det["device_ledger"]["contention_seconds"].items()
+                    if k.split("|")[0] == w
+                ), 9),
+            }
+        try:
+            path = _perf.write_loadtest_rows(rows, smoke=smoke,
+                                             root=bench_root)
+            print(f"bench matrix rows -> {path}", file=stderr)
+        except Exception as e:  # a bench snapshot must never fail the run
+            print(f"warning: bench matrix write failed: {e}", file=stderr)
+    if not gate["ok"]:
+        if not gate["conservation_ok"]:
+            print("error: mixed_duty device-ledger conservation violated "
+                  "(busy + idle + contention-wait != wall; see report)",
+                  file=stderr)
+        if not gate["workload_blocks_ok"]:
+            print("error: mixed_duty run is missing a per-workload SLO "
+                  "block for at least one tenant (see report)",
+                  file=stderr)
+        if not gate["contention_incident_ok"]:
+            print("error: mixed_duty stall produced no schema-valid "
+                  "device_contention incident naming victim + occupant",
+                  file=stderr)
+        if not identical:
+            print("error: mixed_duty rerun was not bit-identical in the "
+                  "deterministic core", file=stderr)
         return 1
     return 0
 
@@ -634,8 +762,13 @@ def add_loadtest_args(parser) -> None:
                              "partition_heal, fork_reorg, sync_catchup, "
                              "equivocation_storm, or a validator-fleet "
                              "family: fleet_steady, fleet_partition, "
-                             "fleet_crash, combined_chaos, fleet_capacity "
-                             "(default: smoke)")
+                             "fleet_crash, combined_chaos, fleet_capacity, "
+                             "or mixed_duty (BLS + state-root + epoch "
+                             "tenants on one device over the global "
+                             "device ledger; nonzero exit unless per-chip "
+                             "conservation, per-workload SLO blocks, a "
+                             "contention incident and a bit-identical "
+                             "rerun all hold) (default: smoke)")
     parser.add_argument("--smoke", action="store_true",
                         help="alone: run the ~5s CPU-only smoke scenario; "
                              "with --scenario: run that scenario shrunk to "
@@ -683,7 +816,10 @@ def add_loadtest_args(parser) -> None:
                              "node's span ring into ONE Perfetto trace "
                              "file — per-node process groups, cross-node "
                              "flow links from each publish span to its "
-                             "remote import spans")
+                             "remote import spans; mixed_duty: render the "
+                             "device ledger's merged per-workload device "
+                             "timeline (occupancy tracks + waiting "
+                             "markers)")
 
 
 def drive_from_args(args) -> int:

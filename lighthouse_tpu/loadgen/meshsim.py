@@ -40,6 +40,7 @@ import math
 import threading
 import time
 
+from ..observability.device_ledger import LEDGER
 from ..utils.metrics import REGISTRY
 from .faults import DeviceStallError
 
@@ -97,6 +98,7 @@ class MeshShardedBackend:
         self.verdict = verdict
         self.calls = 0
         self.stall_hits = 0
+        LEDGER.register("meshsim", dispatcher=self)
         # simulated compute seconds per chip (the occupancy ledger the
         # report's mesh block summarizes)
         self.chip_busy = [0.0] * self.n_devices
@@ -165,6 +167,23 @@ class MeshShardedBackend:
         d = 1 if single_chip else self.n_devices
         share = max(1, math.ceil(max(1, n_sets) / d))
         compute = self.base_secs + self.per_set_secs * share
+        # book the serve into the process-wide device ledger: the mesh
+        # harness is a tenant ("meshsim") like any other, so sweeps show
+        # up on the merged device timeline and in contention attribution
+        iv = LEDGER.open(
+            "meshsim", lane="urgent" if single_chip else "batch",
+            bucket=share, est_cost=compute,
+            chips=(0,) if single_chip else None,
+        ).start()
+        try:
+            return self._serve_booked(single_chip, stalled, compute)
+        except DeviceStallError:
+            iv.close("stalled")
+            raise
+        finally:
+            iv.close("ok")        # no-op when the stall path closed it
+
+    def _serve_booked(self, single_chip, stalled, compute) -> bool:
         time.sleep(compute)
         chips = (0,) if single_chip else tuple(range(self.n_devices))
         with self._lock:

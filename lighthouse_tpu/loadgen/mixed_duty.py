@@ -1,0 +1,452 @@
+"""Mixed-duty proving ground: three tenants, one device, every second on
+the books.
+
+The ROADMAP's "one device, many tenants" arbiter item needs a baseline
+number — what does the node do today, with BLS, tree-hash, and epoch
+work all contending for one mesh and nobody arbitrating? This harness
+produces that number deterministically on CPU: BLS attestation/aggregate
+batches ride the REAL BeaconProcessor; state-root jobs and epoch-vector
+batches are submitted beside them; and all three serve on a logical
+per-chip device ledger with the meshsim cost shape (base_ms +
+per_unit_ms * pow2ceil(n) lanes, BLS sharded across every chip,
+state-root jobs pinned one chip round-robin).
+
+Every serve is booked in the process-wide device ledger
+(observability/device_ledger.py) on a LOGICAL clock, so the run proves
+the ledger's headline invariants rather than assuming them:
+
+  - per-chip conservation: busy + idle + contention-wait == wall,
+    exactly, on every chip (the run exits nonzero otherwise);
+  - per-workload SLO blocks: each tenant's deadline verdicts land in
+    every SlotReport and window summary via record_workload_deadline;
+  - the injected mid-run stall (BLS batches serve stall_factor x
+    slower over stall_slots) makes the other tenants queue behind the
+    occupant, and the accountant's device_contention trigger must dump
+    >= 1 schema-valid incident naming victim + occupant + bucket;
+  - reruns are bit-identical in the deterministic core — no RNG outside
+    the seeded traffic draw, no wall-clock in any decision.
+
+`--trace-out` renders the ledger's merged per-workload device timeline
+(occupancy tracks + waiting markers) as Chrome trace-event JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import tempfile
+import time
+
+from ..chain.beacon_processor import (
+    BeaconProcessor,
+    BeaconProcessorConfig,
+    WorkItem,
+    WorkKind,
+)
+from ..chain.scheduler import pow2ceil
+from ..observability.device_ledger import LEDGER
+from ..observability.flight_recorder import RECORDER, validate_incident
+from ..observability.slo import SlotAccountant
+from ..qos.admission import AdmissionController
+from ..utils.slot_clock import ManualSlotClock
+from .scenarios import MixedDutyScenario, mainnet_mix
+
+#: the tenants this scenario drives (the ledger's workload names)
+WORKLOADS = ("bls", "tree_hash", "epoch")
+
+
+class _ChipModel:
+    """Per-chip logical busy_until timeline with the meshsim cost shape:
+    sharded batches occupy every chip from the max busy edge; pinned
+    jobs occupy one chip independently (true cross-chip overlap)."""
+
+    def __init__(self, n_chips: int):
+        self.n_chips = int(n_chips)
+        self.busy_until = [0.0] * self.n_chips
+
+    def serve_all(self, cost: float, now: float) -> tuple[float, float]:
+        start = max(max(self.busy_until), now)
+        end = start + cost
+        for c in range(self.n_chips):
+            self.busy_until[c] = end
+        return start, end
+
+    def serve_one(self, chip: int, cost: float,
+                  now: float) -> tuple[float, float]:
+        start = max(self.busy_until[chip], now)
+        end = start + cost
+        self.busy_until[chip] = end
+        return start, end
+
+
+def _mixed_traffic(sc: MixedDutyScenario) -> list[tuple[int, int]]:
+    """Per-slot (attestations, aggregates) — seeded, demand-scaled."""
+    rng = random.Random(sc.seed)
+    out = []
+    for _ in range(sc.slots):
+        base = mainnet_mix(sc.n_validators, rng)
+        out.append(
+            (max(1, int(base.attestations * sc.demand_factor)),
+             max(1, int(base.aggregates * sc.demand_factor)))
+        )
+    return out
+
+
+def _in_stall(sc: MixedDutyScenario, slot: int) -> bool:
+    s0, s1 = sc.stall_slots
+    return s0 <= slot < s1
+
+
+def run_mixed_duty_scenario(sc: MixedDutyScenario,
+                            out_path: str | None = None, log_fn=None,
+                            datadir: str | None = None,
+                            trace_out: str | None = None) -> dict:
+    """One full mixed-duty run; the exit-code semantics of the gate
+    verdicts live in loadgen/driver.py (`_drive_mixed_duty`)."""
+    t_wall = time.time()
+    sps = float(max(1, int(sc.seconds_per_slot)))
+    clock = ManualSlotClock(0, max(1, int(sc.seconds_per_slot)))
+    slo_acct = SlotAccountant(
+        export_metrics=False,
+        contention_threshold=sc.contention_threshold,
+    )
+    admission = AdmissionController(clock)
+    proc = BeaconProcessor(BeaconProcessorConfig(), admission=admission)
+    proc.slo = slo_acct
+    slo_acct.bind_clock(clock)
+
+    datadir = datadir or tempfile.mkdtemp(prefix="loadgen-mixed-duty-")
+    incident_dir = os.path.join(datadir, "incidents")
+    RECORDER.reset()
+    RECORDER.configure(incident_dir=incident_dir, clock=clock,
+                       slo_provider=slo_acct.snapshot)
+
+    # the process-wide ledger on a logical clock: one accounting epoch
+    # per run, per-chip books against the scenario's chip universe
+    lclock = {"now": 0.0}
+    LEDGER.configure(n_chips=sc.n_chips, clock=lambda: lclock["now"])
+    for w in WORKLOADS:
+        LEDGER.register(w)
+
+    model = _ChipModel(sc.n_chips)
+    state = {"slot": 0}
+
+    def _now(t: float) -> None:
+        # the ledger clock only moves forward: replayed schedule events
+        # and slot boundaries both clamp monotone
+        lclock["now"] = max(lclock["now"], t)
+
+    def _slot_t0() -> float:
+        return state["slot"] * sps
+
+    bls_cost = lambda n: (                                  # noqa: E731
+        sc.bls_base_ms + sc.bls_per_set_ms * pow2ceil(n) / sc.n_chips
+    ) / 1e3
+    hash_cost = (sc.hash_base_ms
+                 + sc.hash_per_leaf_ms * pow2ceil(sc.root_leaves)) / 1e3
+    epoch_cost = (sc.epoch_base_ms
+                  + sc.epoch_per_val_ms * sc.n_validators) / 1e3
+
+    counts = {
+        "published_att": 0, "published_agg": 0, "late_sets": 0,
+        "roots": 0, "epoch_batches": 0,
+    }
+    workload_totals = {w: [0, 0] for w in WORKLOADS}   # [hits, misses]
+    slot_verdicts = {w: [0, 0] for w in WORKLOADS}     # reset per slot
+
+    def _verdict(workload: str, hits: int, misses: int) -> None:
+        slo_acct.record_workload_deadline(workload, hits, misses)
+        workload_totals[workload][0] += hits
+        workload_totals[workload][1] += misses
+        slot_verdicts[workload][0] += hits
+        slot_verdicts[workload][1] += misses
+
+    def mk_verify(kind_name: str):
+        def verify(payloads):
+            n = len(payloads)
+            cost = bls_cost(n)
+            if _in_stall(sc, state["slot"]):
+                cost *= sc.stall_factor    # the wedged-collective window
+            iv = LEDGER.open("bls", lane="batch", bucket=pow2ceil(n),
+                             est_cost=round(cost, 6))
+            start, end = model.serve_all(cost, lclock["now"])
+            _now(start)
+            iv.start()
+            _now(end)
+            iv.close("ok")
+            clock.set_time(min(end, _slot_t0() + sps * 0.999))
+            late = sum(1 for s in payloads if end > (s + 1) * sps)
+            if late:
+                counts["late_sets"] += late
+                slo_acct.record_late(late)
+            _verdict("bls", n - late, late)
+            slo_acct.record_route("device", n)
+            slo_acct.record_verify_latency(end - start)
+            return None
+
+        return verify
+
+    verify_att = mk_verify("gossip_attestation")
+    verify_agg = mk_verify("gossip_aggregate")
+
+    traffic = _mixed_traffic(sc)
+    per_slot: list[dict] = []
+    totals = {"hits": 0, "misses": 0}
+    contention_seen = 0.0
+
+    def _tally(reports) -> None:
+        for r in reports:
+            totals["hits"] += r.hits
+            totals["misses"] += r.misses
+
+    def _serve_side_jobs(jobs) -> None:
+        """Replay the pinned/sharded side-tenant schedule in event-time
+        order so genuinely parallel chips overlap on the ledger's books.
+        `jobs` is [(iv, start, end)] from the chip model."""
+        events = []
+        for iv, start, end in jobs:
+            events.append((start, 0, iv.seq, "start", iv))
+            events.append((end, 1, iv.seq, "close", iv))
+        events.sort(key=lambda e: (e[0], e[1], e[2]))
+        for t, _o, _s, action, iv in events:
+            _now(t)
+            if action == "start":
+                iv.start()
+            else:
+                iv.close("ok")
+
+    total_slots = sc.slots + sc.epilogue_slots
+    for slot in range(total_slots):
+        state["slot"] = slot
+        clock.set_slot(slot)
+        _now(_slot_t0())
+        for w in slot_verdicts:
+            slot_verdicts[w] = [0, 0]
+        # -- admit the side tenants at the slot boundary (their ledger
+        # intervals open WAITING: time spent queued behind the BLS
+        # occupant is exactly the contention signal under test)
+        th_ivs, ep_ivs = [], []
+        if slot < sc.slots:
+            for i in range(sc.roots_per_slot):
+                th_ivs.append(LEDGER.open(
+                    "tree_hash", lane="batch",
+                    bucket=pow2ceil(sc.root_leaves),
+                    est_cost=round(hash_cost, 6),
+                    chips=(i % sc.n_chips,),
+                ))
+            if sc.epoch_every > 0 and (slot + 1) % sc.epoch_every == 0:
+                for _ in range(sc.epoch_batches):
+                    ep_ivs.append(LEDGER.open(
+                        "epoch", lane="batch",
+                        bucket=pow2ceil(sc.n_validators),
+                        est_cost=round(epoch_cost, 6),
+                    ))
+            # -- BLS through the real processor
+            atts, aggs = traffic[slot]
+            for _ in range(atts):
+                proc.submit(WorkItem(
+                    kind=WorkKind.gossip_attestation, payload=slot,
+                    run_batch=verify_att,
+                    deadline_slot=admission.attestation_deadline_slot(slot),
+                ))
+            for _ in range(aggs):
+                proc.submit(WorkItem(
+                    kind=WorkKind.gossip_aggregate, payload=slot,
+                    run_batch=verify_agg,
+                    deadline_slot=admission.attestation_deadline_slot(slot),
+                ))
+            counts["published_att"] += atts
+            counts["published_agg"] += aggs
+        proc.run_available()
+        # -- side tenants serve after the BLS occupant frees the chips:
+        # epoch shards across every chip, roots pin chips round-robin
+        jobs = []
+        ready = lclock["now"]
+        for iv in ep_ivs:
+            start, end = model.serve_all(iv.est_cost, ready)
+            jobs.append((iv, start, end))
+        for iv in th_ivs:
+            start, end = model.serve_one(
+                iv.chips[0], iv.est_cost, ready
+            )
+            jobs.append((iv, start, end))
+        _serve_side_jobs(jobs)
+        slot_end = (slot + 1) * sps
+        for iv, _start, end in jobs:
+            if iv.workload == "tree_hash":
+                counts["roots"] += 1
+                _verdict("tree_hash", int(end <= slot_end),
+                         int(end > slot_end))
+            else:
+                counts["epoch_batches"] += 1
+                # epoch vectors carry a two-slot budget: they are epoch-
+                # boundary work, not intra-slot gossip
+                _verdict("epoch", int(end <= slot_end + sps),
+                         int(end > slot_end + sps))
+        reports = slo_acct.close_slot(slot)
+        _tally(reports)
+        rep = reports[-1] if reports else None
+        contention_total = LEDGER.contention_total()
+        entry = {
+            "slot": slot,
+            "published": (traffic[slot] if slot < sc.slots else (0, 0)),
+            "roots": len(th_ivs),
+            "epoch_batches": len(ep_ivs),
+            "stalled": _in_stall(sc, slot),
+            "contention_delta": round(contention_total - contention_seen, 9),
+            "workloads": {
+                w: list(v) for w, v in sorted(slot_verdicts.items())
+                if v[0] or v[1]
+            },
+        }
+        contention_seen = contention_total
+        if rep is not None:
+            entry.update(hits=rep.hits, misses=rep.misses, late=rep.late)
+        per_slot.append(entry)
+        if log_fn is not None and slot < sc.slots:
+            log_fn(
+                f"slot {slot}: att={entry['published'][0]} "
+                f"agg={entry['published'][1]} roots={entry['roots']} "
+                f"stalled={entry['stalled']} "
+                f"contention={entry['contention_delta']}"
+            )
+    # force-drain any backlog; it verifies late by construction
+    state["slot"] = total_slots
+    clock.set_slot(total_slots)
+    _now(total_slots * sps)
+    proc.run_until_idle()
+    _tally(slo_acct.close_slot(total_slots))
+
+    # -- the books -------------------------------------------------------
+    conservation = LEDGER.conservation()
+    matrix = LEDGER.contention_matrix()
+    busy = LEDGER.busy_seconds()
+    ledger_block = {
+        "n_chips": sc.n_chips,
+        "conservation": {
+            "ok": conservation["ok"],
+            "wall": round(conservation["wall"], 9),
+            "per_chip": [
+                {
+                    "chip": p["chip"],
+                    "busy": round(p["busy"], 9),
+                    "contention_wait": round(p["contention_wait"], 9),
+                    "idle": round(p["idle"], 9),
+                    "ok": p["ok"],
+                }
+                for p in conservation["per_chip"]
+            ],
+        },
+        "busy_seconds": {
+            w: round(s, 9) for w, s in sorted(busy.items())
+        },
+        "contention_seconds": {
+            f"{v}|{o}": round(s, 9) for (v, o), s in sorted(matrix.items())
+        },
+    }
+    # -- incidents: schema-validated here so the gate verdict is part of
+    # the report (the driver owns exit codes, not re-derivation)
+    incident_names = sorted(
+        os.path.basename(p) for p in RECORDER.incidents_written
+    )
+    contention_incidents = []
+    for name in incident_names:
+        if "device_contention" not in name:
+            continue
+        try:
+            with open(os.path.join(incident_dir, name)) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        ctx = doc.get("context", {})
+        if (not validate_incident(doc) and ctx.get("victim")
+                and ctx.get("occupant")):
+            contention_incidents.append({
+                "file": name,
+                "victim": ctx.get("victim"),
+                "occupant": ctx.get("occupant"),
+                "occupant_bucket": ctx.get("occupant_bucket"),
+            })
+    workload_blocks = {
+        w: {
+            "hits": h,
+            "misses": m,
+            "hit_ratio": None if h + m == 0 else round(h / (h + m), 4),
+            "busy_seconds": ledger_block["busy_seconds"].get(w, 0.0),
+        }
+        for w, (h, m) in sorted(workload_totals.items())
+    }
+    gate = {
+        "conservation_ok": conservation["ok"],
+        "workload_blocks_ok": all(
+            (w in workload_blocks
+             and workload_blocks[w]["hits"] + workload_blocks[w]["misses"] > 0)
+            for w in WORKLOADS
+        ),
+        "contention_incident_ok": len(contention_incidents) >= 1,
+    }
+    gate["ok"] = all(gate.values())
+    deterministic = {
+        "per_slot": per_slot,
+        "deadline_hits": totals["hits"],
+        "deadline_misses": totals["misses"],
+        "late_sets": counts["late_sets"],
+        "published": {
+            "attestations": counts["published_att"],
+            "aggregates": counts["published_agg"],
+            "roots": counts["roots"],
+            "epoch_batches": counts["epoch_batches"],
+        },
+        "workloads": workload_blocks,
+        "device_ledger": ledger_block,
+        "contention_incidents": contention_incidents,
+        "gate": gate,
+    }
+    report = {
+        "scenario": sc.name,
+        "seed": sc.seed,
+        "slots": sc.slots,
+        "n_validators": sc.n_validators,
+        "mixed_duty": True,
+        "deterministic": deterministic,
+        "gate": gate,
+        "slo": {
+            "windows": {
+                name: slo_acct.window_summary(name)
+                for name in slo_acct.windows
+            },
+            "incident_dir": incident_dir,
+            "incidents": incident_names,
+        },
+        "elapsed_secs": round(time.time() - t_wall, 3),
+    }
+    if trace_out:
+        report["trace_events"] = _write_device_timeline(trace_out)
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=1)
+    # detach: the recorder and the ledger go back to their wall-clock
+    # defaults so the next consumer in this process starts clean
+    RECORDER.configure(incident_dir=None, clock=None, slo_provider=None)
+    LEDGER.reset()
+    return report
+
+
+def _write_device_timeline(path: str) -> int:
+    """Render the ledger's merged per-workload device timeline (occupancy
+    tracks + waiting markers) as Chrome trace-event JSON; returns the
+    event count. Called BEFORE the end-of-run ledger reset."""
+    from ..observability.trace import chrome_trace_events
+
+    events = chrome_trace_events(
+        [], device_timeline=LEDGER.perfetto_device_timeline()
+    )
+    doc = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"producer": "lighthouse-tpu mixed_duty device timeline"},
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return len(events)

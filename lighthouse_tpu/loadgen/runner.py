@@ -98,7 +98,7 @@ class LoadgenNode:
 
             self.mesh_devices = resolve_mesh_devices(sc.mesh_devices)
             self.device = MeshShardedBackend(self.mesh_devices)
-            self.dispatcher = PipelinedDispatcher()
+            self.dispatcher = PipelinedDispatcher(workload="meshsim")
         else:
             self.mesh_devices = None
             self.device = StallingBackend()
@@ -108,6 +108,7 @@ class LoadgenNode:
         self.breaker = CircuitBreaker(
             "loadgen_device", failure_threshold=3,
             reset_timeout=float(sc.seconds_per_slot), time_fn=clock._time,
+            workload="meshsim",
         )
         # wall-clock verify observations for mesh runs (device-served
         # batches only): the sweep's sets/s + p50 numbers — kept OUT of

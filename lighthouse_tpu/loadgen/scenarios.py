@@ -176,7 +176,7 @@ def get_scenario(name: str, **overrides) -> Scenario:
     if base is None:
         have = (
             sorted(SCENARIOS) + sorted(CAPACITY_SCENARIOS)
-            + sorted(STATE_ROOT_SCENARIOS)
+            + sorted(STATE_ROOT_SCENARIOS) + sorted(MIXED_DUTY_SCENARIOS)
             + sorted(MULTINODE_SCENARIOS) + sorted(_ensure_fleet())
         )
         raise KeyError(
@@ -639,3 +639,99 @@ def multinode_smoke_variant(sc: MultiNodeScenario) -> MultiNodeScenario:
         n_validators=min(sc.n_validators, 64),
         slots=min(sc.slots, 16),
     )
+
+
+# -------------------------------------------------------------- mixed duty
+
+
+@dataclass
+class MixedDutyScenario:
+    """One device, many tenants (loadgen/mixed_duty.py): BLS attestation
+    batches, tree-hash state-root jobs, and epoch-vector work all drive
+    ONE per-chip device ledger through the process-wide device-occupancy
+    ledger (observability/device_ledger.py). The run is the measurement
+    substrate for the ROADMAP's "one device, many tenants" arbiter: it
+    fails unless per-chip ledger conservation (busy + idle +
+    contention-wait = wall) holds exactly, every workload's SLO block
+    lands in the report, and the injected mid-run stall produces at
+    least one schema-valid `device_contention` incident naming victim
+    and occupant. The deterministic core is bit-identical across reruns
+    — this run IS the workloads-isolated baseline the arbiter item's
+    acceptance clause compares against."""
+
+    name: str
+    n_validators: int = 8192
+    slots: int = 12
+    seed: int = 0x7E9A27
+    #: chip universe of the logical device (the meshsim shape)
+    n_chips: int = 4
+    #: BLS demand scale over mainnet_mix's seeded draw
+    demand_factor: float = 1.0
+    #: tree-hash tenant: state-root jobs per slot, leaves per job
+    roots_per_slot: int = 6
+    root_leaves: int = 4096
+    #: epoch tenant: cadence (every k-th slot) and batches per firing
+    epoch_every: int = 8
+    epoch_batches: int = 2
+    #: logical device cost model per tenant: a batch of n units pays
+    #: base_ms + per_unit_ms * pow2ceil(n) ms (the padding-bucket
+    #: economics shared with the capacity ledger); BLS shards across
+    #: every chip, state-root jobs pin one chip round-robin
+    bls_base_ms: float = 25.0
+    bls_per_set_ms: float = 0.65
+    hash_base_ms: float = 8.0
+    hash_per_leaf_ms: float = 0.004
+    epoch_base_ms: float = 60.0
+    epoch_per_val_ms: float = 0.012
+    seconds_per_slot: int = 1
+    #: traffic-free drain slots before the final force-drain
+    epilogue_slots: int = 2
+    #: injected mid-run stall: over [start, end) slots BLS batches serve
+    #: stall_factor x slower (a wedged collective holding the device),
+    #: so the other tenants' admitted work queues behind the occupant —
+    #: the contention episode the incident trigger must catch and name
+    stall_slots: tuple = (5, 7)
+    stall_factor: float = 8.0
+    #: accountant device_contention trigger threshold (logical seconds
+    #: of cross-tenant contention accrued per slot)
+    contention_threshold: float = 0.25
+
+
+MIXED_DUTY_SCENARIOS: dict[str, MixedDutyScenario] = {
+    # steady mainnet-shaped BLS + 6 state-roots/slot + epoch vectors on
+    # the epoch boundary, with a mid-run 8x BLS stall: the three tenants
+    # genuinely contend for the 4-chip ledger around the stall window
+    "mixed_duty": MixedDutyScenario(name="mixed_duty"),
+}
+
+
+def is_mixed_duty(name: str) -> bool:
+    return name in MIXED_DUTY_SCENARIOS
+
+
+def get_mixed_duty_scenario(name: str, **overrides) -> MixedDutyScenario:
+    base = MIXED_DUTY_SCENARIOS.get(name)
+    if base is None:
+        raise KeyError(f"unknown mixed-duty scenario {name!r}")
+    overrides = {k: v for k, v in overrides.items() if v is not None}
+    return replace(base, **overrides) if overrides else replace(base)
+
+
+def mixed_duty_smoke_variant(sc: MixedDutyScenario) -> MixedDutyScenario:
+    """Seconds-sized clamp preserving the contention physics: shrinking
+    the validator count scales the BLS per-set cost up by the same ratio
+    (the capacity_smoke_variant rule), and the stall window slides inside
+    the clamped run so the contention episode is never cut."""
+    n_small = min(sc.n_validators, 4096)
+    out = replace(
+        sc,
+        n_validators=n_small,
+        bls_per_set_ms=sc.bls_per_set_ms * (sc.n_validators / n_small),
+        slots=min(sc.slots, 10),
+        epilogue_slots=min(sc.epilogue_slots, 2),
+        roots_per_slot=min(sc.roots_per_slot, 4),
+    )
+    s0, s1 = out.stall_slots
+    width = max(1, min(s1 - s0, out.slots - 3))
+    s0 = max(1, min(s0, out.slots - width - 1))
+    return replace(out, stall_slots=(s0, s0 + width))

@@ -19,6 +19,13 @@ everything a diagnosis session starts from:
   - `cluster_report.json`  the newest loadgen report's cluster rollup
                          (cluster deadline-hit ratio, per-node outliers,
                          per-topic propagation p50/p95), when one exists
+  - `device_ledger.json` the process-wide device ledger snapshot (per-
+                         workload occupancy, open intervals, contention
+                         matrix, per-chip conservation)
+  - `mixed_duty_report.json`  the newest loadgen report's mixed-duty
+                         block (per-workload SLO verdicts, ledger
+                         conservation, contention incidents), when one
+                         exists
 
 Every member is independent: a half-initialized process (or a datadir-less
 invocation) still produces a useful bundle, and the manifest says exactly
@@ -125,6 +132,41 @@ def _collect_cluster(root: str) -> dict:
     )
 
 
+def _collect_device_ledger() -> dict:
+    from .device_ledger import LEDGER
+
+    return LEDGER.snapshot()
+
+
+def _collect_mixed_duty(root: str) -> dict:
+    """Latest mixed-duty rollup (per-workload SLO verdicts, device-ledger
+    conservation + contention, incident verdicts): read from the newest
+    loadgen report at the install root that carries one."""
+    candidates = [
+        os.path.join(root, name)
+        for name in ("loadgen_report.json", "LOADGEN_SMOKE.json")
+        if os.path.exists(os.path.join(root, name))
+    ]
+    for path in sorted(candidates, key=os.path.getmtime, reverse=True):
+        with open(path) as f:
+            rep = json.load(f)
+        if not rep.get("mixed_duty"):
+            continue
+        det = rep.get("deterministic") or {}
+        return {
+            "source": os.path.basename(path),
+            "scenario": rep.get("scenario"),
+            "seed": rep.get("seed"),
+            "gate": rep.get("gate"),
+            "workloads": det.get("workloads"),
+            "device_ledger": det.get("device_ledger"),
+            "contention_incidents": det.get("contention_incidents"),
+        }
+    raise FileNotFoundError(
+        "no mixed-duty loadgen report at install root"
+    )
+
+
 def _collect_bench(root: str) -> dict:
     out: dict = {}
     matrix = os.path.join(root, "BENCH_MATRIX.json")
@@ -177,6 +219,8 @@ def build_bundle(out_path: str, datadir: str | None = None,
     add_json("autotune_profile.json", _collect_autotune)
     add_json("bench.json", lambda: _collect_bench(root))
     add_json("cluster_report.json", lambda: _collect_cluster(root))
+    add_json("device_ledger.json", _collect_device_ledger)
+    add_json("mixed_duty_report.json", lambda: _collect_mixed_duty(root))
 
     incidents: list[str] = []
     if datadir:

@@ -61,10 +61,11 @@ INCIDENTS_TOTAL = REGISTRY.counter_vec(
 
 def config_fingerprint() -> dict:
     """Stable description of the running configuration: the LIGHTHOUSE_TPU_*
-    environment, interpreter + argv, and the active BLS backend — plus a
-    sha256 over the canonical JSON so two dumps can be compared at a
-    glance. Best-effort by design (an incident dump must never fail on a
-    half-initialized process)."""
+    environment, interpreter + argv, the active BLS and hash backends,
+    the mesh topology string, and the installed autotune profile key —
+    plus a sha256 over the canonical JSON so two dumps can be compared at
+    a glance. Best-effort by design (an incident dump must never fail on
+    a half-initialized process)."""
     env = {
         k: v for k, v in sorted(os.environ.items())
         if k.startswith("LIGHTHOUSE_TPU_")
@@ -88,6 +89,21 @@ def config_fingerprint() -> dict:
         out["autotune_profile"] = None if prof is None else prof.key_string()
     except Exception:
         out["autotune_profile"] = None
+    try:
+        from ..jaxhash.router import hash_backend
+
+        out["hash_backend"] = hash_backend()
+    except Exception:
+        out["hash_backend"] = None
+    try:
+        # topology only if the mesh layer is already loaded — the
+        # fingerprint must never be the thing that initializes a device
+        mesh_mod = sys.modules.get("lighthouse_tpu.parallel.mesh")
+        out["mesh_topology"] = (
+            None if mesh_mod is None else mesh_mod.mesh_shape_key()
+        )
+    except Exception:
+        out["mesh_topology"] = None
     out["sha256"] = hashlib.sha256(
         json.dumps(out, sort_keys=True).encode()
     ).hexdigest()

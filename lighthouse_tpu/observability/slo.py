@@ -115,7 +115,8 @@ class _SlotCounters:
 
     __slots__ = ("admitted", "processed", "shed", "late", "routes",
                  "queue_wait", "verify_lat", "wait_overflow",
-                 "verify_overflow", "validator_hits", "validator_misses")
+                 "verify_overflow", "validator_hits", "validator_misses",
+                 "workloads")
 
     def __init__(self):
         self.admitted: dict[str, int] = {}
@@ -129,6 +130,9 @@ class _SlotCounters:
         self.verify_overflow = 0
         self.validator_hits = 0
         self.validator_misses = 0
+        # per-tenant deadline verdicts: workload -> [hits, misses] (the
+        # device ledger's workload names — bls / tree_hash / epoch / ...)
+        self.workloads: dict[str, list] = {}
 
     def merge(self, other: "_SlotCounters") -> None:
         """Fold another slot's counters into this one (clock-rebase path)."""
@@ -153,6 +157,10 @@ class _SlotCounters:
         )
         self.validator_hits += other.validator_hits
         self.validator_misses += other.validator_misses
+        for w, (h, m) in other.workloads.items():
+            ent = self.workloads.setdefault(w, [0, 0])
+            ent[0] += h
+            ent[1] += m
 
 
 class SlotReport:
@@ -160,7 +168,8 @@ class SlotReport:
 
     __slots__ = ("slot", "empty", "admitted", "processed", "shed", "late",
                  "routes", "hits", "misses", "queue_wait", "verify_lat",
-                 "validator_hits", "validator_misses", "gap_before")
+                 "validator_hits", "validator_misses", "workloads",
+                 "gap_before")
 
     def __init__(self, slot: int, c: _SlotCounters | None,
                  gap_before: int = 0):
@@ -169,7 +178,8 @@ class SlotReport:
         if c is None:
             c = _SlotCounters()
         self.empty = not (c.admitted or c.processed or c.shed or c.late
-                          or c.validator_hits or c.validator_misses)
+                          or c.validator_hits or c.validator_misses
+                          or c.workloads)
         self.admitted = dict(c.admitted)
         self.processed = dict(c.processed)
         self.shed = {f"{k}:{r}": n for (k, r), n in c.shed.items()}
@@ -177,6 +187,7 @@ class SlotReport:
         self.routes = dict(c.routes)
         self.validator_hits = c.validator_hits
         self.validator_misses = c.validator_misses
+        self.workloads = {w: (hm[0], hm[1]) for w, hm in c.workloads.items()}
         # deadline accounting over TIMELY kinds: everything processed met
         # its deadline (expired work is shed at pop, never executed) except
         # the batches the verifier marked late; every TIMELY loss — full
@@ -236,6 +247,16 @@ class SlotReport:
                 "hits": self.validator_hits,
                 "misses": self.validator_misses,
             }
+        if self.workloads:
+            out["workloads"] = {
+                w: {
+                    "hits": h,
+                    "misses": m,
+                    "hit_ratio": None if h + m == 0
+                    else round(h / (h + m), 4),
+                }
+                for w, (h, m) in sorted(self.workloads.items())
+            }
         if self.gap_before:
             out["gap_before"] = self.gap_before
         return out
@@ -247,6 +268,7 @@ class SlotAccountant:
     def __init__(self, *, target: float = 0.99, burn_threshold: float = 10.0,
                  miss_streak: int = 2, streak_ratio: float = 0.9,
                  shed_burst_threshold: int = 50,
+                 contention_threshold: float = 0.25,
                  recorder: flight_recorder.FlightRecorder | None = None,
                  export_metrics: bool = True):
         self.target = float(target)
@@ -254,6 +276,9 @@ class SlotAccountant:
         self.miss_streak = int(miss_streak)
         self.streak_ratio = float(streak_ratio)
         self.shed_burst_threshold = int(shed_burst_threshold)
+        #: cross-tenant contention seconds accrued since the last
+        #: evaluated slot that arm the device_contention trigger
+        self.contention_threshold = float(contention_threshold)
         self.recorder = recorder if recorder is not None else (
             flight_recorder.RECORDER
         )
@@ -272,6 +297,8 @@ class SlotAccountant:
         self.closed_count = 0
         self._streak = 0                           # consecutive degraded slots
         self._burning = False
+        self._contending = False                   # device_contention latch
+        self._contention_baseline: dict = {}       # last-read ledger matrix
         # serializes _post_close across the concurrent close_slot callers
         # this class supports: trigger/clear state transitions must not
         # interleave (a stale clear re-arming a trigger mid-episode would
@@ -354,6 +381,8 @@ class SlotAccountant:
             self.closed_count = 0
             self._streak = 0
             self._burning = False
+            self._contending = False
+            self._contention_baseline = {}
             self._post_through = -1
 
     def _slot_locked(self) -> int:
@@ -442,6 +471,20 @@ class SlotAccountant:
             c = self._counters_locked()
             c.validator_hits += hits
             c.validator_misses += misses
+
+    def record_workload_deadline(self, workload: str, hits: int = 0,
+                                 misses: int = 0) -> None:
+        """Per-tenant deadline verdicts under the device ledger's
+        workload names (bls / tree_hash / epoch / meshsim): every
+        SlotReport and window summary then carries a per-workload
+        deadline-hit ratio and burn rate beside the aggregate — the
+        tenant-aware view the "one device, many tenants" arbiter needs."""
+        with self._lock:
+            ent = self._counters_locked().workloads.setdefault(
+                str(workload), [0, 0]
+            )
+            ent[0] += int(hits)
+            ent[1] += int(misses)
 
     # ------------------------------------------------------ slot boundary
 
@@ -551,6 +594,22 @@ class SlotAccountant:
         }
         if vhits or vmiss:
             out["validator_monitor"] = {"hits": vhits, "misses": vmiss}
+        per_workload: dict[str, list] = {}
+        for r in reps:
+            for w, (h, m) in r.workloads.items():
+                ent = per_workload.setdefault(w, [0, 0])
+                ent[0] += h
+                ent[1] += m
+        if per_workload:
+            out["workloads"] = {}
+            for w, (h, m) in sorted(per_workload.items()):
+                wr = 1.0 if h + m == 0 else h / (h + m)
+                out["workloads"][w] = {
+                    "hits": h,
+                    "misses": m,
+                    "deadline_hit_ratio": round(wr, 4),
+                    "burn_rate": round((1.0 - wr) / budget, 2),
+                }
         return out
 
     def window_summary(self, name: str) -> dict:
@@ -642,6 +701,54 @@ class SlotAccountant:
                         streak=streak, slo=self.snapshot)
         elif streak == 0:
             rec.clear("deadline_miss_streak")
+        # trigger 3: cross-tenant device contention — the device ledger's
+        # (victim, occupant) matrix accrued over threshold since the last
+        # evaluated slot. Same hysteresis contract as the burn trigger: a
+        # latch arms on the first over-threshold slot and a sustained
+        # episode dumps once; the latch re-arms only after a slot whose
+        # contention delta is back under threshold.
+        self._run_contention_trigger(rep, rec)
+
+    def _run_contention_trigger(self, rep: SlotReport, rec) -> None:
+        try:
+            from .device_ledger import LEDGER
+
+            matrix = LEDGER.contention_matrix()
+        except Exception:
+            return   # the books must never break a slot close
+        delta = {
+            key: secs - self._contention_baseline.get(key, 0.0)
+            for key, secs in matrix.items()
+            if secs - self._contention_baseline.get(key, 0.0) > 0.0
+        }
+        self._contention_baseline = matrix
+        total = sum(delta.values())
+        contending = total >= self.contention_threshold
+        if self._export:
+            DEGRADED.labels("device_contention").set(
+                1.0 if contending else 0.0
+            )
+        if contending and not self._contending:
+            # the dump names who paid (victim), who held the device
+            # (occupant), and the occupying batch's padding bucket
+            (victim, occupant), secs = max(
+                delta.items(), key=lambda kv: (kv[1], kv[0])
+            )
+            try:
+                from .device_ledger import LEDGER
+
+                bucket = LEDGER.last_bucket(occupant)
+            except Exception:
+                bucket = None
+            rec.trigger("device_contention", slot=rep.slot,
+                        victim=victim, occupant=occupant,
+                        occupant_bucket=bucket,
+                        contention_seconds=round(secs, 6),
+                        contention_total_seconds=round(total, 6),
+                        slo=self.snapshot)
+        elif not contending and self._contending:
+            rec.clear("device_contention")
+        self._contending = contending
 
     def health(self) -> dict:
         """The degraded signal the /eth/v1/node/health endpoint consumes:

@@ -154,6 +154,11 @@ class Tracer:
         # the global TRACER at import (test-local Tracer instances export
         # only their own spans)
         self.instants_source = None
+        # optional () -> [(track, name, t0, t1, args)] provider of the
+        # device ledger's merged per-workload occupancy timeline; the
+        # ledger wires itself onto the global TRACER at import, the same
+        # contract as instants_source
+        self.device_timeline_source = None
 
     def begin(self, kind: str, n_items: int = 1) -> Trace:
         tr = Trace(kind, n_items)
@@ -202,6 +207,8 @@ class Tracer:
         events = chrome_trace_events(
             self.snapshot_ring(), counters=self.snapshot_counters(),
             instants=self.instants_source() if self.instants_source else None,
+            device_timeline=self.device_timeline_source()
+            if self.device_timeline_source else None,
         )
         doc = {
             "traceEvents": events,
@@ -229,11 +236,51 @@ def _host_tid(trace_index: int) -> int:
 #: flight-recorder instant events render on this dedicated lane
 INSTANT_LANE = 900
 
+#: the device ledger's per-workload occupancy/waiting tracks render on
+#: dedicated lanes starting here (one tid per track, deterministically
+#: ordered by track name)
+DEVICE_LEDGER_LANE_BASE = 2000
+
+
+def _device_timeline_events(timeline, pid: int, base: float) -> list[dict]:
+    """Render the device ledger's merged timeline — (track, name, t0, t1,
+    args) spans from DeviceLedger.perfetto_device_timeline() — as "X"
+    rows on per-track lanes plus thread_name metadata. Tracks are
+    assigned tids in sorted order so the export is deterministic: each
+    workload's occupancy track (`ledger:<workload>`) sits beside its
+    waiting-marker track (`ledger:<workload>:wait`)."""
+    tracks = sorted({t for t, _, _, _, _ in timeline})
+    tids = {t: DEVICE_LEDGER_LANE_BASE + i for i, t in enumerate(tracks)}
+    events: list[dict] = [
+        {
+            "name": "thread_name",
+            "ph": "M",
+            "pid": pid,
+            "tid": tids[t],
+            "args": {"name": f"ledger:{t}"},
+        }
+        for t in tracks
+    ]
+    for track, name, t0, t1, args in timeline:
+        ev = {
+            "name": name,
+            "cat": "device_ledger",
+            "ph": "X",
+            "ts": (t0 - base) * 1e6,
+            "dur": max(0.0, (t1 - t0) * 1e6),
+            "pid": pid,
+            "tid": tids[track],
+        }
+        if args:
+            ev["args"] = {k: str(v) for k, v in args.items()}
+        events.append(ev)
+    return events
+
 
 def chrome_trace_events(
     traces: list[Trace], counters: list[tuple] | None = None,
     instants: list[tuple] | None = None, pid: int | None = None,
-    base: float | None = None,
+    base: float | None = None, device_timeline: list[tuple] | None = None,
 ) -> list[dict]:
     """Trace-event ("X" complete events, µs) rows for a list of traces.
 
@@ -248,7 +295,10 @@ def chrome_trace_events(
     counter rows. `instants` — (t, name, args) markers from the flight
     recorder (breaker transitions, incidents, deadline misses) — export as
     "ph": "i" instant events on the dedicated INSTANT_LANE, so the black
-    box's view lines up against the pipeline spans. Timestamps are rebased
+    box's view lines up against the pipeline spans. `device_timeline` —
+    (track, name, t0, t1, args) spans from the device ledger — render as
+    per-workload occupancy/waiting lanes (tid >= DEVICE_LEDGER_LANE_BASE,
+    deterministic track order). Timestamps are rebased
     so the oldest event is t=0 (`base` overrides the rebase origin so the
     cluster merge can put N tracers on one shared axis; `pid` overrides
     the process id so each node renders as its own process group).
@@ -259,7 +309,8 @@ def chrome_trace_events(
     `merge_chrome_traces` synthesizes them."""
     counters = counters or []
     instants = instants or []
-    if not traces and not counters and not instants:
+    device_timeline = device_timeline or []
+    if not traces and not counters and not instants and not device_timeline:
         return []
     span_starts = [
         t0
@@ -271,6 +322,7 @@ def chrome_trace_events(
             span_starts
             + [t for t, _, _ in counters]
             + [t for t, _, _ in instants]
+            + [t0 for _, _, t0, _, _ in device_timeline]
         )
     if pid is None:
         pid = os.getpid()
@@ -344,6 +396,8 @@ def chrome_trace_events(
             if args:
                 ev["args"] = {k: str(v) for k, v in args.items()}
             events.append(ev)
+    if device_timeline:
+        events.extend(_device_timeline_events(device_timeline, pid, base))
     return events
 
 
@@ -399,7 +453,8 @@ def _flow_links(snaps, base: float) -> list[dict]:
     return events
 
 
-def merge_chrome_traces(named_tracers, path: str, instants=None) -> int:
+def merge_chrome_traces(named_tracers, path: str, instants=None,
+                        device_timeline="auto") -> int:
     """Merge N nodes' tracers into ONE Chrome-trace file: each node is a
     distinct process group (pid = position + 1, named via process_name
     metadata), every timestamp rebased against one shared origin, and
@@ -408,13 +463,21 @@ def merge_chrome_traces(named_tracers, path: str, instants=None) -> int:
     (name, Tracer); `instants` — (t_mono, name, args) markers (the flight
     recorder's `perfetto_instants()`, which is process-global and so
     cluster-wide in an in-process harness) render as a dedicated
-    `flight_recorder` process group (pid 0). Returns the event count
-    written."""
+    `flight_recorder` process group (pid 0). The device ledger's merged
+    per-workload timeline (process-global, like the recorder) renders as
+    its own `device_ledger` process group after the node groups —
+    `device_timeline="auto"` pulls it from the global TRACER's wired
+    source, an explicit list overrides, None suppresses. Returns the
+    event count written."""
     snaps = [
         (name, tr.snapshot_ring(), tr.snapshot_counters())
         for name, tr in named_tracers
     ]
     instants = list(instants) if instants else []
+    if device_timeline == "auto":
+        src = TRACER.device_timeline_source
+        device_timeline = src() if src else []
+    device_timeline = list(device_timeline) if device_timeline else []
     starts = [
         t0
         for _, traces, counters in snaps
@@ -422,7 +485,7 @@ def merge_chrome_traces(named_tracers, path: str, instants=None) -> int:
         for _, t0, _, _ in tr.spans or [("", tr.t0, tr.t0, None)]
     ] + [t for _, _, counters in snaps for t, _, _ in counters] + [
         t for t, _, _ in instants
-    ]
+    ] + [t0 for _, _, t0, _, _ in device_timeline]
     base = min(starts) if starts else 0.0
     events: list[dict] = []
     if instants:
@@ -452,6 +515,20 @@ def merge_chrome_traces(named_tracers, path: str, instants=None) -> int:
         events.extend(
             chrome_trace_events(traces, counters=counters, pid=pid,
                                 base=base)
+        )
+    if device_timeline:
+        dl_pid = len(snaps) + 1
+        events.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": dl_pid,
+                "tid": 0,
+                "args": {"name": "device_ledger"},
+            }
+        )
+        events.extend(
+            _device_timeline_events(device_timeline, dl_pid, base)
         )
     events.extend(_flow_links(snaps, base))
     doc = {

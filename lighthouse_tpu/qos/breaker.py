@@ -41,12 +41,27 @@ TRANSITIONS = REGISTRY.counter_vec(
     ("breaker", "to"),
 )
 
+# the unified per-tenant state family: the plain per-breaker gauges
+# (bls_device_circuit_state, tree_hash_circuit_state) predate the device
+# ledger's workload naming and stay exported as DEPRECATED aliases so
+# existing dashboards keep working; new consumers read this one
+CIRCUIT_STATE = REGISTRY.gauge_vec(
+    "circuit_state",
+    "circuit state per tenant workload (0=closed, 1=open, 2=half_open); "
+    "supersedes the per-breaker *_circuit_state gauges, which remain as "
+    "deprecated aliases",
+    ("workload",),
+)
+
 
 class CircuitBreaker:
     def __init__(self, name: str, *, failure_threshold: int = 3,
                  reset_timeout: float = 10.0, time_fn=time.monotonic,
-                 state_gauge=None):
+                 state_gauge=None, workload=None):
         self.name = name
+        # tenant identity in the unified circuit_state{workload} family;
+        # breakers constructed without one only export their legacy gauge
+        self.workload = None if workload is None else str(workload)
         self.failure_threshold = int(failure_threshold)
         self.reset_timeout = float(reset_timeout)
         self._time = time_fn
@@ -68,6 +83,8 @@ class CircuitBreaker:
         self._notify_lock = threading.Lock()
         if self._gauge is not None:
             self._gauge.set(STATE_VALUES[CLOSED])
+        if self.workload is not None:
+            CIRCUIT_STATE.labels(self.workload).set(STATE_VALUES[CLOSED])
 
     # ------------------------------------------------------------ internals
 
@@ -79,6 +96,8 @@ class CircuitBreaker:
         TRANSITIONS.labels(self.name, to).inc()
         if self._gauge is not None:
             self._gauge.set(STATE_VALUES[to])
+        if self.workload is not None:
+            CIRCUIT_STATE.labels(self.workload).set(STATE_VALUES[to])
         self._log.info("circuit transition", to=to,
                        failures=self._failures)
         # flight-recorder notification is DEFERRED: a transition to OPEN

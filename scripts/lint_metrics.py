@@ -60,6 +60,7 @@ METRIC_MODULES = (
     "lighthouse_tpu.observability.device",
     "lighthouse_tpu.observability.perf",
     "lighthouse_tpu.observability.slo",
+    "lighthouse_tpu.observability.device_ledger",
     "lighthouse_tpu.observability.flight_recorder",
     "lighthouse_tpu.api.http_api",
     "lighthouse_tpu.qos",
@@ -207,6 +208,18 @@ def lint_registry(registry=None) -> list[str]:
                 errors.append(
                     f"{where}: jaxbls_stage_*/xla_program_* metrics must "
                     "be labeled families (stage + padding bucket)"
+                )
+        if m.name.startswith("device_ledger_"):
+            # the device ledger exists to ATTRIBUTE chip-seconds — which
+            # workload burned them, which lane, which victim waited on
+            # which occupant, which chip's books they land on. An
+            # unlabeled device_ledger_* aggregate is exactly the
+            # un-attributed number the ledger replaces, so the convention
+            # is enforced like qos_*
+            if not getattr(m, "labelnames", ()):
+                errors.append(
+                    f"{where}: device_ledger_* metrics must be labeled "
+                    "families (workload / lane / victim+occupant / chip)"
                 )
         if m.kind == "histogram":
             # a histogram's exposition series must not shadow other metrics

@@ -1,0 +1,459 @@
+"""The per-workload device ledger (PR 16): exact per-chip conservation
+(busy + contention-wait + idle == wall) on a logical clock, cross-tenant
+contention attribution (victim / occupant matrix), pipeline registration
++ `pipeline_inflight{workload}`, the unified `circuit_state{workload}`
+family beside its deprecated aliases, the fingerprint's new hash-backend
+/ mesh-topology / autotune keys, the merged per-workload device
+timeline, and the accountant's `device_contention` trigger hysteresis
+(one dump per episode — no storm under flapping contention)."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from lighthouse_tpu.observability.device_ledger import LEDGER, DeviceLedger
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def logical_ledger():
+    """The global ledger on a 2-chip logical clock; always reset after."""
+    clock = {"now": 0.0}
+    LEDGER.configure(n_chips=2, clock=lambda: clock["now"])
+    try:
+        yield clock
+    finally:
+        LEDGER.reset()
+
+
+def _advance(clock, t):
+    clock["now"] = t
+    LEDGER.tick()
+
+
+# --------------------------------------------------------- conservation
+
+
+def test_conservation_exact_on_logical_clock(logical_ledger):
+    clock = logical_ledger
+    bls = LEDGER.open("bls", lane="batch", bucket=512, est_cost=0.2)
+    _advance(clock, 0.1)              # 0.1s idle on both chips
+    bls.start()
+    _advance(clock, 0.3)              # 0.2s uncontended busy
+    th = LEDGER.open("tree_hash", lane="batch", bucket=4096)
+    _advance(clock, 0.8)              # 0.5s busy WITH a foreign waiter
+    bls.close("ok")
+    th.start()
+    _advance(clock, 0.9)
+    th.close("ok")
+    cons = LEDGER.conservation()
+    assert cons["ok"], cons
+    assert cons["wall"] == pytest.approx(0.9)
+    for chip in cons["per_chip"]:
+        assert chip["ok"], chip
+        total = chip["busy"] + chip["contention_wait"] + chip["idle"]
+        assert total == pytest.approx(chip["wall"])
+        # the contended window is exactly the overlap of bls-busy and
+        # tree_hash-waiting; both chips see it (sharded batches)
+        assert chip["contention_wait"] == pytest.approx(0.5)
+        assert chip["idle"] == pytest.approx(0.1)
+
+
+def test_contention_matrix_names_victim_and_occupant(logical_ledger):
+    clock = logical_ledger
+    bls = LEDGER.open("bls", bucket=256)
+    bls.start()
+    th = LEDGER.open("tree_hash", bucket=1024)
+    _advance(clock, 1.0)
+    bls.close("ok")
+    th.start()
+    th.close("ok")
+    matrix = LEDGER.contention_matrix()
+    assert matrix == {("tree_hash", "bls"): pytest.approx(1.0)}
+    assert LEDGER.contention_total() == pytest.approx(1.0)
+    # the incident context's "occupying batch" comes from here
+    assert LEDGER.last_bucket("bls") == 256
+
+
+def test_same_workload_waiters_are_not_victims(logical_ledger):
+    clock = logical_ledger
+    a = LEDGER.open("bls")
+    a.start()
+    b = LEDGER.open("bls")            # same tenant queued behind itself
+    _advance(clock, 1.0)
+    a.close("ok")
+    b.start()
+    b.close("ok")
+    assert LEDGER.contention_matrix() == {}
+    cons = LEDGER.conservation()
+    assert cons["ok"]
+    # busy, not contended: intra-tenant queueing is depth, not theft
+    assert cons["per_chip"][0]["busy"] == pytest.approx(1.0)
+
+
+def test_pinned_chips_contend_independently(logical_ledger):
+    clock = logical_ledger
+    busy0 = LEDGER.open("tree_hash", chips=(0,))
+    busy0.start()
+    wait0 = LEDGER.open("epoch", chips=(0,))
+    _advance(clock, 1.0)
+    busy0.close("ok")
+    wait0.start()
+    wait0.close("ok")
+    cons = LEDGER.conservation()
+    assert cons["ok"]
+    # chip 0 was contended (epoch waiting on tree_hash); chip 1 idle
+    assert cons["per_chip"][0]["contention_wait"] == pytest.approx(1.0)
+    assert cons["per_chip"][1]["idle"] == pytest.approx(1.0)
+    assert LEDGER.contention_matrix() == {
+        ("epoch", "tree_hash"): pytest.approx(1.0)
+    }
+
+
+def test_close_after_reset_is_a_noop():
+    clock = {"now": 0.0}
+    LEDGER.configure(n_chips=1, clock=lambda: clock["now"])
+    iv = LEDGER.open("bls")
+    iv.start()
+    LEDGER.reset()
+    iv.close("ok")                    # pre-reset straggler: no explosion
+    assert LEDGER.snapshot()["open_intervals"] == []
+
+
+def test_snapshot_is_json_safe(logical_ledger):
+    clock = logical_ledger
+    iv = LEDGER.open("bls", bucket=128, est_cost=0.05)
+    iv.start()
+    _advance(clock, 0.5)
+    snap = LEDGER.snapshot()
+    json.dumps(snap)                  # bundle-member contract
+    assert snap["n_chips"] == 2
+    assert snap["inflight"] == {"bls": 1}
+    assert snap["open_intervals"][0]["state"] == "busy"
+    iv.close("ok")
+
+
+# ------------------------------------------------- dispatcher integration
+
+
+def test_pipelined_dispatcher_registers_and_books_inflight():
+    from lighthouse_tpu.crypto.jaxbls import pipeline as pl
+    from lighthouse_tpu.observability.device_ledger import (
+        _PIPELINE_INFLIGHT,
+    )
+
+    clock = {"now": 0.0}
+    LEDGER.configure(n_chips=1, clock=lambda: clock["now"])
+    try:
+        disp = pl.PipelinedDispatcher(depth=2, workload="unit_bls")
+        assert "unit_bls" in LEDGER.workloads()
+
+        seen = {}
+
+        class _Handle:
+            def result(self):
+                return 7
+
+        def dispatch():
+            seen["inflight"] = _PIPELINE_INFLIGHT.labels("unit_bls").value
+            return _Handle()
+
+        t = disp.submit(dispatch)
+        assert t.result() == 7
+        disp.drain()
+        # the interval was busy while the device fn ran...
+        assert seen["inflight"] == 1.0
+        # ...and resolved with the ticket
+        assert _PIPELINE_INFLIGHT.labels("unit_bls").value == 0.0
+        assert LEDGER.snapshot()["open_intervals"] == []
+    finally:
+        LEDGER.reset()
+
+
+def test_named_dispatchers_cover_every_tenant():
+    """The real dispatch paths register under the canonical tenant names
+    (backend.py, engine.py, runner.py wire workload=...)."""
+    import inspect
+
+    from lighthouse_tpu.crypto.jaxbls import backend as bls_backend
+    from lighthouse_tpu.jaxhash import engine as hash_engine
+
+    assert 'PipelinedDispatcher(workload="bls")' in inspect.getsource(
+        bls_backend
+    )
+    assert 'PipelinedDispatcher(workload="tree_hash")' in inspect.getsource(
+        hash_engine
+    )
+
+
+def test_mesh_backend_books_serves_into_the_ledger():
+    """The mesh harness is a ledger tenant: every serve opens a
+    `meshsim` interval (urgent lane pinned to chip 0, batch sharded)
+    and the stall path still closes its interval."""
+    from lighthouse_tpu.loadgen.faults import DeviceStallError
+    from lighthouse_tpu.loadgen.meshsim import MeshShardedBackend
+    from lighthouse_tpu.observability.device_ledger import _BUSY
+
+    LEDGER.reset()
+    try:
+        be = MeshShardedBackend(2, base_ms=1.0, per_set_ms=0.0,
+                                wait_secs=0.01)
+        assert "meshsim" in LEDGER.workloads()
+        before = {
+            lane: _BUSY.labels("meshsim", lane).value
+            for lane in ("batch", "urgent")
+        }
+        assert be.verify_signature_sets([object()] * 4, None)
+        assert be.verify_signature_sets_urgent([object()], None)
+        for lane in ("batch", "urgent"):
+            assert _BUSY.labels("meshsim", lane).value > before[lane]
+        # a stalled collective raises, but the interval still closes
+        be.stall_chip(0)
+        with pytest.raises(DeviceStallError):
+            be.verify_signature_sets_urgent([object()], None)
+        be.release()
+        assert LEDGER.snapshot()["open_intervals"] == []
+    finally:
+        LEDGER.reset()
+
+
+def test_circuit_state_unified_family_and_deprecated_alias():
+    from lighthouse_tpu.qos.breaker import CIRCUIT_STATE, CircuitBreaker
+    from lighthouse_tpu.utils.metrics import REGISTRY
+
+    br = CircuitBreaker("unit_ledger_breaker", failure_threshold=1,
+                        reset_timeout=60.0, workload="unit_ledger")
+    assert CIRCUIT_STATE.labels("unit_ledger").value == 0.0
+    br.record_failure()
+    assert CIRCUIT_STATE.labels("unit_ledger").value == 1.0
+    # the legacy per-workload gauges survive as deprecated aliases
+    import lighthouse_tpu.crypto.bls.hybrid  # noqa: F401
+    import lighthouse_tpu.jaxhash.router  # noqa: F401
+
+    m = {x.name: x for x in REGISTRY.all_metrics()}
+    assert "DEPRECATED" in m["bls_device_circuit_state"].help
+    assert "DEPRECATED" in m["tree_hash_circuit_state"].help
+    assert 'circuit_state{workload="bls"}' in m["bls_device_circuit_state"].help
+
+
+# ----------------------------------------------------------- fingerprint
+
+
+def test_config_fingerprint_names_backend_topology_and_profile():
+    from lighthouse_tpu.observability.flight_recorder import (
+        config_fingerprint,
+    )
+
+    fp = config_fingerprint()
+    assert "hash_backend" in fp
+    assert "mesh_topology" in fp
+    assert "autotune_profile" in fp
+    assert fp["hash_backend"] in ("host", "device", "hybrid", None)
+    assert len(fp["sha256"]) == 64
+    # two reads agree (the hash covers the new keys deterministically)
+    assert config_fingerprint()["sha256"] == fp["sha256"]
+
+
+# ------------------------------------------------------- device timeline
+
+
+def test_perfetto_timeline_has_distinct_tracks_and_stable_order(
+        logical_ledger):
+    clock = logical_ledger
+    bls = LEDGER.open("bls", bucket=512)
+    bls.start()
+    th = LEDGER.open("tree_hash", bucket=2048)
+    _advance(clock, 0.4)
+    bls.close("ok")
+    th.start()
+    _advance(clock, 0.6)
+    th.close("ok")
+    spans = LEDGER.perfetto_device_timeline()
+    tracks = {s[0] for s in spans}
+    assert tracks == {"bls", "tree_hash", "tree_hash:wait"}
+    busy = [s for s in spans if s[0] == "bls"][0]
+    assert busy[1] == "bls:batch"
+    assert busy[4]["bucket"] == 512
+    assert busy[4]["outcome"] == "ok"
+    # deterministic ordering: sorted by (t0, t1, track, name)
+    assert spans == sorted(spans, key=lambda s: (s[2], s[3], s[0], s[1]))
+    assert spans == LEDGER.perfetto_device_timeline()
+
+
+def test_chrome_trace_renders_ledger_process_group(logical_ledger, tmp_path):
+    from lighthouse_tpu.observability.trace import (
+        DEVICE_LEDGER_LANE_BASE,
+        chrome_trace_events,
+    )
+
+    clock = logical_ledger
+    bls = LEDGER.open("bls")
+    bls.start()
+    th = LEDGER.open("tree_hash")
+    _advance(clock, 0.5)
+    bls.close("ok")
+    th.start()
+    _advance(clock, 0.7)
+    th.close("ok")
+    events = chrome_trace_events(
+        [], device_timeline=LEDGER.perfetto_device_timeline()
+    )
+    xs = [e for e in events if e.get("ph") == "X"]
+    assert xs and all(e["cat"] == "device_ledger" for e in xs)
+    assert all(e["tid"] >= DEVICE_LEDGER_LANE_BASE for e in xs)
+    names = {
+        e["args"]["name"] for e in events
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+    }
+    # one named lane per workload track, wait markers separate
+    assert {"ledger:bls", "ledger:tree_hash",
+            "ledger:tree_hash:wait"} <= names
+
+
+def test_cluster_merge_includes_device_ledger_group(logical_ledger,
+                                                    tmp_path):
+    """The PR 15 cluster rollup picks the ledger timeline up by default
+    (device_timeline="auto" pulls the global TRACER's wired source)."""
+    from lighthouse_tpu.observability.trace import Tracer, merge_chrome_traces
+
+    clock = logical_ledger
+    iv = LEDGER.open("bls")
+    iv.start()
+    _advance(clock, 0.3)
+    iv.close("ok")
+    node = Tracer()
+    tr = node.begin("verify")
+    tr.add_span("form_batch", 0.0, 0.1, lane="batch")
+    node.finish(tr)
+    out = tmp_path / "cluster.json"
+    n = merge_chrome_traces([("node0", node)], str(out))
+    assert n > 0
+    doc = json.loads(out.read_text())
+    procs = {
+        e["args"]["name"] for e in doc["traceEvents"]
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    assert "device_ledger" in procs
+    assert any(
+        e.get("ph") == "X" and e.get("cat") == "device_ledger"
+        for e in doc["traceEvents"]
+    )
+    # explicit None suppresses the group (single-node exports that only
+    # want pipeline spans)
+    out2 = tmp_path / "bare.json"
+    merge_chrome_traces([("node0", node)], str(out2), device_timeline=None)
+    doc2 = json.loads(out2.read_text())
+    assert not any(
+        e.get("cat") == "device_ledger" for e in doc2["traceEvents"]
+    )
+
+
+# ----------------------------------------------- contention trigger (SLO)
+
+
+class _FlapLedger:
+    """Stand-in matrix source: scripted per-slot contention deltas."""
+
+    def __init__(self):
+        self.total = {}
+
+    def bump(self, victim, occupant, secs):
+        key = (victim, occupant)
+        self.total[key] = self.total.get(key, 0.0) + secs
+
+    def contention_matrix(self):
+        return dict(self.total)
+
+    def last_bucket(self, workload):
+        return 1024
+
+
+def _accountant_with_recorder(tmp_path, threshold=0.25):
+    from lighthouse_tpu.observability.flight_recorder import RECORDER
+    from lighthouse_tpu.observability.slo import SlotAccountant
+    from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+
+    clock = ManualSlotClock(0, 1)
+    acct = SlotAccountant(export_metrics=False,
+                          contention_threshold=threshold)
+    acct.bind_clock(clock)
+    RECORDER.reset()
+    RECORDER.configure(incident_dir=str(tmp_path), clock=clock,
+                       slo_provider=acct.snapshot)
+    return acct, clock
+
+
+def _dumps(tmp_path):
+    return sorted(
+        p for p in os.listdir(tmp_path) if "device_contention" in p
+    )
+
+
+def test_contention_trigger_hysteresis_one_dump_per_episode(
+        tmp_path, monkeypatch):
+    """Flapping around the threshold must not dump-storm: the latch
+    arms on the rising edge, stays armed while contention persists, and
+    re-arms only after a clean (below-threshold) slot."""
+    from lighthouse_tpu.observability.flight_recorder import RECORDER
+
+    fake = _FlapLedger()
+    import lighthouse_tpu.observability.device_ledger as dl
+
+    monkeypatch.setattr(dl, "LEDGER", fake)
+    acct, clock = _accountant_with_recorder(tmp_path)
+    try:
+        for slot, secs in enumerate([0.0, 1.0, 1.0, 0.0, 1.0, 0.0]):
+            if secs:
+                fake.bump("tree_hash", "bls", secs)
+            acct.record_workload_deadline("bls", hits=1)
+            clock.set_slot(slot + 1)
+            acct.close_slot(slot)
+        # episodes: slots 1-2 (one dump), slot 4 (one dump) — NOT four
+        assert len(_dumps(tmp_path)) == 2
+        doc = json.loads(
+            (tmp_path / _dumps(tmp_path)[0]).read_text()
+        )
+        assert doc["reason"] == "device_contention"
+        assert doc["context"]["victim"] == "tree_hash"
+        assert doc["context"]["occupant"] == "bls"
+        assert doc["context"]["occupant_bucket"] == 1024
+        from lighthouse_tpu.observability.flight_recorder import (
+            validate_incident,
+        )
+
+        assert validate_incident(doc) == []
+    finally:
+        RECORDER.reset()
+        RECORDER.configure(incident_dir=None, clock=None,
+                           slo_provider=None)
+
+
+def test_contention_trigger_reports_per_workload_windows(tmp_path):
+    """The workload dimension lands in SlotReport and the window
+    summaries: per-workload hit counts + deadline-hit ratios + burn."""
+    from lighthouse_tpu.observability.flight_recorder import RECORDER
+
+    acct, clock = _accountant_with_recorder(tmp_path)
+    try:
+        acct.record_workload_deadline("bls", hits=90, misses=10)
+        acct.record_workload_deadline("tree_hash", hits=5)
+        clock.set_slot(1)
+        reps = acct.close_slot(0)
+        assert reps, "slot report expected"
+        rep = reps[-1].as_dict()
+        assert rep["workloads"]["bls"]["hits"] == 90
+        assert rep["workloads"]["bls"]["hit_ratio"] == pytest.approx(0.9)
+        assert rep["workloads"]["tree_hash"]["hit_ratio"] == 1.0
+        win = acct.window_summary("slot_5")
+        assert win["workloads"]["bls"]["deadline_hit_ratio"] == (
+            pytest.approx(0.9)
+        )
+        assert win["workloads"]["bls"]["burn_rate"] > 0
+    finally:
+        RECORDER.reset()
+        RECORDER.configure(incident_dir=None, clock=None,
+                           slo_provider=None)

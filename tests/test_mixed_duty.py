@@ -1,0 +1,233 @@
+"""The mixed_duty proving ground (PR 16): BLS + state-root + epoch
+tenants on one logical device over the global device ledger. Covers the
+in-process harness gates (per-chip conservation, per-workload SLO
+blocks, the stall-induced device_contention incident), the driver /
+CLI exit-code contract, the --trace-out device-timeline render, the
+BENCH_MATRIX per-workload rows, and the slow-marked multi-run
+bit-identical stress."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from lighthouse_tpu.loadgen.mixed_duty import run_mixed_duty_scenario
+from lighthouse_tpu.loadgen.scenarios import (
+    get_mixed_duty_scenario,
+    get_scenario,
+    is_mixed_duty,
+    mixed_duty_smoke_variant,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _smoke_sc():
+    return mixed_duty_smoke_variant(get_mixed_duty_scenario("mixed_duty"))
+
+
+@pytest.fixture(scope="module")
+def smoke_report(tmp_path_factory):
+    """One smoke run shared by the read-only assertions below."""
+    d = tmp_path_factory.mktemp("mixed-duty")
+    return run_mixed_duty_scenario(_smoke_sc(), datadir=str(d))
+
+
+# ------------------------------------------------------------- scenario
+
+
+def test_scenario_registry_and_smoke_variant():
+    assert is_mixed_duty("mixed_duty")
+    assert not is_mixed_duty("flood")
+    sc = _smoke_sc()
+    assert sc.n_validators <= 4096
+    s0, s1 = sc.stall_slots
+    assert 0 < s0 < s1 <= sc.slots
+    with pytest.raises(KeyError) as e:
+        get_scenario("mixed_duty")
+    # the generic resolver's error names the mixed_duty family
+    assert "mixed_duty" in str(e.value)
+
+
+# ----------------------------------------------------------- the gates
+
+
+def test_smoke_run_passes_every_gate(smoke_report):
+    gate = smoke_report["gate"]
+    assert gate["ok"], gate
+    assert gate["conservation_ok"]
+    assert gate["workload_blocks_ok"]
+    assert gate["contention_incident_ok"]
+
+
+def test_per_chip_conservation_is_exact(smoke_report):
+    ledger = smoke_report["deterministic"]["device_ledger"]
+    cons = ledger["conservation"]
+    assert cons["ok"]
+    assert len(cons["per_chip"]) == _smoke_sc().n_chips
+    for chip in cons["per_chip"]:
+        assert chip["ok"], chip
+        total = chip["busy"] + chip["contention_wait"] + chip["idle"]
+        assert total == pytest.approx(cons["wall"], abs=1e-6)
+        # the stall made every chip feel contention
+        assert chip["contention_wait"] > 0
+
+
+def test_every_tenant_lands_slo_block_and_busy_time(smoke_report):
+    workloads = smoke_report["deterministic"]["workloads"]
+    assert set(workloads) == {"bls", "tree_hash", "epoch"}
+    for w, blk in workloads.items():
+        assert blk["hits"] + blk["misses"] > 0, w
+        assert blk["busy_seconds"] > 0, w
+    # the per-slot reports carry the same dimension (slo.py workload
+    # blocks in every SlotReport of the run's windows)
+    win = smoke_report["slo"]["windows"]["epoch_32"]
+    assert {"bls", "tree_hash", "epoch"} <= set(win["workloads"])
+    assert win["workloads"]["bls"]["deadline_hit_ratio"] is not None
+
+
+def test_stall_produces_schema_valid_contention_incident(smoke_report):
+    incidents = smoke_report["deterministic"]["contention_incidents"]
+    assert len(incidents) >= 1
+    inc = incidents[0]
+    # the dump names the victim, the occupant, and the occupying
+    # batch's padding bucket (validated schema-clean by the harness)
+    assert inc["victim"] in ("tree_hash", "epoch")
+    assert inc["occupant"] == "bls"
+    assert inc["occupant_bucket"] is not None
+    # contention concentrates inside the injected stall window
+    sc = _smoke_sc()
+    per_slot = smoke_report["deterministic"]["per_slot"]
+    stalled = [s["contention_delta"] for s in per_slot if s["stalled"]]
+    calm = [
+        s["contention_delta"] for s in per_slot
+        if not s["stalled"] and s["slot"] < sc.slots
+    ]
+    assert max(stalled) > max(calm)
+
+
+def test_ledger_detaches_after_run(smoke_report):
+    """The run restores the process-wide ledger to its wall-clock
+    defaults — the next tenant in this process starts on clean books."""
+    from lighthouse_tpu.observability.device_ledger import LEDGER
+
+    assert LEDGER.n_chips == 1
+    assert LEDGER.snapshot()["open_intervals"] == []
+
+
+# ------------------------------------------------------ driver contract
+
+
+def test_driver_exit_zero_writes_report_rows_and_trace(tmp_path, capsys):
+    from lighthouse_tpu.loadgen import driver
+
+    out = tmp_path / "md.json"
+    trace = tmp_path / "md_trace.json"
+    rc = driver.drive(
+        scenario="mixed_duty", smoke=True, quiet=True, out=str(out),
+        datadir=str(tmp_path / "dd"), bench_matrix=True,
+        bench_root=str(tmp_path), trace_out=str(trace),
+    )
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["gate"]["ok"]
+    assert summary["gate"]["rerun_identical"]
+    assert summary["trace_out"] == str(trace)
+    report = json.loads(out.read_text())
+    assert report["mixed_duty"] is True
+    assert report["gate"]["ok"]
+    # per-workload BENCH_MATRIX rows (source: loadtest)
+    matrix = json.loads((tmp_path / "BENCH_MATRIX_SMOKE.json").read_text())
+    for w in ("bls", "tree_hash", "epoch"):
+        row = matrix[f"loadtest_mixed_duty_{w}"]
+        assert row["source"] == "loadtest"
+        assert row["workload"] == w
+        assert row["busy_seconds"] > 0
+    # the trace renders one ledger lane per workload track
+    doc = json.loads(trace.read_text())
+    lanes = {
+        e["args"]["name"] for e in doc["traceEvents"]
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+    }
+    assert {"ledger:bls", "ledger:tree_hash", "ledger:epoch"} <= lanes
+    assert any(
+        e.get("ph") == "X" and e.get("cat") == "device_ledger"
+        for e in doc["traceEvents"]
+    )
+
+
+def test_driver_mesh_sweep_refuses_mixed_duty(tmp_path, capsys):
+    from lighthouse_tpu.loadgen import driver
+
+    rc = driver.drive(scenario="mixed_duty", smoke=True, quiet=True,
+                      mesh_devices=["1", "2"],
+                      out=str(tmp_path / "r.json"))
+    assert rc == 1
+    assert "mixed_duty" in capsys.readouterr().err
+
+
+def test_bn_loadtest_mixed_duty_smoke_cli(tmp_path):
+    out = tmp_path / "md.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "lighthouse_tpu", "bn", "loadtest",
+         "--scenario", "mixed_duty", "--smoke", "--quiet",
+         "--out", str(out)],
+        capture_output=True, text=True, cwd=ROOT, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert summary["scenario"] == "mixed_duty"
+    assert summary["gate"]["ok"]
+    assert summary["gate"]["rerun_identical"]
+    assert summary["conservation"]["ok"]
+    report = json.loads(out.read_text())
+    assert report["gate"]["contention_incident_ok"]
+
+
+# ------------------------------------------------------------ debug seam
+
+
+def test_debug_bundle_packages_ledger_and_mixed_duty_report(tmp_path):
+    from lighthouse_tpu.observability.debug_bundle import build_bundle
+
+    root = tmp_path / "install"
+    root.mkdir()
+    run_mixed_duty_scenario(
+        _smoke_sc(), out_path=str(root / "LOADGEN_SMOKE.json"),
+        datadir=str(tmp_path / "dd"),
+    )
+    manifest = build_bundle(str(tmp_path / "bundle.tgz"), root=str(root))
+    assert manifest["status"]["device_ledger.json"] == "ok"
+    assert manifest["status"]["mixed_duty_report.json"] == "ok"
+    import tarfile
+
+    with tarfile.open(tmp_path / "bundle.tgz") as tar:
+        doc = json.load(tar.extractfile("mixed_duty_report.json"))
+    assert doc["scenario"] == "mixed_duty"
+    assert doc["gate"]["ok"]
+    assert doc["device_ledger"]["conservation"]["ok"]
+
+
+# -------------------------------------------------------------- stress
+
+
+@pytest.mark.slow
+def test_multi_run_bit_identical_stress(tmp_path):
+    """Three full (non-smoke) runs byte-agree on the deterministic core,
+    and a different seed does NOT (the comparison has teeth)."""
+    from dataclasses import replace
+
+    sc = get_mixed_duty_scenario("mixed_duty")
+    cores = []
+    for i in range(3):
+        rep = run_mixed_duty_scenario(sc, datadir=str(tmp_path / str(i)))
+        cores.append(json.dumps(rep["deterministic"], sort_keys=True))
+    assert cores[0] == cores[1] == cores[2]
+    other = run_mixed_duty_scenario(
+        replace(sc, seed=sc.seed + 1), datadir=str(tmp_path / "seed")
+    )
+    assert json.dumps(other["deterministic"], sort_keys=True) != cores[0]
