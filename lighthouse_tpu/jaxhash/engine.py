@@ -228,32 +228,44 @@ class _LevelsHandle:
     """In-flight ladder dispatch: resolves to host word arrays. With
     `last_only` just the final device level transfers — the root-only
     path (ssz merkleize) must not pay ~2x the leaf bytes of device->host
-    copies for levels it immediately discards."""
+    copies for levels it immediately discards. `first` skips the
+    transfers below that level index (None placeholders keep positions);
+    the final device level always materializes — the host tail hashes
+    upward from it."""
 
-    __slots__ = ("_levels", "_last_only")
+    __slots__ = ("_levels", "_last_only", "_first")
 
-    def __init__(self, levels, last_only=False):
+    def __init__(self, levels, last_only=False, first=0):
         self._levels = levels
         self._last_only = last_only
+        self._first = first
 
     def result(self):
         levels = self._levels
         if self._last_only:
             out = [np.asarray(levels[-1])]
         else:
-            out = [np.asarray(lvl) for lvl in levels]
+            last = len(levels) - 1
+            out = [
+                np.asarray(lvl) if i >= self._first or i == last else None
+                for i, lvl in enumerate(levels)
+            ]
         self._levels = None  # drop device refs once materialized
         return out
 
 
 def device_build_levels(leaves: np.ndarray, depth: int,
-                        root_only: bool = False):
+                        root_only: bool = False, min_level: int = 0):
     """(levels, root) for `leaves` ((n, 32) uint8, n >= 1) padded to
     2**depth — bit-identical to ssz/tree_cache._build: level d is the
     (ceil(n/2^(d+1)), 32) parent array, the list is `depth` long (virtual
     zero-hash levels included), the root is the top node. With
     `root_only=True` levels is None and only the top device level
-    transfers to host (the merkleize root path).
+    transfers to host (the merkleize root path). With `min_level` the
+    device levels below that index skip the device->host transfer and
+    come back as None (best-effort: host-tail levels above the mesh stop
+    are computed regardless, they're a handful of tiny arrays) — the CoW
+    spine build at 1M leaves drops ~32 MB of copies this way.
 
     The device computes the padded pow2 ladder (zero-chunk padding IS the
     SSZ zero-hash folding, so trimmed prefixes match the host builder
@@ -286,7 +298,8 @@ def device_build_levels(leaves: np.ndarray, depth: int,
     placed = put(words)
 
     dev_levels = _get_dispatcher().submit(
-        lambda: _LevelsHandle(ladder(placed), last_only=root_only)
+        lambda: _LevelsHandle(ladder(placed), last_only=root_only,
+                              first=min_level)
     ).result()
 
     import hashlib
@@ -308,6 +321,9 @@ def device_build_levels(leaves: np.ndarray, depth: int,
     full = None
     for lvl_words in dev_levels:  # widths nb/2 ... stop
         cur_w = (cur_w + 1) // 2
+        if lvl_words is None:  # skipped transfer (below min_level)
+            levels.append(None)
+            continue
         full = bytes_from_words(lvl_words)
         levels.append(full[:cur_w].copy())
     # host tail: the remaining real levels below the mesh stop width ...

@@ -118,6 +118,16 @@ def effective_balance_vec(xp, balances, eff, incr, downward, upward, max_eff):
 # --------------------------------------------------------- state -> arrays
 
 
+def _seq_array(seq, dtype, n: int) -> np.ndarray:
+    """Marshal a state field to an array: chunk-wise for CowList-backed
+    fields (no per-element Python iteration at the top), fromiter for
+    plain lists."""
+    to_numpy = getattr(seq, "to_numpy", None)
+    if to_numpy is not None:
+        return to_numpy(dtype)
+    return np.fromiter(seq, dtype, n)
+
+
 def _registry_arrays(state):
     vals = state.validators
     n = len(vals)
@@ -204,10 +214,8 @@ def altair_deltas(state, spec, fork, eligible):
     prev = acc.get_previous_epoch(state, spec)
     cur = acc.get_current_epoch(state, spec)
     eff, slashed, activation, exit_ep = _registry_arrays(state)
-    part_prev = np.fromiter(
-        state.previous_epoch_participation, np.uint8, n
-    )
-    scores = np.fromiter(state.inactivity_scores, np.uint64, n)
+    part_prev = _seq_array(state.previous_epoch_participation, np.uint8, n)
+    scores = _seq_array(state.inactivity_scores, np.uint64, n)
     active_cur = _active_mask(activation, exit_ep, cur)
     active_prev = _active_mask(activation, exit_ep, prev)
     eligible_mask = np.zeros(n, bool)
@@ -350,7 +358,7 @@ def effective_balance_updates(state, spec):
     eff = np.fromiter(
         (v.effective_balance for v in state.validators), np.uint64, n
     )
-    balances = np.fromiter(state.balances, np.uint64, n)
+    balances = _seq_array(state.balances, np.uint64, n)
     hysteresis_incr = spec.effective_balance_increment // spec.hysteresis_quotient
     downward = hysteresis_incr * spec.hysteresis_downward_multiplier
     upward = hysteresis_incr * spec.hysteresis_upward_multiplier

@@ -112,14 +112,16 @@ class TreeHashRouter:
         return "device", "ok"
 
     def maybe_build_levels(self, leaves, depth: int, n_leaves: int | None = None,
-                           root_only: bool = False):
+                           root_only: bool = False, min_level: int = 0):
         """(levels, root) exactly as ssz/tree_cache._build would return,
         via the device — or None when the host path should serve (the
         caller runs its unchanged hashlib ladder). Never raises. `leaves`
         may be a zero-arg callable producing the (n, 32) uint8 array (with
         `n_leaves` given), so a host-routed call never pays the marshal;
         `root_only` skips the per-level device->host transfers (levels
-        comes back None)."""
+        comes back None); `min_level` lets a caller that retains only the
+        upper levels (the CoW spine) skip the device->host transfers of
+        everything below it — those entries come back None."""
         n = int(n_leaves if n_leaves is not None else leaves.shape[0])
         path, reason = self._route(n)
         if path == "host":
@@ -130,8 +132,11 @@ class TreeHashRouter:
         from . import engine
 
         try:
+            # min_level only when asked: the 2-kwarg call shape is the
+            # stable seam tests/monkeypatched engines rely on
+            kw = {"min_level": min_level} if min_level else {}
             result = engine.device_build_levels(leaves, depth,
-                                                root_only=root_only)
+                                                root_only=root_only, **kw)
         except Exception as e:
             self._breaker.record_failure()
             self._log.warn(
@@ -143,6 +148,17 @@ class TreeHashRouter:
         self._breaker.record_success()
         _ROUTE.labels("device", "ok").inc()
         return result
+
+    def prefer_full_build(self, n_leaves: int, n_dirty_leaves: int) -> bool:
+        """The CoW incremental-vs-rebuild decision: per-chunk host rehash
+        wins while the dirty fraction is small; past it a full ladder is
+        cheaper — and when the full ladder would be served by the DEVICE
+        the crossover drops (the rebuild amortizes over the mesh while
+        the dirty-path rehash is always host-serial)."""
+        path, _ = self._route(n_leaves)
+        if path == "device":
+            return n_dirty_leaves * 4 >= n_leaves
+        return n_dirty_leaves > max(64, n_leaves // 8)
 
     def maybe_tree_root(self, leaves, depth: int, n_leaves: int | None = None):
         """Root-only entry for ssz/core.merkleize: bytes, or None for the

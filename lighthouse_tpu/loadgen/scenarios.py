@@ -335,6 +335,12 @@ class StateRootScenario:
 
 STATE_ROOT_SCENARIOS: dict[str, StateRootScenario] = {
     "state_root": StateRootScenario(name="state_root"),
+    # mainnet scale: the CowList-backed registry (ssz/cow.py) — fewer
+    # slots because each carries the same churn shape but the fixture
+    # build and ground-truth rehash dominate the wall clock
+    "state_root_1m": StateRootScenario(
+        name="state_root_1m", n_validators=1_048_576, slots=4
+    ),
 }
 
 

@@ -42,6 +42,7 @@ def run_state_root_scenario(sc: StateRootScenario, out_path: str | None = None,
     if sc.hash_backend is not None:
         set_hash_backend(sc.hash_backend)
     route_before = _route_totals()
+    cow_before = _cow_snapshot()
     try:
         spec, types, state = build_synthetic_state(
             sc.n_validators, participation_seed=sc.seed & 0xFFFF
@@ -125,6 +126,10 @@ def run_state_root_scenario(sc: StateRootScenario, out_path: str | None = None,
             # route delta over the run: which path actually served (the
             # tree_hash_route_total families, scoped to this scenario)
             "tree_hash_routes": _route_delta(route_before),
+            # CoW accounting over the run: chunks copied/re-hashed and
+            # how the roots were served (tree_cache_root_total outcomes),
+            # plus the final state's per-field chunk sharing
+            "cow": _cow_delta(cow_before, state),
             "elapsed_secs": round(time.time() - t_wall, 3),
             # what --bench-matrix style writers read (driver summary)
             "verify_observations": {
@@ -154,3 +159,32 @@ def _route_delta(before: dict) -> dict:
         for k, v in after.items()
         if v - before.get(k, 0)
     }
+
+
+def _cow_snapshot() -> dict:
+    from ..ssz.cow import cow_totals
+    from ..ssz.tree_cache import root_outcome_totals
+
+    snap = cow_totals()
+    snap["root_outcomes"] = root_outcome_totals()
+    return snap
+
+
+def _cow_delta(before: dict, state) -> dict:
+    from ..ssz.cow import CowList
+
+    after = _cow_snapshot()
+    out = {}
+    for family in ("chunk_copies", "chunk_rehash", "root_outcomes"):
+        prev = before.get(family, {})
+        out[family] = {
+            k: v - prev.get(k, 0)
+            for k, v in after.get(family, {}).items()
+            if v - prev.get(k, 0)
+        }
+    out["shared_chunks"] = {
+        f.name: getattr(state, f.name).shared_chunk_stats()
+        for f in state.__class__.ssz_type.fields
+        if isinstance(getattr(state, f.name), CowList)
+    }
+    return out

@@ -579,14 +579,25 @@ def trend_report(
             )
 
     # state-root p50 (ms, LOWER is better) — the second workload's trend
-    # series, read from the BENCH_MATRIX state_root row's bounded history
-    # (every entry written by bench_state_root.py --bench-matrix is a
-    # fresh measurement; entries marked fresh=false — a hand-carried or
-    # legacy value — render as carried and can neither cause nor mask a
-    # regression, the config1_p50 contract)
-    sr_row = matrix.get("state_root") or {}
+    # series, read from the bounded histories of EVERY state_root* row
+    # (the 16k row keeps the historic unsuffixed key; scale variants like
+    # state_root_1m land beside it — same-config gating below already
+    # separates them by validator count). Every entry written by
+    # bench_state_root.py --bench-matrix is a fresh measurement; entries
+    # marked fresh=false — a hand-carried or legacy value — render as
+    # carried and can neither cause nor mask a regression, the
+    # config1_p50 contract.
+    # row histories are append-ordered (write_loadtest_rows), which IS the
+    # chronology within a row; rows never share a config key (validators
+    # differ), so concatenation order across rows cannot create a
+    # cross-row pair below — no re-sort by measured_unix (tests use it as
+    # an opaque stamp, not a clock)
     sr_entries = [
-        e for e in (sr_row.get("history") or []) if isinstance(e, dict)
+        e
+        for key in sorted(matrix)
+        if key == "state_root" or key.startswith("state_root_")
+        for e in ((matrix.get(key) or {}).get("history") or [])
+        if isinstance(e, dict)
     ]
     sr_fresh = [
         e for e in sr_entries if e.get("fresh", True) and e.get("p50_ms")

@@ -411,6 +411,17 @@ class List(SSZType):
         return items
 
     def hash_tree_root(self, value) -> bytes:
+        # CowList-backed values (the big state fields) carry their own
+        # dirty-chunk set — the recorded diff IS the tree-hash diff, so
+        # the CoW path skips both the O(n) leaf marshal and the O(n)
+        # snapshot diff. It declines (None) for ineligible shapes and the
+        # generic path below serves unchanged.
+        from .cow import CowList, cow_list_root
+
+        if isinstance(value, CowList):
+            root = cow_list_root(self, value)
+            if root is not None:
+                return mix_in_length(root, len(value))
         items = list(value)
         if isinstance(self.element, Uint) or self.element is boolean:
             data = self._pack_basic(items)

@@ -16,10 +16,30 @@ from . import mutators as mut
 
 
 def process_epoch(state, spec: ChainSpec, types, fork: ForkName) -> None:
-    if fork == ForkName.phase0:
-        _process_epoch_phase0(state, spec, types)
-    else:
-        _process_epoch_altair(state, spec, types, fork)
+    from ..ssz.cow import CowList
+
+    # The scalar spec loops below index per element millions of times at
+    # validator scale, and a CowList element read costs ~3x a plain
+    # list's — so the epoch runs over flat lists and diff-rebuilds the
+    # chunked backing afterwards (CowList.rebuild_from: unchanged chunks
+    # stay shared and clean, so post-epoch roots remain incremental over
+    # whatever the epoch left untouched).
+    cow_fields = {}
+    for f in state.__class__.ssz_type.fields:
+        v = getattr(state, f.name)
+        if isinstance(v, CowList):
+            cow_fields[f.name] = v
+            setattr(state, f.name, v.to_list())
+    try:
+        if fork == ForkName.phase0:
+            _process_epoch_phase0(state, spec, types)
+        else:
+            _process_epoch_altair(state, spec, types, fork)
+    finally:
+        for name, cow in cow_fields.items():
+            v = getattr(state, name)
+            if isinstance(v, list):
+                setattr(state, name, cow.rebuild_from(v))
 
 
 # ===================================================== altair+ path
@@ -363,7 +383,19 @@ def process_historical_summaries_update(state, spec, types):
 
 def process_participation_flag_updates(state):
     state.previous_epoch_participation = state.current_epoch_participation
-    state.current_epoch_participation = [0] * len(state.validators)
+    n = len(state.validators)
+    prev = state.previous_epoch_participation
+    from ..ssz.cow import CowList
+
+    if isinstance(prev, CowList):
+        # a CowList-backed state stays CowList-backed across the epoch
+        # boundary; filled() shares one zero chunk across the spine, so
+        # the reset is O(#chunks) instead of an O(n) allocation
+        state.current_epoch_participation = CowList.filled(
+            0, n, prev._chunk_elems, name=prev.name
+        )
+    else:
+        state.current_epoch_participation = [0] * n
 
 
 def process_sync_committee_updates(state, spec, types):

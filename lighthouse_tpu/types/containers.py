@@ -10,7 +10,12 @@ payloads, capella withdrawals, deneb blobs, electra requests).
 
 Values are the cheap generated dataclasses from ssz.core — `state.slot` is a
 plain int, `state.validators` a plain list — friendly both to host logic and
-to columnar extraction for device kernels.
+to columnar extraction for device kernels. The exception at validator scale:
+the big per-validator state fields ride `ssz/cow.py`'s chunked copy-on-write
+`CowList` (list-alike; adopted by `clone_state` once a field crosses
+`cow_min_len()`, never at genesis/deserialize construction), so clones share
+chunk structure and re-roots hash only dirty chunks. Code holding a state
+list should index/iterate it, not assume `type(...) is list`.
 """
 
 from __future__ import annotations

@@ -43,14 +43,16 @@ def build_state(n):
     return build_synthetic_state(n)
 
 
-def bench_state_root(n, reps):
+def bench_state_root(n, reps, cache=None):
+    from lighthouse_tpu.ssz.tree_cache import root_outcome_totals
     from lighthouse_tpu.testing.harness import clone_state
     from lighthouse_tpu.testing.state_fixtures import (
         build_synthetic_state,
         uncached_state_root,
     )
 
-    spec, types, state = build_synthetic_state(n)
+    outcomes_before = root_outcome_totals()
+    spec, types, state = build_synthetic_state(n, cache=cache)
 
     t0 = time.time()
     root_cold = types.BeaconState.hash_tree_root(state)
@@ -84,6 +86,7 @@ def bench_state_root(n, reps):
     assert root_check == root_steady, "cached root diverged from ground truth"
 
     steady_p50 = statistics.median(steady_secs)
+    outcomes_after = root_outcome_totals()
     return {
         "validators": n,
         "cold_ms": round(cold * 1e3, 3),
@@ -94,20 +97,26 @@ def bench_state_root(n, reps):
             round(uncached / steady_p50, 1) if steady_p50 else None
         ),
         "samples": len(steady_secs),
+        "root_outcomes": {
+            k: round(v - outcomes_before.get(k, 0))
+            for k, v in outcomes_after.items()
+            if v - outcomes_before.get(k, 0)
+        },
     }
 
 
-def bench_epoch_transition(n, reps):
+def bench_epoch_transition(n, reps, cache=None):
     """process_epoch on a participation-seeded state one slot before an
     epoch boundary — the per-epoch balance/reward vector workload the
     jaxhash epoch stage accelerates."""
-    import copy
-
     from lighthouse_tpu.state_transition.epoch import process_epoch
     from lighthouse_tpu.state_transition.slot import types_for_slot
+    from lighthouse_tpu.testing.harness import clone_state
     from lighthouse_tpu.testing.state_fixtures import build_synthetic_state
 
-    spec, types, state = build_synthetic_state(n, participation_seed=0xE9)
+    spec, types, state = build_synthetic_state(
+        n, participation_seed=0xE9, cache=cache
+    )
     spe = spec.preset.SLOTS_PER_EPOCH
     state.slot = 3 * spe - 1
     fork = spec.fork_name_at_slot(state.slot)
@@ -116,7 +125,11 @@ def bench_epoch_transition(n, reps):
     secs = []
     balances = None
     for _ in range(max(1, reps)):
-        st = copy.deepcopy(state)
+        # clone_state, not deepcopy: the per-rep copy is the production
+        # pattern (structural sharing; CowList chunks copy on write), and
+        # the determinism assert below doubles as a CoW isolation check —
+        # a write leaking through a shared chunk diverges the reps
+        st = clone_state(state, spec)
         t0 = time.time()
         process_epoch(st, spec, types, fork)
         secs.append(time.time() - t0)
@@ -155,7 +168,14 @@ def main():
                          "the gitignored BENCH_MATRIX_SMOKE.json")
     ap.add_argument("--skip-epoch", action="store_true",
                     help="state root only")
+    ap.add_argument("--fixture-cache", default="auto",
+                    choices=["auto", "on", "off"],
+                    help="npz fixture cache (testing/state_fixtures.py): "
+                         "auto caches at >= 64k validators under "
+                         ".fixture_cache/ (LIGHTHOUSE_TPU_FIXTURE_CACHE "
+                         "overrides the dir or disables)")
     args = ap.parse_args()
+    cache = {"auto": None, "on": True, "off": False}[args.fixture_cache]
 
     if args.hash_backend:
         from lighthouse_tpu.jaxhash import set_hash_backend
@@ -165,27 +185,40 @@ def main():
 
     n = min(args.validators, 2048) if args.smoke else args.validators
     reps = min(args.reps, 3) if args.smoke else args.reps
+    # sub-64k runs keep the historic unsuffixed keys (the perf trend gate
+    # separates configs by validator count regardless, and smoke rows land
+    # in the ungated *_SMOKE artifact whose schema consumers read
+    # "state_root"); mainnet-scale runs land beside them as
+    # state_root_<scale> / epoch_transition_<scale> rows
+    if args.smoke or n < 65536:
+        suffix = ""
+    elif n == 1_048_576:
+        suffix = "_1m"
+    elif n % 1024 == 0:
+        suffix = f"_{n // 1024}k"
+    else:
+        suffix = f"_{n}"
 
-    sr = bench_state_root(n, reps)
+    sr = bench_state_root(n, reps, cache=cache)
     print(
         f"state_root validators={n} cold={sr['cold_ms']:.1f}ms "
         f"steady_p50={sr['p50_ms']:.1f}ms uncached={sr['uncached_ms']:.1f}ms "
         f"speedup_steady_vs_uncached={sr['speedup_steady_vs_uncached']}x "
-        f"hash_backend={hash_backend()}"
+        f"outcomes={sr['root_outcomes']} hash_backend={hash_backend()}"
     )
     rows = {
-        "state_root": dict(
+        f"state_root{suffix}": dict(
             sr, source="bench_state_root", hash_backend=hash_backend(),
             measured_unix=round(time.time(), 3),
         )
     }
     if not args.skip_epoch:
-        et = bench_epoch_transition(n, reps)
+        et = bench_epoch_transition(n, reps, cache=cache)
         print(
             f"epoch_transition validators={n} p50={et['p50_ms']:.1f}ms "
             f"hash_backend={hash_backend()}"
         )
-        rows["epoch_transition"] = dict(
+        rows[f"epoch_transition{suffix}"] = dict(
             et, source="bench_state_root", hash_backend=hash_backend(),
             measured_unix=round(time.time(), 3),
         )

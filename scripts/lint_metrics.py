@@ -55,6 +55,8 @@ METRIC_MODULES = (
     "lighthouse_tpu.crypto.jaxbls.pipeline",
     "lighthouse_tpu.jaxhash",
     "lighthouse_tpu.jaxhash.engine",
+    "lighthouse_tpu.ssz.tree_cache",
+    "lighthouse_tpu.ssz.cow",
     "lighthouse_tpu.autotune.profiler",
     "lighthouse_tpu.observability",
     "lighthouse_tpu.observability.device",
@@ -177,6 +179,18 @@ def lint_registry(registry=None) -> list[str]:
                 errors.append(
                     f"{where}: jaxhash_*/tree_hash_route_* metrics must "
                     "be labeled families (lane / op / path+reason)"
+                )
+        if m.name.startswith(("tree_cache_", "state_cow_")):
+            # the state layer's series answer "HOW was this root served
+            # (hit/update/build), WHICH field's chunks copied or re-hashed,
+            # which cache kind holds the bytes" — an unlabeled aggregate
+            # over fields or outcomes cannot prove the O(changed-chunks)
+            # contract the CoW layer exists for, so the convention is
+            # enforced like jaxhash_*/tree_hash_route_*
+            if not getattr(m, "labelnames", ()):
+                errors.append(
+                    f"{where}: tree_cache_*/state_cow_* metrics must be "
+                    "labeled families (outcome / field / kind)"
                 )
         if m.name.startswith(("vc_", "fleet_")):
             # the validator duty path's series answer "which duty / which

@@ -1,0 +1,412 @@
+"""Chunked copy-on-write state vectors (ssz/cow.py): list semantics,
+root parity against the cache-free ground truth, the O(changed-chunks)
+post-block hashing contract (asserted via the state_cow_* /
+tree_cache_root_total counters, never timing), fork-fanout chunk
+sharing, the npz fixture disk cache, and the CoW-backed state_root
+loadtest scenario."""
+
+import copy
+import random
+
+import pytest
+
+from lighthouse_tpu.jaxhash.router import set_hash_backend
+from lighthouse_tpu.ssz.core import List, uint64, uint256
+from lighthouse_tpu.ssz.cow import (
+    CowList,
+    cow_chunk_elems,
+    cow_list_root,
+    cow_totals,
+    maybe_adopt,
+)
+from lighthouse_tpu.ssz.tree_cache import root_outcome_totals
+from lighthouse_tpu.testing.harness import clone_state
+from lighthouse_tpu.testing.state_fixtures import (
+    build_synthetic_state,
+    uncached_state_root,
+)
+
+
+@pytest.fixture(autouse=True)
+def _host_default():
+    set_hash_backend(None)
+    yield
+    set_hash_backend(None)
+
+
+def _outcome_delta(before):
+    after = root_outcome_totals()
+    return {k: v - before.get(k, 0) for k, v in after.items()
+            if v - before.get(k, 0)}
+
+
+def _rehash_delta(before):
+    after = cow_totals()["chunk_rehash"]
+    prev = before["chunk_rehash"]
+    return {k: v - prev.get(k, 0) for k, v in after.items()
+            if v - prev.get(k, 0)}
+
+
+# ------------------------------------------------------------- semantics
+
+
+def test_cowlist_sequence_semantics():
+    """CowList must behave like a plain list for every operation the
+    state transition uses — checked against a mirrored list oracle."""
+    cow = CowList(range(10), chunk_elems=4, name="sem")
+    ref = list(range(10))
+    assert len(cow) == 10 and list(cow) == ref and cow == ref
+    assert cow[0] == 0 and cow[9] == 9 and cow[-1] == 9 and cow[-10] == 0
+    assert cow[2:7] == ref[2:7] and cow[::3] == ref[::3]
+    with pytest.raises(IndexError):
+        cow[10]
+    with pytest.raises(IndexError):
+        cow[-11]
+
+    cow[5] = 55
+    ref[5] = 55
+    cow[-1] = 99
+    ref[-1] = 99
+    cow[1:4] = [11, 22, 33]
+    ref[1:4] = [11, 22, 33]
+    assert cow == ref
+    with pytest.raises(ValueError):
+        cow[1:4] = [1, 2]  # length-changing slice assignment
+
+    cow.append(100)       # crosses a chunk boundary (len 10 -> 11, ce=4)
+    ref.append(100)
+    cow.extend([101, 102])
+    ref.extend([101, 102])
+    assert cow == ref and len(cow) == 13
+
+    cow.insert(3, 7)      # structure-changing fallback: full re-chunk
+    ref.insert(3, 7)
+    assert cow.pop() == ref.pop()
+    assert cow.pop(0) == ref.pop(0)
+    del cow[4]
+    del ref[4]
+    assert cow == ref and cow.to_list() == ref
+    assert cow != ref + [1] and cow != "not-a-list"
+
+
+def test_cowlist_clone_isolation_and_copy_counters():
+    """A write after clone() privatizes exactly one chunk: the sibling
+    never sees it, and state_cow_chunk_copies_total counts the copy."""
+    a = CowList(range(256), chunk_elems=64, name="iso")
+    b = a.clone()
+    before = cow_totals()["chunk_copies"].get("iso", 0)
+    b[5] = -1
+    b[6] = -2              # same chunk: privatized once, written twice
+    assert a[5] == 5 and a[6] == 6 and b[5] == -1
+    assert cow_totals()["chunk_copies"].get("iso", 0) == before + 1
+    a[200] = -3            # parent lost ownership too (chunks are shared)
+    assert b[200] == 200
+    assert cow_totals()["chunk_copies"].get("iso", 0) == before + 2
+    stats = b.shared_chunk_stats()
+    assert stats == {"chunks": 4, "owned": 1, "shared": 3}
+
+
+def test_filled_shares_one_chunk_and_cow_protects_aliases():
+    """filled() aliases ONE chunk across the spine; writing through any
+    alias must copy first (the partial tail chunk is private)."""
+    f = CowList.filled(0, 130, 64, name="fill")
+    assert len(f) == 130 and list(f) == [0] * 130
+    assert f._chunks[0] is f._chunks[1]  # aliased full chunks
+    f[0] = 7
+    assert f[64] == 0 and f[129] == 0 and f[0] == 7
+
+
+def test_maybe_adopt_eligibility(monkeypatch):
+    monkeypatch.setenv("LIGHTHOUSE_TPU_COW_MIN", "100")
+    lt = List(uint64, 2**40)
+    adopted = maybe_adopt(lt, list(range(200)), "x")
+    assert isinstance(adopted, CowList)
+    assert adopted._chunk_elems == cow_chunk_elems(lt) == 256
+    assert maybe_adopt(lt, list(range(50)), "x") == list(range(50))
+    # big uints pack two-per-leaf through core's packer: never adopted
+    assert cow_chunk_elems(List(uint256, 2**40)) is None
+    monkeypatch.setenv("LIGHTHOUSE_TPU_COW_MIN", "0")
+    assert maybe_adopt(lt, list(range(200)), "x") == list(range(200))
+
+
+def test_cow_list_root_declines_small_and_misaligned():
+    lt = List(uint64, 2**40)
+    small = CowList(range(100), chunk_elems=256, name="small")
+    assert cow_list_root(lt, small) is None  # < _TREE_CACHE_MIN leaves
+    # chunk width not a whole number of leaves -> generic path serves
+    odd = CowList(range(4096), chunk_elems=6, name="odd")
+    assert cow_list_root(lt, odd) is None
+
+
+# ---------------------------------------------------------------- parity
+
+
+def _mutate_script(state, rng, n):
+    """One block's worth of seeded mutations across all five big fields —
+    identical effect on CoW-backed and plain-list states."""
+    for _ in range(6):
+        i = rng.randrange(n)
+        bal = rng.randrange(16 * 10**9, 40 * 10**9)
+        state.balances[i] = bal
+        state.validators[i] = state.validators[i].copy_with(
+            effective_balance=(bal // 10**9) * 10**9
+        )
+    for _ in range(4):
+        state.previous_epoch_participation[rng.randrange(n)] = rng.randrange(8)
+        state.current_epoch_participation[rng.randrange(n)] = rng.randrange(8)
+        state.inactivity_scores[rng.randrange(n)] = rng.randrange(16)
+
+
+def test_randomized_mutation_parity():
+    """The CoW root must stay bit-identical to a plain-list state fed the
+    same mutation script, and to the cache-free ground truth at the end."""
+    n = 4096
+    spec, types, cow_state = build_synthetic_state(
+        n, participation_seed=0xA1, cow=True, cache=False
+    )
+    _, _, plain_state = build_synthetic_state(
+        n, participation_seed=0xA1, cow=False, cache=False
+    )
+    assert isinstance(cow_state.validators, CowList)
+    assert isinstance(plain_state.validators, list)
+
+    assert (types.BeaconState.hash_tree_root(cow_state)
+            == types.BeaconState.hash_tree_root(plain_state))
+
+    rng_a, rng_b = random.Random(0xBEEF), random.Random(0xBEEF)
+    for blk in range(1, 4):
+        cow_state = clone_state(cow_state, spec)
+        plain_state = copy.deepcopy(plain_state)
+        cow_state.slot = plain_state.slot = blk
+        _mutate_script(cow_state, rng_a, n)
+        _mutate_script(plain_state, rng_b, n)
+        root_cow = types.BeaconState.hash_tree_root(cow_state)
+        root_plain = types.BeaconState.hash_tree_root(plain_state)
+        assert root_cow == root_plain, f"diverged at block {blk}"
+    assert root_cow == uncached_state_root(types, cow_state)
+
+
+def test_memoized_roots_carry_across_clones_and_hit():
+    """clone_state shares element instances, so Validator._htr memoized
+    roots carry; an unmutated clone re-roots via pure cache hits (no
+    chunk re-hashed, no build)."""
+    n = 4096
+    spec, types, state = build_synthetic_state(n, cow=True, cache=False)
+    root0 = types.BeaconState.hash_tree_root(state)
+    assert hasattr(state.validators[0], "_htr")
+
+    st = clone_state(state, spec)
+    assert st.validators[0] is state.validators[0]  # shared instance
+    before_out, before_cow = root_outcome_totals(), cow_totals()
+    assert types.BeaconState.hash_tree_root(st) == root0
+    delta = _outcome_delta(before_out)
+    assert delta.get("hit", 0) >= 3  # validators/balances/inactivity
+    assert "build" not in delta and "update" not in delta
+    assert _rehash_delta(before_cow) == {}
+
+    # one mutation flips exactly that field to the update path
+    st = clone_state(st, spec)
+    st.validators[7] = st.validators[7].copy_with(slashed=True)
+    before_out, before_cow = root_outcome_totals(), cow_totals()
+    root1 = types.BeaconState.hash_tree_root(st)
+    assert root1 != root0
+    delta = _outcome_delta(before_out)
+    assert delta.get("update", 0) == 1 and "build" not in delta
+    assert _rehash_delta(before_cow) == {"validators": 1}
+    assert root1 == uncached_state_root(types, st)
+
+
+def test_process_epoch_cow_parity_and_diff_rebuild():
+    """process_epoch flattens CowList fields to plain lists for the
+    scalar spec loops and diff-rebuilds the chunked backing at the end:
+    the CoW state must end bit-identical to a plain-list twin, stay
+    CowList-backed, keep untouched chunks shared, and re-root to the
+    cache-free ground truth."""
+    from lighthouse_tpu.state_transition.epoch import process_epoch
+    from lighthouse_tpu.state_transition.slot import types_for_slot
+
+    n = 4096
+    spec, types0, cow_state = build_synthetic_state(
+        n, participation_seed=0xE9, cow=True, cache=False
+    )
+    _, _, plain_state = build_synthetic_state(
+        n, participation_seed=0xE9, cow=False, cache=False
+    )
+    spe = spec.preset.SLOTS_PER_EPOCH
+    cow_state.slot = plain_state.slot = 3 * spe - 1
+    fork = spec.fork_name_at_slot(cow_state.slot)
+    types = types_for_slot(spec, cow_state.slot)
+    types.BeaconState.hash_tree_root(cow_state)  # warm hash state
+
+    process_epoch(cow_state, spec, types, fork)
+    process_epoch(plain_state, spec, types, fork)
+    assert isinstance(cow_state.balances, CowList)
+    assert isinstance(cow_state.validators, CowList)
+    assert list(cow_state.balances) == list(plain_state.balances)
+    root = types.BeaconState.hash_tree_root(cow_state)
+    assert root == types.BeaconState.hash_tree_root(plain_state)
+    assert root == uncached_state_root(types, cow_state)
+
+
+def test_rebuild_from_shares_unchanged_chunks():
+    """The epoch writeback primitive: rebuild_from must share every
+    unchanged chunk object, own + dirty exactly the changed ones, and
+    carry the base's hash state."""
+    base = CowList(range(512), chunk_elems=64, name="rb")
+    flat = base.to_list()
+    flat[70] = -1    # chunk 1
+    flat[400] = -2   # chunk 6
+    new = base.rebuild_from(flat)
+    assert new == flat and len(new) == 512
+    assert new._chunks[0] is base._chunks[0]  # unchanged: shared object
+    assert new._chunks[1] is not base._chunks[1]
+    assert new._owned == {1, 6}
+    assert {1, 6} <= new._dirty
+    assert base[70] == 70  # the base instance is never mutated
+    # a length change degrades to a full re-chunk (all dirty, no tree)
+    grown = base.rebuild_from(flat + [1])
+    assert len(grown) == 513 and grown._tree is None
+    assert grown._owned == set(range(len(grown._chunks)))
+
+
+def test_epoch_rotation_keeps_cow_backing():
+    from lighthouse_tpu.state_transition.epoch import (
+        process_participation_flag_updates,
+    )
+
+    n = 4096
+    spec, types, state = build_synthetic_state(
+        n, participation_seed=0xE2, cow=True, cache=False
+    )
+    old_cur = state.current_epoch_participation
+    process_participation_flag_updates(state)
+    assert state.previous_epoch_participation is old_cur
+    cur = state.current_epoch_participation
+    assert isinstance(cur, CowList) and len(cur) == n
+    assert all(v == 0 for v in cur)
+    # the rotated state still roots to ground truth
+    root = types.BeaconState.hash_tree_root(state)
+    assert root == uncached_state_root(types, state)
+
+
+# ------------------------------------------------- O(changed-chunks) scale
+
+
+def _assert_post_block_chunk_hashing(n, cache):
+    """Cold root, then one block's worth of mutation: the counters must
+    prove the re-root touched O(changed-chunks), not O(n)."""
+    spec, types, state = build_synthetic_state(n, cow=True, cache=cache)
+    for f in ("validators", "balances", "previous_epoch_participation",
+              "current_epoch_participation", "inactivity_scores"):
+        assert isinstance(getattr(state, f), CowList), f
+    root0 = types.BeaconState.hash_tree_root(state)
+
+    st = clone_state(state, spec)
+    rng = random.Random(0xD00D)
+    touched_v, touched_b = set(), set()
+    for _ in range(8):
+        i = rng.randrange(n)
+        st.validators[i] = st.validators[i].copy_with(
+            effective_balance=31 * 10**9
+        )
+        st.balances[i] = 31 * 10**9
+        touched_v.add(i // st.validators._chunk_elems)
+        touched_b.add(i // st.balances._chunk_elems)
+    before_out, before_cow = root_outcome_totals(), cow_totals()
+    root1 = types.BeaconState.hash_tree_root(st)
+    assert root1 != root0
+
+    # the O(changed-chunks) contract, by counter: exactly the touched
+    # chunks re-hashed (never the n//chunk_elems full planes), untouched
+    # CowList fields served as hits, nothing fell back to a full build
+    rehash = _rehash_delta(before_cow)
+    assert rehash == {"validators": len(touched_v),
+                      "balances": len(touched_b)}
+    n_chunks = len(st.validators._chunks)
+    assert rehash["validators"] <= 8 < n_chunks
+    delta = _outcome_delta(before_out)
+    assert delta.get("update", 0) == 2 and "build" not in delta
+    assert delta.get("hit", 0) >= 3
+
+    # fork fanout: K heads off one parent share >= (1 - eps) of chunks
+    heads = []
+    for h in range(4):
+        head = clone_state(st, spec)
+        for _ in range(4):
+            head.balances[rng.randrange(n)] = 30 * 10**9 + h
+        types.BeaconState.hash_tree_root(head)
+        heads.append(head)
+    for head in heads:
+        s = head.balances.shared_chunk_stats()
+        assert s["shared"] / s["chunks"] >= 1 - 0.05, s
+        assert head.validators.shared_chunk_stats()["owned"] == 0
+
+
+def test_post_block_chunk_hashing_64k():
+    """Tier-1 scale point of the 1M assertion (same contract, CI-sized)."""
+    _assert_post_block_chunk_hashing(65536, cache=False)
+
+
+@pytest.mark.slow
+def test_post_block_chunk_hashing_1m():
+    """Mainnet scale: 1M validators (16384 validator chunks). Uses the
+    npz fixture cache when available — the second run of this test is
+    dominated by the cold root, not the fixture build."""
+    _assert_post_block_chunk_hashing(1_048_576, cache=None)
+
+
+# ----------------------------------------------------------- disk cache
+
+
+def test_fixture_cache_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setenv("LIGHTHOUSE_TPU_FIXTURE_CACHE", str(tmp_path))
+    n, seed = 3000, 11
+    spec, types, s1 = build_synthetic_state(
+        n, participation_seed=seed, cache=True
+    )
+    npzs = list(tmp_path.glob("state_n3000_s11_*.npz"))
+    assert len(npzs) == 1
+    root1 = types.BeaconState.hash_tree_root(s1)
+
+    _, types2, s2 = build_synthetic_state(
+        n, participation_seed=seed, cache=True
+    )
+    # the cache preloads the memoized validator roots: the expensive
+    # per-validator hashing of the first root is already paid
+    assert hasattr(s2.validators[0], "_htr")
+    assert types2.BeaconState.hash_tree_root(s2) == root1
+    assert list(s1.balances) == list(s2.balances)
+
+    # disabled env means no cache dir and no reads
+    monkeypatch.setenv("LIGHTHOUSE_TPU_FIXTURE_CACHE", "off")
+    from lighthouse_tpu.testing.state_fixtures import fixture_cache_dir
+
+    assert fixture_cache_dir() is None
+    _, types3, s3 = build_synthetic_state(
+        n, participation_seed=seed, cache=True
+    )
+    assert not hasattr(s3.validators[0], "_htr")
+    assert types3.BeaconState.hash_tree_root(s3) == root1
+
+
+# ------------------------------------------------------------- scenario
+
+
+def test_state_root_scenario_smoke_with_cow(monkeypatch):
+    """The loadtest churn loop over a CowList-backed state: conservation
+    gate (ledger + ground-truth root) passes and the report's cow block
+    shows incremental serving."""
+    monkeypatch.setenv("LIGHTHOUSE_TPU_COW_MIN", "1024")
+    from lighthouse_tpu.loadgen.scenarios import get_state_root_scenario
+    from lighthouse_tpu.loadgen.state_root import run_state_root_scenario
+
+    # 8192 validators: big enough that the router's rebuild crossover
+    # keeps a block's churn on the incremental path (at the 2048 smoke
+    # clamp the dirty-chunk fraction legitimately prefers full builds)
+    sc = get_state_root_scenario("state_root", n_validators=8192, slots=3)
+    report = run_state_root_scenario(sc)
+    assert report["conservation"]["ok"], report["conservation"]
+    cow = report["cow"]
+    assert cow["root_outcomes"].get("update", 0) >= 1
+    assert "validators" in cow["shared_chunks"]
+    assert cow["chunk_rehash"].get("validators", 0) >= 1
