@@ -19,15 +19,24 @@ from __future__ import annotations
 
 import json
 import os
+import queue
 import re
+import select
+import socket
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
 from time import perf_counter
 
+from ..observability.propagation import decode_ctx, encode_ctx
+from ..observability.trace import set_current_wire_ctx
 from ..state_transition import accessors as acc
 from ..state_transition.slot import types_for_slot
 from ..types import helpers as h
+from ..utils.logging import get_logger
 from ..utils.metrics import REGISTRY
+
+log = get_logger("http_api")
 
 VERSION = "lighthouse-tpu/0.1.0"
 
@@ -40,6 +49,55 @@ _REQUEST_SECONDS = REGISTRY.histogram_vec(
     ("route", "method"),
     buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 5.0, 30.0),
 )
+
+# saturation SLIs for the bounded worker pool: how much work is in the
+# house (workers busy / sockets queued / connections parked), what was
+# turned away and why, and which read stage ate a deadline. All labeled
+# families — an unlabeled aggregate cannot answer "was that shed a
+# saturation event or a shutdown drain", and lint_metrics enforces it.
+_INFLIGHT = REGISTRY.gauge_vec(
+    "http_api_inflight",
+    "Beacon API work in flight, by kind (workers busy / queued / parked)",
+    ("kind",),
+)
+_SHED_TOTAL = REGISTRY.counter_vec(
+    "http_api_shed_total",
+    "Beacon API connections shed by the admission gate, by reason",
+    ("reason",),
+)
+_TIMEOUTS_TOTAL = REGISTRY.counter_vec(
+    "http_api_timeouts_total",
+    "Beacon API per-request read-deadline expiries, by stage",
+    ("stage",),
+)
+_ERRORS_TOTAL = REGISTRY.counter_vec(
+    "http_api_errors_total",
+    "Beacon API handler errors, by stage",
+    ("stage",),
+)
+
+
+def resolve_http_threads(explicit=None) -> int:
+    """Worker-pool size: explicit flag > LIGHTHOUSE_TPU_HTTP_THREADS env >
+    default 8 (the `bn --http-threads` knob, resolve_call_timeout idiom)."""
+    if explicit is not None:
+        return max(1, int(explicit))
+    env = os.environ.get("LIGHTHOUSE_TPU_HTTP_THREADS")
+    if env:
+        return max(1, int(env))
+    return 8
+
+
+def resolve_http_request_timeout(explicit=None) -> float:
+    """Per-request header/body read deadline: explicit flag >
+    LIGHTHOUSE_TPU_HTTP_REQUEST_TIMEOUT env > default 10s — a slow-loris
+    peer costs one worker at most this long (`bn --http-request-timeout`)."""
+    if explicit is not None:
+        return float(explicit)
+    env = os.environ.get("LIGHTHOUSE_TPU_HTTP_REQUEST_TIMEOUT")
+    if env:
+        return float(env)
+    return 10.0
 
 
 def _hex(b: bytes) -> str:
@@ -96,10 +154,22 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
     """Routes are matched with regexes against (method, path)."""
 
     server_version = VERSION
+    # HTTP/1.1: keep-alive by default, so the pooled client's reused
+    # connections survive between requests (every response path sends
+    # Content-Length — _json, _rate_limited, get_health)
+    protocol_version = "HTTP/1.1"
+    # TCP_NODELAY: the response goes out as header-flush + body write;
+    # with Nagle on, the body write waits on the peer's delayed ACK
+    # (~40ms per keep-alive request)
+    disable_nagle_algorithm = True
     chain = None           # injected by serve()
     op_pool = None
     event_bus = None
     allow_origin = None    # --http-allow-origin: CORS on every response
+    # optional Tracer: each served request records an `http_serve` trace,
+    # adopting the caller's X-LH-Trace-Ctx wire context so an HTTP-served
+    # duty's spans carry the producer's causal id in the merged timeline
+    tracer = None
     # QoS token bucket over the whole API (lighthouse_tpu/qos/ratelimit.py,
     # scope "http_api"): requests over quota are answered 429 with a
     # Retry-After header instead of queuing work behind an overloaded
@@ -112,7 +182,27 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
     def end_headers(self):
         if self.allow_origin:
             self.send_header("Access-Control-Allow-Origin", self.allow_origin)
+        ctx = getattr(self, "_wire_ctx", None)
+        if ctx is not None:
+            # echo the adopted context so the caller can confirm the causal
+            # join (hex — header-safe encoding of the wire bytes)
+            self.send_header("X-LH-Trace-Ctx", encode_ctx(ctx).hex())
         super().end_headers()
+
+    def handle(self):
+        """One request per pool dispatch: the worker decides afterwards
+        whether to park the connection for keep-alive re-admission or
+        close it — a handler thread never loops on one peer's socket."""
+        self.close_connection = True
+        self.handle_one_request()
+
+    def log_error(self, fmt, *args):
+        # handle_one_request swallows TimeoutError internally (discarding
+        # the connection) and this hook is the only signal it leaves —
+        # count the header-stage deadline here; body-stage deadlines are
+        # counted in _read_body where the stage is known precisely
+        if str(fmt).startswith("Request timed out"):
+            _TIMEOUTS_TOTAL.labels("header").inc()
     # Backpressure for the HEAVY publish paths (block/attestation/sync-
     # committee import runs verification inline in the handler thread):
     # bounded gates — work beyond the limit gets 503 immediately, like the
@@ -162,7 +252,18 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length", 0))
         if length == 0:
             return None
-        return json.loads(self.rfile.read(length))
+        try:
+            raw = self.rfile.read(length)
+        except (TimeoutError, socket.timeout):
+            # mid-body stall: the read deadline freed this worker — the
+            # connection is poisoned (partial body unread), so close it
+            _TIMEOUTS_TOTAL.labels("body").inc()
+            self.close_connection = True
+            raise ApiError(408, "body read timed out") from None
+        if len(raw) < length:
+            self.close_connection = True
+            raise ApiError(400, "truncated body")
+        return json.loads(raw)
 
     def _state_by_id(self, state_id: str):
         chain = self.chain
@@ -228,12 +329,28 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
 
     def _dispatch(self, method):
         path = self.path.split("?")[0].rstrip("/")
+        # caller-propagated wire context (X-LH-Trace-Ctx, hex of the gossip
+        # envelope encoding): tolerant decode — a malformed context must
+        # never fail the request it rode in on
+        ctx = None
+        raw_ctx = self.headers.get("X-LH-Trace-Ctx")
+        if raw_ctx:
+            try:
+                ctx = decode_ctx(bytes.fromhex(raw_ctx))
+            except ValueError:
+                ctx = None
+        self._wire_ctx = ctx
         if (
             self.rate_limiter is not None
             and path not in self.RATE_LIMIT_EXEMPT
             and not self.rate_limiter.allow("http_api")
         ):
             return self._rate_limited()
+        tr = None
+        if self.tracer is not None:
+            if ctx is not None:
+                set_current_wire_ctx(ctx)
+            tr = self.tracer.begin("http_serve")
         try:
             for pattern, meth, fn in _ROUTES:
                 m = re.fullmatch(pattern, path)
@@ -242,12 +359,21 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
                     try:
                         return fn(self, *m.groups())
                     finally:
+                        t1 = perf_counter()
                         _REQUEST_SECONDS.labels(fn.__name__, method).observe(
-                            perf_counter() - t0
+                            t1 - t0
                         )
+                        if tr is not None:
+                            tr.add_span(fn.__name__, t0, t1,
+                                        path=path, method=method)
             self._error(404, f"unknown route {path}")
         except ApiError as e:
             self._error(e.code, e.message)
+        except ConnectionError:
+            # the PEER died mid-exchange (reset/broken pipe while we wrote
+            # the response) — not a handler fault, and there is no socket
+            # left to send an error envelope on
+            self.close_connection = True
         except Exception as e:  # noqa: BLE001
             from ..chain.beacon_chain import BlockError
 
@@ -262,7 +388,17 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
                 # shapes so internal faults keep surfacing as 500s
                 self._error(400, f"invalid request: {type(e).__name__}: {e}")
             else:
+                _ERRORS_TOTAL.labels("handler").inc()
+                log.warn(
+                    "handler fault", route=path, method=method,
+                    error=f"{type(e).__name__}: {e}",
+                )
                 self._error(500, f"{type(e).__name__}: {e}")
+        finally:
+            if tr is not None:
+                self.tracer.finish(tr)
+            if ctx is not None:
+                set_current_wire_ctx(None)
 
     # ------------------------------------------------------------- handlers
 
@@ -390,6 +526,9 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
             # machine-visible reason without a body (health probes often
             # discard bodies): a header names what degraded
             self.send_header("X-Node-Degraded", ",".join(h["reasons"]))
+        # bodyless response still needs an explicit length under HTTP/1.1
+        # or the keep-alive peer would wait for a body that never comes
+        self.send_header("Content-Length", "0")
         self.end_headers()
 
     def get_version(self):
@@ -523,6 +662,10 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
             raw = bytes.fromhex(ssz_hex[2:])
             signed = types.SignedBeaconBlock.deserialize(raw)
         except Exception as e:  # noqa: BLE001
+            _ERRORS_TOTAL.labels("block_ssz_decode").inc()
+            log.warn("undecodable published block",
+                     stage="block_ssz_decode",
+                     error=f"{type(e).__name__}: {e}")
             raise ApiError(400, f"undecodable block SSZ: {e}") from e
         with self._publish_permit(self._block_publish_gate):
             self._import_published_block(signed)
@@ -1136,6 +1279,10 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
         try:
             block = types.BeaconBlock.deserialize(bytes.fromhex(raw[2:]))
         except Exception as e:  # noqa: BLE001
+            _ERRORS_TOTAL.labels("blinded_ssz_decode").inc()
+            log.warn("undecodable blinded block",
+                     stage="blinded_ssz_decode",
+                     error=f"{type(e).__name__}: {e}")
             raise ApiError(400, f"undecodable block SSZ: {e}") from e
         types = types_for_slot(self.chain.spec, block.slot)
         signed = types.SignedBeaconBlock.make(
@@ -1320,8 +1467,12 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
         slashing = types.AttesterSlashing.deserialize(bytes.fromhex(ssz_hex[2:]))
         try:
             self.chain.verify_slashing_for_pool(slashing, "attester")
-        except Exception as e:
-            raise ApiError(400, f"invalid attester slashing: {e}")
+        except Exception as e:  # noqa: BLE001
+            _ERRORS_TOTAL.labels("attester_slashing_verify").inc()
+            log.warn("rejected attester slashing",
+                     stage="attester_slashing_verify",
+                     error=f"{type(e).__name__}: {e}")
+            raise ApiError(400, f"invalid attester slashing: {e}") from e
         if self.op_pool is not None:
             self.op_pool.insert_attester_slashing(slashing)
         if self.event_bus is not None:
@@ -1362,8 +1513,12 @@ class BeaconApiHandler(BaseHTTPRequestHandler):
         slashing = types.ProposerSlashing.deserialize(bytes.fromhex(ssz_hex[2:]))
         try:
             self.chain.verify_slashing_for_pool(slashing, "proposer")
-        except Exception as e:
-            raise ApiError(400, f"invalid proposer slashing: {e}")
+        except Exception as e:  # noqa: BLE001
+            _ERRORS_TOTAL.labels("proposer_slashing_verify").inc()
+            log.warn("rejected proposer slashing",
+                     stage="proposer_slashing_verify",
+                     error=f"{type(e).__name__}: {e}")
+            raise ApiError(400, f"invalid proposer slashing: {e}") from e
         if self.op_pool is not None:
             self.op_pool.insert_proposer_slashing(slashing)
         if self.event_bus is not None:
@@ -1546,11 +1701,333 @@ class EventBus:
                 q.append((topic, payload))
 
 
+class WorkerPoolHTTPServer(HTTPServer):
+    """Bounded worker pool behind an admission gate (the ThreadingHTTPServer
+    replacement: thread-per-connection is unbounded — a connection flood IS
+    a thread flood, and a slow-loris peer pins a thread forever).
+
+    Topology: the accept loop never reads a byte — it only moves the
+    accepted socket into a bounded work queue. `--http-threads` workers pop
+    sockets, arm the per-request read deadline (`--http-request-timeout`),
+    and serve exactly ONE request per dispatch; keep-alive connections are
+    then *parked* and re-admitted through the same gate when they turn
+    readable, so an idle pool of hundreds of keep-alive VC connections
+    costs one select() set, not hundreds of threads. When the work queue is
+    full, a small shed lane answers 503 + Retry-After (health stays exempt:
+    `/eth/v1/node/health` is served inline off the shed lane so liveness
+    probes answer precisely when the node is busiest) — counted in
+    `http_api_shed_total{reason}` with a flight-recorder event on the
+    saturation edge.
+
+    `stats` (accepted / handled / shed / requeued / health_shed_path) are
+    plain monotonic counters; the fleet's wedge check reads `handled` —
+    a saturated-but-alive server keeps making progress as deadlines free
+    workers, a wedged one does not."""
+
+    allow_reuse_address = True
+    request_queue_size = 128  # listen(2) backlog under accept bursts
+
+    #: deadline for the shed lane's header read — sheds must stay cheap
+    #: even against a slow-loris peer aimed at the shed lane itself
+    SHED_READ_TIMEOUT = 1.0
+
+    def __init__(self, addr, handler_cls, http_threads=None,
+                 request_timeout=None, queue_depth=None):
+        super().__init__(addr, handler_cls)
+        self.http_threads = resolve_http_threads(http_threads)
+        self.request_timeout = resolve_http_request_timeout(request_timeout)
+        depth = (int(queue_depth) if queue_depth is not None
+                 else max(16, 2 * self.http_threads))
+        self._queue: queue.Queue = queue.Queue(depth)
+        self._shed_queue: queue.Queue = queue.Queue(max(8, self.http_threads))
+        self._parked: dict = {}  # socket -> parked_at (time.monotonic)
+        self._park_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._saturated = False  # hysteresis for the flight-recorder event
+        self.stats = {
+            "accepted": 0, "handled": 0, "shed": 0, "requeued": 0,
+            "health_shed_path": 0,
+        }
+        self._workers = [
+            threading.Thread(target=self._worker, daemon=True,
+                             name=f"http-worker-{i}")
+            for i in range(self.http_threads)
+        ]
+        self._shedder = threading.Thread(
+            target=self._shedder_loop, daemon=True, name="http-shedder"
+        )
+        # self-pipe: _park() wakes the parker so a connection reused
+        # immediately after its response re-admits in microseconds, not
+        # at the next poll tick
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._parker = threading.Thread(
+            target=self._parker_loop, daemon=True, name="http-parker"
+        )
+        for t in self._workers:
+            t.start()
+        self._shedder.start()
+        self._parker.start()
+
+    def _bump(self, key, n=1):
+        with self._stats_lock:
+            self.stats[key] += n
+
+    # --------------------------------------------------------- admission
+
+    def process_request(self, request, client_address):
+        # accept-loop override: hand off, never read — accept progress
+        # must not depend on any peer's send rate
+        self._bump("accepted")
+        self._admit(request, client_address)
+
+    def _admit(self, sock, addr, requeued=False):
+        if self._stop.is_set():
+            self._shed_now(sock, "shutdown")
+            return
+        try:
+            self._queue.put_nowait((sock, addr))
+        except queue.Full:
+            self._note_saturated(addr)
+            try:
+                self._shed_queue.put_nowait((sock, addr))
+            except queue.Full:
+                # even the shed lane is full: close without a response —
+                # spending accept-thread time on this peer is the DoS
+                _SHED_TOTAL.labels("overflow").inc()
+                self._bump("shed")
+                self._close_sock(sock)
+            return
+        if requeued:
+            self._bump("requeued")
+        self._saturated = False
+        _INFLIGHT.labels("queue").set(self._queue.qsize())
+
+    def _note_saturated(self, addr):
+        if self._saturated:
+            return
+        self._saturated = True
+        from ..observability.flight_recorder import RECORDER
+
+        RECORDER.record(
+            "http_api_saturated", severity="warn",
+            queue_depth=self._queue.maxsize, workers=self.http_threads,
+            peer=str(addr[0]) if addr else "",
+        )
+
+    # --------------------------------------------------------- shed lane
+
+    def _shedder_loop(self):
+        while True:
+            item = self._shed_queue.get()
+            if item is None:
+                return
+            sock, _addr = item
+            self._shed_now(sock, "saturated")
+
+    def _shed_now(self, sock, reason):
+        try:
+            self._shed_one(sock, reason)
+        except (OSError, TimeoutError):
+            # peer trickling headers at the shed lane, or gone: the shed
+            # still counts — the connection was turned away either way
+            _SHED_TOTAL.labels(reason).inc()
+            self._bump("shed")
+        finally:
+            self._close_sock(sock)
+
+    def _shed_one(self, sock, reason):
+        sock.settimeout(min(self.SHED_READ_TIMEOUT, self.request_timeout))
+        rfile = sock.makefile("rb", -1)
+        try:
+            line = rfile.readline(4096)
+            for _ in range(128):  # drain headers (bounded)
+                hline = rfile.readline(4096)
+                if hline in (b"\r\n", b"\n", b""):
+                    break
+        finally:
+            rfile.close()
+        parts = line.split()
+        path = (parts[1].split(b"?")[0].decode("latin-1", "replace")
+                if len(parts) > 1 else "")
+        if (parts and parts[0] == b"GET"
+                and path in BeaconApiHandler.RATE_LIMIT_EXEMPT):
+            from ..observability import slo as obs_slo
+
+            degraded = obs_slo.health()["degraded"]
+            status = "206 Partial Content" if degraded else "200 OK"
+            sock.sendall(
+                (f"HTTP/1.1 {status}\r\nContent-Length: 0\r\n"
+                 "Connection: close\r\n\r\n").encode()
+            )
+            self._bump("health_shed_path")
+            return
+        body = b'{"code": 503, "message": "worker pool saturated; retry"}'
+        sock.sendall(
+            b"HTTP/1.1 503 Service Unavailable\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Retry-After: 1\r\n"
+            b"Content-Length: " + str(len(body)).encode() + b"\r\n"
+            b"Connection: close\r\n\r\n" + body
+        )
+        _SHED_TOTAL.labels(reason).inc()
+        self._bump("shed")
+
+    # ----------------------------------------------------------- workers
+
+    def _worker(self):
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            sock, addr = item
+            _INFLIGHT.labels("queue").set(self._queue.qsize())
+            _INFLIGHT.labels("workers").inc()
+            keep = False
+            try:
+                sock.settimeout(self.request_timeout)
+                handler = self.RequestHandlerClass(sock, addr, self)
+                keep = not handler.close_connection
+            except Exception:  # noqa: BLE001 — a dead peer must not kill a worker
+                keep = False
+            finally:
+                _INFLIGHT.labels("workers").dec()
+                self._bump("handled")
+            if keep and not self._stop.is_set():
+                self._park(sock)
+            else:
+                self._close_sock(sock)
+
+    # ----------------------------------------------------------- parking
+
+    def _park(self, sock):
+        with self._park_lock:
+            self._parked[sock] = time.monotonic()
+            _INFLIGHT.labels("parked").set(len(self._parked))
+        try:
+            self._wake_w.send(b"p")
+        except OSError:
+            pass
+
+    def _parker_loop(self):
+        while not self._stop.is_set():
+            with self._park_lock:
+                socks = list(self._parked)
+            try:
+                readable, _, errored = select.select(
+                    socks + [self._wake_r], [], socks, 0.25
+                )
+            except (OSError, ValueError):
+                with self._park_lock:
+                    for s in list(self._parked):
+                        if s.fileno() < 0:
+                            del self._parked[s]
+                continue
+            if self._wake_r in readable:
+                readable.remove(self._wake_r)
+                try:
+                    self._wake_r.recv(4096)
+                except OSError:
+                    pass
+            for s in set(readable) | set(errored):
+                with self._park_lock:
+                    self._parked.pop(s, None)
+                if s in errored:
+                    self._close_sock(s)
+                    continue
+                try:
+                    if not s.recv(1, socket.MSG_PEEK):
+                        self._close_sock(s)  # peer sent FIN while parked
+                        continue
+                    addr = s.getpeername()
+                except OSError:
+                    self._close_sock(s)
+                    continue
+                # next request arrived: back through the admission gate —
+                # a parked connection has no standing claim on a worker
+                self._admit(s, addr, requeued=True)
+            now = time.monotonic()
+            with self._park_lock:
+                idle = [s for s, t0 in self._parked.items()
+                        if now - t0 > self.request_timeout]
+                for s in idle:
+                    del self._parked[s]
+                _INFLIGHT.labels("parked").set(len(self._parked))
+            for s in idle:
+                self._close_sock(s)
+
+    # ---------------------------------------------------------- teardown
+
+    @staticmethod
+    def _close_sock(sock):
+        """Close with FIN, not RST: half-close the send side, then drain
+        briefly so unread peer bytes cannot flip the close into a reset."""
+        try:
+            try:
+                sock.shutdown(socket.SHUT_WR)
+            except OSError:
+                pass
+            sock.settimeout(0.05)
+            try:
+                while sock.recv(4096):
+                    pass
+            except (OSError, TimeoutError):
+                pass
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def shutdown(self):
+        """Graceful stop: accept halts, queued + in-flight requests
+        complete (sentinels ride BEHIND queued sockets in the FIFO), late
+        arrivals get a clean 503, every pool thread joins — repeated
+        start/stop cycles leak no worker threads."""
+        self._stop.set()
+        super().shutdown()  # blocks until the accept loop exits
+        for _ in self._workers:
+            self._queue.put(None)
+        for w in self._workers:
+            w.join(timeout=max(5.0, 2 * self.request_timeout))
+        self._shed_queue.put(None)
+        self._shedder.join(timeout=5.0)
+        try:
+            self._wake_w.send(b"s")
+        except OSError:
+            pass
+        self._parker.join(timeout=5.0)
+        for ws in (self._wake_r, self._wake_w):
+            try:
+                ws.close()
+            except OSError:
+                pass
+        with self._park_lock:
+            parked = list(self._parked)
+            self._parked.clear()
+        for s in parked:
+            self._close_sock(s)
+        while True:  # anything that raced past the sentinels
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None:
+                self._shed_now(item[0], "shutdown")
+        self.server_close()
+
+
 def serve(chain, op_pool=None, host="127.0.0.1", port=0, allow_origin=None,
-          rate_limit=None):
+          rate_limit=None, http_threads=None, request_timeout=None,
+          tracer=None):
     """Start the API server; returns (server, thread, actual_port).
     `rate_limit` (requests/second, burst 2x) enables the QoS token bucket —
-    over-quota requests get 429 + Retry-After instead of queued work."""
+    over-quota requests get 429 + Retry-After instead of queued work.
+    `http_threads`/`request_timeout` size the bounded worker pool and the
+    per-request read deadline (None = env/default via the resolvers);
+    `tracer` records per-request `http_serve` traces that adopt the
+    caller's X-LH-Trace-Ctx wire context."""
     limiter = None
     if rate_limit is not None:
         from ..qos.ratelimit import RateLimiter
@@ -1562,9 +2039,13 @@ def serve(chain, op_pool=None, host="127.0.0.1", port=0, allow_origin=None,
         "BoundHandler",
         (BeaconApiHandler,),
         {"chain": chain, "op_pool": op_pool, "event_bus": EventBus(),
-         "allow_origin": allow_origin, "rate_limiter": limiter},
+         "allow_origin": allow_origin, "rate_limiter": limiter,
+         "tracer": tracer},
     )
-    server = ThreadingHTTPServer((host, port), handler)
+    server = WorkerPoolHTTPServer(
+        (host, port), handler, http_threads=http_threads,
+        request_timeout=request_timeout,
+    )
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
     return server, thread, server.server_address[1]

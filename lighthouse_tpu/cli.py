@@ -565,12 +565,19 @@ def cmd_bn(args):
 
         dial_static()
 
+    from .observability import TRACER as _bn_tracer
+
     server, _t, port = serve(
         chain, op_pool=op_pool, host=args.http_address, port=args.http_port,
         allow_origin=args.http_allow_origin,
         rate_limit=args.http_rate_limit,
+        http_threads=args.http_threads,
+        request_timeout=args.http_request_timeout,
+        tracer=_bn_tracer,
     )
-    log.info("HTTP API started", addr=args.http_address, port=port)
+    log.info("HTTP API started", addr=args.http_address, port=port,
+             workers=server.http_threads,
+             request_timeout=server.request_timeout)
     mserver, mport = metrics_http_server(
         host=args.metrics_address, port=args.metrics_port,
         allow_origin=args.metrics_allow_origin,
@@ -1615,6 +1622,16 @@ def build_parser() -> argparse.ArgumentParser:
                     help="HTTP API token-bucket rate (requests/sec, burst "
                          "2x); over-quota requests get 429 + Retry-After "
                          "instead of queued work (default: unlimited)")
+    bn.add_argument("--http-threads", type=int, default=None,
+                    help="HTTP API worker-pool size; when every worker is "
+                         "busy and the bounded queue is full, new "
+                         "connections are shed with 503 + Retry-After "
+                         "(default: LIGHTHOUSE_TPU_HTTP_THREADS or 8)")
+    bn.add_argument("--http-request-timeout", type=float, default=None,
+                    help="per-request header/body read deadline in "
+                         "seconds — a slow-loris peer costs one worker at "
+                         "most this long (default: "
+                         "LIGHTHOUSE_TPU_HTTP_REQUEST_TIMEOUT or 10)")
     bn.add_argument("--gossip-ingest-rate", type=float, default=None,
                     help="gossip ingest token-bucket rate per batchable "
                          "kind (messages/sec, burst 2x); over-quota "

@@ -455,6 +455,9 @@ class ValidatorFleet:
         surface.crash_slot = slot
         surface.api.healthy = False
         self.mh.crash_node(crash.node)
+        if getattr(self.mh, "http_leg", None) is not None:
+            # the crashed 'process' takes its real HTTP server with it
+            self.mh.http_leg.kill_node(crash.node)
         FLEET_FAULTS.labels("crash").inc()
         log.warn("node storefault-crashed", node=crash.node, slot=slot,
                  torn_write=torn)
@@ -596,6 +599,250 @@ def replay_slashable(vcs) -> dict:
     }
 
 
+# ------------------------------------------------------- real-socket leg
+
+
+class HttpLeg:
+    """The fleet's real-HTTP lane: per node, one REAL localhost
+    `api.http_api.serve()` server (bounded worker pool, admission gate,
+    read deadlines) and `sc.http_vcs_per_node` keep-alive pooled
+    `api.client` connections driving duty-shaped read-only requests on a
+    SEEDED fixed schedule. The schedule — and therefore the per-route
+    scheduled counts that join the deterministic cluster rollup — is a
+    pure function of the scenario seed; every socket outcome, latency,
+    and server stat is a wall-clock observation.
+
+    netfaults.HttpFault windows attack the same servers at the raw-socket
+    seam (slow-loris trickle, mid-body stalls, RSTs, 429 storms), so the
+    scheduled traffic and the health probes measure how the hardened
+    stack degrades: sheds become typed 503s the client backs off from,
+    deadline expiries become counted timeouts, and the health-exempt
+    route must keep answering even while the pool is saturated."""
+
+    #: duty-shaped read-only GETs (route table in api/http_api.py)
+    ROUTES = (
+        "/eth/v1/node/version",
+        "/eth/v1/node/syncing",
+        "/eth/v1/beacon/genesis",
+        "/eth/v1/beacon/headers/head",
+        "/eth/v1/beacon/states/head/finality_checkpoints",
+        "/eth/v1/config/fork_schedule",
+    )
+    HEALTH = "/eth/v1/node/health"
+
+    def __init__(self, mh, sc):
+        from ..api.client import BeaconNodeHttpClient
+        from ..api.http_api import serve
+        from ..observability.trace import Tracer
+        from .netfaults import HttpNetFaults
+
+        self.mh = mh
+        self.sc = sc
+        self.servers: dict[int, tuple] = {}     # node -> (server, thread)
+        self.clients: dict[int, list] = {}
+        self.client_tracers: dict[int, object] = {}
+        self.ports: dict[int, int] = {}
+        self.dead: set[int] = set()
+        self.wedged: list[dict] = []
+        self.health = {n.index: {"ok": 0, "failed": 0} for n in mh.nodes}
+        self.outcomes: dict[str, dict[str, int]] = {}
+        self.latencies: dict[str, list[float]] = {}
+        self._prev_stats: dict[int, dict] = {}
+        timeout = max(2.0, 3.0 * sc.http_request_timeout)
+        for n in mh.nodes:
+            server, thread, port = serve(
+                n.chain, op_pool=getattr(n, "op_pool", None),
+                port=0, rate_limit=sc.http_rate_limit,
+                http_threads=sc.http_threads,
+                request_timeout=sc.http_request_timeout,
+                tracer=n.tracer,
+            )
+            self.servers[n.index] = (server, thread)
+            self.ports[n.index] = port
+            tracer = Tracer(ring_size=2048)
+            self.client_tracers[n.index] = tracer
+            base = f"http://127.0.0.1:{port}"
+            self.clients[n.index] = [
+                BeaconNodeHttpClient(
+                    base, timeout=timeout, tracer=tracer,
+                    origin=f"httpleg{n.index}.{j}",
+                )
+                for j in range(sc.http_vcs_per_node)
+            ]
+            self._prev_stats[n.index] = dict(server.stats)
+        self.faults = HttpNetFaults(
+            sc.http_faults, self.ports, recorder=RECORDER,
+        )
+        self.schedule, self.scheduled_routes = self._build_schedule()
+
+    # ---------------------------------------------------------- schedule
+
+    def _build_schedule(self):
+        """slot -> [(node, client_idx, route)]: seeded, fixed at init —
+        the deterministic core of the leg."""
+        rng = random.Random((self.sc.seed << 4) ^ 0x48545450)  # "HTTP"
+        schedule: dict[int, list] = {}
+        counts: dict[str, int] = {r: 0 for r in self.ROUTES}
+        for slot in range(1, self.sc.slots + 1):
+            plan = []
+            for node in sorted(self.ports):
+                for j in range(self.sc.http_vcs_per_node):
+                    for _ in range(self.sc.http_requests_per_slot):
+                        route = rng.choice(self.ROUTES)
+                        counts[route] += 1
+                        plan.append((node, j, route))
+            schedule[slot] = plan
+        return schedule, counts
+
+    def deterministic_block(self) -> dict:
+        return {
+            "routes": dict(self.scheduled_routes),
+            "scheduled_total": sum(self.scheduled_routes.values()),
+            "vcs_per_node": self.sc.http_vcs_per_node,
+            "nodes": len(self.ports),
+        }
+
+    # -------------------------------------------------------------- slot
+
+    def on_slot(self, slot: int) -> None:
+        from time import perf_counter
+
+        self.faults.on_slot(slot)
+        snap = {
+            idx: dict(srv.stats)
+            for idx, (srv, _) in self.servers.items()
+        }
+        for node, j, route in self.schedule.get(slot, ()):
+            if node in self.dead:
+                self._count(route, "unreachable")
+                continue
+            client = self.clients[node][j]
+            t0 = perf_counter()
+            try:
+                client._get(route)
+            except NodeRateLimited:
+                self._count(route, "rate_limited")
+            except NodeTimeout:
+                self._count(route, "timeout")
+            except BeaconNodeError:
+                self._count(route, "error")
+            else:
+                self._count(route, "ok")
+                self.latencies.setdefault(route, []).append(
+                    perf_counter() - t0
+                )
+        for idx in sorted(self.servers):
+            if idx in self.dead:
+                continue
+            try:
+                self.clients[idx][0]._get(self.HEALTH)
+            except BeaconNodeError:
+                self.health[idx]["failed"] += 1
+            else:
+                self.health[idx]["ok"] += 1
+        # wedge check: a slot of scheduled traffic during which the
+        # accept loop made NO progress means the server is stuck, and the
+        # run must fail loudly rather than report a quiet success
+        had_traffic = {n for n, _, _ in self.schedule.get(slot, ())}
+        for idx, (srv, _) in self.servers.items():
+            if idx in self.dead or idx not in had_traffic:
+                continue
+            before, now = snap[idx], srv.stats
+            if (now["accepted"] == before["accepted"]
+                    and now["handled"] == before["handled"]):
+                self.wedged.append({"slot": slot, "node": idx})
+
+    def _count(self, route: str, outcome: str) -> None:
+        per = self.outcomes.setdefault(
+            route, {"ok": 0, "rate_limited": 0, "timeout": 0,
+                    "error": 0, "unreachable": 0},
+        )
+        per[outcome] += 1
+
+    # ------------------------------------------------------------ faults
+
+    def kill_node(self, idx: int) -> None:
+        """Crash integration: a storefault-killed node takes its HTTP
+        server down with it; its scheduled requests count unreachable."""
+        if idx in self.dead or idx not in self.servers:
+            return
+        self.dead.add(idx)
+        server, thread = self.servers[idx]
+        for c in self.clients[idx]:
+            c.close()
+        server.shutdown()
+        thread.join(timeout=10.0)
+
+    # ------------------------------------------------------------ report
+
+    def shed_total(self) -> int:
+        return sum(
+            srv.stats["shed"] for srv, _ in self.servers.values()
+        )
+
+    def failures(self) -> list[str]:
+        out = []
+        if self.wedged:
+            out.append(
+                f"http server wedged: no accept progress for a full "
+                f"slot of scheduled traffic ({self.wedged[:4]})"
+            )
+        if self.sc.expect_http_shed and self.shed_total() == 0:
+            out.append(
+                "expected the http admission gate to shed under the "
+                "fault plan, but http_api_shed_total stayed zero"
+            )
+        unhealthy = {
+            str(i): h for i, h in self.health.items()
+            if i not in self.dead and h["failed"]
+        }
+        if unhealthy:
+            out.append(
+                f"health-exempt {self.HEALTH} failed to answer on "
+                f"alive nodes: {unhealthy}"
+            )
+        return out
+
+    def observations(self) -> dict:
+        def pct(xs, q):
+            if not xs:
+                return None
+            xs = sorted(xs)
+            return round(
+                xs[min(len(xs) - 1, int(q * len(xs)))] * 1000.0, 3
+            )
+
+        return {
+            "outcomes": {r: dict(v) for r, v in
+                         sorted(self.outcomes.items())},
+            "latency_ms": {
+                r: {"count": len(xs), "p50": pct(xs, 0.5),
+                    "p95": pct(xs, 0.95)}
+                for r, xs in sorted(self.latencies.items())
+            },
+            "server": {
+                str(idx): dict(srv.stats)
+                for idx, (srv, _) in sorted(self.servers.items())
+            },
+            "health": {str(i): dict(h) for i, h in self.health.items()},
+            "faults_injected": dict(self.faults.counts),
+            "shed_total": self.shed_total(),
+            "killed_nodes": sorted(self.dead),
+            "wedged": self.wedged,
+        }
+
+    def close(self) -> None:
+        self.faults.close()
+        for idx in sorted(self.servers):
+            if idx in self.dead:
+                continue
+            server, thread = self.servers[idx]
+            for c in self.clients[idx]:
+                c.close()
+            server.shutdown()
+            thread.join(timeout=10.0)
+
+
 # ----------------------------------------------------------- the harness
 
 
@@ -613,6 +860,9 @@ class FleetHarness(MultiNodeHarness):
         self.fleet_datadir = datadir
         self.fleet = ValidatorFleet(self, sc)
         self.fleet_per_slot: list[dict] = []
+        self.http_leg = (
+            HttpLeg(self, sc) if sc.http_vcs_per_node > 0 else None
+        )
 
     # ------------------------------------------------------------- slots
 
@@ -630,7 +880,16 @@ class FleetHarness(MultiNodeHarness):
         self.fleet_per_slot.append({
             "slot": entry["slot"], **entry["duties"],
         })
+        if self.http_leg is not None:
+            self.http_leg.on_slot(entry["slot"])
         return entry
+
+    def close(self) -> None:
+        try:
+            if self.http_leg is not None:
+                self.http_leg.close()
+        finally:
+            super().close()
 
     # -------------------------------------------------------- production
 
@@ -729,6 +988,7 @@ def run_fleet_scenario(sc, out_path: str | None = None, log_fn=None,
         partitions=tuple(sc.partitions),
         links=tuple(sc.links),
         churn=tuple(sc.churn),
+        http_faults=tuple(getattr(sc, "http_faults", ())),
     )
     RECORDER.reset()
     inj = NetFaultInjector(plan, sc.n_nodes, recorder=RECORDER)
@@ -878,14 +1138,22 @@ def run_fleet_scenario(sc, out_path: str | None = None, log_fn=None,
         sc.node_crashes
     ):
         failures.append("a scheduled node crash never fired")
+    if mh.http_leg is not None:
+        failures.extend(mh.http_leg.failures())
     ok = not failures
 
     # cluster rollup: the same deterministic block the multinode reports
-    # carry (observability/propagation.build_cluster_report)
+    # carry (observability/propagation.build_cluster_report); the HTTP
+    # leg's seed-scheduled per-route counts join it — socket outcomes and
+    # wall-clock latencies stay in the observations block below
     from ..observability.propagation import build_cluster_report
 
     cluster = build_cluster_report(
-        (n.index, n.slo, n.net.propagation) for n in mh.nodes
+        ((n.index, n.slo, n.net.propagation) for n in mh.nodes),
+        http_api=(
+            mh.http_leg.deterministic_block()
+            if mh.http_leg is not None else None
+        ),
     )
 
     deterministic = {
@@ -940,17 +1208,27 @@ def run_fleet_scenario(sc, out_path: str | None = None, log_fn=None,
         },
         "elapsed_secs": round(time.time() - t_wall, 3),
     }
+    if mh.http_leg is not None:
+        report["http_api"] = mh.http_leg.observations()
     if trace_out:
         from ..observability.trace import merge_chrome_traces
 
+        named = [(f"node{n.index}", n.tracer) for n in mh.nodes]
+        if mh.http_leg is not None:
+            # client-side http spans merge as their own processes; their
+            # wire contexts link them to the servers' http_serve spans
+            named += [
+                (f"httpleg{idx}", tr)
+                for idx, tr in sorted(mh.http_leg.client_tracers.items())
+            ]
         n_events = merge_chrome_traces(
-            [(f"node{n.index}", n.tracer) for n in mh.nodes], trace_out,
+            named, trace_out,
             instants=RECORDER.perfetto_instants(),
         )
         report["trace"] = {
             "path": trace_out,
             "events": n_events,
-            "processes": len(mh.nodes),
+            "processes": len(named),
         }
     if out_path:
         with open(out_path, "w") as f:

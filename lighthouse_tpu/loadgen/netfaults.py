@@ -35,6 +35,8 @@ transport-level peer ids (node_id strings) back to indices.
 
 from __future__ import annotations
 
+import socket
+import struct
 from dataclasses import dataclass
 
 from ..utils.logging import get_logger
@@ -54,6 +56,12 @@ NETFAULT_EVENTS = REGISTRY.counter_vec(
     "netfault_events_total",
     "fault-plan transitions fired, by kind (partition_start / "
     "partition_heal / churn_down / churn_up / equivocation)",
+    ("kind",),
+)
+NETFAULT_HTTP = REGISTRY.counter_vec(
+    "netfault_http_injections_total",
+    "HTTP socket-seam fault injections against real API servers, by kind "
+    "(slow_loris / body_stall / reset / storm_429)",
     ("kind",),
 )
 
@@ -135,6 +143,29 @@ class Equivocation:
     slot: int
 
 
+@dataclass(frozen=True)
+class HttpFault:
+    """Socket-seam misbehavior against a node's REAL HTTP API server over
+    [start_slot, end_slot): "slow_loris" = attacker connections that send
+    the request line then trickle one header byte per slot, "body_stall" =
+    full headers with a large Content-Length then a stalled body,
+    "reset" = full request followed by an SO_LINGER-0 close (RST on the
+    wire), "storm_429" = a burst of cheap fire-and-forget GETs that burn
+    the server's rate-limit tokens so honest clients see 429s."""
+
+    kind: str                       # slow_loris | body_stall | reset | storm_429
+    start_slot: int
+    end_slot: int
+    nodes: tuple[int, ...] = ()     # empty = every node running an HTTP server
+    clients: int = 4                # attacker connections per node per slot
+
+    def active(self, slot: int) -> bool:
+        return self.start_slot <= slot < self.end_slot
+
+    def matches(self, node: int) -> bool:
+        return not self.nodes or node in self.nodes
+
+
 @dataclass
 class NetFaultPlan:
     """The full declarative fault schedule for one scenario run."""
@@ -144,6 +175,7 @@ class NetFaultPlan:
     rpc_faults: tuple[RpcFault, ...] = ()
     churn: tuple[Churn, ...] = ()
     equivocations: tuple[Equivocation, ...] = ()
+    http_faults: tuple[HttpFault, ...] = ()
 
     def as_dict(self) -> dict:
         """JSON-serializable plan description for the scenario report."""
@@ -172,6 +204,12 @@ class NetFaultPlan:
             ],
             "equivocations": [
                 {"slot": e.slot} for e in self.equivocations
+            ],
+            "http_faults": [
+                {"kind": h.kind, "start_slot": h.start_slot,
+                 "end_slot": h.end_slot, "nodes": list(h.nodes),
+                 "clients": h.clients}
+                for h in self.http_faults
             ],
         }
 
@@ -344,6 +382,167 @@ class NetFaultInjector:
             return None
 
         return fault_filter
+
+
+class HttpNetFaults:
+    """Drives HttpFaults at the raw-socket seam against real localhost
+    HTTP API servers.
+
+    The attacker never goes through api.client — each injection is a bare
+    TCP connection speaking just enough HTTP to land in the server's
+    vulnerable phase: header read (slow_loris), body read (body_stall),
+    worker write/read (reset), or the rate-limit gate (storm_429).
+    slow_loris and body_stall connections persist across slots (topped up
+    to `clients` per node each tick, one trickle byte per slot keeps the
+    header read alive); reset and storm_429 are fire-and-forget per slot.
+    """
+
+    def __init__(self, faults, ports, recorder=None):
+        self.faults = tuple(faults)
+        self.ports = dict(ports)        # node index -> localhost port
+        self.recorder = recorder
+        self.counts: dict[str, int] = {}
+        # (fault_idx, node) -> live attacker sockets for persistent kinds
+        self._held: dict[tuple[int, int], list[socket.socket]] = {}
+        # storm sockets from the previous tick: closed AFTER their
+        # responses/sheds landed, so the burst pressures the admission
+        # queue without turning every close into an RST
+        self._pending_close: list[socket.socket] = []
+        self._announced: set[int] = set()
+
+    def on_slot(self, slot: int) -> None:
+        for s in self._pending_close:
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._pending_close = []
+        for fi, fault in enumerate(self.faults):
+            targets = [n for n in sorted(self.ports) if fault.matches(n)]
+            if not fault.active(slot):
+                for node in targets:
+                    self._release(fi, node)
+                continue
+            if fi not in self._announced:
+                self._announced.add(fi)
+                log.warn("http fault window opens", kind=fault.kind,
+                         slot=slot, nodes=targets or "all")
+                if self.recorder is not None:
+                    self.recorder.record(
+                        "netfault_http_start", severity="warn",
+                        fault_kind=fault.kind, slot=slot,
+                    )
+            for node in targets:
+                port = self.ports.get(node)
+                if port is None:
+                    continue
+                if fault.kind in ("slow_loris", "body_stall"):
+                    self._sustain(fi, fault, node, port)
+                else:
+                    for _ in range(max(1, fault.clients)):
+                        self._fire_once(fault.kind, port)
+
+    def close(self) -> None:
+        for key in list(self._held):
+            self._release(*key)
+        for s in self._pending_close:
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._pending_close = []
+
+    # -- internals -------------------------------------------------------
+
+    def _count(self, kind: str) -> None:
+        NETFAULT_HTTP.labels(kind).inc()
+        self.counts[kind] = self.counts.get(kind, 0) + 1
+
+    def _release(self, fi: int, node: int) -> None:
+        for s in self._held.pop((fi, node), ()):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def _connect(self, port: int):
+        try:
+            return socket.create_connection(("127.0.0.1", port), timeout=0.5)
+        except OSError:
+            return None
+
+    def _sustain(self, fi: int, fault: HttpFault, node: int,
+                 port: int) -> None:
+        held = self._held.setdefault((fi, node), [])
+        # Trickle a header byte on survivors; drop sockets the server
+        # already timed out or reset.
+        alive = []
+        for s in held:
+            try:
+                if fault.kind == "slow_loris":
+                    s.sendall(b"x")
+                alive.append(s)
+            except OSError:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        held[:] = alive
+        while len(held) < max(1, fault.clients):
+            s = self._connect(port)
+            if s is None:
+                break
+            try:
+                if fault.kind == "slow_loris":
+                    # Request line + an unterminated header: the worker
+                    # blocks in the header read until its deadline.
+                    s.sendall(b"GET /eth/v1/node/syncing HTTP/1.1\r\n"
+                              b"Host: lh\r\nX-Drip: ")
+                else:  # body_stall
+                    # Complete headers, oversized Content-Length, then
+                    # silence mid-body: the worker stalls in _read_body.
+                    s.sendall(b"POST /eth/v1/beacon/pool/attestations "
+                              b"HTTP/1.1\r\nHost: lh\r\n"
+                              b"Content-Type: application/json\r\n"
+                              b"Content-Length: 4096\r\n\r\n[{\"agg")
+            except OSError:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+                break
+            self._count(fault.kind)
+            held.append(s)
+
+    def _fire_once(self, kind: str, port: int) -> None:
+        s = self._connect(port)
+        if s is None:
+            return
+        self._count(kind)
+        try:
+            s.sendall(b"GET /eth/v1/node/version HTTP/1.1\r\nHost: lh\r\n"
+                      b"Connection: close\r\n\r\n")
+        except OSError:
+            try:
+                s.close()
+            except OSError:
+                pass
+            return
+        if kind == "reset":
+            # Abortive close: RST instead of FIN.
+            try:
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                             struct.pack("ii", 1, 0))
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+        else:
+            # storm_429: never read the response — the whole burst lands
+            # on the admission gate at once; closed next tick
+            self._pending_close.append(s)
 
 
 class FaultyGossipSend:

@@ -520,6 +520,24 @@ class FleetScenario:
     #: decisions on the nodes (the scheduler-active proof)
     expect_scheduler: bool = False
     seconds_per_slot: float = 1.0
+    #: -------- the real-socket HTTP leg (loadgen/fleet.py HttpLeg): this
+    #: many VCs per node talk to a REAL localhost `api.http_api.serve()`
+    #: server through pooled `api.client` connections (0 = leg off)
+    http_vcs_per_node: int = 0
+    #: duty-shaped GET requests each HTTP VC issues per slot (seeded
+    #: deterministic schedule — the scheduled counts join the cluster
+    #: rollup; socket outcomes/latencies stay wall-clock observations)
+    http_requests_per_slot: int = 1
+    #: server hardening knobs (api.http_api.WorkerPoolHTTPServer)
+    http_threads: int = 4
+    http_request_timeout: float = 1.0
+    #: token-bucket rate on the real servers (None = unlimited)
+    http_rate_limit: float | None = None
+    #: socket-seam attacker schedule (netfaults.HttpFault)
+    http_faults: tuple = ()
+    #: fail unless the admission gate actually shed (http_api_shed_total
+    #: + flight-recorder proof that saturation was reached and survived)
+    expect_http_shed: bool = False
 
 
 FLEET_SMOKE_VALIDATORS = 96
@@ -528,7 +546,7 @@ FLEET_SMOKE_SLOTS = 20
 
 def _fleet_scenarios() -> dict[str, FleetScenario]:
     from .fleet import FlashCrowd, NodeCrash, NodeStall
-    from .netfaults import Partition
+    from .netfaults import HttpFault, Partition
 
     return {
         # the control run: no faults, the fleet must perform >=99% of its
@@ -565,9 +583,13 @@ def _fleet_scenarios() -> dict[str, FleetScenario]:
             batch_gossip=True, expect_scheduler=True,
         ),
         # everything at once: 3-way partition x node-0 API stall x flash
-        # crowd x one torn-write crash. The duty path must degrade with
-        # counted reasons and recover — zero slashable messages, heads
-        # converge after heal, burn back under 1x by the end
+        # crowd x one torn-write crash — PLUS the real-socket lane:
+        # hundreds of HTTP VCs per node drive duty-shaped reads through
+        # REAL localhost servers while an RST window bites the sockets.
+        # The duty path must degrade with counted reasons and recover —
+        # zero slashable messages, heads converge after heal, burn back
+        # under 1x by the end, and the cluster rollup carries the leg's
+        # per-route scheduled counts
         "combined_chaos": FleetScenario(
             name="combined_chaos", slots=20,
             partitions=(Partition(start_slot=4, heal_slot=8,
@@ -576,6 +598,33 @@ def _fleet_scenarios() -> dict[str, FleetScenario]:
             node_crashes=(NodeCrash(node=1, slot=6),),
             flash_crowds=(FlashCrowd(start_slot=10, end_slot=12),),
             converge_slots=5, expect_incident=True,
+            http_vcs_per_node=128, http_requests_per_slot=1,
+            http_threads=4, http_request_timeout=1.0,
+            http_faults=(
+                HttpFault(kind="reset", start_slot=10, end_slot=13,
+                          clients=2),
+            ),
+        ),
+        # the socket-seam siege: slow-loris header trickle occupies every
+        # worker, a fire-and-forget storm overflows the admission queue,
+        # and mid-body stalls eat read deadlines — the gate MUST shed
+        # typed 503s (counted, flight-recorded), the health-exempt route
+        # MUST keep answering, and the in-process duty path must not
+        # notice (the performed floor still holds)
+        "http_slowloris": FleetScenario(
+            name="http_slowloris", n_nodes=2, n_validators=256,
+            vcs_per_node=2, slots=8, converge_slots=4,
+            http_vcs_per_node=3, http_requests_per_slot=1,
+            http_threads=2, http_request_timeout=0.4,
+            http_faults=(
+                HttpFault(kind="slow_loris", start_slot=2, end_slot=5,
+                          clients=4),
+                HttpFault(kind="storm_429", start_slot=2, end_slot=5,
+                          clients=40),
+                HttpFault(kind="body_stall", start_slot=3, end_slot=5,
+                          clients=2),
+            ),
+            expect_http_shed=True, min_performed_ratio=0.97,
         ),
     }
 
@@ -610,6 +659,7 @@ def fleet_smoke_variant(sc: FleetScenario) -> FleetScenario:
         n_validators=min(sc.n_validators, FLEET_SMOKE_VALIDATORS),
         vcs_per_node=min(sc.vcs_per_node, 2),
         slots=min(sc.slots, FLEET_SMOKE_SLOTS),
+        http_vcs_per_node=min(sc.http_vcs_per_node, 4),
     )
 
 

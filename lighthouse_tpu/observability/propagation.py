@@ -400,12 +400,18 @@ class PropagationTracker:
 # ------------------------------------------------------------ cluster rollup
 
 
-def build_cluster_report(nodes) -> dict:
+def build_cluster_report(nodes, http_api=None) -> dict:
     """The deterministic cluster block for multinode/fleet scenario
     reports. `nodes` is an iterable of (index, SlotAccountant,
     PropagationTracker) triples in index order. Everything here derives
     from integer counters and logical-clock samples, so a rerun of the
-    same seed reproduces it bit-for-bit."""
+    same seed reproduces it bit-for-bit.
+
+    `http_api` (optional) is the fleet HTTP leg's per-route series block —
+    scheduled request counts per `http_api_request_seconds` route, which
+    are a pure function of the scenario seed. It lands under the
+    `"http_api"` key verbatim; wall-clock latency quantiles stay OUT of
+    this block (they live in the report's observations)."""
     hits = misses = 0
     per_node_ratio: dict[str, float | None] = {}
     merged: dict[str, list[float]] = {}
@@ -454,7 +460,7 @@ def build_cluster_report(nodes) -> dict:
             "max": round(vals[-1], 6) if vals else 0.0,
         }
     tth_sorted = sorted(tth)
-    return {
+    report = {
         "deadline_hits": hits,
         "deadline_misses": misses,
         "deadline_hit_ratio": ratio,
@@ -468,3 +474,6 @@ def build_cluster_report(nodes) -> dict:
         },
         "propagation_stalls": stalls,
     }
+    if http_api is not None:
+        report["http_api"] = http_api
+    return report

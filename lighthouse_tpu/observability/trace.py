@@ -236,6 +236,11 @@ def _host_tid(trace_index: int) -> int:
 #: flight-recorder instant events render on this dedicated lane
 INSTANT_LANE = 900
 
+#: trace kinds that ANCHOR a cross-node flow (the producer end of the
+#: arrow): gossip publishes and HTTP client requests; everything else
+#: carrying a wire context is a consumer (`http_serve`, imports, ...)
+_FLOW_ANCHOR_KINDS = ("gossip_publish", "http_client")
+
 #: the device ledger's per-workload occupancy/waiting tracks render on
 #: dedicated lanes starting here (one tid per track, deterministically
 #: ordered by track name)
@@ -412,13 +417,20 @@ def _flow_links(snaps, base: float) -> list[dict]:
     caller context) emit nothing."""
     from .propagation import flow_id
 
-    # pass 1: publish anchors — fid -> (pid, tid, mid-span time)
+    # pass 1: producer anchors — fid -> (pid, tid, mid-span time). Gossip
+    # publishes and HTTP client requests both originate causal chains;
+    # a gossip publish wins when both carry the same context (the HTTP
+    # call is then itself a consumer of the publish's chain).
     anchors: dict = {}
     for i, (_name, traces, _c) in enumerate(snaps):
         for j, tr in enumerate(traces):
-            if tr.kind == "gossip_publish" and tr.ctx is not None and tr.spans:
+            if (tr.kind in _FLOW_ANCHOR_KINDS and tr.ctx is not None
+                    and tr.spans):
+                fid = flow_id(tr.ctx)
+                if tr.kind != "gossip_publish" and fid in anchors:
+                    continue
                 first = tr.spans[0]
-                anchors[flow_id(tr.ctx)] = (
+                anchors[fid] = (
                     i + 1, _host_tid(j), (first[1] + first[2]) / 2.0
                 )
     # pass 2: one unique flow per consumer trace with a matching anchor
@@ -426,7 +438,8 @@ def _flow_links(snaps, base: float) -> list[dict]:
     for i, (_name, traces, _c) in enumerate(snaps):
         pid = i + 1
         for j, tr in enumerate(traces):
-            if tr.ctx is None or tr.kind == "gossip_publish" or not tr.spans:
+            if (tr.ctx is None or tr.kind in _FLOW_ANCHOR_KINDS
+                    or not tr.spans):
                 continue
             fid = flow_id(tr.ctx)
             anchor = anchors.get(fid)

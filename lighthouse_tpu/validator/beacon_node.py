@@ -478,6 +478,10 @@ class BeaconNodeFallback:
 
     BACKOFF_BASE = 0.05
     BACKOFF_CAP = 2.0
+    #: hard ceiling on how long a server's Retry-After may stretch the
+    #: between-round backoff — with deadlines disabled (call_timeout 0)
+    #: an unclamped header would be an unbounded server-controlled sleep
+    RETRY_AFTER_CAP = 30.0
 
     def __init__(self, nodes: list, call_timeout: float | None = None,
                  clock=time.monotonic, sleep_fn=time.sleep,
@@ -497,6 +501,7 @@ class BeaconNodeFallback:
             "calls": 0, "successes": 0, "errors": 0, "timeouts": 0,
             "rate_limited": 0, "retries": 0, "failovers": 0,
             "probes_up": 0, "exhausted": 0,
+            "retry_after_honored": 0, "retry_after_skipped": 0,
         }
 
     @property
@@ -582,11 +587,32 @@ class BeaconNodeFallback:
             self._probe_demoted()
         errors: list[tuple[int, str]] = []
         attempts = 0
+        t_begin = self.clock()
+        retry_floor = 0.0  # max Retry-After seen in the previous round
         health = {c.index: c.is_healthy() for c in self._candidates}
         for round_no in range(self.max_retries + 1):
             if round_no:
                 delay = min(self.BACKOFF_CAP,
                             self.BACKOFF_BASE * (2 ** (round_no - 1)))
+                if retry_floor > 0.0:
+                    # honor Retry-After as the backoff FLOOR — unless
+                    # honoring it would sleep past the remaining duty
+                    # deadline, in which case the round proceeds on plain
+                    # exponential backoff (failing over beats out-sleeping
+                    # the slot; the limiting node was already skipped
+                    # within the round)
+                    remaining = (
+                        self.call_timeout - (self.clock() - t_begin)
+                        if self.call_timeout > 0 else float("inf")
+                    )
+                    if retry_floor <= remaining:
+                        delay = max(delay, retry_floor)
+                        self.stats["retry_after_honored"] += 1
+                        VC_FALLBACK.labels(method, "retry_after_honored").inc()
+                    else:
+                        self.stats["retry_after_skipped"] += 1
+                        VC_FALLBACK.labels(method, "retry_after_skipped").inc()
+                retry_floor = 0.0
                 self.stats["retries"] += 1
                 VC_FALLBACK.labels(method, "retry").inc()
                 self.sleep_fn(delay)
@@ -596,7 +622,13 @@ class BeaconNodeFallback:
                 try:
                     result = getattr(cand.node, method)(*args, **kwargs)
                 except Exception as e:  # noqa: BLE001 — fail over
-                    self._record_failure(cand, method, classify_failure(e), e)
+                    outcome = classify_failure(e)
+                    if outcome == "rate_limited":
+                        ra = float(getattr(e, "retry_after", 0.0) or 0.0)
+                        retry_floor = max(
+                            retry_floor, min(ra, self.RETRY_AFTER_CAP)
+                        )
+                    self._record_failure(cand, method, outcome, e)
                     errors.append((cand.index,
                                    f"{type(e).__name__}: {e}"))
                     continue

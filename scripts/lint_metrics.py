@@ -65,6 +65,7 @@ METRIC_MODULES = (
     "lighthouse_tpu.observability.device_ledger",
     "lighthouse_tpu.observability.flight_recorder",
     "lighthouse_tpu.api.http_api",
+    "lighthouse_tpu.api.client",
     "lighthouse_tpu.qos",
 )
 
@@ -234,6 +235,20 @@ def lint_registry(registry=None) -> list[str]:
                 errors.append(
                     f"{where}: device_ledger_* metrics must be labeled "
                     "families (workload / lane / victim+occupant / chip)"
+                )
+        if m.name.startswith(("http_api_", "http_client_")):
+            # the HTTP seam's series answer "which route's latency, which
+            # shed reason, which read stage timed out, which handler
+            # stage failed, which client phase stalled" — an unlabeled
+            # http_* aggregate cannot distinguish a saturation shed from
+            # a shutdown drain or a connect timeout from a stalled body,
+            # so the convention is enforced like qos_* (api/http_api.py,
+            # api/client.py)
+            if not getattr(m, "labelnames", ()):
+                errors.append(
+                    f"{where}: http_api_*/http_client_* metrics must be "
+                    "labeled families (route+method / reason / stage / "
+                    "phase / event / kind)"
                 )
         if m.kind == "histogram":
             # a histogram's exposition series must not shadow other metrics

@@ -153,7 +153,7 @@ def test_flash_crowd_windows():
 
 def test_scenario_registry():
     for name in ("fleet_steady", "fleet_partition", "fleet_crash",
-                 "combined_chaos"):
+                 "combined_chaos", "http_slowloris"):
         assert is_fleet(name)
         sc = get_fleet_scenario(name)
         smoke = fleet_smoke_variant(sc)
@@ -164,9 +164,17 @@ def test_scenario_registry():
             + [c.slot for c in smoke.node_crashes]
             + [s.end_slot for s in smoke.node_stalls]
             + [c.end_slot for c in smoke.flash_crowds]
+            + [f.end_slot for f in smoke.http_faults]
         )
         assert all(e <= smoke.slots for e in ends)
     assert not is_fleet("partition_heal")
+    # the chaos flagship and the loris scenario both drive the real
+    # HTTP leg; the loris one expects the admission gate to shed
+    assert get_fleet_scenario("combined_chaos").http_vcs_per_node > 0
+    loris = get_fleet_scenario("http_slowloris")
+    assert loris.expect_http_shed
+    assert {f.kind for f in loris.http_faults} >= {"slow_loris",
+                                                   "storm_429"}
 
 
 # ------------------------------------------------------------------- e2e
@@ -240,6 +248,60 @@ def test_fleet_crash_fails_over_and_keeps_duty_floor(tmp_path):
     ratio = det["duty_conservation"]["performed_ratio"]
     assert ratio >= 0.9
     assert det["slashable_replay"]["ok"]
+
+
+def test_http_slowloris_sheds_but_health_and_duties_hold(tmp_path):
+    """The HTTP-leg flagship: socket-seam attackers (slow-loris header
+    drip, a 429 storm, mid-body stalls) saturate the bounded worker
+    pools; the servers shed with 503s instead of wedging, the
+    health-exempt route keeps answering, and the duty floor holds."""
+    sc = fleet_smoke_variant(get_fleet_scenario("http_slowloris"))
+    report = run_fleet_scenario(sc, datadir=str(tmp_path / "dd"))
+    assert report["ok"], report["failures"]
+    obs = report["http_api"]
+    # the gate actually shed under attack...
+    assert obs["shed_total"] > 0
+    # ...the attackers actually fired...
+    assert obs["faults_injected"].get("slow_loris", 0) > 0
+    assert obs["faults_injected"].get("storm_429", 0) > 0
+    # ...no server wedged (accept/handle progress on every node)...
+    assert obs["wedged"] == []
+    # ...and the health lane answered on every node, every slot
+    for node, h in obs["health"].items():
+        assert h["failed"] == 0, (node, h)
+    # real requests still completed during the attack windows
+    assert sum(v.get("ok", 0) for v in obs["outcomes"].values()) > 0
+    # the deterministic cluster rollup carries the per-route schedule
+    # with nonzero samples (wall-clock latencies stay in observations)
+    cluster_http = report["deterministic"]["cluster"]["http_api"]
+    assert cluster_http["scheduled_total"] > 0
+    assert sum(cluster_http["routes"].values()) \
+        == cluster_http["scheduled_total"]
+    # duty conservation is untouched by the HTTP chaos
+    assert report["deterministic"]["duty_conservation"]["ok"]
+    assert report["deterministic"]["slashable_replay"]["ok"]
+
+
+def test_http_leg_deterministic_core_rerun_identical(tmp_path):
+    """The HTTP leg must not leak wall-clock into the deterministic
+    core: same seed, two runs, bit-identical — with the leg enabled."""
+    from dataclasses import replace
+
+    sc = replace(
+        fleet_smoke_variant(get_fleet_scenario("fleet_steady")),
+        slots=6, http_vcs_per_node=2, http_requests_per_slot=1,
+    )
+    r1 = run_fleet_scenario(sc)
+    r2 = run_fleet_scenario(sc)
+    assert r1["ok"], r1["failures"]
+    assert json.dumps(r1["deterministic"], sort_keys=True) \
+        == json.dumps(r2["deterministic"], sort_keys=True)
+    # the scheduled per-route mix rode into both cluster blocks
+    assert r1["deterministic"]["cluster"]["http_api"]["scheduled_total"] \
+        == 6 * sc.n_nodes * 2
+    # wall-clock socket timings live OUTSIDE the deterministic core
+    assert "latency_ms" in r1["http_api"]
+    assert "latency_ms" not in json.dumps(r1["deterministic"])
 
 
 @pytest.mark.slow
@@ -324,6 +386,17 @@ def test_bn_loadtest_combined_chaos_smoke_cli(tmp_path):
     assert det["crashes"]
     assert det["netfault_events"]
     assert det["duty_conservation"]["missed"] > 0
+    # the real-socket HTTP leg rode along: the deterministic cluster
+    # block carries the per-route schedule with nonzero samples, the
+    # wall-clock outcomes live in observations, and the crashed node
+    # took its HTTP server down with it
+    cluster_http = det["cluster"]["http_api"]
+    assert cluster_http["scheduled_total"] > 0
+    assert all(n > 0 for n in cluster_http["routes"].values())
+    http_obs = report["http_api"]
+    assert sum(v.get("ok", 0) for v in http_obs["outcomes"].values()) > 0
+    assert http_obs["killed_nodes"] == [c["node"] for c in det["crashes"]]
+    assert http_obs["faults_injected"]   # the socket-seam resets fired
 
 
 def test_bn_loadtest_fleet_broken_invariant_exits_nonzero(tmp_path):

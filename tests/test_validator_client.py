@@ -448,3 +448,106 @@ def test_fallback_nonpositive_timeout_disables_deadline():
         assert fb.first_success("proposer_duties", 0) == ["ok"]
         assert fb.stats["timeouts"] == 0
         assert fb.health_scores()[0] == 1.0
+
+
+# ----------------------------------------------- Retry-After vs deadline
+
+
+class _RateLimitingNode:
+    """Rate-limits the first `limit_for` calls, then serves."""
+
+    def __init__(self, retry_after, limit_for=10**9):
+        from lighthouse_tpu.validator.beacon_node import NodeRateLimited
+
+        self._exc = NodeRateLimited
+        self.retry_after = retry_after
+        self.limit_for = limit_for
+        self.calls = 0
+
+    def is_healthy(self):
+        return True
+
+    def publish_attestations(self, atts):
+        self.calls += 1
+        if self.calls <= self.limit_for:
+            raise self._exc("429 rate limited",
+                            retry_after=self.retry_after)
+        return {"served_by": "limited"}
+
+
+class _ServingNode:
+    def __init__(self, fail_rounds=0):
+        self.calls = 0
+        self.fail_rounds = fail_rounds
+
+    def is_healthy(self):
+        return True
+
+    def publish_attestations(self, atts):
+        self.calls += 1
+        if self.calls <= self.fail_rounds:
+            raise RuntimeError("transient")
+        return {"served_by": "backup"}
+
+
+def test_retry_after_floors_backoff_when_deadline_allows():
+    sleeps = []
+    node = _RateLimitingNode(retry_after=0.5, limit_for=1)
+    fb = BeaconNodeFallback([node], max_retries=1, call_timeout=0,
+                            sleep_fn=sleeps.append)
+    got = fb.first_success("publish_attestations", [])
+    assert got == {"served_by": "limited"}
+    # round-1 exponential backoff would be 0.05s; the server's Retry-After
+    # lifts it to the floor
+    assert sleeps == [0.5]
+    assert fb.stats["retry_after_honored"] == 1
+    assert fb.stats["retry_after_skipped"] == 0
+
+
+def test_retry_after_is_capped_before_flooring():
+    sleeps = []
+    node = _RateLimitingNode(retry_after=9999.0, limit_for=1)
+    fb = BeaconNodeFallback([node], max_retries=1, call_timeout=0,
+                            sleep_fn=sleeps.append)
+    fb.first_success("publish_attestations", [])
+    # no deadline, so the floor IS honored — but clamped to the cap, so a
+    # hostile/buggy Retry-After cannot park the VC for hours
+    assert sleeps == [BeaconNodeFallback.RETRY_AFTER_CAP]
+    assert fb.stats["retry_after_honored"] == 1
+
+
+def test_huge_retry_after_fails_over_within_round():
+    """A 429 whose Retry-After exceeds the remaining duty deadline must
+    not stall the duty: the round fails over to the next node
+    immediately, no sleep at all."""
+    limited = _RateLimitingNode(retry_after=1000.0)
+    backup = _ServingNode()
+    sleeps = []
+    fb = BeaconNodeFallback([limited, backup], max_retries=0,
+                            call_timeout=2.0, clock=lambda: 0.0,
+                            sleep_fn=sleeps.append)
+    got = fb.first_success("publish_attestations", [])
+    assert got == {"served_by": "backup"}     # duty performed, 2nd node
+    assert sleeps == []                       # and nobody slept on it
+    assert fb.stats["failovers"] == 1
+    assert fb.stats["rate_limited"] == 1
+
+
+def test_huge_retry_after_skipped_at_round_boundary():
+    """When a retry round IS needed, a Retry-After floor that would sleep
+    past the remaining deadline is skipped: plain exponential backoff
+    runs instead and the skip is counted."""
+    limited = _RateLimitingNode(retry_after=1000.0)
+    backup = _ServingNode(fail_rounds=1)   # errors round 0, serves round 1
+    sleeps = []
+    t = [0.0]
+    fb = BeaconNodeFallback([limited, backup], max_retries=1,
+                            call_timeout=2.0, clock=lambda: t[0],
+                            sleep_fn=sleeps.append)
+    got = fb.first_success("publish_attestations", [])
+    assert got == {"served_by": "backup"}
+    # the floor (1000s, capped to 30s) still exceeds the 2s deadline →
+    # skipped; the round slept only the exponential 0.05s
+    assert sleeps == [0.05]
+    assert fb.stats["retry_after_skipped"] == 1
+    assert fb.stats["retry_after_honored"] == 0
